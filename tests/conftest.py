@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's `local[2]` in-process Spark trick
+(`utils/.../test/TestSparkContext.scala:36-80`): "distributed" behavior is
+tested on local virtual devices — here via XLA's host-platform device count,
+so every sharding/collective path is exercised without a TPU pod.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_uids():
+    """Deterministic uids per test (reference resets UID in test fixtures)."""
+    from transmogrifai_tpu.utils import uid
+    uid.reset()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

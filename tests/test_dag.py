@@ -1,0 +1,114 @@
+"""Feature DAG + stage wiring tests (reference: FeatureLikeTest, OpWorkflow DAG tests)."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Column, Dataset
+from transmogrifai_tpu.features import (
+    Feature, FeatureBuilder, FeatureCycleError, topological_layers, all_stages)
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage, Stage, Transformer
+
+
+class _Add(Transformer):
+    in_types = (t.Real, t.Real)
+    out_type = t.Real
+
+    def device_apply(self, enc, dev):
+        a, b = dev
+        return {"value": a["value"] + b["value"], "mask": a["mask"] * b["mask"]}
+
+
+def _raw(name, ftype=t.Real, response=False):
+    return FeatureGeneratorStage(name=name, ftype=ftype, is_response=response).get_output()
+
+
+def test_feature_builder_typed_factory():
+    f = FeatureBuilder.Real("age").from_column("age").as_predictor()
+    assert f.name == "age" and f.ftype is t.Real and not f.is_response
+    r = FeatureBuilder.RealNN("label").as_response()
+    assert r.is_response and r.is_raw
+
+
+def test_feature_builder_extract():
+    f = FeatureBuilder.Text("upper").extract(lambda row: row["s"].upper()).as_predictor()
+    ds = Dataset.from_rows([{"s": "ab"}, {"s": "cd"}])
+    col = f.origin_stage.materialize(ds)
+    assert list(col.data) == ["AB", "CD"]
+
+
+def test_from_dataset():
+    ds = Dataset.from_rows([
+        {"age": 22, "fare": 7.25, "survived": 1},
+        {"age": 38, "fare": 71.3, "survived": None},
+    ])
+    preds, label = FeatureBuilder.from_dataset(ds, response="survived")
+    assert {p.name for p in preds} == {"age", "fare"}
+    assert label.ftype is t.RealNN and label.is_response
+    col = label.origin_stage.materialize(ds)
+    np.testing.assert_allclose(col.data["value"], [1.0, 0.0])  # null→0.0 fill
+
+
+def test_stage_type_checking():
+    a, b = _raw("a"), _raw("b")
+    txt = _raw("s", t.Text)
+    _Add().set_input(a, b)  # ok
+    with pytest.raises(TypeError):
+        _Add().set_input(a, txt)
+    with pytest.raises(TypeError):
+        _Add().set_input(a)
+
+
+def test_get_output_wiring():
+    a, b = _raw("a"), _raw("b")
+    stage = _Add().set_input(a, b)
+    out = stage.get_output()
+    assert out.parents == (a, b)
+    assert out.origin_stage is stage
+    assert out.ftype is t.Real
+    assert not out.is_response
+    assert out.raw_features() == [a, b] or set(out.raw_features()) == {a, b}
+
+
+def test_transform_executes():
+    a, b = _raw("a"), _raw("b")
+    stage = _Add().set_input(a, b)
+    ca = Column.from_values(t.Real, [1.0, 2.0])
+    cb = Column.from_values(t.Real, [10.0, None])
+    out = stage.transform([ca, cb])
+    np.testing.assert_allclose(np.asarray(out.data["value"]), [11.0, 2.0])
+    np.testing.assert_allclose(np.asarray(out.data["mask"]), [1.0, 0.0])
+
+
+def test_topological_layers():
+    a, b, c = _raw("a"), _raw("b"), _raw("c")
+    ab = _Add().set_input(a, b).get_output()
+    abc = _Add().set_input(ab, c).get_output()
+    other = _Add().set_input(a, c).get_output()
+    layers = topological_layers([abc, other])
+    assert len(layers) == 3
+    assert {s.feature_name for s in layers[0]} == {"a", "b", "c"}
+    assert len(layers[1]) == 2  # ab, other
+    assert len(layers[2]) == 1  # abc
+    assert len(all_stages([abc, other])) == 6
+
+
+def test_cycle_detection():
+    a, b = _raw("a"), _raw("b")
+    s1 = _Add().set_input(a, b)
+    out1 = s1.get_output()
+    s2 = _Add().set_input(out1, a)
+    out2 = s2.get_output()
+    # force a cycle: rewire s1 to consume s2's output
+    s1.input_features = (out2, b)
+    with pytest.raises(FeatureCycleError):
+        topological_layers([out1])
+
+
+def test_response_propagation():
+    lbl = _raw("y", t.Real, response=True)
+    lbl2 = _raw("y2", t.Real, response=True)
+    out = _Add().set_input(lbl, lbl2).get_output()
+    assert out.is_response
+    mixed = _Add().set_input(lbl, _raw("x")).get_output()
+    assert not mixed.is_response

@@ -1,0 +1,100 @@
+"""Dataset / Column tests (reference: readers CSV tests, FeatureTypeSparkConverter tests)."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Column, Dataset
+from transmogrifai_tpu.data.columns import scalar_to_float
+
+
+CSV = """age,fare,survived,name,embarked
+22,7.25,0,Braund,S
+38,71.2833,1,Cumings,C
+,8.05,0,Unknown,
+26,,1,Heikkinen,S
+"""
+
+
+def test_csv_inference():
+    ds = Dataset.from_csv_string(CSV)
+    assert len(ds) == 4
+    assert ds.schema["age"] is t.Integral
+    assert ds.schema["fare"] is t.Real
+    assert ds.schema["survived"] is t.Integral  # 0/1 ints, like Spark CSV infer
+    assert ds.schema["name"] is t.Text
+    assert ds.column("age")[2] is None
+    assert ds.column("fare")[3] is None
+    assert ds.column("embarked")[2] is None
+    assert ds.column("survived")[1] == 1
+    bools = Dataset.from_csv_string("flag\ntrue\nfalse\n\n")
+    assert bools.schema["flag"] is t.Binary
+    assert bools.column("flag")[0] is True and bools.column("flag")[2] is None
+
+
+def test_csv_explicit_schema():
+    ds = Dataset.from_csv_string(CSV, schema={"survived": t.Integral, "embarked": t.PickList})
+    assert ds.schema["survived"] is t.Integral
+    assert ds.column("survived")[1] == 1
+    assert ds.schema["embarked"] is t.PickList
+
+
+def test_from_rows_and_take():
+    ds = Dataset.from_rows([
+        {"x": 1.0, "s": "a"}, {"x": None, "s": "b"}, {"x": 3.0, "s": None}])
+    assert ds.schema["x"] is t.Real and ds.schema["s"] is t.Text
+    sub = ds.take(np.array([0, 2]))
+    assert len(sub) == 2 and sub.column("x")[1] == 3.0
+
+
+def test_scalar_column():
+    c = Column.from_values(t.Real, [1.0, None, 3.5])
+    assert len(c) == 3
+    assert c.kind == "scalar"
+    np.testing.assert_array_equal(c.data["mask"], [True, False, True])
+    dv = c.device_value()
+    assert dv["value"].dtype == np.float32
+    np.testing.assert_allclose(dv["value"], [1.0, 0.0, 3.5])
+    vals = c.to_values()
+    assert vals[1].is_empty and vals[2].value == 3.5
+    f = scalar_to_float(c)
+    assert np.isnan(f[1]) and f[0] == 1.0
+
+
+def test_integral_column_keeps_int64():
+    ms = 1_577_836_800_123
+    c = Column.from_values(t.DateTime, [ms, None])
+    assert c.data["value"].dtype == np.int64
+    assert c.data["value"][0] == ms  # no float32 precision loss on host
+
+
+def test_text_and_collection_columns():
+    c = Column.from_values(t.PickList, ["a", None, "b"])
+    assert c.kind == "text" and c.data[1] is None
+    lc = Column.from_values(t.TextList, [["x"], [], None])
+    assert lc.kind == "list" and lc.data[1] is None and lc.data[2] is None
+    mc = Column.from_values(t.RealMap, [{"a": 1.0}, {}])
+    assert mc.kind == "map" and mc.data[1] is None
+
+
+def test_vector_column():
+    c = Column.from_values(t.OPVector, [[1, 2], [3, 4]])
+    assert c.kind == "vector" and c.width == 2
+    np.testing.assert_allclose(c.device_value(), [[1, 2], [3, 4]])
+
+
+def test_prediction_column_roundtrip():
+    p0 = t.Prediction.build(1.0, raw_prediction=[-1, 1], probability=[0.3, 0.7])
+    p1 = t.Prediction.build(0.0, raw_prediction=[2, -2], probability=[0.9, 0.1])
+    c = Column.from_values(t.Prediction, [p0, p1])
+    assert c.kind == "prediction"
+    vals = c.to_values()
+    assert vals[0].probability == [0.3, 0.7]
+    assert vals[1].prediction == 0.0
+
+
+def test_column_take():
+    c = Column.from_values(t.Real, [1.0, None, 3.0, 4.0])
+    s = c.take(np.array([0, 3]))
+    assert len(s) == 2
+    np.testing.assert_array_equal(s.data["mask"], [True, True])

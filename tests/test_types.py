@@ -1,0 +1,140 @@
+"""Feature type lattice tests (reference: features/src/test/.../types/*Test.scala)."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+
+
+def test_registry_has_all_major_types():
+    names = set(t.all_feature_types())
+    expected = {
+        "Real", "RealNN", "Binary", "Integral", "Percent", "Currency", "Date",
+        "DateTime", "Text", "Email", "Base64", "Phone", "ID", "URL", "TextArea",
+        "PickList", "ComboBox", "Country", "State", "City", "PostalCode",
+        "Street", "OPVector", "TextList", "DateList", "DateTimeList",
+        "MultiPickList", "Geolocation", "TextMap", "EmailMap", "Base64Map",
+        "PhoneMap", "IDMap", "URLMap", "TextAreaMap", "PickListMap",
+        "ComboBoxMap", "CountryMap", "StateMap", "CityMap", "PostalCodeMap",
+        "StreetMap", "GeolocationMap", "BinaryMap", "IntegralMap", "RealMap",
+        "PercentMap", "CurrencyMap", "DateMap", "DateTimeMap",
+        "MultiPickListMap", "NameStats", "Prediction",
+    }
+    missing = expected - names
+    assert not missing, f"missing types: {missing}"
+
+
+def test_real_nullability():
+    assert t.Real(None).is_empty
+    assert t.Real(1.5).value == 1.5
+    assert t.Real(float("nan")).is_empty
+    assert t.Real(1.5).is_nullable
+    with pytest.raises(t.FeatureTypeError):
+        t.RealNN(None)
+    assert not t.RealNN(2.0).is_nullable
+
+
+def test_numeric_coercions():
+    assert t.Integral(3.0).value == 3 and isinstance(t.Integral(3).value, int)
+    assert t.Binary(1).value is True
+    assert t.Binary(None).is_empty
+    assert t.Date(1577836800000).value == 1577836800000
+    assert isinstance(t.Currency(2.5), t.Real)
+    with pytest.raises(t.FeatureTypeError):
+        t.Real("abc")
+
+
+def test_equality_and_hash():
+    assert t.Real(1.0) == t.Real(1.0)
+    assert t.Real(1.0) != t.RealNN(1.0)  # type-strict equality
+    assert hash(t.Text("a")) == hash(t.Text("a"))
+    assert t.Text(None) == t.Text.empty()
+    s = {t.PickList("a"), t.PickList("a"), t.PickList("b")}
+    assert len(s) == 2
+
+
+def test_email_accessors():
+    e = t.Email("ada@lovelace.org")
+    assert e.prefix == "ada" and e.domain == "lovelace.org"
+    assert t.Email("notanemail").domain is None
+    assert t.Email(None).prefix is None
+
+
+def test_url_accessors():
+    u = t.URL("https://example.com/path?q=1")
+    assert u.domain == "example.com" and u.protocol == "https" and u.is_valid
+    assert not t.URL("gopher://x.y").is_valid
+    assert t.URL("example.com/p").domain == "example.com"
+
+
+def test_lists_and_sets():
+    assert t.TextList(["a", "b"]).value == ["a", "b"]
+    assert t.TextList(None).is_empty and len(t.TextList([])) == 0
+    with pytest.raises(t.FeatureTypeError):
+        t.TextList([1])
+    mpl = t.MultiPickList(["x", "y", "x"])
+    assert mpl.value == frozenset({"x", "y"}) and len(mpl) == 2
+    with pytest.raises(t.FeatureTypeError):
+        t.MultiPickList("bare-string")
+    assert t.DateList([1.0, 2]).value == [1, 2]
+
+
+def test_geolocation():
+    g = t.Geolocation([37.77, -122.42, 5.0])
+    assert g.lat == 37.77 and g.lon == -122.42 and g.accuracy == 5.0
+    assert t.Geolocation(None).is_empty
+    with pytest.raises(t.FeatureTypeError):
+        t.Geolocation([100.0, 0.0, 1.0])
+    with pytest.raises(t.FeatureTypeError):
+        t.Geolocation([1.0, 2.0])
+
+
+def test_opvector():
+    v = t.OPVector([1.0, 2.0, 3.0])
+    assert len(v) == 3 and not v.is_empty
+    assert v == t.OPVector(np.array([1, 2, 3]))
+    assert t.OPVector(None).is_empty
+    with pytest.raises(t.FeatureTypeError):
+        t.OPVector([[1.0], [2.0]])
+
+
+def test_maps():
+    m = t.RealMap({"a": 1, "b": None})
+    assert m["a"] == 1.0 and m["b"] is None
+    assert t.TextMap(None).is_empty
+    with pytest.raises(t.FeatureTypeError):
+        t.RealMap({"a": "oops"})
+    with pytest.raises(t.FeatureTypeError):
+        t.TextMap({1: "a"})
+    gm = t.GeolocationMap({"home": [1.0, 2.0, 3.0]})
+    assert gm["home"] == [1.0, 2.0, 3.0]
+    mm = t.MultiPickListMap({"k": ["a", "b"]})
+    assert mm["k"] == frozenset({"a", "b"})
+
+
+def test_prediction():
+    p = t.Prediction.build(1.0, raw_prediction=[-0.3, 0.3], probability=[0.2, 0.8])
+    assert p.prediction == 1.0
+    assert p.probability == [0.2, 0.8]
+    assert p.raw_prediction == [-0.3, 0.3]
+    with pytest.raises(t.FeatureTypeError):
+        t.Prediction({"probability_0": 0.1})  # missing 'prediction'
+    with pytest.raises(t.FeatureTypeError):
+        t.Prediction({"prediction": 1.0, "bogus": 2.0})
+
+
+def test_traits():
+    assert isinstance(t.PickList("a"), t.Categorical)
+    assert isinstance(t.MultiPickList(["a"]), t.MultiResponse)
+    assert isinstance(t.Country("US"), t.Location)
+    assert isinstance(t.Prediction.build(0.0), t.NonNullable)
+
+
+def test_uid():
+    from transmogrifai_tpu.utils.uid import UID, from_string, reset
+    reset()
+    u1, u2 = UID("Stage"), UID("Stage")
+    assert u1 != u2 and u1.startswith("Stage_")
+    assert from_string(u1) == ("Stage", "000000000001")
+    with pytest.raises(ValueError):
+        from_string("nope")

@@ -1,0 +1,26 @@
+"""transmogrifai_tpu — a TPU-native AutoML framework for structured data.
+
+A ground-up JAX/XLA re-design of the capabilities of TransmogrifAI
+(Scala/Spark reference surveyed in SURVEY.md): typed features over records,
+a lazy stage DAG, automated type-driven feature engineering, automated
+feature validation, cross-validated model selection swept across a TPU
+device mesh, model insights, and save/load plus batch/streaming/local
+scoring — all compiling to fused XLA programs.
+
+Quickstart (mirrors reference README.md:31-61):
+
+    import transmogrifai_tpu as op
+
+    ds = op.Dataset.from_csv("titanic.csv")
+    features, label = op.FeatureBuilder.from_dataset(ds, response="survived")
+    checked = op.transmogrify(features).sanity_check(label)
+    pred = op.BinaryClassificationModelSelector.with_cross_validation() \\
+             .set_input(label, checked).get_output()
+    model = op.Workflow().set_result_features(pred).set_input_dataset(ds).train()
+    scores = model.score(ds)
+"""
+
+from transmogrifai_tpu.utils.uid import UID
+from transmogrifai_tpu.types import *  # noqa: F401,F403 — the feature type lattice
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+from transmogrifai_tpu.data.columns import Column, kind_of
+from transmogrifai_tpu.data.metadata import VectorColumnMetadata, VectorMetadata
+from transmogrifai_tpu.data.dataset import Dataset
+
+__all__ = ["Column", "kind_of", "VectorColumnMetadata", "VectorMetadata", "Dataset"]

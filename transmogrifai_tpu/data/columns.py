@@ -1,0 +1,214 @@
+"""Columnar physical representation of typed feature data.
+
+This is where the row-level type lattice (`transmogrifai_tpu.types`) meets
+arrays. Each `Column` holds one feature's values for a whole batch in the
+layout best suited to its kind:
+
+- scalar (OPNumeric):  float64/int64 `value` + bool `mask` (True = present)
+- text:                object ndarray of str|None
+- list/set/geo:        object ndarray of list/frozenset
+- map:                 object ndarray of dict
+- vector (OPVector):   dense (n, d) float32 array + `VectorMetadata`
+- prediction:          dict of arrays {prediction (n,), probability (n,k),
+                       rawPrediction (n,k)}
+
+The device contract: `Column.device_value()` returns the pytree of numeric
+arrays a jitted stage consumes — strings and other host-only kinds return
+None and must be encoded by a stage's `host_prepare` (see stages.base).
+Reference analogue: `FeatureTypeSparkConverter` / DataFrame columns
+(`features/.../FeatureSparkTypes.scala:54-96`), redesigned for XLA: static
+dtypes, dense tiles, masks instead of in-band nulls.
+"""
+
+from __future__ import annotations
+
+import math
+import numbers
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.metadata import VectorMetadata
+
+SCALAR, TEXT, LIST, MAP, VECTOR, PREDICTION = (
+    "scalar", "text", "list", "map", "vector", "prediction")
+
+
+def kind_of(ftype: type) -> str:
+    if not (isinstance(ftype, type) and issubclass(ftype, T.FeatureType)):
+        raise TypeError(f"{ftype!r} is not a FeatureType class")
+    if issubclass(ftype, T.Prediction):
+        return PREDICTION
+    if issubclass(ftype, T.OPMap):
+        return MAP
+    if issubclass(ftype, T.OPVector):
+        return VECTOR
+    if issubclass(ftype, (T.OPList, T.OPSet)):
+        return LIST
+    if issubclass(ftype, T.OPNumeric):
+        return SCALAR
+    if issubclass(ftype, T.Text):
+        return TEXT
+    raise TypeError(f"No columnar kind for {ftype.__name__}")
+
+
+def _is_integral(ftype: type) -> bool:
+    return issubclass(ftype, T.Integral)
+
+
+@dataclass
+class Column:
+    """One feature's values for a batch, in columnar layout."""
+
+    ftype: type
+    data: Any
+    meta: Optional[VectorMetadata] = None
+
+    @property
+    def kind(self) -> str:
+        return kind_of(self.ftype)
+
+    def __len__(self) -> int:
+        k = self.kind
+        if k == SCALAR:
+            return int(self.data["value"].shape[0])
+        if k == VECTOR:
+            return int(self.data.shape[0])
+        if k == PREDICTION:
+            return int(self.data["prediction"].shape[0])
+        return int(self.data.shape[0])
+
+    @property
+    def width(self) -> int:
+        """Vector width (vector kind) or probability width (prediction kind)."""
+        k = self.kind
+        if k == VECTOR:
+            return int(self.data.shape[1])
+        if k == PREDICTION:
+            return int(self.data["probability"].shape[1])
+        raise TypeError(f"width undefined for kind {k}")
+
+    # ------------------------------------------------------------------ #
+    # construction                                                       #
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def from_values(ftype: type, values: Sequence[Any]) -> "Column":
+        """Build a column from raw python values (each may be a FeatureType
+        instance or a plain value acceptable to `ftype`)."""
+        k = kind_of(ftype)
+        n = len(values)
+
+        def unwrap(v):
+            if isinstance(v, T.FeatureType):
+                return v.value
+            return ftype(v).value  # validate via the type
+
+        if k == SCALAR:
+            dtype = np.int64 if _is_integral(ftype) else np.float64
+            out = np.zeros(n, dtype=dtype)
+            mask = np.zeros(n, dtype=bool)
+            for i, v in enumerate(values):
+                u = unwrap(v)
+                if u is not None:
+                    out[i] = u
+                    mask[i] = True
+            return Column(ftype, {"value": out, "mask": mask})
+        if k == VECTOR:
+            rows = [np.asarray(unwrap(v), dtype=np.float32) for v in values]
+            if n == 0:
+                return Column(ftype, np.zeros((0, 0), dtype=np.float32))
+            width = max((r.size for r in rows), default=0)
+            arr = np.zeros((n, width), dtype=np.float32)
+            for i, r in enumerate(rows):
+                arr[i, : r.size] = r
+            return Column(ftype, arr)
+        if k == PREDICTION:
+            preds = [T.Prediction(unwrap(v)) for v in values]
+            width = max((len(p.probability) for p in preds), default=0)
+            rwidth = max((len(p.raw_prediction) for p in preds), default=0)
+            data = {
+                "prediction": np.array([p.prediction for p in preds], dtype=np.float64),
+                "probability": np.zeros((n, width), dtype=np.float64),
+                "rawPrediction": np.zeros((n, rwidth), dtype=np.float64),
+            }
+            for i, p in enumerate(preds):
+                pr, rw = p.probability, p.raw_prediction
+                data["probability"][i, : len(pr)] = pr
+                data["rawPrediction"][i, : len(rw)] = rw
+            return Column(ftype, data)
+        # host-object kinds
+        arr = np.empty(n, dtype=object)
+        for i, v in enumerate(values):
+            u = unwrap(v)
+            arr[i] = None if (u is None or (k != TEXT and len(u) == 0)) else u
+        return Column(ftype, arr)
+
+    @staticmethod
+    def vector(arr, meta: VectorMetadata) -> "Column":
+        return Column(T.OPVector, arr, meta=meta)
+
+    # ------------------------------------------------------------------ #
+    # access                                                             #
+    # ------------------------------------------------------------------ #
+
+    def device_value(self):
+        """Numeric pytree for jitted stages; None for host-only kinds."""
+        k = self.kind
+        if k == SCALAR:
+            v = np.asarray(self.data["value"], dtype=np.float64)
+            m = np.asarray(self.data["mask"])
+            return {
+                "value": np.where(m, v, 0.0).astype(np.float32),
+                "mask": m.astype(np.float32),
+            }
+        if k == VECTOR:
+            return self.data
+        if k == PREDICTION:
+            return self.data
+        return None
+
+    def to_values(self) -> List[T.FeatureType]:
+        """Rehydrate row-level typed values (tests / local scoring)."""
+        k = self.kind
+        n = len(self)
+        if k == SCALAR:
+            val, mask = self.data["value"], self.data["mask"]
+            return [
+                self.ftype(val[i].item() if mask[i] else None) for i in range(n)
+            ]
+        if k == VECTOR:
+            arr = np.asarray(self.data)
+            return [T.OPVector(arr[i]) for i in range(n)]
+        if k == PREDICTION:
+            out = []
+            for i in range(n):
+                out.append(T.Prediction.build(
+                    float(self.data["prediction"][i]),
+                    raw_prediction=np.asarray(self.data["rawPrediction"][i]).tolist(),
+                    probability=np.asarray(self.data["probability"][i]).tolist(),
+                ))
+            return out
+        return [self.ftype(self.data[i]) for i in range(n)]
+
+    def take(self, idx) -> "Column":
+        """Row subset (numpy fancy index / bool mask)."""
+        k = self.kind
+        if k == SCALAR:
+            return Column(self.ftype, {
+                "value": np.asarray(self.data["value"])[idx],
+                "mask": np.asarray(self.data["mask"])[idx]})
+        if k == PREDICTION:
+            return Column(self.ftype, {key: np.asarray(a)[idx] for key, a in self.data.items()})
+        if k == VECTOR:
+            return Column(self.ftype, np.asarray(self.data)[idx], meta=self.meta)
+        return Column(self.ftype, self.data[idx])
+
+
+def scalar_to_float(col: Column) -> np.ndarray:
+    """Host helper: scalar column → float64 with NaN for missing."""
+    v = np.asarray(col.data["value"], dtype=np.float64).copy()
+    v[~np.asarray(col.data["mask"])] = np.nan
+    return v

@@ -1,0 +1,220 @@
+"""Host-side columnar dataset.
+
+The workflow's input currency: an in-memory dict of named columns, each a
+numpy array (object arrays for text/collections, numeric+mask pairs for
+scalars come later at Column materialization). This replaces the reference's
+Spark DataFrame at L0 (SURVEY.md §1): on TPU the data plane is host columnar
+buffers → device-shardable dense batches, not a distributed DataFrame.
+
+Reference analogues: `readers/.../DataReader.scala:174-259` (record→schema'd
+rows), `CSVAutoReaders.scala` (schema inference).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+
+
+_TRUE = {"true", "t", "yes", "y"}
+_FALSE = {"false", "f", "no", "n"}
+_MISSING = {"", "na", "n/a", "null", "none", "nan"}
+
+
+def _infer_ftype(values: Iterable[Optional[str]]) -> type:
+    """Infer a feature type from string cells: Integral → Real → Binary → Text."""
+    saw_any = False
+    could_int = could_float = could_bool = True
+    for s in values:
+        if s is None:
+            continue
+        saw_any = True
+        ls = s.strip().lower()
+        if could_bool and ls not in _TRUE and ls not in _FALSE:
+            could_bool = False
+        if could_int:
+            try:
+                int(s)
+            except ValueError:
+                could_int = False
+        if not could_int and could_float:
+            try:
+                float(s)
+            except ValueError:
+                could_float = False
+        if not (could_int or could_float or could_bool):
+            return T.Text
+    if not saw_any:
+        return T.Text
+    if could_bool:
+        return T.Binary
+    if could_int:
+        return T.Integral
+    if could_float:
+        return T.Real
+    return T.Text
+
+
+def _parse_cell(s: Optional[str], ftype: type) -> Any:
+    if s is None:
+        return None
+    if isinstance(s, str) and s.strip().lower() in _MISSING:
+        return None
+    if issubclass(ftype, T.Binary):
+        ls = s.strip().lower()
+        if ls in _TRUE:
+            return True
+        if ls in _FALSE:
+            return False
+        return bool(float(s))
+    if issubclass(ftype, T.Integral):
+        try:
+            return int(s)  # exact for big ints (no float64 round-trip)
+        except ValueError:
+            return int(float(s))
+    if issubclass(ftype, T.OPNumeric):
+        return float(s)
+    return s
+
+
+@dataclass
+class Dataset:
+    """Named object-array columns + an optional schema of feature types."""
+
+    columns: Dict[str, np.ndarray]
+    schema: Dict[str, type]
+
+    def __post_init__(self):
+        lengths = {len(a) for a in self.columns.values()}
+        if len(lengths) > 1:
+            raise ValueError(f"Ragged columns: {sorted(lengths)}")
+        self._rows_cache: Optional[List[Dict[str, Any]]] = None
+
+    def __len__(self) -> int:
+        for a in self.columns.values():
+            return len(a)
+        return 0
+
+    @property
+    def n_rows(self) -> int:
+        return len(self)
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def names(self) -> List[str]:
+        return list(self.columns)
+
+    def take(self, idx) -> "Dataset":
+        return Dataset({k: v[idx] for k, v in self.columns.items()}, dict(self.schema))
+
+    def with_column(self, name: str, values: np.ndarray, ftype: type) -> "Dataset":
+        cols = dict(self.columns)
+        cols[name] = values
+        schema = dict(self.schema)
+        schema[name] = ftype
+        return Dataset(cols, schema)
+
+    def to_rows(self) -> List[Dict[str, Any]]:
+        """Row-dict view; cached since every extract-fn feature re-reads it."""
+        if self._rows_cache is None:
+            names = self.names()
+            self._rows_cache = [
+                {k: self.columns[k][i] for k in names} for i in range(len(self))
+            ]
+        return self._rows_cache
+
+    # ------------------------------------------------------------------ #
+    # constructors                                                       #
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def from_rows(rows: Sequence[Mapping[str, Any]],
+                  schema: Optional[Mapping[str, type]] = None) -> "Dataset":
+        keys: List[str] = []
+        for r in rows:
+            for k in r:
+                if k not in keys:
+                    keys.append(k)
+        cols: Dict[str, np.ndarray] = {}
+        for k in keys:
+            arr = np.empty(len(rows), dtype=object)
+            for i, r in enumerate(rows):
+                v = r.get(k)
+                arr[i] = v.value if isinstance(v, T.FeatureType) else v
+            cols[k] = arr
+        sch = dict(schema) if schema else {k: _infer_py_type(cols[k]) for k in keys}
+        return Dataset(cols, sch)
+
+    @staticmethod
+    def from_csv(path_or_buf, schema: Optional[Mapping[str, type]] = None,
+                 delimiter: str = ",") -> "Dataset":
+        """Read a headered CSV; infer Integral/Real/Binary/Text per column
+        unless a schema is given (CSVAutoReaders.scala analogue)."""
+        if isinstance(path_or_buf, (str,)):
+            f = open(path_or_buf, "r", newline="")
+            close = True
+        else:
+            f, close = path_or_buf, False
+        try:
+            reader = csv.reader(f, delimiter=delimiter)
+            try:
+                header = next(reader)
+            except StopIteration:
+                return Dataset({}, {})
+            raw: List[List[Optional[str]]] = [[] for _ in header]
+            for row in reader:
+                for j in range(len(header)):
+                    cell = row[j] if j < len(row) else ""
+                    raw[j].append(None if cell.strip().lower() in _MISSING else cell)
+        finally:
+            if close:
+                f.close()
+        sch: Dict[str, type] = {}
+        cols: Dict[str, np.ndarray] = {}
+        for j, name in enumerate(header):
+            ftype = (schema or {}).get(name) or _infer_ftype(raw[j])
+            sch[name] = ftype
+            arr = np.empty(len(raw[j]), dtype=object)
+            for i, cell in enumerate(raw[j]):
+                arr[i] = _parse_cell(cell, ftype)
+            cols[name] = arr
+        return Dataset(cols, sch)
+
+    @staticmethod
+    def from_csv_string(text: str, **kw) -> "Dataset":
+        return Dataset.from_csv(io.StringIO(text), **kw)
+
+
+def _infer_py_type(arr: np.ndarray) -> type:
+    for v in arr:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return T.Binary
+        if isinstance(v, int):
+            return T.Integral
+        if isinstance(v, float):
+            return T.Real
+        if isinstance(v, str):
+            return T.Text
+        if isinstance(v, (list, tuple)):
+            if len(v) and isinstance(v[0], str):
+                return T.TextList
+            try:
+                T.Geolocation._convert(list(v))
+                return T.Geolocation
+            except T.FeatureTypeError:
+                return T.DateList  # generic numeric list
+        if isinstance(v, (set, frozenset)):
+            return T.MultiPickList
+        if isinstance(v, dict):
+            return T.TextMap
+    return T.Text

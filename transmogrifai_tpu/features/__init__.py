@@ -1,0 +1,9 @@
+from transmogrifai_tpu.features.feature import Feature, FeatureBuilder
+from transmogrifai_tpu.features.dag import (
+    topological_layers, all_stages, FeatureCycleError,
+)
+
+__all__ = [
+    "Feature", "FeatureBuilder", "topological_layers", "all_stages",
+    "FeatureCycleError",
+]

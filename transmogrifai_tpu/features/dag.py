@@ -1,0 +1,80 @@
+"""DAG scheduling: topological layering with cycle detection.
+
+Reference parity: `FeatureLike.parentStages` longest-path topological sort
+(`features/.../FeatureLike.scala:370-432`, cycle throw at `:412`) and
+`FitStagesUtil.computeDAG` (`core/.../utils/stages/FitStagesUtil.scala:173`).
+
+Layering rule: `layer(stage) = 1 + max(layer(parent stages))`, raw
+FeatureGeneratorStages at layer 0. All of a stage's inputs are produced in
+strictly earlier layers, so the workflow fits layer-by-layer and fuses every
+transformer of a layer into one device pass — the XLA analogue of the
+reference's `fitAndTransformLayer` single row-map.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage, Stage
+
+
+class FeatureCycleError(RuntimeError):
+    """The feature graph contains a cycle (FeatureCycleException analogue)."""
+
+
+def all_stages(result_features: Sequence) -> List[Stage]:
+    """Every origin stage reachable from the result features (deduped)."""
+    seen: Dict[str, Stage] = {}
+
+    def visit(f) -> None:
+        s = f.origin_stage
+        if s is not None and s.uid not in seen:
+            seen[s.uid] = s
+        for p in f.parents:
+            visit(p)
+
+    for f in result_features:
+        visit(f)
+    return list(seen.values())
+
+
+def topological_layers(result_features: Sequence) -> List[List[Stage]]:
+    """Layered schedule of all stages reachable from `result_features`.
+
+    Returns layers in execution order; layer 0 is all raw feature
+    generators. Raises FeatureCycleError on cyclic graphs.
+    """
+    depth: Dict[str, int] = {}
+    stages: Dict[str, Stage] = {}
+    visiting: set = set()
+
+    def visit(stage: Stage) -> int:
+        if stage.uid in depth:
+            return depth[stage.uid]
+        if stage.uid in visiting:
+            raise FeatureCycleError(
+                f"Cycle detected through stage {stage.operation_name} ({stage.uid})")
+        visiting.add(stage.uid)
+        if isinstance(stage, FeatureGeneratorStage) or not stage.input_features:
+            d = 0
+        else:
+            d = 1 + max(visit(p.origin_stage) for p in stage.input_features)
+        visiting.discard(stage.uid)
+        depth[stage.uid] = d
+        stages[stage.uid] = stage
+        return d
+
+    for f in result_features:
+        if f.origin_stage is not None:
+            visit(f.origin_stage)
+
+    if not stages:
+        return []
+    n_layers = max(depth.values()) + 1
+    layers: List[List[Stage]] = [[] for _ in range(n_layers)]
+    for uid, d in depth.items():
+        layers[d].append(stages[uid])
+    # deterministic order within a layer
+    for layer in layers:
+        layer.sort(key=lambda s: s.uid)
+    return layers

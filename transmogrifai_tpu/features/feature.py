@@ -1,0 +1,175 @@
+"""Feature DAG nodes + typed raw-feature factories.
+
+Reference parity: `features/.../FeatureLike.scala:49-481`, `Feature.scala:55`,
+`FeatureBuilder.scala:48-351`. A Feature is a lazy, typed handle on a column
+that will exist once the workflow materializes the DAG; nothing computes at
+definition time. DSL operations (transmogrify, sanity_check, arithmetic, …)
+attach to this class from `transmogrifai_tpu.dsl`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage
+from transmogrifai_tpu.utils.uid import UID
+
+
+class Feature:
+    """A typed node in the lazy feature DAG (FeatureLike/Feature)."""
+
+    __slots__ = ("name", "ftype", "is_response", "origin_stage", "parents",
+                 "uid", "distributions")
+
+    def __init__(self, name: str, ftype: type, origin_stage,
+                 parents: Tuple["Feature", ...] = (), is_response: bool = False,
+                 uid: Optional[str] = None):
+        if not (isinstance(ftype, type) and issubclass(ftype, T.FeatureType)):
+            raise TypeError(f"ftype must be a FeatureType class, got {ftype!r}")
+        self.name = name
+        self.ftype = ftype
+        self.is_response = is_response
+        self.origin_stage = origin_stage
+        self.parents = tuple(parents)
+        self.uid = uid or UID("Feature")
+        self.distributions: List[Any] = []  # filled by RawFeatureFilter
+
+    @property
+    def is_raw(self) -> bool:
+        return len(self.parents) == 0
+
+    def raw_features(self) -> List["Feature"]:
+        """All raw ancestors, depth-first, deduped (FeatureLike.scala:345)."""
+        seen: Dict[str, Feature] = {}
+
+        def visit(f: "Feature") -> None:
+            if f.is_raw:
+                seen.setdefault(f.uid, f)
+                return
+            for p in f.parents:
+                visit(p)
+
+        visit(self)
+        return list(seen.values())
+
+    def traverse(self) -> List["Feature"]:
+        """All features in this subtree (self included), parents first."""
+        out: List[Feature] = []
+        seen = set()
+
+        def visit(f: "Feature") -> None:
+            if f.uid in seen:
+                return
+            seen.add(f.uid)
+            for p in f.parents:
+                visit(p)
+            out.append(f)
+
+        visit(self)
+        return out
+
+    def history(self) -> Dict[str, List[str]]:
+        """origin stage chain per raw ancestor (OpVectorColumnHistory-ish)."""
+        stages: List[str] = []
+        for f in self.traverse():
+            if f.origin_stage is not None and not f.is_raw:
+                stages.append(f.origin_stage.operation_name)
+        return {
+            "origin_features": [r.name for r in self.raw_features()],
+            "stages": stages,
+        }
+
+    def __repr__(self) -> str:
+        kind = "response" if self.is_response else "predictor"
+        return f"Feature<{self.ftype.__name__}>({self.name!r}, {kind})"
+
+    # Equality is identity (each node is unique in the DAG); hash by uid.
+    def __hash__(self) -> int:
+        return hash(self.uid)
+
+
+class _TypedBuilder:
+    """`FeatureBuilder.Real("age")`-style factory (FeatureBuilder.scala:52-230)."""
+
+    def __init__(self, name: str, ftype: type):
+        self.name = name
+        self.ftype = ftype
+        self._extract: Optional[Callable] = None
+        self._column: Optional[str] = None
+        self._aggregator = None
+        self._aggregate_window = None
+
+    def extract(self, fn: Callable[[Dict[str, Any]], Any]) -> "_TypedBuilder":
+        """Per-record extract function (macro-captured fn in the reference)."""
+        self._extract = fn
+        return self
+
+    def from_column(self, column: str) -> "_TypedBuilder":
+        """Vectorized extraction of a named dataset column (fast path)."""
+        self._column = column
+        return self
+
+    def aggregate(self, aggregator, window=None) -> "_TypedBuilder":
+        """Event-aggregation monoid (readers milestone; stored for parity)."""
+        self._aggregator = aggregator
+        self._aggregate_window = window
+        return self
+
+    def _build(self, is_response: bool) -> Feature:
+        stage = FeatureGeneratorStage(
+            name=self.name, ftype=self.ftype, extract=self._extract,
+            column=self._column, is_response=is_response)
+        if self._aggregator is not None:
+            stage.params["aggregator"] = self._aggregator
+            stage.params["aggregate_window"] = self._aggregate_window
+        return stage.get_output()
+
+    def as_predictor(self) -> Feature:
+        return self._build(is_response=False)
+
+    def as_response(self) -> Feature:
+        return self._build(is_response=True)
+
+
+class _FeatureBuilderMeta(type):
+    def __getattr__(cls, type_name: str):
+        try:
+            ftype = T.feature_type_by_name(type_name)
+        except T.FeatureTypeError:
+            raise AttributeError(type_name) from None
+
+        def make(name: str) -> _TypedBuilder:
+            return _TypedBuilder(name, ftype)
+
+        return make
+
+
+class FeatureBuilder(metaclass=_FeatureBuilderMeta):
+    """Raw feature factories: `FeatureBuilder.Real("age").from_column("age")
+    .as_predictor()` or schema-driven `FeatureBuilder.from_dataset(ds, ...)`
+    (FeatureBuilder.scala:232-266 `fromDataFrame`)."""
+
+    @staticmethod
+    def from_dataset(dataset, response: str,
+                     response_type: type = T.RealNN,
+                     ignore: Sequence[str] = ()) -> Tuple[List[Feature], Feature]:
+        """Auto-build typed raw features from a Dataset schema; the response
+        column becomes a `response_type` (default RealNN, as in the
+        reference's `fromDataFrame[RealNN]`)."""
+        if response not in dataset.schema:
+            raise KeyError(f"Response column {response!r} not in dataset")
+        preds: List[Feature] = []
+        for name, ftype in dataset.schema.items():
+            if name == response or name in ignore:
+                continue
+            stage = FeatureGeneratorStage(name=name, ftype=ftype, column=name)
+            preds.append(stage.get_output())
+
+        resp_src = dataset.schema[response]
+        null_fill = 0.0 if (issubclass(response_type, T.RealNN)
+                            and not issubclass(resp_src, T.RealNN)) else None
+        stage = FeatureGeneratorStage(
+            name=response, ftype=response_type, column=response,
+            is_response=True, null_fill=null_fill)
+        return preds, stage.get_output()

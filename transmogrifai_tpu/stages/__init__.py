@@ -1,0 +1,9 @@
+from transmogrifai_tpu.stages.base import (
+    FitContext, Stage, Transformer, HostTransformer, Estimator,
+    FeatureGeneratorStage, StageRegistry,
+)
+
+__all__ = [
+    "FitContext", "Stage", "Transformer", "HostTransformer", "Estimator",
+    "FeatureGeneratorStage", "StageRegistry",
+]
