@@ -1,0 +1,240 @@
+"""Vectorizer tests (reference: core/src/test/.../feature/*VectorizerTest.scala)."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Column
+from transmogrifai_tpu.ops import (
+    BinaryVectorizer, DateToUnitCircleVectorizer, GeolocationVectorizer,
+    HashingVectorizer, IntegralVectorizer, MultiPickListVectorizer,
+    OneHotVectorizer, RealNNVectorizer, RealVectorizer, SmartTextVectorizer,
+    TextTokenizer, VectorsCombiner)
+from transmogrifai_tpu.ops.maps import (
+    NumericMapVectorizer, TextMapPivotVectorizer)
+from transmogrifai_tpu.ops.text import murmur3_32
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage, FitContext
+
+
+def _raw(name, ftype):
+    return FeatureGeneratorStage(name=name, ftype=ftype).get_output()
+
+
+def _fit_transform(est, feats, cols):
+    est.set_input(*feats)
+    model = est.fit(cols, FitContext(n_rows=len(cols[0])))
+    out = model.transform(cols)
+    return model, out
+
+
+def test_real_vectorizer_mean_impute():
+    f = _raw("x", t.Real)
+    col = Column.from_values(t.Real, [1.0, None, 3.0])
+    model, out = _fit_transform(RealVectorizer(), [f], [col])
+    arr = np.asarray(out.data)
+    # mean of (1, 3) = 2 imputed; null indicator second column
+    np.testing.assert_allclose(arr, [[1, 0], [2, 1], [3, 0]])
+    meta = out.meta
+    assert meta.size == 2
+    assert meta.columns[1].is_null_indicator
+    assert meta.columns[0].parent_name == "x"
+
+
+def test_real_vectorizer_multi_feature():
+    fs = [_raw("a", t.Real), _raw("b", t.Real)]
+    cols = [Column.from_values(t.Real, [1.0, None]),
+            Column.from_values(t.Real, [None, 10.0])]
+    model, out = _fit_transform(RealVectorizer(), fs, cols)
+    arr = np.asarray(out.data)
+    np.testing.assert_allclose(arr, [[1, 0, 10, 1], [1, 1, 10, 0]])
+
+
+def test_integral_mode_impute():
+    f = _raw("n", t.Integral)
+    col = Column.from_values(t.Integral, [5, 5, 7, None])
+    model, out = _fit_transform(IntegralVectorizer(), [f], [col])
+    arr = np.asarray(out.data)
+    np.testing.assert_allclose(arr[3], [5, 1])  # mode=5 imputed
+
+
+def test_binary_vectorizer():
+    f = _raw("b", t.Binary)
+    col = Column.from_values(t.Binary, [True, False, None])
+    model, out = _fit_transform(BinaryVectorizer(), [f], [col])
+    np.testing.assert_allclose(np.asarray(out.data), [[1, 0], [0, 0], [0, 1]])
+
+
+def test_realnn_identity():
+    fs = [_raw("a", t.RealNN), _raw("b", t.RealNN)]
+    cols = [Column.from_values(t.RealNN, [1.0, 2.0]),
+            Column.from_values(t.RealNN, [3.0, 4.0])]
+    stage = RealNNVectorizer().set_input(*fs)
+    out = stage.transform(cols)
+    np.testing.assert_allclose(np.asarray(out.data), [[1, 3], [2, 4]])
+    assert out.meta.size == 2
+
+
+def test_one_hot_top_k():
+    f = _raw("c", t.PickList)
+    values = ["a"] * 5 + ["b"] * 3 + ["c"] * 1 + [None] * 2
+    col = Column.from_values(t.PickList, values)
+    model, out = _fit_transform(
+        OneHotVectorizer(top_k=2, min_support=2), [f], [col])
+    arr = np.asarray(out.data)
+    assert arr.shape == (11, 4)  # a, b, OTHER, NULL
+    np.testing.assert_allclose(arr[0], [1, 0, 0, 0])   # a
+    np.testing.assert_allclose(arr[5], [0, 1, 0, 0])   # b
+    np.testing.assert_allclose(arr[8], [0, 0, 1, 0])   # c → OTHER
+    np.testing.assert_allclose(arr[9], [0, 0, 0, 1])   # null
+    ivals = [m.indicator_value for m in out.meta.columns]
+    assert ivals == ["a", "b", "OTHER", "NullIndicatorValue"]
+
+
+def test_multipicklist_vectorizer():
+    f = _raw("tags", t.MultiPickList)
+    col = Column.from_values(
+        t.MultiPickList, [{"x", "y"}, {"x"}, None, {"z", "x"}])
+    model, out = _fit_transform(
+        MultiPickListVectorizer(top_k=2, min_support=1), [f], [col])
+    arr = np.asarray(out.data)
+    assert arr.shape == (4, 4)
+    np.testing.assert_allclose(arr[0], [1, 1, 0, 0])  # x, y
+    np.testing.assert_allclose(arr[2], [0, 0, 0, 1])  # null
+    np.testing.assert_allclose(arr[3], [1, 0, 1, 0])  # x + OTHER(z)
+
+
+def test_murmur3_deterministic():
+    assert murmur3_32(b"") == 0
+    assert murmur3_32(b"hello") == murmur3_32(b"hello")
+    assert murmur3_32(b"hello") != murmur3_32(b"hellp")
+    # known vector: murmur3_32("hello", seed=0) = 0x248bfa47
+    assert murmur3_32(b"hello") == 0x248BFA47
+
+
+def test_hashing_vectorizer():
+    f = _raw("words", t.TextList)
+    col = Column.from_values(t.TextList, [["cat", "dog"], ["cat"], None])
+    stage = HashingVectorizer(num_features=16).set_input(f)
+    out = stage.transform([col])
+    arr = np.asarray(out.data)
+    assert arr.shape == (3, 17)  # 16 hash + 1 null indicator
+    assert arr[0].sum() == 2 and arr[1].sum() == 1
+    assert arr[2, 16] == 1.0  # null indicator
+    # same token → same bucket
+    assert np.array_equal(np.nonzero(arr[1, :16])[0],
+                          np.intersect1d(np.nonzero(arr[0, :16])[0],
+                                         np.nonzero(arr[1, :16])[0]))
+
+
+def test_tokenizer():
+    f = _raw("txt", t.Text)
+    col = Column.from_values(t.Text, ["Hello, World! 123", None, ""])
+    out = TextTokenizer().set_input(f).transform([col])
+    assert out.data[0] == ["hello", "world", "123"]
+    assert out.data[1] is None and out.data[2] is None
+
+
+def test_smart_text_pivot_vs_hash_vs_ignore():
+    low = _raw("low", t.Text)    # low cardinality → pivot
+    high = _raw("high", t.Text)  # high cardinality, repeating → hash
+    ids = _raw("ids", t.Text)    # all-unique → ignore
+    n = 60
+    low_col = Column.from_values(t.Text, ["a" if i % 2 else "b" for i in range(n)])
+    high_col = Column.from_values(
+        t.Text, [f"tok{i % 30} blah filler" for i in range(n)])  # 30 distinct / 60
+    ids_col = Column.from_values(t.Text, [f"id_{i}" for i in range(n)])
+    est = SmartTextVectorizer(max_cardinality=10, top_k=5, min_support=1,
+                              num_features=32)
+    model, out = _fit_transform(est, [low, high, ids], [low_col, high_col, ids_col])
+    assert model.strategies == ["pivot", "hash", "ignore"]
+    arr = np.asarray(out.data)
+    # widths: pivot = 2 levels + OTHER + NULL = 4; hash = 32 + 1; ignore = 1
+    assert arr.shape == (n, 4 + 33 + 1)
+    assert out.meta.size == arr.shape[1]
+
+
+def test_date_unit_circle():
+    f = _raw("d", t.Date)
+    noon = 12 * 3_600_000
+    col = Column.from_values(t.Date, [noon, None])
+    stage = DateToUnitCircleVectorizer(periods=("HourOfDay",)).set_input(f)
+    out = stage.transform([col])
+    arr = np.asarray(out.data)
+    assert arr.shape == (2, 2)
+    np.testing.assert_allclose(arr[0], [0.0, -1.0], atol=1e-5)  # noon = π
+    np.testing.assert_allclose(arr[1], [0.0, 0.0])  # null → origin
+    # day-of-week: 1970-01-01 was Thursday → fraction 3/7
+    from transmogrifai_tpu.ops.dates import _phase_fraction
+    assert _phase_fraction(np.array([0]), "DayOfWeek")[0] == pytest.approx(3 / 7)
+
+
+def test_geolocation_vectorizer():
+    f = _raw("loc", t.Geolocation)
+    col = Column.from_values(
+        t.Geolocation, [[10.0, 20.0, 1.0], None, [30.0, 40.0, 3.0]])
+    model, out = _fit_transform(GeolocationVectorizer(), [f], [col])
+    arr = np.asarray(out.data)
+    assert arr.shape == (3, 4)
+    np.testing.assert_allclose(arr[1], [20, 30, 2, 1])  # mean fill + null flag
+
+
+def test_numeric_map_vectorizer():
+    f = _raw("m", t.RealMap)
+    col = Column.from_values(
+        t.RealMap, [{"a": 1.0, "b": 2.0}, {"a": 3.0}, None])
+    model, out = _fit_transform(NumericMapVectorizer(), [f], [col])
+    arr = np.asarray(out.data)
+    # keys sorted: a, b → [a, a_null, b, b_null]
+    assert arr.shape == (3, 4)
+    np.testing.assert_allclose(arr[1], [3, 0, 2, 1])  # b missing → mean 2
+    np.testing.assert_allclose(arr[2], [2, 1, 2, 1])
+    assert [c.grouping for c in out.meta.columns] == ["a", "a", "b", "b"]
+
+
+def test_text_map_pivot():
+    f = _raw("tm", t.TextMap)
+    col = Column.from_values(
+        t.TextMap, [{"k": "x"}, {"k": "y"}, {"k": "x"}, None])
+    model, out = _fit_transform(
+        TextMapPivotVectorizer(top_k=5, min_support=1), [f], [col])
+    arr = np.asarray(out.data)
+    assert arr.shape == (4, 4)  # x, y, OTHER, NULL
+    np.testing.assert_allclose(arr[0], [1, 0, 0, 0])
+    np.testing.assert_allclose(arr[3], [0, 0, 0, 1])
+
+
+def test_vectors_combiner_meta_union():
+    fa, fb = _raw("a", t.Real), _raw("c", t.PickList)
+    ca = Column.from_values(t.Real, [1.0, None, 2.0, 2.0, None])
+    cb = Column.from_values(t.PickList, ["u", "v", "u", None, "u"])
+    ra = RealVectorizer().set_input(fa)
+    ma = ra.fit([ca], FitContext(5))
+    oh = OneHotVectorizer(top_k=3, min_support=1).set_input(fb)
+    mb = oh.fit([cb], FitContext(5))
+    va, vb = ma.get_output(), mb.get_output()
+    comb = VectorsCombiner().set_input(va, vb)
+    out = comb.transform([ma.transform([ca]), mb.transform([cb])])
+    arr = np.asarray(out.data)
+    assert arr.shape == (5, 2 + 4)
+    meta = comb.output_meta()
+    assert meta.size == 6
+    assert [c.index for c in meta.columns] == list(range(6))
+    assert meta.columns[0].parent_name == "a"
+    assert meta.columns[2].parent_name == "c"
+
+
+def test_transmogrify_end_to_end_wiring():
+    from transmogrifai_tpu.automl import transmogrify
+    feats = [
+        _raw("age", t.Real), _raw("n", t.Integral), _raw("flag", t.Binary),
+        _raw("cat", t.PickList), _raw("txt", t.Text), _raw("d", t.Date),
+        _raw("loc", t.Geolocation), _raw("tags", t.MultiPickList),
+        _raw("m", t.RealMap),
+    ]
+    combined = transmogrify(feats)
+    assert combined.ftype is t.OPVector
+    from transmogrifai_tpu.features import topological_layers
+    layers = topological_layers([combined])
+    assert len(layers) == 3  # raw → vectorizers → combiner
+    assert len(layers[0]) == 9
+    assert {s.operation_name for s in layers[2]} == {"VectorsCombiner"}

@@ -1,0 +1,119 @@
+"""End-to-end workflow tests (reference: OpWorkflowTest, OpWorkflowModelReaderWriterTest,
+OpWorkflowModelLocalTest train-vs-serve parity)."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.automl import transmogrify
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+
+def titanic_like(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(1, 80, n)
+    fare = rng.lognormal(2.5, 1.0, n)
+    sex = rng.choice(["male", "female"], n)
+    embarked = rng.choice(["S", "C", "Q", None], n, p=[0.6, 0.2, 0.15, 0.05])
+    logit = (sex == "female") * 2.2 + (age < 12) * 1.2 + 0.15 * np.log(fare) - 1.2
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(int)
+    rows = []
+    for i in range(n):
+        rows.append({
+            "age": float(age[i]) if rng.uniform() > 0.08 else None,
+            "fare": float(fare[i]),
+            "sex": str(sex[i]),
+            "embarked": embarked[i],
+            "survived": int(y[i]),
+        })
+    return Dataset.from_rows(rows, schema={
+        "age": t.Real, "fare": t.Real, "sex": t.PickList,
+        "embarked": t.PickList, "survived": t.Integral})
+
+
+@pytest.fixture(scope="module")
+def trained():
+    ds = titanic_like()
+    preds, label = FeatureBuilder.from_dataset(ds, response="survived")
+    vector = transmogrify(preds)
+    pred_feature = OpLogisticRegression(reg_param=0.01, max_iter=50) \
+        .set_input(label, vector).get_output()
+    model = Workflow().set_result_features(pred_feature, label) \
+        .set_input_dataset(ds).train()
+    return ds, label, pred_feature, model
+
+
+def test_train_and_score(trained):
+    ds, label, pred_feature, model = trained
+    scores = model.score(ds)
+    assert pred_feature.name in scores
+    pcol = scores[pred_feature.name]
+    assert pcol.kind == "prediction"
+    prob = np.asarray(pcol.data["probability"])
+    assert prob.shape == (len(ds), 2)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+    # the model must beat chance comfortably on its own training data
+    ev = BinaryClassificationEvaluator()
+    m = ev.evaluate(scores[label.name], pcol)
+    assert m.auroc > 0.75, m
+    assert 0 < m.error < 0.5
+
+
+def test_score_without_label_column(trained):
+    ds, label, pred_feature, model = trained
+    cols = {k: v for k, v in ds.columns.items() if k != "survived"}
+    schema = {k: v for k, v in ds.schema.items() if k != "survived"}
+    unlabeled = Dataset(cols, schema)
+    scores = model.score(unlabeled)
+    assert len(scores[pred_feature.name]) == len(ds)
+
+
+def test_compiled_scorer_matches_eager(trained):
+    ds, label, pred_feature, model = trained
+    eager = model.score(ds)[pred_feature.name]
+    fused = model.score_compiled(ds)[pred_feature.name]
+    # fused XLA reassociates f32 reductions → small numeric drift is expected
+    ep = np.asarray(eager.data["probability"])
+    fp = np.asarray(fused["probability"])
+    np.testing.assert_allclose(ep, fp, atol=5e-3)
+    # argmax may only flip within the drift band around 0.5
+    flips = np.asarray(eager.data["prediction"]) != np.asarray(fused["prediction"])
+    assert np.all(np.abs(ep[flips, 1] - 0.5) < 5e-3)
+
+
+def test_save_load_roundtrip(tmp_path, trained):
+    ds, label, pred_feature, model = trained
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = WorkflowModel.load(path)
+    orig = model.score(ds)[pred_feature.name]
+    re = loaded.score(ds)[pred_feature.name]
+    np.testing.assert_allclose(
+        np.asarray(orig.data["probability"]),
+        np.asarray(re.data["probability"]), atol=1e-6)
+
+
+def test_score_function_row_parity(trained):
+    ds, label, pred_feature, model = trained
+    fn = model.score_function()
+    batch = model.score(ds)[pred_feature.name]
+    probs = np.asarray(batch.data["probability"])[:, 1]
+    i = int(np.argmax(np.abs(probs - 0.5)))  # confidently-classified row
+    out = fn(dict(ds.to_rows()[i]))
+    got = out[pred_feature.name]
+    assert got["prediction"] == np.asarray(batch.data["prediction"])[i]
+    assert got["probability_1"] == pytest.approx(float(probs[i]), abs=5e-3)
+
+
+def test_untrained_estimator_score_fails():
+    ds = titanic_like(50)
+    preds, label = FeatureBuilder.from_dataset(ds, response="survived")
+    vector = transmogrify(preds)
+    pf = OpLogisticRegression().set_input(label, vector).get_output()
+    model = WorkflowModel(result_features=(pf,), fitted={})
+    with pytest.raises(RuntimeError, match="no\\s+.*fitted|fitted"):
+        model.score(ds)

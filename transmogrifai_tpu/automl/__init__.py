@@ -1,0 +1,3 @@
+from transmogrifai_tpu.automl.transmogrify import transmogrify, TransmogrifierDefaults
+
+__all__ = ["transmogrify", "TransmogrifierDefaults"]

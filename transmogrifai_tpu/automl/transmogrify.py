@@ -1,0 +1,148 @@
+"""Transmogrify: automated type-driven feature engineering.
+
+Reference parity: `core/.../feature/Transmogrifier.scala:92-352` — group
+input features by type, apply the per-type default encoder, combine into one
+OPVector via VectorsCombiner; defaults from `TransmogrifierDefaults`
+(`Transmogrifier.scala:52-90`).
+
+The per-type dispatch (reference match block `Transmogrifier.scala:116-344`):
+
+  RealNN                      → identity stack
+  Real/Percent/Currency       → mean impute + null indicator
+  Integral                    → mode impute + null indicator
+  Binary                      → value + null indicator
+  PickList/ComboBox/Country/
+  State/City/PostalCode/Street→ top-K pivot (one-hot + OTHER + null)
+  Text/TextArea/ID/Email/URL/
+  Phone/Base64                → SmartTextVectorizer (pivot vs hash vs ignore)
+  MultiPickList               → top-K multi-hot
+  TextList                    → hashed token counts
+  Date/DateTime               → unit-circle encodings
+  Geolocation                 → lat/lon/acc + mean impute
+  *Map types                  → map vectorizers (ops.maps)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.ops.categorical import MultiPickListVectorizer, OneHotVectorizer
+from transmogrifai_tpu.ops.combiner import VectorsCombiner
+from transmogrifai_tpu.ops.dates import DateToUnitCircleVectorizer
+from transmogrifai_tpu.ops.geo import GeolocationVectorizer
+from transmogrifai_tpu.ops.numeric import (
+    BinaryVectorizer, IntegralVectorizer, RealNNVectorizer, RealVectorizer)
+from transmogrifai_tpu.ops.text import HashingVectorizer, SmartTextVectorizer
+
+
+@dataclass(frozen=True)
+class TransmogrifierDefaults:
+    """Transmogrifier.scala:52-90 defaults."""
+
+    num_hash_features: int = 512
+    top_k: int = 20
+    min_support: int = 10
+    max_cardinality: int = 100
+    track_nulls: bool = True
+    fill_numeric: str = "mean"
+    circular_date_periods: Tuple[str, ...] = (
+        "HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear")
+
+
+# Categorical text types that always pivot (vs SmartText deciding).
+_PIVOT_TYPES = (T.PickList, T.ComboBox, T.Country, T.State, T.City,
+                T.PostalCode, T.Street)
+# Free-text types routed through SmartTextVectorizer.
+_SMART_TEXT_TYPES = (T.TextArea, T.ID, T.Email, T.URL, T.Phone, T.Base64, T.Text)
+
+
+def _group_features(features: Sequence) -> Dict[str, List]:
+    groups: Dict[str, List] = {}
+    for f in features:
+        ft = f.ftype
+        if issubclass(ft, T.RealNN):
+            key = "realnn"
+        elif issubclass(ft, T.Binary):
+            key = "binary"
+        elif issubclass(ft, (T.Date, T.DateTime)):
+            key = "date"
+        elif issubclass(ft, T.Integral):
+            key = "integral"
+        elif issubclass(ft, T.Real):
+            key = "real"
+        elif issubclass(ft, _PIVOT_TYPES):
+            key = "pivot"
+        elif issubclass(ft, _SMART_TEXT_TYPES):
+            key = "smart_text"
+        elif issubclass(ft, T.MultiPickList):
+            key = "multipicklist"
+        elif issubclass(ft, T.TextList):
+            key = "textlist"
+        elif issubclass(ft, T.Geolocation):
+            key = "geo"
+        elif issubclass(ft, T.OPVector):
+            key = "vector"
+        elif issubclass(ft, T.OPMap):
+            key = "map"
+        else:
+            raise TypeError(
+                f"transmogrify: no default encoder for {ft.__name__} ({f.name})")
+        groups.setdefault(key, []).append(f)
+    return groups
+
+
+def transmogrify(features: Sequence, defaults: Optional[TransmogrifierDefaults] = None):
+    """Apply per-type default encoders and combine into one OPVector feature.
+
+    Returns the combined OPVector Feature (lazily — nothing executes).
+    """
+    d = defaults or TransmogrifierDefaults()
+    groups = _group_features(features)
+    vectors = []
+
+    if "realnn" in groups:
+        vectors.append(RealNNVectorizer().set_input(*groups["realnn"]).get_output())
+    if "real" in groups:
+        vectors.append(RealVectorizer(
+            fill_value=d.fill_numeric, track_nulls=d.track_nulls
+        ).set_input(*groups["real"]).get_output())
+    if "integral" in groups:
+        vectors.append(IntegralVectorizer(
+            track_nulls=d.track_nulls).set_input(*groups["integral"]).get_output())
+    if "binary" in groups:
+        vectors.append(BinaryVectorizer(
+            track_nulls=d.track_nulls).set_input(*groups["binary"]).get_output())
+    if "date" in groups:
+        vectors.append(DateToUnitCircleVectorizer(
+            periods=d.circular_date_periods).set_input(*groups["date"]).get_output())
+    if "pivot" in groups:
+        vectors.append(OneHotVectorizer(
+            top_k=d.top_k, min_support=d.min_support, track_nulls=d.track_nulls
+        ).set_input(*groups["pivot"]).get_output())
+    if "smart_text" in groups:
+        vectors.append(SmartTextVectorizer(
+            max_cardinality=d.max_cardinality, top_k=d.top_k,
+            min_support=d.min_support, num_features=d.num_hash_features,
+            track_nulls=d.track_nulls).set_input(*groups["smart_text"]).get_output())
+    if "multipicklist" in groups:
+        vectors.append(MultiPickListVectorizer(
+            top_k=d.top_k, min_support=d.min_support, track_nulls=d.track_nulls
+        ).set_input(*groups["multipicklist"]).get_output())
+    if "textlist" in groups:
+        vectors.append(HashingVectorizer(
+            num_features=d.num_hash_features, track_nulls=d.track_nulls
+        ).set_input(*groups["textlist"]).get_output())
+    if "geo" in groups:
+        vectors.append(GeolocationVectorizer(
+            track_nulls=d.track_nulls).set_input(*groups["geo"]).get_output())
+    if "map" in groups:
+        from transmogrifai_tpu.ops.maps import map_vectorizers
+        vectors.extend(map_vectorizers(groups["map"], d))
+    if "vector" in groups:
+        vectors.extend(groups["vector"])
+
+    if not vectors:
+        raise ValueError("transmogrify: no input features")
+    return VectorsCombiner().set_input(*vectors).get_output()

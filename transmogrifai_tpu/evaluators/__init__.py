@@ -1,0 +1,15 @@
+from transmogrifai_tpu.evaluators.metrics import (
+    BinaryClassificationMetrics, MultiClassificationMetrics, RegressionMetrics,
+    binary_metrics, multiclass_metrics, regression_metrics,
+)
+from transmogrifai_tpu.evaluators.evaluators import (
+    Evaluator, BinaryClassificationEvaluator, MultiClassificationEvaluator,
+    RegressionEvaluator,
+)
+
+__all__ = [
+    "BinaryClassificationMetrics", "MultiClassificationMetrics",
+    "RegressionMetrics", "binary_metrics", "multiclass_metrics",
+    "regression_metrics", "Evaluator", "BinaryClassificationEvaluator",
+    "MultiClassificationEvaluator", "RegressionEvaluator",
+]

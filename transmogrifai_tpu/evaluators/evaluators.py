@@ -1,0 +1,89 @@
+"""Evaluator stages: score a Prediction column against a label column.
+
+Reference parity: `core/.../evaluators/OpEvaluatorBase.scala`,
+`Evaluators.scala:40-316` thin factories. An Evaluator is not a DAG stage;
+it consumes (label Column, prediction Column) and returns a metrics
+dataclass. `default_metric` names the value used for model selection
+(larger-is-better handled via `is_larger_better`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.evaluators.metrics import (
+    binary_metrics, multiclass_metrics, regression_metrics)
+
+
+class Evaluator:
+    name: str = "evaluator"
+    default_metric: str = ""
+    is_larger_better: bool = True
+
+    def evaluate(self, label: Column, prediction: Column):
+        raise NotImplementedError
+
+    def metric_value(self, label: Column, prediction: Column) -> float:
+        m = self.evaluate(label, prediction).to_json()
+        return float(m[self.default_metric])
+
+
+def _label_array(label: Column) -> np.ndarray:
+    return np.asarray(label.data["value"], dtype=np.float64)
+
+
+class BinaryClassificationEvaluator(Evaluator):
+    """AuPR default, matching BinaryClassificationModelSelector's default."""
+
+    name = "binEval"
+    default_metric = "AuPR"
+
+    def __init__(self, metric: str = "AuPR", threshold: float = 0.5):
+        self.default_metric = metric
+        self.threshold = threshold
+        self.is_larger_better = metric not in ("Error",)
+
+    def evaluate(self, label: Column, prediction: Column):
+        y = _label_array(label)
+        prob = np.asarray(prediction.data["probability"])
+        if prob.ndim == 2 and prob.shape[1] >= 2:
+            scores = prob[:, 1]
+        else:
+            scores = np.asarray(prediction.data["prediction"], dtype=np.float64)
+        return binary_metrics(y, scores, self.threshold)
+
+
+class MultiClassificationEvaluator(Evaluator):
+    """F1 default (OpMultiClassificationEvaluator)."""
+
+    name = "multiEval"
+    default_metric = "F1"
+
+    def __init__(self, metric: str = "F1"):
+        self.default_metric = metric
+        self.is_larger_better = metric not in ("Error",)
+
+    def evaluate(self, label: Column, prediction: Column):
+        y = _label_array(label)
+        pred = np.asarray(prediction.data["prediction"], dtype=np.float64)
+        return multiclass_metrics(y, pred)
+
+
+class RegressionEvaluator(Evaluator):
+    """RMSE default, smaller is better (OpRegressionEvaluator)."""
+
+    name = "regEval"
+    default_metric = "RMSE"
+    is_larger_better = False
+
+    def __init__(self, metric: str = "RMSE"):
+        self.default_metric = metric
+        self.is_larger_better = metric in ("R2",)
+
+    def evaluate(self, label: Column, prediction: Column):
+        y = _label_array(label)
+        pred = np.asarray(prediction.data["prediction"], dtype=np.float64)
+        return regression_metrics(y, pred)

@@ -13,13 +13,52 @@ reference's `fitAndTransformLayer` single row-map.
 
 from __future__ import annotations
 
+import copy
 from typing import Dict, List, Sequence
 
-from transmogrifai_tpu.stages.base import FeatureGeneratorStage, Stage
+from transmogrifai_tpu.stages.base import Estimator, FeatureGeneratorStage, Stage
 
 
 class FeatureCycleError(RuntimeError):
     """The feature graph contains a cycle (FeatureCycleException analogue)."""
+
+
+def clone_graph(result_features: Sequence) -> List:
+    """Private copy of the feature DAG, preserving uids.
+
+    `Workflow.train` fits a clone so the estimator→model origin swap
+    (stages/base.py Estimator.fit) never mutates the user's graph or a
+    previously returned WorkflowModel's graph — the reference achieves the
+    same isolation by `copyWithNewStages` copies (FeatureLike.scala).
+    Fitted models encountered in the source graph are unwound back to their
+    original estimators so a re-train actually refits.
+    """
+    from transmogrifai_tpu.features.feature import Feature
+
+    fmap: Dict[str, object] = {}
+    smap: Dict[str, Stage] = {}
+
+    def clone_feature(f) -> object:
+        if f.uid in fmap:
+            return fmap[f.uid]
+        parents = tuple(clone_feature(p) for p in f.parents)
+        stage = f.origin_stage
+        # unwind a fitted model to its estimator (re-train semantics)
+        stage = getattr(stage, "_estimator", None) or stage
+        cs = smap.get(stage.uid)
+        if cs is None:
+            cs = copy.copy(stage)
+            cs._output = None
+            smap[stage.uid] = cs
+        if parents:
+            cs.input_features = parents
+        nf = Feature(name=f.name, ftype=f.ftype, origin_stage=cs,
+                     parents=parents, is_response=f.is_response, uid=f.uid)
+        cs._output = nf
+        fmap[f.uid] = nf
+        return nf
+
+    return [clone_feature(f) for f in result_features]
 
 
 def all_stages(result_features: Sequence) -> List[Stage]:

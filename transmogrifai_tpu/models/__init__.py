@@ -1,0 +1,9 @@
+from transmogrifai_tpu.models.base import PredictorEstimator, PredictionModel
+from transmogrifai_tpu.models.logistic import OpLogisticRegression, LogisticRegressionModel
+from transmogrifai_tpu.models.linear import OpLinearRegression, LinearRegressionModel
+
+__all__ = [
+    "PredictorEstimator", "PredictionModel",
+    "OpLogisticRegression", "LogisticRegressionModel",
+    "OpLinearRegression", "LinearRegressionModel",
+]

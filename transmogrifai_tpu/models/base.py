@@ -1,0 +1,62 @@
+"""Predictor base classes: Estimator2(RealNN, OPVector) → Prediction.
+
+Reference parity: `core/.../sparkwrappers/specific/OpPredictorWrapper.scala:71-121`
+and `OpPredictionModel` — but instead of wrapping Spark MLlib, every model
+here is a pair of pure jnp functions:
+
+    fit_fn(X, y, w, hyper)   -> params      (jit/vmap-able)
+    predict_fn(params, X)    -> prediction pytree
+
+`w` is a per-row weight vector — the single mechanism behind fold masking,
+class balancing, and train/holdout splits in the sweep engine: k-fold CV
+vmaps `fit_fn` over stacked weight masks so every fold×grid fit is one XLA
+program on the mesh (SURVEY.md §3.3 north star).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+
+class PredictionModel(Transformer):
+    """Fitted predictor: device_apply returns the Prediction pytree."""
+
+    out_type = T.Prediction
+
+    def predict_arrays(self, X: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        raise NotImplementedError
+
+    def device_apply(self, enc, dev):
+        X = dev[-1]  # inputs are (label, features); label unused at transform
+        return self.predict_arrays(jnp.asarray(X))
+
+
+class PredictorEstimator(Estimator):
+    """Base for model estimators. Subclasses implement `fit_arrays`."""
+
+    in_types = (T.RealNN, T.OPVector)
+    out_type = T.Prediction
+
+    def fit_arrays(self, X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
+                   ctx: FitContext) -> PredictionModel:
+        raise NotImplementedError
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        label, vec = cols
+        y = jnp.asarray(np.asarray(label.data["value"], dtype=np.float32))
+        X = jnp.asarray(vec.device_value())
+        w = jnp.ones_like(y)
+        return self.fit_arrays(X, y, w, ctx)
+
+
+def infer_n_classes(y: np.ndarray) -> int:
+    """Label cardinality for classification (labels must be 0..k-1)."""
+    k = int(np.asarray(y).max(initial=0)) + 1
+    return max(k, 2)

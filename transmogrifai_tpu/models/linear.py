@@ -1,0 +1,76 @@
+"""Ridge / OLS linear regression via normal equations.
+
+Reference parity: `core/.../impl/regression/OpLinearRegression.scala`
+(Spark MLlib LinearRegression, "normal"/"l-bfgs" solvers).
+
+TPU-first: closed-form (XᵀX + λI)β = Xᵀy with a Cholesky-backed solve —
+XᵀX is one MXU matmul, shardable over the data axis with a `psum`, and the
+whole fit vmaps over the λ grid and fold masks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu.models.base import PredictionModel, PredictorEstimator
+from transmogrifai_tpu.stages.base import FitContext
+
+
+@jax.jit
+def fit_linreg(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, l2) -> Dict:
+    """Weighted ridge: returns {"beta": (d,), "intercept": ()}."""
+    wsum = jnp.maximum(w.sum(), 1.0)
+    x_mean = (X * w[:, None]).sum(0) / wsum
+    y_mean = (y * w).sum() / wsum
+    Xc = (X - x_mean) * jnp.sqrt(w)[:, None]
+    yc = (y - y_mean) * jnp.sqrt(w)
+    d = X.shape[1]
+    gram = Xc.T @ Xc / wsum + l2 * jnp.eye(d, dtype=X.dtype)
+    rhs = Xc.T @ yc / wsum
+    beta = jax.scipy.linalg.solve(gram, rhs, assume_a="pos")
+    intercept = y_mean - x_mean @ beta
+    return {"beta": beta, "intercept": intercept}
+
+
+def predict_linreg(params: Dict, X: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    pred = X @ params["beta"] + params["intercept"]
+    return {
+        "prediction": pred,
+        "rawPrediction": pred[:, None],
+        "probability": jnp.zeros((X.shape[0], 0), X.dtype),
+    }
+
+
+class LinearRegressionModel(PredictionModel):
+    def __init__(self, beta=None, intercept: float = 0.0,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.beta = np.asarray(beta, dtype=np.float32)
+        self.intercept = float(intercept)
+
+    def predict_arrays(self, X):
+        return predict_linreg(
+            {"beta": jnp.asarray(self.beta),
+             "intercept": jnp.float32(self.intercept)}, X)
+
+    def get_params(self):
+        return {"beta": self.beta.tolist(), "intercept": self.intercept}
+
+
+class OpLinearRegression(PredictorEstimator):
+    def __init__(self, reg_param: float = 0.0, uid: Optional[str] = None):
+        super().__init__(uid=uid, reg_param=reg_param)
+        self.reg_param = reg_param
+
+    fit_fn = staticmethod(fit_linreg)
+    predict_fn = staticmethod(predict_linreg)
+
+    def fit_arrays(self, X, y, w, ctx: FitContext) -> LinearRegressionModel:
+        p = fit_linreg(X, y, w, jnp.float32(self.reg_param))
+        return LinearRegressionModel(np.asarray(p["beta"]),
+                                     float(p["intercept"]))

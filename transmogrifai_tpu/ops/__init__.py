@@ -1,0 +1,14 @@
+from transmogrifai_tpu.ops.numeric import (
+    RealVectorizer, IntegralVectorizer, BinaryVectorizer, RealNNVectorizer)
+from transmogrifai_tpu.ops.categorical import OneHotVectorizer, MultiPickListVectorizer
+from transmogrifai_tpu.ops.combiner import VectorsCombiner
+from transmogrifai_tpu.ops.text import TextTokenizer, HashingVectorizer, SmartTextVectorizer
+from transmogrifai_tpu.ops.dates import DateToUnitCircleVectorizer
+from transmogrifai_tpu.ops.geo import GeolocationVectorizer
+
+__all__ = [
+    "RealVectorizer", "IntegralVectorizer", "BinaryVectorizer",
+    "RealNNVectorizer", "OneHotVectorizer", "MultiPickListVectorizer",
+    "VectorsCombiner", "TextTokenizer", "HashingVectorizer",
+    "SmartTextVectorizer", "DateToUnitCircleVectorizer", "GeolocationVectorizer",
+]

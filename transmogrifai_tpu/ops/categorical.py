@@ -1,0 +1,203 @@
+"""Categorical pivot (one-hot) vectorizers.
+
+Reference parity: `core/.../feature/OpOneHotVectorizer.scala` /
+`OpSetVectorizer` — top-K pivot with OTHER and null-indicator columns,
+defaults TopK=20, MinSupport=10 (`Transmogrifier.scala:52-90`).
+
+TPU-first: the vocabulary (data-dependent) is resolved at fit time on host;
+the transform is a static-shape `one_hot` over integer ids — host_prepare
+maps strings → ids with a dict lookup, device_apply builds the dense pivot
+so XLA fuses it with the downstream combine/model matmul.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.nn
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import (
+    NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMetadata, VectorMetadata)
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+
+def top_k_levels(counter: Counter, top_k: int, min_support: int) -> List[str]:
+    """Most frequent levels, count-desc then lexicographic for determinism."""
+    eligible = [(c, lvl) for lvl, c in counter.items() if c >= min_support]
+    eligible.sort(key=lambda t: (-t[0], t[1]))
+    return [lvl for _, lvl in eligible[:top_k]]
+
+
+def pivot_encode_ids(values, lut: Dict[str, int], k: int) -> np.ndarray:
+    """Map level strings → ids with OTHER=k, NULL=k+1 (shared by OneHotModel
+    and SmartTextModel so the two pivot encodings cannot drift)."""
+    return np.fromiter(
+        ((k + 1 if s is None else lut.get(s, k)) for s in values),
+        dtype=np.int32, count=len(values))
+
+
+def one_hot_np(ids: np.ndarray, k: int, track_nulls: bool) -> np.ndarray:
+    """Host-side dense pivot block: k levels + OTHER (+ NULL if tracked)."""
+    block = np.zeros((len(ids), k + 2), dtype=np.float32)
+    block[np.arange(len(ids)), ids] = 1.0
+    return block if track_nulls else block[:, : k + 1]
+
+
+class OneHotModel(Transformer):
+    """Fitted pivot: per feature K level columns + OTHER + null indicator."""
+
+    out_type = T.OPVector
+
+    def __init__(self, vocabs: Sequence[Sequence[str]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.vocabs = [list(v) for v in vocabs]
+        self.track_nulls = track_nulls
+        self._lookups = [
+            {lvl: i for i, lvl in enumerate(v)} for v in self.vocabs]
+
+    def _widths(self) -> List[int]:
+        return [len(v) + 1 + (1 if self.track_nulls else 0) for v in self.vocabs]
+
+    def host_prepare(self, cols: Sequence[Optional[Column]]):
+        return [
+            pivot_encode_ids(c.data, self._lookups[i], len(self.vocabs[i]))
+            for i, c in enumerate(cols)
+        ]
+
+    def device_apply(self, enc, dev):
+        outs = []
+        for i, ids in enumerate(enc):
+            k = len(self.vocabs[i])
+            n_classes = k + 2  # levels + OTHER + NULL
+            oh = jax.nn.one_hot(ids, n_classes, dtype=jnp.float32)
+            if not self.track_nulls:
+                oh = oh[:, : k + 1]
+            outs.append(oh)
+        return jnp.concatenate(outs, axis=1) if outs else jnp.zeros((0, 0))
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for f, vocab in zip(self.input_features, self.vocabs):
+            for lvl in vocab:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=f.name, indicator_value=lvl))
+            cols.append(VectorColumnMetadata(
+                parent_name=f.name, parent_type=f.ftype.__name__,
+                grouping=f.name, indicator_value=OTHER_INDICATOR))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=f.name, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"vocabs": self.vocabs, "track_nulls": self.track_nulls}
+
+
+class OneHotVectorizer(Estimator):
+    """N categorical text features → top-K pivot each (OpSetVectorizer)."""
+
+    in_types = (T.Text, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid, top_k=top_k, min_support=min_support,
+                         track_nulls=track_nulls)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        vocabs = []
+        for c in cols:
+            counter = Counter(s for s in c.data if s is not None)
+            vocabs.append(top_k_levels(counter, self.top_k, self.min_support))
+        return OneHotModel(vocabs, self.track_nulls)
+
+
+class MultiPickListModel(Transformer):
+    """Fitted multi-hot pivot for set-valued categoricals."""
+
+    out_type = T.OPVector
+
+    def __init__(self, vocabs: Sequence[Sequence[str]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.vocabs = [list(v) for v in vocabs]
+        self.track_nulls = track_nulls
+        self._lookups = [{lvl: i for i, lvl in enumerate(v)} for v in self.vocabs]
+
+    def host_prepare(self, cols: Sequence[Optional[Column]]):
+        outs = []
+        for i, c in enumerate(cols):
+            lut, k = self._lookups[i], len(self.vocabs[i])
+            width = k + 1 + (1 if self.track_nulls else 0)
+            arr = np.zeros((len(c.data), width), dtype=np.float32)
+            for r, val in enumerate(c.data):
+                if val is None:
+                    if self.track_nulls:
+                        arr[r, k + 1] = 1.0
+                    continue
+                for s in val:
+                    j = lut.get(s)
+                    if j is None:
+                        arr[r, k] = 1.0  # OTHER
+                    else:
+                        arr[r, j] = 1.0
+            outs.append(arr)
+        return outs
+
+    def device_apply(self, enc, dev):
+        return jnp.concatenate([jnp.asarray(a) for a in enc], axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for f, vocab in zip(self.input_features, self.vocabs):
+            for lvl in vocab:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=f.name, indicator_value=lvl))
+            cols.append(VectorColumnMetadata(
+                parent_name=f.name, parent_type=f.ftype.__name__,
+                grouping=f.name, indicator_value=OTHER_INDICATOR))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=f.name, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"vocabs": self.vocabs, "track_nulls": self.track_nulls}
+
+
+class MultiPickListVectorizer(Estimator):
+    """N MultiPickList features → top-K multi-hot each."""
+
+    in_types = (T.MultiPickList, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid, top_k=top_k, min_support=min_support,
+                         track_nulls=track_nulls)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        vocabs = []
+        for c in cols:
+            counter: Counter = Counter()
+            for val in c.data:
+                if val is not None:
+                    counter.update(val)
+            vocabs.append(top_k_levels(counter, self.top_k, self.min_support))
+        return MultiPickListModel(vocabs, self.track_nulls)

@@ -1,0 +1,35 @@
+"""VectorsCombiner: N OPVector features → one, with metadata union.
+
+Reference parity: `core/.../feature/VectorsCombiner.scala`. On device this
+is a single concatenate that XLA folds into downstream consumers — the
+combined matrix never materializes separately in HBM unless a stage needs
+it whole.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.metadata import VectorMetadata
+from transmogrifai_tpu.stages.base import Transformer
+
+
+class VectorsCombiner(Transformer):
+    in_types = (T.OPVector, Ellipsis)
+    out_type = T.OPVector
+
+    def device_apply(self, enc, dev):
+        return jnp.concatenate([jnp.asarray(d) for d in dev], axis=1)
+
+    def output_meta(self) -> Optional[VectorMetadata]:
+        metas = []
+        for f in self.input_features:
+            stage = f.origin_stage
+            m = stage.output_meta() if isinstance(stage, Transformer) else None
+            if m is None:
+                return None  # an input with unknown lineage poisons the union
+            metas.append(m)
+        return VectorMetadata.union(self.output_name(), metas)

@@ -1,0 +1,104 @@
+"""Date/time vectorization: circular (unit-circle) encodings.
+
+Reference parity: `core/.../feature/DateToUnitCircleTransformer.scala` and
+the transmogrify defaults `CircularDateRepresentations = HourOfDay,
+DayOfWeek, DayOfMonth, DayOfYear` (`Transmogrifier.scala:81`).
+
+TPU-first: calendar math runs on host over int64 epoch-millis (float32
+cannot hold epoch-ms precision), producing small phase fractions; the
+device side is just sin/cos — fully fusable. Missing dates map to the
+origin (0, 0), which no valid point on the unit circle can hit.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import VectorColumnMetadata, VectorMetadata
+from transmogrifai_tpu.stages.base import Transformer
+
+DEFAULT_PERIODS = ("HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear")
+
+_MS_PER_DAY = 86_400_000
+_MS_PER_HOUR = 3_600_000
+
+
+def _phase_fraction(ms: np.ndarray, period: str) -> np.ndarray:
+    """Fraction in [0, 1) of the given calendar period (host, int64-exact)."""
+    if period == "HourOfDay":
+        return (ms % _MS_PER_DAY) / _MS_PER_DAY
+    if period == "DayOfWeek":
+        day = ms // _MS_PER_DAY
+        # 1970-01-01 was a Thursday; ISO Monday=0 → offset 3
+        dow = (day + 3) % 7
+        return dow / 7.0
+    days = (ms // _MS_PER_DAY).astype("datetime64[D]")
+    if period == "DayOfMonth":
+        month_start = days.astype("datetime64[M]")
+        dom = (days - month_start).astype(np.int64)  # 0-based day of month
+        return dom / 31.0
+    if period == "DayOfYear":
+        year_start = days.astype("datetime64[Y]")
+        doy = (days - year_start).astype(np.int64)
+        return doy / 366.0
+    if period == "MonthOfYear":
+        months = days.astype("datetime64[M]").astype(np.int64)
+        return (months % 12) / 12.0
+    if period == "WeekOfYear":
+        year_start = days.astype("datetime64[Y]")
+        doy = (days - year_start).astype(np.int64)
+        return (doy // 7) / 53.0
+    raise ValueError(f"Unknown time period {period!r}")
+
+
+class DateToUnitCircleVectorizer(Transformer):
+    """N Date features → [sin, cos] per period per feature (stateless)."""
+
+    in_types = (T.Date, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, periods: Sequence[str] = DEFAULT_PERIODS,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, periods=list(periods))
+        self.periods = tuple(periods)
+
+    def host_prepare(self, cols: Sequence[Optional[Column]]):
+        out = []
+        for c in cols:
+            ms = np.asarray(c.data["value"], dtype=np.int64)
+            mask = np.asarray(c.data["mask"], dtype=np.float32)
+            phases = np.stack(
+                [np.asarray(_phase_fraction(ms, p), dtype=np.float32)
+                 for p in self.periods], axis=1)
+            out.append({"phases": phases, "mask": mask})
+        return out
+
+    def device_apply(self, enc, dev):
+        parts = []
+        for e in enc:
+            theta = 2.0 * jnp.pi * jnp.asarray(e["phases"])
+            m = jnp.asarray(e["mask"])[:, None]
+            parts.append(jnp.sin(theta) * m)
+            parts.append(jnp.cos(theta) * m)
+        # interleave sin/cos per feature: [sin_p0, cos_p0, sin_p1, ...]
+        stacked = []
+        for i in range(0, len(parts), 2):
+            s, c = parts[i], parts[i + 1]
+            inter = jnp.stack([s, c], axis=2).reshape(s.shape[0], -1)
+            stacked.append(inter)
+        return jnp.concatenate(stacked, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for f in self.input_features:
+            for p in self.periods:
+                for fn in ("sin", "cos"):
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        descriptor_value=f"{p}_{fn}"))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()

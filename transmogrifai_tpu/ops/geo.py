@@ -1,0 +1,88 @@
+"""Geolocation vectorization.
+
+Reference parity: `core/.../feature/GeolocationVectorizer.scala` —
+lat/lon/accuracy triple with mean imputation + null indicator.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import (
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata)
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+
+def _geo_arrays(col: Column):
+    n = len(col.data)
+    vals = np.zeros((n, 3), dtype=np.float32)
+    mask = np.zeros(n, dtype=np.float32)
+    for i, v in enumerate(col.data):
+        if v is not None:
+            vals[i] = v
+            mask[i] = 1.0
+    return vals, mask
+
+
+class GeolocationModel(Transformer):
+    out_type = T.OPVector
+
+    def __init__(self, fills: Sequence[Sequence[float]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.fills = np.asarray(fills, dtype=np.float32)  # (F, 3)
+        self.track_nulls = track_nulls
+
+    def host_prepare(self, cols: Sequence[Optional[Column]]):
+        return [_geo_arrays(c) for c in cols]
+
+    def device_apply(self, enc, dev):
+        parts = []
+        for i, (vals, mask) in enumerate(enc):
+            v = jnp.asarray(vals)
+            m = jnp.asarray(mask)[:, None]
+            filled = v * m + self.fills[i][None, :] * (1.0 - m)
+            parts.append(filled)
+            if self.track_nulls:
+                parts.append(1.0 - m)
+        return jnp.concatenate(parts, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for f in self.input_features:
+            for d in ("lat", "lon", "accuracy"):
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    descriptor_value=d))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"fills": self.fills.tolist(), "track_nulls": self.track_nulls}
+
+
+class GeolocationVectorizer(Estimator):
+    """N Geolocation features → [lat, lon, acc (mean-imputed), null] each."""
+
+    in_types = (T.Geolocation, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid, track_nulls=track_nulls)
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        fills = []
+        for c in cols:
+            vals, mask = _geo_arrays(c)
+            denom = max(float(mask.sum()), 1.0)
+            fills.append((vals * mask[:, None]).sum(axis=0) / denom)
+        return GeolocationModel(np.asarray(fills), self.track_nulls)

@@ -1,0 +1,426 @@
+"""Map-type vectorizers: each map key behaves like its scalar counterpart.
+
+Reference parity: `core/.../feature/OPMapVectorizer.scala`,
+`TextMapPivotVectorizer.scala`, `MultiPickListMapVectorizer.scala`,
+`GeolocationMapVectorizer.scala`, `DateMapToUnitCircleVectorizer.scala`.
+
+Fit discovers the key set (data-dependent → resolved on host at fit time,
+sorted for determinism); transform is static-shape per-key encoding. A map
+column explodes into `len(keys)` pseudo-columns whose metadata carries the
+key in `grouping`.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import (
+    NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMetadata, VectorMetadata)
+from transmogrifai_tpu.ops.categorical import top_k_levels
+from transmogrifai_tpu.ops.dates import DEFAULT_PERIODS, _phase_fraction
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+
+def _discover_keys(col: Column, allow: Sequence[str] = (),
+                   block: Sequence[str] = ()) -> List[str]:
+    keys = set()
+    for m in col.data:
+        if m is not None:
+            keys.update(m.keys())
+    if allow:
+        keys &= set(allow)
+    keys -= set(block)
+    return sorted(keys)
+
+
+def _key_scalar(col: Column, key: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Extract one key of a numeric map → (value f64, mask f32)."""
+    n = len(col.data)
+    val = np.zeros(n, dtype=np.float64)
+    mask = np.zeros(n, dtype=np.float32)
+    for i, m in enumerate(col.data):
+        if m is not None:
+            v = m.get(key)
+            if v is not None:
+                val[i] = float(v)
+                mask[i] = 1.0
+    return val, mask
+
+
+class NumericMapModel(Transformer):
+    out_type = T.OPVector
+
+    def __init__(self, keys_per_feature: Sequence[Sequence[str]],
+                 fills: Sequence[Sequence[float]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.keys_per_feature = [list(k) for k in keys_per_feature]
+        self.fills = [np.asarray(f, dtype=np.float32) for f in fills]
+        self.track_nulls = track_nulls
+
+    def host_prepare(self, cols):
+        out = []
+        for i, c in enumerate(cols):
+            vals, masks = [], []
+            for key in self.keys_per_feature[i]:
+                v, m = _key_scalar(c, key)
+                vals.append(v.astype(np.float32))
+                masks.append(m)
+            out.append({
+                "value": np.stack(vals, 1) if vals else np.zeros((len(c.data), 0), np.float32),
+                "mask": np.stack(masks, 1) if masks else np.zeros((len(c.data), 0), np.float32)})
+        return out
+
+    def device_apply(self, enc, dev):
+        parts = []
+        for i, e in enumerate(enc):
+            v, m = jnp.asarray(e["value"]), jnp.asarray(e["mask"])
+            filled = v * m + self.fills[i][None, :] * (1.0 - m)
+            if self.track_nulls:
+                both = jnp.stack([filled, 1.0 - m], axis=2).reshape(v.shape[0], -1)
+                parts.append(both)
+            else:
+                parts.append(filled)
+        return jnp.concatenate(parts, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for f, keys in zip(self.input_features, self.keys_per_feature):
+            for k in keys:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__, grouping=k))
+                if self.track_nulls:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=k, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"keys_per_feature": self.keys_per_feature,
+                "fills": [f.tolist() for f in self.fills],
+                "track_nulls": self.track_nulls}
+
+
+class NumericMapVectorizer(Estimator):
+    """RealMap/IntegralMap/BinaryMap… → per-key impute + null indicator
+    (OPMapVectorizer)."""
+
+    in_types = (T.OPMap, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, fill_value: str = "mean", track_nulls: bool = True,
+                 allow_keys: Sequence[str] = (), block_keys: Sequence[str] = (),
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, fill_value=fill_value, track_nulls=track_nulls,
+                         allow_keys=list(allow_keys), block_keys=list(block_keys))
+        self.fill_value = fill_value
+        self.track_nulls = track_nulls
+        self.allow_keys = tuple(allow_keys)
+        self.block_keys = tuple(block_keys)
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        keys_pf, fills_pf = [], []
+        for c in cols:
+            keys = _discover_keys(c, self.allow_keys, self.block_keys)
+            fills = []
+            for k in keys:
+                v, m = _key_scalar(c, k)
+                if self.fill_value == "mean" and m.sum() > 0:
+                    fills.append(float((v * m).sum() / m.sum()))
+                else:
+                    fills.append(0.0)
+            keys_pf.append(keys)
+            fills_pf.append(fills)
+        return NumericMapModel(keys_pf, fills_pf, self.track_nulls)
+
+
+class TextMapPivotModel(Transformer):
+    out_type = T.OPVector
+
+    def __init__(self, keys_per_feature: Sequence[Sequence[str]],
+                 vocabs: Sequence[Dict[str, List[str]]], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.keys_per_feature = [list(k) for k in keys_per_feature]
+        self.vocabs = list(vocabs)
+        self.track_nulls = track_nulls
+
+    def host_prepare(self, cols):
+        blocks = []
+        for i, c in enumerate(cols):
+            n = len(c.data)
+            feat_blocks = []
+            for key in self.keys_per_feature[i]:
+                vocab = self.vocabs[i][key]
+                lut = {s: j for j, s in enumerate(vocab)}
+                k = len(vocab)
+                width = k + 1 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, width), dtype=np.float32)
+                for r, m in enumerate(c.data):
+                    v = None if m is None else m.get(key)
+                    if v is None:
+                        if self.track_nulls:
+                            block[r, k + 1] = 1.0
+                    elif isinstance(v, (set, frozenset)):  # MultiPickListMap
+                        for s in v:
+                            block[r, lut.get(s, k)] = 1.0
+                    else:
+                        block[r, lut.get(v, k)] = 1.0
+                feat_blocks.append(block)
+            blocks.append(np.concatenate(feat_blocks, 1) if feat_blocks
+                          else np.zeros((n, 0), np.float32))
+        return blocks
+
+    def device_apply(self, enc, dev):
+        return jnp.concatenate([jnp.asarray(b) for b in enc], axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for i, f in enumerate(self.input_features):
+            for key in self.keys_per_feature[i]:
+                for lvl in self.vocabs[i][key]:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=key, indicator_value=lvl))
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=key, indicator_value=OTHER_INDICATOR))
+                if self.track_nulls:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=key, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"keys_per_feature": self.keys_per_feature, "vocabs": self.vocabs,
+                "track_nulls": self.track_nulls}
+
+
+class TextMapPivotVectorizer(Estimator):
+    """TextMap/PickListMap… → per-key top-K pivot
+    (TextMapPivotVectorizer.scala)."""
+
+    in_types = (T.OPMap, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid, top_k=top_k, min_support=min_support,
+                         track_nulls=track_nulls)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        keys_pf, vocabs_pf = [], []
+        for c in cols:
+            keys = _discover_keys(c)
+            vocabs: Dict[str, List[str]] = {}
+            for k in keys:
+                counter: Counter = Counter()
+                for m in c.data:
+                    if m is not None:
+                        v = m.get(k)
+                        if v is None:
+                            continue
+                        if isinstance(v, (set, frozenset)):  # MultiPickListMap
+                            counter.update(v)
+                        else:
+                            counter[v] += 1
+                vocabs[k] = top_k_levels(counter, self.top_k, self.min_support)
+            keys_pf.append(keys)
+            vocabs_pf.append(vocabs)
+        return TextMapPivotModel(keys_pf, vocabs_pf, self.track_nulls)
+
+
+class GeolocationMapModel(Transformer):
+    out_type = T.OPVector
+
+    def __init__(self, keys_per_feature, fills, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.keys_per_feature = [list(k) for k in keys_per_feature]
+        self.fills = [np.asarray(f, dtype=np.float32) for f in fills]  # (K,3)
+        self.track_nulls = track_nulls
+
+    def host_prepare(self, cols):
+        out = []
+        for i, c in enumerate(cols):
+            n = len(c.data)
+            keys = self.keys_per_feature[i]
+            vals = np.zeros((n, len(keys), 3), dtype=np.float32)
+            mask = np.zeros((n, len(keys)), dtype=np.float32)
+            for r, m in enumerate(c.data):
+                if m is None:
+                    continue
+                for j, key in enumerate(keys):
+                    v = m.get(key)
+                    if v is not None:
+                        vals[r, j] = v
+                        mask[r, j] = 1.0
+            out.append({"value": vals, "mask": mask})
+        return out
+
+    def device_apply(self, enc, dev):
+        parts = []
+        for i, e in enumerate(enc):
+            v = jnp.asarray(e["value"])           # (n, K, 3)
+            m = jnp.asarray(e["mask"])[:, :, None]  # (n, K, 1)
+            filled = v * m + self.fills[i][None, :, :] * (1.0 - m)
+            if self.track_nulls:
+                block = jnp.concatenate([filled, 1.0 - m], axis=2)
+            else:
+                block = filled
+            parts.append(block.reshape(v.shape[0], -1))
+        return jnp.concatenate(parts, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for i, f in enumerate(self.input_features):
+            for key in self.keys_per_feature[i]:
+                for d in ("lat", "lon", "accuracy"):
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=key, descriptor_value=d))
+                if self.track_nulls:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=key, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"keys_per_feature": self.keys_per_feature,
+                "fills": [f.tolist() for f in self.fills],
+                "track_nulls": self.track_nulls}
+
+
+class GeolocationMapVectorizer(Estimator):
+    in_types = (T.GeolocationMap, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid, track_nulls=track_nulls)
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        keys_pf, fills_pf = [], []
+        for c in cols:
+            keys = _discover_keys(c)
+            sums = np.zeros((len(keys), 3), dtype=np.float64)
+            counts = np.zeros(len(keys), dtype=np.float64)
+            for m in c.data:
+                if m is None:
+                    continue
+                for j, key in enumerate(keys):
+                    v = m.get(key)
+                    if v is not None:
+                        sums[j] += v
+                        counts[j] += 1
+            fills = sums / np.maximum(counts, 1.0)[:, None]
+            keys_pf.append(keys)
+            fills_pf.append(fills)
+        return GeolocationMapModel(keys_pf, fills_pf, self.track_nulls)
+
+
+class DateMapVectorizer(Estimator):
+    """DateMap → per-key unit-circle encodings
+    (DateMapToUnitCircleVectorizer.scala)."""
+
+    in_types = (T.DateMap, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, periods: Sequence[str] = DEFAULT_PERIODS,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, periods=list(periods))
+        self.periods = tuple(periods)
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        keys_pf = [_discover_keys(c) for c in cols]
+        return DateMapModel(keys_pf, self.periods)
+
+
+class DateMapModel(Transformer):
+    out_type = T.OPVector
+
+    def __init__(self, keys_per_feature, periods: Sequence[str] = DEFAULT_PERIODS,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.keys_per_feature = [list(k) for k in keys_per_feature]
+        self.periods = tuple(periods)
+
+    def host_prepare(self, cols):
+        per_key = []
+        n = len(cols[0].data) if cols else 0
+        for i, c in enumerate(cols):
+            for key in self.keys_per_feature[i]:
+                val, mask = _key_scalar(c, key)
+                ms = val.astype(np.int64)
+                phases = np.stack(
+                    [np.asarray(_phase_fraction(ms, p), dtype=np.float32)
+                     for p in self.periods], axis=1)
+                per_key.append({"phases": phases, "mask": mask})
+        return {"n": np.zeros((n, 0), np.float32), "keys": per_key}
+
+    def device_apply(self, enc, dev):
+        parts = []
+        for e in enc["keys"]:
+            theta = 2.0 * jnp.pi * jnp.asarray(e["phases"])
+            m = jnp.asarray(e["mask"])[:, None]
+            sc = jnp.stack([jnp.sin(theta) * m, jnp.cos(theta) * m], axis=2)
+            parts.append(sc.reshape(theta.shape[0], -1))
+        if not parts:  # all keys filtered / all-null training data
+            return jnp.asarray(enc["n"])
+        return jnp.concatenate(parts, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for i, f in enumerate(self.input_features):
+            for key in self.keys_per_feature[i]:
+                for p in self.periods:
+                    for fn in ("sin", "cos"):
+                        cols.append(VectorColumnMetadata(
+                            parent_name=f.name, parent_type=f.ftype.__name__,
+                            grouping=key, descriptor_value=f"{p}_{fn}"))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"keys_per_feature": self.keys_per_feature,
+                "periods": list(self.periods)}
+
+
+def map_vectorizers(features: Sequence, defaults) -> List:
+    """Dispatch map-typed features to their vectorizers (transmogrify helper)."""
+    numeric, textish, geo, date = [], [], [], []
+    for f in features:
+        ft = f.ftype
+        if issubclass(ft, T.GeolocationMap):
+            geo.append(f)
+        elif issubclass(ft, (T.DateMap,)):
+            date.append(f)
+        elif issubclass(ft, (T.RealMap, T.IntegralMap, T.BinaryMap)):
+            numeric.append(f)
+        elif issubclass(ft, (T.TextMap, T.MultiPickListMap)):
+            textish.append(f)
+        else:
+            raise TypeError(f"No map vectorizer for {ft.__name__} ({f.name})")
+    out = []
+    if numeric:
+        out.append(NumericMapVectorizer(
+            track_nulls=defaults.track_nulls).set_input(*numeric).get_output())
+    if textish:
+        out.append(TextMapPivotVectorizer(
+            top_k=defaults.top_k, min_support=defaults.min_support,
+            track_nulls=defaults.track_nulls).set_input(*textish).get_output())
+    if geo:
+        out.append(GeolocationMapVectorizer(
+            track_nulls=defaults.track_nulls).set_input(*geo).get_output())
+    if date:
+        out.append(DateMapVectorizer(
+            periods=defaults.circular_date_periods).set_input(*date).get_output())
+    return out

@@ -1,0 +1,359 @@
+"""Text ops: tokenizer, feature hashing, smart cardinality-driven vectorizer.
+
+Reference parity: `core/.../feature/TextTokenizer.scala` (Lucene analyzer →
+simple analyzer here), `OPCollectionHashingVectorizer.scala` + murmur3
+(`HashAlgorithm.scala`), `SmartTextVectorizer.scala:62-267` (per-field
+TextStats choose pivot vs hash vs ignore; shared/separate hash space).
+
+TPU-first: all string work is host-side vectorized prep producing dense
+(n, d) count arrays; the device side is pure concat/scale so the hashed
+space feeds straight into the combined matmul. Hashing is murmur3-32 for
+cross-process determinism (python's hash() is salted), memoized per token.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import (
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata)
+from transmogrifai_tpu.ops.categorical import (
+    one_hot_np, pivot_encode_ids, top_k_levels)
+from transmogrifai_tpu.stages.base import (
+    Estimator, FitContext, HostTransformer, Transformer)
+
+# ---------------------------------------------------------------------------
+# murmur3-32 (pure python, memoized) — HashAlgorithm.MurMur3 parity
+# ---------------------------------------------------------------------------
+
+_M32 = 0xFFFFFFFF
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & _M32
+    length = len(data)
+    rounded = length & ~0x3
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * c2) & _M32
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _M32
+        h = (h * 5 + 0xE6546B64) & _M32
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * c2) & _M32
+        h ^= k
+    h ^= length
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+class TokenHasher:
+    """Memoized token → bucket mapper."""
+
+    def __init__(self, num_features: int, seed: int = 42):
+        self.num_features = num_features
+        self.seed = seed
+        self._memo: Dict[str, int] = {}
+
+    def __call__(self, token: str) -> int:
+        b = self._memo.get(token)
+        if b is None:
+            b = murmur3_32(token.encode("utf-8"), self.seed) % self.num_features
+            self._memo[token] = b
+        return b
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer (TextTokenizer.scala; simple analyzer stand-in for Lucene)
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"[^\W_]+", re.UNICODE)
+
+
+def tokenize(text: Optional[str], min_token_length: int = 1,
+             to_lowercase: bool = True) -> List[str]:
+    if not text:
+        return []
+    if to_lowercase:
+        text = text.lower()
+    return [t for t in _TOKEN_RE.findall(text) if len(t) >= min_token_length]
+
+
+class TextTokenizer(HostTransformer):
+    """Text → TextList of analyzer tokens (host-only stage)."""
+
+    in_types = (T.Text,)
+    out_type = T.TextList
+
+    def __init__(self, min_token_length: int = 1, to_lowercase: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, min_token_length=min_token_length,
+                         to_lowercase=to_lowercase)
+        self.min_token_length = min_token_length
+        self.to_lowercase = to_lowercase
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        src = cols[0].data
+        out = np.empty(len(src), dtype=object)
+        for i, s in enumerate(src):
+            toks = tokenize(s, self.min_token_length, self.to_lowercase)
+            out[i] = toks if toks else None
+        return Column(self.output_ftype(), out)
+
+
+# ---------------------------------------------------------------------------
+# Hashing vectorizer (OPCollectionHashingVectorizer)
+# ---------------------------------------------------------------------------
+
+def _hash_counts(values, hasher: TokenHasher, binary: bool,
+                 pre_tokenized: bool) -> np.ndarray:
+    n = len(values)
+    out = np.zeros((n, hasher.num_features), dtype=np.float32)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        toks = v if pre_tokenized else tokenize(v)
+        for tok in toks:
+            j = hasher(tok)
+            if binary:
+                out[i, j] = 1.0
+            else:
+                out[i, j] += 1.0
+    return out
+
+
+class HashingVectorizer(Transformer):
+    """N Text/TextList features → murmur3 hashed token counts.
+
+    shared_hash_space=True packs all inputs into one `num_features` space
+    (HashSpaceStrategy.Shared); otherwise each input gets its own block.
+    """
+
+    in_types = None  # Text or TextList, checked below
+    out_type = T.OPVector
+
+    def __init__(self, num_features: int = 512, binary: bool = False,
+                 shared_hash_space: bool = False, track_nulls: bool = True,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(uid=uid, num_features=num_features, binary=binary,
+                         shared_hash_space=shared_hash_space,
+                         track_nulls=track_nulls, seed=seed)
+        self.num_features = num_features
+        self.binary = binary
+        self.shared_hash_space = shared_hash_space
+        self.track_nulls = track_nulls
+        self.seed = seed
+
+    def _check_inputs(self, features):
+        for f in features:
+            if not (issubclass(f.ftype, T.Text) or issubclass(f.ftype, T.TextList)):
+                raise TypeError(
+                    f"HashingVectorizer input {f.name!r} must be Text or "
+                    f"TextList, got {f.ftype.__name__}")
+
+    def host_prepare(self, cols: Sequence[Optional[Column]]):
+        blocks, nulls = [], []
+        shared = (TokenHasher(self.num_features, self.seed)
+                  if self.shared_hash_space else None)
+        for i, c in enumerate(cols):
+            pre_tok = c.kind == "list"
+            hasher = shared or TokenHasher(self.num_features, self.seed + i)
+            blocks.append(_hash_counts(c.data, hasher, self.binary, pre_tok))
+            nulls.append(np.fromiter(
+                (1.0 if v is None else 0.0 for v in c.data),
+                dtype=np.float32, count=len(c.data)))
+        if self.shared_hash_space:
+            merged = np.sum(blocks, axis=0)
+            if self.binary:
+                merged = np.minimum(merged, 1.0)  # keep 0/1 presence contract
+            blocks = [merged]
+        return {"blocks": blocks, "nulls": nulls}
+
+    def device_apply(self, enc, dev):
+        parts = [jnp.asarray(b) for b in enc["blocks"]]
+        if self.track_nulls:
+            parts.extend(jnp.asarray(z)[:, None] for z in enc["nulls"])
+        return jnp.concatenate(parts, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        if self.shared_hash_space:
+            group = ",".join(f.name for f in self.input_features)
+            for j in range(self.num_features):
+                cols.append(VectorColumnMetadata(
+                    parent_name=group, parent_type="Text",
+                    descriptor_value=f"hash_{j}"))
+        else:
+            for f in self.input_features:
+                for j in range(self.num_features):
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        descriptor_value=f"hash_{j}"))
+        if self.track_nulls:
+            for f in self.input_features:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+
+# ---------------------------------------------------------------------------
+# SmartTextVectorizer (SmartTextVectorizer.scala:62-267)
+# ---------------------------------------------------------------------------
+
+PIVOT, HASH, IGNORE = "pivot", "hash", "ignore"
+
+
+class SmartTextModel(Transformer):
+    """Fitted per-field strategy: categorical pivot, hashed tokens, or
+    null-indicator-only for ID-like fields."""
+
+    out_type = T.OPVector
+
+    def __init__(self, strategies: Sequence[str], vocabs: Sequence[Sequence[str]],
+                 num_features: int, track_nulls: bool = True, seed: int = 42,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.strategies = list(strategies)
+        self.vocabs = [list(v) for v in vocabs]
+        self.num_features = num_features
+        self.track_nulls = track_nulls
+        self.seed = seed
+        self._lookups = [{s: i for i, s in enumerate(v)} for v in self.vocabs]
+
+    def host_prepare(self, cols: Sequence[Optional[Column]]):
+        blocks = []
+        for i, c in enumerate(cols):
+            strat = self.strategies[i]
+            n = len(c.data)
+            if strat == PIVOT:
+                lut, k = self._lookups[i], len(self.vocabs[i])
+                block = one_hot_np(pivot_encode_ids(c.data, lut, k), k,
+                                   self.track_nulls)
+            elif strat == HASH:
+                hasher = TokenHasher(self.num_features, self.seed + i)
+                block = _hash_counts(c.data, hasher, False, False)
+                if self.track_nulls:
+                    nulls = np.fromiter(
+                        (1.0 if v is None else 0.0 for v in c.data),
+                        dtype=np.float32, count=n)
+                    block = np.concatenate([block, nulls[:, None]], axis=1)
+            else:  # IGNORE: null indicator only
+                nulls = np.fromiter(
+                    (1.0 if v is None else 0.0 for v in c.data),
+                    dtype=np.float32, count=n)
+                block = nulls[:, None]
+            blocks.append(block)
+        return blocks
+
+    def device_apply(self, enc, dev):
+        return jnp.concatenate([jnp.asarray(b) for b in enc], axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for i, f in enumerate(self.input_features):
+            strat = self.strategies[i]
+            if strat == PIVOT:
+                for lvl in self.vocabs[i]:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=f.name, indicator_value=lvl))
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=f.name, indicator_value="OTHER"))
+                if self.track_nulls:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=f.name, indicator_value=NULL_INDICATOR))
+            elif strat == HASH:
+                for j in range(self.num_features):
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        descriptor_value=f"hash_{j}"))
+                if self.track_nulls:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        indicator_value=NULL_INDICATOR))
+            else:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"strategies": self.strategies, "vocabs": self.vocabs,
+                "num_features": self.num_features,
+                "track_nulls": self.track_nulls, "seed": self.seed}
+
+
+class SmartTextVectorizer(Estimator):
+    """Per-field cardinality stats choose the encoding
+    (SmartTextVectorizer.scala):
+
+    - distinct <= max_cardinality          → top-K categorical pivot
+    - ID-like (distinct ≈ count)           → ignore (null indicator only)
+    - otherwise                            → hashed token counts
+    """
+
+    in_types = (T.Text, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, max_cardinality: int = 100, top_k: int = 20,
+                 min_support: int = 10, num_features: int = 512,
+                 id_detect_ratio: float = 0.99, track_nulls: bool = True,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(
+            uid=uid, max_cardinality=max_cardinality, top_k=top_k,
+            min_support=min_support, num_features=num_features,
+            id_detect_ratio=id_detect_ratio, track_nulls=track_nulls, seed=seed)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_features = num_features
+        self.id_detect_ratio = id_detect_ratio
+        self.track_nulls = track_nulls
+        self.seed = seed
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        strategies, vocabs = [], []
+        for c in cols:
+            counter = Counter(s for s in c.data if s is not None)
+            n_values = sum(counter.values())
+            n_distinct = len(counter)
+            if n_distinct == 0:
+                strategies.append(IGNORE)
+                vocabs.append([])
+            elif n_distinct <= self.max_cardinality:
+                strategies.append(PIVOT)
+                vocabs.append(top_k_levels(counter, self.top_k, self.min_support))
+            elif n_values > 0 and n_distinct / n_values >= self.id_detect_ratio:
+                strategies.append(IGNORE)  # ID-like: every value unique
+                vocabs.append([])
+            else:
+                strategies.append(HASH)
+                vocabs.append([])
+        return SmartTextModel(strategies, vocabs, self.num_features,
+                              self.track_nulls, self.seed)

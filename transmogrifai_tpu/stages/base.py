@@ -207,7 +207,14 @@ class Estimator(Stage):
         model = self.fit_model(cols, ctx)
         model.uid = self.uid
         model.input_features = self.input_features
-        model._output = None
+        # The fitted model takes over the estimator's output feature node AND
+        # becomes its origin stage, so post-fit DAG traversal sees fitted
+        # transformers — the reference's `copyWithNewStages` estimator→model
+        # swap. `_estimator` is kept so a re-train can find the unfitted stage.
+        out = self.get_output()
+        out.origin_stage = model
+        model._output = out
+        model._estimator = self
         return model
 
     def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
@@ -248,11 +255,17 @@ class FeatureGeneratorStage(Stage):
                 parents=(), is_response=self.is_response)
         return self._output
 
-    def materialize(self, dataset) -> Column:
+    def materialize(self, dataset, allow_missing_response: bool = False) -> Column:
         if self.extract is not None:
             values = [self.extract(row) for row in dataset.to_rows()]
             return Column.from_values(self.ftype, values)
         if self.column not in dataset.columns:
+            if self.is_response and allow_missing_response:
+                # scoring data without the label column: a type-appropriate
+                # placeholder (zeros for numerics, empties otherwise).
+                # Training always raises (allow_missing_response=False).
+                fill = 0.0 if issubclass(self.ftype, T.OPNumeric) else None
+                return Column.from_values(self.ftype, [fill] * len(dataset))
             raise KeyError(
                 f"Raw feature {self.feature_name!r}: column {self.column!r} "
                 f"not in dataset {dataset.names()}")

@@ -1,0 +1,3 @@
+from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
+
+__all__ = ["Workflow", "WorkflowModel"]

@@ -1,0 +1,116 @@
+"""Model persistence: JSON manifest + per-stage params.
+
+Reference parity: `core/.../OpWorkflowModelWriter.scala:56-207` (single
+`op-model.json` manifest: uids, features, stages, params, version) and
+`OpWorkflowModelReader.scala:63-300` (rebuild stages via registry, re-link
+features by uid — `resolveFeatures:182`).
+
+Layout: `<path>/op-model.json`. Stage params must be JSON-safe (model
+weights are stored as lists; large-array npz offload is a TODO for wide
+models). Extract-fn raw features cannot round-trip (python closures);
+column-backed features do.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.features.feature import Feature
+from transmogrifai_tpu.stages.base import (
+    FeatureGeneratorStage, StageRegistry, Transformer)
+
+MANIFEST = "op-model.json"
+VERSION = 1
+
+
+def _feature_entry(f: Feature) -> Dict[str, Any]:
+    return {
+        "uid": f.uid, "name": f.name, "ftype": f.ftype.__name__,
+        "is_response": f.is_response,
+        "origin_stage": f.origin_stage.uid if f.origin_stage else None,
+        "parents": [p.uid for p in f.parents],
+    }
+
+
+def save_model(model, path: str, overwrite: bool = True) -> None:
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, MANIFEST)
+    if os.path.exists(out) and not overwrite:
+        raise FileExistsError(out)
+
+    features: Dict[str, Feature] = {}
+    order: List[str] = []
+    for rf in model.result_features:
+        for f in rf.traverse():
+            if f.uid not in features:
+                features[f.uid] = f
+                order.append(f.uid)
+
+    stage_entries = []
+    seen = set()
+    for f in features.values():
+        stage = f.origin_stage
+        if stage is None or stage.uid in seen:
+            continue
+        seen.add(stage.uid)
+        fitted = model.fitted.get(stage.uid, stage)
+        entry = {
+            "uid": stage.uid,
+            "class": type(fitted).__name__,
+            "estimator_class": type(getattr(stage, "_estimator", stage)).__name__,
+            "params": fitted.get_params(),
+            "inputs": [p.uid for p in stage.input_features],
+        }
+        if isinstance(stage, FeatureGeneratorStage) and stage.extract is not None:
+            entry["warning"] = "extract-fn feature: not reloadable"
+        stage_entries.append(entry)
+
+    manifest = {
+        "version": VERSION,
+        "result_features": [f.uid for f in model.result_features],
+        "features": [_feature_entry(features[uid]) for uid in order],
+        "stages": stage_entries,
+    }
+    with open(out, "w") as fh:
+        json.dump(manifest, fh)
+
+
+def load_model(path: str):
+    from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+    with open(os.path.join(path, MANIFEST)) as fh:
+        manifest = json.load(fh)
+    if manifest["version"] != VERSION:
+        raise ValueError(f"Unsupported model version {manifest['version']}")
+
+    stage_specs = {s["uid"]: s for s in manifest["stages"]}
+    stages: Dict[str, Any] = {}
+    for uid, spec in stage_specs.items():
+        cls = StageRegistry.get(spec["class"])
+        params = dict(spec["params"])
+        if cls is FeatureGeneratorStage:
+            params["ftype"] = T.feature_type_by_name(params.pop("ftype"))
+        stages[uid] = cls(uid=uid, **params)
+
+    features: Dict[str, Feature] = {}
+    for fe in manifest["features"]:
+        stage = stages.get(fe["origin_stage"])
+        parents = tuple(features[p] for p in fe["parents"])
+        if stage is not None and parents:
+            stage.input_features = parents
+        f = Feature(
+            name=fe["name"], ftype=T.feature_type_by_name(fe["ftype"]),
+            origin_stage=stage, parents=parents,
+            is_response=fe["is_response"], uid=fe["uid"])
+        if stage is not None:
+            stage._output = f
+        features[fe["uid"]] = f
+
+    fitted = {
+        uid: stage for uid, stage in stages.items()
+        if isinstance(stage, Transformer)}
+    result = [features[uid] for uid in manifest["result_features"]]
+    return WorkflowModel(result_features=result, fitted=fitted)

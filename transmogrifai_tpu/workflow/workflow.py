@@ -1,0 +1,221 @@
+"""Workflow engine: fit the feature DAG layer-by-layer, score, save/load.
+
+Reference parity: `core/.../OpWorkflow.scala:61-588` (train),
+`OpWorkflowModel.scala:60-455` (score/evaluate/save),
+`FitStagesUtil.scala:51-369` (layered DAG fit + fused layer transforms).
+
+TPU-first: fitting walks the layered DAG on host, dispatching estimator fits
+(which internally run jitted reductions/optimizers); transforms execute
+eagerly during fit so estimators see materialized inputs. Scoring uses the
+same walk (`_execute`) or the fused `CompiledScorer` (workflow/compiled.py)
+that runs every jittable stage in ONE XLA program.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.features.dag import clone_graph, topological_layers
+from transmogrifai_tpu.stages.base import (
+    Estimator, FeatureGeneratorStage, FitContext, Stage, Transformer)
+
+
+class Workflow:
+    """Declarative workflow: wire result features, then `train()`."""
+
+    def __init__(self):
+        self.result_features: Tuple = ()
+        self._dataset: Optional[Dataset] = None
+        self._reader = None
+        self.parameters: Dict[str, Any] = {}
+
+    def set_result_features(self, *features) -> "Workflow":
+        self.result_features = tuple(features)
+        return self
+
+    def set_input_dataset(self, dataset: Dataset) -> "Workflow":
+        self._dataset = dataset
+        return self
+
+    def set_reader(self, reader) -> "Workflow":
+        self._reader = reader
+        return self
+
+    def set_parameters(self, params: Dict[str, Any]) -> "Workflow":
+        self.parameters = dict(params)
+        return self
+
+    # ------------------------------------------------------------------ #
+
+    def _resolve_dataset(self, dataset: Optional[Dataset]) -> Dataset:
+        ds = dataset or self._dataset
+        if ds is None and self._reader is not None:
+            ds = self._reader.read()
+        if ds is None:
+            raise RuntimeError(
+                "No input data: call set_input_dataset / set_reader or pass "
+                "a dataset to train()/score()")
+        return ds
+
+    def train(self, dataset: Optional[Dataset] = None,
+              seed: int = 42) -> "WorkflowModel":
+        """Materialize raw features, then fit the DAG layer by layer
+        (OpWorkflow.train → fitStages → fitAndTransformLayer)."""
+        ds = self._resolve_dataset(dataset)
+        if not self.result_features:
+            raise RuntimeError("set_result_features before train()")
+        # fit a private clone: the estimator→model swap must not mutate the
+        # user's graph or previously returned models (see dag.clone_graph)
+        result_features = clone_graph(self.result_features)
+        layers = topological_layers(result_features)
+        ctx = FitContext(n_rows=len(ds), seed=seed)
+        columns: Dict[str, Column] = {}
+        fitted: Dict[str, Transformer] = {}
+
+        for gen in layers[0] if layers else []:
+            if not isinstance(gen, FeatureGeneratorStage):
+                raise TypeError(f"Layer-0 stage {gen!r} is not a feature generator")
+            columns[gen.get_output().uid] = gen.materialize(ds)
+
+        for li, layer in enumerate(layers[1:], start=1):
+            for stage in layer:
+                inputs = [columns[f.uid] for f in stage.input_features]
+                # a re-train sees fitted models in the DAG; refit via their
+                # original estimator (copyWithNewStages swap, stages/base.py)
+                est = getattr(stage, "_estimator", None) or stage
+                if isinstance(est, Estimator):
+                    model = est.fit(inputs, ctx.child(li))
+                    fitted[est.uid] = model
+                    out = model.transform(inputs, ctx)
+                elif isinstance(stage, Transformer):
+                    fitted[stage.uid] = stage
+                    out = stage.transform(inputs, ctx)
+                else:
+                    raise TypeError(f"Cannot execute stage {stage!r}")
+                columns[stage.get_output().uid] = out
+
+        return WorkflowModel(
+            result_features=result_features, fitted=fitted,
+            train_columns=columns)
+
+
+class WorkflowModel:
+    """A fitted workflow (OpWorkflowModel): scoring, evaluation, persistence."""
+
+    def __init__(self, result_features: Sequence, fitted: Dict[str, Transformer],
+                 train_columns: Optional[Dict[str, Column]] = None):
+        self.result_features = tuple(result_features)
+        self.fitted = dict(fitted)
+        self.train_columns = train_columns or {}
+        self._compiled = None
+
+    # ------------------------------------------------------------------ #
+    # execution                                                          #
+    # ------------------------------------------------------------------ #
+
+    def _execute(self, ds: Dataset) -> Dict[str, Column]:
+        """Eager layer-by-layer transform walk (estimators must be fitted)."""
+        layers = topological_layers(self.result_features)
+        columns: Dict[str, Column] = {}
+        for gen in layers[0] if layers else []:
+            columns[gen.get_output().uid] = gen.materialize(
+                ds, allow_missing_response=True)
+        for layer in layers[1:]:
+            for stage in layer:
+                model = self.fitted.get(stage.uid)
+                if model is None:
+                    raise RuntimeError(
+                        f"Stage {stage.operation_name} ({stage.uid}) has no "
+                        "fitted model — did train() run?")
+                inputs = [columns[f.uid] for f in stage.input_features]
+                columns[stage.get_output().uid] = model.transform(inputs)
+        return columns
+
+    def score(self, dataset: Dataset,
+              keep_intermediate: bool = False) -> Dict[str, Column]:
+        """Batch scoring: returns {feature_name: Column} for result features
+        (OpWorkflowModel.score; drops raw/intermediate like saveScores)."""
+        columns = self._execute(dataset)
+        if keep_intermediate:
+            return columns
+        return {f.name: columns[f.uid] for f in self.result_features}
+
+    def score_compiled(self, dataset: Dataset) -> Dict[str, Any]:
+        """Fused-XLA scoring path (the `local/` + MLeap equivalent)."""
+        if self._compiled is None:
+            from transmogrifai_tpu.workflow.compiled import CompiledScorer
+            self._compiled = CompiledScorer(self)
+        return self._compiled(dataset)
+
+    def score_function(self):
+        """Row-level scoring closure: Map[str, Any] → Map[str, Any]
+        (local/.../OpWorkflowModelLocal.scala:79-122)."""
+        from transmogrifai_tpu.workflow.compiled import CompiledScorer
+        scorer = CompiledScorer(self)
+
+        def score_row(row: Dict[str, Any]) -> Dict[str, Any]:
+            ds = Dataset.from_rows([row])
+            out = scorer(ds)
+            result: Dict[str, Any] = {}
+            for f in self.result_features:
+                v = out.get(f.name)
+                if isinstance(v, dict) and "prediction" in v:  # Prediction pytree
+                    m: Dict[str, float] = {
+                        "prediction": float(np.asarray(v["prediction"])[0])}
+                    prob = np.asarray(v["probability"])[0]
+                    raw = np.asarray(v["rawPrediction"])[0]
+                    for i, x in enumerate(prob):
+                        m[f"probability_{i}"] = float(x)
+                    for i, x in enumerate(raw):
+                        m[f"rawPrediction_{i}"] = float(x)
+                    result[f.name] = m
+                elif isinstance(v, dict):  # scalar {value, mask} pytree
+                    present = bool(np.asarray(v["mask"])[0])
+                    result[f.name] = (
+                        float(np.asarray(v["value"])[0]) if present else None)
+                else:
+                    arr = np.asarray(v)
+                    first = arr[0]
+                    if arr.dtype == object:  # host kinds: str/list/dict
+                        result[f.name] = first
+                    else:
+                        result[f.name] = (first.tolist() if arr.ndim > 1
+                                          else first.item())
+            return result
+
+        return score_row
+
+    def evaluate(self, dataset: Dataset, evaluator, label_feature,
+                 prediction_feature):
+        cols = self._execute(dataset)
+        return evaluator.evaluate(
+            cols[label_feature.uid], cols[prediction_feature.uid])
+
+    # ------------------------------------------------------------------ #
+    # persistence                                                        #
+    # ------------------------------------------------------------------ #
+
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from transmogrifai_tpu.workflow.serialization import save_model
+        save_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "WorkflowModel":
+        from transmogrifai_tpu.workflow.serialization import load_model
+        return load_model(path)
+
+    def summary(self) -> Dict[str, Any]:
+        """Stage inventory + params (OpWorkflowModel.summary analogue)."""
+        return {
+            "result_features": [f.name for f in self.result_features],
+            "stages": [
+                {"uid": uid, "class": type(s).__name__}
+                for uid, s in sorted(self.fitted.items())
+            ],
+        }
