@@ -1,0 +1,117 @@
+"""Benchmark: ModelSelector CV sweep wall-clock + scored rows/sec.
+
+Workload (BASELINE.md config 1/4 shape, scaled to one chip): synthetic
+tabular binary classification — 100k rows × (20 numeric + 3 categorical)
+features → transmogrify → SanityChecker → BinaryClassificationModelSelector
+(LR grid of 8 × 3-fold CV = 24 fits, vmapped into batched XLA programs) →
+fused compiled scoring over the full dataset.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+`value` is scored rows/sec through the fused scorer (higher is better).
+`vs_baseline` divides by BASELINE_ROWS_PER_SEC — an estimate of the
+reference's Spark local[*] scoring throughput for an equivalent fitted
+pipeline (the reference publishes no numbers; see BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+N_ROWS = 100_000
+N_NUMERIC = 20
+BASELINE_ROWS_PER_SEC = 50_000.0  # documented estimate, BASELINE.md
+BASELINE_SWEEP_S = 120.0          # documented estimate, BASELINE.md
+
+
+def make_data(n=N_ROWS, seed=7):
+    from transmogrifai_tpu.data import Dataset
+    rng = np.random.default_rng(seed)
+    cols = {}
+    schema = {}
+    import transmogrifai_tpu.types as t
+    w = rng.normal(size=N_NUMERIC) / np.sqrt(N_NUMERIC)
+    Xn = rng.normal(size=(n, N_NUMERIC))
+    logits = Xn @ w
+    for j in range(N_NUMERIC):
+        vals = Xn[:, j].astype(np.float64).copy()
+        vals[rng.uniform(size=n) < 0.05] = np.nan  # typed numeric storage
+        cols[f"num{j}"] = vals
+        schema[f"num{j}"] = t.Real
+    for name, levels, effect in (("cat_a", ["u", "v", "w"], 0.8),
+                                 ("cat_b", ["x", "y"], -0.5),
+                                 ("cat_c", ["p", "q", "r", "s"], 0.3)):
+        ids = rng.integers(len(levels), size=n)
+        logits = logits + effect * (ids == 0)
+        arr = np.empty(n, dtype=object)
+        for i in range(n):
+            arr[i] = levels[ids[i]]
+        cols[name] = arr
+        schema[name] = t.PickList
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(int)
+    cols["label"] = y.astype(np.float64)
+    schema["label"] = t.Integral
+    return Dataset(cols, schema)
+
+
+def main():
+    import jax
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.automl.sanity_checker import SanityChecker
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, DataSplitter)
+    from transmogrifai_tpu.workflow import Workflow
+
+    t0 = time.time()
+    ds = make_data()
+    t_data = time.time() - t0
+
+    preds, label = FeatureBuilder.from_dataset(ds, response="label")
+    vector = transmogrify(preds)
+    checked = SanityChecker().set_input(label, vector).get_output()
+    lr_grid = [{"reg_param": r} for r in
+               (0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.15, 0.2)]
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        models=[(OpLogisticRegression(max_iter=30), lr_grid)],
+        n_folds=3, splitter=DataSplitter(reserve_test_fraction=0.1))
+    pf = selector.set_input(label, checked).get_output()
+
+    t0 = time.time()
+    model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
+    t_sweep = time.time() - t0
+
+    fitted = model.fitted[pf.origin_stage.uid]
+    holdout = fitted.summary.holdout_metrics
+
+    # fused scoring: warm up (compile), then measure
+    t0 = time.time()
+    out = model.score_compiled(ds)
+    jax.block_until_ready(out[pf.name])
+    t_compile_score = time.time() - t0
+    t0 = time.time()
+    out = model.score_compiled(ds)
+    jax.block_until_ready(out[pf.name])
+    t_score = time.time() - t0
+    rows_per_sec = N_ROWS / t_score
+
+    print(json.dumps({
+        "metric": "fused_scoring_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+        "sweep_wall_s": round(t_sweep, 2),
+        "sweep_vs_baseline": round(BASELINE_SWEEP_S / t_sweep, 3),
+        "sweep_fits": 8 * 3,
+        "n_rows": N_ROWS,
+        "holdout_aupr": round(holdout.get("AuPR", 0.0), 4),
+        "holdout_auroc": round(holdout.get("AuROC", 0.0), 4),
+        "score_compile_s": round(t_compile_score - t_score, 2),
+        "datagen_s": round(t_data, 2),
+        "platform": jax.devices()[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

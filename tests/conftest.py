@@ -8,11 +8,16 @@ so every sharding/collective path is exercised without a TPU pod.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# NOTE: the axon TPU plugin ignores the JAX_PLATFORMS env var; forcing CPU
+# requires jax.config.update (or JAX_PLATFORM_NAME) before backend init.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -23,13 +23,16 @@ def test_csv_inference():
     assert ds.schema["fare"] is t.Real
     assert ds.schema["survived"] is t.Integral  # 0/1 ints, like Spark CSV infer
     assert ds.schema["name"] is t.Text
-    assert ds.column("age")[2] is None
-    assert ds.column("fare")[3] is None
-    assert ds.column("embarked")[2] is None
+    # numeric columns use typed float64 storage with NaN for missing
+    assert np.isnan(ds.column("age")[2])
+    assert np.isnan(ds.column("fare")[3])
+    assert ds.column("embarked")[2] is None  # text stays object/None
     assert ds.column("survived")[1] == 1
+    assert ds.to_rows()[2]["age"] is None  # row view restores None
     bools = Dataset.from_csv_string("flag\ntrue\nfalse\n\n")
     assert bools.schema["flag"] is t.Binary
-    assert bools.column("flag")[0] is True and bools.column("flag")[2] is None
+    assert bools.column("flag")[0] == 1.0 and np.isnan(bools.column("flag")[2])
+    assert bools.to_rows()[0]["flag"] == 1.0 and bools.to_rows()[2]["flag"] is None
 
 
 def test_csv_explicit_schema():

@@ -1,0 +1,112 @@
+"""SanityChecker / MinVarianceFilter tests (reference: SanityCheckerTest.scala)."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.automl.sanity_checker import (
+    MinVarianceFilter, SanityChecker, cramers_v)
+from transmogrifai_tpu.data import Column
+from transmogrifai_tpu.data.metadata import VectorColumnMetadata, VectorMetadata
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage, FitContext
+
+
+def _vec_col(X, names=None, groups=None, indicators=None):
+    X = np.asarray(X, dtype=np.float32)
+    cols = []
+    for i in range(X.shape[1]):
+        cols.append(VectorColumnMetadata(
+            parent_name=(names[i] if names else f"f{i}"),
+            parent_type="Real",
+            grouping=(groups[i] if groups else None),
+            indicator_value=(indicators[i] if indicators else None)))
+    meta = VectorMetadata("v", tuple(cols)).with_indices()
+    return Column.vector(X, meta)
+
+
+def _label(y):
+    y = np.asarray(y, dtype=np.float64)
+    return Column(t.RealNN, {"value": y, "mask": np.ones(len(y), dtype=bool)})
+
+
+def _fit(est, label, vec):
+    lf = FeatureGeneratorStage(name="y", ftype=t.RealNN, is_response=True).get_output()
+    vf = FeatureGeneratorStage(name="v", ftype=t.OPVector).get_output()
+    est.set_input(lf, vf)
+    return est.fit([label, vec], FitContext(len(label.data["value"])))
+
+
+def test_drops_low_variance_and_leakage():
+    rng = np.random.default_rng(0)
+    n = 400
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    good = rng.normal(size=n)
+    constant = np.full(n, 3.0)
+    leak = y * 2 - 1 + rng.normal(0, 1e-3, n)  # corr ≈ 1 with label
+    X = np.stack([good, constant, leak], axis=1)
+    model = _fit(SanityChecker(), _label(y), _vec_col(X, names=["good", "const", "leak"]))
+    assert model.indices == [0]
+    s = model.summary
+    assert s["kept"] == [0]
+    reasons = {st["name"]: st["dropped"] for st in s["stats"]}
+    assert any("variance" in r for r in reasons["const_1"])
+    assert any("label corr" in r for r in reasons["leak_2"])
+    # transform slices kept columns
+    out = model.transform([_label(y), _vec_col(X)])
+    assert np.asarray(out.data).shape == (n, 1)
+    assert model.output_meta().size == 1
+
+
+def test_cramers_v_leakage_drop():
+    rng = np.random.default_rng(1)
+    n = 600
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    # categorical group perfectly aligned with the label (one-hot of y)
+    cat_a = (y == 1).astype(np.float32)
+    cat_b = (y == 0).astype(np.float32)
+    noise = rng.normal(size=n).astype(np.float32)
+    X = np.stack([cat_a, cat_b, noise], axis=1)
+    vec = _vec_col(
+        X, names=["c", "c", "x"], groups=["c", "c", None],
+        indicators=["a", "b", None])
+    model = _fit(SanityChecker(), _label(y), vec)
+    assert model.indices == [2]  # both group columns dropped via Cramér's V
+    stats = model.summary["stats"]
+    assert stats[0]["cramersV"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_keeps_everything_when_clean():
+    rng = np.random.default_rng(2)
+    n = 300
+    y = (rng.uniform(size=n) > 0.5).astype(float)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    model = _fit(SanityChecker(), _label(y), _vec_col(X))
+    assert model.indices == [0, 1, 2, 3]
+
+
+def test_never_drops_all():
+    n = 100
+    y = np.zeros(n)
+    X = np.ones((n, 2), dtype=np.float32)  # all constant
+    model = _fit(SanityChecker(), _label(y), _vec_col(X))
+    assert model.indices == [0, 1]  # retained despite flags
+
+
+def test_cramers_v_function():
+    # perfect association → 1
+    assert cramers_v(np.array([[50, 0], [0, 50]])) == pytest.approx(1.0)
+    # independence → 0
+    assert cramers_v(np.array([[25, 25], [25, 25]])) == pytest.approx(0.0)
+    assert cramers_v(np.zeros((2, 2))) == 0.0
+
+
+def test_min_variance_filter():
+    rng = np.random.default_rng(3)
+    n = 200
+    X = np.stack([rng.normal(size=n), np.full(n, 7.0)], axis=1)
+    vf = FeatureGeneratorStage(name="v", ftype=t.OPVector).get_output()
+    est = MinVarianceFilter().set_input(vf)
+    model = est.fit([_vec_col(X)], FitContext(n))
+    assert model.indices == [0]
+    out = model.transform([_vec_col(X)])
+    assert np.asarray(out.data).shape == (n, 1)

@@ -1,0 +1,174 @@
+"""ModelSelector / splitters / validators / sweep tests
+(reference: ModelSelectorTest, DataBalancerTest, OpCrossValidationTest)."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.automl import transmogrify
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLinearRegression, OpLogisticRegression
+from transmogrifai_tpu.selector import (
+    BinaryClassificationModelSelector, DataBalancer, DataCutter, DataSplitter,
+    MultiClassificationModelSelector, OpCrossValidation, OpTrainValidationSplit,
+    ParamGridBuilder, RandomParamBuilder, RegressionModelSelector)
+from transmogrifai_tpu.workflow import Workflow
+
+
+def test_param_grid_builder():
+    grids = ParamGridBuilder().add("reg_param", [0.1, 0.2]).add("max_iter", [10, 20]).build()
+    assert len(grids) == 4
+    assert {g["reg_param"] for g in grids} == {0.1, 0.2}
+    assert ParamGridBuilder().build() == [{}]
+
+
+def test_random_param_builder():
+    grids = RandomParamBuilder(seed=1).exponential("reg_param", 1e-4, 1e-1) \
+        .subset("max_iter", [10, 50]).build(8)
+    assert len(grids) == 8
+    assert all(1e-4 <= g["reg_param"] <= 1e-1 for g in grids)
+    assert all(g["max_iter"] in (10, 50) for g in grids)
+    with pytest.raises(ValueError):
+        RandomParamBuilder().exponential("x", 0, 1)
+
+
+def test_cross_validation_masks():
+    y = np.arange(100) % 2
+    cv = OpCrossValidation(n_folds=4, seed=0)
+    folds = cv.splits(y.astype(float))
+    assert len(folds) == 4
+    total_val = np.zeros(100)
+    for tr, va in folds:
+        assert np.all(tr + va == 1.0)  # partition
+        total_val += va
+    np.testing.assert_array_equal(total_val, 1.0)  # each row in exactly 1 fold
+
+
+def test_stratified_cv():
+    y = np.array([0.0] * 90 + [1.0] * 10)
+    folds = OpCrossValidation(n_folds=5, stratify=True, seed=0).splits(y)
+    for tr, va in folds:
+        assert y[va > 0.5].sum() == 2  # exactly 10/5 positives per fold
+
+
+def test_train_validation_split():
+    y = np.zeros(1000)
+    folds = OpTrainValidationSplit(train_ratio=0.75, seed=0).splits(y)
+    assert len(folds) == 1
+    assert folds[0][0].sum() == pytest.approx(750, abs=50)
+
+
+def test_data_splitter():
+    y = np.arange(100, dtype=float)
+    tr, te, s = DataSplitter(reserve_test_fraction=0.2, seed=0).split(y)
+    assert len(te) == 20 and len(tr) == 80
+    assert len(np.intersect1d(tr, te)) == 0
+    assert s.n_test == 20
+
+
+def test_data_balancer():
+    y = np.array([1.0] * 20 + [0.0] * 980)
+    b = DataBalancer(sample_fraction=0.25, seed=0)
+    tr, te, _ = b.split(y)
+    prepared, details = b.prepare(y, tr)
+    yp = y[prepared]
+    frac = yp.sum() / len(yp)
+    assert details["balanced"]
+    assert frac >= 0.2  # minority lifted to ~sample_fraction
+
+
+def test_data_cutter():
+    y = np.array([0.0] * 50 + [1.0] * 40 + [2.0] * 5 + [3.0] * 5)
+    c = DataCutter(max_label_categories=2, reserve_test_fraction=0.0)
+    tr, te, _ = c.split(y)
+    prepared, details = c.prepare(y, tr)
+    assert set(np.unique(y[prepared])) == {0.0, 1.0}
+    assert set(details["labels_dropped"]) == {2.0, 3.0}
+
+
+def _binary_ds(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = (x1 + 0.5 * x2 + rng.normal(0, 0.5, n) > 0).astype(int)
+    return Dataset.from_rows(
+        [{"x1": float(x1[i]), "x2": float(x2[i]), "y": int(y[i])} for i in range(n)])
+
+
+def test_binary_selector_end_to_end():
+    ds = _binary_ds()
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = transmogrify(preds)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=3, splitter=DataSplitter(reserve_test_fraction=0.2))
+    pf = sel.set_input(label, vec).get_output()
+    model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
+    fitted = model.fitted[pf.origin_stage.uid]
+    s = fitted.summary
+    assert s.best_model == "OpLogisticRegression"
+    assert len(s.validation_results) == 4  # default LR grid
+    assert all(len(r.fold_metrics) == 3 for r in s.validation_results)
+    assert s.holdout_metrics["AuPR"] > 0.7
+    assert s.train_metrics["AuROC"] > 0.7
+    assert "Evaluated 4 model configs" in s.pretty()
+
+
+def test_multiclass_selector():
+    rng = np.random.default_rng(1)
+    n = 400
+    X = rng.normal(size=(n, 2))
+    y = np.argmax(X @ rng.normal(size=(2, 3)) + rng.normal(0, 0.3, (n, 3)), axis=1)
+    ds = Dataset.from_rows(
+        [{"a": float(X[i, 0]), "b": float(X[i, 1]), "y": int(y[i])} for i in range(n)])
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = transmogrify(preds)
+    sel = MultiClassificationModelSelector.with_cross_validation(n_folds=2)
+    pf = sel.set_input(label, vec).get_output()
+    model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
+    scores = model.score(ds)
+    assert np.asarray(scores[pf.name].data["probability"]).shape == (n, 3)
+    fitted = model.fitted[pf.origin_stage.uid]
+    assert fitted.summary.problem_type == "multiclass"
+    assert fitted.summary.holdout_metrics["F1"] > 0.6
+
+
+def test_regression_selector():
+    rng = np.random.default_rng(2)
+    n = 300
+    x = rng.normal(size=n)
+    y = 2.0 * x + 1.0 + rng.normal(0, 0.1, n)
+    ds = Dataset.from_rows(
+        [{"x": float(x[i]), "y": float(y[i])} for i in range(n)])
+    preds, label = FeatureBuilder.from_dataset(ds, response="y", response_type=t.RealNN)
+    vec = transmogrify(preds)
+    sel = RegressionModelSelector.with_cross_validation(n_folds=3)
+    pf = sel.set_input(label, vec).get_output()
+    model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
+    fitted = model.fitted[pf.origin_stage.uid]
+    assert fitted.summary.best_model == "OpLinearRegression"
+    # RMSE is smaller-is-better: best grid should win with low error
+    assert fitted.summary.holdout_metrics["RMSE"] < 0.5
+
+
+def test_selector_fault_tolerance():
+    ds = _binary_ds(100)
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = transmogrify(preds)
+
+    from transmogrifai_tpu.models.base import PredictorEstimator
+
+    class ExplodingModel(PredictorEstimator):  # generic sweep path
+        def fit_arrays(self, X, y, w, ctx):
+            raise RuntimeError("boom")
+
+    from transmogrifai_tpu.selector import ModelSelector
+
+    sel = ModelSelector(
+        models=[(ExplodingModel(), [{"reg_param": 0.1}]),
+                (OpLogisticRegression(max_iter=20), [{"reg_param": 0.1}])],
+        splitter=None)
+    pf = sel.set_input(label, vec).get_output()
+    model = Workflow().set_result_features(pf).set_input_dataset(ds).train()
+    fitted = model.fitted[pf.origin_stage.uid]
+    assert fitted.summary.best_model == "OpLogisticRegression"

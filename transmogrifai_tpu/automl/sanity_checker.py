@@ -1,0 +1,270 @@
+"""SanityChecker & MinVarianceFilter: automated feature validation.
+
+Reference parity: `core/.../preparators/SanityChecker.scala:232-656`
+(colStats + label correlations + categorical Cramér's V, drop rules, summary
+metadata) and `MinVarianceFilter.scala:58,145`.
+
+TPU-first: all statistics are single-pass masked reductions over the (n, d)
+feature matrix — sums, squared sums, X·y and group contingency via one-hot
+label matmul — each a `psum`-ready reduction over the sharded batch axis.
+Drop decisions (data-dependent shapes) resolve on host at fit time; the
+fitted model is a static-index column gather that XLA fuses downstream.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.nn
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import VectorMetadata
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+
+@dataclass
+class ColumnStats:
+    name: str
+    mean: float
+    variance: float
+    min: float
+    max: float
+    corr_label: float
+    cramers_v: Optional[float]
+    dropped: List[str] = field(default_factory=list)
+
+    def to_json(self) -> Dict:
+        return {
+            "name": self.name, "mean": self.mean, "variance": self.variance,
+            "min": self.min, "max": self.max, "corrLabel": self.corr_label,
+            "cramersV": self.cramers_v, "dropped": self.dropped,
+        }
+
+
+@dataclass
+class SanityCheckerSummary:
+    """Persisted fit diagnostics (SanityCheckerMetadata analogue)."""
+
+    n_rows: int
+    stats: List[ColumnStats]
+    kept_indices: List[int]
+    dropped_indices: List[int]
+
+    def to_json(self) -> Dict:
+        return {
+            "n_rows": self.n_rows,
+            "stats": [s.to_json() for s in self.stats],
+            "kept": self.kept_indices, "dropped": self.dropped_indices,
+        }
+
+
+def _column_reductions(X: jnp.ndarray, y: jnp.ndarray):
+    """One fused pass: per-column moments + label correlation terms.
+
+    Every term is a sum over rows → shard the row axis, `psum` the sums.
+    """
+    n = X.shape[0]
+    sx = X.sum(0)
+    sxx = (X * X).sum(0)
+    sy = y.sum()
+    syy = (y * y).sum()
+    sxy = X.T @ y
+    xmin = X.min(0) if n else jnp.zeros(X.shape[1])
+    xmax = X.max(0) if n else jnp.zeros(X.shape[1])
+    return {"n": n, "sx": sx, "sxx": sxx, "sy": sy, "syy": syy, "sxy": sxy,
+            "min": xmin, "max": xmax}
+
+
+def _label_onehot(y: np.ndarray, max_card: int) -> Optional[np.ndarray]:
+    """One-hot label for contingency tests, or None if not categorical."""
+    yi = np.round(y).astype(np.int64)
+    if not np.allclose(y, yi, atol=1e-6):
+        return None
+    levels = np.unique(yi)
+    if len(levels) < 2 or len(levels) > max_card:
+        return None
+    lut = {v: i for i, v in enumerate(levels.tolist())}
+    idx = np.array([lut[v] for v in yi.tolist()])
+    oh = np.zeros((len(y), len(levels)), dtype=np.float32)
+    oh[np.arange(len(y)), idx] = 1.0
+    return oh
+
+
+def cramers_v(contingency: np.ndarray) -> float:
+    """Cramér's V from a levels × labels count table
+    (OpStatistics.scala contingency analysis)."""
+    n = contingency.sum()
+    if n == 0:
+        return 0.0
+    row = contingency.sum(axis=1, keepdims=True)
+    col = contingency.sum(axis=0, keepdims=True)
+    expected = row @ col / n
+    with np.errstate(divide="ignore", invalid="ignore"):
+        chi2 = np.where(expected > 0,
+                        (contingency - expected) ** 2 / expected, 0.0).sum()
+    r, c = contingency.shape
+    denom = n * (min(r, c) - 1)
+    return float(np.sqrt(chi2 / denom)) if denom > 0 else 0.0
+
+
+class SanityCheckerModel(Transformer):
+    """Fitted checker: static column gather of the kept indices."""
+
+    out_type = T.OPVector
+
+    def __init__(self, indices: Sequence[int], meta: Optional[Dict] = None,
+                 summary: Optional[Dict] = None, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.indices = list(int(i) for i in indices)
+        self._meta_json = (meta.to_json() if isinstance(meta, VectorMetadata)
+                           else meta)
+        self.summary = summary
+
+    def device_apply(self, enc, dev):
+        X = jnp.asarray(dev[-1])
+        return X[:, jnp.asarray(self.indices, dtype=jnp.int32)]
+
+    def output_meta(self) -> Optional[VectorMetadata]:
+        if self._meta_json is None:
+            return None
+        return VectorMetadata.from_json(self._meta_json)
+
+    def get_params(self):
+        return {"indices": self.indices, "meta": self._meta_json,
+                "summary": self.summary}
+
+
+class SanityChecker(Estimator):
+    """BinaryEstimator(RealNN label, OPVector) → cleaned OPVector.
+
+    Drop rules (DerivedFeatureFilterUtils analogue): variance below
+    `min_variance`; |corr(feature, label)| above `max_correlation` (leakage)
+    or below `min_correlation`; categorical-group Cramér's V above
+    `max_cramers_v` (leakage).
+    """
+
+    in_types = (T.RealNN, T.OPVector)
+    out_type = T.OPVector
+
+    def __init__(self, max_correlation: float = 0.95,
+                 min_correlation: float = 0.0, min_variance: float = 1e-5,
+                 max_cramers_v: float = 0.95, remove_bad_features: bool = True,
+                 categorical_label_max_card: int = 30,
+                 uid: Optional[str] = None):
+        super().__init__(
+            uid=uid, max_correlation=max_correlation,
+            min_correlation=min_correlation, min_variance=min_variance,
+            max_cramers_v=max_cramers_v, remove_bad_features=remove_bad_features,
+            categorical_label_max_card=categorical_label_max_card)
+        self.max_correlation = max_correlation
+        self.min_correlation = min_correlation
+        self.min_variance = min_variance
+        self.max_cramers_v = max_cramers_v
+        self.remove_bad_features = remove_bad_features
+        self.categorical_label_max_card = categorical_label_max_card
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        label_col, vec_col = cols
+        y_np = np.asarray(label_col.data["value"], dtype=np.float64)
+        X = jnp.asarray(vec_col.device_value())
+        y = jnp.asarray(y_np.astype(np.float32))
+        n, d = X.shape
+
+        red = {k: np.asarray(v) for k, v in _column_reductions(X, y).items()}
+        mean = red["sx"] / max(n, 1)
+        var = (red["sxx"] - n * mean ** 2) / max(n - 1, 1)
+        var = np.maximum(var, 0.0)
+        y_mean = red["sy"] / max(n, 1)
+        y_var = max((red["syy"] - n * y_mean ** 2) / max(n - 1, 1), 0.0)
+        cov = (red["sxy"] - n * mean * y_mean) / max(n - 1, 1)
+        denom = np.sqrt(var * y_var)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            corr = np.where(denom > 0, cov / denom, 0.0)
+
+        meta = vec_col.meta
+        names = (meta.column_names() if meta is not None
+                 else [f"col_{i}" for i in range(d)])
+
+        # categorical groups → Cramér's V against a categorical label
+        group_v: Dict[int, float] = {}
+        if meta is not None:
+            oh = _label_onehot(y_np, self.categorical_label_max_card)
+            if oh is not None:
+                groups: Dict[str, List[int]] = {}
+                for i, c in enumerate(meta.columns):
+                    if c.indicator_value is not None:
+                        groups.setdefault(c.grouping_key(), []).append(i)
+                Xn = np.asarray(X)
+                for key, idxs in groups.items():
+                    cont = Xn[:, idxs].T @ oh  # levels × labels counts
+                    v = cramers_v(cont)
+                    for i in idxs:
+                        group_v[i] = v
+
+        stats: List[ColumnStats] = []
+        kept: List[int] = []
+        for i in range(d):
+            reasons: List[str] = []
+            if var[i] < self.min_variance:
+                reasons.append(f"variance {var[i]:.2e} < {self.min_variance}")
+            ac = abs(float(corr[i]))
+            if ac > self.max_correlation:
+                reasons.append(f"label corr {ac:.3f} > {self.max_correlation}")
+            elif self.min_correlation > 0 and ac < self.min_correlation:
+                reasons.append(f"label corr {ac:.3f} < {self.min_correlation}")
+            gv = group_v.get(i)
+            if gv is not None and gv > self.max_cramers_v:
+                reasons.append(f"cramersV {gv:.3f} > {self.max_cramers_v}")
+            stats.append(ColumnStats(
+                name=names[i], mean=float(mean[i]), variance=float(var[i]),
+                min=float(red["min"][i]), max=float(red["max"][i]),
+                corr_label=float(corr[i]), cramers_v=gv, dropped=reasons))
+            if not reasons or not self.remove_bad_features:
+                kept.append(i)
+
+        if not kept:  # never drop everything (reference keeps result usable)
+            kept = list(range(d))
+            for s in stats:
+                s.dropped.append("retained: all columns flagged")
+
+        kept_set = set(kept)
+        summary = SanityCheckerSummary(
+            n_rows=n, stats=stats, kept_indices=kept,
+            dropped_indices=[i for i in range(d) if i not in kept_set])
+        sel_meta = meta.select(kept) if meta is not None else None
+        return SanityCheckerModel(kept, meta=sel_meta, summary=summary.to_json())
+
+
+class MinVarianceFilterModel(SanityCheckerModel):
+    pass
+
+
+class MinVarianceFilter(Estimator):
+    """Unary OPVector → OPVector: drop near-constant columns
+    (MinVarianceFilter.scala — the unlabeled SanityChecker)."""
+
+    in_types = (T.OPVector,)
+    out_type = T.OPVector
+
+    def __init__(self, min_variance: float = 1e-5, uid: Optional[str] = None):
+        super().__init__(uid=uid, min_variance=min_variance)
+        self.min_variance = min_variance
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        vec_col = cols[0]
+        X = jnp.asarray(vec_col.device_value())
+        n, d = X.shape
+        mean = np.asarray(X.mean(0))
+        var = np.asarray(((X - mean) ** 2).sum(0)) / max(n - 1, 1)
+        kept = [i for i in range(d) if var[i] >= self.min_variance]
+        if not kept:
+            kept = list(range(d))
+        meta = vec_col.meta
+        sel_meta = meta.select(kept) if meta is not None else None
+        summary = {"n_rows": int(n), "kept": kept,
+                   "dropped": [i for i in range(d) if var[i] < self.min_variance]}
+        return MinVarianceFilterModel(kept, meta=sel_meta, summary=summary)

@@ -108,6 +108,18 @@ class Column:
 
         if k == SCALAR:
             dtype = np.int64 if _is_integral(ftype) else np.float64
+            arr = np.asarray(values)
+            if arr.dtype != object:
+                # fast path: typed numeric storage (Dataset keeps numeric
+                # columns as float arrays with NaN for missing)
+                f = arr.astype(np.float64, copy=False)
+                mask = ~np.isnan(f)
+                if issubclass(ftype, T.NonNullable) and not mask.all():
+                    raise T.FeatureTypeError(
+                        f"{ftype.__name__} cannot be empty "
+                        f"({int((~mask).sum())} missing values)")
+                out = np.where(mask, f, 0.0).astype(dtype)
+                return Column(ftype, {"value": out, "mask": mask})
             out = np.zeros(n, dtype=dtype)
             mask = np.zeros(n, dtype=bool)
             for i, v in enumerate(values):

@@ -86,7 +86,12 @@ def _parse_cell(s: Optional[str], ftype: type) -> Any:
 
 @dataclass
 class Dataset:
-    """Named object-array columns + an optional schema of feature types."""
+    """Named columns + a schema of feature types.
+
+    Physical storage: numeric (OPNumeric-typed) columns are float64 arrays
+    with NaN marking missing values — zero-copy into Column materialization
+    and cheap to shard; all other kinds are object arrays (str/list/set/
+    dict with None for missing)."""
 
     columns: Dict[str, np.ndarray]
     schema: Dict[str, type]
@@ -123,11 +128,21 @@ class Dataset:
         return Dataset(cols, schema)
 
     def to_rows(self) -> List[Dict[str, Any]]:
-        """Row-dict view; cached since every extract-fn feature re-reads it."""
+        """Row-dict view; cached since every extract-fn feature re-reads it.
+        Numeric NaNs surface as None (the row-level missing convention)."""
         if self._rows_cache is None:
             names = self.names()
+            cols = {}
+            for k in names:
+                a = self.columns[k]
+                if a.dtype != object:
+                    obj = a.astype(object)
+                    obj[np.isnan(a.astype(np.float64))] = None
+                    cols[k] = obj
+                else:
+                    cols[k] = a
             self._rows_cache = [
-                {k: self.columns[k][i] for k in names} for i in range(len(self))
+                {k: cols[k][i] for k in names} for i in range(len(self))
             ]
         return self._rows_cache
 
@@ -150,7 +165,12 @@ class Dataset:
                 v = r.get(k)
                 arr[i] = v.value if isinstance(v, T.FeatureType) else v
             cols[k] = arr
-        sch = dict(schema) if schema else {k: _infer_py_type(cols[k]) for k in keys}
+        sch = dict(schema) if schema else {}
+        for k in keys:  # infer any unmapped columns; pack numeric storage
+            if k not in sch:
+                sch[k] = _infer_py_type(cols[k])
+            if issubclass(sch[k], T.OPNumeric):
+                cols[k] = _to_numeric_storage(cols[k])
         return Dataset(cols, sch)
 
     @staticmethod
@@ -185,12 +205,30 @@ class Dataset:
             arr = np.empty(len(raw[j]), dtype=object)
             for i, cell in enumerate(raw[j]):
                 arr[i] = _parse_cell(cell, ftype)
+            if issubclass(ftype, T.OPNumeric):
+                arr = _to_numeric_storage(arr)
             cols[name] = arr
         return Dataset(cols, sch)
 
     @staticmethod
     def from_csv_string(text: str, **kw) -> "Dataset":
         return Dataset.from_csv(io.StringIO(text), **kw)
+
+
+def _to_numeric_storage(arr: np.ndarray) -> np.ndarray:
+    """Object array of numbers/None → float64 with NaN for missing.
+
+    Integers beyond float64's exact range (±2^53) keep object storage so
+    large IDs / epoch-nanos don't silently lose precision."""
+    out = np.empty(len(arr), dtype=np.float64)
+    for i, v in enumerate(arr):
+        if v is None:
+            out[i] = np.nan
+        else:
+            if isinstance(v, int) and abs(v) > (1 << 53):
+                return arr  # exact-int column: stay object
+            out[i] = float(v)
+    return out
 
 
 def _infer_py_type(arr: np.ndarray) -> type:

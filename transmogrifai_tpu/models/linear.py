@@ -30,7 +30,11 @@ def fit_linreg(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, l2) -> Dict:
     Xc = (X - x_mean) * jnp.sqrt(w)[:, None]
     yc = (y - y_mean) * jnp.sqrt(w)
     d = X.shape[1]
-    gram = Xc.T @ Xc / wsum + l2 * jnp.eye(d, dtype=X.dtype)
+    gram = Xc.T @ Xc / wsum
+    # adaptive jitter keeps the solve well-posed when columns are constant
+    # (e.g. an all-zero null-indicator) and l2 == 0
+    eps = 1e-6 * (jnp.trace(gram) / d + 1.0)
+    gram = gram + (l2 + eps) * jnp.eye(d, dtype=X.dtype)
     rhs = Xc.T @ yc / wsum
     beta = jax.scipy.linalg.solve(gram, rhs, assume_a="pos")
     intercept = y_mean - x_mean @ beta

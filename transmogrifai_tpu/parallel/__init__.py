@@ -1,0 +1,4 @@
+from transmogrifai_tpu.parallel.mesh import make_mesh, sweep_sharding, data_sharding
+from transmogrifai_tpu.parallel.sweep import run_sweep
+
+__all__ = ["make_mesh", "sweep_sharding", "data_sharding", "run_sweep"]

@@ -1,0 +1,141 @@
+"""The sweep engine: folds × grids as one batched XLA program.
+
+Reference parity: `OpValidator.getSummary` / `OpCrossValidation.validate`
+(`core/.../tuning/OpValidator.scala:299-358`, `OpCrossValidation.scala:87-147`)
+— the reference dispatches each model×grid×fold fit as a Future running
+Spark jobs; here the same sweep is `vmap(vmap(fit))` over stacked fold
+masks and a dynamic hyperparameter vector, jitted once per static-parameter
+group. On a mesh, sharding the grid axis with `sweep_sharding` spreads the
+whole sweep across chips (SURVEY.md §3.3 north star); fold masks make every
+fit shape-identical so XLA batches them without recompilation.
+
+Fault tolerance mirrors `OpValidator.scala:324-353`: a failing model family
+is dropped with a warning; only all-families-failing raises.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.models.base import infer_n_classes
+from transmogrifai_tpu.models.linear import OpLinearRegression, fit_linreg, predict_linreg
+from transmogrifai_tpu.models.logistic import (
+    OpLogisticRegression, fit_logreg, predict_logreg)
+
+log = logging.getLogger(__name__)
+
+
+def _metric(evaluator, y: np.ndarray, pred: Dict[str, np.ndarray],
+            val_mask: np.ndarray) -> float:
+    idx = val_mask > 0.5
+    label = Column(T.RealNN, {
+        "value": y[idx], "mask": np.ones(int(idx.sum()), dtype=bool)})
+    pcol = Column(T.Prediction, {k: np.asarray(v)[idx] for k, v in pred.items()})
+    return evaluator.metric_value(label, pcol)
+
+
+def _eval_grid_fold(evaluator, y, preds_gk, val_masks) -> List[List[float]]:
+    """preds_gk: dict of arrays with leading (g, k) axes → metric[g][k]."""
+    g = np.asarray(preds_gk["prediction"]).shape[0]
+    k = np.asarray(preds_gk["prediction"]).shape[1]
+    out = []
+    for gi in range(g):
+        row = []
+        for ki in range(k):
+            pred = {key: np.asarray(v)[gi, ki] for key, v in preds_gk.items()}
+            row.append(_metric(evaluator, y, pred, val_masks[ki]))
+        out.append(row)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# vmapped family sweeps                                                       #
+# --------------------------------------------------------------------------- #
+
+def _sweep_logistic(est: OpLogisticRegression, grids: List[Dict], X, y,
+                    folds, evaluator, sharding=None) -> List[List[float]]:
+    y_np = np.asarray(y)
+    n_classes = est.n_classes or infer_n_classes(y_np)
+    W_train = jnp.asarray(np.stack([tr for tr, _ in folds]))
+    val_masks = [va for _, va in folds]
+
+    # group grids sharing static params (max_iter) → one compile per group
+    metrics: List[Optional[List[float]]] = [None] * len(grids)
+    by_static: Dict[int, List[int]] = {}
+    for i, grid in enumerate(grids):
+        mi = int(grid.get("max_iter", est.max_iter))
+        by_static.setdefault(mi, []).append(i)
+
+    for max_iter, idxs in by_static.items():
+        l2s = jnp.asarray(
+            [float(grids[i].get("reg_param", est.reg_param)) for i in idxs],
+            dtype=jnp.float32)
+        if sharding is not None:
+            l2s = jax.device_put(l2s, sharding)
+
+        fit_one = lambda l2, w: fit_logreg(  # noqa: E731
+            X, y, w, l2, n_classes, max_iter)
+        fit_gk = jax.jit(jax.vmap(jax.vmap(fit_one, in_axes=(None, 0)),
+                                  in_axes=(0, None)))
+        params = fit_gk(l2s, W_train)  # pytree with leading (g, k)
+        preds = jax.jit(jax.vmap(jax.vmap(
+            lambda p: predict_logreg(p, X))))(params)
+        grid_fold = _eval_grid_fold(evaluator, y_np, preds, val_masks)
+        for row, i in zip(grid_fold, idxs):
+            metrics[i] = row
+    return metrics  # type: ignore[return-value]
+
+
+def _sweep_linear(est: OpLinearRegression, grids: List[Dict], X, y,
+                  folds, evaluator, sharding=None) -> List[List[float]]:
+    y_np = np.asarray(y)
+    W_train = jnp.asarray(np.stack([tr for tr, _ in folds]))
+    val_masks = [va for _, va in folds]
+    l2s = jnp.asarray(
+        [float(g.get("reg_param", est.reg_param)) for g in grids],
+        dtype=jnp.float32)
+    if sharding is not None:
+        l2s = jax.device_put(l2s, sharding)
+    fit_gk = jax.jit(jax.vmap(jax.vmap(
+        lambda l2, w: fit_linreg(X, y, w, l2), in_axes=(None, 0)),
+        in_axes=(0, None)))
+    params = fit_gk(l2s, W_train)
+    preds = jax.jit(jax.vmap(jax.vmap(
+        lambda p: predict_linreg(p, X))))(params)
+    return _eval_grid_fold(evaluator, y_np, preds, val_masks)
+
+
+def _sweep_generic(est, grids: List[Dict], X, y, folds, evaluator,
+                   ctx) -> List[List[float]]:
+    """Fallback: python loop over grids × folds (future tree models etc.)."""
+    out = []
+    y_np = np.asarray(y)
+    for grid in grids:
+        clone = type(est)(**{**{k: v for k, v in est.params.items()
+                                if k != "uid"}, **grid})
+        row = []
+        for tr, va in folds:
+            model = clone.fit_arrays(X, y, jnp.asarray(tr), ctx)
+            pred = model.predict_arrays(X)
+            row.append(_metric(evaluator, y_np,
+                               {k: np.asarray(v) for k, v in pred.items()}, va))
+        out.append(row)
+    return out
+
+
+def run_sweep(est, grids: List[Dict], X, y, folds, evaluator, ctx,
+              sharding=None) -> List[List[float]]:
+    """Metric matrix [grid][fold] for one model family."""
+    if isinstance(est, OpLogisticRegression):
+        return _sweep_logistic(est, grids, X, y, folds, evaluator, sharding)
+    if isinstance(est, OpLinearRegression):
+        return _sweep_linear(est, grids, X, y, folds, evaluator, sharding)
+    return _sweep_generic(est, grids, X, y, folds, evaluator, ctx)

@@ -1,0 +1,18 @@
+from transmogrifai_tpu.selector.splitters import (
+    DataSplitter, DataBalancer, DataCutter, SplitterSummary)
+from transmogrifai_tpu.selector.validators import (
+    OpCrossValidation, OpTrainValidationSplit)
+from transmogrifai_tpu.selector.grids import ParamGridBuilder, RandomParamBuilder
+from transmogrifai_tpu.selector.model_selector import (
+    ModelSelector, ModelSelectorSummary,
+    BinaryClassificationModelSelector, MultiClassificationModelSelector,
+    RegressionModelSelector)
+
+__all__ = [
+    "DataSplitter", "DataBalancer", "DataCutter", "SplitterSummary",
+    "OpCrossValidation", "OpTrainValidationSplit",
+    "ParamGridBuilder", "RandomParamBuilder",
+    "ModelSelector", "ModelSelectorSummary",
+    "BinaryClassificationModelSelector", "MultiClassificationModelSelector",
+    "RegressionModelSelector",
+]

@@ -1,0 +1,252 @@
+"""ModelSelector: cross-validated model + hyperparameter selection.
+
+Reference parity: `core/.../selector/ModelSelector.scala:72-211` (prep data
+→ findBestEstimator → refit best on full prepared train → evaluate → wrap
+SelectedModel + ModelSelectorSummary), factories
+`BinaryClassificationModelSelector.scala:49-224`,
+`MultiClassificationModelSelector`, `RegressionModelSelector.scala`,
+defaults `DefaultSelectorParams.scala:35-90`.
+
+The sweep (folds × models × grids) runs through
+`transmogrifai_tpu.parallel.sweep.run_sweep` — vmapped/batched XLA programs
+instead of the reference's Future-per-fit thread pool.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.evaluators import (
+    BinaryClassificationEvaluator, MultiClassificationEvaluator,
+    RegressionEvaluator)
+from transmogrifai_tpu.models import OpLinearRegression, OpLogisticRegression
+from transmogrifai_tpu.parallel.sweep import run_sweep
+from transmogrifai_tpu.selector.splitters import DataBalancer, DataCutter, DataSplitter
+from transmogrifai_tpu.selector.validators import OpCrossValidation
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ValidationResult:
+    model: str
+    grid: Dict[str, Any]
+    fold_metrics: List[float]
+    model_index: int = 0  # index into ModelSelector.models (class names can repeat)
+
+    @property
+    def mean_metric(self) -> float:
+        return float(np.mean(self.fold_metrics)) if self.fold_metrics else float("nan")
+
+    def to_json(self) -> Dict:
+        return {"model": self.model, "model_index": self.model_index,
+                "grid": self.grid, "fold_metrics": self.fold_metrics,
+                "mean": self.mean_metric}
+
+
+@dataclass
+class ModelSelectorSummary:
+    """ModelSelectorSummary.scala analogue, persisted on the fitted model."""
+
+    problem_type: str
+    metric_name: str
+    validation_results: List[ValidationResult] = field(default_factory=list)
+    best_model: str = ""
+    best_grid: Dict[str, Any] = field(default_factory=dict)
+    train_metrics: Dict[str, Any] = field(default_factory=dict)
+    holdout_metrics: Dict[str, Any] = field(default_factory=dict)
+    splitter_summary: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> Dict:
+        return {
+            "problem_type": self.problem_type, "metric": self.metric_name,
+            "validation_results": [r.to_json() for r in self.validation_results],
+            "best_model": self.best_model, "best_grid": self.best_grid,
+            "train_metrics": self.train_metrics,
+            "holdout_metrics": self.holdout_metrics,
+            "splitter": self.splitter_summary,
+        }
+
+    def pretty(self) -> str:
+        lines = [f"Evaluated {len(self.validation_results)} model configs "
+                 f"({self.metric_name}):"]
+        for r in sorted(self.validation_results, key=lambda r: -r.mean_metric):
+            lines.append(f"  {r.model} {r.grid} -> {r.mean_metric:.4f}")
+        lines.append(f"Best: {self.best_model} {self.best_grid}")
+        return "\n".join(lines)
+
+
+class ModelSelector(Estimator):
+    """Estimator2(RealNN, OPVector) → Prediction. Fits the sweep, refits the
+    winner on the full prepared training data, evaluates train + holdout."""
+
+    in_types = (T.RealNN, T.OPVector)
+    out_type = T.Prediction
+
+    def __init__(self, models: Sequence[Tuple[Estimator, List[Dict]]],
+                 validator=None, splitter=None, evaluator=None,
+                 problem_type: str = "binary", uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.models = list(models)
+        self.validator = validator or OpCrossValidation()
+        self.splitter = splitter
+        self.evaluator = evaluator or BinaryClassificationEvaluator()
+        self.problem_type = problem_type
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        label_col, vec_col = cols
+        y_np = np.asarray(label_col.data["value"], dtype=np.float64)
+        X_full = jnp.asarray(vec_col.device_value())
+
+        # -- data preparation (Splitter.split + preValidationPrepare) ---- #
+        split_summary: Dict[str, Any] = {}
+        if self.splitter is not None:
+            train_idx, test_idx, ssum = self.splitter.split(y_np)
+            train_idx, prep_details = self.splitter.prepare(y_np, train_idx)
+            split_summary = ssum.to_json()
+            split_summary["details"].update(prep_details)
+        else:
+            train_idx = np.arange(len(y_np))
+            test_idx = np.array([], dtype=np.int64)
+
+        X = X_full[jnp.asarray(train_idx)]
+        y_train = y_np[train_idx]
+        y_dev = jnp.asarray(y_train.astype(np.float32))
+        folds = self.validator.splits(y_train)
+
+        # -- the sweep --------------------------------------------------- #
+        results: List[ValidationResult] = []
+        failures = 0
+        for mi, (est, grids) in enumerate(self.models):
+            try:
+                grid_fold = run_sweep(est, grids, X, y_dev, folds,
+                                      self.evaluator, ctx)
+                for grid, fm in zip(grids, grid_fold):
+                    results.append(ValidationResult(
+                        model=type(est).__name__, grid=grid,
+                        fold_metrics=[float(m) for m in fm], model_index=mi))
+            except Exception:  # drop a failing family (OpValidator:344-347)
+                failures += 1
+                log.exception("Model family %s failed; dropping from sweep",
+                              type(est).__name__)
+        if not results:
+            raise RuntimeError(
+                f"All {failures} model families failed during validation")
+
+        sign = 1.0 if self.evaluator.is_larger_better else -1.0
+        finite = [r for r in results if np.isfinite(r.mean_metric)]
+        if not finite:
+            raise RuntimeError(
+                "Every validated config produced a non-finite metric")
+        best = max(finite, key=lambda r: sign * r.mean_metric)
+
+        # -- refit winner on full prepared train ------------------------- #
+        best_est_proto = self.models[best.model_index][0]
+        kwargs = {k: v for k, v in best_est_proto.params.items() if k != "uid"}
+        kwargs.update(best.grid)
+        best_est = type(best_est_proto)(**kwargs)
+        model = best_est.fit_arrays(
+            X, y_dev, jnp.ones_like(y_dev), ctx)
+
+        # -- evaluate train + holdout ------------------------------------ #
+        def _eval(idx: np.ndarray) -> Dict[str, Any]:
+            if len(idx) == 0:
+                return {}
+            pred = model.predict_arrays(X_full[jnp.asarray(idx)])
+            pcol = Column(T.Prediction, {k: np.asarray(v) for k, v in pred.items()})
+            lcol = Column(T.RealNN, {
+                "value": y_np[idx], "mask": np.ones(len(idx), dtype=bool)})
+            m = self.evaluator.evaluate(lcol, pcol).to_json()
+            return {k: v for k, v in m.items() if not isinstance(v, list)}
+
+        summary = ModelSelectorSummary(
+            problem_type=self.problem_type,
+            metric_name=self.evaluator.default_metric,
+            validation_results=results, best_model=best.model,
+            best_grid=best.grid, train_metrics=_eval(train_idx),
+            holdout_metrics=_eval(test_idx), splitter_summary=split_summary)
+        model.summary = summary
+        return model
+
+
+# --------------------------------------------------------------------------- #
+# Factories (ModelSelectorFactory + per-problem selectors)                    #
+# --------------------------------------------------------------------------- #
+
+def _default_binary_models() -> List[Tuple[Estimator, List[Dict]]]:
+    """DefaultSelectorParams grids (reg {0.001..0.2}); model families grow
+    as the zoo grows (RF/GBT/XGB land with the tree milestone)."""
+    lr_grid = [{"reg_param": r} for r in (0.001, 0.01, 0.1, 0.2)]
+    return [(OpLogisticRegression(max_iter=50), lr_grid)]
+
+
+def _default_regression_models() -> List[Tuple[Estimator, List[Dict]]]:
+    grid = [{"reg_param": r} for r in (0.0, 0.001, 0.01, 0.1)]
+    return [(OpLinearRegression(), grid)]
+
+
+class BinaryClassificationModelSelector:
+    """`BinaryClassificationModelSelector.with_cross_validation()` factory
+    (BinaryClassificationModelSelector.scala:170)."""
+
+    @staticmethod
+    def with_cross_validation(
+            models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
+            n_folds: int = 3, validation_metric: str = "AuPR",
+            splitter=None, seed: int = 42) -> ModelSelector:
+        return ModelSelector(
+            models=models or _default_binary_models(),
+            validator=OpCrossValidation(n_folds=n_folds, seed=seed),
+            splitter=splitter if splitter is not None else DataBalancer(seed=seed),
+            evaluator=BinaryClassificationEvaluator(metric=validation_metric),
+            problem_type="binary")
+
+    @staticmethod
+    def with_train_validation_split(
+            models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
+            train_ratio: float = 0.75, validation_metric: str = "AuPR",
+            splitter=None, seed: int = 42) -> ModelSelector:
+        from transmogrifai_tpu.selector.validators import OpTrainValidationSplit
+        return ModelSelector(
+            models=models or _default_binary_models(),
+            validator=OpTrainValidationSplit(train_ratio=train_ratio, seed=seed),
+            splitter=splitter if splitter is not None else DataBalancer(seed=seed),
+            evaluator=BinaryClassificationEvaluator(metric=validation_metric),
+            problem_type="binary")
+
+
+class MultiClassificationModelSelector:
+    @staticmethod
+    def with_cross_validation(
+            models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
+            n_folds: int = 3, validation_metric: str = "F1",
+            splitter=None, seed: int = 42) -> ModelSelector:
+        return ModelSelector(
+            models=models or [(OpLogisticRegression(max_iter=50),
+                               [{"reg_param": r} for r in (0.001, 0.01, 0.1, 0.2)])],
+            validator=OpCrossValidation(n_folds=n_folds, seed=seed),
+            splitter=splitter if splitter is not None else DataCutter(seed=seed),
+            evaluator=MultiClassificationEvaluator(metric=validation_metric),
+            problem_type="multiclass")
+
+
+class RegressionModelSelector:
+    @staticmethod
+    def with_cross_validation(
+            models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
+            n_folds: int = 3, validation_metric: str = "RMSE",
+            splitter=None, seed: int = 42) -> ModelSelector:
+        return ModelSelector(
+            models=models or _default_regression_models(),
+            validator=OpCrossValidation(n_folds=n_folds, seed=seed),
+            splitter=splitter if splitter is not None else DataSplitter(seed=seed),
+            evaluator=RegressionEvaluator(metric=validation_metric),
+            problem_type="regression")

@@ -271,8 +271,13 @@ class FeatureGeneratorStage(Stage):
                 f"not in dataset {dataset.names()}")
         values = dataset.column(self.column)
         if self.null_fill is not None:
-            values = np.array(
-                [self.null_fill if v is None else v for v in values], dtype=object)
+            if values.dtype != object:  # typed numeric storage: NaN = missing
+                values = np.where(np.isnan(values.astype(np.float64)),
+                                  float(self.null_fill), values)
+            else:
+                values = np.array(
+                    [self.null_fill if v is None else v for v in values],
+                    dtype=object)
         return Column.from_values(self.ftype, values)
 
     def get_params(self) -> Dict[str, Any]:
