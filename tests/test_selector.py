@@ -106,12 +106,14 @@ def test_binary_selector_end_to_end():
     model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
     fitted = model.fitted[pf.origin_stage.uid]
     s = fitted.summary
-    assert s.best_model == "OpLogisticRegression"
-    assert len(s.validation_results) == 4  # default LR grid
+    # reference-parity default families: LR (4 grids) + RF (6) + XGB (4)
+    assert s.best_model in ("OpLogisticRegression", "OpRandomForestClassifier",
+                            "OpXGBoostClassifier")
+    assert len(s.validation_results) == 14
     assert all(len(r.fold_metrics) == 3 for r in s.validation_results)
     assert s.holdout_metrics["AuPR"] > 0.7
     assert s.train_metrics["AuROC"] > 0.7
-    assert "Evaluated 4 model configs" in s.pretty()
+    assert "Evaluated 14 model configs" in s.pretty()
 
 
 def test_multiclass_selector():

@@ -1,9 +1,31 @@
 from transmogrifai_tpu.models.base import PredictorEstimator, PredictionModel
 from transmogrifai_tpu.models.logistic import OpLogisticRegression, LogisticRegressionModel
 from transmogrifai_tpu.models.linear import OpLinearRegression, LinearRegressionModel
+from transmogrifai_tpu.models.naive_bayes import OpNaiveBayes, NaiveBayesModel
+from transmogrifai_tpu.models.linear_svc import OpLinearSVC, LinearSVCModel
+from transmogrifai_tpu.models.mlp import (
+    OpMultilayerPerceptronClassifier, MLPModel)
+from transmogrifai_tpu.models.glm import (
+    OpGeneralizedLinearRegression, GLMModel)
+from transmogrifai_tpu.models.isotonic import (
+    IsotonicRegressionCalibrator, IsotonicCalibratorModel)
+from transmogrifai_tpu.models.trees import (
+    OpDecisionTreeClassifier, OpDecisionTreeRegressor,
+    OpRandomForestClassifier, OpRandomForestRegressor,
+    OpGBTClassifier, OpGBTRegressor,
+    OpXGBoostClassifier, OpXGBoostRegressor)
 
 __all__ = [
     "PredictorEstimator", "PredictionModel",
     "OpLogisticRegression", "LogisticRegressionModel",
     "OpLinearRegression", "LinearRegressionModel",
+    "OpNaiveBayes", "NaiveBayesModel",
+    "OpLinearSVC", "LinearSVCModel",
+    "OpMultilayerPerceptronClassifier", "MLPModel",
+    "OpGeneralizedLinearRegression", "GLMModel",
+    "IsotonicRegressionCalibrator", "IsotonicCalibratorModel",
+    "OpDecisionTreeClassifier", "OpDecisionTreeRegressor",
+    "OpRandomForestClassifier", "OpRandomForestRegressor",
+    "OpGBTClassifier", "OpGBTRegressor",
+    "OpXGBoostClassifier", "OpXGBoostRegressor",
 ]

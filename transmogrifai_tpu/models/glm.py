@@ -1,0 +1,119 @@
+"""Generalized linear models (gaussian/binomial/poisson/gamma/tweedie).
+
+Reference parity: `core/.../impl/regression/OpGeneralizedLinearRegression.scala`
+(Spark GLR: family+link, IRLS). Here: penalized negative log-likelihood
+minimized with L-BFGS in a fixed-length scan — same optimum, vmappable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from transmogrifai_tpu.models.base import PredictionModel, PredictorEstimator
+from transmogrifai_tpu.stages.base import FitContext
+
+FAMILIES = ("gaussian", "binomial", "poisson", "gamma", "tweedie")
+_EPS = 1e-8
+
+
+def _neg_log_likelihood(family: str, mu, y, var_power: float = 1.5):
+    if family == "gaussian":
+        return 0.5 * (y - mu) ** 2
+    if family == "binomial":
+        mu = jnp.clip(mu, _EPS, 1 - _EPS)
+        return -(y * jnp.log(mu) + (1 - y) * jnp.log(1 - mu))
+    if family == "poisson":
+        mu = jnp.maximum(mu, _EPS)
+        return mu - y * jnp.log(mu)
+    if family == "gamma":
+        mu = jnp.maximum(mu, _EPS)
+        return y / mu + jnp.log(mu)
+    if family == "tweedie":
+        mu = jnp.maximum(mu, _EPS)
+        p = var_power
+        return -(y * mu ** (1 - p) / (1 - p) - mu ** (2 - p) / (2 - p))
+    raise ValueError(f"Unknown family {family!r}")
+
+
+def _inverse_link(family: str, eta):
+    if family == "gaussian":
+        return eta  # identity
+    if family == "binomial":
+        return jax.nn.sigmoid(eta)  # logit link
+    return jnp.exp(eta)  # log link (poisson/gamma/tweedie)
+
+
+@partial(jax.jit, static_argnames=("family", "max_iter"))
+def fit_glm(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, l2,
+            family: str = "gaussian", max_iter: int = 100,
+            var_power: float = 1.5) -> Dict:
+    d = X.shape[1]
+    params = {"beta": jnp.zeros((d,), jnp.float32), "b": jnp.float32(0.0)}
+
+    def loss_fn(p):
+        eta = X @ p["beta"] + p["b"]
+        mu = _inverse_link(family, eta)
+        nll = _neg_log_likelihood(family, mu, y, var_power)
+        return (nll * w).sum() / jnp.maximum(w.sum(), 1.0) \
+            + 0.5 * l2 * (p["beta"] ** 2).sum()
+
+    opt = optax.lbfgs()
+    state = opt.init(params)
+    vg = optax.value_and_grad_from_state(loss_fn)
+
+    def step(carry, _):
+        p, s = carry
+        v, g = vg(p, state=s)
+        updates, s = opt.update(g, s, p, value=v, grad=g, value_fn=loss_fn)
+        return (optax.apply_updates(p, updates), s), v
+
+    (params, _), _ = jax.lax.scan(step, (params, state), None, length=max_iter)
+    return params
+
+
+def predict_glm(params: Dict, X: jnp.ndarray, family: str) -> Dict:
+    eta = X @ params["beta"] + params["b"]
+    mu = _inverse_link(family, eta)
+    return {"prediction": mu, "rawPrediction": eta[:, None],
+            "probability": jnp.zeros((X.shape[0], 0), X.dtype)}
+
+
+class GLMModel(PredictionModel):
+    def __init__(self, beta=None, b: float = 0.0, family: str = "gaussian",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.beta = np.asarray(beta, dtype=np.float32)
+        self.b = float(b)
+        self.family = family
+
+    def predict_arrays(self, X):
+        return predict_glm({"beta": jnp.asarray(self.beta),
+                            "b": jnp.float32(self.b)}, X, self.family)
+
+    def get_params(self):
+        return {"beta": self.beta.tolist(), "b": self.b, "family": self.family}
+
+
+class OpGeneralizedLinearRegression(PredictorEstimator):
+    def __init__(self, family: str = "gaussian", reg_param: float = 0.0,
+                 max_iter: int = 100, var_power: float = 1.5,
+                 uid: Optional[str] = None):
+        if family not in FAMILIES:
+            raise ValueError(f"family must be one of {FAMILIES}")
+        super().__init__(uid=uid, family=family, reg_param=reg_param,
+                         max_iter=max_iter, var_power=var_power)
+        self.family = family
+        self.reg_param = reg_param
+        self.max_iter = max_iter
+        self.var_power = var_power
+
+    def fit_arrays(self, X, y, w, ctx: FitContext) -> GLMModel:
+        p = fit_glm(X, y, w, jnp.float32(self.reg_param), self.family,
+                    self.max_iter, self.var_power)
+        return GLMModel(np.asarray(p["beta"]), float(p["b"]), self.family)

@@ -1,0 +1,87 @@
+"""Isotonic regression calibrator (pool-adjacent-violators).
+
+Reference parity: `core/.../impl/regression/IsotonicRegressionCalibrator.scala`
+(Spark IsotonicRegression). PAV runs on host (inherently sequential);
+the fitted model is a device-side piecewise-linear interpolation
+(`jnp.interp`) that fuses into the scoring program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+
+def pav_fit(x: np.ndarray, y: np.ndarray, w: Optional[np.ndarray] = None,
+            increasing: bool = True):
+    """Pool-adjacent-violators → (boundaries, values) knots."""
+    order = np.argsort(x, kind="mergesort")
+    xs, ys = x[order], y[order].astype(np.float64)
+    ws = (np.ones_like(ys) if w is None else w[order]).astype(np.float64)
+    if not increasing:
+        ys = -ys
+    # blocks as (weighted mean, weight, start_idx)
+    means: List[float] = []
+    weights: List[float] = []
+    starts: List[int] = []
+    for i in range(len(ys)):
+        means.append(ys[i])
+        weights.append(ws[i])
+        starts.append(i)
+        while len(means) > 1 and means[-2] > means[-1]:
+            m2, w2 = means.pop(), weights.pop()
+            starts.pop()
+            means[-1] = (means[-1] * weights[-1] + m2 * w2) / (weights[-1] + w2)
+            weights[-1] += w2
+        # starts[-1] stays at the merged block's first index
+    bounds, values = [], []
+    for bi, s in enumerate(starts):
+        e = starts[bi + 1] - 1 if bi + 1 < len(starts) else len(xs) - 1
+        v = means[bi] if increasing else -means[bi]
+        bounds.extend([xs[s], xs[e]])
+        values.extend([v, v])
+    return np.asarray(bounds, dtype=np.float64), np.asarray(values, dtype=np.float64)
+
+
+class IsotonicCalibratorModel(Transformer):
+    out_type = T.RealNN
+
+    def __init__(self, boundaries: Sequence[float], values: Sequence[float],
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.boundaries = np.asarray(boundaries, dtype=np.float32)
+        self.values = np.asarray(values, dtype=np.float32)
+
+    def device_apply(self, enc, dev):
+        score = dev[-1]["value"]
+        cal = jnp.interp(score, jnp.asarray(self.boundaries),
+                         jnp.asarray(self.values))
+        return {"value": cal, "mask": dev[-1]["mask"]}
+
+    def get_params(self):
+        return {"boundaries": self.boundaries.tolist(),
+                "values": self.values.tolist()}
+
+
+class IsotonicRegressionCalibrator(Estimator):
+    """BinaryEstimator(RealNN label, RealNN score) → calibrated RealNN."""
+
+    in_types = (T.RealNN, T.RealNN)
+    out_type = T.RealNN
+
+    def __init__(self, increasing: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid, increasing=increasing)
+        self.increasing = increasing
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        label, score = cols
+        y = np.asarray(label.data["value"], dtype=np.float64)
+        x = np.asarray(score.data["value"], dtype=np.float64)
+        bounds, values = pav_fit(x, y, increasing=self.increasing)
+        return IsotonicCalibratorModel(bounds, values)

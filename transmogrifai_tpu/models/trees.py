@@ -1,0 +1,435 @@
+"""Decision trees, random forests, and gradient boosting as XLA programs.
+
+Reference parity: `core/.../impl/classification/OpDecisionTreeClassifier.scala`,
+`OpRandomForestClassifier.scala`, `OpGBTClassifier.scala`,
+`OpXGBoostClassifier.scala` and the regression counterparts — all JNI/JVM
+(Spark MLlib trees, libxgboost+Rabit) in the reference (SURVEY.md §2.9).
+
+TPU-first design (SURVEY.md §7 "Trees on TPU"):
+- features are pre-binned to `max_bins` quantile buckets (host quantiles →
+  static shapes); a tree never sees raw floats
+- trees grow LEVEL-WISE with a fixed depth: every level builds
+  (nodes × features × bins × outputs) gradient/weight histograms with one
+  scatter-add over the batch — the data-parallel reduction (`psum` over a
+  sharded batch axis), then picks argmax-gain splits — no data-dependent
+  control flow, so the whole learner jits and vmaps
+- a "tree" is three dense arrays (per-level split feature, split bin,
+  leaf values); prediction is `depth` gathers — fusable into the scoring
+  program
+- RandomForest = vmap over per-tree bootstrap weights + feature masks;
+  GBT/XGBoost = `lax.scan` over boosting rounds carrying the margin, using
+  second-order (grad/hess) gains — the XGBoost formulation, with `psum`
+  replacing Rabit allreduce when the batch axis is sharded
+
+Unified learner: targets G (n, m) and weights H (n,); split gain =
+Σ_m GL²/(HL+λ) + Σ_m GR²/(HR+λ) − Σ_m G²/(H+λ); leaf value = G/(H+λ).
+With one-hot labels as G and counts as H this is exactly gini-style
+variance reduction (RF/DT classification); with gradients/hessians it is
+the XGBoost gain (GBT); with y and counts it is variance reduction (reg).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu.models.base import (
+    PredictionModel, PredictorEstimator, infer_n_classes)
+from transmogrifai_tpu.stages.base import FitContext
+
+DEFAULT_MAX_BINS = 32
+
+
+# --------------------------------------------------------------------------- #
+# binning                                                                     #
+# --------------------------------------------------------------------------- #
+
+def quantile_bin_edges(X: np.ndarray, max_bins: int = DEFAULT_MAX_BINS) -> np.ndarray:
+    """(d, max_bins-1) ascending bin edges per feature (host, fit-time)."""
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    edges = np.quantile(np.asarray(X, dtype=np.float64), qs, axis=0).T
+    return np.ascontiguousarray(edges, dtype=np.float32)
+
+
+def bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """(n, d) int32 bin ids in [0, max_bins)."""
+    def one(col, e):
+        return jnp.searchsorted(e, col, side="right")
+    return jax.vmap(one, in_axes=(1, 0), out_axes=1)(X, edges).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------- #
+# the level-wise learner                                                      #
+# --------------------------------------------------------------------------- #
+
+def _histograms(Xb, node_idx, G, H, n_nodes: int, n_bins: int):
+    """hist_G: (nodes, d, bins, m); hist_H: (nodes, d, bins)."""
+    n, d = Xb.shape
+    m = G.shape[1]
+    hg = jnp.zeros((n_nodes, d, n_bins, m), G.dtype)
+    hh = jnp.zeros((n_nodes, d, n_bins), H.dtype)
+    feat = jnp.arange(d, dtype=jnp.int32)[None, :]
+    node = node_idx[:, None]
+    hg = hg.at[node, feat, Xb].add(G[:, None, :])
+    hh = hh.at[node, feat, Xb].add(H[:, None])
+    return hg, hh
+
+
+def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
+              max_depth: int, n_bins: int, reg_lambda: float = 1.0,
+              min_child_weight: float = 1.0, min_gain: float = 0.0,
+              feature_mask: Optional[jnp.ndarray] = None) -> Dict:
+    """Grow one fixed-depth tree. Returns dense arrays:
+
+    {"feat": (depth, 2^depth) int32, "bin": (depth, 2^depth) int32,
+     "leaf": (2^max_depth, m) float32}
+    (per-level arrays are padded to 2^max_depth node slots)
+    """
+    n, d = Xb.shape
+    m = G.shape[1]
+    max_nodes = 2 ** max_depth
+    node_idx = jnp.zeros(n, dtype=jnp.int32)
+    feats = jnp.zeros((max_depth, max_nodes), jnp.int32)
+    bins = jnp.full((max_depth, max_nodes), n_bins, jnp.int32)  # n_bins = "no split"
+
+    for level in range(max_depth):
+        n_nodes = 2 ** level
+        hg, hh = _histograms(Xb, node_idx, G, H, n_nodes, n_bins)
+        cg = jnp.cumsum(hg, axis=2)           # left sums at split-bin b
+        ch = jnp.cumsum(hh, axis=2)
+        tg = cg[:, :, -1:, :]
+        th = ch[:, :, -1:]
+        score = lambda g, h: (g ** 2).sum(-1) / (h + reg_lambda)  # noqa: E731
+        gain = score(cg, ch) + score(tg - cg, th - ch) - score(tg, th)
+        valid = (ch >= min_child_weight) & ((th - ch) >= min_child_weight)
+        gain = jnp.where(valid, gain, -jnp.inf)
+        if feature_mask is not None:
+            gain = jnp.where(feature_mask[None, :, None], gain, -jnp.inf)
+        flat = gain.reshape(n_nodes, -1)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+        bf = (best // n_bins).astype(jnp.int32)
+        bb = (best % n_bins).astype(jnp.int32)
+        # a node with no usable gain "splits" at bin >= n_bins-1 → all left
+        splits = best_gain > min_gain
+        bb = jnp.where(splits, bb, n_bins)
+        feats = feats.at[level, :n_nodes].set(bf)
+        bins = bins.at[level, :n_nodes].set(bb)
+        sample_feat = bf[node_idx]
+        sample_bin = jnp.take_along_axis(Xb, sample_feat[:, None], 1)[:, 0]
+        go_right = sample_bin > bb[node_idx]
+        node_idx = node_idx * 2 + go_right.astype(jnp.int32)
+
+    leaf_g = jnp.zeros((max_nodes, m), G.dtype).at[node_idx].add(G)
+    leaf_h = jnp.zeros((max_nodes,), H.dtype).at[node_idx].add(H)
+    leaf = leaf_g / (leaf_h + reg_lambda)[:, None]
+    return {"feat": feats, "bin": bins, "leaf": leaf}
+
+
+def predict_tree(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
+    """(n, m) leaf values for binned samples."""
+    n = Xb.shape[0]
+    node = jnp.zeros(n, dtype=jnp.int32)
+    depth = tree["feat"].shape[0]
+    for level in range(depth):
+        f = tree["feat"][level][node]
+        b = tree["bin"][level][node]
+        sample_bin = jnp.take_along_axis(Xb, f[:, None], 1)[:, 0]
+        node = node * 2 + (sample_bin > b).astype(jnp.int32)
+    return tree["leaf"][node]
+
+
+# --------------------------------------------------------------------------- #
+# Random forest / decision tree                                               #
+# --------------------------------------------------------------------------- #
+
+@partial(jax.jit, static_argnames=("n_trees", "max_depth", "n_bins",
+                                   "n_outputs", "subsample_features"))
+def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
+               n_outputs: int, seed, subsample_features: bool = True,
+               min_child_weight: float = 1.0):
+    n, d = Xb.shape
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+    n_sub = max(int(np.sqrt(d)), 1) if subsample_features else d
+
+    def one_tree(key):
+        k1, k2 = jax.random.split(key)
+        boot = jax.random.poisson(k1, 1.0, (n,)).astype(jnp.float32) * w
+        if subsample_features:
+            scores = jax.random.uniform(k2, (d,))
+            thresh = jnp.sort(scores)[n_sub - 1]
+            fmask = scores <= thresh
+        else:
+            fmask = jnp.ones((d,), bool)
+        return grow_tree(Xb, Y * boot[:, None], boot, max_depth, n_bins,
+                         reg_lambda=1e-6, min_child_weight=min_child_weight,
+                         feature_mask=fmask)
+
+    return jax.vmap(one_tree)(keys)
+
+
+@partial(jax.jit, static_argnames=())
+def predict_forest(trees: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
+    preds = jax.vmap(lambda t: predict_tree(t, Xb))(trees)  # (T, n, m)
+    return preds.mean(axis=0)
+
+
+# --------------------------------------------------------------------------- #
+# Gradient boosting (XGBoost-style second order)                              #
+# --------------------------------------------------------------------------- #
+
+@partial(jax.jit, static_argnames=("n_estimators", "max_depth", "n_bins",
+                                   "objective"))
+def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
+            learning_rate, reg_lambda, objective: str = "logistic",
+            min_child_weight: float = 1.0):
+    n = Xb.shape[0]
+
+    def grads(margin):
+        if objective == "logistic":
+            p = jax.nn.sigmoid(margin)
+            return (p - y) * w, jnp.maximum(p * (1 - p), 1e-6) * w
+        return (margin - y) * w, w  # squared error
+
+    def round_(margin, _):
+        g, h = grads(margin)
+        tree = grow_tree(Xb, (-g)[:, None], h, max_depth, n_bins,
+                         reg_lambda=reg_lambda,
+                         min_child_weight=min_child_weight)
+        margin = margin + learning_rate * predict_tree(tree, Xb)[:, 0]
+        return margin, tree
+
+    base = jnp.zeros(n, jnp.float32)
+    _, trees = jax.lax.scan(round_, base, None, length=n_estimators)
+    return trees
+
+
+@partial(jax.jit, static_argnames=())
+def predict_gbt_margin(trees: Dict, Xb: jnp.ndarray, learning_rate) -> jnp.ndarray:
+    preds = jax.vmap(lambda t: predict_tree(t, Xb))(trees)  # (T, n, 1)
+    return learning_rate * preds[:, :, 0].sum(axis=0)
+
+
+# --------------------------------------------------------------------------- #
+# Stage classes                                                               #
+# --------------------------------------------------------------------------- #
+
+class _TreeModelBase(PredictionModel):
+    def __init__(self, edges=None, trees=None, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.edges = np.asarray(edges, dtype=np.float32)
+        self.trees = {k: np.asarray(v) for k, v in trees.items()}
+
+    def get_params(self):
+        return {"edges": self.edges.tolist(),
+                "trees": {k: v.tolist() for k, v in self.trees.items()}}
+
+    def _binned(self, X):
+        return bin_features(jnp.asarray(X), jnp.asarray(self.edges))
+
+    def _tree_pytree(self):
+        return {"feat": jnp.asarray(self.trees["feat"], jnp.int32),
+                "bin": jnp.asarray(self.trees["bin"], jnp.int32),
+                "leaf": jnp.asarray(self.trees["leaf"], jnp.float32)}
+
+
+class ForestClassificationModel(_TreeModelBase):
+    def predict_arrays(self, X):
+        probs = predict_forest(self._tree_pytree(), self._binned(X))
+        probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), 1e-9)
+        return {"prediction": jnp.argmax(probs, -1).astype(jnp.float32),
+                "rawPrediction": probs,
+                "probability": probs}
+
+
+class ForestRegressionModel(_TreeModelBase):
+    def predict_arrays(self, X):
+        pred = predict_forest(self._tree_pytree(), self._binned(X))[:, 0]
+        return {"prediction": pred, "rawPrediction": pred[:, None],
+                "probability": jnp.zeros((X.shape[0], 0), jnp.float32)}
+
+
+class GBTClassificationModel(_TreeModelBase):
+    def __init__(self, edges=None, trees=None, learning_rate: float = 0.1,
+                 uid: Optional[str] = None):
+        super().__init__(edges=edges, trees=trees, uid=uid)
+        self.learning_rate = learning_rate
+
+    def get_params(self):
+        d = super().get_params()
+        d["learning_rate"] = self.learning_rate
+        return d
+
+    def predict_arrays(self, X):
+        margin = predict_gbt_margin(self._tree_pytree(), self._binned(X),
+                                    jnp.float32(self.learning_rate))
+        p1 = jax.nn.sigmoid(margin)
+        prob = jnp.stack([1 - p1, p1], axis=1)
+        return {"prediction": (margin > 0).astype(jnp.float32),
+                "rawPrediction": jnp.stack([-margin, margin], 1),
+                "probability": prob}
+
+
+class GBTRegressionModel(GBTClassificationModel):
+    def predict_arrays(self, X):
+        pred = predict_gbt_margin(self._tree_pytree(), self._binned(X),
+                                  jnp.float32(self.learning_rate))
+        return {"prediction": pred, "rawPrediction": pred[:, None],
+                "probability": jnp.zeros((X.shape[0], 0), jnp.float32)}
+
+
+class _TreeEstimatorBase(PredictorEstimator):
+    # Optional sweep-shared binning cache (max_bins → (edges, Xb)): the sweep
+    # engine attaches one dict per family so 30 grid×fold fits bin the
+    # training matrix once instead of 30 times (binning depends only on X).
+    _bin_cache: Optional[Dict] = None
+
+    def _edges_binned(self, X, ctx):
+        cache = self._bin_cache
+        if cache is not None and self.max_bins in cache:
+            return cache[self.max_bins]
+        edges = quantile_bin_edges(np.asarray(X), self.max_bins)
+        Xb = bin_features(jnp.asarray(X), jnp.asarray(edges))
+        if cache is not None:
+            cache[self.max_bins] = (edges, Xb)
+        return edges, Xb
+
+
+class OpRandomForestClassifier(_TreeEstimatorBase):
+    def __init__(self, n_trees: int = 20, max_depth: int = 5,
+                 max_bins: int = DEFAULT_MAX_BINS, min_child_weight: float = 1.0,
+                 subsample_features: bool = True,
+                 n_classes: Optional[int] = None, uid: Optional[str] = None):
+        super().__init__(uid=uid, n_trees=n_trees, max_depth=max_depth,
+                         max_bins=max_bins, min_child_weight=min_child_weight,
+                         subsample_features=subsample_features, n_classes=n_classes)
+        self.n_trees = n_trees
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.min_child_weight = min_child_weight
+        self.subsample_features = subsample_features
+        self.n_classes = n_classes
+
+    def fit_arrays(self, X, y, w, ctx: FitContext):
+        k = self.n_classes or infer_n_classes(np.asarray(y))
+        edges, Xb = self._edges_binned(X, ctx)
+        Y = jax.nn.one_hot(y.astype(jnp.int32), k)
+        trees = fit_forest(Xb, Y, w, self.n_trees, self.max_depth,
+                           self.max_bins, k, ctx.seed,
+                           self.subsample_features, self.min_child_weight)
+        return ForestClassificationModel(edges, {k2: np.asarray(v)
+                                                 for k2, v in trees.items()})
+
+
+class OpRandomForestRegressor(OpRandomForestClassifier):
+    def fit_arrays(self, X, y, w, ctx: FitContext):
+        edges, Xb = self._edges_binned(X, ctx)
+        trees = fit_forest(Xb, y[:, None], w, self.n_trees, self.max_depth,
+                           self.max_bins, 1, ctx.seed,
+                           self.subsample_features, self.min_child_weight)
+        return ForestRegressionModel(edges, {k: np.asarray(v)
+                                             for k, v in trees.items()})
+
+
+class OpDecisionTreeClassifier(OpRandomForestClassifier):
+    """Single deterministic tree (no bootstrap, all features)."""
+
+    def __init__(self, max_depth: int = 5, max_bins: int = DEFAULT_MAX_BINS,
+                 min_child_weight: float = 1.0, n_classes: Optional[int] = None,
+                 uid: Optional[str] = None):
+        super().__init__(n_trees=1, max_depth=max_depth, max_bins=max_bins,
+                         min_child_weight=min_child_weight,
+                         subsample_features=False, n_classes=n_classes, uid=uid)
+        self.params = {"max_depth": max_depth, "max_bins": max_bins,
+                       "min_child_weight": min_child_weight, "n_classes": n_classes}
+
+    def fit_arrays(self, X, y, w, ctx: FitContext):
+        k = self.n_classes or infer_n_classes(np.asarray(y))
+        edges, Xb = self._edges_binned(X, ctx)
+        Y = jax.nn.one_hot(y.astype(jnp.int32), k)
+        tree = grow_tree(Xb, Y * w[:, None], w, self.max_depth, self.max_bins,
+                         reg_lambda=1e-6,
+                         min_child_weight=self.min_child_weight)
+        trees = jax.tree.map(lambda a: a[None], tree)  # (1, ...) forest shape
+        return ForestClassificationModel(edges, {k2: np.asarray(v)
+                                                 for k2, v in trees.items()})
+
+
+class OpDecisionTreeRegressor(OpRandomForestRegressor):
+    def __init__(self, max_depth: int = 5, max_bins: int = DEFAULT_MAX_BINS,
+                 min_child_weight: float = 1.0, uid: Optional[str] = None):
+        super().__init__(n_trees=1, max_depth=max_depth, max_bins=max_bins,
+                         min_child_weight=min_child_weight,
+                         subsample_features=False, uid=uid)
+        self.params = {"max_depth": max_depth, "max_bins": max_bins,
+                       "min_child_weight": min_child_weight}
+
+    def fit_arrays(self, X, y, w, ctx: FitContext):
+        edges, Xb = self._edges_binned(X, ctx)
+        tree = grow_tree(Xb, (y * w)[:, None], w, self.max_depth, self.max_bins,
+                         reg_lambda=1e-6,
+                         min_child_weight=self.min_child_weight)
+        trees = jax.tree.map(lambda a: a[None], tree)
+        return ForestRegressionModel(edges, {k: np.asarray(v)
+                                             for k, v in trees.items()})
+
+
+class OpGBTClassifier(_TreeEstimatorBase):
+    """Binary-only (Spark GBTClassifier parity); XGBoost-style 2nd order."""
+
+    def __init__(self, n_estimators: int = 20, max_depth: int = 3,
+                 learning_rate: float = 0.1, reg_lambda: float = 1.0,
+                 max_bins: int = DEFAULT_MAX_BINS, min_child_weight: float = 1.0,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, n_estimators=n_estimators, max_depth=max_depth,
+                         learning_rate=learning_rate, reg_lambda=reg_lambda,
+                         max_bins=max_bins, min_child_weight=min_child_weight)
+        self.n_estimators = n_estimators
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.reg_lambda = reg_lambda
+        self.max_bins = max_bins
+        self.min_child_weight = min_child_weight
+
+    _objective = "logistic"
+    _model_cls = GBTClassificationModel
+
+    def fit_arrays(self, X, y, w, ctx: FitContext):
+        edges, Xb = self._edges_binned(X, ctx)
+        trees = fit_gbt(Xb, y, w, self.n_estimators, self.max_depth,
+                        self.max_bins, jnp.float32(self.learning_rate),
+                        jnp.float32(self.reg_lambda), self._objective,
+                        self.min_child_weight)
+        return self._model_cls(edges, {k: np.asarray(v) for k, v in trees.items()},
+                               self.learning_rate)
+
+
+class OpGBTRegressor(OpGBTClassifier):
+    _objective = "squared"
+    _model_cls = GBTRegressionModel
+
+
+class OpXGBoostClassifier(OpGBTClassifier):
+    """XGBoost-parameter facade (OpXGBoostClassifier.scala): the in-tree GBT
+    already implements the XGBoost histogram + second-order algorithm; Rabit
+    allreduce becomes a psum over the sharded batch axis."""
+
+    def __init__(self, n_estimators: int = 50, max_depth: int = 6,
+                 eta: float = 0.3, reg_lambda: float = 1.0,
+                 max_bins: int = DEFAULT_MAX_BINS,
+                 min_child_weight: float = 1.0, uid: Optional[str] = None):
+        super().__init__(n_estimators=n_estimators, max_depth=max_depth,
+                         learning_rate=eta, reg_lambda=reg_lambda,
+                         max_bins=max_bins, min_child_weight=min_child_weight,
+                         uid=uid)
+        self.params["eta"] = eta
+        self.params.pop("learning_rate", None)
+
+
+class OpXGBoostRegressor(OpXGBoostClassifier):
+    _objective = "squared"
+    _model_cls = GBTRegressionModel

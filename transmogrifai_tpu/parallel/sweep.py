@@ -115,12 +115,16 @@ def _sweep_linear(est: OpLinearRegression, grids: List[Dict], X, y,
 
 def _sweep_generic(est, grids: List[Dict], X, y, folds, evaluator,
                    ctx) -> List[List[float]]:
-    """Fallback: python loop over grids × folds (future tree models etc.)."""
+    """Fallback: python loop over grids × folds (tree models etc.)."""
+    from transmogrifai_tpu.models.trees import _TreeEstimatorBase
     out = []
     y_np = np.asarray(y)
+    bin_cache: Dict = {}  # shared across the family: bin X once per max_bins
     for grid in grids:
         clone = type(est)(**{**{k: v for k, v in est.params.items()
                                 if k != "uid"}, **grid})
+        if isinstance(clone, _TreeEstimatorBase):
+            clone._bin_cache = bin_cache
         row = []
         for tr, va in folds:
             model = clone.fit_arrays(X, y, jnp.asarray(tr), ctx)

@@ -64,6 +64,7 @@ class ModelSelectorSummary:
     train_metrics: Dict[str, Any] = field(default_factory=dict)
     holdout_metrics: Dict[str, Any] = field(default_factory=dict)
     splitter_summary: Dict[str, Any] = field(default_factory=dict)
+    larger_is_better: bool = True
 
     def to_json(self) -> Dict:
         return {
@@ -76,9 +77,10 @@ class ModelSelectorSummary:
         }
 
     def pretty(self) -> str:
+        sign = -1.0 if self.larger_is_better else 1.0  # best first
         lines = [f"Evaluated {len(self.validation_results)} model configs "
                  f"({self.metric_name}):"]
-        for r in sorted(self.validation_results, key=lambda r: -r.mean_metric):
+        for r in sorted(self.validation_results, key=lambda r: sign * r.mean_metric):
             lines.append(f"  {r.model} {r.grid} -> {r.mean_metric:.4f}")
         lines.append(f"Best: {self.best_model} {self.best_grid}")
         return "\n".join(lines)
@@ -123,12 +125,16 @@ class ModelSelector(Estimator):
         folds = self.validator.splits(y_train)
 
         # -- the sweep --------------------------------------------------- #
+        sharding = None
+        if ctx.mesh is not None:  # spread the grid axis across the mesh
+            from transmogrifai_tpu.parallel.mesh import sweep_sharding
+            sharding = sweep_sharding(ctx.mesh)
         results: List[ValidationResult] = []
         failures = 0
         for mi, (est, grids) in enumerate(self.models):
             try:
                 grid_fold = run_sweep(est, grids, X, y_dev, folds,
-                                      self.evaluator, ctx)
+                                      self.evaluator, ctx, sharding=sharding)
                 for grid, fm in zip(grids, grid_fold):
                     results.append(ValidationResult(
                         model=type(est).__name__, grid=grid,
@@ -172,7 +178,8 @@ class ModelSelector(Estimator):
             metric_name=self.evaluator.default_metric,
             validation_results=results, best_model=best.model,
             best_grid=best.grid, train_metrics=_eval(train_idx),
-            holdout_metrics=_eval(test_idx), splitter_summary=split_summary)
+            holdout_metrics=_eval(test_idx), splitter_summary=split_summary,
+            larger_is_better=self.evaluator.is_larger_better)
         model.summary = summary
         return model
 
@@ -182,15 +189,38 @@ class ModelSelector(Estimator):
 # --------------------------------------------------------------------------- #
 
 def _default_binary_models() -> List[Tuple[Estimator, List[Dict]]]:
-    """DefaultSelectorParams grids (reg {0.001..0.2}); model families grow
-    as the zoo grows (RF/GBT/XGB land with the tree milestone)."""
+    """Reference defaults: LR + RF + XGB
+    (BinaryClassificationModelSelector.scala:62-64), grids from
+    DefaultSelectorParams (maxDepth {3,6,12}, reg {0.001..0.2})."""
+    from transmogrifai_tpu.models import (
+        OpRandomForestClassifier, OpXGBoostClassifier)
     lr_grid = [{"reg_param": r} for r in (0.001, 0.01, 0.1, 0.2)]
-    return [(OpLogisticRegression(max_iter=50), lr_grid)]
+    rf_grid = [{"max_depth": d, "min_child_weight": m}
+               for d in (3, 6, 12) for m in (1.0, 10.0)]
+    xgb_grid = [{"eta": e, "max_depth": d}
+                for e in (0.1, 0.3) for d in (3, 6)]
+    return [(OpLogisticRegression(max_iter=50), lr_grid),
+            (OpRandomForestClassifier(n_trees=20), rf_grid),
+            (OpXGBoostClassifier(n_estimators=50), xgb_grid)]
+
+
+def _default_multiclass_models() -> List[Tuple[Estimator, List[Dict]]]:
+    from transmogrifai_tpu.models import OpRandomForestClassifier
+    lr_grid = [{"reg_param": r} for r in (0.001, 0.01, 0.1, 0.2)]
+    rf_grid = [{"max_depth": d} for d in (3, 6, 12)]
+    return [(OpLogisticRegression(max_iter=50), lr_grid),
+            (OpRandomForestClassifier(n_trees=20), rf_grid)]
 
 
 def _default_regression_models() -> List[Tuple[Estimator, List[Dict]]]:
-    grid = [{"reg_param": r} for r in (0.0, 0.001, 0.01, 0.1)]
-    return [(OpLinearRegression(), grid)]
+    from transmogrifai_tpu.models import (
+        OpGBTRegressor, OpRandomForestRegressor)
+    lin_grid = [{"reg_param": r} for r in (0.0, 0.001, 0.01, 0.1)]
+    rf_grid = [{"max_depth": d} for d in (3, 6, 12)]
+    gbt_grid = [{"max_depth": d} for d in (3, 6)]
+    return [(OpLinearRegression(), lin_grid),
+            (OpRandomForestRegressor(n_trees=20), rf_grid),
+            (OpGBTRegressor(n_estimators=50), gbt_grid)]
 
 
 class BinaryClassificationModelSelector:
@@ -230,8 +260,7 @@ class MultiClassificationModelSelector:
             n_folds: int = 3, validation_metric: str = "F1",
             splitter=None, seed: int = 42) -> ModelSelector:
         return ModelSelector(
-            models=models or [(OpLogisticRegression(max_iter=50),
-                               [{"reg_param": r} for r in (0.001, 0.01, 0.1, 0.2)])],
+            models=models or _default_multiclass_models(),
             validator=OpCrossValidation(n_folds=n_folds, seed=seed),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             evaluator=MultiClassificationEvaluator(metric=validation_metric),

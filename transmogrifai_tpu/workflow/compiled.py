@@ -62,8 +62,10 @@ class CompiledScorer:
             for f in self.model.result_features if f.uid in vals
         }
 
-    def __call__(self, dataset: Dataset) -> Dict[str, Any]:
-        # -- host phase ------------------------------------------------- #
+    def host_phase(self, dataset: Dataset):
+        """Per-batch host work: materialize raw columns, run host stages,
+        call each device stage's host_prepare. Returns (encs, raw_dev,
+        columns) — the jitted device program's inputs."""
         columns: Dict[str, Column] = {}
         for gen in self.generators:
             columns[gen.get_output().uid] = gen.materialize(
@@ -92,7 +94,10 @@ class CompiledScorer:
             c = columns[f.uid]
             if c.kind not in _HOST_KINDS:
                 raw_dev[f.uid] = c.device_value()
+        return encs, raw_dev, columns
 
+    def __call__(self, dataset: Dataset) -> Dict[str, Any]:
+        encs, raw_dev, columns = self.host_phase(dataset)
         # -- device phase (one XLA program) ----------------------------- #
         out = self._jitted(encs, raw_dev)
 

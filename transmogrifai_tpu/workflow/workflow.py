@@ -63,10 +63,13 @@ class Workflow:
                 "a dataset to train()/score()")
         return ds
 
-    def train(self, dataset: Optional[Dataset] = None,
-              seed: int = 42) -> "WorkflowModel":
+    def train(self, dataset: Optional[Dataset] = None, seed: int = 42,
+              mesh=None) -> "WorkflowModel":
         """Materialize raw features, then fit the DAG layer by layer
-        (OpWorkflow.train → fitStages → fitAndTransformLayer)."""
+        (OpWorkflow.train → fitStages → fitAndTransformLayer).
+
+        `mesh`: optional jax.sharding.Mesh — estimator fits that support it
+        (the ModelSelector sweep) shard their work across it."""
         ds = self._resolve_dataset(dataset)
         if not self.result_features:
             raise RuntimeError("set_result_features before train()")
@@ -74,7 +77,7 @@ class Workflow:
         # user's graph or previously returned models (see dag.clone_graph)
         result_features = clone_graph(self.result_features)
         layers = topological_layers(result_features)
-        ctx = FitContext(n_rows=len(ds), seed=seed)
+        ctx = FitContext(n_rows=len(ds), seed=seed, mesh=mesh)
         columns: Dict[str, Column] = {}
         fitted: Dict[str, Transformer] = {}
 
