@@ -1,0 +1,106 @@
+"""Tests for threshold curves, bin-score calibration, forecast metrics, and
+the Evaluators factories.
+
+Reference: core/src/test/.../evaluators/OpBinaryClassificationEvaluatorTest,
+OpBinScoreEvaluatorTest, OpForecastEvaluatorTest,
+OpMultiClassificationEvaluatorTest (threshold metrics sections).
+"""
+
+import numpy as np
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Column
+from transmogrifai_tpu.evaluators import (
+    BinScoreEvaluator, Evaluators, ForecastEvaluator,
+    bin_score_metrics, binary_threshold_metrics, forecast_metrics,
+    misclassifications_per_category, multiclass_threshold_metrics)
+
+
+def _pred_col(scores):
+    s = np.asarray(scores, dtype=np.float32)
+    prob = np.stack([1 - s, s], axis=1)
+    return Column(t.Prediction, {
+        "prediction": (s >= 0.5).astype(np.float32),
+        "probability": prob, "rawPrediction": prob})
+
+
+def _label_col(y):
+    y = np.asarray(y, dtype=np.float64)
+    return Column(t.RealNN, {"value": y, "mask": np.ones(len(y), bool)})
+
+
+def test_binary_threshold_metrics_monotone_recall():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 500).astype(float)
+    s = np.clip(y * 0.3 + rng.uniform(0, 0.7, 500), 0, 1)
+    m = binary_threshold_metrics(y, s, num_bins=50)
+    rec = m.recall_by_threshold
+    assert all(rec[i] <= rec[i + 1] + 1e-12 for i in range(len(rec) - 1))
+    assert len(m.thresholds) <= 50
+    # thresholds descend
+    assert all(m.thresholds[i] >= m.thresholds[i + 1]
+               for i in range(len(m.thresholds) - 1))
+
+
+def test_bin_score_calibrated_scores():
+    rng = np.random.default_rng(1)
+    s = rng.uniform(0, 1, 20_000)
+    y = (rng.uniform(0, 1, 20_000) < s).astype(float)  # perfectly calibrated
+    m = bin_score_metrics(y, s, num_bins=10)
+    avg_s = np.array(m.average_score)
+    avg_c = np.array(m.average_conversion_rate)
+    np.testing.assert_allclose(avg_s, avg_c, atol=0.05)
+    assert 0.1 < m.brier_score < 0.25  # ~ E[s(1-s)] = 1/6
+    assert sum(m.number_of_data_points) == 20_000
+
+
+def test_bin_score_evaluator_api():
+    ev = BinScoreEvaluator()
+    y = [0, 0, 1, 1]
+    m = ev.evaluate(_label_col(y), _pred_col([0.1, 0.2, 0.8, 0.9]))
+    assert m.brier_score < 0.05
+    assert not ev.is_larger_better
+
+
+def test_forecast_metrics():
+    y = np.array([10.0, 12, 11, 13, 12, 14])
+    m = forecast_metrics(y, y)  # perfect forecast
+    assert m.smape == 0.0 and m.mase == 0.0
+    m2 = forecast_metrics(y, y * 1.5)
+    assert m2.smape > 0
+    ev = ForecastEvaluator()
+    pred = Column(t.Prediction, {
+        "prediction": y * 1.1,
+        "probability": np.zeros((6, 1)), "rawPrediction": np.zeros((6, 1))})
+    assert ev.metric_value(_label_col(y), pred) > 0
+
+
+def test_multiclass_threshold_and_misclassification():
+    rng = np.random.default_rng(2)
+    n, k = 300, 4
+    y = rng.integers(0, k, n)
+    logits = rng.normal(size=(n, k))
+    logits[np.arange(n), y] += 2.0
+    p = np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+    m = multiclass_threshold_metrics(y, p, top_ns=(1, 3))
+    # top3 correct ≥ top1 correct at every threshold
+    assert all(c3 >= c1 for c1, c3 in
+               zip(m.correct_counts[1], m.correct_counts[3]))
+    # counts partition n
+    for i in range(len(m.thresholds)):
+        assert (m.correct_counts[1][i] + m.incorrect_counts[1][i]
+                + m.no_prediction_counts[1][i]) == n
+    pred = p.argmax(axis=1)
+    mis = misclassifications_per_category(y, pred, min_support=10)
+    assert len(mis) == k
+    assert all(0 <= d["error"] <= 1 for d in mis)
+
+
+def test_evaluator_factories():
+    assert Evaluators.BinaryClassification.au_pr().default_metric == "AuPR"
+    assert Evaluators.Regression.r2().is_larger_better
+    assert not Evaluators.Regression.rmse().is_larger_better
+    custom = Evaluators.BinaryClassification.custom(
+        "always1", lambda l, p: 1.0)
+    y = _label_col([0, 1])
+    assert custom.metric_value(y, _pred_col([0.2, 0.8])) == 1.0

@@ -1,0 +1,346 @@
+"""Tests for the extended op library: math, scalers, bucketizers, indexers,
+row ops, time periods, and the feature DSL.
+
+Reference test analogues: core/src/test/.../feature/MathTransformersTest,
+OpScalarStandardScalerTest, NumericBucketizerTest,
+DecisionTreeNumericBucketizerTest, OpStringIndexerTest, AliasTransformerTest,
+TextLenTransformerTest, JaccardSimilarityTest, TimePeriodTransformerTest.
+"""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Column
+from transmogrifai_tpu.ops import (
+    AliasTransformer, BinaryMathTransformer, DateListVectorizer,
+    DecisionTreeNumericBucketizer, DescalerTransformer, ExistsTransformer,
+    FillMissingWithMean, JaccardSimilarity, NGramSimilarity,
+    NumericBucketizer, OpIndexToString, OpScalarStandardScaler,
+    OpStringIndexer, OpStringIndexerNoFilter, PercentileCalibrator,
+    ScalarMathTransformer, ScalerTransformer, SubstringTransformer,
+    TextLenTransformer, TimePeriodTransformer, ToOccurTransformer,
+    UnaryMathTransformer)
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage, FitContext
+
+
+def _raw(name, ftype):
+    return FeatureGeneratorStage(name=name, ftype=ftype).get_output()
+
+
+def _scalar(col):
+    v = np.asarray(col.data["value"], dtype=np.float64)
+    m = np.asarray(col.data["mask"]).astype(bool)
+    return [float(v[i]) if m[i] else None for i in range(len(v))]
+
+
+def _ctx(cols):
+    return FitContext(n_rows=len(cols[0]))
+
+
+# ----------------------------------------------------------------- #
+# math                                                              #
+# ----------------------------------------------------------------- #
+
+def test_plus_one_sided_missing():
+    a = Column.from_values(t.Real, [1.0, None, 2.0, None])
+    b = Column.from_values(t.Real, [10.0, 5.0, None, None])
+    st = BinaryMathTransformer("plus").set_input(_raw("a", t.Real), _raw("b", t.Real))
+    out = _scalar(st.transform([a, b]))
+    assert out == [11.0, 5.0, 2.0, None]
+
+
+def test_minus_negates_one_sided():
+    a = Column.from_values(t.Real, [None])
+    b = Column.from_values(t.Real, [4.0])
+    st = BinaryMathTransformer("minus").set_input(_raw("a", t.Real), _raw("b", t.Real))
+    assert _scalar(st.transform([a, b])) == [-4.0]
+
+
+def test_multiply_requires_both_divide_by_zero_missing():
+    a = Column.from_values(t.Real, [3.0, 3.0, 6.0])
+    b = Column.from_values(t.Real, [None, 2.0, 0.0])
+    mul = BinaryMathTransformer("multiply").set_input(_raw("a", t.Real), _raw("b", t.Real))
+    assert _scalar(mul.transform([a, b])) == [None, 6.0, 0.0]
+    div = BinaryMathTransformer("divide").set_input(_raw("a", t.Real), _raw("b", t.Real))
+    assert _scalar(div.transform([a, b])) == [None, 1.5, None]
+
+
+def test_scalar_and_unary_math():
+    a = Column.from_values(t.Real, [4.0, -9.0, None])
+    add2 = ScalarMathTransformer("plus", 2.0).set_input(_raw("a", t.Real))
+    assert _scalar(add2.transform([a])) == [6.0, -7.0, None]
+    sq = UnaryMathTransformer("sqrt").set_input(_raw("a", t.Real))
+    assert _scalar(sq.transform([a])) == [2.0, None, None]  # sqrt(-9) dropped
+    lg = UnaryMathTransformer("log", 10.0).set_input(_raw("a", t.Real))
+    out = _scalar(lg.transform([a]))
+    assert out[1] is None and abs(out[0] - np.log10(4.0)) < 1e-6
+
+
+# ----------------------------------------------------------------- #
+# scalers                                                           #
+# ----------------------------------------------------------------- #
+
+def test_standard_scaler_znorm():
+    f = _raw("x", t.Real)
+    col = Column.from_values(t.Real, [1.0, 2.0, 3.0, None])
+    est = OpScalarStandardScaler().set_input(f)
+    model = est.fit([col], _ctx([col]))
+    out = _scalar(model.transform([col]))
+    vals = np.array(out[:3])
+    np.testing.assert_allclose(vals.mean(), 0.0, atol=1e-6)
+    assert out[3] == 0.0  # missing → mean → 0 after centering
+
+
+def test_fill_missing_with_mean():
+    f = _raw("x", t.Real)
+    col = Column.from_values(t.Real, [2.0, None, 4.0])
+    model = FillMissingWithMean().set_input(f).fit([col], _ctx([col]))
+    assert _scalar(model.transform([col])) == [2.0, 3.0, 4.0]
+
+
+def test_scaler_descaler_roundtrip():
+    f = _raw("x", t.Real)
+    col = Column.from_values(t.Real, [1.0, 10.0, 100.0])
+    scaled_f = f.scale(scaling_type="log")
+    scaler = scaled_f.origin_stage
+    scaled = scaler.transform([col])
+    desc = DescalerTransformer().set_input(scaled_f, scaled_f)
+    out = _scalar(desc.transform([scaled, scaled]))
+    np.testing.assert_allclose(out, [1.0, 10.0, 100.0], rtol=1e-5)
+
+
+def test_percentile_calibrator():
+    f = _raw("x", t.RealNN)
+    col = Column.from_values(t.RealNN, list(np.linspace(0, 1, 101)))
+    model = PercentileCalibrator(buckets=100).set_input(f).fit([col], _ctx([col]))
+    out = _scalar(model.transform([col]))
+    assert out[0] == 0.0 and out[-1] == 99.0
+    assert all(out[i] <= out[i + 1] for i in range(100))
+
+
+# ----------------------------------------------------------------- #
+# bucketizers                                                       #
+# ----------------------------------------------------------------- #
+
+def test_numeric_bucketizer_onehot_and_meta():
+    f = _raw("x", t.Real)
+    st = NumericBucketizer([0.0, 1.0, 2.0], track_nulls=True,
+                           track_invalid=True).set_input(f)
+    col = Column.from_values(t.Real, [0.5, 1.5, -1.0, None])
+    out = st.transform([col])
+    arr = np.asarray(out.data)
+    np.testing.assert_allclose(arr, [
+        [1, 0, 0, 0], [0, 1, 0, 0], [0, 0, 1, 0], [0, 0, 0, 1]])
+    assert out.meta.columns[-1].is_null_indicator
+
+
+def test_decision_tree_bucketizer_finds_signal_split():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1, 1, size=400)
+    y = (x > 0.25).astype(float)
+    label = Column.from_values(t.RealNN, list(y))
+    num = Column.from_values(t.Real, list(x))
+    est = DecisionTreeNumericBucketizer(max_depth=1).set_input(
+        _raw("y", t.RealNN), _raw("x", t.Real))
+    model = est.fit([label, num], _ctx([label]))
+    assert model.did_split
+    assert abs(model.thresholds[0] - 0.25) < 0.05
+    out = np.asarray(model.transform([label, num]).data)
+    # bucket membership must follow the threshold
+    np.testing.assert_allclose(out[:, 1], (x >= model.thresholds[0]).astype(float))
+
+
+def test_decision_tree_bucketizer_no_signal_no_split():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-1, 1, size=300)
+    y = rng.integers(0, 2, size=300).astype(float)
+    est = DecisionTreeNumericBucketizer(max_depth=2, min_info_gain=0.01).set_input(
+        _raw("y", t.RealNN), _raw("x", t.Real))
+    model = est.fit([Column.from_values(t.RealNN, list(y)),
+                     Column.from_values(t.Real, list(x))],
+                    FitContext(n_rows=300))
+    assert not model.did_split
+    out = np.asarray(model.transform(
+        [Column.from_values(t.RealNN, list(y)),
+         Column.from_values(t.Real, list(x))]).data)
+    assert out.shape[1] == 1  # only the null indicator column
+
+
+# ----------------------------------------------------------------- #
+# indexers                                                          #
+# ----------------------------------------------------------------- #
+
+def test_string_indexer_roundtrip():
+    f = _raw("s", t.Text)
+    col = Column.from_values(t.Text, ["b", "a", "b", None, "c", "b", "a"])
+    model = OpStringIndexer(handle_invalid="keep").set_input(f).fit(
+        [col], _ctx([col]))
+    assert model.labels == ["b", "a", "c"]  # desc frequency
+    idx = model.transform([col])
+    assert _scalar(idx)[:3] == [0.0, 1.0, 0.0]
+    back = OpIndexToString(labels=model.labels).set_input(model.get_output())
+    vals = list(back.transform([idx]).data)
+    assert vals == ["b", "a", "b", None, "c", "b", "a"]
+
+
+def test_string_indexer_unseen_keep_and_error():
+    f = _raw("s", t.Text)
+    col = Column.from_values(t.Text, ["a", "a", "b"])
+    model = OpStringIndexerNoFilter().set_input(f).fit([col], _ctx([col]))
+    test = Column.from_values(t.Text, ["zzz"])
+    assert _scalar(model.transform([test])) == [2.0]  # unseen → len(labels)
+    strict = OpStringIndexer().set_input(f).fit([col], _ctx([col]))
+    with pytest.raises(ValueError):
+        strict.transform([test])
+
+
+# ----------------------------------------------------------------- #
+# row ops                                                           #
+# ----------------------------------------------------------------- #
+
+def test_alias_occurs_exists_textlen():
+    f = _raw("s", t.Text)
+    col = Column.from_values(t.Text, ["hi", None, "world"])
+    al = AliasTransformer("renamed").set_input(f)
+    assert al.get_output().name == "renamed"
+    assert list(al.transform([col]).data) == ["hi", None, "world"]
+    occ = ToOccurTransformer().set_input(f)
+    assert _scalar(occ.transform([col])) == [1.0, 0.0, 1.0]
+    ex = ExistsTransformer(lambda s: len(s) > 3).set_input(f)
+    assert _scalar(ex.transform([col])) == [0.0, 0.0, 1.0]
+    tl = TextLenTransformer().set_input(f)
+    assert _scalar(tl.transform([col])) == [2.0, 0.0, 5.0]
+
+
+def test_similarity_ops():
+    a = Column.from_values(t.MultiPickList, [{"x", "y"}, set()])
+    b = Column.from_values(t.MultiPickList, [{"y", "z"}, set()])
+    jc = JaccardSimilarity().set_input(
+        _raw("a", t.MultiPickList), _raw("b", t.MultiPickList))
+    out = _scalar(jc.transform([a, b]))
+    assert abs(out[0] - 1 / 3) < 1e-9 and out[1] == 1.0
+    ta = Column.from_values(t.Text, ["hello", None])
+    tb = Column.from_values(t.Text, ["hello", "x"])
+    ng = NGramSimilarity(n=3).set_input(_raw("ta", t.Text), _raw("tb", t.Text))
+    out = _scalar(ng.transform([ta, tb]))
+    assert out[0] == 1.0 and out[1] == 0.0
+    sub = SubstringTransformer().set_input(_raw("ta", t.Text), _raw("tb", t.Text))
+    assert _scalar(sub.transform([ta, tb])) == [1.0, None]
+
+
+# ----------------------------------------------------------------- #
+# time periods                                                      #
+# ----------------------------------------------------------------- #
+
+def test_time_period_transformer():
+    # 2020-06-15 12:00 UTC was a Monday
+    ms = 1592222400000
+    f = _raw("d", t.Date)
+    col = Column.from_values(t.Date, [ms, None])
+    for period, expect in [("DayOfWeek", 1), ("HourOfDay", 12),
+                           ("DayOfMonth", 15), ("MonthOfYear", 6)]:
+        st = TimePeriodTransformer(period).set_input(f)
+        out = _scalar(st.transform([col]))
+        assert out[0] == expect, period
+        assert out[1] is None
+
+
+def test_date_list_vectorizer_since_last():
+    day = 86_400_000
+    f = _raw("dl", t.DateList)
+    col = Column.from_values(t.DateList, [[0, 5 * day], [], [3 * day]])
+    st = DateListVectorizer(pivot="SinceLast", reference_ms=10 * day).set_input(f)
+    arr = np.asarray(st.transform([col]).data)
+    np.testing.assert_allclose(arr[:, 0], [5.0, 0.0, 7.0])
+    np.testing.assert_allclose(arr[:, 1], [0.0, 1.0, 0.0])  # null indicator
+
+
+def test_date_list_vectorizer_mode_day():
+    day = 86_400_000
+    f = _raw("dl", t.DateList)
+    # 1970-01-01 = Thursday(4); two Thursdays + one Friday → mode Thursday
+    col = Column.from_values(t.DateList, [[0, 7 * day, day]])
+    st = DateListVectorizer(pivot="ModeDay").set_input(f)
+    arr = np.asarray(st.transform([col]).data)
+    assert arr[0, 3] == 1.0  # Thursday one-hot slot (Mon=0)
+    assert arr[0].sum() == 1.0
+
+
+# ----------------------------------------------------------------- #
+# DSL                                                               #
+# ----------------------------------------------------------------- #
+
+def test_dsl_arithmetic_builds_stages():
+    import transmogrifai_tpu  # noqa: F401 — attaches DSL
+    a, b = _raw("a", t.Real), _raw("b", t.Real)
+    c = (a + b) / 2.0
+    ca = Column.from_values(t.Real, [2.0, 4.0])
+    cb = Column.from_values(t.Real, [4.0, 8.0])
+    half = c.origin_stage
+    summed = c.parents[0].origin_stage.transform([ca, cb])
+    out = _scalar(half.transform([summed]))
+    assert out == [3.0, 6.0]
+
+
+def test_dsl_feature_methods_wire_types():
+    import transmogrifai_tpu  # noqa: F401
+    x = _raw("x", t.Real)
+    s = _raw("s", t.Text)
+    d = _raw("d", t.Date)
+    assert x.z_normalize().ftype is t.RealNN
+    assert x.bucketize([0, 1, 2]).ftype is t.OPVector
+    assert s.indexed().ftype is t.RealNN
+    assert s.pivot().ftype is t.OPVector
+    assert d.to_time_period("HourOfDay").ftype is t.Integral
+    assert x.alias("z").name == "z"
+    v1, v2 = x.vectorize(), s.pivot()
+    assert v1.combine(v2).ftype is t.OPVector
+
+
+# ----------------------------------------------------------------- #
+# regression tests for review findings                              #
+# ----------------------------------------------------------------- #
+
+def test_best_split_exact_midpoint():
+    from transmogrifai_tpu.ops.bucketizers import _best_split
+    thr, gain = _best_split(np.array([0.0, 1.0, 100.0]),
+                            np.array([0.0, 1.0, 1.0]), True, 1)
+    assert thr == 0.5 and gain > 0
+    thr2, _ = _best_split(
+        np.array([0.0, 1.0, 2.0, 3.0, 10.0, 11.0, 12.0]),
+        np.array([0.0, 0.0, 0.0, 0.0, 1.0, 1.0, 1.0]), True, 1)
+    assert thr2 == 6.5
+
+
+def test_since_last_default_reference_not_degenerate():
+    day = 86_400_000
+    f = _raw("dl", t.DateList)
+    col = Column.from_values(t.DateList, [[0], [9 * day], [4 * day]])
+    st = DateListVectorizer(pivot="SinceLast").set_input(f)  # no reference_ms
+    arr = np.asarray(st.transform([col]).data)
+    # batch max (day 9) is the reference → 9, 0, 5 days since last
+    np.testing.assert_allclose(arr[:, 0], [9.0, 0.0, 5.0])
+
+
+def test_reflected_scalar_ops():
+    import transmogrifai_tpu  # noqa: F401
+    x = _raw("x", t.Real)
+    col = Column.from_values(t.Real, [2.0, 4.0])
+    r1 = (10.0 - x).origin_stage.transform([col])
+    assert _scalar(r1) == [8.0, 6.0]
+    r2 = (8.0 / x).origin_stage.transform([col])
+    assert _scalar(r2) == [4.0, 2.0]
+    r3 = (1.0 + x).origin_stage.transform([col])
+    assert _scalar(r3) == [3.0, 5.0]
+
+
+def test_dsl_vectorize_threads_args():
+    import transmogrifai_tpu  # noqa: F401
+    x = _raw("x", t.Real)
+    v = x.vectorize(track_nulls=False)
+    col = Column.from_values(t.Real, [1.0, None, 3.0])
+    est = v.parents[0].origin_stage  # RealVectorizer under the combiner
+    model = est.fit([col], FitContext(n_rows=3))
+    out = model.transform([col])
+    assert out.width == 1  # no null-indicator column

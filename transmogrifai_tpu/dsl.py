@@ -1,0 +1,270 @@
+"""The feature DSL: every op as a method on `Feature`.
+
+Reference parity: `core/src/main/scala/com/salesforce/op/dsl/` — the
+implicit enrichment classes `RichNumericFeature` (arith at :70-228,
+`bucketize:263`, `autoBucketize:288`, `vectorize:315`, `zNormalize:377`,
+`sanityCheck:469`), `RichTextFeature` (tokenize/pivot/smartVectorize),
+`RichDateFeature`, `RichListFeature`, `RichSetFeature`, `RichMapFeature`,
+`RichVectorFeature`, generic `RichFeature` (map/alias/filter/exists/
+replaceWith/occurs), and `RichFeaturesCollection.transmogrify`
+(`RichFeaturesCollection.scala:69`).
+
+Python has no implicits: importing this module (done by the package
+`__init__`) attaches the methods directly onto `Feature`. Each method wires
+a stage lazily and returns its output feature — nothing executes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.features.feature import Feature
+
+
+def _stage(cls, *inputs, **kw) -> Feature:
+    return cls(**kw).set_input(*inputs).get_output()
+
+
+# ----------------------------------------------------------------- #
+# arithmetic (RichNumericFeature:70-228)                            #
+# ----------------------------------------------------------------- #
+
+def _binary_or_scalar(op: str):
+    def method(self: Feature, other):
+        from transmogrifai_tpu.ops.mathops import (
+            BinaryMathTransformer, ScalarMathTransformer)
+        if isinstance(other, Feature):
+            return _stage(BinaryMathTransformer, self, other, op=op)
+        return _stage(ScalarMathTransformer, self, op=op, scalar=float(other))
+    return method
+
+
+def _reflected_scalar(op: str):
+    """scalar ⊕ feature for non-commutative ops (__rsub__/__rtruediv__)."""
+    def method(self: Feature, other):
+        from transmogrifai_tpu.ops.mathops import ScalarMathTransformer
+        return _stage(ScalarMathTransformer, self, op=op, scalar=float(other))
+    return method
+
+
+def _unary(op: str, needs_arg: bool = False):
+    if needs_arg:
+        def method(self: Feature, arg: float):
+            from transmogrifai_tpu.ops.mathops import UnaryMathTransformer
+            return _stage(UnaryMathTransformer, self, op=op, arg=arg)
+    else:
+        def method(self: Feature):
+            from transmogrifai_tpu.ops.mathops import UnaryMathTransformer
+            return _stage(UnaryMathTransformer, self, op=op)
+    return method
+
+
+def log(self: Feature, base: float = 0.0) -> Feature:
+    from transmogrifai_tpu.ops.mathops import UnaryMathTransformer
+    return _stage(UnaryMathTransformer, self, op="log", arg=base)
+
+
+# ----------------------------------------------------------------- #
+# numeric feature engineering                                       #
+# ----------------------------------------------------------------- #
+
+def vectorize(self: Feature, track_nulls: bool = True, fill_value="mean") -> Feature:
+    """Per-type default encoding of a single feature (RichNumericFeature.vectorize
+    etc.) — delegates to transmogrify on the singleton list."""
+    from transmogrifai_tpu.automl.transmogrify import (
+        TransmogrifierDefaults, transmogrify)
+    d = TransmogrifierDefaults(track_nulls=track_nulls, fill_numeric=fill_value)
+    return transmogrify([self], defaults=d)
+
+
+def z_normalize(self: Feature, with_mean: bool = True, with_std: bool = True) -> Feature:
+    from transmogrifai_tpu.ops.scalers import OpScalarStandardScaler
+    return _stage(OpScalarStandardScaler, self, with_mean=with_mean, with_std=with_std)
+
+
+def fill_missing_with_mean(self: Feature, default: float = 0.0) -> Feature:
+    from transmogrifai_tpu.ops.scalers import FillMissingWithMean
+    return _stage(FillMissingWithMean, self, default=default)
+
+
+def bucketize(self: Feature, splits: Sequence[float], track_nulls: bool = True,
+              track_invalid: bool = False) -> Feature:
+    from transmogrifai_tpu.ops.bucketizers import NumericBucketizer
+    return _stage(NumericBucketizer, self, splits=splits,
+                  track_nulls=track_nulls, track_invalid=track_invalid)
+
+
+def auto_bucketize(self: Feature, label: Feature, max_depth: int = 2,
+                   track_nulls: bool = True) -> Feature:
+    from transmogrifai_tpu.ops.bucketizers import DecisionTreeNumericBucketizer
+    return _stage(DecisionTreeNumericBucketizer, label, self,
+                  max_depth=max_depth, track_nulls=track_nulls)
+
+
+def to_percentile(self: Feature, buckets: int = 100) -> Feature:
+    from transmogrifai_tpu.ops.scalers import PercentileCalibrator
+    return _stage(PercentileCalibrator, self, buckets=buckets)
+
+
+def scale(self: Feature, scaling_type: str = "linear", slope: float = 1.0,
+          intercept: float = 0.0) -> Feature:
+    from transmogrifai_tpu.ops.scalers import ScalerTransformer
+    return _stage(ScalerTransformer, self, scaling_type=scaling_type,
+                  slope=slope, intercept=intercept)
+
+
+def descale(self: Feature, scaled: Feature) -> Feature:
+    from transmogrifai_tpu.ops.scalers import DescalerTransformer
+    return _stage(DescalerTransformer, self, scaled)
+
+
+# ----------------------------------------------------------------- #
+# label / sanity / selection entry points                           #
+# ----------------------------------------------------------------- #
+
+def sanity_check(self: Feature, feature_vector: Feature, **kw) -> Feature:
+    """label.sanity_check(vector) — RichNumericFeature.sanityCheck:469."""
+    from transmogrifai_tpu.automl.sanity_checker import SanityChecker
+    return _stage(SanityChecker, self, feature_vector, **kw)
+
+
+# ----------------------------------------------------------------- #
+# text (RichTextFeature)                                            #
+# ----------------------------------------------------------------- #
+
+def tokenize(self: Feature, **kw) -> Feature:
+    from transmogrifai_tpu.ops.text import TextTokenizer
+    return _stage(TextTokenizer, self, **kw)
+
+
+def pivot(self: Feature, top_k: int = 20, min_support: int = 10,
+          track_nulls: bool = True) -> Feature:
+    from transmogrifai_tpu.ops.categorical import OneHotVectorizer
+    return _stage(OneHotVectorizer, self, top_k=top_k, min_support=min_support,
+                  track_nulls=track_nulls)
+
+
+def smart_vectorize(self: Feature, **kw) -> Feature:
+    from transmogrifai_tpu.ops.text import SmartTextVectorizer
+    return _stage(SmartTextVectorizer, self, **kw)
+
+
+def indexed(self: Feature, handle_invalid: str = "error") -> Feature:
+    from transmogrifai_tpu.ops.indexers import OpStringIndexer
+    return _stage(OpStringIndexer, self, handle_invalid=handle_invalid)
+
+
+def deindexed(self: Feature, labels: Optional[Sequence[str]] = None) -> Feature:
+    from transmogrifai_tpu.ops.indexers import OpIndexToString
+    return _stage(OpIndexToString, self, labels=labels)
+
+
+def text_len(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.rowops import TextLenTransformer
+    return _stage(TextLenTransformer, self)
+
+
+# ----------------------------------------------------------------- #
+# dates (RichDateFeature)                                           #
+# ----------------------------------------------------------------- #
+
+def to_unit_circle(self: Feature, periods: Optional[Sequence[str]] = None) -> Feature:
+    from transmogrifai_tpu.ops.dates import DEFAULT_PERIODS, DateToUnitCircleVectorizer
+    return _stage(DateToUnitCircleVectorizer, self,
+                  periods=list(periods or DEFAULT_PERIODS))
+
+
+def to_time_period(self: Feature, period: str = "DayOfWeek") -> Feature:
+    from transmogrifai_tpu.ops.dates import TimePeriodTransformer
+    return _stage(TimePeriodTransformer, self, period=period)
+
+
+# ----------------------------------------------------------------- #
+# generic (RichFeature)                                             #
+# ----------------------------------------------------------------- #
+
+def alias(self: Feature, name: str) -> Feature:
+    from transmogrifai_tpu.ops.rowops import AliasTransformer
+    return _stage(AliasTransformer, self, name=name)
+
+
+def map_values(self: Feature, fn: Callable[[Any], Any], out_type: type) -> Feature:
+    from transmogrifai_tpu.ops.rowops import LambdaMap
+    return _stage(LambdaMap, self, fn=fn, out_type=out_type)
+
+
+def filter_values(self: Feature, predicate: Callable[[Any], bool]) -> Feature:
+    from transmogrifai_tpu.ops.rowops import FilterTransformer
+    return _stage(FilterTransformer, self, predicate=predicate)
+
+
+def exists(self: Feature, predicate: Callable[[Any], bool]) -> Feature:
+    from transmogrifai_tpu.ops.rowops import ExistsTransformer
+    return _stage(ExistsTransformer, self, predicate=predicate)
+
+
+def replace_with(self: Feature, old: Any, new: Any) -> Feature:
+    from transmogrifai_tpu.ops.rowops import ReplaceTransformer
+    return _stage(ReplaceTransformer, self, old=old, new=new)
+
+
+def occurs(self: Feature, match_fn: Optional[Callable[[Any], bool]] = None) -> Feature:
+    from transmogrifai_tpu.ops.rowops import ToOccurTransformer
+    return _stage(ToOccurTransformer, self, match_fn=match_fn)
+
+
+def jaccard_similarity(self: Feature, other: Feature) -> Feature:
+    from transmogrifai_tpu.ops.rowops import JaccardSimilarity
+    return _stage(JaccardSimilarity, self, other)
+
+
+def ngram_similarity(self: Feature, other: Feature, n: int = 3) -> Feature:
+    from transmogrifai_tpu.ops.rowops import NGramSimilarity
+    return _stage(NGramSimilarity, self, other, n=n)
+
+
+def contained_in(self: Feature, other: Feature, ignore_case: bool = True) -> Feature:
+    from transmogrifai_tpu.ops.rowops import SubstringTransformer
+    return _stage(SubstringTransformer, self, other, ignore_case=ignore_case)
+
+
+# ----------------------------------------------------------------- #
+# vector (RichVectorFeature)                                        #
+# ----------------------------------------------------------------- #
+
+def combine(self: Feature, *others: Feature) -> Feature:
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+    return _stage(VectorsCombiner, self, *others)
+
+
+_METHODS = {
+    "__add__": _binary_or_scalar("plus"),
+    "__radd__": _binary_or_scalar("plus"),
+    "__sub__": _binary_or_scalar("minus"),
+    "__rsub__": _reflected_scalar("rminus"),
+    "__mul__": _binary_or_scalar("multiply"),
+    "__rmul__": _binary_or_scalar("multiply"),
+    "__truediv__": _binary_or_scalar("divide"),
+    "__rtruediv__": _reflected_scalar("rdivide"),
+    "abs": _unary("abs"), "ceil": _unary("ceil"), "floor": _unary("floor"),
+    "round": _unary("round"), "exp": _unary("exp"), "sqrt": _unary("sqrt"),
+    "negate": _unary("negate"), "power": _unary("power", needs_arg=True),
+    "log": log,
+    "vectorize": vectorize, "z_normalize": z_normalize,
+    "fill_missing_with_mean": fill_missing_with_mean,
+    "bucketize": bucketize, "auto_bucketize": auto_bucketize,
+    "to_percentile": to_percentile, "scale": scale, "descale": descale,
+    "sanity_check": sanity_check,
+    "tokenize": tokenize, "pivot": pivot, "smart_vectorize": smart_vectorize,
+    "indexed": indexed, "deindexed": deindexed, "text_len": text_len,
+    "to_unit_circle": to_unit_circle, "to_time_period": to_time_period,
+    "alias": alias, "map_values": map_values, "filter_values": filter_values,
+    "exists": exists, "replace_with": replace_with, "occurs": occurs,
+    "jaccard_similarity": jaccard_similarity,
+    "ngram_similarity": ngram_similarity, "contained_in": contained_in,
+    "combine": combine,
+}
+
+for _name, _fn in _METHODS.items():
+    setattr(Feature, _name, _fn)

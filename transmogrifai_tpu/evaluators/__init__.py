@@ -1,15 +1,25 @@
 from transmogrifai_tpu.evaluators.metrics import (
     BinaryClassificationMetrics, MultiClassificationMetrics, RegressionMetrics,
+    BinaryThresholdMetrics, MulticlassThresholdMetrics, BinScoreMetrics,
+    ForecastMetrics,
     binary_metrics, multiclass_metrics, regression_metrics,
+    binary_threshold_metrics, multiclass_threshold_metrics,
+    misclassifications_per_category, bin_score_metrics, forecast_metrics,
 )
 from transmogrifai_tpu.evaluators.evaluators import (
-    Evaluator, BinaryClassificationEvaluator, MultiClassificationEvaluator,
-    RegressionEvaluator,
+    Evaluator, Evaluators, BinaryClassificationEvaluator,
+    MultiClassificationEvaluator, RegressionEvaluator, BinScoreEvaluator,
+    ForecastEvaluator, LambdaEvaluator,
 )
 
 __all__ = [
     "BinaryClassificationMetrics", "MultiClassificationMetrics",
-    "RegressionMetrics", "binary_metrics", "multiclass_metrics",
-    "regression_metrics", "Evaluator", "BinaryClassificationEvaluator",
-    "MultiClassificationEvaluator", "RegressionEvaluator",
+    "RegressionMetrics", "BinaryThresholdMetrics", "MulticlassThresholdMetrics",
+    "BinScoreMetrics", "ForecastMetrics",
+    "binary_metrics", "multiclass_metrics", "regression_metrics",
+    "binary_threshold_metrics", "multiclass_threshold_metrics",
+    "misclassifications_per_category", "bin_score_metrics", "forecast_metrics",
+    "Evaluator", "Evaluators", "BinaryClassificationEvaluator",
+    "MultiClassificationEvaluator", "RegressionEvaluator", "BinScoreEvaluator",
+    "ForecastEvaluator", "LambdaEvaluator",
 ]

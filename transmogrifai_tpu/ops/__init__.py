@@ -3,12 +3,41 @@ from transmogrifai_tpu.ops.numeric import (
 from transmogrifai_tpu.ops.categorical import OneHotVectorizer, MultiPickListVectorizer
 from transmogrifai_tpu.ops.combiner import VectorsCombiner
 from transmogrifai_tpu.ops.text import TextTokenizer, HashingVectorizer, SmartTextVectorizer
-from transmogrifai_tpu.ops.dates import DateToUnitCircleVectorizer
+from transmogrifai_tpu.ops.dates import (
+    DateToUnitCircleVectorizer, TimePeriodTransformer, TimePeriodListTransformer,
+    DateListVectorizer)
 from transmogrifai_tpu.ops.geo import GeolocationVectorizer
+from transmogrifai_tpu.ops.mathops import (
+    BinaryMathTransformer, ScalarMathTransformer, UnaryMathTransformer)
+from transmogrifai_tpu.ops.scalers import (
+    OpScalarStandardScaler, FillMissingWithMean, ScalerTransformer,
+    DescalerTransformer, PercentileCalibrator)
+from transmogrifai_tpu.ops.bucketizers import (
+    NumericBucketizer, DecisionTreeNumericBucketizer,
+    DecisionTreeNumericMapBucketizer)
+from transmogrifai_tpu.ops.indexers import (
+    OpStringIndexer, OpStringIndexerNoFilter, OpIndexToString,
+    PredictionDeIndexer)
+from transmogrifai_tpu.ops.rowops import (
+    AliasTransformer, LambdaMap, FilterTransformer, ExistsTransformer,
+    ReplaceTransformer, ToOccurTransformer, SubstringTransformer,
+    TextLenTransformer, JaccardSimilarity, NGramSimilarity)
 
 __all__ = [
     "RealVectorizer", "IntegralVectorizer", "BinaryVectorizer",
     "RealNNVectorizer", "OneHotVectorizer", "MultiPickListVectorizer",
     "VectorsCombiner", "TextTokenizer", "HashingVectorizer",
-    "SmartTextVectorizer", "DateToUnitCircleVectorizer", "GeolocationVectorizer",
+    "SmartTextVectorizer", "DateToUnitCircleVectorizer",
+    "TimePeriodTransformer", "TimePeriodListTransformer", "DateListVectorizer",
+    "GeolocationVectorizer",
+    "BinaryMathTransformer", "ScalarMathTransformer", "UnaryMathTransformer",
+    "OpScalarStandardScaler", "FillMissingWithMean", "ScalerTransformer",
+    "DescalerTransformer", "PercentileCalibrator",
+    "NumericBucketizer", "DecisionTreeNumericBucketizer",
+    "DecisionTreeNumericMapBucketizer",
+    "OpStringIndexer", "OpStringIndexerNoFilter", "OpIndexToString",
+    "PredictionDeIndexer",
+    "AliasTransformer", "LambdaMap", "FilterTransformer", "ExistsTransformer",
+    "ReplaceTransformer", "ToOccurTransformer", "SubstringTransformer",
+    "TextLenTransformer", "JaccardSimilarity", "NGramSimilarity",
 ]

@@ -1,0 +1,362 @@
+"""Bucketization stages: unsupervised splits and supervised (decision-tree)
+split discovery.
+
+Reference parity:
+- `core/.../feature/NumericBucketizer.scala` — one-hot of user-provided
+  monotonic splits, with trackNulls / trackInvalid columns.
+- `core/.../feature/DecisionTreeNumericBucketizer.scala` — label-aware
+  bucketization: fit a single-feature decision tree against the label and
+  use its thresholds as splits; produces no bucket columns when the tree
+  finds no useful split.
+- `core/.../feature/DecisionTreeNumericMapBucketizer.scala` — same per map
+  key.
+
+TPU-first: the reference delegates to Spark's DecisionTreeClassifier; here
+split search is a vectorized prefix-sum scan over sorted (value, label)
+pairs — O(n log n) on host numpy at fit time (fit-time host work mirrors
+the two-phase fit→static-transform design), while the fitted transform is a
+pure jnp one-hot that fuses into the scoring program.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import (
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata)
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+
+def _bucket_labels(splits: Sequence[float]) -> List[str]:
+    s = ["-Inf" if not np.isfinite(a) else f"{a:g}" for a in splits]
+    s[-1] = "Inf" if not np.isfinite(splits[-1]) else s[-1]
+    return [f"[{a}-{b})" for a, b in zip(s[:-1], s[1:])]
+
+
+class NumericBucketizerModel(Transformer):
+    """One-hot of bucket membership given monotonic `splits` (left-inclusive)."""
+
+    in_types = (T.OPNumeric,)
+    out_type = T.OPVector
+
+    def __init__(self, splits: Sequence[float], track_nulls: bool = True,
+                 track_invalid: bool = False,
+                 labels: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.splits = np.asarray(splits, dtype=np.float64)
+        if len(self.splits) < 2 or np.any(np.diff(self.splits) <= 0):
+            raise ValueError("splits must be ≥2 strictly increasing values")
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+        self.labels = list(labels) if labels else _bucket_labels(self.splits)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.splits) - 1
+
+    def device_apply(self, enc, dev):
+        x, m = dev[0]["value"], dev[0]["mask"].astype(bool)
+        inner = jnp.asarray(self.splits[1:-1])
+        idx = jnp.searchsorted(inner, x, side="right")
+        valid = m & (x >= self.splits[0]) & (x < self.splits[-1])
+        onehot = (jnp.arange(self.n_buckets)[None, :] == idx[:, None]) & valid[:, None]
+        cols = [onehot.astype(jnp.float32)]
+        if self.track_invalid:
+            cols.append((m & ~valid)[:, None].astype(jnp.float32))
+        if self.track_nulls:
+            cols.append((~m)[:, None].astype(jnp.float32))
+        return jnp.concatenate(cols, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        f = self.input_features[0]
+        cols = [VectorColumnMetadata(parent_name=f.name,
+                                     parent_type=f.ftype.__name__,
+                                     indicator_value=lbl)
+                for lbl in self.labels]
+        if self.track_invalid:
+            cols.append(VectorColumnMetadata(
+                parent_name=f.name, parent_type=f.ftype.__name__,
+                indicator_value="OutOfBounds"))
+        if self.track_nulls:
+            cols.append(VectorColumnMetadata(
+                parent_name=f.name, parent_type=f.ftype.__name__,
+                indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"splits": self.splits.tolist(), "track_nulls": self.track_nulls,
+                "track_invalid": self.track_invalid, "labels": self.labels}
+
+
+class NumericBucketizer(NumericBucketizerModel):
+    """Public unsupervised bucketizer (it is already a pure transformer)."""
+
+
+# ------------------------------------------------------------------ #
+# supervised split search                                            #
+# ------------------------------------------------------------------ #
+
+def _best_split(x: np.ndarray, y: np.ndarray, classification: bool,
+                min_leaf: int) -> Tuple[Optional[float], float]:
+    """Best threshold by impurity decrease via one sorted prefix-sum scan.
+
+    Returns (threshold, gain); threshold None when no valid split.
+    Candidate thresholds are midpoints between distinct consecutive sorted
+    values (Spark's tree uses binned candidates; exact scan is affordable
+    for the single-feature case and removes binning error).
+    """
+    n = x.shape[0]
+    if n < 2 * min_leaf:
+        return None, 0.0
+    order = np.argsort(x, kind="stable")
+    xs, ys = x[order], y[order]
+    # positions where a split is allowed: value changes AND both sides ≥ min_leaf
+    change = xs[1:] != xs[:-1]
+    pos = np.arange(1, n)
+    ok = change & (pos >= min_leaf) & (n - pos >= min_leaf)
+    if not ok.any():
+        return None, 0.0
+    if classification:
+        classes, yi = np.unique(ys, return_inverse=True)
+        k = len(classes)
+        onehot = np.zeros((n, k), dtype=np.float64)
+        onehot[np.arange(n), yi] = 1.0
+        left = np.cumsum(onehot, axis=0)[:-1]         # (n-1, k) counts left of split i
+        total = onehot.sum(axis=0)
+        right = total[None, :] - left
+        nl = pos.astype(np.float64)
+        nr = (n - pos).astype(np.float64)
+        gini_l = 1.0 - ((left / nl[:, None]) ** 2).sum(axis=1)
+        gini_r = 1.0 - ((right / nr[:, None]) ** 2).sum(axis=1)
+        p = onehot.sum(axis=0) / n
+        parent = 1.0 - (p ** 2).sum()
+        gain = parent - (nl / n) * gini_l - (nr / n) * gini_r
+    else:
+        s = np.cumsum(ys)[:-1]
+        s2 = np.cumsum(ys ** 2)[:-1]
+        st, s2t = ys.sum(), (ys ** 2).sum()
+        nl = pos.astype(np.float64)
+        nr = (n - pos).astype(np.float64)
+        var_l = s2 / nl - (s / nl) ** 2
+        var_r = (s2t - s2) / nr - ((st - s) / nr) ** 2
+        parent = s2t / n - (st / n) ** 2
+        gain = parent - (nl / n) * var_l - (nr / n) * var_r
+    gain = np.where(ok, gain, -np.inf)
+    i = int(np.argmax(gain))
+    if not np.isfinite(gain[i]) or gain[i] <= 0:
+        return None, 0.0
+    # split index i puts xs[0..i] left and xs[i+1..] right
+    return float((xs[i] + xs[i + 1]) / 2.0), float(gain[i])
+
+
+def decision_tree_splits(x: np.ndarray, y: np.ndarray, classification: bool,
+                         max_depth: int = 2, min_leaf: int = 1,
+                         min_info_gain: float = 1e-6) -> List[float]:
+    """Thresholds of a greedy depth-`max_depth` single-feature tree."""
+    thresholds: List[float] = []
+
+    def grow(idx: np.ndarray, depth: int) -> None:
+        if depth >= max_depth or idx.size < 2 * min_leaf:
+            return
+        thr, gain = _best_split(x[idx], y[idx], classification, min_leaf)
+        if thr is None or gain < min_info_gain:
+            return
+        thresholds.append(thr)
+        grow(idx[x[idx] < thr], depth + 1)
+        grow(idx[x[idx] >= thr], depth + 1)
+
+    grow(np.arange(x.shape[0]), 0)
+    return sorted(thresholds)
+
+
+def _is_classification(y: np.ndarray, max_classes: int = 32) -> bool:
+    u = np.unique(y)
+    return u.size <= max_classes and np.allclose(u, np.round(u))
+
+
+class DecisionTreeNumericBucketizer(Estimator):
+    """(label, numeric) → one-hot of label-aware buckets; empty buckets (only
+    the null indicator, if tracked) when no useful split exists."""
+
+    in_types = (T.OPNumeric, T.OPNumeric)  # (response, numeric predictor)
+    out_type = T.OPVector
+
+    def __init__(self, max_depth: int = 2, min_info_gain: float = 1e-6,
+                 min_instances_per_node: int = 1, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, max_depth=max_depth,
+                         min_info_gain=min_info_gain,
+                         min_instances_per_node=min_instances_per_node,
+                         track_nulls=track_nulls)
+        self.max_depth = max_depth
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
+        self.track_nulls = track_nulls
+
+    def _fit_splits(self, label: Column, num: Column) -> List[float]:
+        y = np.asarray(label.data["value"], dtype=np.float64)
+        x = np.asarray(num.data["value"], dtype=np.float64)
+        m = (np.asarray(num.data["mask"]).astype(bool)
+             & np.asarray(label.data["mask"]).astype(bool))
+        if not m.any():
+            return []
+        x, y = x[m], y[m]
+        return decision_tree_splits(
+            x, y, _is_classification(y), self.max_depth,
+            self.min_instances_per_node, self.min_info_gain)
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        thr = self._fit_splits(cols[0], cols[1])
+        return DecisionTreeBucketizerModel(thr, track_nulls=self.track_nulls)
+
+
+class DecisionTreeBucketizerModel(Transformer):
+    """Fitted supervised bucketizer. Input wiring keeps (label, numeric); the
+    label is ignored at transform time (may be absent when scoring)."""
+
+    in_types = (T.OPNumeric, T.OPNumeric)
+    out_type = T.OPVector
+
+    def __init__(self, thresholds: Sequence[float], track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.thresholds = list(thresholds)
+        self.track_nulls = track_nulls
+        if self.thresholds:
+            splits = [-np.inf] + self.thresholds + [np.inf]
+            # splits span ±inf so every present value is in-bounds;
+            # track_invalid therefore adds no column here
+            self._inner = NumericBucketizerModel(
+                splits, track_nulls=False, track_invalid=False)
+        else:
+            self._inner = None
+
+    @property
+    def did_split(self) -> bool:
+        return self._inner is not None
+
+    def device_apply(self, enc, dev):
+        d = dev[1]
+        m = d["mask"].astype(bool)
+        cols = []
+        if self._inner is not None:
+            cols.append(self._inner.device_apply(None, [d]))
+        if self.track_nulls:
+            cols.append((~m)[:, None].astype(jnp.float32))
+        if not cols:
+            return jnp.zeros((d["value"].shape[0], 0), jnp.float32)
+        return jnp.concatenate(cols, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        f = self.input_features[1]
+        cols: List[VectorColumnMetadata] = []
+        if self._inner is not None:
+            for lbl in self._inner.labels:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    indicator_value=lbl))
+        if self.track_nulls:
+            cols.append(VectorColumnMetadata(
+                parent_name=f.name, parent_type=f.ftype.__name__,
+                indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"thresholds": self.thresholds, "track_nulls": self.track_nulls}
+
+
+class DecisionTreeNumericMapBucketizer(Estimator):
+    """(label, numeric map) → concatenated label-aware buckets per map key
+    (`DecisionTreeNumericMapBucketizer.scala`)."""
+
+    in_types = (T.OPNumeric, T.OPMap)
+    out_type = T.OPVector
+
+    def __init__(self, max_depth: int = 2, min_info_gain: float = 1e-6,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid, max_depth=max_depth,
+                         min_info_gain=min_info_gain, track_nulls=track_nulls)
+        self.max_depth = max_depth
+        self.min_info_gain = min_info_gain
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        label, mapped = cols
+        y_all = np.asarray(label.data["value"], dtype=np.float64)
+        ym = np.asarray(label.data["mask"]).astype(bool)
+        keys = sorted({k for row in mapped.data for k in (row or {})})
+        per_key = {}
+        for k in keys:
+            x = np.array([float(row[k]) if row and k in row and row[k] is not None
+                          else np.nan for row in mapped.data])
+            m = ~np.isnan(x) & ym
+            thr: List[float] = []
+            if m.any():
+                thr = decision_tree_splits(
+                    x[m], y_all[m], _is_classification(y_all[ym]),
+                    self.max_depth, 1, self.min_info_gain)
+            per_key[k] = thr
+        return DecisionTreeMapBucketizerModel(per_key, self.track_nulls)
+
+
+class DecisionTreeMapBucketizerModel(Transformer):
+    in_types = (T.OPNumeric, T.OPMap)
+    out_type = T.OPVector
+    jittable = False  # map input needs host-side key extraction
+
+    def __init__(self, splits_by_key, track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.splits_by_key = dict(splits_by_key)
+        self.track_nulls = track_nulls
+
+    def host_prepare(self, cols):
+        mapped = cols[1]
+        out = {}
+        for k in self.splits_by_key:
+            x = np.array([float(row[k]) if row and k in row and row[k] is not None
+                          else np.nan for row in mapped.data])
+            out[k] = {"value": np.nan_to_num(x), "mask": ~np.isnan(x)}
+        return out
+
+    def device_apply(self, enc, dev):
+        groups = []
+        for k, thr in self.splits_by_key.items():
+            d = enc[k]
+            m = jnp.asarray(d["mask"])
+            if thr:
+                inner = NumericBucketizerModel(
+                    [-np.inf] + list(thr) + [np.inf],
+                    track_nulls=False, track_invalid=False)
+                groups.append(inner.device_apply(None, [d]))
+            if self.track_nulls:
+                groups.append((~m)[:, None].astype(jnp.float32))
+        if not groups:
+            n = len(next(iter(enc.values()))["value"]) if enc else 0
+            return jnp.zeros((n, 0), jnp.float32)
+        return jnp.concatenate(groups, axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        f = self.input_features[1]
+        cols: List[VectorColumnMetadata] = []
+        for k, thr in self.splits_by_key.items():
+            if thr:
+                for lbl in _bucket_labels([-np.inf] + list(thr) + [np.inf]):
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=k, indicator_value=lbl))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=k, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"splits_by_key": {k: list(v) for k, v in self.splits_by_key.items()},
+                "track_nulls": self.track_nulls}

@@ -102,3 +102,185 @@ class DateToUnitCircleVectorizer(Transformer):
                         parent_name=f.name, parent_type=f.ftype.__name__,
                         descriptor_value=f"{p}_{fn}"))
         return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+
+# --------------------------------------------------------------------- #
+# calendar-unit extraction + date-list pivots                           #
+# --------------------------------------------------------------------- #
+
+TIME_PERIODS = ("DayOfMonth", "DayOfWeek", "DayOfYear", "HourOfDay",
+                "MonthOfYear", "WeekOfMonth", "WeekOfYear")
+
+
+def time_period_value(ms: np.ndarray, period: str) -> np.ndarray:
+    """Integral calendar unit per reference `TimePeriod.scala` (1-based
+    days/months, 0-based hours/weeks)."""
+    day = ms // _MS_PER_DAY
+    days = day.astype("datetime64[D]")
+    if period == "HourOfDay":
+        return (ms % _MS_PER_DAY) // _MS_PER_HOUR
+    if period == "DayOfWeek":
+        return (day + 3) % 7 + 1  # Monday=1..Sunday=7 (ISO)
+    if period == "DayOfMonth":
+        return (days - days.astype("datetime64[M]")).astype(np.int64) + 1
+    if period == "DayOfYear":
+        return (days - days.astype("datetime64[Y]")).astype(np.int64) + 1
+    if period == "MonthOfYear":
+        return days.astype("datetime64[M]").astype(np.int64) % 12 + 1
+    if period == "WeekOfMonth":
+        dom = (days - days.astype("datetime64[M]")).astype(np.int64)
+        return dom // 7
+    if period == "WeekOfYear":
+        doy = (days - days.astype("datetime64[Y]")).astype(np.int64)
+        return doy // 7
+    raise ValueError(f"Unknown time period {period!r}")
+
+
+class TimePeriodTransformer(Transformer):
+    """Date → Integral calendar unit (`TimePeriodTransformer.scala`)."""
+
+    in_types = (T.Date,)
+    out_type = T.Integral
+
+    def __init__(self, period: str = "DayOfWeek", uid: Optional[str] = None):
+        if period not in TIME_PERIODS:
+            raise ValueError(f"period must be one of {TIME_PERIODS}")
+        super().__init__(uid=uid, period=period)
+        self.period = period
+
+    def host_prepare(self, cols):
+        ms = np.asarray(cols[0].data["value"], dtype=np.int64)
+        mask = np.asarray(cols[0].data["mask"]).astype(bool)
+        vals = time_period_value(ms, self.period).astype(np.float64)
+        return {"value": np.where(mask, vals, 0.0), "mask": mask}
+
+    def device_apply(self, enc, dev):
+        return enc
+
+
+class TimePeriodListTransformer(Transformer):
+    """DateList → TextList-like integral list is host-only in the reference;
+    here we map each date list to its calendar units (host kind output)."""
+
+    in_types = (T.DateList,)
+    out_type = T.TextList
+    jittable = False
+
+    def __init__(self, period: str = "DayOfWeek", uid: Optional[str] = None):
+        super().__init__(uid=uid, period=period)
+        self.period = period
+
+    def transform(self, cols, ctx=None):
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, lst in enumerate(cols[0].data):
+            if not lst:
+                out[i] = []
+            else:
+                ms = np.asarray(list(lst), dtype=np.int64)
+                out[i] = [str(int(v)) for v in time_period_value(ms, self.period)]
+        return Column(T.TextList, out)
+
+
+DATE_LIST_PIVOTS = ("SinceFirst", "SinceLast", "ModeDay", "ModeMonth", "ModeHour")
+
+
+class DateListVectorizer(Transformer):
+    """N DateList features → OPVector per the reference's DateListPivot modes
+    (`core/.../feature/DateListVectorizer.scala`):
+
+    - SinceFirst/SinceLast: days between reference date and first/last event
+      (+ null indicator).
+    - ModeDay/ModeMonth/ModeHour: one-hot of the modal day-of-week / month /
+      hour across the list.
+    """
+
+    in_types = (T.DateList, Ellipsis)
+    out_type = T.OPVector
+    jittable = False  # list input needs host extraction
+
+    def __init__(self, pivot: str = "SinceLast",
+                 reference_ms: Optional[int] = None,
+                 track_nulls: bool = True, uid: Optional[str] = None):
+        if pivot not in DATE_LIST_PIVOTS:
+            raise ValueError(f"pivot must be one of {DATE_LIST_PIVOTS}")
+        super().__init__(uid=uid, pivot=pivot, reference_ms=reference_ms,
+                         track_nulls=track_nulls)
+        self.pivot = pivot
+        self.reference_ms = reference_ms
+        self.track_nulls = track_nulls
+
+    def _pivot_widths(self):
+        return {"ModeDay": 7, "ModeMonth": 12, "ModeHour": 24}.get(self.pivot)
+
+    def host_prepare(self, cols):
+        out = []
+        period = {"ModeDay": "DayOfWeek", "ModeMonth": "MonthOfYear",
+                  "ModeHour": "HourOfDay"}.get(self.pivot)
+        for c in cols:
+            n = len(c.data)
+            if period is None:  # SinceFirst / SinceLast
+                val = np.zeros(n, dtype=np.float32)
+                mask = np.zeros(n, dtype=np.float32)
+                ref = self.reference_ms
+                if ref is None:
+                    # default reference = latest event in the batch (the
+                    # reference uses "now"; a data-derived instant keeps the
+                    # transform deterministic)
+                    batch_max = max((max(lst) for lst in c.data if lst),
+                                    default=0)
+                    ref = batch_max
+                for i, lst in enumerate(c.data):
+                    if lst:
+                        pick = min(lst) if self.pivot == "SinceFirst" else max(lst)
+                        val[i] = (ref - pick) / _MS_PER_DAY
+                        mask[i] = 1.0
+                out.append({"value": val, "mask": mask})
+            else:
+                w = self._pivot_widths()
+                oh = np.zeros((n, w), dtype=np.float32)
+                mask = np.zeros(n, dtype=np.float32)
+                base = 1 if period != "HourOfDay" else 0
+                for i, lst in enumerate(c.data):
+                    if lst:
+                        units = time_period_value(
+                            np.asarray(list(lst), dtype=np.int64), period) - base
+                        counts = np.bincount(units.astype(np.int64), minlength=w)[:w]
+                        oh[i, int(np.argmax(counts))] = 1.0
+                        mask[i] = 1.0
+                out.append({"onehot": oh, "mask": mask})
+        return out
+
+    def device_apply(self, enc, dev):
+        parts = []
+        for e in enc:
+            if "onehot" in e:
+                parts.append(jnp.asarray(e["onehot"]))
+            else:
+                parts.append(jnp.asarray(e["value"])[:, None])
+            if self.track_nulls:
+                parts.append(1.0 - jnp.asarray(e["mask"])[:, None])
+        return jnp.concatenate(parts, axis=1)
+
+    def transform(self, cols, ctx=None):
+        enc = self.host_prepare(cols)
+        return self._wrap(self.device_apply(enc, None))
+
+    def output_meta(self) -> VectorMetadata:
+        from transmogrifai_tpu.data.metadata import NULL_INDICATOR
+        cols: List[VectorColumnMetadata] = []
+        w = self._pivot_widths()
+        for f in self.input_features:
+            if w is None:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    descriptor_value=self.pivot))
+            else:
+                for j in range(w):
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        indicator_value=f"{self.pivot}_{j}"))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()

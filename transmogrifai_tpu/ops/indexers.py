@@ -1,0 +1,167 @@
+"""String/label indexing round-trip.
+
+Reference parity: `core/.../feature/OpStringIndexer.scala` (Text → RealNN
+indices ordered by descending frequency), `OpIndexToString.scala` (+
+NoFilter variants: unseen labels map to an extra index instead of erroring),
+`core/.../preparators/PredictionDeIndexer.scala` (map a Prediction's class
+index back to the original string label using the indexer that encoded the
+response).
+
+Host/device split: building and applying a vocabulary over strings is host
+work (numpy object arrays); the produced index column is a device scalar so
+everything downstream stays jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.stages.base import (
+    Estimator, FitContext, HostTransformer, Transformer)
+
+ERROR, SKIP, KEEP = "error", "skip", "keep"
+
+
+class StringIndexerModel(Transformer):
+    """Fitted vocabulary: label → index (desc-frequency order)."""
+
+    in_types = (T.Text,)
+    out_type = T.RealNN
+    jittable = False  # input is a host text column
+
+    def __init__(self, labels: Sequence[str], handle_invalid: str = ERROR,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.labels = list(labels)
+        self.handle_invalid = handle_invalid
+        self._index: Dict[str, int] = {l: i for i, l in enumerate(self.labels)}
+
+    def host_prepare(self, cols):
+        vals = cols[0].data
+        n = len(vals)
+        idx = np.zeros(n, dtype=np.float64)
+        mask = np.ones(n, dtype=bool)
+        unseen = float(len(self.labels))
+        for i, v in enumerate(vals):
+            if v is None:
+                mask[i] = False
+                continue
+            j = self._index.get(v)
+            if j is None:
+                if self.handle_invalid == ERROR:
+                    raise ValueError(f"Unseen label {v!r} in {self.operation_name}")
+                if self.handle_invalid == SKIP:
+                    mask[i] = False
+                else:  # KEEP
+                    idx[i] = unseen
+            else:
+                idx[i] = float(j)
+        return {"value": idx, "mask": mask}
+
+    def device_apply(self, enc, dev):
+        return enc
+
+    def get_params(self):
+        return {"labels": self.labels, "handle_invalid": self.handle_invalid}
+
+
+class OpStringIndexer(Estimator):
+    """Text → RealNN index; labels ordered by descending frequency (ties by
+    label for determinism)."""
+
+    in_types = (T.Text,)
+    out_type = T.RealNN
+
+    def __init__(self, handle_invalid: str = ERROR, uid: Optional[str] = None):
+        if handle_invalid not in (ERROR, SKIP, KEEP):
+            raise ValueError(f"handle_invalid must be one of error/skip/keep")
+        super().__init__(uid=uid, handle_invalid=handle_invalid)
+        self.handle_invalid = handle_invalid
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        counts: Dict[str, int] = {}
+        for v in cols[0].data:
+            if v is not None:
+                counts[v] = counts.get(v, 0) + 1
+        labels = sorted(counts, key=lambda l: (-counts[l], l))
+        return StringIndexerModel(labels, self.handle_invalid)
+
+
+class OpStringIndexerNoFilter(OpStringIndexer):
+    """Unseen labels keep an extra index (`OpStringIndexerNoFilter.scala`)."""
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(handle_invalid=KEEP, uid=uid)
+
+
+class OpIndexToString(HostTransformer):
+    """RealNN index → Text using an explicit label list, or the labels of the
+    StringIndexerModel that produced the input."""
+
+    in_types = (T.OPNumeric,)
+    out_type = T.Text
+
+    def __init__(self, labels: Optional[Sequence[str]] = None,
+                 unseen_name: str = "UnseenLabel", uid: Optional[str] = None):
+        super().__init__(uid=uid, labels=list(labels) if labels else None,
+                         unseen_name=unseen_name)
+        self.labels = list(labels) if labels else None
+        self.unseen_name = unseen_name
+
+    def _labels(self) -> List[str]:
+        if self.labels is not None:
+            return self.labels
+        origin = self.input_features[0].origin_stage
+        if isinstance(origin, StringIndexerModel):
+            return origin.labels
+        raise ValueError(
+            "OpIndexToString needs labels= or a StringIndexerModel parent")
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        labels = self._labels()
+        v = np.asarray(cols[0].data["value"], dtype=np.float64)
+        m = np.asarray(cols[0].data["mask"]).astype(bool)
+        out = np.empty(len(v), dtype=object)
+        for i in range(len(v)):
+            if not m[i]:
+                out[i] = None
+            else:
+                j = int(v[i])
+                out[i] = labels[j] if 0 <= j < len(labels) else self.unseen_name
+        return Column(T.Text, out)
+
+
+class PredictionDeIndexer(HostTransformer):
+    """(indexed response, Prediction) → Text: the predicted class as its
+    original string label (`PredictionDeIndexer.scala`)."""
+
+    in_types = (T.OPNumeric, T.Prediction)
+    out_type = T.Text
+
+    def __init__(self, labels: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, labels=list(labels) if labels else None)
+        self.labels = list(labels) if labels else None
+
+    def _labels(self) -> List[str]:
+        if self.labels is not None:
+            return self.labels
+        origin = self.input_features[0].origin_stage
+        if isinstance(origin, StringIndexerModel):
+            return origin.labels
+        raise ValueError(
+            "PredictionDeIndexer: response must come from a StringIndexerModel "
+            "(or pass labels=)")
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        labels = self._labels()
+        pred = np.asarray(cols[1].data["prediction"], dtype=np.float64)
+        out = np.empty(len(pred), dtype=object)
+        for i, p in enumerate(pred):
+            j = int(p)
+            out[i] = labels[j] if 0 <= j < len(labels) else None
+        return Column(T.Text, out)

@@ -1,0 +1,264 @@
+"""Generic per-row feature ops: alias/map/filter/exists/replace/occurs and
+small text measures.
+
+Reference parity: `core/.../feature/AliasTransformer.scala`,
+`ToOccurTransformer.scala`, `FilterTransformer/FilterMap/ExistsTransformer/
+ReplaceTransformer/SubstringTransformer` (surfaced by the generic DSL in
+`core/.../dsl/RichFeature.scala`), `TextLenTransformer.scala`,
+`JaccardSimilarity.scala`, `NGramSimilarity.scala`.
+
+These are host-value row maps (arbitrary python predicates over typed
+values, like the reference's arbitrary Scala lambdas); numeric outputs land
+in device scalar columns so downstream stages stay jittable. `LambdaMap`'s
+function is serialized by qualified name, mirroring the reference's
+extract-fn class-name persistence (`FeatureGeneratorStage.scala:129`).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column, kind_of, SCALAR, TEXT
+from transmogrifai_tpu.stages.base import HostTransformer, Transformer
+
+
+def _values_of(col: Column):
+    """Host python values (None = missing) for any column kind."""
+    k = col.kind
+    if k == SCALAR:
+        v = np.asarray(col.data["value"])
+        m = np.asarray(col.data["mask"]).astype(bool)
+        return [float(v[i]) if m[i] else None for i in range(len(v))]
+    return list(col.data)
+
+
+class AliasTransformer(HostTransformer):
+    """Rename a feature without changing values (`AliasTransformer.scala`)."""
+
+    in_types = None
+
+    def __init__(self, name: str, uid: Optional[str] = None):
+        super().__init__(uid=uid, name=name)
+        self.name = name
+
+    def output_name(self) -> str:
+        return self.name
+
+    def output_ftype(self) -> type:
+        return self.input_features[0].ftype
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        c = cols[0]
+        return Column(c.ftype, c.data, c.meta)
+
+
+class LambdaMap(HostTransformer):
+    """feature.map(fn): arbitrary row transform to `out_type`. `fn` must be
+    a module-level named function for model persistence."""
+
+    in_types = None
+
+    def __init__(self, fn: Callable[[Any], Any], out_type: type,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.fn = fn
+        self._out = out_type
+
+    def output_ftype(self) -> type:
+        return self._out
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        vals = _values_of(cols[0])
+        return Column.from_values(self._out, [self.fn(v) for v in vals])
+
+    def get_params(self):
+        return {"fn": f"{self.fn.__module__}:{self.fn.__qualname__}",
+                "out_type": self._out.__name__}
+
+    @staticmethod
+    def resolve_fn(ref: str) -> Callable:
+        mod, qual = ref.split(":")
+        obj: Any = importlib.import_module(mod)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+
+class FilterTransformer(HostTransformer):
+    """Keep the value when `predicate(value)` else missing
+    (`FilterTransformer.scala`; default-on-missing like the reference)."""
+
+    in_types = None
+
+    def __init__(self, predicate: Callable[[Any], bool],
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.predicate = predicate
+
+    def output_ftype(self) -> type:
+        return self.input_features[0].ftype
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        ft = self.input_features[0].ftype
+        vals = _values_of(cols[0])
+        kept = [v if (v is not None and self.predicate(v)) else None for v in vals]
+        return Column.from_values(ft, kept)
+
+    def get_params(self):
+        return {"predicate": f"{self.predicate.__module__}:{self.predicate.__qualname__}"}
+
+
+class ExistsTransformer(HostTransformer):
+    """feature.exists(pred) → Binary (`RichFeature.exists`)."""
+
+    in_types = None
+    out_type = T.Binary
+
+    def __init__(self, predicate: Callable[[Any], bool],
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.predicate = predicate
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        vals = _values_of(cols[0])
+        out = [bool(v is not None and self.predicate(v)) for v in vals]
+        return Column.from_values(T.Binary, out)
+
+
+class ReplaceTransformer(HostTransformer):
+    """Replace values equal to `old` with `new` (`RichFeature.replaceWith`)."""
+
+    in_types = None
+
+    def __init__(self, old: Any, new: Any, uid: Optional[str] = None):
+        super().__init__(uid=uid, old=old, new=new)
+        self.old, self.new = old, new
+
+    def output_ftype(self) -> type:
+        return self.input_features[0].ftype
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        ft = self.input_features[0].ftype
+        vals = _values_of(cols[0])
+        return Column.from_values(
+            ft, [self.new if v == self.old else v for v in vals])
+
+
+class ToOccurTransformer(HostTransformer):
+    """Non-empty (by `matchFn`) → 1.0 else 0.0 (`ToOccurTransformer.scala`)."""
+
+    in_types = None
+    out_type = T.RealNN
+
+    def __init__(self, match_fn: Optional[Callable[[Any], bool]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.match_fn = match_fn
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        vals = _values_of(cols[0])
+
+        def occurs(v):
+            if v is None:
+                return False
+            if self.match_fn is not None:
+                return bool(self.match_fn(v))
+            if isinstance(v, (list, tuple, set, frozenset, dict, str)):
+                return len(v) > 0
+            return True
+
+        return Column.from_values(
+            T.RealNN, [1.0 if occurs(v) else 0.0 for v in vals])
+
+
+class SubstringTransformer(HostTransformer):
+    """(text, text) → Binary: does input 2 contain input 1?
+    (`SubstringTransformer.scala`)."""
+
+    in_types = (T.Text, T.Text)
+    out_type = T.Binary
+
+    def __init__(self, ignore_case: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid, ignore_case=ignore_case)
+        self.ignore_case = ignore_case
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = []
+        for needle, hay in zip(cols[0].data, cols[1].data):
+            if needle is None or hay is None:
+                out.append(None)
+            elif self.ignore_case:
+                out.append(needle.lower() in hay.lower())
+            else:
+                out.append(needle in hay)
+        return Column.from_values(T.Binary, out)
+
+
+class TextLenTransformer(HostTransformer):
+    """Text(/TextList) → Integral total length (`TextLenTransformer.scala`)."""
+
+    in_types = None
+    out_type = T.Integral
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        vals = _values_of(cols[0])
+        out = []
+        for v in vals:
+            if v is None:
+                out.append(0)
+            elif isinstance(v, str):
+                out.append(len(v))
+            else:
+                out.append(sum(len(s) for s in v))
+        return Column.from_values(T.Integral, out)
+
+
+class JaccardSimilarity(HostTransformer):
+    """(set, set) → RealNN |∩|/|∪| (`JaccardSimilarity.scala`; both empty → 1)."""
+
+    in_types = (T.OPSet, T.OPSet)
+    out_type = T.RealNN
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = []
+        for a, b in zip(cols[0].data, cols[1].data):
+            sa = set(a) if a else set()
+            sb = set(b) if b else set()
+            union = sa | sb
+            out.append(1.0 if not union else len(sa & sb) / len(union))
+        return Column.from_values(T.RealNN, out)
+
+
+def _ngrams(s: str, n: int) -> set:
+    s = f" {s} "
+    if len(s) < n:
+        return {s}
+    return {s[i:i + n] for i in range(len(s) - n + 1)}
+
+
+class NGramSimilarity(HostTransformer):
+    """(text, text) → RealNN character n-gram Jaccard similarity, the
+    behavioral analogue of Lucene's NGramDistance used by
+    `NGramSimilarity.scala` (0 when either side is empty)."""
+
+    in_types = (T.Text, T.Text)
+    out_type = T.RealNN
+
+    def __init__(self, n: int = 3, uid: Optional[str] = None):
+        super().__init__(uid=uid, n=n)
+        self.n = int(n)
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = []
+        for a, b in zip(cols[0].data, cols[1].data):
+            if not a or not b:
+                out.append(0.0)
+                continue
+            ga, gb = _ngrams(a.lower(), self.n), _ngrams(b.lower(), self.n)
+            union = ga | gb
+            out.append(len(ga & gb) / len(union) if union else 0.0)
+        return Column.from_values(T.RealNN, out)

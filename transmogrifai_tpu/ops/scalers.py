@@ -1,0 +1,210 @@
+"""Scaling / imputation / calibration stages for scalar features.
+
+Reference parity:
+- `core/.../feature/OpScalarStandardScaler.scala` — z-normalization of a
+  single numeric feature (Spark StandardScaler on a 1-d vector there; a
+  masked mean/std reduction here).
+- `core/.../feature/ScalerTransformer.scala` / `DescalerTransformer.scala`
+  + `features/.../impl/feature/ScalingArgs.scala` — invertible scaling whose
+  args travel with the stage so a descaler can undo it (the reference stores
+  them in column metadata).
+- `core/.../feature/FillMissingWithMean.scala` — Real → RealNN mean impute.
+- `core/.../feature/PercentileCalibrator.scala` — maps a score to its
+  percentile bucket [0, 99] via fitted quantiles (Spark QuantileDiscretizer
+  there; a device-side searchsorted here).
+
+TPU-first: fits are masked reductions over the sharded batch; transforms are
+pure jnp maps that fuse into the downstream scoring program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
+
+
+def _masked_mean_std(value: np.ndarray, mask: np.ndarray):
+    m = mask.astype(bool)
+    n = max(int(m.sum()), 1)
+    mean = float(np.where(m, value, 0.0).sum() / n)
+    var = float((np.where(m, value - mean, 0.0) ** 2).sum() / n)
+    return mean, float(np.sqrt(var))
+
+
+class StandardScalerModel(Transformer):
+    in_types = (T.OPNumeric,)
+    out_type = T.RealNN
+
+    def __init__(self, mean: float, std: float, with_mean: bool = True,
+                 with_std: bool = True, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.mean, self.std = float(mean), float(std)
+        self.with_mean, self.with_std = with_mean, with_std
+
+    def device_apply(self, enc, dev):
+        x, m = dev[0]["value"], dev[0]["mask"].astype(bool)
+        v = jnp.where(m, x, self.mean)
+        if self.with_mean:
+            v = v - self.mean
+        if self.with_std:
+            v = v / (self.std if self.std > 0 else 1.0)
+        return {"value": v, "mask": jnp.ones_like(m, dtype=bool)}
+
+    def get_params(self):
+        return {"mean": self.mean, "std": self.std,
+                "with_mean": self.with_mean, "with_std": self.with_std}
+
+
+class OpScalarStandardScaler(Estimator):
+    """z-normalize one numeric feature (missing imputed with the mean)."""
+
+    in_types = (T.OPNumeric,)
+    out_type = T.RealNN
+
+    def __init__(self, with_mean: bool = True, with_std: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, with_mean=with_mean, with_std=with_std)
+        self.with_mean, self.with_std = with_mean, with_std
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        mean, std = _masked_mean_std(
+            np.asarray(cols[0].data["value"], dtype=np.float64),
+            np.asarray(cols[0].data["mask"]))
+        return StandardScalerModel(mean, std, self.with_mean, self.with_std)
+
+
+class FillMissingWithMeanModel(Transformer):
+    in_types = (T.OPNumeric,)
+    out_type = T.RealNN
+
+    def __init__(self, fill: float, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.fill = float(fill)
+
+    def device_apply(self, enc, dev):
+        x, m = dev[0]["value"], dev[0]["mask"].astype(bool)
+        return {"value": jnp.where(m, x, self.fill),
+                "mask": jnp.ones_like(m, dtype=bool)}
+
+    def get_params(self):
+        return {"fill": self.fill}
+
+
+class FillMissingWithMean(Estimator):
+    """Real → RealNN: impute missing with the training mean (or `default`
+    when the whole column is missing)."""
+
+    in_types = (T.OPNumeric,)
+    out_type = T.RealNN
+
+    def __init__(self, default: float = 0.0, uid: Optional[str] = None):
+        super().__init__(uid=uid, default=default)
+        self.default = float(default)
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        v = np.asarray(cols[0].data["value"], dtype=np.float64)
+        m = np.asarray(cols[0].data["mask"]).astype(bool)
+        fill = float(v[m].mean()) if m.any() else self.default
+        return FillMissingWithMeanModel(fill)
+
+
+class ScalerTransformer(Transformer):
+    """Invertible scaling of a Real feature: 'linear' (slope, intercept) or
+    'log'. The args are stage params, so `DescalerTransformer` can invert by
+    walking the parent feature's origin stage."""
+
+    in_types = (T.Real,)
+    out_type = T.Real
+
+    def __init__(self, scaling_type: str = "linear", slope: float = 1.0,
+                 intercept: float = 0.0, uid: Optional[str] = None):
+        if scaling_type not in ("linear", "log"):
+            raise ValueError(f"unknown scaling_type {scaling_type!r}")
+        super().__init__(uid=uid, scaling_type=scaling_type, slope=slope,
+                         intercept=intercept)
+        self.scaling_type = scaling_type
+        self.slope, self.intercept = float(slope), float(intercept)
+
+    def device_apply(self, enc, dev):
+        x, m = dev[0]["value"], dev[0]["mask"].astype(bool)
+        if self.scaling_type == "linear":
+            v = self.slope * x + self.intercept
+        else:
+            v = jnp.log(jnp.where(x > 0, x, jnp.nan))
+            m = m & jnp.isfinite(v)
+            v = jnp.where(m, v, 0.0)
+        return {"value": v, "mask": m}
+
+    def invert(self, value, mask):
+        if self.scaling_type == "linear":
+            slope = self.slope if self.slope != 0 else 1.0
+            return (value - self.intercept) / slope, mask
+        return jnp.exp(value), mask
+
+
+class DescalerTransformer(Transformer):
+    """(scaled value, scaled feature) → Real: applies the inverse of the
+    ScalerTransformer that produced input 2 to input 1 (the reference reads
+    the scaler args from metadata — `DescalerTransformer.scala`)."""
+
+    in_types = (T.Real, T.Real)
+    out_type = T.Real
+
+    def _scaler(self) -> ScalerTransformer:
+        origin = self.input_features[1].origin_stage
+        if not isinstance(origin, ScalerTransformer):
+            raise TypeError(
+                "DescalerTransformer input 2 must be produced by a "
+                f"ScalerTransformer; got {type(origin).__name__}")
+        return origin
+
+    def device_apply(self, enc, dev):
+        x, m = dev[0]["value"], dev[0]["mask"].astype(bool)
+        v, m = self._scaler().invert(x, m)
+        return {"value": v, "mask": m}
+
+
+class PercentileCalibratorModel(Transformer):
+    in_types = (T.OPNumeric,)
+    out_type = T.RealNN
+
+    def __init__(self, quantiles: Sequence[float], uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.quantiles = np.asarray(quantiles, dtype=np.float64)
+
+    def device_apply(self, enc, dev):
+        x, m = dev[0]["value"], dev[0]["mask"].astype(bool)
+        q = jnp.asarray(self.quantiles)
+        buckets = jnp.searchsorted(q, x, side="right").astype(jnp.float32)
+        hi = float(len(self.quantiles))
+        v = jnp.clip(buckets * (99.0 / max(hi, 1.0)), 0.0, 99.0)
+        return {"value": jnp.where(m, jnp.round(v), 0.0), "mask": m}
+
+    def get_params(self):
+        return {"quantiles": self.quantiles.tolist()}
+
+
+class PercentileCalibrator(Estimator):
+    """RealNN score → percentile bucket in [0, 99] via fitted quantiles."""
+
+    in_types = (T.OPNumeric,)
+    out_type = T.RealNN
+
+    def __init__(self, buckets: int = 100, uid: Optional[str] = None):
+        super().__init__(uid=uid, buckets=buckets)
+        self.buckets = int(buckets)
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        v = np.asarray(cols[0].data["value"], dtype=np.float64)
+        m = np.asarray(cols[0].data["mask"]).astype(bool)
+        vals = v[m]
+        if vals.size == 0:
+            return PercentileCalibratorModel([0.0])
+        qs = np.quantile(vals, np.linspace(0, 1, self.buckets + 1)[1:-1])
+        return PercentileCalibratorModel(np.unique(qs))
