@@ -1,0 +1,273 @@
+"""Aggregators + readers: monoid semantics, cutoffs, joins, streaming.
+
+Mirrors reference specs: FeatureAggregatorTest / DataReadersTest /
+JoinedDataReaderDataGenerationTest (readers/src/test).
+"""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.aggregators import (
+    CutOffTime, Event, aggregate_events, concat_agg, default_aggregator,
+    first_agg, last_agg, mean_agg, mode_agg, union_map_agg, sum_agg)
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.readers import DataReaders, JoinedDataReader
+from transmogrifai_tpu.workflow import Workflow
+
+
+def ev(value, t=0):
+    return Event(t, value)
+
+
+class TestAggregators:
+    def test_sum_real(self):
+        agg = default_aggregator(T.Real)
+        assert agg([ev(1.5), ev(2.5), ev(None)]) == 4.0
+        assert agg([]) is None
+
+    def test_sum_integral_stays_int(self):
+        agg = default_aggregator(T.Integral)
+        out = agg([ev(2), ev(3)])
+        assert out == 5 and isinstance(out, int)
+
+    def test_percent_mean(self):
+        assert default_aggregator(T.Percent)([ev(0.2), ev(0.6)]) == pytest.approx(0.4)
+
+    def test_binary_or(self):
+        agg = default_aggregator(T.Binary)
+        assert agg([ev(False), ev(True)]) is True
+        assert agg([ev(False), ev(False)]) is False
+
+    def test_date_max(self):
+        assert default_aggregator(T.Date)([ev(100), ev(500), ev(300)]) == 500
+
+    def test_text_concat(self):
+        assert default_aggregator(T.Text)([ev("a"), ev(None), ev("b")]) == "a b"
+
+    def test_picklist_mode_tie_breaks_lexicographic(self):
+        agg = default_aggregator(T.PickList)
+        assert agg([ev("b"), ev("a"), ev("b")]) == "b"
+        assert agg([ev("b"), ev("a")]) == "a"
+
+    def test_multipicklist_union(self):
+        assert default_aggregator(T.MultiPickList)(
+            [ev({"x", "y"}), ev({"y", "z"})]) == {"x", "y", "z"}
+
+    def test_textlist_concat(self):
+        assert default_aggregator(T.TextList)(
+            [ev(["a"]), ev(["b", "c"])]) == ["a", "b", "c"]
+
+    def test_real_map_union_sums(self):
+        agg = default_aggregator(T.RealMap)
+        assert agg([ev({"a": 1.0, "b": 2.0}), ev({"b": 3.0, "c": 4.0})]) == \
+            {"a": 1.0, "b": 5.0, "c": 4.0}
+
+    def test_text_map_union_concat(self):
+        agg = default_aggregator(T.TextMap)
+        assert agg([ev({"a": "x"}), ev({"a": "y", "b": "z"})]) == \
+            {"a": "x y", "b": "z"}
+
+    def test_geolocation_midpoint(self):
+        agg = default_aggregator(T.Geolocation)
+        out = agg([ev([0.0, 0.0, 1.0]), ev([0.0, 90.0, 5.0])])
+        assert out[0] == pytest.approx(0.0, abs=1e-6)
+        assert out[1] == pytest.approx(45.0, abs=1e-6)
+        assert out[2] == 5.0  # accuracy keeps the max, not the sum
+
+    def test_first_last(self):
+        evs = [Event(5, "late"), Event(1, "early"), Event(3, "mid")]
+        assert first_agg()(evs) == "early"
+        assert last_agg()(evs) == "late"
+
+    def test_cutoff_split(self):
+        evs = [Event(t, float(t)) for t in range(10)]
+        cut = CutOffTime.unix_epoch(5)
+        pred = aggregate_events(evs, T.Real, cutoff=cut, is_response=False)
+        resp = aggregate_events(evs, T.Real, cutoff=cut, is_response=True)
+        assert pred == sum(range(5))      # strictly before cutoff
+        assert resp == sum(range(5, 10))  # at/after cutoff
+
+    def test_cutoff_window(self):
+        evs = [Event(t, 1.0) for t in range(10)]
+        cut = CutOffTime.unix_epoch(8)
+        out = aggregate_events(evs, T.Real, cutoff=cut, window_ms=3)
+        assert out == 3.0  # times 5,6,7 only
+
+    def test_days_ago(self):
+        now = 1_000 * 86_400_000
+        c = CutOffTime.days_ago(10, now)
+        assert c.timestamp == 990 * 86_400_000
+
+
+EVENTS = [
+    # key, time(day), amount, tag, converted
+    {"id": "a", "day": 1, "amount": 10.0, "tag": "x", "converted": 0},
+    {"id": "a", "day": 2, "amount": 5.0, "tag": "y", "converted": 0},
+    {"id": "a", "day": 8, "amount": 99.0, "tag": "z", "converted": 1},
+    {"id": "b", "day": 3, "amount": 2.0, "tag": "x", "converted": 0},
+    {"id": "b", "day": 9, "amount": 50.0, "tag": "x", "converted": 0},
+]
+
+
+def _raw_features():
+    amount = FeatureBuilder.Real("amount").from_column("amount").as_predictor()
+    tag = FeatureBuilder.PickList("tag").from_column("tag").as_predictor()
+    label = FeatureBuilder.Binary("converted").from_column("converted").as_response()
+    return amount, tag, label
+
+
+class TestAggregateReader:
+    def test_cutoff_semantics(self):
+        amount, tag, label = _raw_features()
+        reader = DataReaders.aggregate(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
+            cutoff=CutOffTime.unix_epoch(5))
+        ds = reader.read([amount, tag, label])
+        assert ds.pre_extracted
+        rows = {r["key"]: r for r in ds.to_rows()}
+        # 'a': predictors fold days 1,2; response folds day 8
+        assert rows["a"]["amount"] == 15.0
+        assert rows["a"]["converted"] == 1.0
+        # 'b': predictor day 3; response day 9 (converted=0 → False)
+        assert rows["b"]["amount"] == 2.0
+        assert rows["b"]["converted"] == 0.0
+
+    def test_workflow_integration(self):
+        amount, tag, label = _raw_features()
+        reader = DataReaders.aggregate(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
+            cutoff=CutOffTime.unix_epoch(5))
+        from transmogrifai_tpu.ops.numeric import RealVectorizer
+        vec = RealVectorizer().set_input(amount).get_output()
+        model = Workflow().set_result_features(vec).set_reader(reader).train()
+        assert model is not None
+
+    def test_custom_aggregator_via_builder(self):
+        amount = FeatureBuilder.Real("amount").from_column("amount") \
+            .aggregate(mean_agg()).as_predictor()
+        reader = DataReaders.aggregate(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"])
+        ds = reader.read([amount])
+        rows = {r["key"]: r for r in ds.to_rows()}
+        assert rows["a"]["amount"] == pytest.approx((10 + 5 + 99) / 3)
+
+
+class TestConditionalReader:
+    def test_condition_sets_per_key_cutoff(self):
+        amount, tag, label = _raw_features()
+        reader = DataReaders.conditional(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
+            target_condition=lambda r: r["converted"] == 1)
+        ds = reader.read([amount, tag, label])
+        rows = {r["key"]: r for r in ds.to_rows()}
+        # only 'a' has a converting event (day 8): predictors fold days < 8
+        assert set(rows) == {"a"}
+        assert rows["a"]["amount"] == 15.0
+        assert rows["a"]["converted"] == 1.0
+
+    def test_keep_unmatched(self):
+        amount, _, label = _raw_features()
+        reader = DataReaders.conditional(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
+            target_condition=lambda r: r["converted"] == 1,
+            drop_if_not_met=False)
+        ds = reader.read([amount, label])
+        rows = {r["key"]: r for r in ds.to_rows()}
+        # unmatched key: all events are predictors, the response stays empty
+        assert rows["b"]["amount"] == 52.0
+        assert rows["b"]["converted"] is None
+
+
+class TestJoinedReader:
+    def test_left_join(self):
+        left = DataReaders.simple(
+            records=[{"id": "a", "age": 30}, {"id": "b", "age": 40}],
+            key_fn=lambda r: r["id"])
+        right = DataReaders.simple(
+            records=[{"id": "a", "city": "sf"}],
+            key_fn=lambda r: r["id"])
+        ds = left.left_outer_join(right).read([])
+        rows = {r["key"]: r for r in ds.to_rows()}
+        assert rows["a"]["city"] == "sf"
+        assert rows["b"]["city"] is None
+
+    def test_inner_join(self):
+        left = DataReaders.simple(
+            records=[{"id": "a", "age": 30}, {"id": "b", "age": 40}],
+            key_fn=lambda r: r["id"])
+        right = DataReaders.simple(
+            records=[{"id": "a", "city": "sf"}], key_fn=lambda r: r["id"])
+        ds = left.inner_join(right).read([])
+        assert {r["key"] for r in ds.to_rows()} == {"a"}
+
+    def test_secondary_aggregation(self):
+        left = DataReaders.simple(
+            records=[{"id": "a", "age": 30}], key_fn=lambda r: r["id"])
+        right = DataReaders.simple(
+            records=[{"id": "a", "spend": 1.0}, {"id": "a", "spend": 2.0}],
+            key_fn=lambda r: r["id"],
+            schema={"spend": T.Real})
+        joined = left.left_outer_join(right).with_secondary_aggregation()
+        ds = joined.read([])
+        rows = ds.to_rows()
+        assert len(rows) == 1
+        assert rows[0]["spend"] == 3.0  # Real default monoid = sum
+
+    def test_outer_join_expands_right_only_children(self):
+        left = DataReaders.simple(
+            records=[{"id": "a", "age": 30}], key_fn=lambda r: r["id"])
+        right = DataReaders.simple(
+            records=[{"id": "z", "spend": 1.0}, {"id": "z", "spend": 2.0},
+                     {"id": "z", "spend": 3.0}],
+            key_fn=lambda r: r["id"])
+        ds = left.outer_join(right).read([])
+        zrows = [r for r in ds.to_rows() if r["key"] == "z"]
+        assert sorted(r["spend"] for r in zrows) == [1.0, 2.0, 3.0]
+
+    def test_join_of_two_aggregating_readers_keeps_ownership(self):
+        # each aggregating side owns its feature set; the other side must not
+        # shadow it with empty columns
+        visits = [{"id": "a", "day": 1, "visits": 1.0},
+                  {"id": "a", "day": 2, "visits": 1.0}]
+        purchases = [{"id": "a", "day": 1, "spend": 5.0},
+                     {"id": "a", "day": 3, "spend": 7.0}]
+        nvisits = FeatureBuilder.Real("visits").from_column("visits").as_predictor()
+        spend = FeatureBuilder.Real("spend").from_column("spend").as_predictor()
+        left = DataReaders.aggregate(
+            visits, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
+            features=[nvisits])
+        right = DataReaders.aggregate(
+            purchases, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
+            features=[spend])
+        ds = left.left_outer_join(right).read([nvisits, spend])
+        row = ds.to_rows()[0]
+        assert row["visits"] == 2.0
+        assert row["spend"] == 12.0
+        assert ds.pre_extracted == {"visits", "spend"}
+
+    def test_duplicate_rows_without_secondary(self):
+        left = DataReaders.simple(
+            records=[{"id": "a", "age": 30}], key_fn=lambda r: r["id"])
+        right = DataReaders.simple(
+            records=[{"id": "a", "spend": 1.0}, {"id": "a", "spend": 2.0}],
+            key_fn=lambda r: r["id"])
+        ds = left.left_outer_join(right).read([])
+        assert len(ds.to_rows()) == 2  # one row per child match
+
+
+class TestStreamingReader:
+    def test_micro_batches(self):
+        records = [{"x": float(i)} for i in range(10)]
+        reader = DataReaders.stream(records=records, batch_size=4)
+        batches = list(reader.stream())
+        assert [len(b) for b in batches] == [4, 4, 2]
+        assert reader.read().n_rows == 10
+
+    def test_csv_stream(self, tmp_path):
+        p = tmp_path / "s.csv"
+        p.write_text("x,y\n1,2\n3,4\n5,6\n")
+        reader = DataReaders.stream(csv_path=str(p), batch_size=2)
+        batches = list(reader.stream())
+        assert [len(b) for b in batches] == [2, 1]

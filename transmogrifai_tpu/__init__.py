@@ -21,6 +21,8 @@ Quickstart (mirrors reference README.md:31-61):
 """
 
 from transmogrifai_tpu.utils.uid import UID
+from transmogrifai_tpu.aggregators import CutOffTime, Event
+from transmogrifai_tpu.readers import DataReaders
 from transmogrifai_tpu.types import *  # noqa: F401,F403 — the feature type lattice
 from transmogrifai_tpu import dsl  # noqa: F401 — attaches rich methods to Feature
 

@@ -1,0 +1,18 @@
+"""Data readers: ingestion + event-time aggregation (readers/ module)."""
+
+from transmogrifai_tpu.readers.readers import (
+    AggregateDataReader,
+    ConditionalDataReader,
+    CSVReader,
+    DataReaders,
+    JoinedDataReader,
+    Reader,
+    SimpleReader,
+    StreamingReader,
+)
+
+__all__ = [
+    "AggregateDataReader", "ConditionalDataReader", "CSVReader",
+    "DataReaders", "JoinedDataReader", "Reader", "SimpleReader",
+    "StreamingReader",
+]

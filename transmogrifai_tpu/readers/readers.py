@@ -1,0 +1,396 @@
+"""Readers: record ingestion, key-grouped event aggregation, joins, streaming.
+
+Reference parity: `readers/src/main/scala/com/salesforce/op/readers/` —
+`Reader.generateDataFrame` (Reader.scala:96-168, DataReader.scala:174-259),
+`DataReaders.Simple/Aggregate/Conditional` factories (DataReaders.scala:44-290),
+`AggregateDataReader`/`ConditionalDataReader` cutoff semantics
+(DataReader.scala:216-367), `JoinedDataReader` (JoinedDataReader.scala:119-356),
+`StreamingReader` (StreamingReader.scala:54).
+
+TPU-first: a reader's product is a host-side columnar `Dataset` (the device
+sees only dense batches later). Aggregating readers fold unbounded per-key
+event streams through monoid aggregators (transmogrifai_tpu.aggregators) so
+row width is constant regardless of history length — the reference's Spark
+groupBy+fold becomes a host dict-group + monoid fold.
+
+Aggregating readers emit *pre-extracted* datasets: columns are final typed
+feature values keyed by feature name (FeatureGeneratorStage.materialize
+reads them directly instead of re-running extract functions).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.aggregators import (
+    CutOffTime, Event, MonoidAggregator, aggregate_events, default_aggregator)
+from transmogrifai_tpu.data.dataset import Dataset
+
+KEY_COLUMN = "key"  # reference: DataFrameFieldNames.KeyFieldName
+
+
+def _record_value(stage, record: Mapping[str, Any]) -> Any:
+    """Extract one raw value from a record via the feature's generator stage
+    (extract fn or named column) — DataReader.scala:174-213."""
+    if stage.extract is not None:
+        return stage.extract(record)
+    return record.get(stage.column)
+
+
+def _mark_pre_extracted(ds: Dataset, names) -> Dataset:
+    # per-column marking read by FeatureGeneratorStage.materialize — a
+    # dataset-global flag would wrongly bypass extract/null_fill for columns
+    # contributed by a non-aggregating side of a join
+    ds.pre_extracted = set(names)
+    return ds
+
+
+def _own_features(reader, raw_features: Sequence) -> List:
+    """Restrict to the raw features this reader produces (its `features`
+    allowlist when given — the analogue of each reader in a join owning its
+    own feature set, JoinedDataReader.scala:119-180)."""
+    allow = getattr(reader, "features", None)
+    if allow is None:
+        return list(raw_features)
+    names = {f.name if hasattr(f, "name") else str(f) for f in allow}
+    return [f for f in raw_features if f.name in names]
+
+
+class Reader:
+    """Base reader: `read(raw_features) -> Dataset`."""
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        raise NotImplementedError
+
+    # -- composition (Reader.scala `innerJoin/leftOuterJoin/outerJoin`) --- #
+
+    def inner_join(self, other: "Reader") -> "JoinedDataReader":
+        return JoinedDataReader(self, other, how="inner")
+
+    def left_outer_join(self, other: "Reader") -> "JoinedDataReader":
+        return JoinedDataReader(self, other, how="left")
+
+    def outer_join(self, other: "Reader") -> "JoinedDataReader":
+        return JoinedDataReader(self, other, how="outer")
+
+
+class SimpleReader(Reader):
+    """Non-aggregating reader over records or a prebuilt Dataset
+    (DataReaders.Simple — one row per record, raw features extracted
+    lazily by the workflow's generator stages)."""
+
+    def __init__(self, records: Optional[Sequence[Mapping[str, Any]]] = None,
+                 dataset: Optional[Dataset] = None,
+                 key_fn: Optional[Callable[[Mapping[str, Any]], str]] = None,
+                 schema: Optional[Mapping[str, type]] = None):
+        if (records is None) == (dataset is None):
+            raise ValueError("SimpleReader: pass exactly one of records/dataset")
+        self.records = records
+        self.dataset = dataset
+        self.key_fn = key_fn
+        self.schema = schema
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        if self.dataset is not None:
+            ds = self.dataset
+        else:
+            ds = Dataset.from_rows(list(self.records), schema=self.schema)
+        if self.key_fn is not None and KEY_COLUMN not in ds.columns:
+            rows = self.records if self.records is not None else ds.to_rows()
+            keys = np.array([str(self.key_fn(r)) for r in rows], dtype=object)
+            ds = ds.with_column(KEY_COLUMN, keys, T.ID)
+        return ds
+
+
+class CSVReader(SimpleReader):
+    """CSV-file reader (CSVAutoReaders/CSVReaders analogue): schema inferred
+    unless given."""
+
+    def __init__(self, path: str, schema: Optional[Mapping[str, type]] = None,
+                 key_column: Optional[str] = None, delimiter: str = ","):
+        self.path = path
+        self._schema = schema
+        self.key_column = key_column
+        self.delimiter = delimiter
+        self.key_fn = None
+        self.dataset = None
+        self.records = None
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        ds = Dataset.from_csv(self.path, schema=self._schema,
+                              delimiter=self.delimiter)
+        if self.key_column and self.key_column in ds.columns \
+                and KEY_COLUMN not in ds.columns:
+            keys = np.array([str(v) for v in ds.column(self.key_column)],
+                            dtype=object)
+            ds = ds.with_column(KEY_COLUMN, keys, T.ID)
+        return ds
+
+
+def _group_events(records: Iterable[Mapping[str, Any]],
+                  key_fn: Callable, time_fn: Callable
+                  ) -> Dict[str, List[Any]]:
+    groups: Dict[str, List[Any]] = {}
+    for rec in records:
+        groups.setdefault(str(key_fn(rec)), []).append(
+            (int(time_fn(rec)), rec))
+    return groups
+
+
+def _aggregate_groups(groups: Dict[str, List[Any]], raw_features: Sequence,
+                      cutoffs: Mapping[str, Optional[CutOffTime]]) -> Dataset:
+    """Fold each key's event list through every raw feature's aggregator
+    (DataReader.scala:229-330: groupBy key → monoid fold per feature)."""
+    rows: List[Dict[str, Any]] = []
+    schema: Dict[str, type] = {KEY_COLUMN: T.ID}
+    for f in raw_features:
+        schema[f.name] = f.ftype
+    for key in groups:
+        events_rec = groups[key]
+        row: Dict[str, Any] = {KEY_COLUMN: key}
+        for f in raw_features:
+            stage = f.origin_stage
+            agg: Optional[MonoidAggregator] = stage.params.get("aggregator")
+            window = stage.params.get("aggregate_window")
+            events = [Event(t, _record_value(stage, rec))
+                      for t, rec in events_rec]
+            row[f.name] = aggregate_events(
+                events, f.ftype, aggregator=agg, cutoff=cutoffs.get(key),
+                is_response=f.is_response, window_ms=window)
+        rows.append(row)
+    return _mark_pre_extracted(Dataset.from_rows(rows, schema=schema),
+                               [f.name for f in raw_features])
+
+
+class AggregateDataReader(Reader):
+    """Event-time aggregating reader (DataReaders.Aggregate,
+    DataReader.scala:216-300): group records by key, fold each feature's
+    events through its monoid with a global `CutOffTime` — predictors see
+    pre-cutoff events, responses post-cutoff."""
+
+    def __init__(self, records: Sequence[Mapping[str, Any]],
+                 key_fn: Callable[[Mapping[str, Any]], str],
+                 time_fn: Callable[[Mapping[str, Any]], int],
+                 cutoff: Optional[CutOffTime] = None,
+                 features: Optional[Sequence] = None):
+        self.records = records
+        self.key_fn = key_fn
+        self.time_fn = time_fn
+        self.cutoff = cutoff or CutOffTime.no_cutoff()
+        self.features = features  # allowlist when joined with other readers
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        raw_features = _own_features(self, raw_features or [])
+        if not raw_features:
+            raise ValueError(
+                "AggregateDataReader needs the workflow's raw features to "
+                "aggregate (call through Workflow, or pass raw_features)")
+        groups = _group_events(self.records, self.key_fn, self.time_fn)
+        cutoffs = {k: self.cutoff for k in groups}
+        return _aggregate_groups(groups, raw_features, cutoffs)
+
+
+class ConditionalDataReader(Reader):
+    """Per-key dynamic cutoff (DataReaders.Conditional,
+    DataReader.scala:303-367): the cutoff for each key is the time of its
+    earliest record satisfying `target_condition` — "simulate the state at
+    the moment event X happened". Keys with no matching record are dropped
+    when `drop_if_not_met` (else they keep all events as predictors)."""
+
+    def __init__(self, records: Sequence[Mapping[str, Any]],
+                 key_fn: Callable[[Mapping[str, Any]], str],
+                 time_fn: Callable[[Mapping[str, Any]], int],
+                 target_condition: Callable[[Mapping[str, Any]], bool],
+                 drop_if_not_met: bool = True,
+                 features: Optional[Sequence] = None):
+        self.records = records
+        self.key_fn = key_fn
+        self.time_fn = time_fn
+        self.target_condition = target_condition
+        self.drop_if_not_met = drop_if_not_met
+        self.features = features
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        raw_features = _own_features(self, raw_features or [])
+        if not raw_features:
+            raise ValueError("ConditionalDataReader needs raw features")
+        groups = _group_events(self.records, self.key_fn, self.time_fn)
+        cutoffs: Dict[str, Optional[CutOffTime]] = {}
+        for key, evs in list(groups.items()):
+            match = [t for t, rec in evs if self.target_condition(rec)]
+            if match:
+                cutoffs[key] = CutOffTime.unix_epoch(min(match))
+            elif self.drop_if_not_met:
+                del groups[key]
+            else:
+                # unmatched keys: all events are predictors, responses stay
+                # empty (an infinite-future cutoff — nothing is ever at/after)
+                cutoffs[key] = CutOffTime.infinite_future()
+        return _aggregate_groups(groups, raw_features, cutoffs)
+
+
+class JoinedDataReader(Reader):
+    """Key-based join of two readers (JoinedDataReader.scala:119-356):
+    both sides are read (each producing a keyed Dataset), then joined on
+    `key`. `with_secondary_aggregation` folds duplicate right-side rows per
+    key through type-default monoids (the post-join aggregation stage)."""
+
+    def __init__(self, left: Reader, right: Reader, how: str = "left"):
+        if how not in ("inner", "left", "outer"):
+            raise ValueError(f"Unsupported join type {how!r}")
+        self.left = left
+        self.right = right
+        self.how = how
+        self._secondary: Optional[CutOffTime] = None
+
+    def with_secondary_aggregation(self, cutoff: Optional[CutOffTime] = None
+                                   ) -> "JoinedDataReader":
+        self._secondary = cutoff or CutOffTime.no_cutoff()
+        return self
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        raw_features = list(raw_features or [])
+        left_ds = self.left.read(raw_features)
+        right_ds = self.right.read(raw_features)
+        for side, ds in (("left", left_ds), ("right", right_ds)):
+            if KEY_COLUMN not in ds.columns:
+                raise ValueError(
+                    f"JoinedDataReader: {side} reader produced no "
+                    f"{KEY_COLUMN!r} column (give it a key_fn)")
+
+        lrows = left_ds.to_rows()
+        rrows = right_ds.to_rows()
+        rindex: Dict[str, List[Dict[str, Any]]] = {}
+        for r in rrows:
+            rindex.setdefault(str(r[KEY_COLUMN]), []).append(r)
+
+        schema: Dict[str, type] = dict(left_ds.schema)
+        for name, t in right_ds.schema.items():
+            schema.setdefault(name, t)
+        rcols = [c for c in right_ds.schema if c != KEY_COLUMN
+                 and c not in left_ds.schema]
+
+        ftypes = {f.name: f.ftype for f in raw_features}
+
+        def merge(l_row: Optional[Dict], r_group: List[Dict]) -> Dict[str, Any]:
+            row = dict(l_row) if l_row else {
+                KEY_COLUMN: r_group[0][KEY_COLUMN]}
+            if not r_group:
+                for c in rcols:
+                    row.setdefault(c, None)
+            elif len(r_group) == 1 or self._secondary is None:
+                for c in rcols:
+                    row[c] = r_group[0].get(c)
+            else:  # secondary aggregation of duplicate child rows
+                for c in rcols:
+                    ftype = ftypes.get(c) or right_ds.schema.get(c, T.Text)
+                    events = [Event(0, g.get(c)) for g in r_group]
+                    row[c] = default_aggregator(ftype)(events)
+            return row
+
+        out: List[Dict[str, Any]] = []
+        seen_keys = set()
+        for l_row in lrows:
+            k = str(l_row[KEY_COLUMN])
+            seen_keys.add(k)
+            group = rindex.get(k, [])
+            if group and self._secondary is None and len(group) > 1:
+                # no secondary aggregation: one output row per child match
+                for g in group:
+                    out.append(merge(l_row, [g]))
+            elif group:
+                out.append(merge(l_row, group))
+            elif self.how in ("left", "outer"):
+                out.append(merge(l_row, []))
+        if self.how == "outer":
+            for k, group in rindex.items():
+                if k in seen_keys:
+                    continue
+                if self._secondary is None and len(group) > 1:
+                    for g in group:  # same per-child expansion as left matches
+                        out.append(merge(None, [g]))
+                else:
+                    out.append(merge(None, group))
+        ds = Dataset.from_rows(out, schema=schema)
+        pre = set(getattr(left_ds, "pre_extracted", ()) or ()) | \
+            set(getattr(right_ds, "pre_extracted", ()) or ())
+        if pre:
+            _mark_pre_extracted(ds, pre & set(ds.columns))
+        return ds
+
+
+class StreamingReader(Reader):
+    """Micro-batch streaming source (StreamingReader.scala:54): yields
+    Datasets of up to `batch_size` records for the runner's streaming-score
+    loop. `read()` materializes everything (the batch path)."""
+
+    def __init__(self, records: Optional[Iterable[Mapping[str, Any]]] = None,
+                 csv_path: Optional[str] = None, batch_size: int = 1024,
+                 schema: Optional[Mapping[str, type]] = None):
+        if (records is None) == (csv_path is None):
+            raise ValueError("StreamingReader: pass exactly one of records/csv_path")
+        self.records = records
+        self.csv_path = csv_path
+        self.batch_size = int(batch_size)
+        self.schema = schema
+
+    def _record_iter(self) -> Iterator[Mapping[str, Any]]:
+        if self.records is not None:
+            yield from self.records
+            return
+        with open(self.csv_path, "r", newline="") as f:
+            for row in _csv.DictReader(f):
+                yield row
+
+    def stream(self) -> Iterator[Dataset]:
+        buf: List[Mapping[str, Any]] = []
+        for rec in self._record_iter():
+            buf.append(rec)
+            if len(buf) >= self.batch_size:
+                yield Dataset.from_rows(buf, schema=self.schema)
+                buf = []
+        if buf:
+            yield Dataset.from_rows(buf, schema=self.schema)
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        return Dataset.from_rows(list(self._record_iter()), schema=self.schema)
+
+
+class DataReaders:
+    """Factory namespace mirroring `DataReaders.Simple/Aggregate/Conditional`
+    (DataReaders.scala:44-290)."""
+
+    @staticmethod
+    def simple(records=None, dataset=None, key_fn=None, schema=None) -> SimpleReader:
+        return SimpleReader(records=records, dataset=dataset, key_fn=key_fn,
+                            schema=schema)
+
+    @staticmethod
+    def csv(path, schema=None, key_column=None, delimiter=",") -> CSVReader:
+        return CSVReader(path, schema=schema, key_column=key_column,
+                         delimiter=delimiter)
+
+    @staticmethod
+    def aggregate(records, key_fn, time_fn, cutoff=None,
+                  features=None) -> AggregateDataReader:
+        return AggregateDataReader(records, key_fn, time_fn, cutoff=cutoff,
+                                   features=features)
+
+    @staticmethod
+    def conditional(records, key_fn, time_fn, target_condition,
+                    drop_if_not_met=True,
+                    features=None) -> ConditionalDataReader:
+        return ConditionalDataReader(records, key_fn, time_fn,
+                                     target_condition,
+                                     drop_if_not_met=drop_if_not_met,
+                                     features=features)
+
+    @staticmethod
+    def stream(records=None, csv_path=None, batch_size=1024,
+               schema=None) -> StreamingReader:
+        return StreamingReader(records=records, csv_path=csv_path,
+                               batch_size=batch_size, schema=schema)

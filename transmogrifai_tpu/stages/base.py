@@ -256,6 +256,12 @@ class FeatureGeneratorStage(Stage):
         return self._output
 
     def materialize(self, dataset, allow_missing_response: bool = False) -> Column:
+        if self.feature_name in getattr(dataset, "pre_extracted", ()) and \
+                self.feature_name in dataset.columns:
+            # aggregating readers already folded events to final typed values
+            # keyed by feature name — bypass extract fns (readers/readers.py)
+            return Column.from_values(
+                self.ftype, dataset.column(self.feature_name))
         if self.extract is not None:
             values = [self.extract(row) for row in dataset.to_rows()]
             return Column.from_values(self.ftype, values)

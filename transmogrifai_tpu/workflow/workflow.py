@@ -53,10 +53,19 @@ class Workflow:
 
     # ------------------------------------------------------------------ #
 
+    def _raw_features(self) -> List:
+        seen: Dict[str, Any] = {}
+        for f in self.result_features:
+            for r in f.raw_features():
+                seen.setdefault(r.uid, r)
+        return list(seen.values())
+
     def _resolve_dataset(self, dataset: Optional[Dataset]) -> Dataset:
-        ds = dataset or self._dataset
+        ds = dataset if dataset is not None else self._dataset
         if ds is None and self._reader is not None:
-            ds = self._reader.read()
+            # aggregating readers fold per-key event streams through each raw
+            # feature's monoid (readers/readers.py; DataReader.scala:216-330)
+            ds = self._reader.read(self._raw_features())
         if ds is None:
             raise RuntimeError(
                 "No input data: call set_input_dataset / set_reader or pass "
