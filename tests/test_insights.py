@@ -1,0 +1,132 @@
+"""ModelInsights + RecordInsightsLOCO.
+
+Mirrors reference specs: ModelInsightsTest, RecordInsightsLOCOTest
+(core/src/test/.../insights/).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.automl import transmogrify
+from transmogrifai_tpu.automl.sanity_checker import SanityChecker
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.insights import (
+    ModelInsights, RecordInsightsLOCO, RecordInsightsParser)
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(11)
+    n = 400
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    noise = rng.normal(size=n)  # irrelevant feature
+    y = (2.0 * x1 + 0.2 * x2 + rng.normal(0, 0.3, n) > 0).astype(float)
+    cat = np.where(x1 > 0, "hi", "lo")
+    rows = [{"x1": float(x1[i]), "x2": float(x2[i]),
+             "noise": float(noise[i]), "cat": str(cat[i]),
+             "y": float(y[i])} for i in range(n)]
+    ds = Dataset.from_rows(rows, schema={
+        "x1": T.Real, "x2": T.Real, "noise": T.Real, "cat": T.PickList,
+        "y": T.RealNN})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = transmogrify(preds)
+    checked = SanityChecker().set_input(label, vec).get_output()
+    pred = OpLogisticRegression(max_iter=40).set_input(label, checked).get_output()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+    return model, ds, pred, checked
+
+
+class TestModelInsights:
+    def test_extract_structure(self, fitted):
+        model, ds, pred, checked = fitted
+        mi = model.model_insights()
+        assert mi.label_name == "y"
+        names = {f.name for f in mi.features}
+        assert {"x1", "x2", "cat"} <= names
+        # every derived slot has a contribution from the LR weights
+        x1f = next(f for f in mi.features if f.name == "x1")
+        assert any(d.contribution for d in x1f.derived)
+        # sanity checker stats merged in
+        assert mi.sanity_checker is not None
+        assert any(d.corr is not None for f in mi.features for d in f.derived)
+
+    def test_signal_feature_ranks_above_noise(self, fitted):
+        model, *_ = fitted
+        mi = model.model_insights()
+        byname = {f.name: f.importance for f in mi.features}
+        assert byname["x1"] > byname["noise"]
+
+    def test_json_roundtrip_and_pretty(self, fitted, tmp_path):
+        model, *_ = fitted
+        mi = model.model_insights()
+        p = tmp_path / "insights.json"
+        mi.write(str(p))
+        loaded = json.loads(p.read_text())
+        assert "features" in loaded and "label" in loaded
+        assert "x1" in mi.pretty()
+
+    def test_rff_reasons_included(self):
+        rng = np.random.default_rng(5)
+        n = 600
+        rows = [{"x": float(rng.normal()),
+                 "mostly_null": 1.0 if rng.uniform() < 0.0005 else None,
+                 "y": float(rng.integers(0, 2))} for i in range(n)]
+        ds = Dataset.from_rows(rows, schema={
+            "x": T.Real, "mostly_null": T.Real, "y": T.RealNN})
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = transmogrify(preds)
+        pred = OpLogisticRegression(max_iter=15).set_input(label, vec).get_output()
+        model = Workflow().set_result_features(pred, label) \
+            .set_input_dataset(ds).with_raw_feature_filter(min_fill=0.01).train()
+        mi = model.model_insights()
+        dropped = next(f for f in mi.features if f.name == "mostly_null")
+        assert dropped.rff_reasons
+
+
+class TestLOCO:
+    def test_loco_shape_and_ranking(self, fitted):
+        model, ds, pred, checked = fitted
+        # serve path: compute the checked vector for a scoring batch
+        cols = model.score(ds, keep_intermediate=True)
+        vec_col = cols[checked.uid]
+        pm = model.fitted[pred.origin_stage.uid]
+        loco = RecordInsightsLOCO(pm, top_k=3).set_input(checked)
+        out = loco.transform([vec_col])
+        assert out.ftype is T.TextMap
+        assert len(out.data) == len(ds)
+        row0 = out.data[0]
+        assert len(row0) == 3  # top_k groups
+        parsed = RecordInsightsParser.parse_row(row0)
+        for name, pairs in parsed.items():
+            for cls, diff in pairs:
+                assert isinstance(cls, int) and isinstance(diff, float)
+
+    def test_strong_feature_dominates(self, fitted):
+        model, ds, pred, checked = fitted
+        cols = model.score(ds, keep_intermediate=True)
+        vec_col = cols[checked.uid]
+        pm = model.fitted[pred.origin_stage.uid]
+        loco = RecordInsightsLOCO(pm, top_k=2).set_input(checked)
+        out = loco.transform([vec_col])
+        # x1 drives the label; it should appear in most rows' top-2
+        hits = sum(1 for row in out.data
+                   if any(k.startswith("x1") for k in row))
+        assert hits > len(out.data) * 0.7
+
+    def test_parse_column(self, fitted):
+        model, ds, pred, checked = fitted
+        cols = model.score(ds.take(np.arange(5)), keep_intermediate=True)
+        pm = model.fitted[pred.origin_stage.uid]
+        loco = RecordInsightsLOCO(pm, top_k=2).set_input(checked)
+        out = loco.transform([cols[checked.uid]])
+        parsed = RecordInsightsParser.parse_column(out)
+        assert len(parsed) == 5
+        assert all(isinstance(p, dict) for p in parsed)

@@ -1,0 +1,173 @@
+"""RawFeatureFilter: distributions, drop rules, blocklist rewiring.
+
+Mirrors reference specs: RawFeatureFilterTest / FeatureDistributionTest
+(core/src/test/.../filters/).
+"""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.automl.raw_feature_filter import (
+    FeatureDistribution, RawFeatureFilter, Summary)
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.features.dag import rewire_without
+from transmogrifai_tpu.automl import transmogrify
+from transmogrifai_tpu.workflow import Workflow
+
+
+def make_ds(n=1000, seed=0, x_fill=1.0, shift=0.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(shift, 1.0, size=n)
+    miss = rng.uniform(size=n) >= x_fill
+    x[miss] = np.nan
+    y = (rng.normal(size=n) > 0).astype(float)
+    cat = rng.choice(["a", "b", "c"], size=n)
+    return Dataset.from_rows(
+        [{"x": None if np.isnan(x[i]) else float(x[i]),
+          "cat": str(cat[i]), "y": float(y[i])} for i in range(n)],
+        schema={"x": T.Real, "cat": T.PickList, "y": T.RealNN})
+
+
+def features_of(ds):
+    return FeatureBuilder.from_dataset(ds, response="y")
+
+
+class TestFeatureDistribution:
+    def test_fill_rate_and_js(self):
+        a = FeatureDistribution("f", None, 100, 20, np.array([10, 10, 60]))
+        b = FeatureDistribution("f", None, 100, 80, np.array([60, 10, 10]))
+        assert a.fill_rate == pytest.approx(0.8)
+        assert a.relative_fill_rate(b) == pytest.approx(0.6)
+        assert a.relative_fill_ratio(b) == pytest.approx(4.0)
+        assert 0.0 < a.js_divergence(b) <= 1.0
+        assert a.js_divergence(a) == pytest.approx(0.0)
+
+    def test_summary(self):
+        s = Summary.of(np.array([1.0, 2.0, 3.0]))
+        assert (s.min, s.max, s.sum, s.count) == (1.0, 3.0, 6.0, 3.0)
+
+
+class TestDropRules:
+    def test_low_fill_dropped(self):
+        ds = make_ds(x_fill=0.0005)  # x almost never filled
+        preds, label = features_of(ds)
+        rff = RawFeatureFilter(min_fill=0.01)
+        out = rff.generate_filtered_raw(ds, preds + [label], label_feature=label)
+        assert "x" in out.features_to_drop
+        assert "cat" not in out.features_to_drop
+
+    def test_healthy_features_kept(self):
+        ds = make_ds()
+        preds, label = features_of(ds)
+        out = RawFeatureFilter().generate_filtered_raw(
+            ds, preds + [label], label_feature=label)
+        assert out.features_to_drop == []
+
+    def test_distribution_shift_dropped(self):
+        train = make_ds(seed=1)
+        score = make_ds(seed=2, shift=30.0)  # x wildly shifted
+        preds, label = features_of(train)
+        rff = RawFeatureFilter(max_js_divergence=0.5, min_scoring_rows=10)
+        out = rff.generate_filtered_raw(
+            train, preds + [label], score_dataset=score, label_feature=label)
+        assert "x" in out.features_to_drop
+        m = {(m.name, m.key): m for m in out.results.metrics}
+        assert m[("x", None)].js_divergence > 0.5
+
+    def test_fill_difference_dropped(self):
+        train = make_ds(seed=1, x_fill=1.0)
+        score = make_ds(seed=2, x_fill=0.02)
+        preds, label = features_of(train)
+        rff = RawFeatureFilter(max_fill_difference=0.5, min_scoring_rows=10)
+        out = rff.generate_filtered_raw(
+            train, preds + [label], score_dataset=score, label_feature=label)
+        assert "x" in out.features_to_drop
+
+    def test_small_scoring_set_skips_comparisons(self):
+        train = make_ds(seed=1)
+        score = make_ds(seed=2, shift=30.0, n=50)  # < min_scoring_rows
+        preds, label = features_of(train)
+        rff = RawFeatureFilter(max_js_divergence=0.1)
+        out = rff.generate_filtered_raw(
+            train, preds + [label], score_dataset=score, label_feature=label)
+        assert out.features_to_drop == []
+        assert out.results.config["scoring_set_used"] is False
+
+    def test_leakage_correlation_dropped(self):
+        # feature null-ness perfectly encodes the label → leakage
+        n = 600
+        rng = np.random.default_rng(3)
+        y = (rng.uniform(size=n) > 0.5).astype(float)
+        rows = [{"leaky": (1.0 if y[i] else None), "y": float(y[i]),
+                 "ok": float(rng.normal())} for i in range(n)]
+        ds = Dataset.from_rows(rows, schema={"leaky": T.Real, "ok": T.Real,
+                                             "y": T.RealNN})
+        preds, label = features_of(ds)
+        out = RawFeatureFilter(max_correlation=0.9).generate_filtered_raw(
+            ds, preds + [label], label_feature=label)
+        assert "leaky" in out.features_to_drop
+        assert "ok" not in out.features_to_drop
+
+    def test_protected_features_never_dropped(self):
+        ds = make_ds(x_fill=0.0005)
+        preds, label = features_of(ds)
+        rff = RawFeatureFilter(min_fill=0.01, protected_features=["x"])
+        out = rff.generate_filtered_raw(ds, preds + [label], label_feature=label)
+        assert out.features_to_drop == []
+
+    def test_map_key_dropping(self):
+        n = 600
+        rng = np.random.default_rng(4)
+        rows = []
+        for i in range(n):
+            m = {"good": float(rng.normal())}
+            if rng.uniform() < 0.001:  # 'bad' key almost never present
+                m["bad"] = 1.0
+            rows.append({"m": m, "y": float(i % 2)})
+        ds = Dataset.from_rows(rows, schema={"m": T.RealMap, "y": T.RealNN})
+        preds, label = features_of(ds)
+        out = RawFeatureFilter(min_fill=0.01).generate_filtered_raw(
+            ds, preds + [label], label_feature=label)
+        assert out.features_to_drop == []
+        assert out.map_keys_to_drop == {"m": ["bad"]}
+        # dropped key is nulled out of the cleaned dataset
+        cleaned = out.clean_dataset.column("m")
+        assert all("bad" not in v for v in cleaned if isinstance(v, dict))
+
+
+class TestBlocklistRewiring:
+    def test_variadic_stage_keeps_surviving_inputs(self):
+        ds = make_ds()
+        preds, label = features_of(ds)
+        vec = transmogrify(preds)
+        survived, dropped = rewire_without([vec, label], ["x"])
+        assert dropped == []
+        # the vectorizer DAG no longer references 'x'
+        raw_names = {r.name for f in survived for r in f.raw_features()}
+        assert "x" not in raw_names and "cat" in raw_names
+
+    def test_fixed_arity_cascade_drop(self):
+        ds = make_ds()
+        preds, label = features_of(ds)
+        x = next(f for f in preds if f.name == "x")
+        from transmogrifai_tpu.ops.numeric import RealVectorizer
+        only_x = RealVectorizer().set_input(x).get_output()
+        survived, dropped = rewire_without([only_x], ["x"])
+        assert survived == [] and dropped == [only_x.name]
+
+    def test_workflow_with_rff_trains(self):
+        ds = make_ds(x_fill=0.0005, n=800)
+        preds, label = features_of(ds)
+        vec = transmogrify(preds)
+        from transmogrifai_tpu.models import OpLogisticRegression
+        pred = OpLogisticRegression(max_iter=15).set_input(label, vec).get_output()
+        wf = Workflow().set_result_features(pred, label) \
+            .set_input_dataset(ds).with_raw_feature_filter(min_fill=0.01)
+        model = wf.train()
+        assert wf.blocklist == ["x"]
+        assert model.rff_results is not None
+        assert "x" in model.rff_results.dropped_features
+        scores = model.score(ds)
+        assert len(scores) == 2

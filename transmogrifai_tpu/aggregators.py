@@ -416,7 +416,9 @@ def aggregate_events(events: List[Event], ftype: type,
         hi = None if window_ms is None else ts + window_ms
         kept = [e for e in events if e.time >= ts and (hi is None or e.time < hi)]
     else:
-        lo = None if window_ms is None else ts - window_ms
+        # an infinite-future cutoff means "everything is a predictor" — a
+        # window anchored at infinity must not filter anything out
+        lo = None if (window_ms is None or math.isinf(ts)) else ts - window_ms
         kept = [e for e in events if e.time < ts and (lo is None or e.time >= lo)]
     out = agg(kept)
     if out is None and issubclass(ftype, T.NonNullable):

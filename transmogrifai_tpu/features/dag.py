@@ -61,6 +61,57 @@ def clone_graph(result_features: Sequence) -> List:
     return [clone_feature(f) for f in result_features]
 
 
+def rewire_without(result_features: Sequence, blocked_raw: Sequence[str]):
+    """Blocklist rewiring (OpWorkflow.setBlocklist, OpWorkflow.scala:118-167):
+    rebuild the DAG excluding the named raw features. Variadic stages keep
+    their surviving inputs; fixed-arity stages missing any input are dropped,
+    cascading downward. Returns (surviving_result_features, dropped_result_names).
+    """
+    from transmogrifai_tpu.features.feature import Feature
+
+    blocked = set(blocked_raw)
+    fmap: Dict[str, object] = {}
+    smap: Dict[str, Stage] = {}
+
+    def rebuild(f):
+        """Clone of `f` without blocked ancestors, or None if unproducible."""
+        if f.uid in fmap:
+            return fmap[f.uid]
+        stage = f.origin_stage
+        stage = getattr(stage, "_estimator", None) or stage
+        if isinstance(stage, FeatureGeneratorStage) or not f.parents:
+            nf = None if f.name in blocked else f
+            fmap[f.uid] = nf
+            return nf
+        parents = [rebuild(p) for p in f.parents]
+        kept = tuple(p for p in parents if p is not None)
+        spec = stage.in_types
+        variadic = spec is not None and len(spec) == 2 and spec[1] is Ellipsis
+        if (not kept) or (not variadic and len(kept) != len(f.parents)):
+            fmap[f.uid] = None  # a required input was blocked → drop stage
+            return None
+        cs = smap.get(stage.uid)
+        if cs is None:
+            cs = copy.copy(stage)
+            cs._output = None
+            cs.input_features = kept
+            smap[stage.uid] = cs
+        nf = Feature(name=f.name, ftype=f.ftype, origin_stage=cs,
+                     parents=kept, is_response=f.is_response, uid=f.uid)
+        cs._output = nf
+        fmap[f.uid] = nf
+        return nf
+
+    survived, dropped = [], []
+    for f in result_features:
+        nf = rebuild(f)
+        if nf is None:
+            dropped.append(f.name)
+        else:
+            survived.append(nf)
+    return survived, dropped
+
+
 def all_stages(result_features: Sequence) -> List[Stage]:
     """Every origin stage reachable from the result features (deduped)."""
     seen: Dict[str, Stage] = {}

@@ -1,0 +1,9 @@
+"""Model explanation artifacts: ModelInsights + per-record LOCO."""
+
+from transmogrifai_tpu.insights.model_insights import (
+    DerivedFeatureInsights, FeatureInsights, ModelInsights)
+from transmogrifai_tpu.insights.loco import (
+    RecordInsightsLOCO, RecordInsightsParser)
+
+__all__ = ["DerivedFeatureInsights", "FeatureInsights", "ModelInsights",
+           "RecordInsightsLOCO", "RecordInsightsParser"]

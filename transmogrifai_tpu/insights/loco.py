@@ -1,0 +1,123 @@
+"""RecordInsightsLOCO: per-record leave-one-column-out score deltas.
+
+Reference parity: `core/.../insights/RecordInsightsLOCO.scala:101-347` —
+for each scored row, ablate each logical feature (group of vector slots)
+and report the top-K score changes; hashed-text and date unit-circle slots
+are aggregated into one group (`aggregateDiffs:186`, top-K heap `:213-244`).
+Output format matches the reference: a TextMap of
+feature-group → JSON array of [class_index, score_diff] pairs, parseable by
+`RecordInsightsParser` (RecordInsightsParser.scala).
+
+TPU-first: the reference loops columns per row on the driver; here the
+whole ablation is ONE vmapped XLA program — predictions for all G group
+ablations of all n rows in a single (G, n, C) batch on device.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import VectorMetadata
+from transmogrifai_tpu.stages.base import FitContext, HostTransformer
+
+
+class RecordInsightsLOCO(HostTransformer):
+    """LOCO insights transformer over a fitted prediction model.
+
+    `RecordInsightsLOCO(fitted_model).set_input(feature_vector)` — input is
+    the same OPVector the model consumes; output is a TextMap feature.
+    """
+
+    in_types = (T.OPVector,)
+    out_type = T.TextMap
+
+    def __init__(self, model=None, top_k: int = 20, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.model = model
+        self.params["top_k"] = int(top_k)
+
+    # -- grouping ------------------------------------------------------- #
+
+    @staticmethod
+    def _groups(meta: Optional[VectorMetadata], d: int
+                ) -> Tuple[List[str], np.ndarray]:
+        """Group vector slots into logical features via column metadata
+        (hash/one-hot/date slots of one parent collapse together); masks is
+        (G, d) with 1s on the group's slots."""
+        if meta is None or meta.size != d:
+            names = [f"column_{j}" for j in range(d)]
+            return names, np.eye(d, dtype=np.float32)
+        order: List[str] = []
+        idx: Dict[str, List[int]] = {}
+        for j, cm in enumerate(meta.columns):
+            g = cm.grouping_key()
+            if g not in idx:
+                idx[g] = []
+                order.append(g)
+            idx[g].append(j)
+        masks = np.zeros((len(order), d), dtype=np.float32)
+        for gi, g in enumerate(order):
+            masks[gi, idx[g]] = 1.0
+        return order, masks
+
+    # -- compute -------------------------------------------------------- #
+
+    def _scores(self, X: jnp.ndarray) -> jnp.ndarray:
+        out = self.model.predict_arrays(X)
+        prob = out.get("probability")
+        if prob is not None and prob.ndim == 2 and prob.shape[1] > 0:
+            return prob
+        return out["prediction"][:, None]
+
+    def transform(self, cols: Sequence[Column], ctx: Optional[FitContext] = None) -> Column:
+        if self.model is None:
+            raise RuntimeError("RecordInsightsLOCO needs a fitted model")
+        vec = cols[0]
+        X = jnp.asarray(vec.device_value())
+        n, d = X.shape
+        names, masks_np = self._groups(vec.meta, d)
+        masks = jnp.asarray(masks_np)
+
+        base = self._scores(X)                                    # (n, C)
+        ablated = jax.vmap(lambda m: self._scores(X * (1.0 - m)))(masks)
+        diffs = base[None, :, :] - ablated                        # (G, n, C)
+        diffs_np = np.asarray(diffs)
+
+        top_k = min(self.params["top_k"], len(names))
+        strength = np.max(np.abs(diffs_np), axis=2)               # (G, n)
+        # per row: indices of the top-K strongest groups
+        top_idx = np.argsort(-strength, axis=0)[:top_k, :]        # (K, n)
+
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            row: Dict[str, str] = {}
+            for gi in top_idx[:, i]:
+                row[names[gi]] = json.dumps(
+                    [[c, round(float(diffs_np[gi, i, c]), 9)]
+                     for c in range(diffs_np.shape[2])])
+            out[i] = row
+        return Column(T.TextMap, out)
+
+    def get_params(self) -> Dict[str, Any]:
+        return {"top_k": self.params["top_k"]}
+
+
+class RecordInsightsParser:
+    """Parse LOCO TextMap values back to structured insights
+    (RecordInsightsParser.scala): {feature_group: [(class_index, diff)]}."""
+
+    @staticmethod
+    def parse_row(value: Dict[str, str]) -> Dict[str, List[Tuple[int, float]]]:
+        return {k: [(int(c), float(x)) for c, x in json.loads(v)]
+                for k, v in (value or {}).items()}
+
+    @staticmethod
+    def parse_column(col: Column) -> List[Dict[str, List[Tuple[int, float]]]]:
+        return [RecordInsightsParser.parse_row(v) for v in col.data]

@@ -245,15 +245,25 @@ class JoinedDataReader(Reader):
         self.left = left
         self.right = right
         self.how = how
-        self._secondary: Optional[CutOffTime] = None
+        self._secondary = False
 
-    def with_secondary_aggregation(self, cutoff: Optional[CutOffTime] = None
-                                   ) -> "JoinedDataReader":
-        self._secondary = cutoff or CutOffTime.no_cutoff()
+    def with_secondary_aggregation(self) -> "JoinedDataReader":
+        """Fold duplicate right-side rows per key through type-default
+        monoids. (Time-windowed post-join filtering belongs in the child
+        reader's own CutOffTime — joined rows no longer carry event times.)"""
+        self._secondary = True
         return self
 
     def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
         raw_features = list(raw_features or [])
+        aggregating = (AggregateDataReader, ConditionalDataReader)
+        if (isinstance(self.left, aggregating) and self.left.features is None
+                and isinstance(self.right, aggregating)
+                and self.right.features is None):
+            raise ValueError(
+                "Joining two aggregating readers requires each to declare "
+                "its own features= allowlist, otherwise both sides "
+                "aggregate every raw feature and shadow each other")
         left_ds = self.left.read(raw_features)
         right_ds = self.right.read(raw_features)
         for side, ds in (("left", left_ds), ("right", right_ds)):
@@ -277,16 +287,20 @@ class JoinedDataReader(Reader):
         ftypes = {f.name: f.ftype for f in raw_features}
 
         def merge(l_row: Optional[Dict], r_group: List[Dict]) -> Dict[str, Any]:
-            row = dict(l_row) if l_row else {
-                KEY_COLUMN: r_group[0][KEY_COLUMN]}
+            if l_row is not None:
+                row = dict(l_row)
+                copy_cols = rcols  # left values win on shared names
+            else:  # right-only row: every right column carries over
+                row = {KEY_COLUMN: r_group[0][KEY_COLUMN]}
+                copy_cols = [c for c in right_ds.schema if c != KEY_COLUMN]
             if not r_group:
-                for c in rcols:
+                for c in copy_cols:
                     row.setdefault(c, None)
-            elif len(r_group) == 1 or self._secondary is None:
-                for c in rcols:
+            elif len(r_group) == 1 or not self._secondary:
+                for c in copy_cols:
                     row[c] = r_group[0].get(c)
             else:  # secondary aggregation of duplicate child rows
-                for c in rcols:
+                for c in copy_cols:
                     ftype = ftypes.get(c) or right_ds.schema.get(c, T.Text)
                     events = [Event(0, g.get(c)) for g in r_group]
                     row[c] = default_aggregator(ftype)(events)
@@ -298,7 +312,7 @@ class JoinedDataReader(Reader):
             k = str(l_row[KEY_COLUMN])
             seen_keys.add(k)
             group = rindex.get(k, [])
-            if group and self._secondary is None and len(group) > 1:
+            if group and not self._secondary and len(group) > 1:
                 # no secondary aggregation: one output row per child match
                 for g in group:
                     out.append(merge(l_row, [g]))
@@ -310,7 +324,7 @@ class JoinedDataReader(Reader):
             for k, group in rindex.items():
                 if k in seen_keys:
                     continue
-                if self._secondary is None and len(group) > 1:
+                if not self._secondary and len(group) > 1:
                     for g in group:  # same per-child expansion as left matches
                         out.append(merge(None, [g]))
                 else:
@@ -342,9 +356,20 @@ class StreamingReader(Reader):
         if self.records is not None:
             yield from self.records
             return
+        # parse CSV cells with the same typed inference as Dataset.from_csv
+        # so the streaming path matches DataReaders.csv on the same file
+        from transmogrifai_tpu.data.dataset import _infer_ftype, _parse_cell
         with open(self.csv_path, "r", newline="") as f:
-            for row in _csv.DictReader(f):
-                yield row
+            reader = _csv.DictReader(f)
+            rows = list(reader)
+        if self.schema is None:
+            fields = rows[0].keys() if rows else ()
+            self.schema = {
+                name: _infer_ftype([r.get(name) or None for r in rows])
+                for name in fields}
+        for r in rows:
+            yield {k: _parse_cell(v, self.schema.get(k, T.Text))
+                   for k, v in r.items()}
 
     def stream(self) -> Iterator[Dataset]:
         buf: List[Mapping[str, Any]] = []

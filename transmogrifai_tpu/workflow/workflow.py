@@ -34,6 +34,9 @@ class Workflow:
         self._dataset: Optional[Dataset] = None
         self._reader = None
         self.parameters: Dict[str, Any] = {}
+        self._rff = None
+        self._rff_score_source = None
+        self.blocklist: List[str] = []
 
     def set_result_features(self, *features) -> "Workflow":
         self.result_features = tuple(features)
@@ -49,6 +52,17 @@ class Workflow:
 
     def set_parameters(self, params: Dict[str, Any]) -> "Workflow":
         self.parameters = dict(params)
+        return self
+
+    def with_raw_feature_filter(self, score_dataset=None, score_reader=None,
+                                **rff_params) -> "Workflow":
+        """Enable RawFeatureFilter before training
+        (OpWorkflow.withRawFeatureFilter, OpWorkflow.scala:544-586):
+        train/score distribution comparison drops unhealthy raw features and
+        rewires the DAG around them."""
+        from transmogrifai_tpu.automl.raw_feature_filter import RawFeatureFilter
+        self._rff = RawFeatureFilter(**rff_params)
+        self._rff_score_source = (score_dataset, score_reader)
         return self
 
     # ------------------------------------------------------------------ #
@@ -82,9 +96,13 @@ class Workflow:
         ds = self._resolve_dataset(dataset)
         if not self.result_features:
             raise RuntimeError("set_result_features before train()")
+        rff_results = None
+        source_features = self.result_features
+        if self._rff is not None:
+            ds, source_features, rff_results = self._apply_rff(ds)
         # fit a private clone: the estimator→model swap must not mutate the
         # user's graph or previously returned models (see dag.clone_graph)
-        result_features = clone_graph(self.result_features)
+        result_features = clone_graph(source_features)
         layers = topological_layers(result_features)
         ctx = FitContext(n_rows=len(ds), seed=seed, mesh=mesh)
         columns: Dict[str, Column] = {}
@@ -112,9 +130,41 @@ class Workflow:
                     raise TypeError(f"Cannot execute stage {stage!r}")
                 columns[stage.get_output().uid] = out
 
-        return WorkflowModel(
+        model = WorkflowModel(
             result_features=result_features, fitted=fitted,
             train_columns=columns)
+        model.rff_results = rff_results
+        model.blocklist = list(self.blocklist)
+        return model
+
+    def _apply_rff(self, ds: Dataset):
+        """Run RawFeatureFilter and rewire the DAG around dropped raw
+        features (OpWorkflow.scala:235-258 generateRawData with RFF +
+        setBlocklist). Result features that become unproducible raise —
+        the reference's default retention policy."""
+        from transmogrifai_tpu.features.dag import rewire_without
+
+        raws = self._raw_features()
+        label = next((f for f in raws if f.is_response), None)
+        score_ds = None
+        if self._rff_score_source is not None:
+            score_ds, score_reader = self._rff_score_source
+            if score_ds is None and score_reader is not None:
+                score_ds = score_reader.read(raws)
+        filtered = self._rff.generate_filtered_raw(
+            ds, raws, score_dataset=score_ds, label_feature=label)
+        self.blocklist = list(filtered.features_to_drop)
+        if not filtered.features_to_drop:
+            return filtered.clean_dataset, self.result_features, filtered.results
+        survived, dropped = rewire_without(
+            self.result_features, filtered.features_to_drop)
+        if dropped:
+            raise RuntimeError(
+                f"RawFeatureFilter removed raw features "
+                f"{filtered.features_to_drop} making result features "
+                f"{dropped} unproducible; protect them via "
+                f"protected_features or relax thresholds")
+        return filtered.clean_dataset, tuple(survived), filtered.results
 
 
 class WorkflowModel:
@@ -126,6 +176,8 @@ class WorkflowModel:
         self.fitted = dict(fitted)
         self.train_columns = train_columns or {}
         self._compiled = None
+        self.rff_results = None   # RawFeatureFilterResults when RFF ran
+        self.blocklist: List[str] = []
 
     # ------------------------------------------------------------------ #
     # execution                                                          #
@@ -221,6 +273,11 @@ class WorkflowModel:
     def load(path: str) -> "WorkflowModel":
         from transmogrifai_tpu.workflow.serialization import load_model
         return load_model(path)
+
+    def model_insights(self):
+        """Merged explanation artifact (ModelInsights.scala:74)."""
+        from transmogrifai_tpu.insights import ModelInsights
+        return ModelInsights.extract(self)
 
     def summary(self) -> Dict[str, Any]:
         """Stage inventory + params (OpWorkflowModel.summary analogue)."""
