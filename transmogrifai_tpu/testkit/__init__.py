@@ -1,0 +1,22 @@
+"""Test kit: random typed data generators, feature builders, contract specs.
+
+Reference parity: `testkit/src/main/scala/com/salesforce/op/testkit/`
+(RandomReal/RandomText/RandomIntegral/…, TestFeatureBuilder, FeatureAsserts)
+plus the reusable stage contract specs shipped in the main jar
+(`features/.../test/OpTransformerSpec.scala:53-156`, `OpEstimatorSpec.scala:55-130`).
+"""
+
+from transmogrifai_tpu.testkit.random_data import (
+    RandomBinary, RandomIntegral, RandomList, RandomMap, RandomReal,
+    RandomSet, RandomStream, RandomText, RandomVector)
+from transmogrifai_tpu.testkit.feature_builder import TestFeatureBuilder
+from transmogrifai_tpu.testkit.asserts import assert_feature
+from transmogrifai_tpu.testkit.contract import (
+    check_estimator_contract, check_transformer_contract)
+
+__all__ = [
+    "RandomBinary", "RandomIntegral", "RandomList", "RandomMap", "RandomReal",
+    "RandomSet", "RandomStream", "RandomText", "RandomVector",
+    "TestFeatureBuilder", "assert_feature",
+    "check_estimator_contract", "check_transformer_contract",
+]

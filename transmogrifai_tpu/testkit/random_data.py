@@ -1,0 +1,287 @@
+"""Random typed data streams with controllable emptiness.
+
+Reference parity: `testkit/.../RandomReal.scala:45` (normal/uniform/poisson/
+exponential/gamma/logNormal), `RandomText.scala:49-64` (strings, emails,
+urls, phones, ids, countries, picklists, …), `RandomIntegral`,
+`RandomBinary`, `RandomList`, `RandomMap`, `RandomSet`, `RandomVector`,
+composed via `RandomData`/`InfiniteStream`.
+
+A stream is an infinite typed generator: `.take(n)` yields n FeatureType
+instances; `.with_prob_of_empty(p)` makes each draw empty with probability
+p (the reference's probabilityOfEmpty). Deterministic under `seed`.
+"""
+
+from __future__ import annotations
+
+import string
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+
+
+class RandomStream:
+    """Infinite stream of `ftype` values drawn by `sample(rng) -> raw`."""
+
+    def __init__(self, ftype: type, sample: Callable[[np.random.Generator], Any],
+                 prob_of_empty: float = 0.0, seed: int = 42):
+        self.ftype = ftype
+        self._sample = sample
+        self.prob_of_empty = prob_of_empty
+        self.seed = seed
+
+    def with_prob_of_empty(self, p: float) -> "RandomStream":
+        if issubclass(self.ftype, T.NonNullable) and p > 0:
+            raise ValueError(f"{self.ftype.__name__} cannot be empty")
+        return RandomStream(self.ftype, self._sample, p, self.seed)
+
+    def with_seed(self, seed: int) -> "RandomStream":
+        return RandomStream(self.ftype, self._sample, self.prob_of_empty, seed)
+
+    def take(self, n: int) -> List[T.FeatureType]:
+        rng = np.random.default_rng(self.seed)
+        out = []
+        for _ in range(n):
+            v = self._sample(rng)
+            if self.prob_of_empty > 0 and rng.uniform() < self.prob_of_empty:
+                out.append(self.ftype.empty())
+            else:
+                out.append(self.ftype(v))
+        return out
+
+    limit = take  # reference naming
+
+
+def _typed(ftype):
+    def deco(fn):
+        return fn
+    return deco
+
+
+class RandomReal:
+    """RandomReal.scala:45 — continuous distributions for any Real subtype."""
+
+    @staticmethod
+    def normal(mean: float = 0.0, sigma: float = 1.0,
+               ftype: type = T.Real, seed: int = 42) -> RandomStream:
+        return RandomStream(ftype, lambda r: float(r.normal(mean, sigma)), seed=seed)
+
+    @staticmethod
+    def uniform(low: float = 0.0, high: float = 1.0,
+                ftype: type = T.Real, seed: int = 42) -> RandomStream:
+        return RandomStream(ftype, lambda r: float(r.uniform(low, high)), seed=seed)
+
+    @staticmethod
+    def poisson(mean: float = 4.0, ftype: type = T.Real, seed: int = 42) -> RandomStream:
+        return RandomStream(ftype, lambda r: float(r.poisson(mean)), seed=seed)
+
+    @staticmethod
+    def exponential(scale: float = 1.0, ftype: type = T.Real, seed: int = 42) -> RandomStream:
+        return RandomStream(ftype, lambda r: float(r.exponential(scale)), seed=seed)
+
+    @staticmethod
+    def gamma(shape: float = 2.0, scale: float = 1.0,
+              ftype: type = T.Real, seed: int = 42) -> RandomStream:
+        return RandomStream(ftype, lambda r: float(r.gamma(shape, scale)), seed=seed)
+
+    @staticmethod
+    def lognormal(mean: float = 0.0, sigma: float = 1.0,
+                  ftype: type = T.Real, seed: int = 42) -> RandomStream:
+        return RandomStream(ftype, lambda r: float(r.lognormal(mean, sigma)), seed=seed)
+
+
+class RandomIntegral:
+    """RandomIntegral.scala — integers and epoch dates."""
+
+    @staticmethod
+    def integers(low: int = 0, high: int = 100,
+                 ftype: type = T.Integral, seed: int = 42) -> RandomStream:
+        return RandomStream(ftype, lambda r: int(r.integers(low, high)), seed=seed)
+
+    @staticmethod
+    def dates(start_ms: int = 1_500_000_000_000, step_ms: int = 86_400_000,
+              seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.Date, lambda r: int(start_ms + r.integers(0, 365) * step_ms), seed=seed)
+
+    @staticmethod
+    def datetimes(start_ms: int = 1_500_000_000_000, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.DateTime,
+            lambda r: int(start_ms + r.integers(0, 365 * 86_400_000)), seed=seed)
+
+
+class RandomBinary:
+    @staticmethod
+    def of(prob_true: float = 0.5, seed: int = 42) -> RandomStream:
+        return RandomStream(T.Binary, lambda r: bool(r.uniform() < prob_true),
+                            seed=seed)
+
+
+_COUNTRIES = ["USA", "Canada", "Mexico", "France", "Germany", "Japan",
+              "Brazil", "India", "Kenya", "Australia"]
+_STATES = ["CA", "NY", "TX", "WA", "OR", "IL", "MA", "FL", "CO", "GA"]
+_CITIES = ["San Francisco", "New York", "Austin", "Seattle", "Portland",
+           "Chicago", "Boston", "Miami", "Denver", "Atlanta"]
+_STREETS = ["Market St", "Main St", "Broadway", "Elm St", "Oak Ave",
+            "Pine St", "2nd Ave", "5th Ave", "Lake Dr", "Hill Rd"]
+_DOMAINS = ["example.com", "mail.org", "corp.net", "web.io"]
+_WORDS = ("lorem ipsum dolor sit amet consectetur adipiscing elit sed do "
+          "eiusmod tempor incididunt ut labore et dolore magna aliqua").split()
+
+
+def _rand_string(r: np.random.Generator, lo: int = 3, hi: int = 10) -> str:
+    n = int(r.integers(lo, hi + 1))
+    letters = list(string.ascii_lowercase)
+    return "".join(r.choice(letters) for _ in range(n))
+
+
+class RandomText:
+    """RandomText.scala:49-64 — every text subtype."""
+
+    @staticmethod
+    def strings(min_len: int = 3, max_len: int = 10, seed: int = 42) -> RandomStream:
+        return RandomStream(T.Text, lambda r: _rand_string(r, min_len, max_len),
+                            seed=seed)
+
+    @staticmethod
+    def textareas(min_words: int = 5, max_words: int = 20, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.TextArea,
+            lambda r: " ".join(r.choice(_WORDS)
+                               for _ in range(int(r.integers(min_words, max_words + 1)))),
+            seed=seed)
+
+    @staticmethod
+    def emails(domains: Sequence[str] = _DOMAINS, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.Email, lambda r: f"{_rand_string(r)}@{r.choice(list(domains))}",
+            seed=seed)
+
+    @staticmethod
+    def urls(domains: Sequence[str] = _DOMAINS, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.URL,
+            lambda r: f"https://{r.choice(list(domains))}/{_rand_string(r)}",
+            seed=seed)
+
+    @staticmethod
+    def phones(seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.Phone,
+            lambda r: "+1" + "".join(str(r.integers(0, 10)) for _ in range(10)),
+            seed=seed)
+
+    @staticmethod
+    def postal_codes(seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.PostalCode,
+            lambda r: "".join(str(r.integers(0, 10)) for _ in range(5)), seed=seed)
+
+    @staticmethod
+    def ids(seed: int = 42) -> RandomStream:
+        return RandomStream(T.ID, lambda r: _rand_string(r, 8, 12), seed=seed)
+
+    @staticmethod
+    def unique_ids(seed: int = 42) -> RandomStream:
+        counter = {"i": 0}
+
+        def sample(r):
+            counter["i"] += 1
+            return f"id_{counter['i']:08d}"
+        return RandomStream(T.ID, sample, seed=seed)
+
+    @staticmethod
+    def countries(seed: int = 42) -> RandomStream:
+        return RandomStream(T.Country, lambda r: str(r.choice(_COUNTRIES)), seed=seed)
+
+    @staticmethod
+    def states(seed: int = 42) -> RandomStream:
+        return RandomStream(T.State, lambda r: str(r.choice(_STATES)), seed=seed)
+
+    @staticmethod
+    def cities(seed: int = 42) -> RandomStream:
+        return RandomStream(T.City, lambda r: str(r.choice(_CITIES)), seed=seed)
+
+    @staticmethod
+    def streets(seed: int = 42) -> RandomStream:
+        return RandomStream(T.Street, lambda r: str(r.choice(_STREETS)), seed=seed)
+
+    @staticmethod
+    def picklists(domain: Sequence[str], seed: int = 42) -> RandomStream:
+        return RandomStream(T.PickList, lambda r: str(r.choice(list(domain))),
+                            seed=seed)
+
+    @staticmethod
+    def comboboxes(domain: Sequence[str], seed: int = 42) -> RandomStream:
+        return RandomStream(T.ComboBox, lambda r: str(r.choice(list(domain))),
+                            seed=seed)
+
+    @staticmethod
+    def base64(min_len: int = 8, max_len: int = 32, seed: int = 42) -> RandomStream:
+        import base64 as b64
+
+        def sample(r):
+            n = int(r.integers(min_len, max_len + 1))
+            return b64.b64encode(bytes(int(x) for x in r.integers(0, 256, n))).decode()
+        return RandomStream(T.Base64, sample, seed=seed)
+
+
+class RandomList:
+    @staticmethod
+    def of_texts(min_len: int = 0, max_len: int = 5, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.TextList,
+            lambda r: [str(r.choice(_WORDS))
+                       for _ in range(int(r.integers(min_len, max_len + 1)))],
+            seed=seed)
+
+    @staticmethod
+    def of_dates(min_len: int = 0, max_len: int = 5,
+                 start_ms: int = 1_500_000_000_000, seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.DateList,
+            lambda r: [int(start_ms + x) for x in
+                       r.integers(0, 10 ** 9, int(r.integers(min_len, max_len + 1)))],
+            seed=seed)
+
+
+class RandomSet:
+    @staticmethod
+    def of(domain: Sequence[str], min_size: int = 0, max_size: int = 3,
+           seed: int = 42) -> RandomStream:
+        def sample(r):
+            k = int(r.integers(min_size, max_size + 1))
+            return set(r.choice(list(domain), size=min(k, len(domain)),
+                                replace=False).tolist())
+        return RandomStream(T.MultiPickList, sample, seed=seed)
+
+
+class RandomMap:
+    """RandomMap.scala — maps built from a value sampler over random keys."""
+
+    @staticmethod
+    def of(value_stream: RandomStream, keys: Sequence[str],
+           ftype: Optional[type] = None, seed: int = 42) -> RandomStream:
+        mtype = ftype or {
+            T.Real: T.RealMap, T.Currency: T.CurrencyMap, T.Binary: T.BinaryMap,
+            T.Integral: T.IntegralMap, T.Text: T.TextMap, T.Email: T.EmailMap,
+            T.PickList: T.PickListMap,
+        }.get(value_stream.ftype, T.TextMap)
+
+        def sample(r):
+            out = {}
+            for k in keys:
+                if r.uniform() >= value_stream.prob_of_empty:
+                    out[k] = value_stream._sample(r)
+            return out
+        return RandomStream(mtype, sample, seed=seed)
+
+
+class RandomVector:
+    @staticmethod
+    def dense(dim: int, mean: float = 0.0, sigma: float = 1.0,
+              seed: int = 42) -> RandomStream:
+        return RandomStream(
+            T.OPVector, lambda r: r.normal(mean, sigma, dim).tolist(), seed=seed)
