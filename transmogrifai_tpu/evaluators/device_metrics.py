@@ -1,0 +1,166 @@
+"""Masked, jit-safe metric kernels for the batched sweep engine.
+
+Reference parity: the metric *values* match `evaluators/metrics.py` (which
+itself mirrors `core/.../evaluators/OpBinaryClassificationEvaluator.scala`
+etc.), but these run ON DEVICE inside the fused sweep program: folds are
+0/1 row masks over the fixed training matrix, so fit → predict → metric for
+every grid×fold executes as one XLA computation with no host round-trip
+(the reference evaluates each fit's metrics in a separate Spark job —
+`OpValidator.scala:318-340`).
+
+Masked-row semantics: a row with mask 0 contributes zero weight everywhere.
+In the rank-based metrics (AuROC/AuPR) masked rows still occupy slots in
+the sorted arrays but with zero weight they only create duplicated curve
+points whose trapezoid contribution is exactly zero, so the result equals
+the host metric computed on the unmasked subset (ties included).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked_sum(x, mask):
+    return (x * mask).sum()
+
+
+def auroc_dev(y: jnp.ndarray, scores: jnp.ndarray, mask: jnp.ndarray):
+    """Tie-averaged Mann-Whitney AuROC over masked rows (auroc_score parity)."""
+    wpos = mask * y
+    wneg = mask * (1.0 - y)
+    order = jnp.argsort(scores)
+    s = scores[order]
+    wp = wpos[order]
+    wn = wneg[order]
+    cumn = jnp.concatenate([jnp.zeros(1, s.dtype), jnp.cumsum(wn)])
+    left = jnp.searchsorted(s, s, side="left")
+    right = jnp.searchsorted(s, s, side="right")
+    below = cumn[left]
+    tied = cumn[right] - cumn[left]
+    num = (wp * (below + 0.5 * tied)).sum()
+    n_pos = wpos.sum()
+    n_neg = wneg.sum()
+    ok = (n_pos > 0) & (n_neg > 0)
+    return jnp.where(ok, num / jnp.maximum(n_pos * n_neg, 1e-30), 0.0)
+
+
+def aupr_dev(y: jnp.ndarray, scores: jnp.ndarray, mask: jnp.ndarray):
+    """Trapezoid area under the tie-grouped PR curve with the (r=0, p=1)
+    start point (aupr_score / Spark BinaryClassificationMetrics parity)."""
+    wpos = mask * y
+    neg_s = -scores
+    order = jnp.argsort(neg_s)
+    s_asc = neg_s[order]            # ascending == scores descending
+    wp = wpos[order]
+    w = mask[order]
+    cum_tp = jnp.cumsum(wp)
+    cum_n = jnp.cumsum(w)
+    # map every index to its tie-group END (last index with an equal score)
+    right = jnp.searchsorted(s_asc, s_asc, side="right") - 1
+    tp = cum_tp[right]
+    n_at = cum_n[right]
+    n_pos = wpos.sum()
+    prec = jnp.where(n_at > 0, tp / jnp.maximum(n_at, 1e-30), 1.0)
+    rec = tp / jnp.maximum(n_pos, 1e-30)
+    r = jnp.concatenate([jnp.zeros(1, rec.dtype), rec])
+    p = jnp.concatenate([jnp.ones(1, prec.dtype), prec])
+    area = ((r[1:] - r[:-1]) * (p[1:] + p[:-1]) * 0.5).sum()
+    return jnp.where(n_pos > 0, area, 0.0)
+
+
+def binary_confusion_dev(y, scores, mask, threshold: float = 0.5):
+    """Weighted TP/TN/FP/FN and the derived point metrics at `threshold`."""
+    pred = (scores >= threshold).astype(scores.dtype)
+    pos = (y > 0.5).astype(scores.dtype)
+    tp = _masked_sum(pred * pos, mask)
+    fp = _masked_sum(pred * (1 - pos), mask)
+    fn = _masked_sum((1 - pred) * pos, mask)
+    tn = _masked_sum((1 - pred) * (1 - pos), mask)
+    n = jnp.maximum(mask.sum(), 1.0)
+    precision = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1e-30), 0.0)
+    recall = jnp.where(tp + fn > 0, tp / jnp.maximum(tp + fn, 1e-30), 0.0)
+    f1 = jnp.where(precision + recall > 0,
+                   2 * precision * recall
+                   / jnp.maximum(precision + recall, 1e-30), 0.0)
+    error = (fp + fn) / n
+    return {"Precision": precision, "Recall": recall, "F1": f1,
+            "Error": error, "TP": tp, "TN": tn, "FP": fp, "FN": fn}
+
+
+def multiclass_dev(y, pred, mask, n_classes: int):
+    """Weighted-average Precision/Recall/F1 + Error over a masked confusion
+    matrix (multiclass_metrics parity; `n_classes` static — extra empty
+    classes carry zero support weight so any upper bound is exact)."""
+    yi = jnp.clip(y.astype(jnp.int32), 0, n_classes - 1)
+    pi = jnp.clip(pred.astype(jnp.int32), 0, n_classes - 1)
+    conf = jnp.zeros((n_classes, n_classes), jnp.float32).at[yi, pi].add(mask)
+    tp = jnp.diagonal(conf)
+    support = conf.sum(axis=1)
+    pred_count = conf.sum(axis=0)
+    prec_c = jnp.where(pred_count > 0, tp / jnp.maximum(pred_count, 1e-30), 0.0)
+    rec_c = jnp.where(support > 0, tp / jnp.maximum(support, 1e-30), 0.0)
+    f1_c = jnp.where(prec_c + rec_c > 0,
+                     2 * prec_c * rec_c / jnp.maximum(prec_c + rec_c, 1e-30), 0.0)
+    w = support / jnp.maximum(support.sum(), 1.0)
+    err = 1.0 - tp.sum() / jnp.maximum(mask.sum(), 1.0)
+    return {"Precision": (prec_c * w).sum(), "Recall": (rec_c * w).sum(),
+            "F1": (f1_c * w).sum(), "Error": err}
+
+
+def regression_dev(y, pred, mask):
+    """Weighted RMSE/MSE/MAE/R2 (regression_metrics parity)."""
+    n = jnp.maximum(mask.sum(), 1.0)
+    err = (pred - y) * mask
+    mse = (err ** 2).sum() / n
+    mae = jnp.abs(err).sum() / n
+    y_mean = _masked_sum(y, mask) / n
+    ss_tot = _masked_sum((y - y_mean) ** 2, mask)
+    ss_res = (err ** 2).sum()
+    r2 = jnp.where(ss_tot > 0, 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30), 0.0)
+    return {"RMSE": jnp.sqrt(mse), "MSE": mse, "MAE": mae, "R2": r2}
+
+
+def _binary_scores(pred: dict) -> jnp.ndarray:
+    prob = pred.get("probability")
+    if prob is not None and prob.ndim == 2 and prob.shape[1] >= 2:
+        return prob[:, 1]
+    return pred["prediction"]
+
+
+def make_device_metric(evaluator, n_classes: int | None = None):
+    """metric_fn(y, pred_dict, val_mask) -> scalar for the sweep program, or
+    None when `evaluator` has no device kernel (LambdaEvaluator etc. fall
+    back to the host path in parallel/sweep.py)."""
+    from transmogrifai_tpu.evaluators.evaluators import (
+        BinaryClassificationEvaluator, MultiClassificationEvaluator,
+        RegressionEvaluator)
+
+    metric = evaluator.default_metric
+
+    if isinstance(evaluator, BinaryClassificationEvaluator):
+        threshold = evaluator.threshold
+
+        def fn(y, pred, mask):
+            s = _binary_scores(pred)
+            if metric == "AuPR":
+                return aupr_dev(y, s, mask)
+            if metric == "AuROC":
+                return auroc_dev(y, s, mask)
+            return binary_confusion_dev(y, s, mask, threshold)[metric]
+        return fn
+
+    if isinstance(evaluator, MultiClassificationEvaluator):
+        if n_classes is None:
+            return None
+
+        def fn(y, pred, mask):
+            return multiclass_dev(y, pred["prediction"], mask, n_classes)[metric]
+        return fn
+
+    if isinstance(evaluator, RegressionEvaluator):
+        def fn(y, pred, mask):
+            return regression_dev(y, pred["prediction"], mask)[metric]
+        return fn
+
+    return None

@@ -82,12 +82,19 @@ def _histograms(Xb, node_idx, G, H, n_nodes: int, n_bins: int):
 def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               max_depth: int, n_bins: int, reg_lambda: float = 1.0,
               min_child_weight: float = 1.0, min_gain: float = 0.0,
-              feature_mask: Optional[jnp.ndarray] = None) -> Dict:
+              feature_mask: Optional[jnp.ndarray] = None,
+              active_depth=None) -> Dict:
     """Grow one fixed-depth tree. Returns dense arrays:
 
     {"feat": (depth, 2^depth) int32, "bin": (depth, 2^depth) int32,
      "leaf": (2^max_depth, m) float32}
     (per-level arrays are padded to 2^max_depth node slots)
+
+    `active_depth`: optional TRACED effective depth ≤ max_depth. Levels at or
+    beyond it never split (every sample routes left, partition unchanged), so
+    the padded tree predicts exactly like a tree grown to that depth — this
+    lets the sweep engine vmap a {max_depth: 3, 6, 12} grid in ONE compiled
+    program padded to 12 instead of one compile per depth.
     """
     n, d = Xb.shape
     m = G.shape[1]
@@ -116,6 +123,8 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
         bb = (best % n_bins).astype(jnp.int32)
         # a node with no usable gain "splits" at bin >= n_bins-1 → all left
         splits = best_gain > min_gain
+        if active_depth is not None:
+            splits = splits & (level < active_depth)
         bb = jnp.where(splits, bb, n_bins)
         feats = feats.at[level, :n_nodes].set(bf)
         bins = bins.at[level, :n_nodes].set(bb)
@@ -148,17 +157,22 @@ def predict_tree(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
 # --------------------------------------------------------------------------- #
 
 @partial(jax.jit, static_argnames=("n_trees", "max_depth", "n_bins",
-                                   "n_outputs", "subsample_features"))
+                                   "n_outputs", "subsample_features",
+                                   "bootstrap"))
 def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
                n_outputs: int, seed, subsample_features: bool = True,
-               min_child_weight: float = 1.0):
+               min_child_weight: float = 1.0, active_depth=None,
+               bootstrap: bool = True):
     n, d = Xb.shape
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
     n_sub = max(int(np.sqrt(d)), 1) if subsample_features else d
 
     def one_tree(key):
         k1, k2 = jax.random.split(key)
-        boot = jax.random.poisson(k1, 1.0, (n,)).astype(jnp.float32) * w
+        if bootstrap:
+            boot = jax.random.poisson(k1, 1.0, (n,)).astype(jnp.float32) * w
+        else:  # deterministic single tree (OpDecisionTree* parity)
+            boot = w
         if subsample_features:
             scores = jax.random.uniform(k2, (d,))
             thresh = jnp.sort(scores)[n_sub - 1]
@@ -167,7 +181,7 @@ def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
             fmask = jnp.ones((d,), bool)
         return grow_tree(Xb, Y * boot[:, None], boot, max_depth, n_bins,
                          reg_lambda=1e-6, min_child_weight=min_child_weight,
-                         feature_mask=fmask)
+                         feature_mask=fmask, active_depth=active_depth)
 
     return jax.vmap(one_tree)(keys)
 
@@ -186,7 +200,9 @@ def predict_forest(trees: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
                                    "objective"))
 def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
             learning_rate, reg_lambda, objective: str = "logistic",
-            min_child_weight: float = 1.0):
+            min_child_weight: float = 1.0, active_depth=None):
+    """Returns (trees, final_margin): the scan carry already holds the full
+    training-matrix margin, so sweep callers need not re-walk the forest."""
     n = Xb.shape[0]
 
     def grads(margin):
@@ -199,19 +215,48 @@ def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
         g, h = grads(margin)
         tree = grow_tree(Xb, (-g)[:, None], h, max_depth, n_bins,
                          reg_lambda=reg_lambda,
-                         min_child_weight=min_child_weight)
+                         min_child_weight=min_child_weight,
+                         active_depth=active_depth)
         margin = margin + learning_rate * predict_tree(tree, Xb)[:, 0]
         return margin, tree
 
     base = jnp.zeros(n, jnp.float32)
-    _, trees = jax.lax.scan(round_, base, None, length=n_estimators)
-    return trees
+    margin, trees = jax.lax.scan(round_, base, None, length=n_estimators)
+    return trees, margin
 
 
 @partial(jax.jit, static_argnames=())
 def predict_gbt_margin(trees: Dict, Xb: jnp.ndarray, learning_rate) -> jnp.ndarray:
     preds = jax.vmap(lambda t: predict_tree(t, Xb))(trees)  # (T, n, 1)
     return learning_rate * preds[:, :, 0].sum(axis=0)
+
+
+# --------------------------------------------------------------------------- #
+# shared prediction assembly (model classes AND the sweep engine use these,   #
+# so sweep metrics always describe exactly what the refit model predicts)     #
+# --------------------------------------------------------------------------- #
+
+def forest_classification_pred(trees: Dict, Xb: jnp.ndarray) -> Dict:
+    probs = predict_forest(trees, Xb)
+    probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), 1e-9)
+    return {"prediction": jnp.argmax(probs, -1).astype(jnp.float32),
+            "rawPrediction": probs, "probability": probs}
+
+
+def forest_regression_pred(trees: Dict, Xb: jnp.ndarray) -> Dict:
+    pred = predict_forest(trees, Xb)[:, 0]
+    return {"prediction": pred, "rawPrediction": pred[:, None],
+            "probability": jnp.zeros((Xb.shape[0], 0), jnp.float32)}
+
+
+def gbt_pred_from_margin(margin: jnp.ndarray, objective: str) -> Dict:
+    if objective == "logistic":
+        p1 = jax.nn.sigmoid(margin)
+        return {"prediction": (margin > 0).astype(jnp.float32),
+                "rawPrediction": jnp.stack([-margin, margin], 1),
+                "probability": jnp.stack([1 - p1, p1], axis=1)}
+    return {"prediction": margin, "rawPrediction": margin[:, None],
+            "probability": jnp.zeros((margin.shape[0], 0), jnp.float32)}
 
 
 # --------------------------------------------------------------------------- #
@@ -239,18 +284,12 @@ class _TreeModelBase(PredictionModel):
 
 class ForestClassificationModel(_TreeModelBase):
     def predict_arrays(self, X):
-        probs = predict_forest(self._tree_pytree(), self._binned(X))
-        probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), 1e-9)
-        return {"prediction": jnp.argmax(probs, -1).astype(jnp.float32),
-                "rawPrediction": probs,
-                "probability": probs}
+        return forest_classification_pred(self._tree_pytree(), self._binned(X))
 
 
 class ForestRegressionModel(_TreeModelBase):
     def predict_arrays(self, X):
-        pred = predict_forest(self._tree_pytree(), self._binned(X))[:, 0]
-        return {"prediction": pred, "rawPrediction": pred[:, None],
-                "probability": jnp.zeros((X.shape[0], 0), jnp.float32)}
+        return forest_regression_pred(self._tree_pytree(), self._binned(X))
 
 
 class GBTClassificationModel(_TreeModelBase):
@@ -267,25 +306,21 @@ class GBTClassificationModel(_TreeModelBase):
     def predict_arrays(self, X):
         margin = predict_gbt_margin(self._tree_pytree(), self._binned(X),
                                     jnp.float32(self.learning_rate))
-        p1 = jax.nn.sigmoid(margin)
-        prob = jnp.stack([1 - p1, p1], axis=1)
-        return {"prediction": (margin > 0).astype(jnp.float32),
-                "rawPrediction": jnp.stack([-margin, margin], 1),
-                "probability": prob}
+        return gbt_pred_from_margin(margin, "logistic")
 
 
 class GBTRegressionModel(GBTClassificationModel):
     def predict_arrays(self, X):
-        pred = predict_gbt_margin(self._tree_pytree(), self._binned(X),
-                                  jnp.float32(self.learning_rate))
-        return {"prediction": pred, "rawPrediction": pred[:, None],
-                "probability": jnp.zeros((X.shape[0], 0), jnp.float32)}
+        margin = predict_gbt_margin(self._tree_pytree(), self._binned(X),
+                                    jnp.float32(self.learning_rate))
+        return gbt_pred_from_margin(margin, "squared")
 
 
 class _TreeEstimatorBase(PredictorEstimator):
-    # Optional sweep-shared binning cache (max_bins → (edges, Xb)): the sweep
-    # engine attaches one dict per family so 30 grid×fold fits bin the
-    # training matrix once instead of 30 times (binning depends only on X).
+    # Optional shared binning cache (max_bins → (edges, Xb)) used by the
+    # sweep engine's HOST-loop fallback (`parallel/sweep.py:_sweep_generic`)
+    # so repeated grid×fold fits bin the training matrix once. The batched
+    # sweep path keeps its own per-family cache (`parallel/sweep.py:_binned`).
     _bin_cache: Optional[Dict] = None
 
     def _edges_binned(self, X, ctx):
@@ -400,10 +435,10 @@ class OpGBTClassifier(_TreeEstimatorBase):
 
     def fit_arrays(self, X, y, w, ctx: FitContext):
         edges, Xb = self._edges_binned(X, ctx)
-        trees = fit_gbt(Xb, y, w, self.n_estimators, self.max_depth,
-                        self.max_bins, jnp.float32(self.learning_rate),
-                        jnp.float32(self.reg_lambda), self._objective,
-                        self.min_child_weight)
+        trees, _ = fit_gbt(Xb, y, w, self.n_estimators, self.max_depth,
+                           self.max_bins, jnp.float32(self.learning_rate),
+                           jnp.float32(self.reg_lambda), self._objective,
+                           self.min_child_weight)
         return self._model_cls(edges, {k: np.asarray(v) for k, v in trees.items()},
                                self.learning_rate)
 

@@ -1,13 +1,24 @@
-"""The sweep engine: folds × grids as one batched XLA program.
+"""The sweep engine: folds × models × grids as batched XLA programs.
 
 Reference parity: `OpValidator.getSummary` / `OpCrossValidation.validate`
 (`core/.../tuning/OpValidator.scala:299-358`, `OpCrossValidation.scala:87-147`)
 — the reference dispatches each model×grid×fold fit as a Future running
-Spark jobs; here the same sweep is `vmap(vmap(fit))` over stacked fold
-masks and a dynamic hyperparameter vector, jitted once per static-parameter
-group. On a mesh, sharding the grid axis with `sweep_sharding` spreads the
-whole sweep across chips (SURVEY.md §3.3 north star); fold masks make every
-fit shape-identical so XLA batches them without recompilation.
+Spark jobs; here EVERY model family (logistic, linear, GLM, SVC, NB, MLP,
+random forest / decision tree, GBT / XGBoost) compiles its whole grid×fold
+block into ONE XLA program: fit → predict → masked device metric
+(`evaluators/device_metrics.py`), no host round-trips inside the sweep.
+
+Static-shape strategy per family:
+- linear-like: grids share one compile per distinct `max_iter`; the
+  regularization axis is a traced vector, vmapped.
+- trees: `max_depth` grids are PADDED to the group's largest depth and
+  grown with a traced `active_depth` (models/trees.py), so a
+  {3, 6, 12} depth grid is one compile; `min_child_weight`,
+  `learning_rate`, `reg_lambda` are traced vectors.
+- grid axis execution: `vmap` (parallel) when the sweep axis is sharded
+  over a mesh or the family is cheap; `lax.scan`-based `lax.map`
+  (sequential, single compile) for deep trees on a single device to bound
+  the histogram working set.
 
 Fault tolerance mirrors `OpValidator.scala:324-353`: a failing model family
 is dropped with a warning; only all-families-failing raises.
@@ -16,8 +27,7 @@ is dropped with a warning; only all-families-failing raises.
 from __future__ import annotations
 
 import logging
-from functools import partial
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,13 +35,33 @@ import numpy as np
 
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.evaluators.device_metrics import make_device_metric
 from transmogrifai_tpu.models.base import infer_n_classes
-from transmogrifai_tpu.models.linear import OpLinearRegression, fit_linreg, predict_linreg
+from transmogrifai_tpu.models.glm import (
+    OpGeneralizedLinearRegression, fit_glm, predict_glm)
+from transmogrifai_tpu.models.linear import (
+    OpLinearRegression, fit_linreg, predict_linreg)
+from transmogrifai_tpu.models.linear_svc import (
+    OpLinearSVC, fit_linear_svc, predict_linear_svc)
 from transmogrifai_tpu.models.logistic import (
     OpLogisticRegression, fit_logreg, predict_logreg)
+from transmogrifai_tpu.models.mlp import (
+    OpMultilayerPerceptronClassifier, fit_mlp, predict_mlp)
+from transmogrifai_tpu.models.naive_bayes import (
+    OpNaiveBayes, fit_naive_bayes, predict_naive_bayes)
+from transmogrifai_tpu.models.trees import (
+    OpDecisionTreeClassifier, OpDecisionTreeRegressor, OpGBTClassifier,
+    OpGBTRegressor, OpRandomForestClassifier, OpRandomForestRegressor,
+    OpXGBoostClassifier, OpXGBoostRegressor,
+    bin_features, fit_forest, fit_gbt, forest_classification_pred,
+    forest_regression_pred, gbt_pred_from_margin, quantile_bin_edges)
 
 log = logging.getLogger(__name__)
 
+
+# --------------------------------------------------------------------------- #
+# host-path fallback (LambdaEvaluator / unknown model classes)                #
+# --------------------------------------------------------------------------- #
 
 def _metric(evaluator, y: np.ndarray, pred: Dict[str, np.ndarray],
             val_mask: np.ndarray) -> float:
@@ -42,80 +72,9 @@ def _metric(evaluator, y: np.ndarray, pred: Dict[str, np.ndarray],
     return evaluator.metric_value(label, pcol)
 
 
-def _eval_grid_fold(evaluator, y, preds_gk, val_masks) -> List[List[float]]:
-    """preds_gk: dict of arrays with leading (g, k) axes → metric[g][k]."""
-    g = np.asarray(preds_gk["prediction"]).shape[0]
-    k = np.asarray(preds_gk["prediction"]).shape[1]
-    out = []
-    for gi in range(g):
-        row = []
-        for ki in range(k):
-            pred = {key: np.asarray(v)[gi, ki] for key, v in preds_gk.items()}
-            row.append(_metric(evaluator, y, pred, val_masks[ki]))
-        out.append(row)
-    return out
-
-
-# --------------------------------------------------------------------------- #
-# vmapped family sweeps                                                       #
-# --------------------------------------------------------------------------- #
-
-def _sweep_logistic(est: OpLogisticRegression, grids: List[Dict], X, y,
-                    folds, evaluator, sharding=None) -> List[List[float]]:
-    y_np = np.asarray(y)
-    n_classes = est.n_classes or infer_n_classes(y_np)
-    W_train = jnp.asarray(np.stack([tr for tr, _ in folds]))
-    val_masks = [va for _, va in folds]
-
-    # group grids sharing static params (max_iter) → one compile per group
-    metrics: List[Optional[List[float]]] = [None] * len(grids)
-    by_static: Dict[int, List[int]] = {}
-    for i, grid in enumerate(grids):
-        mi = int(grid.get("max_iter", est.max_iter))
-        by_static.setdefault(mi, []).append(i)
-
-    for max_iter, idxs in by_static.items():
-        l2s = jnp.asarray(
-            [float(grids[i].get("reg_param", est.reg_param)) for i in idxs],
-            dtype=jnp.float32)
-        if sharding is not None:
-            l2s = jax.device_put(l2s, sharding)
-
-        fit_one = lambda l2, w: fit_logreg(  # noqa: E731
-            X, y, w, l2, n_classes, max_iter)
-        fit_gk = jax.jit(jax.vmap(jax.vmap(fit_one, in_axes=(None, 0)),
-                                  in_axes=(0, None)))
-        params = fit_gk(l2s, W_train)  # pytree with leading (g, k)
-        preds = jax.jit(jax.vmap(jax.vmap(
-            lambda p: predict_logreg(p, X))))(params)
-        grid_fold = _eval_grid_fold(evaluator, y_np, preds, val_masks)
-        for row, i in zip(grid_fold, idxs):
-            metrics[i] = row
-    return metrics  # type: ignore[return-value]
-
-
-def _sweep_linear(est: OpLinearRegression, grids: List[Dict], X, y,
-                  folds, evaluator, sharding=None) -> List[List[float]]:
-    y_np = np.asarray(y)
-    W_train = jnp.asarray(np.stack([tr for tr, _ in folds]))
-    val_masks = [va for _, va in folds]
-    l2s = jnp.asarray(
-        [float(g.get("reg_param", est.reg_param)) for g in grids],
-        dtype=jnp.float32)
-    if sharding is not None:
-        l2s = jax.device_put(l2s, sharding)
-    fit_gk = jax.jit(jax.vmap(jax.vmap(
-        lambda l2, w: fit_linreg(X, y, w, l2), in_axes=(None, 0)),
-        in_axes=(0, None)))
-    params = fit_gk(l2s, W_train)
-    preds = jax.jit(jax.vmap(jax.vmap(
-        lambda p: predict_linreg(p, X))))(params)
-    return _eval_grid_fold(evaluator, y_np, preds, val_masks)
-
-
 def _sweep_generic(est, grids: List[Dict], X, y, folds, evaluator,
                    ctx) -> List[List[float]]:
-    """Fallback: python loop over grids × folds (tree models etc.)."""
+    """Fallback: python loop over grids × folds (host metric path)."""
     from transmogrifai_tpu.models.trees import _TreeEstimatorBase
     out = []
     y_np = np.asarray(y)
@@ -135,11 +94,301 @@ def _sweep_generic(est, grids: List[Dict], X, y, folds, evaluator,
     return out
 
 
+# --------------------------------------------------------------------------- #
+# batched execution scaffold                                                  #
+# --------------------------------------------------------------------------- #
+
+def _grid_param(est, grid: Dict, name: str) -> Any:
+    return grid.get(name, getattr(est, name, est.params.get(name)))
+
+
+def _shard_dyn(dyn: Dict[str, jnp.ndarray], sharding) -> Dict[str, jnp.ndarray]:
+    if sharding is None:
+        return dyn
+    g = next(iter(dyn.values())).shape[0]
+    n_shards = sharding.mesh.shape[sharding.spec[0]] if sharding.spec else 1
+    if n_shards > 1 and g % n_shards != 0:
+        return dyn  # uneven grid axis: leave replicated
+    return {k: jax.device_put(v, sharding) for k, v in dyn.items()}
+
+
+def _run_block(one_cfg: Callable, dyn: Dict[str, jnp.ndarray], sharding,
+               grid_vmap: bool) -> np.ndarray:
+    """Execute metric block: one_cfg(dyn_slice) -> (k,) over the grid axis.
+
+    vmap → parallel over grids (sharded across the mesh's sweep axis when
+    `sharding` is set); lax.map → sequential single compile (bounds the peak
+    memory of deep-tree histogram building on one chip).
+    """
+    dyn = _shard_dyn(dyn, sharding)
+    if grid_vmap or sharding is not None:
+        prog = jax.jit(jax.vmap(one_cfg))
+    else:
+        prog = jax.jit(lambda d: jax.lax.map(one_cfg, d))
+    return np.asarray(jax.block_until_ready(prog(dyn)))  # (g, k)
+
+
+def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
+                  static_of: Callable[[Dict], Tuple],
+                  dyn_of: Callable[[Dict], Dict[str, Any]],
+                  build: Callable[[Tuple, List[int]], Callable],
+                  grid_vmap: Callable[[Tuple, List[int]], bool] = lambda s, i: True,
+                  ) -> List[List[float]]:
+    """Shared scaffold: group grids by static params; per group, stack the
+    dynamic params into traced vectors and run fit→predict→metric as one
+    program. `build(static, idxs)` returns `fit_predict(dyn_slice, w) -> pred`.
+    """
+    groups: Dict[Tuple, List[int]] = {}
+    for i, g in enumerate(grids):
+        groups.setdefault(static_of(g), []).append(i)
+    metrics: List[Optional[List[float]]] = [None] * len(grids)
+    for static, idxs in groups.items():
+        dyn_dicts = [dyn_of(grids[i]) for i in idxs]
+        dyn = {k: jnp.asarray([d[k] for d in dyn_dicts],
+                              jnp.int32 if isinstance(dyn_dicts[0][k], int)
+                              else jnp.float32)
+               for k in dyn_dicts[0]}
+        fit_predict = build(static, idxs)
+
+        def one_cfg(d, fit_predict=fit_predict):
+            def one_fold(w, v):
+                return metric_fn(y, fit_predict(d, w), v)
+            return jax.vmap(one_fold)(W, V)
+
+        gk = _run_block(one_cfg, dyn, sharding, grid_vmap(static, idxs))
+        for row_i, grid_i in enumerate(idxs):
+            metrics[grid_i] = [float(m) for m in gk[row_i]]
+    return metrics  # type: ignore[return-value]
+
+
+# --------------------------------------------------------------------------- #
+# family handlers                                                             #
+# --------------------------------------------------------------------------- #
+
+def _sweep_logistic(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    n_classes = est.n_classes or infer_n_classes(np.asarray(y))
+    return _sweep_blocks(
+        grids, y, W, V, metric_fn, sharding,
+        static_of=lambda g: (int(_grid_param(est, g, "max_iter")),),
+        dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
+        build=lambda st, idxs: lambda d, w: predict_logreg(
+            fit_logreg(X, y, w, d["reg"], n_classes, st[0]), X))
+
+
+def _sweep_linreg(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    return _sweep_blocks(
+        grids, y, W, V, metric_fn, sharding,
+        static_of=lambda g: (),
+        dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
+        build=lambda st, idxs: lambda d, w: predict_linreg(
+            fit_linreg(X, y, w, d["reg"]), X))
+
+
+def _sweep_svc(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    return _sweep_blocks(
+        grids, y, W, V, metric_fn, sharding,
+        static_of=lambda g: (int(_grid_param(est, g, "max_iter")),),
+        dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
+        build=lambda st, idxs: lambda d, w: predict_linear_svc(
+            fit_linear_svc(X, y, w, d["reg"], st[0]), X))
+
+
+def _sweep_glm(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    def build(st, idxs):
+        family, max_iter, var_power = st
+        return lambda d, w: predict_glm(
+            fit_glm(X, y, w, d["reg"], family, max_iter, var_power), X, family)
+    return _sweep_blocks(
+        grids, y, W, V, metric_fn, sharding,
+        static_of=lambda g: (str(_grid_param(est, g, "family")),
+                             int(_grid_param(est, g, "max_iter")),
+                             float(_grid_param(est, g, "var_power"))),
+        dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
+        build=build)
+
+
+def _sweep_nb(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    if bool(jnp.any(X < 0)):  # Spark parity: family fails, selector drops it
+        raise ValueError(
+            "NaiveBayes requires non-negative features (Spark parity)")
+    n_classes = est.n_classes or infer_n_classes(np.asarray(y))
+    return _sweep_blocks(
+        grids, y, W, V, metric_fn, sharding,
+        static_of=lambda g: (),
+        dyn_of=lambda g: {"smoothing": float(_grid_param(est, g, "smoothing"))},
+        build=lambda st, idxs: lambda d, w: predict_naive_bayes(
+            fit_naive_bayes(X, y, w, d["smoothing"], n_classes), X))
+
+
+def _sweep_mlp(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    n_classes = est.n_classes or infer_n_classes(np.asarray(y))
+    seed = ctx.seed if ctx is not None else 0
+
+    def build(st, idxs):
+        hidden, max_iter = st
+        layers = (int(X.shape[1]),) + tuple(hidden) + (n_classes,)
+        return lambda d, w: predict_mlp(
+            fit_mlp(X, y, w, layers, max_iter, d["lr"], seed), X)
+    return _sweep_blocks(
+        grids, y, W, V, metric_fn, sharding,
+        static_of=lambda g: (tuple(_grid_param(est, g, "hidden_layers")),
+                             int(_grid_param(est, g, "max_iter"))),
+        dyn_of=lambda g: {"lr": float(_grid_param(est, g, "learning_rate"))},
+        build=build)
+
+
+# --------------------------------------------------------------------------- #
+# tree families: padded-depth trick, one compile per (bins, trees) group      #
+# --------------------------------------------------------------------------- #
+
+def _binned_cache(est, grids, X) -> Dict[int, jnp.ndarray]:
+    """Bin X once per distinct max_bins in the family (host quantiles).
+    (The eager fallback path has its own per-estimator `_bin_cache`.)"""
+    out: Dict[int, jnp.ndarray] = {}
+    for g in grids:
+        mb = int(_grid_param(est, g, "max_bins"))
+        if mb not in out:
+            edges = quantile_bin_edges(np.asarray(X), mb)
+            out[mb] = bin_features(jnp.asarray(X), jnp.asarray(edges))
+    return out
+
+
+def _pad_depth_of(est, grids, idxs) -> int:
+    return max(int(_grid_param(est, grids[i], "max_depth")) for i in idxs)
+
+
+def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
+                  regression: bool):
+    xb_by_bins = _binned_cache(est, grids, X)
+    if regression:
+        Y = jnp.asarray(y)[:, None]
+        n_out = 1
+    else:
+        k = est.n_classes or infer_n_classes(np.asarray(y))
+        Y = jax.nn.one_hot(jnp.asarray(y).astype(jnp.int32), k)
+        n_out = k
+    seed = ctx.seed if ctx is not None else 0
+    pred_fn = forest_regression_pred if regression else forest_classification_pred
+    # single deterministic tree for DT estimators (no Poisson bootstrap), so
+    # sweep metrics describe exactly what the refit fit_arrays produces
+    bootstrap = not isinstance(
+        est, (OpDecisionTreeClassifier, OpDecisionTreeRegressor))
+
+    def build(st, idxs):
+        n_trees, max_bins, subsample = st
+        Xb = xb_by_bins[max_bins]
+        pad_depth = _pad_depth_of(est, grids, idxs)
+
+        def fit_predict(d, w):
+            trees = fit_forest(Xb, Y, w, n_trees, pad_depth, max_bins,
+                               n_out, seed, subsample, d["mcw"],
+                               active_depth=d["depth"], bootstrap=bootstrap)
+            return pred_fn(trees, Xb)
+        return fit_predict
+
+    return _sweep_blocks(
+        grids, y, W, V, metric_fn, sharding,
+        static_of=lambda g: (int(_grid_param(est, g, "n_trees")),
+                             int(_grid_param(est, g, "max_bins")),
+                             bool(_grid_param(est, g, "subsample_features"))),
+        dyn_of=lambda g: {
+            "depth": int(_grid_param(est, g, "max_depth")),
+            "mcw": float(_grid_param(est, g, "min_child_weight"))},
+        build=build,
+        grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6)
+
+
+def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    xb_by_bins = _binned_cache(est, grids, X)
+    objective = est._objective
+
+    def lr_of(grid) -> float:
+        v = grid.get("eta", grid.get("learning_rate"))
+        if v is None:
+            v = est.params.get("eta", getattr(est, "learning_rate", 0.1))
+        return float(v)
+
+    def build(st, idxs):
+        n_estimators, max_bins = st
+        Xb = xb_by_bins[max_bins]
+        pad_depth = _pad_depth_of(est, grids, idxs)
+
+        def fit_predict(d, w):
+            # the scan carry is the final training-matrix margin — no
+            # post-fit forest re-walk needed
+            _, margin = fit_gbt(Xb, y, w, n_estimators, pad_depth, max_bins,
+                                d["lr"], d["lam"], objective, d["mcw"],
+                                active_depth=d["depth"])
+            return gbt_pred_from_margin(margin, objective)
+        return fit_predict
+
+    return _sweep_blocks(
+        grids, y, W, V, metric_fn, sharding,
+        static_of=lambda g: (int(_grid_param(est, g, "n_estimators")),
+                             int(_grid_param(est, g, "max_bins"))),
+        dyn_of=lambda g: {
+            "depth": int(_grid_param(est, g, "max_depth")),
+            "lr": lr_of(g),
+            "lam": float(_grid_param(est, g, "reg_lambda")),
+            "mcw": float(_grid_param(est, g, "min_child_weight"))},
+        build=build,
+        grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6)
+
+
+# --------------------------------------------------------------------------- #
+# dispatch                                                                    #
+# --------------------------------------------------------------------------- #
+
+def _dispatch(est) -> Optional[Callable]:
+    # order matters: subclasses before parents
+    if isinstance(est, (OpXGBoostClassifier, OpXGBoostRegressor,
+                        OpGBTClassifier, OpGBTRegressor)):
+        return _sweep_gbt
+    if isinstance(est, (OpRandomForestRegressor, OpDecisionTreeRegressor)):
+        return lambda *a: _sweep_forest(*a, regression=True)
+    if isinstance(est, (OpRandomForestClassifier, OpDecisionTreeClassifier)):
+        return lambda *a: _sweep_forest(*a, regression=False)
+    if isinstance(est, OpLogisticRegression):
+        return _sweep_logistic
+    if isinstance(est, OpLinearRegression):
+        return _sweep_linreg
+    if isinstance(est, OpLinearSVC):
+        return _sweep_svc
+    if isinstance(est, OpGeneralizedLinearRegression):
+        return _sweep_glm
+    if isinstance(est, OpNaiveBayes):
+        return _sweep_nb
+    if isinstance(est, OpMultilayerPerceptronClassifier):
+        return _sweep_mlp
+    return None
+
+
 def run_sweep(est, grids: List[Dict], X, y, folds, evaluator, ctx,
               sharding=None) -> List[List[float]]:
     """Metric matrix [grid][fold] for one model family."""
-    if isinstance(est, OpLogisticRegression):
-        return _sweep_logistic(est, grids, X, y, folds, evaluator, sharding)
-    if isinstance(est, OpLinearRegression):
-        return _sweep_linear(est, grids, X, y, folds, evaluator, sharding)
-    return _sweep_generic(est, grids, X, y, folds, evaluator, ctx)
+    handler = _dispatch(est)
+    metric_fn = None
+    if handler is not None:
+        try:
+            n_classes = getattr(est, "n_classes", None) or \
+                infer_n_classes(np.asarray(y))
+        except Exception:
+            n_classes = None
+        metric_fn = make_device_metric(evaluator, n_classes=n_classes)
+    if handler is None or metric_fn is None:
+        return _sweep_generic(est, grids, X, y, folds, evaluator, ctx)
+    W = jnp.asarray(np.stack([tr for tr, _ in folds]))
+    V = jnp.asarray(np.stack([va for _, va in folds]))
+    if ctx is not None and ctx.mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from transmogrifai_tpu.parallel.mesh import DATA_AXIS
+        data_size = ctx.mesh.shape.get(DATA_AXIS, 1)
+        n = int(np.asarray(y).shape[0])
+        if data_size > 1 and n % data_size == 0:
+            mesh = ctx.mesh
+            X = jax.device_put(X, NamedSharding(mesh, P(DATA_AXIS, None)))
+            y = jax.device_put(y, NamedSharding(mesh, P(DATA_AXIS)))
+            W = jax.device_put(W, NamedSharding(mesh, P(None, DATA_AXIS)))
+            V = jax.device_put(V, NamedSharding(mesh, P(None, DATA_AXIS)))
+    return handler(est, grids, X, y, W, V, metric_fn, ctx, sharding)
