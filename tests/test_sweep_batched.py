@@ -225,3 +225,32 @@ def test_padded_depth_equals_exact_depth(clf_data):
         solo = np.asarray(S.run_sweep(OpRandomForestClassifier(n_trees=4),
                                       [g], X, y, folds, ev, ctx))
         np.testing.assert_allclose(mixed[i], solo[0], atol=1e-5)
+
+
+def test_lambda_evaluator_uses_batched_fits_with_host_metrics(rng):
+    """A LambdaEvaluator has no device kernel, but the sweep must still run
+    the batched fit+predict program (HostMetricFallback), matching the fully
+    eager host loop."""
+    from transmogrifai_tpu.evaluators.evaluators import LambdaEvaluator
+    from transmogrifai_tpu.evaluators.metrics import auroc_score
+    from transmogrifai_tpu.models import OpLogisticRegression
+
+    n, d = 200, 5
+    X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    y_np = (rng.uniform(size=n) > 0.5).astype(np.float64)
+    y = jnp.asarray(y_np.astype(np.float32))
+    folds = OpCrossValidation(n_folds=2, seed=0).splits(y_np)
+
+    def custom(label, pred):
+        yv = np.asarray(label.data["value"], dtype=np.float64)
+        s = np.asarray(pred.data["probability"])[:, 1]
+        return auroc_score(yv, s)
+
+    ev = LambdaEvaluator("customAuROC", custom)
+    est = OpLogisticRegression(max_iter=10)
+    grids = [{"reg_param": r} for r in (0.001, 0.1)]
+    ctx = FitContext(n_rows=n)
+
+    got = S.run_sweep(est, grids, X, y, folds, ev, ctx)
+    want = S._sweep_generic(est, grids, X, y, folds, ev, ctx)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)

@@ -102,30 +102,45 @@ def _grid_param(est, grid: Dict, name: str) -> Any:
     return grid.get(name, getattr(est, name, est.params.get(name)))
 
 
+class HostMetricFallback:
+    """Marker metric_fn: run the batched fit+predict XLA program, but score
+    with a host evaluator (LambdaEvaluator / metrics with no device kernel).
+    """
+
+    def __init__(self, evaluator):
+        self.evaluator = evaluator
+
+
 def _shard_dyn(dyn: Dict[str, jnp.ndarray], sharding) -> Dict[str, jnp.ndarray]:
     if sharding is None:
         return dyn
     g = next(iter(dyn.values())).shape[0]
     n_shards = sharding.mesh.shape[sharding.spec[0]] if sharding.spec else 1
     if n_shards > 1 and g % n_shards != 0:
-        return dyn  # uneven grid axis: leave replicated
+        log.warning(
+            "sweep axis: grid group of %d configs is not divisible by the "
+            "%d-way sweep mesh axis; leaving the grid axis replicated", g,
+            n_shards)
+        return dyn
     return {k: jax.device_put(v, sharding) for k, v in dyn.items()}
 
 
 def _run_block(one_cfg: Callable, dyn: Dict[str, jnp.ndarray], sharding,
-               grid_vmap: bool) -> np.ndarray:
-    """Execute metric block: one_cfg(dyn_slice) -> (k,) over the grid axis.
+               grid_vmap: bool):
+    """Execute one grid block: one_cfg(dyn_slice) over the grid axis.
 
     vmap → parallel over grids (sharded across the mesh's sweep axis when
     `sharding` is set); lax.map → sequential single compile (bounds the peak
-    memory of deep-tree histogram building on one chip).
+    memory of deep-tree histogram building on one chip). Returns the raw
+    jax output (a (g, k) metric array, or a prediction pytree with leading
+    (g, k) axes on the host-metric fallback path).
     """
     dyn = _shard_dyn(dyn, sharding)
     if grid_vmap or sharding is not None:
         prog = jax.jit(jax.vmap(one_cfg))
     else:
         prog = jax.jit(lambda d: jax.lax.map(one_cfg, d))
-    return np.asarray(jax.block_until_ready(prog(dyn)))  # (g, k)
+    return jax.block_until_ready(prog(dyn))
 
 
 def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
@@ -137,11 +152,19 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
     """Shared scaffold: group grids by static params; per group, stack the
     dynamic params into traced vectors and run fit→predict→metric as one
     program. `build(static, idxs)` returns `fit_predict(dyn_slice, w) -> pred`.
+
+    A `HostMetricFallback` metric_fn (custom/LambdaEvaluator metrics with no
+    device kernel) keeps the batched fit+predict program but evaluates the
+    wrapped evaluator over the materialized (g, k, n, …) prediction pytree
+    on host — fits stay one XLA program per group either way.
     """
     groups: Dict[Tuple, List[int]] = {}
     for i, g in enumerate(grids):
         groups.setdefault(static_of(g), []).append(i)
     metrics: List[Optional[List[float]]] = [None] * len(grids)
+    host = isinstance(metric_fn, HostMetricFallback)
+    y_np = np.asarray(y) if host else None
+    V_np = np.asarray(V) if host else None
     for static, idxs in groups.items():
         dyn_dicts = [dyn_of(grids[i]) for i in idxs]
         dyn = {k: jnp.asarray([d[k] for d in dyn_dicts],
@@ -152,12 +175,23 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
 
         def one_cfg(d, fit_predict=fit_predict):
             def one_fold(w, v):
-                return metric_fn(y, fit_predict(d, w), v)
+                pred = fit_predict(d, w)
+                return pred if host else metric_fn(y, pred, v)
             return jax.vmap(one_fold)(W, V)
 
         gk = _run_block(one_cfg, dyn, sharding, grid_vmap(static, idxs))
-        for row_i, grid_i in enumerate(idxs):
-            metrics[grid_i] = [float(m) for m in gk[row_i]]
+        if host:
+            pred_np = jax.tree_util.tree_map(np.asarray, gk)
+            for row_i, grid_i in enumerate(idxs):
+                metrics[grid_i] = [
+                    _metric(metric_fn.evaluator, y_np,
+                            {k: v[row_i, fold_j] for k, v in pred_np.items()},
+                            V_np[fold_j])
+                    for fold_j in range(V_np.shape[0])]
+        else:
+            gk = np.asarray(gk)
+            for row_i, grid_i in enumerate(idxs):
+                metrics[grid_i] = [float(m) for m in gk[row_i]]
     return metrics  # type: ignore[return-value]
 
 
@@ -241,14 +275,29 @@ def _sweep_mlp(est, grids, X, y, W, V, metric_fn, ctx, sharding):
 # tree families: padded-depth trick, one compile per (bins, trees) group      #
 # --------------------------------------------------------------------------- #
 
-def _binned_cache(est, grids, X) -> Dict[int, jnp.ndarray]:
-    """Bin X once per distinct max_bins in the family (host quantiles).
-    (The eager fallback path has its own per-estimator `_bin_cache`.)"""
-    out: Dict[int, jnp.ndarray] = {}
+def _binned_cache(est, grids, X, ctx) -> Dict[int, jnp.ndarray]:
+    """Bin X once per distinct max_bins ACROSS tree families in a sweep:
+    the cache lives on the FitContext, so RF and XGB in the same selector
+    share the quantile binning of the identical training matrix. (The eager
+    fallback path has its own per-estimator `_bin_cache`.)
+
+    Quantile edges come from the UNPADDED rows (`ctx._sweep_n_rows`): mesh
+    padding appends zero-weight rows which must not shift bin edges, or
+    sharded sweeps would silently deviate from unsharded ones."""
+    out = getattr(ctx, "_sweep_bin_cache", None) if ctx is not None else None
+    if out is None:
+        out = {}
+        if ctx is not None:
+            ctx._sweep_bin_cache = out
+    n = getattr(ctx, "_sweep_n_rows", None) if ctx is not None else None
+    X_edges = None  # device→host gather only on a cache miss
     for g in grids:
         mb = int(_grid_param(est, g, "max_bins"))
         if mb not in out:
-            edges = quantile_bin_edges(np.asarray(X), mb)
+            if X_edges is None:
+                X_host = np.asarray(X)
+                X_edges = X_host if n is None else X_host[:n]
+            edges = quantile_bin_edges(X_edges, mb)
             out[mb] = bin_features(jnp.asarray(X), jnp.asarray(edges))
     return out
 
@@ -259,7 +308,7 @@ def _pad_depth_of(est, grids, idxs) -> int:
 
 def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
                   regression: bool):
-    xb_by_bins = _binned_cache(est, grids, X)
+    xb_by_bins = _binned_cache(est, grids, X, ctx)
     if regression:
         Y = jnp.asarray(y)[:, None]
         n_out = 1
@@ -299,7 +348,7 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
 
 
 def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
-    xb_by_bins = _binned_cache(est, grids, X)
+    xb_by_bins = _binned_cache(est, grids, X, ctx)
     objective = est._objective
 
     def lr_of(grid) -> float:
@@ -367,28 +416,49 @@ def run_sweep(est, grids: List[Dict], X, y, folds, evaluator, ctx,
               sharding=None) -> List[List[float]]:
     """Metric matrix [grid][fold] for one model family."""
     handler = _dispatch(est)
-    metric_fn = None
-    if handler is not None:
-        try:
-            n_classes = getattr(est, "n_classes", None) or \
-                infer_n_classes(np.asarray(y))
-        except Exception:
-            n_classes = None
-        metric_fn = make_device_metric(evaluator, n_classes=n_classes)
-    if handler is None or metric_fn is None:
+    if handler is None:
         return _sweep_generic(est, grids, X, y, folds, evaluator, ctx)
-    W = jnp.asarray(np.stack([tr for tr, _ in folds]))
-    V = jnp.asarray(np.stack([va for _, va in folds]))
-    if ctx is not None and ctx.mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    try:
+        n_classes = getattr(est, "n_classes", None) or \
+            infer_n_classes(np.asarray(y))
+    except Exception:
+        n_classes = None
+    # no device kernel for this evaluator → batched fits, host metrics
+    metric_fn = (make_device_metric(evaluator, n_classes=n_classes)
+                 or HostMetricFallback(evaluator))
+    cached = getattr(ctx, "_sweep_data_cache", None) if ctx is not None else None
+    if cached is not None:
+        X, y, W, V = cached  # same selector fit: reuse the padded/sharded set
+    else:
+        W = jnp.asarray(np.stack([tr for tr, _ in folds]))
+        V = jnp.asarray(np.stack([va for _, va in folds]))
+        if ctx is not None and ctx.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from transmogrifai_tpu.parallel.mesh import DATA_AXIS
-        data_size = ctx.mesh.shape.get(DATA_AXIS, 1)
-        n = int(np.asarray(y).shape[0])
-        if data_size > 1 and n % data_size == 0:
-            mesh = ctx.mesh
-            X = jax.device_put(X, NamedSharding(mesh, P(DATA_AXIS, None)))
-            y = jax.device_put(y, NamedSharding(mesh, P(DATA_AXIS)))
-            W = jax.device_put(W, NamedSharding(mesh, P(None, DATA_AXIS)))
-            V = jax.device_put(V, NamedSharding(mesh, P(None, DATA_AXIS)))
+            from transmogrifai_tpu.parallel.mesh import DATA_AXIS
+            data_size = ctx.mesh.shape.get(DATA_AXIS, 1)
+            n = int(np.asarray(y).shape[0])
+            if data_size > 1:
+                # every fit/metric is weight-masked, so rows pad with zero
+                # weight in ALL folds — sharding never silently degrades to
+                # replication on uneven row counts. Tree binning must ignore
+                # the pad rows (see _binned_cache); bootstrap streams are
+                # prefix-stable across the padded shape.
+                ctx._sweep_n_rows = n
+                pad = (-n) % data_size
+                if pad:
+                    X = jnp.concatenate(
+                        [X, jnp.zeros((pad, X.shape[1]), X.dtype)])
+                    y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+                    W = jnp.concatenate(
+                        [W, jnp.zeros((W.shape[0], pad), W.dtype)], axis=1)
+                    V = jnp.concatenate(
+                        [V, jnp.zeros((V.shape[0], pad), V.dtype)], axis=1)
+                mesh = ctx.mesh
+                X = jax.device_put(X, NamedSharding(mesh, P(DATA_AXIS, None)))
+                y = jax.device_put(y, NamedSharding(mesh, P(DATA_AXIS)))
+                W = jax.device_put(W, NamedSharding(mesh, P(None, DATA_AXIS)))
+                V = jax.device_put(V, NamedSharding(mesh, P(None, DATA_AXIS)))
+        if ctx is not None:
+            ctx._sweep_data_cache = (X, y, W, V)
     return handler(est, grids, X, y, W, V, metric_fn, ctx, sharding)
