@@ -159,13 +159,41 @@ class TestConditionalReader:
         amount, tag, label = _raw_features()
         reader = DataReaders.conditional(
             EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
-            target_condition=lambda r: r["converted"] == 1)
+            target_condition=lambda r: r["converted"] == 1,
+            drop_if_not_met=True)
         ds = reader.read([amount, tag, label])
         rows = {r["key"]: r for r in ds.to_rows()}
         # only 'a' has a converting event (day 8): predictors fold days < 8
         assert set(rows) == {"a"}
         assert rows["a"]["amount"] == 15.0
         assert rows["a"]["converted"] == 1.0
+
+    def test_unmatched_kept_by_default(self):
+        # reference parity: dropIfTargetConditionNotMet defaults to FALSE
+        # (ConditionalParams, DataReader.scala:375)
+        amount, tag, label = _raw_features()
+        reader = DataReaders.conditional(
+            EVENTS, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
+            target_condition=lambda r: r["converted"] == 1)
+        ds = reader.read([amount, tag, label])
+        assert {r["key"] for r in ds.to_rows()} == {"a", "b"}
+
+    def test_time_stamp_to_keep_min_max(self):
+        amount, tag, label = _raw_features()
+        events = EVENTS + [
+            {"id": "a", "day": 6, "amount": 7.0, "tag": "x", "converted": 1}]
+        common = dict(key_fn=lambda r: r["id"], time_fn=lambda r: r["day"],
+                      target_condition=lambda r: r["converted"] == 1,
+                      drop_if_not_met=True)
+        lo = DataReaders.conditional(
+            events, time_stamp_to_keep="min", **common).read([amount, label])
+        hi = DataReaders.conditional(
+            events, time_stamp_to_keep="max", **common).read([amount, label])
+        lo_amt = {r["key"]: r["amount"] for r in lo.to_rows()}["a"]
+        hi_amt = {r["key"]: r["amount"] for r in hi.to_rows()}["a"]
+        # min cutoff = day 6 (predictors: days 1,2); max = day 8 (adds day 6)
+        assert lo_amt == 15.0
+        assert hi_amt == 22.0
 
     def test_keep_unmatched(self):
         amount, _, label = _raw_features()
@@ -271,3 +299,34 @@ class TestStreamingReader:
         reader = DataReaders.stream(csv_path=str(p), batch_size=2)
         batches = list(reader.stream())
         assert [len(b) for b in batches] == [2, 1]
+
+
+class TestJoinDerivability:
+    def test_single_aggregating_side_restricted_to_derivable(self):
+        # ADVICE r1 (medium): an aggregating reader without features= joined
+        # to a SimpleReader must only aggregate raw features derivable from
+        # ITS records — the simple side's column must not be shadowed by a
+        # garbage pre-extracted column
+        events = [{"id": "a", "day": 1, "spend": 5.0},
+                  {"id": "a", "day": 2, "spend": 7.0}]
+        profiles = [{"id": "a", "age": 33.0}]
+        spend = FeatureBuilder.Real("spend").from_column("spend").as_predictor()
+        age = FeatureBuilder.Real("age").from_column("age").as_predictor()
+        agg = DataReaders.aggregate(
+            events, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"])
+        simple = DataReaders.simple(records=profiles, key_fn=lambda r: r["id"])
+        ds = agg.left_outer_join(simple).read([spend, age])
+        row = ds.to_rows()[0]
+        assert row["spend"] == 12.0   # aggregated by the event side
+        assert row["age"] == 33.0     # supplied intact by the simple side
+        assert "age" not in (ds.pre_extracted or set())
+
+    def test_two_aggregating_readers_without_allowlists_raise(self):
+        events = [{"id": "a", "day": 1, "spend": 5.0}]
+        spend = FeatureBuilder.Real("spend").from_column("spend").as_predictor()
+        a = DataReaders.aggregate(
+            events, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"])
+        b = DataReaders.aggregate(
+            events, key_fn=lambda r: r["id"], time_fn=lambda r: r["day"])
+        with pytest.raises(ValueError, match="allowlist"):
+            a.inner_join(b).read([spend])

@@ -402,19 +402,29 @@ def aggregate_events(events: List[Event], ftype: type,
                      aggregator: Optional[MonoidAggregator] = None,
                      cutoff: Optional[CutOffTime] = None,
                      is_response: bool = False,
-                     window_ms: Optional[int] = None) -> Any:
+                     window_ms: Optional[int] = None,
+                     response_window_ms: Optional[int] = None,
+                     predictor_window_ms: Optional[int] = None) -> Any:
     """FeatureAggregator.extract (aggregators/FeatureAggregator.scala):
     split events around the cutoff — predictors fold events strictly
-    *before* it (optionally only within `window_ms` back from it),
-    responses fold events *at/after* it (optionally only `window_ms`
-    forward) — then apply the monoid."""
+    *before* it (optionally only within the window back from it),
+    responses fold events *at/after* it (optionally only the window
+    forward) — then apply the monoid.
+
+    `window_ms` is the feature's own aggregate window and wins over the
+    reader-level `response_window_ms`/`predictor_window_ms`
+    (`specialTimeWindow.orElse(timeWindow)`, FeatureAggregator.scala)."""
     agg = aggregator or default_aggregator(ftype)
+    if window_ms is None:
+        window_ms = response_window_ms if is_response else predictor_window_ms
     ts = None if cutoff is None else cutoff.timestamp
     if ts is None:
         kept = events
     elif is_response:
+        # inclusive upper bound: the reference keeps events exactly at
+        # cutoff + window (FeatureAggregator.scala filterByDateWithCutoff)
         hi = None if window_ms is None else ts + window_ms
-        kept = [e for e in events if e.time >= ts and (hi is None or e.time < hi)]
+        kept = [e for e in events if e.time >= ts and (hi is None or e.time <= hi)]
     else:
         # an infinite-future cutoff means "everything is a predictor" — a
         # window anchored at infinity must not filter anything out

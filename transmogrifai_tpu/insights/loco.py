@@ -38,10 +38,15 @@ class RecordInsightsLOCO(HostTransformer):
     in_types = (T.OPVector,)
     out_type = T.TextMap
 
-    def __init__(self, model=None, top_k: int = 20, uid: Optional[str] = None):
+    def __init__(self, model=None, top_k: int = 20, group_chunk: int = 32,
+                 uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.model = model
         self.params["top_k"] = int(top_k)
+        # bound peak device memory: the ablation batch materializes
+        # chunk × n copies of X, so metadata-less wide vectors (G = d)
+        # don't OOM where the reference's per-column loop would not
+        self.params["group_chunk"] = int(group_chunk)
 
     # -- grouping ------------------------------------------------------- #
 
@@ -86,9 +91,13 @@ class RecordInsightsLOCO(HostTransformer):
         masks = jnp.asarray(masks_np)
 
         base = self._scores(X)                                    # (n, C)
-        ablated = jax.vmap(lambda m: self._scores(X * (1.0 - m)))(masks)
-        diffs = base[None, :, :] - ablated                        # (G, n, C)
-        diffs_np = np.asarray(diffs)
+        chunk = max(1, self.params.get("group_chunk", 32))
+        parts: List[np.ndarray] = []
+        for s in range(0, masks.shape[0], chunk):
+            ablated = jax.vmap(
+                lambda m: self._scores(X * (1.0 - m)))(masks[s:s + chunk])
+            parts.append(np.asarray(base[None, :, :] - ablated))
+        diffs_np = np.concatenate(parts, axis=0)                  # (G, n, C)
 
         top_k = min(self.params["top_k"], len(names))
         strength = np.max(np.abs(diffs_np), axis=2)               # (G, n)
@@ -106,7 +115,8 @@ class RecordInsightsLOCO(HostTransformer):
         return Column(T.TextMap, out)
 
     def get_params(self) -> Dict[str, Any]:
-        return {"top_k": self.params["top_k"]}
+        return {"top_k": self.params["top_k"],
+                "group_chunk": self.params["group_chunk"]}
 
 
 class RecordInsightsParser:

@@ -69,17 +69,21 @@ class FeatureInsights:
         }
 
 
-def _tree_importances(trees, d: int) -> Optional[np.ndarray]:
+def _tree_importances(trees, d: int,
+                      n_bins: Optional[int] = None) -> Optional[np.ndarray]:
     """Split-frequency importances from dense histogram trees
     ({"feat","bin","leaf"} pytrees, models/trees.py): count valid splits per
-    feature (bin == n_bins marks "no split")."""
+    feature (bin == n_bins marks "no split"). `n_bins` must come from the
+    model — inferring the sentinel as bins.max() would wrongly exclude real
+    splits at the top bin when no node is unsplit."""
     try:
         counts = np.zeros(d, dtype=np.float64)
         tlist = trees if isinstance(trees, (list, tuple)) else [trees]
         for t in tlist:
             feat = np.asarray(t["feat"]).reshape(-1)
             bins = np.asarray(t["bin"]).reshape(-1)
-            valid = bins < bins.max()  # n_bins sentinel = unsplit node
+            sentinel = n_bins if n_bins is not None else bins.max()
+            valid = bins < sentinel
             for f in feat[valid]:
                 if 0 <= int(f) < d:
                     counts[int(f)] += 1.0
@@ -106,7 +110,10 @@ def feature_contributions(model, d: int) -> List[List[float]]:
         return [[float(b[j])] for j in range(min(d, b.size))]
     trees = getattr(model, "trees", None)
     if trees is not None:
-        imp = _tree_importances(trees, d)
+        # edges is (d, max_bins-1) → the "unsplit" bin sentinel is max_bins
+        edges = getattr(model, "edges", None)
+        n_bins = None if edges is None else int(np.asarray(edges).shape[1]) + 1
+        imp = _tree_importances(trees, d, n_bins=n_bins)
         if imp is not None:
             return [[float(imp[j])] for j in range(d)]
     inner = getattr(model, "model", None) or getattr(model, "best_model", None)

@@ -426,10 +426,21 @@ def run_sweep(est, grids: List[Dict], X, y, folds, evaluator, ctx,
     # no device kernel for this evaluator → batched fits, host metrics
     metric_fn = (make_device_metric(evaluator, n_classes=n_classes)
                  or HostMetricFallback(evaluator))
+    # the cache entry RETAINS the keying objects so `is` comparisons are
+    # safe (an id()-only key could false-hit after GC address reuse): a
+    # FitContext reused with different X/y/folds (public run_sweep callers)
+    # must not silently get the first call's arrays back
+    def _same_data(key_objs) -> bool:
+        kX, ky, kfolds = key_objs
+        return (kX is X and ky is y and len(kfolds) == len(folds)
+                and all(a is c and b is d
+                        for (a, b), (c, d) in zip(kfolds, folds)))
+
     cached = getattr(ctx, "_sweep_data_cache", None) if ctx is not None else None
-    if cached is not None:
-        X, y, W, V = cached  # same selector fit: reuse the padded/sharded set
+    if cached is not None and _same_data(cached[0]):
+        _, X, y, W, V = cached  # same selector fit: reuse padded/sharded set
     else:
+        key_objs = (X, y, list(folds))
         W = jnp.asarray(np.stack([tr for tr, _ in folds]))
         V = jnp.asarray(np.stack([va for _, va in folds]))
         if ctx is not None and ctx.mesh is not None:
@@ -460,5 +471,6 @@ def run_sweep(est, grids: List[Dict], X, y, folds, evaluator, ctx,
                 W = jax.device_put(W, NamedSharding(mesh, P(None, DATA_AXIS)))
                 V = jax.device_put(V, NamedSharding(mesh, P(None, DATA_AXIS)))
         if ctx is not None:
-            ctx._sweep_data_cache = (X, y, W, V)
+            ctx._sweep_data_cache = (key_objs, X, y, W, V)
+            ctx._sweep_bin_cache = {}  # binned-X cache is per-data too
     return handler(est, grids, X, y, W, V, metric_fn, ctx, sharding)

@@ -21,6 +21,7 @@ reads them directly instead of re-running extract functions).
 from __future__ import annotations
 
 import csv as _csv
+import logging
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Mapping, Optional, Sequence
 
 import numpy as np
@@ -31,6 +32,8 @@ from transmogrifai_tpu.aggregators import (
 from transmogrifai_tpu.data.dataset import Dataset
 
 KEY_COLUMN = "key"  # reference: DataFrameFieldNames.KeyFieldName
+
+log = logging.getLogger(__name__)
 
 
 def _record_value(stage, record: Mapping[str, Any]) -> Any:
@@ -58,6 +61,51 @@ def _own_features(reader, raw_features: Sequence) -> List:
         return list(raw_features)
     names = {f.name if hasattr(f, "name") else str(f) for f in allow}
     return [f for f in raw_features if f.name in names]
+
+
+def _derivable_features(reader, raw_features: Sequence,
+                        probe_limit: int = 100) -> List:
+    """Features actually derivable from this reader's records, probed over
+    the first `probe_limit` records (event streams have heterogeneous
+    records, so one record is not enough): column-based features need their
+    column present in SOME record; extract-fn features must yield a
+    non-None value on some record. Used when an aggregating reader joins
+    another reader without declaring a features= allowlist — it must not
+    aggregate (and then shadow) raw features owned by the other side.
+
+    Caveat: an extract fn with a non-None fallback (e.g.
+    `lambda r: r.get("age", 0.0)`) probes as derivable on ANY record and
+    will be claimed by the wrong side — declare a features= allowlist on
+    joined aggregating readers whenever extract fns have defaults."""
+    records = list(getattr(reader, "records", None) or [])
+    # column-based features: exact check over ALL records (cheap key scan —
+    # rare record types can first appear arbitrarily late in a stream)
+    all_keys: set = set()
+    for r in records:
+        all_keys.update(r.keys())
+    probes = records[:probe_limit]
+    out = []
+    for f in raw_features:
+        stage = f.origin_stage
+        if stage.extract is None:
+            if stage.column in all_keys:
+                out.append(f)
+            continue
+        for probe in probes:
+            try:
+                if stage.extract(probe) is not None:
+                    out.append(f)
+                    break
+            except Exception:
+                continue
+        else:
+            log.warning(
+                "JoinedDataReader: feature %r (extract fn) probed "
+                "non-derivable on the first %d records of an aggregating "
+                "side with no features= allowlist — it will come from the "
+                "other side / null-fill; declare features= to silence",
+                f.name, len(probes))
+    return out
 
 
 class Reader:
@@ -142,9 +190,13 @@ def _group_events(records: Iterable[Mapping[str, Any]],
 
 
 def _aggregate_groups(groups: Dict[str, List[Any]], raw_features: Sequence,
-                      cutoffs: Mapping[str, Optional[CutOffTime]]) -> Dataset:
+                      cutoffs: Mapping[str, Optional[CutOffTime]],
+                      response_window_ms: Optional[int] = None,
+                      predictor_window_ms: Optional[int] = None) -> Dataset:
     """Fold each key's event list through every raw feature's aggregator
-    (DataReader.scala:229-330: groupBy key → monoid fold per feature)."""
+    (DataReader.scala:229-330: groupBy key → monoid fold per feature).
+    Reader-level windows apply when a feature has no aggregate window of
+    its own (FeatureAggregator.scala specialTimeWindow.orElse)."""
     rows: List[Dict[str, Any]] = []
     schema: Dict[str, type] = {KEY_COLUMN: T.ID}
     for f in raw_features:
@@ -160,7 +212,9 @@ def _aggregate_groups(groups: Dict[str, List[Any]], raw_features: Sequence,
                       for t, rec in events_rec]
             row[f.name] = aggregate_events(
                 events, f.ftype, aggregator=agg, cutoff=cutoffs.get(key),
-                is_response=f.is_response, window_ms=window)
+                is_response=f.is_response, window_ms=window,
+                response_window_ms=response_window_ms,
+                predictor_window_ms=predictor_window_ms)
         rows.append(row)
     return _mark_pre_extracted(Dataset.from_rows(rows, schema=schema),
                                [f.name for f in raw_features])
@@ -193,25 +247,49 @@ class AggregateDataReader(Reader):
         cutoffs = {k: self.cutoff for k in groups}
         return _aggregate_groups(groups, raw_features, cutoffs)
 
+    def surviving_keys(self) -> List[str]:
+        """Keys this reader would emit (all of them — no row-dropping)."""
+        return sorted({str(self.key_fn(r)) for r in self.records})
+
+
+_WEEK_MS = 7 * 24 * 3600 * 1000  # reference default response/predictor window
+
 
 class ConditionalDataReader(Reader):
     """Per-key dynamic cutoff (DataReaders.Conditional,
-    DataReader.scala:303-367): the cutoff for each key is the time of its
-    earliest record satisfying `target_condition` — "simulate the state at
-    the moment event X happened". Keys with no matching record are dropped
-    when `drop_if_not_met` (else they keep all events as predictors)."""
+    DataReader.scala:303-367): each key's cutoff is chosen among the times
+    of its records satisfying `target_condition` — "simulate the state at
+    the moment event X happened". Reference-parity defaults
+    (ConditionalParams, DataReader.scala:369-375): unmatched keys are KEPT
+    (`drop_if_not_met=False`), `time_stamp_to_keep="random"` (seeded here,
+    unlike the reference's unseeded Random), and 7-day response/predictor
+    windows. Unmatched kept keys aggregate every event as predictor via an
+    infinite-future cutoff (deterministic, where the reference anchors at
+    wall-clock now())."""
 
     def __init__(self, records: Sequence[Mapping[str, Any]],
                  key_fn: Callable[[Mapping[str, Any]], str],
                  time_fn: Callable[[Mapping[str, Any]], int],
                  target_condition: Callable[[Mapping[str, Any]], bool],
-                 drop_if_not_met: bool = True,
+                 drop_if_not_met: bool = False,
+                 time_stamp_to_keep: str = "random",
+                 response_window_ms: Optional[int] = _WEEK_MS,
+                 predictor_window_ms: Optional[int] = _WEEK_MS,
+                 seed: int = 42,
                  features: Optional[Sequence] = None):
+        if time_stamp_to_keep not in ("min", "max", "random"):
+            raise ValueError(
+                f"time_stamp_to_keep must be min/max/random, "
+                f"got {time_stamp_to_keep!r}")
         self.records = records
         self.key_fn = key_fn
         self.time_fn = time_fn
         self.target_condition = target_condition
         self.drop_if_not_met = drop_if_not_met
+        self.time_stamp_to_keep = time_stamp_to_keep
+        self.response_window_ms = response_window_ms
+        self.predictor_window_ms = predictor_window_ms
+        self.seed = seed
         self.features = features
 
     def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
@@ -219,18 +297,42 @@ class ConditionalDataReader(Reader):
         if not raw_features:
             raise ValueError("ConditionalDataReader needs raw features")
         groups = _group_events(self.records, self.key_fn, self.time_fn)
+        rng = np.random.default_rng(self.seed)
         cutoffs: Dict[str, Optional[CutOffTime]] = {}
-        for key, evs in list(groups.items()):
-            match = [t for t, rec in evs if self.target_condition(rec)]
+        # sorted iteration: the per-key random draw must not depend on
+        # record order
+        for key in sorted(groups):
+            match = [t for t, rec in groups[key] if self.target_condition(rec)]
             if match:
-                cutoffs[key] = CutOffTime.unix_epoch(min(match))
+                if self.time_stamp_to_keep == "min":
+                    ts = min(match)
+                elif self.time_stamp_to_keep == "max":
+                    ts = max(match)
+                else:  # draw from sorted times: independent of record order
+                    ts = sorted(match)[int(rng.integers(len(match)))]
+                cutoffs[key] = CutOffTime.unix_epoch(ts)
             elif self.drop_if_not_met:
                 del groups[key]
             else:
                 # unmatched keys: all events are predictors, responses stay
                 # empty (an infinite-future cutoff — nothing is ever at/after)
                 cutoffs[key] = CutOffTime.infinite_future()
-        return _aggregate_groups(groups, raw_features, cutoffs)
+        return _aggregate_groups(
+            groups, raw_features, cutoffs,
+            response_window_ms=self.response_window_ms,
+            predictor_window_ms=self.predictor_window_ms)
+
+    def surviving_keys(self) -> List[str]:
+        """Keys this reader would emit — honors target_condition +
+        drop_if_not_met (keys a read() would drop must not reappear when a
+        join uses this side for keys only)."""
+        groups = _group_events(self.records, self.key_fn, self.time_fn)
+        out = []
+        for key, evs in groups.items():
+            if (not self.drop_if_not_met
+                    or any(self.target_condition(rec) for _, rec in evs)):
+                out.append(key)
+        return sorted(out)
 
 
 class JoinedDataReader(Reader):
@@ -264,8 +366,27 @@ class JoinedDataReader(Reader):
                 "Joining two aggregating readers requires each to declare "
                 "its own features= allowlist, otherwise both sides "
                 "aggregate every raw feature and shadow each other")
-        left_ds = self.left.read(raw_features)
-        right_ds = self.right.read(raw_features)
+        def read_side(side) -> Dataset:
+            # an aggregating reader without an allowlist must not aggregate
+            # raw features it cannot derive (extract fns over the wrong
+            # records yield None/garbage, and the pre_extracted marking
+            # would then shadow the other side's real columns) — restrict
+            # it to features probed derivable from its own records; with
+            # none derivable it contributes join keys only
+            if isinstance(side, aggregating) and side.features is None:
+                feats = _derivable_features(side, raw_features)
+                if not feats:
+                    # surviving_keys honors the reader's own row-dropping
+                    # semantics (conditional target_condition etc.)
+                    return Dataset(
+                        {KEY_COLUMN: np.array(side.surviving_keys(),
+                                              dtype=object)},
+                        {KEY_COLUMN: T.ID})
+                return side.read(feats)
+            return side.read(raw_features)
+
+        left_ds = read_side(self.left)
+        right_ds = read_side(self.right)
         for side, ds in (("left", left_ds), ("right", right_ds)):
             if KEY_COLUMN not in ds.columns:
                 raise ValueError(
@@ -407,12 +528,16 @@ class DataReaders:
 
     @staticmethod
     def conditional(records, key_fn, time_fn, target_condition,
-                    drop_if_not_met=True,
-                    features=None) -> ConditionalDataReader:
+                    drop_if_not_met=False, time_stamp_to_keep="random",
+                    response_window_ms=_WEEK_MS, predictor_window_ms=_WEEK_MS,
+                    seed=42, features=None) -> ConditionalDataReader:
         return ConditionalDataReader(records, key_fn, time_fn,
                                      target_condition,
                                      drop_if_not_met=drop_if_not_met,
-                                     features=features)
+                                     time_stamp_to_keep=time_stamp_to_keep,
+                                     response_window_ms=response_window_ms,
+                                     predictor_window_ms=predictor_window_ms,
+                                     seed=seed, features=features)
 
     @staticmethod
     def stream(records=None, csv_path=None, batch_size=1024,
