@@ -1,0 +1,73 @@
+"""Boston housing regression — OpBostonSimple parity example.
+
+Mirrors `/root/reference/helloworld/src/main/scala/com/salesforce/hw/
+OpBostonSimple.scala`: 13 numeric/categorical predictors transmogrified,
+RealNN response, SanityChecker, RegressionModelSelector with
+train/validation split.
+
+Run: python examples/op_boston_simple.py [csv_path]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import transmogrifai_tpu.types as t  # noqa: E402
+from transmogrifai_tpu.automl import transmogrify  # noqa: E402
+from transmogrifai_tpu.data import Dataset  # noqa: E402
+from transmogrifai_tpu.features import FeatureBuilder  # noqa: E402
+from transmogrifai_tpu.selector import RegressionModelSelector  # noqa: E402
+from transmogrifai_tpu.workflow import Workflow  # noqa: E402
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "boston.csv")
+
+SCHEMA = {
+    "rowId": t.Integral, "crim": t.RealNN, "zn": t.RealNN, "indus": t.RealNN,
+    "chas": t.PickList, "nox": t.RealNN, "rm": t.RealNN, "age": t.RealNN,
+    "dis": t.RealNN, "rad": t.Integral, "tax": t.RealNN, "ptratio": t.RealNN,
+    "b": t.RealNN, "lstat": t.RealNN, "medv": t.RealNN,
+}
+
+
+def build_pipeline():
+    crim = FeatureBuilder.RealNN("crim").from_column("crim").as_predictor()
+    zn = FeatureBuilder.RealNN("zn").from_column("zn").as_predictor()
+    indus = FeatureBuilder.RealNN("indus").from_column("indus").as_predictor()
+    chas = FeatureBuilder.PickList("chas").from_column("chas").as_predictor()
+    nox = FeatureBuilder.RealNN("nox").from_column("nox").as_predictor()
+    rm = FeatureBuilder.RealNN("rm").from_column("rm").as_predictor()
+    age = FeatureBuilder.RealNN("age").from_column("age").as_predictor()
+    dis = FeatureBuilder.RealNN("dis").from_column("dis").as_predictor()
+    rad = FeatureBuilder.Integral("rad").from_column("rad").as_predictor()
+    tax = FeatureBuilder.RealNN("tax").from_column("tax").as_predictor()
+    ptratio = FeatureBuilder.RealNN("ptratio").from_column("ptratio").as_predictor()
+    b = FeatureBuilder.RealNN("b").from_column("b").as_predictor()
+    lstat = FeatureBuilder.RealNN("lstat").from_column("lstat").as_predictor()
+    medv = FeatureBuilder.RealNN("medv").from_column("medv").as_response()
+
+    features = transmogrify(
+        [crim, zn, indus, chas, nox, rm, age, dis, rad, tax, ptratio, b,
+         lstat])
+    checked = medv.sanity_check(features, remove_bad_features=True)
+    prediction = RegressionModelSelector.with_train_validation_split(
+    ).set_input(medv, checked).get_output()
+    return medv, prediction
+
+
+def run(csv_path: str = DATA):
+    ds = Dataset.from_csv(csv_path, schema=SCHEMA)
+    medv, prediction = build_pipeline()
+    model = (Workflow()
+             .set_result_features(prediction, medv)
+             .set_input_dataset(ds)
+             .train())
+    fitted = model.fitted[prediction.origin_stage.uid]
+    return model, fitted.summary
+
+
+if __name__ == "__main__":
+    path = sys.argv[1] if len(sys.argv) > 1 else DATA
+    model, summary = run(path)
+    print(summary.pretty())
+    print("holdout:", summary.holdout_metrics)

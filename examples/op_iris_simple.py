@@ -1,0 +1,63 @@
+"""Iris multiclass classification — OpIrisSimple parity example.
+
+Mirrors `/root/reference/helloworld/src/main/scala/com/salesforce/hw/
+OpIrisSimple.scala`: four Real predictors transmogrified, Text response
+indexed to a label, SanityChecker, MultiClassificationModelSelector with
+train/validation split.
+
+Run: python examples/op_iris_simple.py [csv_path]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import transmogrifai_tpu.types as t  # noqa: E402
+from transmogrifai_tpu.automl import transmogrify  # noqa: E402
+from transmogrifai_tpu.data import Dataset  # noqa: E402
+from transmogrifai_tpu.features import FeatureBuilder  # noqa: E402
+from transmogrifai_tpu.selector import (  # noqa: E402
+    MultiClassificationModelSelector)
+from transmogrifai_tpu.workflow import Workflow  # noqa: E402
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "iris.csv")
+
+SCHEMA = {
+    "id": t.Integral, "sepalLength": t.Real, "sepalWidth": t.Real,
+    "petalLength": t.Real, "petalWidth": t.Real, "irisClass": t.Text,
+}
+
+
+def build_pipeline():
+    sepal_length = FeatureBuilder.Real("sepalLength").from_column("sepalLength").as_predictor()
+    sepal_width = FeatureBuilder.Real("sepalWidth").from_column("sepalWidth").as_predictor()
+    petal_length = FeatureBuilder.Real("petalLength").from_column("petalLength").as_predictor()
+    petal_width = FeatureBuilder.Real("petalWidth").from_column("petalWidth").as_predictor()
+    iris_class = FeatureBuilder.Text("irisClass").from_column("irisClass").as_response()
+
+    features = transmogrify(
+        [sepal_length, sepal_width, petal_length, petal_width])
+    label = iris_class.indexed()
+    checked = label.sanity_check(features, remove_bad_features=True)
+    prediction = MultiClassificationModelSelector.with_train_validation_split(
+    ).set_input(label, checked).get_output()
+    return label, prediction
+
+
+def run(csv_path: str = DATA):
+    ds = Dataset.from_csv(csv_path, schema=SCHEMA)
+    label, prediction = build_pipeline()
+    model = (Workflow()
+             .set_result_features(prediction, label)
+             .set_input_dataset(ds)
+             .train())
+    fitted = model.fitted[prediction.origin_stage.uid]
+    return model, fitted.summary
+
+
+if __name__ == "__main__":
+    path = sys.argv[1] if len(sys.argv) > 1 else DATA
+    model, summary = run(path)
+    print(summary.pretty())
+    print("holdout:", summary.holdout_metrics)

@@ -1,0 +1,87 @@
+"""Titanic binary classification — OpTitanicSimple parity example.
+
+Mirrors `/root/reference/helloworld/src/main/scala/com/salesforce/hw/
+OpTitanicSimple.scala:78-170` feature-for-feature: the same raw feature
+types, the same derived features (familySize, estimatedCostOfTickets,
+pivoted sex, normalized age, age group), transmogrify → SanityChecker →
+BinaryClassificationModelSelector → train → evaluate.
+
+Published reference holdout metrics to compare against
+(`/root/reference/README.md:85-90`): Precision 0.85, Recall 0.6538,
+F1 0.7391, AuROC 0.8822, AuPR 0.8225, Error 0.1644.
+
+Run: python examples/op_titanic_simple.py [csv_path]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import transmogrifai_tpu.types as t  # noqa: E402
+from transmogrifai_tpu.automl import transmogrify  # noqa: E402
+from transmogrifai_tpu.data import Dataset  # noqa: E402
+from transmogrifai_tpu.features import FeatureBuilder  # noqa: E402
+from transmogrifai_tpu.selector import (  # noqa: E402
+    BinaryClassificationModelSelector)
+from transmogrifai_tpu.workflow import Workflow  # noqa: E402
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "titanic.csv")
+
+SCHEMA = {
+    "id": t.Integral, "survived": t.Integral, "pClass": t.PickList,
+    "name": t.Text, "sex": t.PickList, "age": t.Real, "sibSp": t.Integral,
+    "parCh": t.Integral, "ticket": t.PickList, "fare": t.Real,
+    "cabin": t.PickList, "embarked": t.PickList,
+}
+
+
+def build_pipeline():
+    """Raw + derived features exactly as OpTitanicSimple.scala:102-134."""
+    survived = FeatureBuilder.RealNN("survived").from_column("survived").as_response()
+    pclass = FeatureBuilder.PickList("pClass").from_column("pClass").as_predictor()
+    name = FeatureBuilder.Text("name").from_column("name").as_predictor()
+    sex = FeatureBuilder.PickList("sex").from_column("sex").as_predictor()
+    age = FeatureBuilder.Real("age").from_column("age").as_predictor()
+    sibsp = FeatureBuilder.Integral("sibSp").from_column("sibSp").as_predictor()
+    parch = FeatureBuilder.Integral("parCh").from_column("parCh").as_predictor()
+    ticket = FeatureBuilder.PickList("ticket").from_column("ticket").as_predictor()
+    fare = FeatureBuilder.Real("fare").from_column("fare").as_predictor()
+    cabin = FeatureBuilder.PickList("cabin").from_column("cabin").as_predictor()
+    embarked = FeatureBuilder.PickList("embarked").from_column("embarked").as_predictor()
+
+    # derived features (OpTitanicSimple.scala:117-124)
+    family_size = (sibsp + parch + 1).alias("familySize")
+    estimated_cost = (family_size * fare).alias("estimatedCostOfTickets")
+    pivoted_sex = sex.pivot()
+    normed_age = age.fill_missing_with_mean().z_normalize()
+    age_group = age.map_values(
+        lambda v: None if v is None else ("adult" if v > 18 else "child"),
+        t.PickList)
+
+    features = transmogrify([
+        pclass, name, age, sibsp, parch, ticket, cabin, embarked,
+        family_size, estimated_cost, pivoted_sex, age_group, normed_age])
+    checked = survived.sanity_check(features, remove_bad_features=True)
+    prediction = BinaryClassificationModelSelector.with_train_validation_split(
+    ).set_input(survived, checked).get_output()
+    return survived, prediction
+
+
+def run(csv_path: str = DATA):
+    ds = Dataset.from_csv(csv_path, schema=SCHEMA)
+    survived, prediction = build_pipeline()
+    model = (Workflow()
+             .set_result_features(prediction, survived)
+             .set_input_dataset(ds)
+             .train())
+    fitted = model.fitted[prediction.origin_stage.uid]
+    return model, fitted.summary
+
+
+if __name__ == "__main__":
+    path = sys.argv[1] if len(sys.argv) > 1 else DATA
+    model, summary = run(path)
+    print(summary.pretty())
+    print("holdout:", summary.holdout_metrics)
+    print(model.model_insights().pretty(top=20))

@@ -92,7 +92,9 @@ class RecordInsightsLOCO(HostTransformer):
 
         base = self._scores(X)                                    # (n, C)
         chunk = max(1, self.params.get("group_chunk", 32))
-        parts: List[np.ndarray] = []
+        # empty seed: zero groups (everything pruned) → empty insight maps
+        parts: List[np.ndarray] = [
+            np.zeros((0, n, base.shape[1]), np.float32)]
         for s in range(0, masks.shape[0], chunk):
             ablated = jax.vmap(
                 lambda m: self._scores(X * (1.0 - m)))(masks[s:s + chunk])

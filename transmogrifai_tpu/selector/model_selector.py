@@ -266,6 +266,19 @@ class MultiClassificationModelSelector:
             evaluator=MultiClassificationEvaluator(metric=validation_metric),
             problem_type="multiclass")
 
+    @staticmethod
+    def with_train_validation_split(
+            models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
+            train_ratio: float = 0.75, validation_metric: str = "F1",
+            splitter=None, seed: int = 42) -> ModelSelector:
+        from transmogrifai_tpu.selector.validators import OpTrainValidationSplit
+        return ModelSelector(
+            models=models or _default_multiclass_models(),
+            validator=OpTrainValidationSplit(train_ratio=train_ratio, seed=seed),
+            splitter=splitter if splitter is not None else DataCutter(seed=seed),
+            evaluator=MultiClassificationEvaluator(metric=validation_metric),
+            problem_type="multiclass")
+
 
 class RegressionModelSelector:
     @staticmethod
@@ -276,6 +289,19 @@ class RegressionModelSelector:
         return ModelSelector(
             models=models or _default_regression_models(),
             validator=OpCrossValidation(n_folds=n_folds, seed=seed),
+            splitter=splitter if splitter is not None else DataSplitter(seed=seed),
+            evaluator=RegressionEvaluator(metric=validation_metric),
+            problem_type="regression")
+
+    @staticmethod
+    def with_train_validation_split(
+            models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
+            train_ratio: float = 0.75, validation_metric: str = "RMSE",
+            splitter=None, seed: int = 42) -> ModelSelector:
+        from transmogrifai_tpu.selector.validators import OpTrainValidationSplit
+        return ModelSelector(
+            models=models or _default_regression_models(),
+            validator=OpTrainValidationSplit(train_ratio=train_ratio, seed=seed),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             evaluator=RegressionEvaluator(metric=validation_metric),
             problem_type="regression")
