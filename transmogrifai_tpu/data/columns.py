@@ -151,11 +151,17 @@ class Column:
                 data["probability"][i, : len(pr)] = pr
                 data["rawPrediction"][i, : len(rw)] = rw
             return Column(ftype, data)
-        # host-object kinds
+        # host-object kinds; str/None text cells skip FeatureType
+        # construction — the per-value validation round-trip dominated
+        # host encode at scale
         arr = np.empty(n, dtype=object)
-        for i, v in enumerate(values):
-            u = unwrap(v)
-            arr[i] = None if (u is None or (k != TEXT and len(u) == 0)) else u
+        if k == TEXT:
+            for i, v in enumerate(values):
+                arr[i] = v if (v is None or type(v) is str) else unwrap(v)
+        else:
+            for i, v in enumerate(values):
+                u = unwrap(v)
+                arr[i] = None if (u is None or len(u) == 0) else u
         return Column(ftype, arr)
 
     @staticmethod

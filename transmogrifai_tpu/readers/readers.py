@@ -179,6 +179,27 @@ class CSVReader(SimpleReader):
         return ds
 
 
+class ParquetReader(Reader):
+    """Columnar Parquet reader (ParquetProductReader.scala analogue):
+    typed columns land directly from the arrow table — no row dicts."""
+
+    def __init__(self, path: str, schema: Optional[Mapping[str, type]] = None,
+                 key_column: Optional[str] = None):
+        self.path = path
+        self._schema = schema
+        self.key_column = key_column
+        self.features = None
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        ds = Dataset.from_parquet(self.path, schema=self._schema)
+        if self.key_column and self.key_column in ds.columns \
+                and KEY_COLUMN not in ds.columns:
+            keys = np.array([str(v) for v in ds.column(self.key_column)],
+                            dtype=object)
+            ds = ds.with_column(KEY_COLUMN, keys, T.ID)
+        return ds
+
+
 def _group_events(records: Iterable[Mapping[str, Any]],
                   key_fn: Callable, time_fn: Callable
                   ) -> Dict[str, List[Any]]:
@@ -464,12 +485,16 @@ class StreamingReader(Reader):
     loop. `read()` materializes everything (the batch path)."""
 
     def __init__(self, records: Optional[Iterable[Mapping[str, Any]]] = None,
-                 csv_path: Optional[str] = None, batch_size: int = 1024,
+                 csv_path: Optional[str] = None,
+                 parquet_path: Optional[str] = None, batch_size: int = 1024,
                  schema: Optional[Mapping[str, type]] = None):
-        if (records is None) == (csv_path is None):
-            raise ValueError("StreamingReader: pass exactly one of records/csv_path")
+        sources = sum(x is not None for x in (records, csv_path, parquet_path))
+        if sources != 1:
+            raise ValueError("StreamingReader: pass exactly one of "
+                             "records/csv_path/parquet_path")
         self.records = records
         self.csv_path = csv_path
+        self.parquet_path = parquet_path
         self.batch_size = int(batch_size)
         self.schema = schema
 
@@ -493,6 +518,16 @@ class StreamingReader(Reader):
                    for k, v in r.items()}
 
     def stream(self) -> Iterator[Dataset]:
+        if self.parquet_path is not None:
+            # columnar batch path: row groups stream straight to typed
+            # columns, no python row dicts (the 1B-row scoring path)
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+            pf = pq.ParquetFile(self.parquet_path)
+            for batch in pf.iter_batches(batch_size=self.batch_size):
+                yield Dataset.from_arrow(
+                    pa.Table.from_batches([batch]), schema=self.schema)
+            return
         buf: List[Mapping[str, Any]] = []
         for rec in self._record_iter():
             buf.append(rec)
@@ -503,6 +538,8 @@ class StreamingReader(Reader):
             yield Dataset.from_rows(buf, schema=self.schema)
 
     def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        if self.parquet_path is not None:
+            return Dataset.from_parquet(self.parquet_path, schema=self.schema)
         return Dataset.from_rows(list(self._record_iter()), schema=self.schema)
 
 
@@ -519,6 +556,10 @@ class DataReaders:
     def csv(path, schema=None, key_column=None, delimiter=",") -> CSVReader:
         return CSVReader(path, schema=schema, key_column=key_column,
                          delimiter=delimiter)
+
+    @staticmethod
+    def parquet(path, schema=None, key_column=None) -> "ParquetReader":
+        return ParquetReader(path, schema=schema, key_column=key_column)
 
     @staticmethod
     def aggregate(records, key_fn, time_fn, cutoff=None,
@@ -540,7 +581,8 @@ class DataReaders:
                                      seed=seed, features=features)
 
     @staticmethod
-    def stream(records=None, csv_path=None, batch_size=1024,
-               schema=None) -> StreamingReader:
+    def stream(records=None, csv_path=None, parquet_path=None,
+               batch_size=1024, schema=None) -> StreamingReader:
         return StreamingReader(records=records, csv_path=csv_path,
+                               parquet_path=parquet_path,
                                batch_size=batch_size, schema=schema)

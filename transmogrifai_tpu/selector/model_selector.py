@@ -131,24 +131,78 @@ class ModelSelector(Estimator):
             sharding = sweep_sharding(ctx.mesh)
         results: List[ValidationResult] = []
         failures = 0
-        for mi, (est, grids) in enumerate(self.models):
-            try:
-                grid_fold = run_sweep(est, grids, X, y_dev, folds,
-                                      self.evaluator, ctx, sharding=sharding)
-                for grid, fm in zip(grids, grid_fold):
-                    results.append(ValidationResult(
-                        model=type(est).__name__, grid=grid,
-                        fold_metrics=[float(m) for m in fm], model_index=mi))
-            except Exception:  # drop a failing family (OpValidator:344-347)
-                failures += 1
-                log.exception("Model family %s failed; dropping from sweep",
-                              type(est).__name__)
+        if ctx.cv_refit is None:
+            for mi, (est, grids) in enumerate(self.models):
+                try:
+                    grid_fold = run_sweep(est, grids, X, y_dev, folds,
+                                          self.evaluator, ctx, sharding=sharding)
+                    for grid, fm in zip(grids, grid_fold):
+                        results.append(ValidationResult(
+                            model=type(est).__name__, grid=grid,
+                            fold_metrics=[float(m) for m in fm], model_index=mi))
+                except Exception:  # drop failing family (OpValidator:344-347)
+                    failures += 1
+                    log.exception("Model family %s failed; dropping from sweep",
+                                  type(est).__name__)
+        else:
+            results, failures = self._sweep_with_workflow_cv(
+                ctx, folds, train_idx, y_dev, sharding)
         if not results:
             raise RuntimeError(
                 f"All {failures} model families failed during validation")
 
         sign = 1.0 if self.evaluator.is_larger_better else -1.0
         finite = [r for r in results if np.isfinite(r.mean_metric)]
+        return self._finish(ctx, results, finite, sign, X, X_full, y_np,
+                            y_dev, train_idx, test_idx, split_summary)
+
+    def _sweep_with_workflow_cv(self, ctx, folds, train_idx, y_dev, sharding):
+        """Workflow-level CV (OpWorkflowCore.withWorkflowCV → cutDAG,
+        FitStagesUtil.scala:302-367; in-fold applyDAG OpValidator.scala:250):
+        re-fit the pre-selector feature-engineering DAG on each fold's
+        training rows via `ctx.cv_refit`, then sweep each family fold by
+        fold on the fold-specific matrix — fold-global statistics cannot
+        leak into validation metrics."""
+        import jax.numpy as jnp
+
+        fold_X = []
+        for tr, _ in folds:
+            fold_rows = train_idx[np.asarray(tr) > 0.5]
+            Xf_full = np.asarray(ctx.cv_refit(fold_rows))
+            fold_X.append(jnp.asarray(Xf_full[train_idx]))
+
+        per_family: Dict[int, List[List[float]]] = {}
+        dead: set = set()
+        # fold-outer so all families in one fold share the sweep data cache
+        for fi, (tr, va) in enumerate(folds):
+            for mi, (est, grids) in enumerate(self.models):
+                if mi in dead:
+                    continue
+                try:
+                    gm = run_sweep(est, grids, fold_X[fi], y_dev, [(tr, va)],
+                                   self.evaluator, ctx, sharding=sharding)
+                except Exception:
+                    dead.add(mi)
+                    per_family.pop(mi, None)
+                    log.exception(
+                        "Model family %s failed in fold %d; dropping",
+                        type(est).__name__, fi)
+                    continue
+                rows = per_family.setdefault(
+                    mi, [[] for _ in range(len(grids))])
+                for gi, row in enumerate(gm):
+                    rows[gi].append(float(row[0]))
+        results: List[ValidationResult] = []
+        for mi, (est, grids) in enumerate(self.models):
+            if mi in per_family:
+                for grid, fm in zip(grids, per_family[mi]):
+                    results.append(ValidationResult(
+                        model=type(est).__name__, grid=grid,
+                        fold_metrics=fm, model_index=mi))
+        return results, len(dead)
+
+    def _finish(self, ctx, results, finite, sign, X, X_full, y_np, y_dev,
+                train_idx, test_idx, split_summary):
         if not finite:
             raise RuntimeError(
                 "Every validated config produced a non-finite metric")

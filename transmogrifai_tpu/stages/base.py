@@ -35,12 +35,19 @@ from transmogrifai_tpu.utils.uid import UID
 
 @dataclass
 class FitContext:
-    """Per-fit environment: row count, rng seed, optional device mesh."""
+    """Per-fit environment: row count, rng seed, optional device mesh.
+
+    `cv_refit` is set by the workflow ONLY on the ModelSelector's context
+    when workflow-level CV is enabled (`Workflow.with_workflow_cv()`): a
+    callable `fold_rows -> (n_total, d) feature matrix` that re-fits the
+    pre-selector feature-engineering DAG on the given rows (the cutDAG
+    equivalent, FitStagesUtil.scala:302-367)."""
 
     n_rows: int
     seed: int = 42
     mesh: Any = None  # jax.sharding.Mesh when running sharded
     data_axis: str = "data"
+    cv_refit: Any = None
 
     def child(self, salt: int) -> "FitContext":
         return FitContext(self.n_rows, self.seed * 1000003 + salt, self.mesh, self.data_axis)

@@ -37,6 +37,7 @@ class Workflow:
         self._rff = None
         self._rff_score_source = None
         self.blocklist: List[str] = []
+        self._workflow_cv = False
 
     def set_result_features(self, *features) -> "Workflow":
         self.result_features = tuple(features)
@@ -52,6 +53,16 @@ class Workflow:
 
     def set_parameters(self, params: Dict[str, Any]) -> "Workflow":
         self.parameters = dict(params)
+        return self
+
+    def with_workflow_cv(self) -> "Workflow":
+        """Move the pre-ModelSelector feature-engineering DAG inside the CV
+        folds (OpWorkflowCore.withWorkflowCV, OpWorkflowCore.scala:105 →
+        FitStagesUtil.cutDAG:302-367): estimators feeding the selector are
+        re-fit on each fold's training rows, so fold-global statistics
+        (target encodings, supervised buckets, sanity-check selections)
+        cannot leak into validation metrics."""
+        self._workflow_cv = True
         return self
 
     def with_raw_feature_filter(self, score_dataset=None, score_reader=None,
@@ -120,7 +131,11 @@ class Workflow:
                 # original estimator (copyWithNewStages swap, stages/base.py)
                 est = getattr(stage, "_estimator", None) or stage
                 if isinstance(est, Estimator):
-                    model = est.fit(inputs, ctx.child(li))
+                    stage_ctx = ctx.child(li)
+                    if self._workflow_cv and self._is_selector(est):
+                        stage_ctx.cv_refit = self._make_cv_refit(
+                            stage, layers, columns, ctx)
+                    model = est.fit(inputs, stage_ctx)
                     fitted[est.uid] = model
                     out = model.transform(inputs, ctx)
                 elif isinstance(stage, Transformer):
@@ -136,6 +151,55 @@ class Workflow:
         model.rff_results = rff_results
         model.blocklist = list(self.blocklist)
         return model
+
+    @staticmethod
+    def _is_selector(est) -> bool:
+        from transmogrifai_tpu.selector.model_selector import ModelSelector
+        return isinstance(est, ModelSelector)
+
+    def _make_cv_refit(self, selector_stage, layers, columns, ctx):
+        """The cutDAG "during" partition (FitStagesUtil.scala:302-367) as a
+        closure: re-fit every estimator feeding the selector's feature
+        vector on `fold_rows` only, re-run the transformers, and return the
+        fold-specific feature matrix for ALL rows. The label subtree is
+        excluded (reused from the global pass) so fold masks stay aligned.
+        """
+        label_f, vec_f = selector_stage.input_features
+        label_uids = {f.uid for f in label_f.traverse()}
+        during_stage_uids = {
+            f.origin_stage.uid for f in vec_f.traverse()
+            if not f.is_raw and f.uid not in label_uids}
+        base = dict(columns)  # global columns materialized so far
+
+        def refit(fold_rows: np.ndarray) -> np.ndarray:
+            cols = dict(base)
+            salt = 0
+            for layer in layers[1:]:
+                for stage in layer:
+                    if (stage is selector_stage
+                            or stage.uid not in during_stage_uids):
+                        continue
+                    salt += 1
+                    ins_full = [cols[f.uid] for f in stage.input_features]
+                    est = getattr(stage, "_estimator", None) or stage
+                    if isinstance(est, Estimator):
+                        fold_ctx = FitContext(
+                            n_rows=len(fold_rows),
+                            seed=ctx.seed * 1000003 + salt, mesh=ctx.mesh)
+                        # fit_model (NOT fit): fold models are throwaway and
+                        # must not graph-swap origin_stage away from the
+                        # globally fitted model
+                        m = est.fit_model(
+                            [c.take(fold_rows) for c in ins_full], fold_ctx)
+                        m.uid = est.uid
+                        m.input_features = est.input_features
+                        out = m.transform(ins_full)
+                    else:
+                        out = stage.transform(ins_full)
+                    cols[stage.get_output().uid] = out
+            return np.asarray(cols[vec_f.uid].data)
+
+        return refit
 
     def _apply_rff(self, ds: Dataset):
         """Run RawFeatureFilter and rewire the DAG around dropped raw
@@ -216,6 +280,42 @@ class WorkflowModel:
             from transmogrifai_tpu.workflow.compiled import CompiledScorer
             self._compiled = CompiledScorer(self)
         return self._compiled(dataset)
+
+    def score_stream(self, batches, prefetch: int = 2):
+        """Streaming micro-batch scoring with host/device overlap
+        (OpWorkflowRunner streaming loop, OpWorkflowRunner.scala:233-262 —
+        TPU-first: the NEXT batch's host encode runs in a background thread
+        while the device executes the current batch, so string work does
+        not starve the chip).
+
+        `batches`: iterable of Datasets (e.g. `StreamingReader.stream()`).
+        Yields {feature_name: result} per batch like `score_compiled`.
+        """
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        from transmogrifai_tpu.workflow.compiled import CompiledScorer
+        if self._compiled is None:
+            self._compiled = CompiledScorer(self)
+        scorer = self._compiled
+
+        def finish(host_out):
+            encs, raw_dev, columns = host_out
+            out = scorer._jitted(encs, raw_dev)
+            result: Dict[str, Any] = {}
+            for f in self.result_features:
+                result[f.name] = (out[f.uid] if f.uid in out
+                                  else columns[f.uid].data)
+            return result
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pending = deque()
+            for ds in batches:
+                pending.append(pool.submit(scorer.host_phase, ds))
+                while len(pending) > max(1, prefetch):
+                    yield finish(pending.popleft().result())
+            while pending:
+                yield finish(pending.popleft().result())
 
     def score_function(self):
         """Row-level scoring closure: Map[str, Any] → Map[str, Any]
