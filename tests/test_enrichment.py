@@ -1,0 +1,276 @@
+"""Text/map enrichment stack (VERDICT r1 #6): detectors, advanced text
+ops, map smart/multi/phone vectorizers, transmogrify type coverage.
+"""
+
+import base64
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.enrich import (
+    detect_language, detect_mime, email_parts, is_valid_phone, name_stats,
+    url_parts)
+from transmogrifai_tpu.stages.base import FitContext
+
+
+def _col(ftype, values):
+    return Column.from_values(ftype, values)
+
+
+class TestEmailUrl:
+    def test_email_parts(self):
+        assert email_parts("jane.doe@example.com") == ("jane.doe", "example.com")
+        assert email_parts("bad-email") == (None, None)
+        assert email_parts("a@b") == (None, None)
+        assert email_parts(None) == (None, None)
+
+    def test_url_parts(self):
+        assert url_parts("https://www.example.com/page?x=1") == \
+            ("https", "www.example.com")
+        assert url_parts("ftp://files.example.org") == ("ftp", "files.example.org")
+        assert url_parts("notaurl") == (None, None)
+        assert url_parts("mailto:x@y.com") == (None, None)
+
+    def test_email_to_parts_map(self):
+        from transmogrifai_tpu.ops.enrich import EmailToPickListMapTransformer
+        out = EmailToPickListMapTransformer().transform(
+            [_col(T.Email, ["a@x.com", None, "junk"])])
+        assert out.data[0] == {"Prefix": "a", "Domain": "x.com"}
+        assert out.data[1] is None
+        assert out.data[2] is None
+
+
+class TestPhone:
+    def test_us_numbers(self):
+        assert is_valid_phone("(415) 555-2671") is True
+        assert is_valid_phone("+1 415 555 2671") is True
+        assert is_valid_phone("555-2671") is False  # too short for US
+        assert is_valid_phone("not a phone") is False
+        assert is_valid_phone(None) is None
+
+    def test_other_regions(self):
+        assert is_valid_phone("030 123456", default_region="DE") is True
+        assert is_valid_phone("+44 20 7946 0958", default_region="GB") is True
+
+    def test_phone_vectorizer(self):
+        from transmogrifai_tpu.ops.enrich import PhoneVectorizer
+        v = PhoneVectorizer()
+        enc = v.host_prepare([_col(T.Phone, ["4155552671", "bad", None])])
+        block = enc[0]
+        np.testing.assert_array_equal(block[:, 0], [1.0, 0.0, 0.0])
+        np.testing.assert_array_equal(block[:, 1], [0.0, 0.0, 1.0])
+
+
+class TestMime:
+    def test_magic_bytes(self):
+        png = base64.b64encode(b"\x89PNG\r\n\x1a\n....").decode()
+        pdf = base64.b64encode(b"%PDF-1.7 blah").decode()
+        txt = base64.b64encode("plain words here".encode()).decode()
+        html = base64.b64encode(b"<html><body>x</body></html>").decode()
+        assert detect_mime(png) == "image/png"
+        assert detect_mime(pdf) == "application/pdf"
+        assert detect_mime(txt) == "text/plain"
+        assert detect_mime(html) == "text/html"
+        assert detect_mime("!!!notbase64") is None
+        assert detect_mime(None) is None
+
+
+class TestLanguage:
+    def test_scripts(self):
+        assert max(detect_language("Это русский текст"),
+                   key=detect_language("Это русский текст").get) == "ru"
+        assert "ja" in detect_language("これは日本語のテキストです")
+        assert "zh" in detect_language("这是中文文本")
+
+    def test_latin_profiles(self):
+        en = detect_language("the cat sat on the mat and it was happy")
+        de = detect_language("der Hund und die Katze sind in dem Haus")
+        fr = detect_language("le chat est dans la maison avec les enfants")
+        assert max(en, key=en.get) == "en"
+        assert max(de, key=de.get) == "de"
+        assert max(fr, key=fr.get) == "fr"
+
+    def test_empty(self):
+        assert detect_language("") == {}
+        assert detect_language(None) == {}
+
+
+class TestNames:
+    def test_name_stats(self):
+        assert name_stats("Mary Johnson") == {
+            "isName": "true", "gender": "female", "firstName": "mary"}
+        assert name_stats("james smith")["gender"] == "male"
+        assert name_stats("quarterly report 2024")["isName"] == "false"
+        assert name_stats(None) is None
+
+    def test_ner(self):
+        from transmogrifai_tpu.ops.enrich import NameEntityRecognizer
+        out = NameEntityRecognizer().transform(
+            [_col(T.Text, ["Talked to Mary Johnson about the deal", "no names"])])
+        assert "mary johnson" in out.data[0]["Person"]
+        assert out.data[1] is None
+
+
+class TestAdvancedText:
+    def _toklist(self, rows):
+        return _col(T.TextList, rows)
+
+    def test_stop_words(self):
+        from transmogrifai_tpu.ops.text_advanced import OpStopWordsRemover
+        out = OpStopWordsRemover().transform(
+            [self._toklist([["the", "cat", "sat"], ["the", "a"], None])])
+        assert out.data[0] == ["cat", "sat"]
+        assert out.data[1] is None  # all stopwords
+        assert out.data[2] is None
+
+    def test_ngram(self):
+        from transmogrifai_tpu.ops.text_advanced import OpNGram
+        out = OpNGram(n=2).transform(
+            [self._toklist([["a", "b", "c"], ["only"], None])])
+        assert out.data[0] == ["a b", "b c"]
+        assert out.data[1] is None
+
+    def test_count_vectorizer(self):
+        from transmogrifai_tpu.ops.text_advanced import OpCountVectorizer
+        col = self._toklist([["a", "b", "a"], ["b", "c"], ["a"]])
+        model = OpCountVectorizer(min_df=2).fit_model([col], FitContext(3))
+        assert model.vocab == ["a", "b"]  # c has df=1 < 2
+        enc = model.host_prepare([col])
+        np.testing.assert_array_equal(enc, [[2, 1], [0, 1], [1, 0]])
+
+    def test_word2vec_learns_similarity(self):
+        from transmogrifai_tpu.ops.text_advanced import OpWord2Vec
+        rng = np.random.default_rng(0)
+        # two separate topic clusters: words within a cluster co-occur
+        a_words, b_words = ["cat", "dog", "pet"], ["stock", "bond", "fund"]
+        docs = []
+        for _ in range(300):
+            src = a_words if rng.uniform() < 0.5 else b_words
+            docs.append(list(rng.choice(src, size=6)))
+        col = self._toklist(docs)
+        m = OpWord2Vec(vector_size=16, window=3, min_count=1,
+                       num_iter=3, seed=1).fit_model([col], FitContext(len(docs)))
+
+        def sim(w1, w2):
+            v1, v2 = m.vectors[w1], m.vectors[w2]
+            return float(v1 @ v2 / (np.linalg.norm(v1) * np.linalg.norm(v2) + 1e-9))
+
+        assert sim("cat", "dog") > sim("cat", "stock")
+        assert sim("stock", "bond") > sim("dog", "bond")
+
+    def test_lda_separates_topics(self):
+        from transmogrifai_tpu.ops.text_advanced import OpLDA
+        rng = np.random.default_rng(0)
+        n, V = 120, 20
+        X = np.zeros((n, V), dtype=np.float32)
+        for i in range(n):
+            block = 0 if i % 2 == 0 else 1  # two disjoint vocab halves
+            X[i, rng.integers(block * 10, block * 10 + 10, size=30)] += 1
+        col = Column(T.OPVector, X)
+        model = OpLDA(k=2, max_iter=40, seed=3).fit_model([col], FitContext(n))
+        theta = model.host_prepare([col])
+        top_even = np.argmax(theta[::2].mean(axis=0))
+        top_odd = np.argmax(theta[1::2].mean(axis=0))
+        assert top_even != top_odd
+        assert theta[::2, top_even].mean() > 0.8
+        assert theta[1::2, top_odd].mean() > 0.8
+
+
+class TestMapVectorizers:
+    def test_smart_text_map_strategies(self):
+        from transmogrifai_tpu.ops.maps import SmartTextMapVectorizer
+        rng = np.random.default_rng(0)
+        n = 60
+        # 12 distinct sentences: cardinality > max_card but far from ID-like
+        sentences = [" ".join(rng.choice(
+            ["big", "small", "fast", "slow", "cheap"], size=4))
+            for _ in range(12)]
+        rows = []
+        for i in range(n):
+            rows.append({
+                "color": ["red", "blue", "green"][i % 3],     # low card → pivot
+                "desc": sentences[int(rng.integers(len(sentences)))],
+                "uid": f"id_{i}",                              # id-like → ignore
+            })
+        col = _col(T.TextMap, rows)
+        est = SmartTextMapVectorizer(max_cardinality=10, min_support=1,
+                                     num_features=16)
+        model = est.fit_model([col], FitContext(n))
+        strat = model.strategies[0]
+        assert strat["color"] == "pivot"
+        assert strat["desc"] == "hash"
+        assert strat["uid"] == "ignore"
+        block = model.host_prepare([col])[0]
+        assert block.shape[0] == n
+        meta = None
+        model.input_features = ()  # not wired; host block shape is the check
+        assert block.shape[1] > 16
+
+    def test_multipicklist_map(self):
+        from transmogrifai_tpu.ops.maps import MultiPickListMapVectorizer
+        rows = [{"tags": frozenset(["a", "b"])},
+                {"tags": frozenset(["b", "c"])}, None]
+        col = _col(T.MultiPickListMap, rows)
+        est = MultiPickListMapVectorizer(top_k=5, min_support=1)
+        model = est.fit_model([col], FitContext(3))
+        block = model.host_prepare([col])[0]
+        # vocab a,b,c + OTHER + NULL = 5 columns
+        assert block.shape == (3, 5)
+        assert block[0].sum() == 2  # two tags hot
+        assert block[2, 4] == 1.0   # null indicator
+
+    def test_phone_map(self):
+        from transmogrifai_tpu.ops.maps import PhoneMapVectorizer
+        rows = [{"home": "4155552671", "work": "bad"}, None]
+        col = _col(T.PhoneMap, rows)
+        model = PhoneMapVectorizer().fit_model([col], FitContext(2))
+        block = model.host_prepare([col])[0]
+        # keys sorted: home(valid), work(invalid); row2 all-null
+        np.testing.assert_array_equal(block[0], [1.0, 0.0, 0.0, 0.0])
+        np.testing.assert_array_equal(block[1], [0.0, 1.0, 0.0, 1.0])
+
+
+class TestTransmogrifyCoverage:
+    def test_every_scalar_type_has_encoder(self):
+        """transmogrify handles every SURVEY §2.1 non-map type with a
+        non-trivial encoder (VERDICT r1 #6 'done' criterion)."""
+        from transmogrifai_tpu.automl import transmogrify
+        from transmogrifai_tpu.workflow import Workflow
+
+        rng = np.random.default_rng(0)
+        n = 40
+        png = base64.b64encode(b"\x89PNG\r\n\x1a\nxx").decode()
+        rows = []
+        for i in range(n):
+            rows.append({
+                "email": f"user{i % 5}@dom{i % 3}.com",
+                "url": f"https://site{i % 4}.com/p",
+                "phone": "4155552671" if i % 2 else "123",
+                "b64": png,
+                "mpl_map": {"k": frozenset(["x", "y"][: 1 + i % 2])},
+                "txt_map": {"color": ["red", "blue"][i % 2]},
+                "phone_map": {"home": "4155552671"},
+                "y": float(i % 2),
+            })
+        ds = Dataset.from_rows(rows, schema={
+            "email": T.Email, "url": T.URL, "phone": T.Phone,
+            "b64": T.Base64, "mpl_map": T.MultiPickListMap,
+            "txt_map": T.TextMap, "phone_map": T.PhoneMap, "y": T.Integral})
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = transmogrify(preds)
+        model = Workflow().set_result_features(vec, label) \
+            .set_input_dataset(ds).train()
+        out = model.score(ds, keep_intermediate=True)[vec.uid]
+        arr = np.asarray(out.data)
+        assert arr.shape[0] == n and arr.shape[1] > 10
+        assert np.isfinite(arr).all()
+        meta = out.meta
+        parents = {c.parent_name for c in meta.columns}
+        assert {"phone", "b64", "mpl_map", "txt_map", "phone_map"} <= parents
+        # email/url contribute via their derived domain features
+        assert any(p.startswith("email") for p in parents), parents
+        assert any(p.startswith("url") for p in parents), parents

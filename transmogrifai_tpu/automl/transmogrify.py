@@ -51,11 +51,13 @@ class TransmogrifierDefaults:
         "HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear")
 
 
-# Categorical text types that always pivot (vs SmartText deciding).
+# Categorical text types that always pivot (vs SmartText deciding);
+# ID and Base64 pivot raw values (Transmogrifier.scala:281-287, :299-303).
 _PIVOT_TYPES = (T.PickList, T.ComboBox, T.Country, T.State, T.City,
-                T.PostalCode, T.Street)
-# Free-text types routed through SmartTextVectorizer.
-_SMART_TEXT_TYPES = (T.TextArea, T.ID, T.Email, T.URL, T.Phone, T.Base64, T.Text)
+                T.PostalCode, T.Street, T.ID, T.Base64)
+# Free-text types routed through SmartTextVectorizer
+# (Transmogrifier.scala:305-321).
+_SMART_TEXT_TYPES = (T.TextArea, T.Text)
 
 
 def _group_features(features: Sequence) -> Dict[str, List]:
@@ -72,6 +74,12 @@ def _group_features(features: Sequence) -> Dict[str, List]:
             key = "integral"
         elif issubclass(ft, T.Real):
             key = "real"
+        elif issubclass(ft, T.Email):
+            key = "email"    # domain pivot (RichTextFeature.scala:620-633)
+        elif issubclass(ft, T.URL):
+            key = "url"      # valid-domain pivot (RichTextFeature.scala:670)
+        elif issubclass(ft, T.Phone):
+            key = "phone"    # validity vector (RichTextFeature.scala:569)
         elif issubclass(ft, _PIVOT_TYPES):
             key = "pivot"
         elif issubclass(ft, _SMART_TEXT_TYPES):
@@ -121,6 +129,24 @@ def transmogrify(features: Sequence, defaults: Optional[TransmogrifierDefaults] 
         vectors.append(OneHotVectorizer(
             top_k=d.top_k, min_support=d.min_support, track_nulls=d.track_nulls
         ).set_input(*groups["pivot"]).get_output())
+    if "email" in groups:
+        from transmogrifai_tpu.ops.enrich import EmailDomainTransformer
+        domains = [EmailDomainTransformer().set_input(f).get_output()
+                   for f in groups["email"]]
+        vectors.append(OneHotVectorizer(
+            top_k=d.top_k, min_support=d.min_support, track_nulls=d.track_nulls
+        ).set_input(*domains).get_output())
+    if "url" in groups:
+        from transmogrifai_tpu.ops.enrich import UrlDomainTransformer
+        domains = [UrlDomainTransformer().set_input(f).get_output()
+                   for f in groups["url"]]
+        vectors.append(OneHotVectorizer(
+            top_k=d.top_k, min_support=d.min_support, track_nulls=d.track_nulls
+        ).set_input(*domains).get_output())
+    if "phone" in groups:
+        from transmogrifai_tpu.ops.enrich import PhoneVectorizer
+        vectors.append(PhoneVectorizer(
+            track_nulls=d.track_nulls).set_input(*groups["phone"]).get_output())
     if "smart_text" in groups:
         vectors.append(SmartTextVectorizer(
             max_cardinality=d.max_cardinality, top_k=d.top_k,

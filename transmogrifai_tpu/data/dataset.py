@@ -237,8 +237,12 @@ class Dataset:
                     or pa.types.is_timestamp(at) or pa.types.is_date(at)):
                 if pa.types.is_timestamp(at) or pa.types.is_date(at):
                     # date32 has no direct int64 cast; both routes land on
-                    # ms-epoch, matching T.DateTime's convention
-                    col = col.cast(pa.timestamp("ms")).cast(pa.int64())
+                    # ms-epoch, matching T.DateTime's convention. us/ns
+                    # precision truncates (python datetimes are us).
+                    import pyarrow.compute as pc
+                    opts = pc.CastOptions(target_type=pa.timestamp("ms"),
+                                          allow_time_truncate=True)
+                    col = pc.cast(col, options=opts).cast(pa.int64())
                 arr = col.to_numpy(zero_copy_only=False)
                 if arr.dtype == object:  # nullable ints surface as object
                     arr = _to_numeric_storage(arr)

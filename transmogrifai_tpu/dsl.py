@@ -166,6 +166,96 @@ def text_len(self: Feature) -> Feature:
 
 
 # ----------------------------------------------------------------- #
+# enrichment (RichTextFeature email/url/phone/base64 sections)      #
+# ----------------------------------------------------------------- #
+
+def is_valid_email(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import ValidEmailTransformer
+    return _stage(ValidEmailTransformer, self)
+
+
+def to_email_domain(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import EmailDomainTransformer
+    return _stage(EmailDomainTransformer, self)
+
+
+def to_email_parts(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import EmailToPickListMapTransformer
+    return _stage(EmailToPickListMapTransformer, self)
+
+
+def is_valid_url(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import UrlIsValidTransformer
+    return _stage(UrlIsValidTransformer, self)
+
+
+def to_domain(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import UrlDomainTransformer
+    return _stage(UrlDomainTransformer, self)
+
+
+def to_protocol(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import UrlProtocolTransformer
+    return _stage(UrlProtocolTransformer, self)
+
+
+def is_valid_phone(self: Feature, default_region: str = "US") -> Feature:
+    from transmogrifai_tpu.ops.enrich import PhoneIsValidTransformer
+    return _stage(PhoneIsValidTransformer, self, default_region=default_region)
+
+
+def detect_mime_types(self: Feature, type_hint=None) -> Feature:
+    from transmogrifai_tpu.ops.enrich import MimeTypeDetector
+    return _stage(MimeTypeDetector, self, type_hint=type_hint)
+
+
+def detect_languages(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import LangDetector
+    return _stage(LangDetector, self)
+
+
+def detect_name(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import HumanNameDetector
+    return _stage(HumanNameDetector, self)
+
+
+def recognize_entities(self: Feature) -> Feature:
+    from transmogrifai_tpu.ops.enrich import NameEntityRecognizer
+    return _stage(NameEntityRecognizer, self)
+
+
+def remove_stop_words(self: Feature, stop_words=None,
+                      case_sensitive: bool = False) -> Feature:
+    from transmogrifai_tpu.ops.text_advanced import OpStopWordsRemover
+    return _stage(OpStopWordsRemover, self, stop_words=stop_words,
+                  case_sensitive=case_sensitive)
+
+
+def ngram(self: Feature, n: int = 2) -> Feature:
+    from transmogrifai_tpu.ops.text_advanced import OpNGram
+    return _stage(OpNGram, self, n=n)
+
+
+def count_vectorize(self: Feature, vocab_size: int = 1 << 18,
+                    min_df: float = 1.0, binary: bool = False) -> Feature:
+    from transmogrifai_tpu.ops.text_advanced import OpCountVectorizer
+    return _stage(OpCountVectorizer, self, vocab_size=vocab_size,
+                  min_df=min_df, binary=binary)
+
+
+def word2vec(self: Feature, vector_size: int = 100, window: int = 5,
+             min_count: int = 5, num_iter: int = 1) -> Feature:
+    from transmogrifai_tpu.ops.text_advanced import OpWord2Vec
+    return _stage(OpWord2Vec, self, vector_size=vector_size, window=window,
+                  min_count=min_count, num_iter=num_iter)
+
+
+def lda(self: Feature, k: int = 10, max_iter: int = 20) -> Feature:
+    from transmogrifai_tpu.ops.text_advanced import OpLDA
+    return _stage(OpLDA, self, k=k, max_iter=max_iter)
+
+
+# ----------------------------------------------------------------- #
 # dates (RichDateFeature)                                           #
 # ----------------------------------------------------------------- #
 
@@ -258,6 +348,14 @@ _METHODS = {
     "sanity_check": sanity_check,
     "tokenize": tokenize, "pivot": pivot, "smart_vectorize": smart_vectorize,
     "indexed": indexed, "deindexed": deindexed, "text_len": text_len,
+    "is_valid_email": is_valid_email, "to_email_domain": to_email_domain,
+    "to_email_parts": to_email_parts, "is_valid_url": is_valid_url,
+    "to_domain": to_domain, "to_protocol": to_protocol,
+    "is_valid_phone": is_valid_phone, "detect_mime_types": detect_mime_types,
+    "detect_languages": detect_languages, "detect_name": detect_name,
+    "recognize_entities": recognize_entities,
+    "remove_stop_words": remove_stop_words, "ngram": ngram,
+    "count_vectorize": count_vectorize, "word2vec": word2vec, "lda": lda,
     "to_unit_circle": to_unit_circle, "to_time_period": to_time_period,
     "alias": alias, "map_values": map_values, "filter_values": filter_values,
     "exists": exists, "replace_with": replace_with, "occurs": occurs,

@@ -22,6 +22,18 @@ from transmogrifai_tpu.ops.rowops import (
     AliasTransformer, LambdaMap, FilterTransformer, ExistsTransformer,
     ReplaceTransformer, ToOccurTransformer, SubstringTransformer,
     TextLenTransformer, JaccardSimilarity, NGramSimilarity)
+from transmogrifai_tpu.ops.enrich import (
+    ValidEmailTransformer, EmailDomainTransformer,
+    EmailToPickListMapTransformer, UrlIsValidTransformer,
+    UrlDomainTransformer, UrlProtocolTransformer, PhoneIsValidTransformer,
+    PhoneVectorizer, MimeTypeDetector, LangDetector, HumanNameDetector,
+    NameEntityRecognizer)
+from transmogrifai_tpu.ops.text_advanced import (
+    OpStopWordsRemover, OpNGram, OpCountVectorizer, OpWord2Vec, OpLDA)
+from transmogrifai_tpu.ops.maps import (
+    NumericMapVectorizer, TextMapPivotVectorizer, SmartTextMapVectorizer,
+    MultiPickListMapVectorizer, PhoneMapVectorizer, GeolocationMapVectorizer,
+    DateMapVectorizer)
 
 __all__ = [
     "RealVectorizer", "IntegralVectorizer", "BinaryVectorizer",
@@ -40,4 +52,14 @@ __all__ = [
     "AliasTransformer", "LambdaMap", "FilterTransformer", "ExistsTransformer",
     "ReplaceTransformer", "ToOccurTransformer", "SubstringTransformer",
     "TextLenTransformer", "JaccardSimilarity", "NGramSimilarity",
+    "ValidEmailTransformer", "EmailDomainTransformer",
+    "EmailToPickListMapTransformer", "UrlIsValidTransformer",
+    "UrlDomainTransformer", "UrlProtocolTransformer",
+    "PhoneIsValidTransformer", "PhoneVectorizer", "MimeTypeDetector",
+    "LangDetector", "HumanNameDetector", "NameEntityRecognizer",
+    "OpStopWordsRemover", "OpNGram", "OpCountVectorizer", "OpWord2Vec",
+    "OpLDA",
+    "NumericMapVectorizer", "TextMapPivotVectorizer",
+    "SmartTextMapVectorizer", "MultiPickListMapVectorizer",
+    "PhoneMapVectorizer", "GeolocationMapVectorizer", "DateMapVectorizer",
 ]

@@ -46,9 +46,17 @@ def pivot_encode_ids(values, lut: Dict[str, int], k: int) -> np.ndarray:
     out = np.full(n, k + 1, dtype=np.int32)  # NULL id
     present = arr[mask]
     if present.size:
-        uniq, inv = np.unique(present.astype(str), return_inverse=True)
-        ids = np.fromiter((lut.get(u, k) for u in uniq), np.int32, len(uniq))
-        out[mask] = ids[inv]
+        try:
+            # hash-based factorize: no sort, no stringification — levels
+            # keep their python identity for the lut lookup
+            import pandas as pd
+            inv, uniq = pd.factorize(present)
+            ids = np.fromiter((lut.get(u, k) for u in uniq), np.int32,
+                              len(uniq))
+            out[mask] = ids[inv]
+        except Exception:  # unhashable levels etc: direct per-row path
+            out[mask] = np.fromiter((lut.get(v, k) for v in present),
+                                    np.int32, present.size)
     return out
 
 

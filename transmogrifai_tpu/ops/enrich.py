@@ -1,0 +1,510 @@
+"""Type-specific enrichment: email/URL/phone/MIME/language/name detection.
+
+Reference parity:
+- `core/.../feature/PhoneNumberParser.scala` (libphonenumber validity) →
+  region-aware digit rules here (pure python; no JVM libphonenumber)
+- `ValidEmailTransformer` (core/.../feature/ValidEmailTransformer.scala)
+- Email/URL domain pivots (`core/.../dsl/RichTextFeature.scala:603-688`,
+  `EmailToPickListMapTransformer.scala`)
+- `MimeTypeDetector` (core/.../feature/MimeTypeDetector.scala — Tika) →
+  magic-byte table here
+- `LangDetector` (core/.../feature/LangDetector.scala +
+  `OptimaizeLanguageDetector.scala:45`) → script ranges + stopword-profile
+  scoring (pure python)
+- `HumanNameDetector`/gender (`features/.../impl/feature/
+  GenderDetectStrategy.scala`, OpenNLPNameEntityTagger.scala:42) →
+  dictionary heuristic (the reference's OpenNLP binaries are data files,
+  substituted per SURVEY §2.9)
+
+All are host-side stages: their outputs (Binary/PickList/Text/maps) feed
+the standard vectorizers, so the device program sees only dense encodings.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import re
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import (
+    NULL_INDICATOR, VectorColumnMetadata, VectorMetadata)
+from transmogrifai_tpu.stages.base import HostTransformer, Transformer
+
+# --------------------------------------------------------------------------- #
+# email                                                                       #
+# --------------------------------------------------------------------------- #
+
+_EMAIL_RE = re.compile(
+    r"^[A-Za-z0-9!#$%&'*+/=?^_`{|}~.-]+@([A-Za-z0-9-]+\.)+[A-Za-z]{2,}$")
+
+
+def email_parts(s: Optional[str]):
+    """(prefix, domain) or (None, None) when invalid."""
+    if not s or not _EMAIL_RE.match(s):
+        return None, None
+    prefix, domain = s.rsplit("@", 1)
+    return prefix, domain
+
+
+class ValidEmailTransformer(HostTransformer):
+    """Email → Binary validity (ValidEmailTransformer.scala)."""
+
+    in_types = (T.Email,)
+    out_type = T.Binary
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        src = cols[0].data
+        return Column.from_values(T.Binary, [
+            None if v is None else (email_parts(v)[1] is not None)
+            for v in src])
+
+
+class EmailDomainTransformer(HostTransformer):
+    """Email → PickList of the domain (EmailDomainToPickList,
+    RichTextFeature.scala:630); invalid/empty → None."""
+
+    in_types = (T.Email,)
+    out_type = T.PickList
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, v in enumerate(cols[0].data):
+            out[i] = email_parts(v)[1]
+        return Column(T.PickList, out)
+
+
+class EmailToPickListMapTransformer(HostTransformer):
+    """Email → PickListMap {Prefix, Domain}
+    (EmailToPickListMapTransformer.scala)."""
+
+    in_types = (T.Email,)
+    out_type = T.PickListMap
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, v in enumerate(cols[0].data):
+            prefix, domain = email_parts(v)
+            out[i] = ({"Prefix": prefix, "Domain": domain}
+                      if domain is not None else None)
+        return Column(T.PickListMap, out)
+
+
+# --------------------------------------------------------------------------- #
+# URL                                                                         #
+# --------------------------------------------------------------------------- #
+
+_URL_RE = re.compile(
+    r"^(?P<proto>https?|ftp)://(?P<host>[A-Za-z0-9.-]+\.[A-Za-z]{2,})"
+    r"(?::\d+)?(?:/[^\s]*)?$", re.IGNORECASE)
+
+
+def url_parts(s: Optional[str]):
+    """(protocol, domain) of a valid http/https/ftp url, else (None, None)
+    (URLIsValid / URLDomainToText, RichTextFeature.scala:642-654)."""
+    if not s:
+        return None, None
+    m = _URL_RE.match(s.strip())
+    if not m:
+        return None, None
+    return m.group("proto").lower(), m.group("host").lower()
+
+
+class UrlIsValidTransformer(HostTransformer):
+    in_types = (T.URL,)
+    out_type = T.Binary
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        return Column.from_values(T.Binary, [
+            None if v is None else (url_parts(v)[1] is not None)
+            for v in cols[0].data])
+
+
+class UrlDomainTransformer(HostTransformer):
+    """URL → PickList domain of VALID urls (URLDomainToPickList,
+    RichTextFeature.scala:843)."""
+
+    in_types = (T.URL,)
+    out_type = T.PickList
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, v in enumerate(cols[0].data):
+            out[i] = url_parts(v)[1]
+        return Column(T.PickList, out)
+
+
+class UrlProtocolTransformer(HostTransformer):
+    in_types = (T.URL,)
+    out_type = T.Text
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, v in enumerate(cols[0].data):
+            out[i] = url_parts(v)[0]
+        return Column(T.Text, out)
+
+
+# --------------------------------------------------------------------------- #
+# phone                                                                       #
+# --------------------------------------------------------------------------- #
+
+# national number length rules per region (libphonenumber-lite):
+# region → (country_code, min_len, max_len)
+_PHONE_REGIONS: Dict[str, tuple] = {
+    "US": ("1", 10, 10), "CA": ("1", 10, 10), "GB": ("44", 9, 10),
+    "DE": ("49", 6, 11), "FR": ("33", 9, 9), "IN": ("91", 10, 10),
+    "AU": ("61", 9, 9), "JP": ("81", 9, 10), "BR": ("55", 10, 11),
+    "MX": ("52", 10, 10), "CN": ("86", 10, 11), "ES": ("34", 9, 9),
+    "IT": ("39", 8, 11), "NL": ("31", 9, 9),
+}
+
+
+def is_valid_phone(s: Optional[str], default_region: str = "US",
+                   strict: bool = False) -> Optional[bool]:
+    """Region-aware validity (PhoneNumberParser.scala: validity against a
+    default region; non-strict mode tolerates missing country code)."""
+    if s is None:
+        return None
+    digits = re.sub(r"[^\d+]", "", s.strip())
+    if not digits:
+        return False
+    cc, lo, hi = _PHONE_REGIONS.get(default_region.upper(), ("1", 7, 15))
+    if digits.startswith("+"):
+        body = digits[1:]
+        if not body.isdigit():
+            return False
+        if body.startswith(cc):
+            national = body[len(cc):]
+            return lo <= len(national) <= hi
+        # other country code: generic E.164 bound
+        return 7 <= len(body) <= 15
+    if not digits.isdigit():
+        return False
+    if digits.startswith(cc) and lo <= len(digits) - len(cc) <= hi:
+        return not strict or default_region.upper() in ("US", "CA")
+    return lo <= len(digits) <= hi
+
+
+def phone_valid_block(values, default_region: str,
+                      track_nulls: bool) -> np.ndarray:
+    """[isValid(, isNull)] block shared by PhoneVectorizer and
+    PhoneMapVectorizer so scalar and map phone encodings cannot drift."""
+    n = len(values)
+    block = np.zeros((n, 2 if track_nulls else 1), dtype=np.float32)
+    for i, v in enumerate(values):
+        valid = is_valid_phone(v, default_region)
+        if valid is None:
+            if track_nulls:
+                block[i, 1] = 1.0
+        elif valid:
+            block[i, 0] = 1.0
+    return block
+
+
+class PhoneIsValidTransformer(HostTransformer):
+    """Phone → Binary validity (RichTextFeature.isValidPhoneDefaultCountry,
+    RichTextFeature.scala:545)."""
+
+    in_types = (T.Phone,)
+    out_type = T.Binary
+
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, default_region=default_region, strict=strict)
+        self.default_region = default_region
+        self.strict = strict
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        return Column.from_values(T.Binary, [
+            is_valid_phone(v, self.default_region, self.strict)
+            for v in cols[0].data])
+
+
+class PhoneVectorizer(Transformer):
+    """N Phone features → [isValid, isNull] per feature — the transmogrify
+    default for Phone (RichTextFeature.vectorize, :569-582)."""
+
+    in_types = (T.Phone, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, default_region: str = "US", track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, default_region=default_region,
+                         track_nulls=track_nulls)
+        self.default_region = default_region
+        self.track_nulls = track_nulls
+
+    def host_prepare(self, cols: Sequence[Optional[Column]]):
+        return [phone_valid_block(c.data, self.default_region,
+                                  self.track_nulls) for c in cols]
+
+    def device_apply(self, enc, dev):
+        import jax.numpy as jnp
+        return jnp.concatenate([jnp.asarray(b) for b in enc], axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for f in self.input_features:
+            cols.append(VectorColumnMetadata(
+                parent_name=f.name, parent_type=f.ftype.__name__,
+                grouping=f.name, indicator_value="IsValid"))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=f.name, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+
+# --------------------------------------------------------------------------- #
+# MIME type (Base64 payloads)                                                 #
+# --------------------------------------------------------------------------- #
+
+_MAGIC = [
+    (b"%PDF", "application/pdf"),
+    (b"\x89PNG\r\n\x1a\n", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF8", "image/gif"),
+    (b"BM", "image/bmp"),
+    (b"PK\x03\x04", "application/zip"),
+    (b"\x1f\x8b", "application/gzip"),
+    (b"Rar!", "application/x-rar-compressed"),
+    (b"\x7fELF", "application/x-executable"),
+    (b"MZ", "application/x-msdownload"),
+    (b"ID3", "audio/mpeg"),
+    (b"RIFF", "audio/x-wav"),
+    (b"OggS", "audio/ogg"),
+    (b"\xd0\xcf\x11\xe0", "application/x-ole-storage"),
+]
+
+
+def detect_mime(b64: Optional[str], type_hint: Optional[str] = None) -> Optional[str]:
+    """Magic-byte MIME sniffing of base64 payloads (MimeTypeDetector.scala —
+    Tika's detector behind the same Base64 → Text contract)."""
+    if b64 is None:
+        return None
+    if not b64:
+        return ""
+    try:
+        raw = base64.b64decode(b64, validate=True)
+    except (binascii.Error, ValueError):
+        return None
+    if not raw:
+        return ""
+    for magic, mime in _MAGIC:
+        if raw.startswith(magic):
+            return mime
+    head = raw[:512]
+    try:
+        text = head.decode("utf-8")
+    except UnicodeDecodeError:
+        return type_hint or "application/octet-stream"
+    stripped = text.lstrip().lower()
+    if stripped.startswith(("<html", "<!doctype html")):
+        return "text/html"
+    if stripped.startswith("<?xml"):
+        return "application/xml"
+    if stripped.startswith(("{", "[")):
+        return "application/json"
+    if stripped.startswith("<svg"):
+        return "image/svg+xml"
+    return type_hint or "text/plain"
+
+
+class MimeTypeDetector(HostTransformer):
+    """Base64 → Text MIME type (MimeTypeDetector.scala)."""
+
+    in_types = (T.Base64,)
+    out_type = T.Text
+
+    def __init__(self, type_hint: Optional[str] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, type_hint=type_hint)
+        self.type_hint = type_hint
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, v in enumerate(cols[0].data):
+            out[i] = detect_mime(v, self.type_hint)
+        return Column(T.Text, out)
+
+
+# --------------------------------------------------------------------------- #
+# language detection                                                          #
+# --------------------------------------------------------------------------- #
+
+# script ranges decide non-latin languages outright
+_SCRIPTS = [
+    ((0x0400, 0x04FF), "ru"), ((0x3040, 0x30FF), "ja"),
+    ((0xAC00, 0xD7AF), "ko"), ((0x4E00, 0x9FFF), "zh"),
+    ((0x0600, 0x06FF), "ar"), ((0x0900, 0x097F), "hi"),
+    ((0x0370, 0x03FF), "el"), ((0x0590, 0x05FF), "he"),
+    ((0x0E00, 0x0E7F), "th"),
+]
+
+# latin languages: high-frequency function words (profile scoring)
+_PROFILES: Dict[str, frozenset] = {
+    "en": frozenset("the of and to in is was for that it with as his on be "
+                    "at by had this are but from they which not have".split()),
+    "de": frozenset("der die und das in den von zu mit sich des auf für ist "
+                    "im dem nicht ein eine als auch es an werden".split()),
+    "fr": frozenset("de la le et les des en un du une est que dans qui par "
+                    "pour au sur pas plus ne se sont avec il".split()),
+    "es": frozenset("de la que el en y a los se del las un por con una su "
+                    "para es al lo como más pero sus le".split()),
+    "it": frozenset("di e il la che in un a per è una sono con non del si "
+                    "da come le dei nel alla più anche".split()),
+    "pt": frozenset("de a o que e do da em um para é com não uma os no se "
+                    "na por mais as dos como mas foi ao".split()),
+    "nl": frozenset("de van het een en in is dat op te zijn met voor niet "
+                    "aan er om ook als dan maar bij uit".split()),
+}
+
+
+def detect_language(text: Optional[str]) -> Dict[str, float]:
+    """{language: confidence} (LanguageDetector contract,
+    OptimaizeLanguageDetector.scala:45). Scripts decide CJK/Cyrillic/...;
+    latin text scores stopword-profile hits."""
+    if not text:
+        return {}
+    counts: Dict[str, int] = {}
+    letters = 0
+    for ch in text:
+        cp = ord(ch)
+        if cp < 0x80:
+            if ch.isalpha():
+                letters += 1
+            continue
+        for (lo, hi), lang in _SCRIPTS:
+            if lo <= cp <= hi:
+                counts[lang] = counts.get(lang, 0) + 1
+                break
+    if counts:
+        total = sum(counts.values())
+        if total >= max(1, letters // 4):
+            return {lang: c / total for lang, c in
+                    sorted(counts.items(), key=lambda kv: -kv[1])}
+    words = re.findall(r"[a-zà-ÿäöüß]+", text.lower())
+    if not words:
+        return {}
+    scores = {}
+    for lang, profile in _PROFILES.items():
+        hits = sum(1 for w in words if w in profile)
+        if hits:
+            scores[lang] = hits / len(words)
+    total = sum(scores.values())
+    if not total:
+        return {}
+    return {lang: s / total for lang, s in
+            sorted(scores.items(), key=lambda kv: -kv[1])}
+
+
+class LangDetector(HostTransformer):
+    """Text → RealMap of language → confidence (LangDetector.scala)."""
+
+    in_types = (T.Text,)
+    out_type = T.RealMap
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, v in enumerate(cols[0].data):
+            d = detect_language(v)
+            out[i] = d if d else None
+        return Column(T.RealMap, out)
+
+
+# --------------------------------------------------------------------------- #
+# human names                                                                 #
+# --------------------------------------------------------------------------- #
+
+_FEMALE = frozenset("""
+mary patricia jennifer linda elizabeth barbara susan jessica sarah karen
+nancy lisa margaret betty sandra ashley dorothy kimberly emily donna
+michelle carol amanda melissa deborah stephanie rebecca laura sharon
+cynthia kathleen amy shirley angela helen anna brenda pamela nicole emma
+samantha katherine christine debra rachel catherine carolyn janet ruth
+maria heather diane virginia julie joyce victoria olivia kelly christina
+lauren joan evelyn judith megan cheryl andrea hannah martha jacqueline
+frances gloria ann teresa kathryn sara janice jean alice madison doris
+abigail julia judy grace denise amber marilyn beverly danielle theresa
+sophia marie diana brittany natalie isabella charlotte rose alexis kayla
+""".split())
+
+_MALE = frozenset("""
+james robert john michael david william richard joseph thomas charles
+christopher daniel matthew anthony mark donald steven paul andrew joshua
+kenneth kevin brian george timothy ronald edward jason jeffrey ryan jacob
+gary nicholas eric jonathan stephen larry justin scott brandon benjamin
+samuel gregory frank alexander raymond patrick jack dennis jerry tyler
+aaron jose adam nathan henry douglas zachary peter kyle ethan walter noah
+jeremy christian keith roger terry austin sean gerald carl harold dylan
+arthur lawrence jordan jesse bryan billy bruce gabriel joe logan alan
+juan albert willie elijah wayne randy vincent mason roy ralph bobby
+russell bradley philip eugene
+""".split())
+
+
+def name_stats(text: Optional[str]) -> Optional[Dict[str, str]]:
+    """NameStats map {isName, gender[, firstName]} — HumanNameDetector /
+    GenderDetectStrategy.ByFirstName analogue over a name dictionary."""
+    if not text:
+        return None
+    tokens = [t.lower() for t in re.findall(r"[A-Za-zà-ÿ'-]+", text)]
+    if not 1 <= len(tokens) <= 4:
+        return {"isName": "false", "gender": "unknown"}
+    first = tokens[0]
+    if first in _FEMALE:
+        return {"isName": "true", "gender": "female", "firstName": first}
+    if first in _MALE:
+        return {"isName": "true", "gender": "male", "firstName": first}
+    # any dictionary hit in later tokens (e.g. "dr maria lopez")
+    for t in tokens[1:]:
+        if t in _FEMALE:
+            return {"isName": "true", "gender": "female", "firstName": t}
+        if t in _MALE:
+            return {"isName": "true", "gender": "male", "firstName": t}
+    return {"isName": "false", "gender": "unknown"}
+
+
+class HumanNameDetector(HostTransformer):
+    """Text → NameStats (HumanNameDetector.scala / GenderDetectStrategy)."""
+
+    in_types = (T.Text,)
+    out_type = T.NameStats
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, v in enumerate(cols[0].data):
+            out[i] = name_stats(v)
+        return Column(T.NameStats, out)
+
+
+class NameEntityRecognizer(HostTransformer):
+    """Text → MultiPickListMap of entity type → tokens
+    (OpenNLPNameEntityTagger.scala:42 contract; capitalization + dictionary
+    heuristics standing in for the OpenNLP binary models)."""
+
+    in_types = (T.Text,)
+    out_type = T.MultiPickListMap
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out = np.empty(len(cols[0].data), dtype=object)
+        for i, v in enumerate(cols[0].data):
+            out[i] = self._entities(v)
+        return Column(T.MultiPickListMap, out)
+
+    @staticmethod
+    def _entities(text: Optional[str]) -> Optional[Dict[str, frozenset]]:
+        if not text:
+            return None
+        persons = set()
+        for m in re.finditer(r"\b([A-Z][a-zà-ÿ'-]+)(?:\s+[A-Z][a-zà-ÿ'-]+)*",
+                             text):
+            first = m.group(1).lower()
+            if first in _FEMALE or first in _MALE:
+                persons.add(m.group(0).lower())
+        return {"Person": frozenset(persons)} if persons else None

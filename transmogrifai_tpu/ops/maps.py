@@ -394,29 +394,291 @@ class DateMapModel(Transformer):
                 "periods": list(self.periods)}
 
 
+class SmartTextMapModel(Transformer):
+    """Fitted per-(feature, key) strategy: pivot / hashed tokens / ignore."""
+
+    out_type = T.OPVector
+
+    def __init__(self, keys_per_feature: Sequence[Sequence[str]],
+                 strategies: Sequence[Dict[str, str]],
+                 vocabs: Sequence[Dict[str, List[str]]],
+                 num_features: int = 512, track_nulls: bool = True,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.keys_per_feature = [list(k) for k in keys_per_feature]
+        self.strategies = list(strategies)
+        self.vocabs = list(vocabs)
+        self.num_features = num_features
+        self.track_nulls = track_nulls
+        self.seed = seed
+
+    def _key_values(self, c: Column, key: str) -> np.ndarray:
+        out = np.empty(len(c.data), dtype=object)
+        for i, m in enumerate(c.data):
+            out[i] = None if m is None else m.get(key)
+        return out
+
+    def host_prepare(self, cols):
+        from transmogrifai_tpu.ops.categorical import one_hot_np, pivot_encode_ids
+        from transmogrifai_tpu.ops.text import TokenHasher, _hash_counts
+        blocks = []
+        for i, c in enumerate(cols):
+            n = len(c.data)
+            feat_blocks = []
+            for ki, key in enumerate(self.keys_per_feature[i]):
+                values = self._key_values(c, key)
+                strat = self.strategies[i][key]
+                if strat == "pivot":
+                    vocab = self.vocabs[i][key]
+                    lut = {s: j for j, s in enumerate(vocab)}
+                    block = one_hot_np(
+                        pivot_encode_ids(values, lut, len(vocab)),
+                        len(vocab), self.track_nulls)
+                elif strat == "hash":
+                    hasher = TokenHasher(self.num_features,
+                                         self.seed + 31 * i + ki)
+                    block = _hash_counts(values, hasher, False, False)
+                    if self.track_nulls:
+                        nulls = np.fromiter(
+                            (1.0 if v is None else 0.0 for v in values),
+                            dtype=np.float32, count=n)
+                        block = np.concatenate([block, nulls[:, None]], 1)
+                else:  # ignore: null indicator only
+                    nulls = np.fromiter(
+                        (1.0 if v is None else 0.0 for v in values),
+                        dtype=np.float32, count=n)
+                    block = nulls[:, None]
+                feat_blocks.append(block)
+            blocks.append(np.concatenate(feat_blocks, 1) if feat_blocks
+                          else np.zeros((n, 0), np.float32))
+        return blocks
+
+    def device_apply(self, enc, dev):
+        return jnp.concatenate([jnp.asarray(b) for b in enc], axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for i, f in enumerate(self.input_features):
+            for key in self.keys_per_feature[i]:
+                strat = self.strategies[i][key]
+                if strat == "pivot":
+                    for lvl in self.vocabs[i][key]:
+                        cols.append(VectorColumnMetadata(
+                            parent_name=f.name, parent_type=f.ftype.__name__,
+                            grouping=key, indicator_value=lvl))
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=key, indicator_value=OTHER_INDICATOR))
+                    if self.track_nulls:
+                        cols.append(VectorColumnMetadata(
+                            parent_name=f.name, parent_type=f.ftype.__name__,
+                            grouping=key, indicator_value=NULL_INDICATOR))
+                elif strat == "hash":
+                    for j in range(self.num_features):
+                        cols.append(VectorColumnMetadata(
+                            parent_name=f.name, parent_type=f.ftype.__name__,
+                            grouping=key, descriptor_value=f"hash_{j}"))
+                    if self.track_nulls:
+                        cols.append(VectorColumnMetadata(
+                            parent_name=f.name, parent_type=f.ftype.__name__,
+                            grouping=key, indicator_value=NULL_INDICATOR))
+                else:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=key, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"keys_per_feature": self.keys_per_feature,
+                "strategies": self.strategies, "vocabs": self.vocabs,
+                "num_features": self.num_features,
+                "track_nulls": self.track_nulls, "seed": self.seed}
+
+
+class SmartTextMapVectorizer(Estimator):
+    """TextMap/TextAreaMap → per-KEY cardinality stats choose pivot vs
+    hashed tokens vs ignore (SmartTextMapVectorizer.scala — the map
+    variant of SmartTextVectorizer; the transmogrify default for
+    TextMap/TextAreaMap, Transmogrifier.scala:196-209)."""
+
+    in_types = (T.OPMap, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, max_cardinality: int = 100, top_k: int = 20,
+                 min_support: int = 10, num_features: int = 512,
+                 id_detect_ratio: float = 0.99, track_nulls: bool = True,
+                 seed: int = 42, uid: Optional[str] = None):
+        super().__init__(
+            uid=uid, max_cardinality=max_cardinality, top_k=top_k,
+            min_support=min_support, num_features=num_features,
+            id_detect_ratio=id_detect_ratio, track_nulls=track_nulls,
+            seed=seed)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_features = num_features
+        self.id_detect_ratio = id_detect_ratio
+        self.track_nulls = track_nulls
+        self.seed = seed
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        keys_pf, strats_pf, vocabs_pf = [], [], []
+        for c in cols:
+            keys = _discover_keys(c)
+            strategies: Dict[str, str] = {}
+            vocabs: Dict[str, List[str]] = {}
+            for k in keys:
+                counter: Counter = Counter()
+                for m in c.data:
+                    v = None if m is None else m.get(k)
+                    if v is not None:
+                        counter[v] += 1
+                n_values = sum(counter.values())
+                n_distinct = len(counter)
+                if n_distinct == 0:
+                    strategies[k] = "ignore"
+                    vocabs[k] = []
+                elif n_distinct <= self.max_cardinality:
+                    strategies[k] = "pivot"
+                    vocabs[k] = top_k_levels(counter, self.top_k,
+                                             self.min_support)
+                elif n_values > 0 and \
+                        n_distinct / n_values >= self.id_detect_ratio:
+                    strategies[k] = "ignore"
+                    vocabs[k] = []
+                else:
+                    strategies[k] = "hash"
+                    vocabs[k] = []
+            keys_pf.append(keys)
+            strats_pf.append(strategies)
+            vocabs_pf.append(vocabs)
+        return SmartTextMapModel(keys_pf, strats_pf, vocabs_pf,
+                                 self.num_features, self.track_nulls,
+                                 self.seed)
+
+
+class MultiPickListMapVectorizer(TextMapPivotVectorizer):
+    """MultiPickListMap → per-key top-K multi-hot
+    (MultiPickListMapVectorizer.scala). The pivot model already multi-hots
+    set values; this named class carries the reference's stage identity and
+    restricts input typing."""
+
+    in_types = (T.MultiPickListMap, Ellipsis)
+
+
+class PhoneMapModel(Transformer):
+    out_type = T.OPVector
+
+    def __init__(self, keys_per_feature: Sequence[Sequence[str]],
+                 default_region: str = "US", track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.keys_per_feature = [list(k) for k in keys_per_feature]
+        self.default_region = default_region
+        self.track_nulls = track_nulls
+
+    def host_prepare(self, cols):
+        from transmogrifai_tpu.ops.enrich import phone_valid_block
+        blocks = []
+        for i, c in enumerate(cols):
+            n = len(c.data)
+            key_blocks = []
+            for key in self.keys_per_feature[i]:
+                values = [None if m is None else m.get(key) for m in c.data]
+                key_blocks.append(phone_valid_block(
+                    values, self.default_region, self.track_nulls))
+            blocks.append(np.concatenate(key_blocks, 1) if key_blocks
+                          else np.zeros((n, 0), np.float32))
+        return blocks
+
+    def device_apply(self, enc, dev):
+        return jnp.concatenate([jnp.asarray(b) for b in enc], axis=1)
+
+    def output_meta(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for i, f in enumerate(self.input_features):
+            for key in self.keys_per_feature[i]:
+                cols.append(VectorColumnMetadata(
+                    parent_name=f.name, parent_type=f.ftype.__name__,
+                    grouping=key, indicator_value="IsValid"))
+                if self.track_nulls:
+                    cols.append(VectorColumnMetadata(
+                        parent_name=f.name, parent_type=f.ftype.__name__,
+                        grouping=key, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.output_name(), tuple(cols)).with_indices()
+
+    def get_params(self):
+        return {"keys_per_feature": self.keys_per_feature,
+                "default_region": self.default_region,
+                "track_nulls": self.track_nulls}
+
+
+class PhoneMapVectorizer(Estimator):
+    """PhoneMap → per-key validity vector (the transmogrify default for
+    PhoneMap, Transmogrifier.scala:185-187)."""
+
+    in_types = (T.PhoneMap, Ellipsis)
+    out_type = T.OPVector
+
+    def __init__(self, default_region: str = "US", track_nulls: bool = True,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, default_region=default_region,
+                         track_nulls=track_nulls)
+        self.default_region = default_region
+        self.track_nulls = track_nulls
+
+    def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
+        return PhoneMapModel([_discover_keys(c) for c in cols],
+                             self.default_region, self.track_nulls)
+
+
 def map_vectorizers(features: Sequence, defaults) -> List:
-    """Dispatch map-typed features to their vectorizers (transmogrify helper)."""
-    numeric, textish, geo, date = [], [], [], []
+    """Dispatch map-typed features to their vectorizers (transmogrify
+    helper; per-type cases Transmogrifier.scala:140-273)."""
+    numeric, pivot, smart, multi, phone, geo, date = ([], [], [], [], [],
+                                                      [], [])
     for f in features:
         ft = f.ftype
         if issubclass(ft, T.GeolocationMap):
             geo.append(f)
         elif issubclass(ft, (T.DateMap,)):
             date.append(f)
-        elif issubclass(ft, (T.RealMap, T.IntegralMap, T.BinaryMap)):
+        elif issubclass(ft, (T.RealMap, T.IntegralMap, T.BinaryMap)) and \
+                not issubclass(ft, T.Prediction):
             numeric.append(f)
-        elif issubclass(ft, (T.TextMap, T.MultiPickListMap)):
-            textish.append(f)
+        elif issubclass(ft, T.PhoneMap):
+            phone.append(f)
+        elif issubclass(ft, T.MultiPickListMap):
+            multi.append(f)
+        elif issubclass(ft, (T.TextAreaMap,)) or ft in (T.TextMap,):
+            # free-text maps → per-key smart strategies
+            smart.append(f)
+        elif issubclass(ft, T.TextMap):
+            # Email/ID/URL/PickList/ComboBox/Base64/location maps → pivot
+            pivot.append(f)
         else:
             raise TypeError(f"No map vectorizer for {ft.__name__} ({f.name})")
     out = []
     if numeric:
         out.append(NumericMapVectorizer(
             track_nulls=defaults.track_nulls).set_input(*numeric).get_output())
-    if textish:
+    if pivot:
         out.append(TextMapPivotVectorizer(
             top_k=defaults.top_k, min_support=defaults.min_support,
-            track_nulls=defaults.track_nulls).set_input(*textish).get_output())
+            track_nulls=defaults.track_nulls).set_input(*pivot).get_output())
+    if smart:
+        out.append(SmartTextMapVectorizer(
+            max_cardinality=defaults.max_cardinality, top_k=defaults.top_k,
+            min_support=defaults.min_support,
+            num_features=defaults.num_hash_features,
+            track_nulls=defaults.track_nulls).set_input(*smart).get_output())
+    if multi:
+        out.append(MultiPickListMapVectorizer(
+            top_k=defaults.top_k, min_support=defaults.min_support,
+            track_nulls=defaults.track_nulls).set_input(*multi).get_output())
+    if phone:
+        out.append(PhoneMapVectorizer(
+            track_nulls=defaults.track_nulls).set_input(*phone).get_output())
     if geo:
         out.append(GeolocationMapVectorizer(
             track_nulls=defaults.track_nulls).set_input(*geo).get_output())

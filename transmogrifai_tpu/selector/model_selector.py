@@ -165,21 +165,21 @@ class ModelSelector(Estimator):
         leak into validation metrics."""
         import jax.numpy as jnp
 
-        fold_X = []
-        for tr, _ in folds:
-            fold_rows = train_idx[np.asarray(tr) > 0.5]
-            Xf_full = np.asarray(ctx.cv_refit(fold_rows))
-            fold_X.append(jnp.asarray(Xf_full[train_idx]))
-
         per_family: Dict[int, List[List[float]]] = {}
         dead: set = set()
-        # fold-outer so all families in one fold share the sweep data cache
+        # fold-outer so all families in one fold share the sweep data cache;
+        # the fold matrix is built AND consumed inside the loop — only one
+        # fold's refit matrix is alive at a time (bounds device memory to
+        # the plain sweep's footprint)
         for fi, (tr, va) in enumerate(folds):
+            fold_rows = train_idx[np.asarray(tr) > 0.5]
+            X_fold = jnp.asarray(
+                np.asarray(ctx.cv_refit(fold_rows))[train_idx])
             for mi, (est, grids) in enumerate(self.models):
                 if mi in dead:
                     continue
                 try:
-                    gm = run_sweep(est, grids, fold_X[fi], y_dev, [(tr, va)],
+                    gm = run_sweep(est, grids, X_fold, y_dev, [(tr, va)],
                                    self.evaluator, ctx, sharding=sharding)
                 except Exception:
                     dead.add(mi)
