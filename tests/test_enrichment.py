@@ -270,7 +270,8 @@ class TestTransmogrifyCoverage:
         assert np.isfinite(arr).all()
         meta = out.meta
         parents = {c.parent_name for c in meta.columns}
-        assert {"phone", "b64", "mpl_map", "txt_map", "phone_map"} <= parents
-        # email/url contribute via their derived domain features
+        assert {"phone", "mpl_map", "txt_map", "phone_map"} <= parents
+        # email/url/base64 contribute via derived domain/MIME features
         assert any(p.startswith("email") for p in parents), parents
         assert any(p.startswith("url") for p in parents), parents
+        assert any(p.startswith("b64") for p in parents), parents

@@ -110,3 +110,83 @@ def test_min_variance_filter():
     assert model.indices == [0]
     out = model.transform([_vec_col(X)])
     assert np.asarray(out.data).shape == (n, 1)
+
+
+class TestReferenceDepth:
+    """VERDICT r1 #7: Spearman, feature-feature corr, PMI/MI/rule
+    confidence, sampling."""
+
+    def _fit(self, X, y, meta=None, **kw):
+        from transmogrifai_tpu.automl.sanity_checker import SanityChecker
+        from transmogrifai_tpu.data.columns import Column
+        import transmogrifai_tpu.types as T
+        lcol = Column(T.RealNN, {"value": y.astype(np.float64),
+                                 "mask": np.ones(len(y), dtype=bool)})
+        vcol = Column(T.OPVector, X.astype(np.float32), meta=meta)
+        est = SanityChecker(**kw)
+        return est.fit_model([lcol, vcol], FitContext(n_rows=len(y)))
+
+    def test_duplicated_column_dropped_by_feature_corr(self, rng):
+        n = 300
+        x = rng.normal(size=n)
+        y = (x + rng.normal(0, 1, size=n) > 0).astype(float)
+        X = np.stack([x, rng.normal(size=n), x * 1.0], axis=1)  # col2 = col0
+        model = self._fit(X, y)
+        assert model.indices == [0, 1]  # the LATER duplicate dropped
+        reasons = model.summary["stats"][2]["dropped"]
+        assert any("corr" in r and "col_0" in r for r in reasons), reasons
+
+    def test_spearman_detects_monotone_nonlinear(self, rng):
+        n = 400
+        x = rng.uniform(size=n)
+        y = np.exp(6 * x)  # monotone but very non-linear
+        X = np.stack([x, rng.normal(size=n)], axis=1)
+        pear = self._fit(X, y, correlation_type="pearson",
+                         max_feature_corr=1.0)
+        spear = self._fit(X, y, correlation_type="spearman",
+                          max_feature_corr=1.0)
+        sp = spear.summary["stats"][0]["corrLabel"]
+        pe = pear.summary["stats"][0]["corrLabel"]
+        assert sp > 0.99            # rank corr is exactly monotone
+        assert pe < 0.95            # pearson understates it
+        assert spear.summary["correlationType"] == "spearman"
+
+    def test_rule_confidence_drop(self, rng):
+        from transmogrifai_tpu.data.metadata import (
+            VectorColumnMetadata, VectorMetadata)
+        n = 200
+        y = (np.arange(n) % 2).astype(float)
+        # one-hot "level A" column that PERFECTLY implies label 1
+        a = (y == 1.0).astype(np.float32)
+        b = rng.normal(size=n).astype(np.float32)
+        X = np.stack([a, 1.0 - a, b], axis=1)
+        meta = VectorMetadata("v", (
+            VectorColumnMetadata("cat", "PickList", grouping="cat",
+                                 indicator_value="A"),
+            VectorColumnMetadata("cat", "PickList", grouping="cat",
+                                 indicator_value="B"),
+            VectorColumnMetadata("num", "Real"),
+        )).with_indices()
+        model = self._fit(X, y, meta=meta, max_rule_confidence=0.9,
+                          min_required_rule_support=0.1,
+                          max_cramers_v=2.0,       # isolate the rule check
+                          max_correlation=2.0, max_feature_corr=1.0)
+        dropped = set(model.summary["dropped"])
+        assert 0 in dropped and 1 in dropped  # the perfect-rule group
+        assert 2 in model.indices
+        cats = model.summary["categoricalStats"]
+        assert cats and cats[0]["maxRuleConfidences"][0] == 1.0
+        assert "pointwiseMutualInfo" in cats[0]
+        assert cats[0]["mutualInfo"] > 0.5  # ~1 bit for a perfect predictor
+
+    def test_sampling_limits(self, rng):
+        n = 5000
+        X = rng.normal(size=(n, 3))
+        y = (X[:, 0] > 0).astype(float)
+        model = self._fit(X, y, check_sample=0.2, sample_lower_limit=100,
+                          max_feature_corr=1.0)
+        s = model.summary
+        assert s["n_rows"] == 1000  # 20% sample
+        assert abs(s["sampleFraction"] - 0.2) < 1e-9
+        # statistics still sound on the sample
+        assert abs(s["stats"][0]["corrLabel"]) > 0.5

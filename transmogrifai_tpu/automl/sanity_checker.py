@@ -1,14 +1,20 @@
 """SanityChecker & MinVarianceFilter: automated feature validation.
 
 Reference parity: `core/.../preparators/SanityChecker.scala:232-656`
-(colStats + label correlations + categorical Cramér's V, drop rules, summary
-metadata) and `MinVarianceFilter.scala:58,145`.
+(sampling, Pearson/Spearman label correlations, full feature-feature
+correlation matrix, categorical contingency stats — Cramér's V, pointwise
+mutual information, mutual information, association-rule max confidence —
+drop rules from `DerivedFeatureFilterUtils.scala:355-385`, defaults
+`SanityChecker.scala:561-578`), statistics math from
+`utils/.../stats/OpStatistics.scala:180-320`, and
+`MinVarianceFilter.scala:58,145`.
 
-TPU-first: all statistics are single-pass masked reductions over the (n, d)
-feature matrix — sums, squared sums, X·y and group contingency via one-hot
-label matmul — each a `psum`-ready reduction over the sharded batch axis.
-Drop decisions (data-dependent shapes) resolve on host at fit time; the
-fitted model is a static-index column gather that XLA fuses downstream.
+TPU-first: moments, label correlation and the feature-feature Gram matrix
+are ONE fused device pass over (n, d+1) — `Z^T Z` rides the MXU and every
+term is a row-axis sum (`psum`-ready under a data-sharded mesh). Spearman
+reuses the same pass over host-ranked columns. Contingency tables are
+one-hot × one-hot matmuls. Drop decisions (data-dependent shapes) resolve
+on host at fit time; the fitted model is a static-index column gather.
 """
 
 from __future__ import annotations
@@ -25,6 +31,18 @@ from transmogrifai_tpu.data.columns import Column
 from transmogrifai_tpu.data.metadata import VectorMetadata
 from transmogrifai_tpu.stages.base import Estimator, FitContext, Transformer
 
+# reference defaults (SanityChecker.scala:561-578)
+CHECK_SAMPLE = 1.0
+SAMPLE_LOWER_LIMIT = 1_000
+SAMPLE_UPPER_LIMIT = 1_000_000
+MAX_CORRELATION = 0.95
+MAX_FEATURE_CORR = 0.99
+MIN_CORRELATION = 0.0
+MIN_VARIANCE = 1e-5
+MAX_CRAMERS_V = 0.95
+MAX_RULE_CONFIDENCE = 1.0       # 1.0/1.0 = rule-confidence check off
+MIN_REQUIRED_RULE_SUPPORT = 1.0
+
 
 @dataclass
 class ColumnStats:
@@ -35,13 +53,39 @@ class ColumnStats:
     max: float
     corr_label: float
     cramers_v: Optional[float]
+    mutual_info: Optional[float] = None
+    max_rule_confidence: Optional[float] = None
+    support: Optional[float] = None
     dropped: List[str] = field(default_factory=list)
 
     def to_json(self) -> Dict:
         return {
             "name": self.name, "mean": self.mean, "variance": self.variance,
             "min": self.min, "max": self.max, "corrLabel": self.corr_label,
-            "cramersV": self.cramers_v, "dropped": self.dropped,
+            "cramersV": self.cramers_v, "mutualInfo": self.mutual_info,
+            "maxRuleConfidence": self.max_rule_confidence,
+            "support": self.support, "dropped": self.dropped,
+        }
+
+
+@dataclass
+class CategoricalGroupStats:
+    """Per categorical group (OpStatistics.ContingencyStats analogue)."""
+
+    group: str
+    cramers_v: float
+    mutual_info: float
+    pointwise_mutual_info: Dict[str, List[float]]
+    max_rule_confidences: List[float]
+    supports: List[float]
+
+    def to_json(self) -> Dict:
+        return {
+            "group": self.group, "cramersV": self.cramers_v,
+            "mutualInfo": self.mutual_info,
+            "pointwiseMutualInfo": self.pointwise_mutual_info,
+            "maxRuleConfidences": self.max_rule_confidences,
+            "supports": self.supports,
         }
 
 
@@ -53,39 +97,69 @@ class SanityCheckerSummary:
     stats: List[ColumnStats]
     kept_indices: List[int]
     dropped_indices: List[int]
+    correlation_type: str = "pearson"
+    sample_fraction: float = 1.0
+    categorical_stats: List[CategoricalGroupStats] = field(default_factory=list)
 
     def to_json(self) -> Dict:
         return {
             "n_rows": self.n_rows,
             "stats": [s.to_json() for s in self.stats],
             "kept": self.kept_indices, "dropped": self.dropped_indices,
+            "correlationType": self.correlation_type,
+            "sampleFraction": self.sample_fraction,
+            "categoricalStats": [c.to_json() for c in self.categorical_stats],
         }
 
 
-def _column_reductions(X: jnp.ndarray, y: jnp.ndarray):
-    """One fused pass: per-column moments + label correlation terms.
+def _column_reductions(X: jnp.ndarray, y: Optional[jnp.ndarray] = None):
+    """One fused pass: per-column moments (+ label terms when y given —
+    correlations now come from the `_corr_matrix` Gram pass, so the
+    checker calls this with y=None).
 
     Every term is a sum over rows → shard the row axis, `psum` the sums.
     """
     n = X.shape[0]
-    sx = X.sum(0)
-    sxx = (X * X).sum(0)
-    sy = y.sum()
-    syy = (y * y).sum()
-    sxy = X.T @ y
-    xmin = X.min(0) if n else jnp.zeros(X.shape[1])
-    xmax = X.max(0) if n else jnp.zeros(X.shape[1])
-    return {"n": n, "sx": sx, "sxx": sxx, "sy": sy, "syy": syy, "sxy": sxy,
-            "min": xmin, "max": xmax}
+    out = {"n": n, "sx": X.sum(0), "sxx": (X * X).sum(0),
+           "min": X.min(0) if n else jnp.zeros(X.shape[1]),
+           "max": X.max(0) if n else jnp.zeros(X.shape[1])}
+    if y is not None:
+        out.update({"sy": y.sum(), "syy": (y * y).sum(), "sxy": X.T @ y})
+    return out
 
 
-def _label_onehot(y: np.ndarray, max_card: int) -> Optional[np.ndarray]:
-    """One-hot label for contingency tests, or None if not categorical."""
+def _corr_matrix(Z: jnp.ndarray) -> np.ndarray:
+    """Full correlation matrix of (n, k) via one Gram matmul (MXU path;
+    rows sharded → psum). Columns with zero variance correlate as 0."""
+    n = Z.shape[0]
+    mean = Z.mean(0)
+    Zc = Z - mean
+    cov = np.asarray(Zc.T @ Zc) / max(n - 1, 1)
+    sd = np.sqrt(np.maximum(np.diag(cov), 0.0))
+    denom = np.outer(sd, sd)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        corr = np.where(denom > 0, np.asarray(cov) / denom, 0.0)
+    return corr
+
+
+def _rank_transform(A: np.ndarray) -> np.ndarray:
+    """Average-tie ranks per column (Spearman = Pearson over ranks)."""
+    import pandas as pd
+    return pd.DataFrame(A).rank(method="average").to_numpy(dtype=np.float32)
+
+
+def _label_onehot(y: np.ndarray, max_card: int,
+                  force: Optional[bool] = None) -> Optional[np.ndarray]:
+    """One-hot label for contingency tests, or None if not categorical.
+    `force=True` treats the (rounded) label as categorical regardless of
+    the integrality/cardinality heuristics (categoricalLabel param)."""
+    if force is False:
+        return None
     yi = np.round(y).astype(np.int64)
-    if not np.allclose(y, yi, atol=1e-6):
+    if force is not True and not np.allclose(y, yi, atol=1e-6):
         return None
     levels = np.unique(yi)
-    if len(levels) < 2 or len(levels) > max_card:
+    if len(levels) < 2 or (force is not True and len(levels) > max_card):
         return None
     lut = {v: i for i, v in enumerate(levels.tolist())}
     idx = np.array([lut[v] for v in yi.tolist()])
@@ -95,20 +169,44 @@ def _label_onehot(y: np.ndarray, max_card: int) -> Optional[np.ndarray]:
 
 
 def cramers_v(contingency: np.ndarray) -> float:
-    """Cramér's V from a levels × labels count table
-    (OpStatistics.scala contingency analysis)."""
-    n = contingency.sum()
+    """Cramér's V from a levels × labels count table, empty rows/cols
+    filtered first (OpStatistics.chiSquaredTest, OpStatistics.scala:188)."""
+    cont = contingency[contingency.sum(1) > 0][:, contingency.sum(0) > 0]
+    if cont.shape[0] < 2 or cont.shape[1] < 2:
+        return 0.0
+    n = cont.sum()
     if n == 0:
         return 0.0
-    row = contingency.sum(axis=1, keepdims=True)
-    col = contingency.sum(axis=0, keepdims=True)
+    row = cont.sum(axis=1, keepdims=True)
+    col = cont.sum(axis=0, keepdims=True)
     expected = row @ col / n
     with np.errstate(divide="ignore", invalid="ignore"):
         chi2 = np.where(expected > 0,
-                        (contingency - expected) ** 2 / expected, 0.0).sum()
-    r, c = contingency.shape
-    denom = n * (min(r, c) - 1)
+                        (cont - expected) ** 2 / expected, 0.0).sum()
+    denom = n * (min(cont.shape) - 1)
     return float(np.sqrt(chi2 / denom)) if denom > 0 else 0.0
+
+
+def contingency_stats(cont: np.ndarray) -> Dict:
+    """PMI / mutual info / association-rule confidences from a levels ×
+    labels table (OpStatistics.mutualInfo:234-276, maxConfidences:280-296).
+    """
+    total = cont.sum()
+    row = cont.sum(axis=1)          # per level
+    col = cont.sum(axis=0)          # per label
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pmi = np.where(
+            (cont > 0) & (row[:, None] > 0) & (col[None, :] > 0),
+            np.log2(np.maximum(cont, 1e-99) * total
+                    / np.maximum(row[:, None] * col[None, :], 1e-99)),
+            0.0)
+        mi = float((pmi * cont / max(total, 1)).sum())
+        conf = np.where(row > 0, cont.max(axis=1) / np.maximum(row, 1), 0.0)
+    supports = (row / max(total, 1)).tolist()
+    pmi_map = {str(j): pmi[:, j].tolist() for j in range(cont.shape[1])}
+    return {"cramers_v": cramers_v(cont), "mutual_info": mi,
+            "pmi": pmi_map, "max_confidences": conf.tolist(),
+            "supports": supports}
 
 
 class SanityCheckerModel(Transformer):
@@ -141,72 +239,154 @@ class SanityCheckerModel(Transformer):
 class SanityChecker(Estimator):
     """BinaryEstimator(RealNN label, OPVector) → cleaned OPVector.
 
-    Drop rules (DerivedFeatureFilterUtils analogue): variance below
-    `min_variance`; |corr(feature, label)| above `max_correlation` (leakage)
-    or below `min_correlation`; categorical-group Cramér's V above
-    `max_cramers_v` (leakage).
+    Drop rules (DerivedFeatureFilterUtils.scala:355-385): variance below
+    `min_variance`; |corr(feature, label)| above `max_correlation`
+    (leakage) or below `min_correlation`; |corr| with an EARLIER feature
+    column above `max_feature_corr` (duplicates — later column dropped);
+    categorical-group Cramér's V above `max_cramers_v`; association-rule
+    confidence above `max_rule_confidence` at support above
+    `min_required_rule_support`.
     """
 
     in_types = (T.RealNN, T.OPVector)
     out_type = T.OPVector
 
-    def __init__(self, max_correlation: float = 0.95,
-                 min_correlation: float = 0.0, min_variance: float = 1e-5,
-                 max_cramers_v: float = 0.95, remove_bad_features: bool = True,
+    def __init__(self, max_correlation: float = MAX_CORRELATION,
+                 min_correlation: float = MIN_CORRELATION,
+                 max_feature_corr: float = MAX_FEATURE_CORR,
+                 min_variance: float = MIN_VARIANCE,
+                 max_cramers_v: float = MAX_CRAMERS_V,
+                 max_rule_confidence: float = MAX_RULE_CONFIDENCE,
+                 min_required_rule_support: float = MIN_REQUIRED_RULE_SUPPORT,
+                 correlation_type: str = "pearson",
+                 check_sample: float = CHECK_SAMPLE,
+                 sample_lower_limit: int = SAMPLE_LOWER_LIMIT,
+                 sample_upper_limit: int = SAMPLE_UPPER_LIMIT,
+                 sample_seed: int = 42,
+                 remove_bad_features: bool = True,
+                 categorical_label: Optional[bool] = None,
                  categorical_label_max_card: int = 30,
                  uid: Optional[str] = None):
+        if correlation_type not in ("pearson", "spearman"):
+            raise ValueError("correlation_type must be pearson or spearman")
         super().__init__(
             uid=uid, max_correlation=max_correlation,
-            min_correlation=min_correlation, min_variance=min_variance,
-            max_cramers_v=max_cramers_v, remove_bad_features=remove_bad_features,
+            min_correlation=min_correlation, max_feature_corr=max_feature_corr,
+            min_variance=min_variance, max_cramers_v=max_cramers_v,
+            max_rule_confidence=max_rule_confidence,
+            min_required_rule_support=min_required_rule_support,
+            correlation_type=correlation_type, check_sample=check_sample,
+            sample_lower_limit=sample_lower_limit,
+            sample_upper_limit=sample_upper_limit, sample_seed=sample_seed,
+            remove_bad_features=remove_bad_features,
+            categorical_label=categorical_label,
             categorical_label_max_card=categorical_label_max_card)
         self.max_correlation = max_correlation
         self.min_correlation = min_correlation
+        self.max_feature_corr = max_feature_corr
         self.min_variance = min_variance
         self.max_cramers_v = max_cramers_v
+        self.max_rule_confidence = max_rule_confidence
+        self.min_required_rule_support = min_required_rule_support
+        self.correlation_type = correlation_type
+        self.check_sample = check_sample
+        self.sample_lower_limit = sample_lower_limit
+        self.sample_upper_limit = sample_upper_limit
+        self.sample_seed = sample_seed
         self.remove_bad_features = remove_bad_features
+        self.categorical_label = categorical_label
         self.categorical_label_max_card = categorical_label_max_card
+
+    # ------------------------------------------------------------------ #
+
+    def _sample_rows(self, n: int) -> Optional[np.ndarray]:
+        """Row subsample for the statistics pass (checkSample/limits,
+        SanityChecker.scala:60-92); None = use everything."""
+        target = n
+        if self.check_sample < 1.0:
+            target = int(n * self.check_sample)
+        target = min(target, self.sample_upper_limit)
+        target = max(target, min(n, self.sample_lower_limit))
+        if target >= n:
+            return None
+        rng = np.random.default_rng(self.sample_seed)
+        return np.sort(rng.choice(n, size=target, replace=False))
 
     def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
         label_col, vec_col = cols
         y_np = np.asarray(label_col.data["value"], dtype=np.float64)
-        X = jnp.asarray(vec_col.device_value())
+        X_np = np.asarray(vec_col.device_value())
+        n_total = X_np.shape[0]
+
+        sample_idx = self._sample_rows(n_total)
+        if sample_idx is not None:
+            X_np = X_np[sample_idx]
+            y_np = y_np[sample_idx]
+        X = jnp.asarray(X_np)
         y = jnp.asarray(y_np.astype(np.float32))
         n, d = X.shape
 
-        red = {k: np.asarray(v) for k, v in _column_reductions(X, y).items()}
+        red = {k: np.asarray(v) for k, v in _column_reductions(X).items()}
         mean = red["sx"] / max(n, 1)
         var = (red["sxx"] - n * mean ** 2) / max(n - 1, 1)
         var = np.maximum(var, 0.0)
-        y_mean = red["sy"] / max(n, 1)
-        y_var = max((red["syy"] - n * y_mean ** 2) / max(n - 1, 1), 0.0)
-        cov = (red["sxy"] - n * mean * y_mean) / max(n - 1, 1)
-        denom = np.sqrt(var * y_var)
-        with np.errstate(divide="ignore", invalid="ignore"):
-            corr = np.where(denom > 0, cov / denom, 0.0)
+
+        # full corr matrix of [X | y]: one Gram matmul on device; Spearman
+        # ranks on host feed the identical pass (OpStatistics streaming corr)
+        if self.correlation_type == "spearman":
+            Z = jnp.asarray(np.concatenate(
+                [_rank_transform(np.asarray(X_np)),
+                 _rank_transform(y_np[:, None])], axis=1))
+        else:
+            Z = jnp.concatenate([X, y[:, None]], axis=1)
+        corr_all = _corr_matrix(Z)
+        corr = corr_all[:d, d]          # label column
+        feat_corr = corr_all[:d, :d]
 
         meta = vec_col.meta
         names = (meta.column_names() if meta is not None
                  else [f"col_{i}" for i in range(d)])
 
-        # categorical groups → Cramér's V against a categorical label
-        group_v: Dict[int, float] = {}
+        # categorical groups → contingency stats vs a categorical label
+        group_stats: Dict[int, Tuple[str, Dict]] = {}
+        cat_groups: List[CategoricalGroupStats] = []
         if meta is not None:
-            oh = _label_onehot(y_np, self.categorical_label_max_card)
+            oh = _label_onehot(y_np, self.categorical_label_max_card,
+                               force=self.categorical_label)
             if oh is not None:
                 groups: Dict[str, List[int]] = {}
                 for i, c in enumerate(meta.columns):
                     if c.indicator_value is not None:
                         groups.setdefault(c.grouping_key(), []).append(i)
-                Xn = np.asarray(X)
+                Xh = X_np  # the sampled host matrix (no device round-trip)
                 for key, idxs in groups.items():
-                    cont = Xn[:, idxs].T @ oh  # levels × labels counts
-                    v = cramers_v(cont)
-                    for i in idxs:
-                        group_v[i] = v
+                    cont = Xh[:, idxs].T.astype(np.float64) @ oh
+                    cs = contingency_stats(cont)
+                    cat_groups.append(CategoricalGroupStats(
+                        group=key, cramers_v=cs["cramers_v"],
+                        mutual_info=cs["mutual_info"],
+                        pointwise_mutual_info=cs["pmi"],
+                        max_rule_confidences=cs["max_confidences"],
+                        supports=cs["supports"]))
+                    for li, i in enumerate(idxs):
+                        group_stats[i] = (key, {
+                            "cramers_v": cs["cramers_v"],
+                            "mutual_info": cs["mutual_info"],
+                            "conf": cs["max_confidences"][li],
+                            "support": cs["supports"][li]})
+
+        # feature-feature duplicates: vectorized candidate pairs, then the
+        # "later column drops" scan ("dropping the later features",
+        # DerivedFeatureFilterUtils:376)
+        hit_lists: Dict[int, np.ndarray] = {}
+        if self.max_feature_corr < 1.0 and d > 1:
+            hit = np.abs(np.tril(feat_corr, k=-1)) > self.max_feature_corr
+            for i in np.flatnonzero(hit.any(axis=1)):
+                hit_lists[int(i)] = np.flatnonzero(hit[i])
 
         stats: List[ColumnStats] = []
         kept: List[int] = []
+        dropped_so_far: set = set()
         for i in range(d):
             reasons: List[str] = []
             if var[i] < self.min_variance:
@@ -216,15 +396,34 @@ class SanityChecker(Estimator):
                 reasons.append(f"label corr {ac:.3f} > {self.max_correlation}")
             elif self.min_correlation > 0 and ac < self.min_correlation:
                 reasons.append(f"label corr {ac:.3f} < {self.min_correlation}")
-            gv = group_v.get(i)
-            if gv is not None and gv > self.max_cramers_v:
-                reasons.append(f"cramersV {gv:.3f} > {self.max_cramers_v}")
+            for j in hit_lists.get(i, ()):
+                if j not in dropped_so_far:
+                    reasons.append(
+                        f"corr {feat_corr[i, j]:.3f} with column "
+                        f"{names[j]!r} > {self.max_feature_corr}")
+                    break
+            gs = group_stats.get(i)
+            gv = mi = conf = sup = None
+            if gs is not None:
+                key, s = gs
+                gv, mi = s["cramers_v"], s["mutual_info"]
+                conf, sup = s["conf"], s["support"]
+                if gv > self.max_cramers_v:
+                    reasons.append(f"cramersV {gv:.3f} > {self.max_cramers_v}")
+                if (conf > self.max_rule_confidence
+                        and sup > self.min_required_rule_support):
+                    reasons.append(
+                        f"rule confidence {conf:.3f} > "
+                        f"{self.max_rule_confidence} at support {sup:.3f}")
             stats.append(ColumnStats(
                 name=names[i], mean=float(mean[i]), variance=float(var[i]),
                 min=float(red["min"][i]), max=float(red["max"][i]),
-                corr_label=float(corr[i]), cramers_v=gv, dropped=reasons))
+                corr_label=float(corr[i]), cramers_v=gv, mutual_info=mi,
+                max_rule_confidence=conf, support=sup, dropped=reasons))
             if not reasons or not self.remove_bad_features:
                 kept.append(i)
+            elif reasons:
+                dropped_so_far.add(i)
 
         if not kept:  # never drop everything (reference keeps result usable)
             kept = list(range(d))
@@ -234,7 +433,10 @@ class SanityChecker(Estimator):
         kept_set = set(kept)
         summary = SanityCheckerSummary(
             n_rows=n, stats=stats, kept_indices=kept,
-            dropped_indices=[i for i in range(d) if i not in kept_set])
+            dropped_indices=[i for i in range(d) if i not in kept_set],
+            correlation_type=self.correlation_type,
+            sample_fraction=n / max(n_total, 1),
+            categorical_stats=cat_groups)
         sel_meta = meta.select(kept) if meta is not None else None
         return SanityCheckerModel(kept, meta=sel_meta, summary=summary.to_json())
 

@@ -12,9 +12,16 @@ The per-type dispatch (reference match block `Transmogrifier.scala:116-344`):
   Integral                    → mode impute + null indicator
   Binary                      → value + null indicator
   PickList/ComboBox/Country/
-  State/City/PostalCode/Street→ top-K pivot (one-hot + OTHER + null)
-  Text/TextArea/ID/Email/URL/
-  Phone/Base64                → SmartTextVectorizer (pivot vs hash vs ignore)
+  State/City/PostalCode/
+  Street/ID                   → top-K pivot (one-hot + OTHER + null)
+  Text/TextArea               → SmartTextVectorizer (pivot vs hash vs ignore)
+  Email                       → domain → pivot (RichTextFeature:620)
+  URL                         → valid-domain → pivot (RichTextFeature:670)
+  Phone                       → validity vector (RichTextFeature:569)
+  Base64                      → MIME type → pivot (the reference pivots raw
+                                values with a "make better default" TODO,
+                                Transmogrifier.scala:281; MIME-first is
+                                that better default via MimeTypeDetector)
   MultiPickList               → top-K multi-hot
   TextList                    → hashed token counts
   Date/DateTime               → unit-circle encodings
@@ -52,9 +59,9 @@ class TransmogrifierDefaults:
 
 
 # Categorical text types that always pivot (vs SmartText deciding);
-# ID and Base64 pivot raw values (Transmogrifier.scala:281-287, :299-303).
+# ID pivots raw values (Transmogrifier.scala:292-295).
 _PIVOT_TYPES = (T.PickList, T.ComboBox, T.Country, T.State, T.City,
-                T.PostalCode, T.Street, T.ID, T.Base64)
+                T.PostalCode, T.Street, T.ID)
 # Free-text types routed through SmartTextVectorizer
 # (Transmogrifier.scala:305-321).
 _SMART_TEXT_TYPES = (T.TextArea, T.Text)
@@ -80,6 +87,8 @@ def _group_features(features: Sequence) -> Dict[str, List]:
             key = "url"      # valid-domain pivot (RichTextFeature.scala:670)
         elif issubclass(ft, T.Phone):
             key = "phone"    # validity vector (RichTextFeature.scala:569)
+        elif issubclass(ft, T.Base64):
+            key = "base64"   # MIME type → pivot (MimeTypeDetector)
         elif issubclass(ft, _PIVOT_TYPES):
             key = "pivot"
         elif issubclass(ft, _SMART_TEXT_TYPES):
@@ -147,6 +156,14 @@ def transmogrify(features: Sequence, defaults: Optional[TransmogrifierDefaults] 
         from transmogrifai_tpu.ops.enrich import PhoneVectorizer
         vectors.append(PhoneVectorizer(
             track_nulls=d.track_nulls).set_input(*groups["phone"]).get_output())
+    if "base64" in groups:
+        from transmogrifai_tpu.ops.enrich import MimeTypeDetector
+        mimes = [MimeTypeDetector().set_input(f).get_output()
+                 for f in groups["base64"]]
+        # MIME cardinality is tiny: pivot every observed type
+        vectors.append(OneHotVectorizer(
+            top_k=d.top_k, min_support=1, track_nulls=d.track_nulls
+        ).set_input(*mimes).get_output())
     if "smart_text" in groups:
         vectors.append(SmartTextVectorizer(
             max_cardinality=d.max_cardinality, top_k=d.top_k,

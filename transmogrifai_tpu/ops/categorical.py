@@ -42,7 +42,10 @@ def pivot_encode_ids(values, lut: Dict[str, int], k: int) -> np.ndarray:
     with |levels| lookups + one unique/take (VERDICT r1 weak#5)."""
     n = len(values)
     arr = np.asarray(values, dtype=object)
-    mask = np.fromiter((v is not None for v in arr), dtype=bool, count=n)
+    # None and float NaN are both missing → NULL id (pd.factorize would
+    # otherwise code NaN as -1, which fancy-indexes the LAST level)
+    mask = np.fromiter((v is not None and v == v for v in arr),
+                       dtype=bool, count=n)
     out = np.full(n, k + 1, dtype=np.int32)  # NULL id
     present = arr[mask]
     if present.size:

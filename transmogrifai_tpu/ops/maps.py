@@ -639,12 +639,16 @@ def map_vectorizers(features: Sequence, defaults) -> List:
                                                       [], [])
     for f in features:
         ft = f.ftype
+        if issubclass(ft, T.Prediction):
+            raise TypeError(
+                f"transmogrify: refusing to vectorize Prediction feature "
+                f"{f.name!r} — feeding model scores back in is usually "
+                f"label leakage; extract explicit columns if intended")
         if issubclass(ft, T.GeolocationMap):
             geo.append(f)
         elif issubclass(ft, (T.DateMap,)):
             date.append(f)
-        elif issubclass(ft, (T.RealMap, T.IntegralMap, T.BinaryMap)) and \
-                not issubclass(ft, T.Prediction):
+        elif issubclass(ft, (T.RealMap, T.IntegralMap, T.BinaryMap)):
             numeric.append(f)
         elif issubclass(ft, T.PhoneMap):
             phone.append(f)
