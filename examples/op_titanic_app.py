@@ -1,0 +1,35 @@
+"""Titanic as a runnable application (OpTitanic / OpAppWithRunner parity,
+`helloworld/.../titanic/OpTitanic.scala`): the same pipeline as
+op_titanic_simple, wrapped in a WorkflowRunner so the CLI can drive
+train / score / evaluate from an OpParams JSON.
+
+  python -m transmogrifai_tpu.cli run --app op_titanic_app:runner \
+      --run-type train --params params.json
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+from op_titanic_simple import DATA, SCHEMA, build_pipeline  # noqa: E402
+
+from transmogrifai_tpu.evaluators import (  # noqa: E402
+    BinaryClassificationEvaluator)
+from transmogrifai_tpu.readers import CSVReader  # noqa: E402
+from transmogrifai_tpu.workflow import Workflow  # noqa: E402
+from transmogrifai_tpu.workflow.runner import WorkflowRunner  # noqa: E402
+
+
+def runner(csv_path: str = DATA) -> WorkflowRunner:
+    survived, prediction = build_pipeline()
+    workflow = Workflow().set_result_features(prediction, survived)
+    reader = CSVReader(csv_path, schema=SCHEMA)
+    return WorkflowRunner(
+        workflow,
+        train_reader=reader,
+        score_reader=reader,
+        evaluator=BinaryClassificationEvaluator(),
+        label_feature=survived,
+        prediction_feature=prediction)

@@ -322,26 +322,47 @@ class SanityChecker(Estimator):
         if sample_idx is not None:
             X_np = X_np[sample_idx]
             y_np = y_np[sample_idx]
-        X = jnp.asarray(X_np)
-        y = jnp.asarray(y_np.astype(np.float32))
-        n, d = X.shape
+        n, d = X_np.shape
 
-        red = {k: np.asarray(v) for k, v in _column_reductions(X).items()}
+        # Spearman = Pearson over average-tie ranks (host rank transform
+        # feeding the identical device passes); `Cx/cy` are the correlation
+        # inputs, raw-X moments are reported in the stats either way
+        spearman = self.correlation_type == "spearman"
+        X_dev = jnp.asarray(X_np)
+        if spearman:
+            Cx = jnp.asarray(_rank_transform(X_np))
+            cy = jnp.asarray(_rank_transform(y_np[:, None])[:, 0])
+        else:
+            Cx = X_dev
+            cy = jnp.asarray(y_np.astype(np.float32))
+
+        need_ff = self.max_feature_corr < 1.0
+        redc = {k: np.asarray(v)
+                for k, v in _column_reductions(Cx, cy).items()}
+        red = ({k: np.asarray(v)
+                for k, v in _column_reductions(X_dev).items()}
+               if spearman else redc)
         mean = red["sx"] / max(n, 1)
         var = (red["sxx"] - n * mean ** 2) / max(n - 1, 1)
         var = np.maximum(var, 0.0)
-
-        # full corr matrix of [X | y]: one Gram matmul on device; Spearman
-        # ranks on host feed the identical pass (OpStatistics streaming corr)
-        if self.correlation_type == "spearman":
-            Z = jnp.asarray(np.concatenate(
-                [_rank_transform(np.asarray(X_np)),
-                 _rank_transform(y_np[:, None])], axis=1))
+        if need_ff:
+            # full corr matrix of [X | y]: ONE Gram matmul on the MXU
+            corr_all = _corr_matrix(jnp.concatenate([Cx, cy[:, None]], 1))
+            corr = corr_all[:d, d]
+            feat_corr = corr_all[:d, :d]
         else:
-            Z = jnp.concatenate([X, y[:, None]], axis=1)
-        corr_all = _corr_matrix(Z)
-        corr = corr_all[:d, d]          # label column
-        feat_corr = corr_all[:d, :d]
+            # duplicates check disabled → O(n·d) label terms suffice
+            cmean = redc["sx"] / max(n, 1)
+            cvar = np.maximum(
+                (redc["sxx"] - n * cmean ** 2) / max(n - 1, 1), 0.0)
+            y_mean = redc["sy"] / max(n, 1)
+            y_var = max(
+                (redc["syy"] - n * y_mean ** 2) / max(n - 1, 1), 0.0)
+            cov = (redc["sxy"] - n * cmean * y_mean) / max(n - 1, 1)
+            denom = np.sqrt(cvar * y_var)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                corr = np.where(denom > 0, cov / denom, 0.0)
+            feat_corr = None
 
         meta = vec_col.meta
         names = (meta.column_names() if meta is not None

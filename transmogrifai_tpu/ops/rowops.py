@@ -16,7 +16,6 @@ extract-fn class-name persistence (`FeatureGeneratorStage.scala:129`).
 
 from __future__ import annotations
 
-import importlib
 from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
@@ -24,6 +23,7 @@ import numpy as np
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.data.columns import Column, kind_of, SCALAR, TEXT
 from transmogrifai_tpu.stages.base import HostTransformer, Transformer
+from transmogrifai_tpu.utils.fnser import decode_fn, encode_fn
 
 
 def _values_of(col: Column):
@@ -57,16 +57,18 @@ class AliasTransformer(HostTransformer):
 
 
 class LambdaMap(HostTransformer):
-    """feature.map(fn): arbitrary row transform to `out_type`. `fn` must be
-    a module-level named function for model persistence."""
+    """feature.map(fn): arbitrary row transform to `out_type`. Lambdas and
+    closures persist via cloudpickle (utils/fnser.py); named functions as
+    module:name references."""
 
     in_types = None
 
     def __init__(self, fn: Callable[[Any], Any], out_type: type,
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
-        self.fn = fn
-        self._out = out_type
+        self.fn = decode_fn(fn)
+        self._out = (out_type if isinstance(out_type, type)
+                     else T.feature_type_by_name(out_type))
 
     def output_ftype(self) -> type:
         return self._out
@@ -76,16 +78,7 @@ class LambdaMap(HostTransformer):
         return Column.from_values(self._out, [self.fn(v) for v in vals])
 
     def get_params(self):
-        return {"fn": f"{self.fn.__module__}:{self.fn.__qualname__}",
-                "out_type": self._out.__name__}
-
-    @staticmethod
-    def resolve_fn(ref: str) -> Callable:
-        mod, qual = ref.split(":")
-        obj: Any = importlib.import_module(mod)
-        for part in qual.split("."):
-            obj = getattr(obj, part)
-        return obj
+        return {"fn": encode_fn(self.fn), "out_type": self._out.__name__}
 
 
 class FilterTransformer(HostTransformer):
@@ -97,7 +90,7 @@ class FilterTransformer(HostTransformer):
     def __init__(self, predicate: Callable[[Any], bool],
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
-        self.predicate = predicate
+        self.predicate = decode_fn(predicate)
 
     def output_ftype(self) -> type:
         return self.input_features[0].ftype
@@ -109,7 +102,7 @@ class FilterTransformer(HostTransformer):
         return Column.from_values(ft, kept)
 
     def get_params(self):
-        return {"predicate": f"{self.predicate.__module__}:{self.predicate.__qualname__}"}
+        return {"predicate": encode_fn(self.predicate)}
 
 
 class ExistsTransformer(HostTransformer):
@@ -121,12 +114,15 @@ class ExistsTransformer(HostTransformer):
     def __init__(self, predicate: Callable[[Any], bool],
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
-        self.predicate = predicate
+        self.predicate = decode_fn(predicate)
 
     def transform(self, cols: Sequence[Column], ctx=None) -> Column:
         vals = _values_of(cols[0])
         out = [bool(v is not None and self.predicate(v)) for v in vals]
         return Column.from_values(T.Binary, out)
+
+    def get_params(self):
+        return {"predicate": encode_fn(self.predicate)}
 
 
 class ReplaceTransformer(HostTransformer):
@@ -157,7 +153,10 @@ class ToOccurTransformer(HostTransformer):
     def __init__(self, match_fn: Optional[Callable[[Any], bool]] = None,
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
-        self.match_fn = match_fn
+        self.match_fn = decode_fn(match_fn)
+
+    def get_params(self):
+        return {"match_fn": encode_fn(self.match_fn)}
 
     def transform(self, cols: Sequence[Column], ctx=None) -> Column:
         vals = _values_of(cols[0])

@@ -241,9 +241,10 @@ class FeatureGeneratorStage(Stage):
                  column: Optional[str] = None, is_response: bool = False,
                  null_fill: Any = None, uid: Optional[str] = None):
         super().__init__(uid=uid)
+        from transmogrifai_tpu.utils.fnser import decode_fn
         self.feature_name = name
         self.ftype = ftype
-        self.extract = extract
+        self.extract = decode_fn(extract)
         self.column = column if column is not None else (name if extract is None else None)
         self.is_response = is_response
         self.null_fill = null_fill  # vectorized null replacement (fast path)
@@ -294,8 +295,10 @@ class FeatureGeneratorStage(Stage):
         return Column.from_values(self.ftype, values)
 
     def get_params(self) -> Dict[str, Any]:
+        from transmogrifai_tpu.utils.fnser import encode_fn
         return {
             "name": self.feature_name, "ftype": self.ftype.__name__,
+            "extract": encode_fn(self.extract),
             "column": self.column, "is_response": self.is_response,
             "null_fill": self.null_fill,
         }

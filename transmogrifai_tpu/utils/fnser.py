@@ -1,0 +1,54 @@
+"""Function (de)serialization for stage params.
+
+The reference persists macro-captured extract-fn sources and named classes
+(FeatureGeneratorStageReaderWriter, FeatureBuilderMacros.scala:40-95);
+python's equivalent fidelity is cloudpickle: lambdas and closures
+round-trip byte-exactly. Named module-level functions are stored as
+`module:qualname` references (readable + stable across versions); anything
+else falls back to a cloudpickle payload.
+
+Loading a model therefore executes pickled code — the same trust model as
+every pickle-based ML model format; only load models you produced.
+"""
+
+from __future__ import annotations
+
+import base64
+import importlib
+from typing import Any, Callable, Optional
+
+_REF_KEY = "__pyref__"
+_PICKLE_KEY = "__pyfn__"
+
+
+def encode_fn(fn: Optional[Callable]) -> Any:
+    if fn is None:
+        return None
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", "")
+    if mod and qual and "<" not in qual and "." not in qual:
+        try:  # prefer a readable module:name reference when it resolves
+            if getattr(importlib.import_module(mod), qual, None) is fn:
+                return {_REF_KEY: f"{mod}:{qual}"}
+        except Exception:
+            pass
+    import cloudpickle
+    return {_PICKLE_KEY: base64.b64encode(cloudpickle.dumps(fn)).decode()}
+
+
+def decode_fn(obj: Any) -> Optional[Callable]:
+    if obj is None or callable(obj):
+        return obj
+    if isinstance(obj, dict):
+        if _REF_KEY in obj:
+            mod, qual = obj[_REF_KEY].split(":", 1)
+            target: Any = importlib.import_module(mod)
+            for part in qual.split("."):
+                target = getattr(target, part)
+            return target
+        if _PICKLE_KEY in obj:
+            import cloudpickle
+            return cloudpickle.loads(base64.b64decode(obj[_PICKLE_KEY]))
+    if isinstance(obj, str) and ":" in obj:  # legacy module:qualname string
+        return decode_fn({_REF_KEY: obj})
+    raise TypeError(f"Cannot decode function from {type(obj).__name__}")

@@ -1,0 +1,85 @@
+"""Per-phase run profiling (the OpSparkListener / JobGroupUtil analogue).
+
+Reference parity: `utils/.../spark/OpSparkListener.scala:62-141` (per-phase
+metrics, app duration, custom tags) and `OpStep.scala:35-45` (phase names).
+Here phases are wall-clock scopes; under jax the scope also opens a named
+TraceAnnotation so device traces line up with framework phases when the
+jax profiler is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# OpStep.scala phase names
+DATA_READING = "DataReadingAndFiltering"
+FEATURE_ENG = "FeatureEngineering"
+CV = "CrossValidation"
+TRAINING = "Training"
+SCORING = "Scoring"
+EVALUATION = "Evaluation"
+
+
+@dataclass
+class PhaseMetric:
+    name: str
+    duration_s: float
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "duration_s": round(self.duration_s, 4),
+                **self.extra}
+
+
+@dataclass
+class RunProfile:
+    """Collected per-phase timings for one runner invocation
+    (AppMetrics/StageMetrics analogue)."""
+
+    run_type: str = ""
+    custom_tag_name: Optional[str] = None
+    custom_tag_value: Optional[str] = None
+    phases: List[PhaseMetric] = field(default_factory=list)
+    started_at: float = field(default_factory=time.time)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, **extra):
+        """Time a named phase; nests with the jax profiler when tracing."""
+        try:
+            import jax.profiler
+            annotation = jax.profiler.TraceAnnotation(name)
+        except Exception:  # profiler unavailable: plain timing
+            annotation = contextlib.nullcontext()
+        t0 = time.time()
+        with annotation:
+            yield
+        self.phases.append(PhaseMetric(name, time.time() - t0, dict(extra)))
+
+    @property
+    def app_duration_s(self) -> float:
+        return time.time() - self.started_at
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "run_type": self.run_type,
+            "custom_tag": ({self.custom_tag_name: self.custom_tag_value}
+                           if self.custom_tag_name else None),
+            "app_duration_s": round(self.app_duration_s, 4),
+            "phases": [p.to_json() for p in self.phases],
+        }
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2)
+
+    def pretty(self) -> str:
+        lines = [f"Run {self.run_type} "
+                 f"({self.app_duration_s:.2f}s total):"]
+        for p in self.phases:
+            lines.append(f"  {p.name}: {p.duration_s:.2f}s "
+                         + (str(p.extra) if p.extra else ""))
+        return "\n".join(lines)

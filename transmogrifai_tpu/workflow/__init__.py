@@ -1,3 +1,6 @@
 from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
+from transmogrifai_tpu.workflow.params import OpParams, ReaderParams
+from transmogrifai_tpu.workflow.runner import RunResult, WorkflowRunner
 
-__all__ = ["Workflow", "WorkflowModel"]
+__all__ = ["Workflow", "WorkflowModel", "OpParams", "ReaderParams",
+           "RunResult", "WorkflowRunner"]

@@ -1,23 +1,31 @@
-"""CompiledScorer: the fitted DAG as ONE fused XLA program.
+"""CompiledScorer: the fitted DAG as fused XLA program segments.
 
 This is the TPU replacement for both the reference's fused row transform
 (`FitStagesUtil.applyOpTransformations`, FitStagesUtil.scala:96-119) and its
 Spark-free MLeap scoring path (`local/.../OpWorkflowModelLocal.scala:79-122`):
 
-- host phase (per batch): materialize raw columns, run HostTransformers
-  eagerly, call each jittable stage's `host_prepare` (string → ids etc.)
-- device phase: a single `jax.jit` function threads every stage's
-  `device_apply` — XLA fuses imputation, one-hot, concat, and the model
-  matmul into one program; with a mesh, the batch axis shards over devices.
+- host phase (per batch): materialize raw columns, call each jittable
+  stage's `host_prepare` (string → ids etc.)
+- device phase: consecutive jittable stages compile into ONE `jax.jit`
+  program — XLA fuses imputation, one-hot, concat, and the model matmul;
+  with a mesh, the batch axis shards over devices.
+
+Topologies where a HostTransformer consumes a device-produced feature
+(e.g. `(sibSp + parCh).alias(...)`) split the plan into alternating
+host/device SEGMENTS: each device segment is still one fused XLA program,
+and device outputs materialize to host columns only when a host stage
+actually reads them. A pipeline with no such crossing keeps the single
+fused program.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from transmogrifai_tpu import types as T
 from transmogrifai_tpu.data.columns import Column
 from transmogrifai_tpu.data.dataset import Dataset
 from transmogrifai_tpu.features.dag import topological_layers
@@ -27,84 +35,159 @@ from transmogrifai_tpu.stages.base import (
 _HOST_KINDS = ("text", "list", "map")
 
 
+def _column_from_device(ftype: type, dev) -> Column:
+    """Wrap a device pytree back into a host Column (segment boundary)."""
+    if isinstance(dev, dict) and "prediction" in dev:
+        return Column(T.Prediction,
+                      {k: np.asarray(v) for k, v in dev.items()})
+    if isinstance(dev, dict) and "value" in dev:
+        return Column(ftype, {
+            "value": np.asarray(dev["value"], dtype=np.float64),
+            "mask": np.asarray(dev["mask"]) > 0.5})
+    return Column(T.OPVector, np.asarray(dev))
+
+
 class CompiledScorer:
     def __init__(self, model, sharding: Optional[Any] = None):
         self.model = model
         self.sharding = sharding  # optional jax.sharding.NamedSharding for batch
         layers = topological_layers(model.result_features)
         self.generators: List[FeatureGeneratorStage] = list(layers[0]) if layers else []
-        self.host_stages: List[Transformer] = []
-        self.device_stages: List[Transformer] = []
+        ordered: List[Transformer] = []
         for layer in layers[1:]:
             for stage in layer:
                 fitted = model.fitted.get(stage.uid)
                 if fitted is None:
                     raise RuntimeError(f"Unfitted stage {stage.uid}")
-                if isinstance(fitted, HostTransformer):
-                    self.host_stages.append(fitted)
-                else:
-                    self.device_stages.append(fitted)
+                ordered.append(fitted)
         self._stage_out_uid = {
-            s.uid: s.get_output().uid
-            for s in self.host_stages + self.device_stages}
-        self._jitted = jax.jit(self._device_fn)
+            s.uid: s.get_output().uid for s in ordered}
+        # alternating host/device segments in topo order
+        self.segments: List[Tuple[str, List[Transformer]]] = []
+        for s in ordered:
+            kind = "host" if isinstance(s, HostTransformer) else "device"
+            if not self.segments or self.segments[-1][0] != kind:
+                self.segments.append((kind, []))
+            self.segments[-1][1].append(s)
+        self._seg_fns = [
+            (jax.jit(self._make_segment_fn(stages)) if kind == "device"
+             else None)
+            for kind, stages in self.segments]
+        self.device_stages: List[Transformer] = [
+            s for kind, stages in self.segments if kind == "device"
+            for s in stages]
 
     # ------------------------------------------------------------------ #
 
-    def _device_fn(self, encs: Dict[str, Any], raw_dev: Dict[str, Any]):
-        vals: Dict[str, Any] = dict(raw_dev)
-        for stage in self.device_stages:
-            dev_inputs = [vals.get(f.uid) for f in stage.input_features]
-            out = stage.device_apply(encs.get(stage.uid), dev_inputs)
-            vals[self._stage_out_uid[stage.uid]] = out
-        return {
-            f.uid: vals[f.uid]
-            for f in self.model.result_features if f.uid in vals
-        }
+    def _make_segment_fn(self, stages: List[Transformer]):
+        out_uid = self._stage_out_uid
+
+        def seg_fn(encs: Dict[str, Any], dev_vals: Dict[str, Any]):
+            vals = dict(dev_vals)
+            outs: Dict[str, Any] = {}
+            for stage in stages:
+                dev_inputs = [vals.get(f.uid) for f in stage.input_features]
+                out = stage.device_apply(encs.get(stage.uid), dev_inputs)
+                vals[out_uid[stage.uid]] = out
+                outs[out_uid[stage.uid]] = out
+            return outs
+
+        return seg_fn
+
+    def _fused_index(self) -> int:
+        """Index of the single trailing device segment, or raise."""
+        dev_segs = [i for i, (k, _) in enumerate(self.segments)
+                    if k == "device"]
+        if len(dev_segs) != 1 or dev_segs[0] != len(self.segments) - 1:
+            raise RuntimeError(
+                "pipeline does not compile to a single trailing device "
+                "segment; use __call__")
+        return dev_segs[0]
+
+    # the driver's single-chip compile check (__graft_entry__) jits this
+    @property
+    def _device_fn(self):
+        return self._make_segment_fn(self.segments[self._fused_index()][1])
+
+    def fused_jitted(self):
+        """The ALREADY-jitted trailing device segment (streaming path —
+        shares the compile cache with __call__)."""
+        return self._seg_fns[self._fused_index()]
 
     def host_phase(self, dataset: Dataset):
-        """Per-batch host work: materialize raw columns, run host stages,
-        call each device stage's host_prepare. Returns (encs, raw_dev,
-        columns) — the jitted device program's inputs."""
+        """Raw materialization + host-prefix stages + host_prepare for the
+        single-trailing-device-segment fast path (driver entry + streaming
+        overlap; __call__ handles the general segmented case)."""
         columns: Dict[str, Column] = {}
         for gen in self.generators:
             columns[gen.get_output().uid] = gen.materialize(
                 dataset, allow_missing_response=True)
-        for stage in self.host_stages:
-            inputs = []
-            for f in stage.input_features:
-                c = columns.get(f.uid)
-                if c is None:
-                    raise RuntimeError(
-                        f"Host stage {stage.operation_name} needs device-"
-                        f"produced input {f.name}; unsupported topology")
-                inputs.append(c)
-            columns[self._stage_out_uid[stage.uid]] = stage.transform(inputs)
-
+        for kind, stages in self.segments[:-1]:  # host prefix
+            if kind != "host":
+                raise RuntimeError("host_phase requires a host-prefix plan")
+            for stage in stages:
+                inputs = [columns[f.uid] for f in stage.input_features]
+                columns[self._stage_out_uid[stage.uid]] = \
+                    stage.transform(inputs)
         encs: Dict[str, Any] = {}
         for stage in self.device_stages:
             cols = [columns.get(f.uid) for f in stage.input_features]
             enc = stage.host_prepare(cols)
             if enc is not None:
                 encs[stage.uid] = enc
-
         raw_dev: Dict[str, Any] = {}
-        for gen in self.generators:
-            f = gen.get_output()
-            c = columns[f.uid]
+        for uid, c in columns.items():
             if c.kind not in _HOST_KINDS:
-                raw_dev[f.uid] = c.device_value()
+                dv = c.device_value()
+                if dv is not None:
+                    raw_dev[uid] = dv
         return encs, raw_dev, columns
 
-    def __call__(self, dataset: Dataset) -> Dict[str, Any]:
-        encs, raw_dev, columns = self.host_phase(dataset)
-        # -- device phase (one XLA program) ----------------------------- #
-        out = self._jitted(encs, raw_dev)
+    # ------------------------------------------------------------------ #
 
+    def run(self, dataset: Dataset):
+        """Execute all segments; returns (dev_vals, columns)."""
+        columns: Dict[str, Column] = {}
+        dev_vals: Dict[str, Any] = {}
+        for gen in self.generators:
+            f = gen.get_output()
+            c = gen.materialize(dataset, allow_missing_response=True)
+            columns[f.uid] = c
+            if c.kind not in _HOST_KINDS:
+                dev_vals[f.uid] = c.device_value()
+
+        for (kind, stages), jfn in zip(self.segments, self._seg_fns):
+            if kind == "host":
+                for stage in stages:
+                    inputs = []
+                    for f in stage.input_features:
+                        c = columns.get(f.uid)
+                        if c is None:  # device-produced → materialize once
+                            c = _column_from_device(f.ftype, dev_vals[f.uid])
+                            columns[f.uid] = c
+                        inputs.append(c)
+                    out_col = stage.transform(inputs)
+                    uid = self._stage_out_uid[stage.uid]
+                    columns[uid] = out_col
+                    dv = out_col.device_value()
+                    if dv is not None:
+                        dev_vals[uid] = dv
+            else:
+                encs: Dict[str, Any] = {}
+                for stage in stages:
+                    cols = [columns.get(f.uid) for f in stage.input_features]
+                    enc = stage.host_prepare(cols)
+                    if enc is not None:
+                        encs[stage.uid] = enc
+                dev_vals.update(jfn(encs, dev_vals))
+        return dev_vals, columns
+
+    def __call__(self, dataset: Dataset) -> Dict[str, Any]:
+        dev_vals, columns = self.run(dataset)
         result: Dict[str, Any] = {}
         for f in self.model.result_features:
-            if f.uid in out:
-                result[f.name] = out[f.uid]
+            if f.uid in dev_vals:
+                result[f.name] = dev_vals[f.uid]
             else:  # host-kind result feature
                 result[f.name] = columns[f.uid].data
         return result

@@ -1,0 +1,116 @@
+"""OpParams: JSON-loadable run configuration.
+
+Reference parity: `features/src/main/scala/com/salesforce/op/OpParams.scala:81-97`
+(stageParams, readerParams, model/write/metrics locations, streaming batch
+duration, custom tags, metric flags, customParams; JSON load at :300-308).
+Applied to stages reflectively at `Workflow.set_parameters`
+(OpWorkflow.scala:179-201 analogue — here: matched by stage class name or
+uid, set via params dict + attribute).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ReaderParams:
+    """Per-reader runtime params (ReaderParams analogue): data path +
+    format + anything reader-specific."""
+
+    path: Optional[str] = None
+    format: str = "csv"          # csv | parquet | stream
+    key_column: Optional[str] = None
+    batch_size: int = 1024
+    custom: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ReaderParams":
+        known = {k: d[k] for k in ("path", "format", "key_column",
+                                   "batch_size") if k in d}
+        custom = {k: v for k, v in d.items()
+                  if k not in ("path", "format", "key_column", "batch_size")}
+        return ReaderParams(custom=custom, **known)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"path": self.path, "format": self.format,
+                "key_column": self.key_column, "batch_size": self.batch_size,
+                **self.custom}
+
+
+@dataclass
+class OpParams:
+    """Runtime workflow configuration (OpParams.scala:81-97)."""
+
+    stage_params: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    reader_params: Dict[str, ReaderParams] = field(default_factory=dict)
+    model_location: Optional[str] = None
+    write_location: Optional[str] = None
+    metrics_location: Optional[str] = None
+    batch_duration_secs: Optional[int] = None
+    custom_tag_name: Optional[str] = None
+    custom_tag_value: Optional[str] = None
+    log_stage_metrics: bool = False
+    collect_stage_metrics: bool = True
+    custom_params: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "OpParams":
+        readers = {k: ReaderParams.from_json(v)
+                   for k, v in (d.get("reader_params") or {}).items()}
+        return OpParams(
+            stage_params=dict(d.get("stage_params") or {}),
+            reader_params=readers,
+            model_location=d.get("model_location"),
+            write_location=d.get("write_location"),
+            metrics_location=d.get("metrics_location"),
+            batch_duration_secs=d.get("batch_duration_secs"),
+            custom_tag_name=d.get("custom_tag_name"),
+            custom_tag_value=d.get("custom_tag_value"),
+            log_stage_metrics=bool(d.get("log_stage_metrics", False)),
+            collect_stage_metrics=bool(d.get("collect_stage_metrics", True)),
+            custom_params=dict(d.get("custom_params") or {}))
+
+    @staticmethod
+    def load(path: str) -> "OpParams":
+        with open(path) as f:
+            return OpParams.from_json(json.load(f))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "stage_params": self.stage_params,
+            "reader_params": {k: v.to_json()
+                              for k, v in self.reader_params.items()},
+            "model_location": self.model_location,
+            "write_location": self.write_location,
+            "metrics_location": self.metrics_location,
+            "batch_duration_secs": self.batch_duration_secs,
+            "custom_tag_name": self.custom_tag_name,
+            "custom_tag_value": self.custom_tag_value,
+            "log_stage_metrics": self.log_stage_metrics,
+            "collect_stage_metrics": self.collect_stage_metrics,
+            "custom_params": self.custom_params,
+        }
+
+
+def apply_stage_params(stages, stage_params: Dict[str, Dict[str, Any]],
+                       log=None) -> int:
+    """Set per-stage param overrides, matched by stage class name, operation
+    name, or uid (OpWorkflow.setParameters → ReflectionUtils setter path).
+    Returns the number of stages touched."""
+    touched = 0
+    for stage in stages:
+        for key in (type(stage).__name__, stage.operation_name, stage.uid):
+            overrides = stage_params.get(key)
+            if overrides:
+                for name, value in overrides.items():
+                    stage.params[name] = value
+                    if hasattr(stage, name):
+                        setattr(stage, name, value)
+                touched += 1
+                if log is not None:
+                    log.info("Applied %s overrides to %s", key, stage.uid)
+                break
+    return touched

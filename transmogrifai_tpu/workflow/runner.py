@@ -1,0 +1,332 @@
+"""WorkflowRunner: train / score / streaming-score / features / evaluate.
+
+Reference parity: `core/.../OpWorkflowRunner.scala:296-440` (run-type
+dispatch driven by OpParams, streaming loop :233-262, result types) and
+`OpApp.scala:49,191` (the application shell the CLI invokes).
+
+TPU-first: scoring writes parquet (columnar) instead of Avro; the
+streaming loop drives `WorkflowModel.score_stream` so host encode of the
+next micro-batch overlaps device compute; per-phase timings are collected
+by `RunProfile` (the OpSparkListener analogue) and written beside the
+metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.utils import profiling
+from transmogrifai_tpu.utils.profiling import RunProfile
+from transmogrifai_tpu.workflow.params import OpParams, ReaderParams
+from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
+
+log = logging.getLogger(__name__)
+
+RUN_TYPES = ("train", "score", "streaming-score", "features", "evaluate")
+
+
+@dataclass
+class RunResult:
+    """OpWorkflowRunnerResult analogue."""
+
+    run_type: str
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    model_location: Optional[str] = None
+    write_location: Optional[str] = None
+    profile: Optional[Dict[str, Any]] = None
+    batches: int = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"run_type": self.run_type, "metrics": self.metrics,
+                "model_location": self.model_location,
+                "write_location": self.write_location,
+                "profile": self.profile, "batches": self.batches}
+
+
+def _reader_from_params(rp: ReaderParams):
+    from transmogrifai_tpu.readers import DataReaders
+    if rp.format == "csv":
+        return DataReaders.csv(rp.path, key_column=rp.key_column)
+    if rp.format == "parquet":
+        return DataReaders.parquet(rp.path, key_column=rp.key_column)
+    if rp.format == "stream":
+        if rp.path and rp.path.endswith(".parquet"):
+            return DataReaders.stream(parquet_path=rp.path,
+                                      batch_size=rp.batch_size)
+        return DataReaders.stream(csv_path=rp.path, batch_size=rp.batch_size)
+    raise ValueError(f"Unknown reader format {rp.format!r}")
+
+
+class WorkflowRunner:
+    """Dispatch a workflow run (OpWorkflowRunner.scala:70-131 ctor shape:
+    workflow + train/score/evaluation readers + evaluator + the features
+    needed to wire scoring outputs)."""
+
+    def __init__(self, workflow: Workflow, train_reader=None,
+                 score_reader=None, evaluation_reader=None, evaluator=None,
+                 label_feature=None, prediction_feature=None):
+        self.workflow = workflow
+        self.train_reader = train_reader
+        self.score_reader = score_reader
+        self.evaluation_reader = evaluation_reader
+        self.evaluator = evaluator
+        self.label_feature = label_feature
+        self.prediction_feature = prediction_feature
+        self._end_handlers: List = []
+
+    def add_application_end_handler(self, fn) -> "WorkflowRunner":
+        """Callback receiving the RunProfile when a run finishes
+        (OpWorkflowRunner.addApplicationEndHandler)."""
+        self._end_handlers.append(fn)
+        return self
+
+    # ------------------------------------------------------------------ #
+
+    def run(self, run_type: str, params: OpParams) -> RunResult:
+        if run_type not in RUN_TYPES:
+            raise ValueError(
+                f"run_type must be one of {RUN_TYPES}, got {run_type!r}")
+        log.info("Assuming OP params: %s", json.dumps(params.to_json()))
+        profile = RunProfile(run_type=run_type,
+                             custom_tag_name=params.custom_tag_name,
+                             custom_tag_value=params.custom_tag_value)
+        self.workflow.set_parameters(params)
+        dispatch = {
+            "train": self._train, "score": self._score,
+            "streaming-score": self._streaming_score,
+            "features": self._features, "evaluate": self._evaluate,
+        }
+        result = dispatch[run_type](params, profile)
+        result.profile = profile.to_json()
+        if params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            with open(os.path.join(params.metrics_location,
+                                   f"{run_type}-metrics.json"), "w") as f:
+                json.dump(result.to_json(), f, indent=2, default=str)
+        if params.log_stage_metrics:
+            log.info("%s", profile.pretty())
+        for fn in self._end_handlers:
+            fn(profile)
+        return result
+
+    # ------------------------------------------------------------------ #
+
+    def _resolve_reader(self, default, params: OpParams, name: str,
+                        model: Optional[WorkflowModel] = None):
+        rp = params.reader_params.get(name)
+        if rp is not None and rp.path:
+            reader = _reader_from_params(rp)
+        elif default is None:
+            raise ValueError(
+                f"Run requires a {name!r} reader: construct the runner with "
+                f"one or put reader_params[{name!r}].path in the params")
+        else:
+            reader = default
+        if model is not None:
+            _ensure_schema(reader, model)
+        return reader
+
+    def _train(self, params: OpParams, profile: RunProfile) -> RunResult:
+        reader = self._resolve_reader(self.train_reader, params, "train")
+        with profile.phase(profiling.DATA_READING):
+            ds = reader.read(self.workflow._raw_features())
+        with profile.phase(profiling.TRAINING, n_rows=len(ds)):
+            model = self.workflow.set_input_dataset(ds).train()
+        metrics: Dict[str, Any] = {}
+        if self.prediction_feature is not None:
+            fitted = model.fitted.get(self.prediction_feature.origin_stage.uid)
+            summary = getattr(fitted, "summary", None)
+            if summary is not None:
+                metrics = {"train": summary.train_metrics,
+                           "holdout": summary.holdout_metrics,
+                           "best_model": summary.best_model,
+                           "best_grid": summary.best_grid}
+        loc = params.model_location
+        if loc:
+            model.save(loc)
+        return RunResult("train", metrics=metrics, model_location=loc)
+
+    def _load_model(self, params: OpParams) -> WorkflowModel:
+        if not params.model_location:
+            raise ValueError("model_location required")
+        return WorkflowModel.load(params.model_location)
+
+    def _score(self, params: OpParams, profile: RunProfile) -> RunResult:
+        model = self._load_model(params)
+        reader = self._resolve_reader(self.score_reader, params, "score",
+                                      model=model)
+        with profile.phase(profiling.DATA_READING):
+            ds = reader.read([f for f in model.result_features])
+        with profile.phase(profiling.SCORING, n_rows=len(ds)):
+            scores = model.score_compiled(ds)
+        loc = params.write_location
+        if loc:
+            os.makedirs(loc, exist_ok=True)
+            _write_scores(scores, model, os.path.join(loc, "scores.parquet"))
+        metrics: Dict[str, Any] = {"n_rows": len(ds)}
+        if self.evaluator is not None and self.label_feature is not None \
+                and self.prediction_feature is not None:
+            with profile.phase(profiling.EVALUATION):
+                metrics["evaluation"] = self._eval_scores(model, ds, scores)
+        return RunResult("score", metrics=metrics, write_location=loc)
+
+    def _streaming_score(self, params: OpParams,
+                         profile: RunProfile) -> RunResult:
+        model = self._load_model(params)
+        reader = self._resolve_reader(self.score_reader, params, "score",
+                                      model=model)
+        if not hasattr(reader, "stream"):
+            raise ValueError("streaming-score requires a StreamingReader")
+        loc = params.write_location
+        if loc:
+            os.makedirs(loc, exist_ok=True)
+        n_batches = 0
+        n_rows = 0
+        with profile.phase(profiling.SCORING):
+            for out in model.score_stream(reader.stream()):
+                if loc:
+                    _write_scores(out, model, os.path.join(
+                        loc, f"scores-{n_batches:05d}.parquet"))
+                first = next(iter(out.values()))
+                n_rows += _batch_len(first)
+                n_batches += 1
+        return RunResult("streaming-score",
+                         metrics={"n_rows": n_rows, "batches": n_batches},
+                         write_location=loc, batches=n_batches)
+
+    def _features(self, params: OpParams, profile: RunProfile) -> RunResult:
+        """Materialize + write the transformed feature columns
+        (computeFeatures run type)."""
+        model = self._load_model(params)
+        reader = self._resolve_reader(self.score_reader, params, "score",
+                                      model=model)
+        with profile.phase(profiling.DATA_READING):
+            ds = reader.read([f for f in model.result_features])
+        with profile.phase(profiling.FEATURE_ENG, n_rows=len(ds)):
+            columns = model.score(ds, keep_intermediate=True)
+        loc = params.write_location
+        if loc:
+            os.makedirs(loc, exist_ok=True)
+            arrays: Dict[str, np.ndarray] = {}
+            for f in model.result_features:
+                col = columns[f.uid]
+                if col.kind == "vector":
+                    arr = np.asarray(col.data)
+                    for j in range(arr.shape[1]):
+                        arrays[f"{f.name}_{j}"] = arr[:, j].astype(np.float64)
+            Dataset(arrays, {k: __import__(
+                "transmogrifai_tpu.types", fromlist=["Real"]).Real
+                for k in arrays}).to_parquet(
+                os.path.join(loc, "features.parquet"))
+        return RunResult("features", metrics={"n_rows": len(ds)},
+                         write_location=loc)
+
+    def _evaluate(self, params: OpParams, profile: RunProfile) -> RunResult:
+        if self.evaluator is None or self.label_feature is None or \
+                self.prediction_feature is None:
+            raise ValueError(
+                "evaluate requires evaluator + label_feature + "
+                "prediction_feature on the runner")
+        model = self._load_model(params)
+        reader = self._resolve_reader(
+            self.evaluation_reader or self.score_reader, params,
+            "evaluation", model=model)
+        with profile.phase(profiling.DATA_READING):
+            ds = reader.read([f for f in model.result_features])
+        with profile.phase(profiling.EVALUATION, n_rows=len(ds)):
+            scores = model.score_compiled(ds)
+            metrics = self._eval_scores(model, ds, scores)
+        loc = params.write_location
+        if loc:
+            os.makedirs(loc, exist_ok=True)
+            _write_scores(scores, model, os.path.join(loc, "scores.parquet"))
+        return RunResult("evaluate", metrics=metrics, write_location=loc)
+
+    # ------------------------------------------------------------------ #
+
+    def _eval_scores(self, model: WorkflowModel, ds: Dataset,
+                     scores: Dict[str, Any]) -> Dict[str, Any]:
+        from transmogrifai_tpu import types as T
+        from transmogrifai_tpu.data.columns import Column
+        label_col = self.label_feature.origin_stage.materialize(ds)
+        # look the prediction up on the LOADED model's graph: derived
+        # feature names embed process-local uid counters, so the rebuilt
+        # app graph's name need not match the saved one
+        pred_name = next(
+            (f.name for f in model.result_features
+             if issubclass(f.ftype, T.Prediction)),
+            self.prediction_feature.name)
+        pred = scores[pred_name]
+        pcol = Column(T.Prediction,
+                      {k: np.asarray(v) for k, v in pred.items()})
+        m = self.evaluator.evaluate(label_col, pcol).to_json()
+        return {k: v for k, v in m.items() if not isinstance(v, list)}
+
+
+def _ensure_schema(reader, model: WorkflowModel) -> None:
+    """Schema-less file readers infer types that can clash with the model's
+    raw feature types (e.g. integer-looking PickLists); inject the model's
+    own raw schema (the reference derives reader schema from the features,
+    DataReader.scala:221-259)."""
+    schema = {}
+    for rf in model.result_features:
+        for f in rf.raw_features():
+            schema[f.name] = f.ftype
+    for attr in ("_schema", "schema"):
+        if hasattr(reader, attr) and getattr(reader, attr) is None:
+            setattr(reader, attr, schema)
+            break
+
+
+def _batch_len(v) -> int:
+    if isinstance(v, dict):
+        return int(np.asarray(next(iter(v.values()))).shape[0])
+    return int(np.asarray(v).shape[0])
+
+
+def _write_scores(scores: Dict[str, Any], model: WorkflowModel,
+                  path: str) -> None:
+    """Flatten result features into a columnar parquet file
+    (saveScores analogue; parquet instead of Avro)."""
+    import transmogrifai_tpu.types as T
+    arrays: Dict[str, np.ndarray] = {}
+    schema: Dict[str, type] = {}
+    for name, v in scores.items():
+        if isinstance(v, dict) and "prediction" in v:
+            arrays[f"{name}_prediction"] = np.asarray(
+                v["prediction"], dtype=np.float64)
+            schema[f"{name}_prediction"] = T.Real
+            prob = np.asarray(v["probability"])
+            if prob.ndim == 2:
+                for j in range(prob.shape[1]):
+                    arrays[f"{name}_probability_{j}"] = prob[:, j].astype(
+                        np.float64)
+                    schema[f"{name}_probability_{j}"] = T.Real
+        elif isinstance(v, dict) and "value" in v:
+            val = np.asarray(v["value"], dtype=np.float64).copy()
+            mask = np.asarray(v["mask"]).astype(bool)
+            val[~mask] = np.nan
+            arrays[name] = val
+            schema[name] = T.Real
+        else:
+            arr = np.asarray(v)
+            if arr.dtype == object:
+                arrays[name] = np.array(
+                    [None if x is None else str(x) for x in arr],
+                    dtype=object)
+                schema[name] = T.Text
+            elif arr.ndim == 1:
+                arrays[name] = arr.astype(np.float64)
+                schema[name] = T.Real
+            else:
+                for j in range(arr.shape[1]):
+                    arrays[f"{name}_{j}"] = arr[:, j].astype(np.float64)
+                    schema[f"{name}_{j}"] = T.Real
+    Dataset(arrays, schema).to_parquet(path)

@@ -64,8 +64,6 @@ def save_model(model, path: str, overwrite: bool = True) -> None:
             "params": fitted.get_params(),
             "inputs": [p.uid for p in stage.input_features],
         }
-        if isinstance(stage, FeatureGeneratorStage) and stage.extract is not None:
-            entry["warning"] = "extract-fn feature: not reloadable"
         stage_entries.append(entry)
 
     manifest = {

@@ -51,8 +51,15 @@ class Workflow:
         self._reader = reader
         return self
 
-    def set_parameters(self, params: Dict[str, Any]) -> "Workflow":
-        self.parameters = dict(params)
+    def set_parameters(self, params) -> "Workflow":
+        """Accepts an OpParams or a plain dict; stage_params overrides are
+        applied to the DAG's stages at train time
+        (OpWorkflow.setParameters, OpWorkflow.scala:179-201)."""
+        from transmogrifai_tpu.workflow.params import OpParams
+        if isinstance(params, OpParams):
+            self.parameters = params.to_json()
+        else:
+            self.parameters = dict(params)
         return self
 
     def with_workflow_cv(self) -> "Workflow":
@@ -115,6 +122,13 @@ class Workflow:
         # user's graph or previously returned models (see dag.clone_graph)
         result_features = clone_graph(source_features)
         layers = topological_layers(result_features)
+        stage_params = self.parameters.get("stage_params") or {}
+        if stage_params:
+            from transmogrifai_tpu.workflow.params import apply_stage_params
+            import logging
+            apply_stage_params(
+                [s for layer in layers[1:] for s in layer], stage_params,
+                log=logging.getLogger(__name__))
         ctx = FitContext(n_rows=len(ds), seed=seed, mesh=mesh)
         columns: Dict[str, Column] = {}
         fitted: Dict[str, Transformer] = {}
@@ -298,10 +312,18 @@ class WorkflowModel:
         if self._compiled is None:
             self._compiled = CompiledScorer(self)
         scorer = self._compiled
+        try:
+            device_fn = scorer.fused_jitted()  # shared compile cache
+        except RuntimeError:
+            # multi-segment plan (host stage consumes device output):
+            # sequential per-batch scoring, no host/device overlap
+            for ds in batches:
+                yield scorer(ds)
+            return
 
         def finish(host_out):
             encs, raw_dev, columns = host_out
-            out = scorer._jitted(encs, raw_dev)
+            out = device_fn(encs, raw_dev)
             result: Dict[str, Any] = {}
             for f in self.result_features:
                 result[f.name] = (out[f.uid] if f.uid in out
