@@ -1,0 +1,322 @@
+"""Contract specs auto-applied to the stage inventory (VERDICT r1 #9).
+
+Every op in ops/ and every model family runs the reusable
+transformer/estimator battery (testkit/contract.py): batch vs row-subset
+consistency, empty input, save/load round-trip through the registry +
+npz packing, and metadata width checks — the OpTransformerSpec /
+OpEstimatorSpec parity harness.
+"""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.stages.base import FitContext
+from transmogrifai_tpu.testkit import (
+    check_estimator_contract, check_transformer_contract)
+
+N = 24
+RNG = np.random.default_rng(0)
+
+
+def _real(seed=1, with_nulls=True):
+    r = np.random.default_rng(seed)
+    v = r.normal(size=N)
+    if with_nulls:
+        v = v.copy()
+        v[::6] = np.nan
+    return lambda: Column.from_values(T.Real, v.copy())
+
+
+def _realnn(seed=2):
+    r = np.random.default_rng(seed)
+    v = r.normal(size=N)
+    return lambda: Column.from_values(T.RealNN, v.copy())
+
+
+def _label(k=2, seed=3):
+    r = np.random.default_rng(seed)
+    v = r.integers(k, size=N).astype(np.float64)
+    return lambda: Column.from_values(T.RealNN, v.copy())
+
+
+def _integral(seed=4):
+    r = np.random.default_rng(seed)
+    return lambda: Column.from_values(
+        T.Integral, r.integers(0, 5, size=N).astype(np.float64))
+
+
+def _binary(seed=5):
+    r = np.random.default_rng(seed)
+    vals = [bool(x) if i % 7 else None
+            for i, x in enumerate(r.uniform(size=N) > 0.5)]
+    return lambda: Column.from_values(T.Binary, list(vals))
+
+
+def _text(seed=6):
+    r = np.random.default_rng(seed)
+    words = ["red shoe", "blue hat", "green sock", None, "red hat"]
+    vals = [words[i] for i in r.integers(len(words), size=N)]
+    return lambda: Column.from_values(T.Text, list(vals))
+
+
+def _picklist(seed=7):
+    r = np.random.default_rng(seed)
+    lv = ["a", "b", "c", None]
+    vals = [lv[i] for i in r.integers(len(lv), size=N)]
+    return lambda: Column.from_values(T.PickList, list(vals))
+
+
+def _textlist(seed=8):
+    r = np.random.default_rng(seed)
+    vals = [["tok%d" % x for x in r.integers(6, size=3)] if i % 5 else None
+            for i in range(N)]
+    return lambda: Column.from_values(T.TextList, list(vals))
+
+
+def _date(seed=9):
+    r = np.random.default_rng(seed)
+    base = 1_600_000_000_000
+    return lambda: Column.from_values(
+        T.DateTime, (base + r.integers(0, 10**9, size=N)).astype(np.float64))
+
+
+def _geo(seed=10):
+    r = np.random.default_rng(seed)
+    vals = [[float(r.uniform(-60, 60)), float(r.uniform(-120, 120)), 1.0]
+            if i % 6 else None for i in range(N)]
+    return lambda: Column.from_values(T.Geolocation, list(vals))
+
+
+def _vector(d=5, seed=11):
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(N, d)).astype(np.float32)
+    return lambda: Column(T.OPVector, X.copy())
+
+
+def _counts_vector(d=6, seed=12):
+    r = np.random.default_rng(seed)
+    X = r.poisson(2.0, size=(N, d)).astype(np.float32)
+    return lambda: Column(T.OPVector, X.copy())
+
+
+def _real_map(seed=13):
+    r = np.random.default_rng(seed)
+    vals = [{"k1": float(r.normal()), "k2": float(r.normal())}
+            if i % 5 else None for i in range(N)]
+    return lambda: Column.from_values(T.RealMap, list(vals))
+
+
+def _text_map(seed=14):
+    r = np.random.default_rng(seed)
+    lv = ["x", "y", "z"]
+    vals = [{"c": lv[int(r.integers(3))], "d": lv[int(r.integers(3))]}
+            if i % 5 else None for i in range(N)]
+    return lambda: Column.from_values(T.TextMap, list(vals))
+
+
+def _email(seed=15):
+    r = np.random.default_rng(seed)
+    vals = [f"u{int(r.integers(5))}@d{int(r.integers(3))}.com"
+            if i % 4 else None for i in range(N)]
+    return lambda: Column.from_values(T.Email, list(vals))
+
+
+def _phone(seed=16):
+    vals = ["4155552671" if i % 3 else "12" for i in range(N)]
+    return lambda: Column.from_values(T.Phone, list(vals))
+
+
+def _meta_vector(d=3, seed=17):
+    from transmogrifai_tpu.data.metadata import (
+        NULL_INDICATOR, VectorColumnMetadata, VectorMetadata)
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(N, d)).astype(np.float32)
+    meta = VectorMetadata("v", tuple(
+        [VectorColumnMetadata("p", "Real") for _ in range(d - 1)] +
+        [VectorColumnMetadata("p", "Real",
+                              indicator_value=NULL_INDICATOR)])).with_indices()
+    return lambda: Column(T.OPVector, X.copy(), meta=meta)
+
+
+def _mk(factory, *col_makers, **kw):
+    return (factory, lambda: [m() for m in col_makers], kw)
+
+
+def _transformer_inventory():
+    import transmogrifai_tpu.ops as O
+    from transmogrifai_tpu.data.metadata import NULL_INDICATOR
+    return {
+        "TextTokenizer": _mk(lambda: O.TextTokenizer(), _text()),
+        "HashingVectorizer": _mk(
+            lambda: O.HashingVectorizer(num_features=16), _text()),
+        "AliasTransformer": _mk(
+            lambda: O.AliasTransformer(name="x"), _real()),
+        "LambdaMap": _mk(
+            lambda: O.LambdaMap(fn=lambda v: None if v is None else v * 2,
+                                out_type=T.Real), _real()),
+        "FilterTransformer": _mk(
+            lambda: O.FilterTransformer(predicate=lambda v: v > 0), _real()),
+        "ExistsTransformer": _mk(
+            lambda: O.ExistsTransformer(predicate=lambda v: v > 0), _real()),
+        "ReplaceTransformer": _mk(
+            lambda: O.ReplaceTransformer(old="a", new="z"), _picklist()),
+        "ToOccurTransformer": _mk(lambda: O.ToOccurTransformer(), _text()),
+        "SubstringTransformer": _mk(
+            lambda: O.SubstringTransformer(), _text(), _text(seed=61)),
+        "TextLenTransformer": _mk(lambda: O.TextLenTransformer(), _text()),
+        "JaccardSimilarity": _mk(
+            lambda: O.JaccardSimilarity(),
+            lambda: Column.from_values(
+                T.MultiPickList, [{"a", "b"} if i % 2 else None
+                                  for i in range(N)]),
+            lambda: Column.from_values(
+                T.MultiPickList, [{"b", "c"} for _ in range(N)])),
+        "NGramSimilarity": _mk(
+            lambda: O.NGramSimilarity(), _text(), _text(seed=62)),
+        "TimePeriodTransformer": _mk(
+            lambda: O.TimePeriodTransformer(period="DayOfWeek"), _date()),
+        "ValidEmailTransformer": _mk(
+            lambda: O.ValidEmailTransformer(), _email()),
+        "EmailDomainTransformer": _mk(
+            lambda: O.EmailDomainTransformer(), _email()),
+        "EmailToPickListMapTransformer": _mk(
+            lambda: O.EmailToPickListMapTransformer(), _email()),
+        "UrlIsValidTransformer": _mk(
+            lambda: O.UrlIsValidTransformer(),
+            lambda: Column.from_values(
+                T.URL, [f"https://d{i % 3}.com/x" if i % 4 else None
+                        for i in range(N)])),
+        "PhoneIsValidTransformer": _mk(
+            lambda: O.PhoneIsValidTransformer(), _phone()),
+        "PhoneVectorizer": _mk(lambda: O.PhoneVectorizer(), _phone()),
+        "MimeTypeDetector": _mk(
+            lambda: O.MimeTypeDetector(),
+            lambda: Column.from_values(
+                T.Base64, ["JVBERi0xLjc=" if i % 2 else None
+                           for i in range(N)])),
+        "LangDetector": _mk(lambda: O.LangDetector(), _text()),
+        "HumanNameDetector": _mk(
+            lambda: O.HumanNameDetector(),
+            lambda: Column.from_values(
+                T.Text, ["Mary Jones" if i % 2 else "report q3"
+                         for i in range(N)])),
+        "NameEntityRecognizer": _mk(
+            lambda: O.NameEntityRecognizer(),
+            lambda: Column.from_values(
+                T.Text, ["Talk to James Smith" if i % 2 else None
+                         for i in range(N)])),
+        "OpStopWordsRemover": _mk(
+            lambda: O.OpStopWordsRemover(), _textlist()),
+        "OpNGram": _mk(lambda: O.OpNGram(n=2), _textlist()),
+        "VectorsCombiner": _mk(
+            lambda: O.VectorsCombiner(), _vector(), _vector(d=3, seed=63)),
+        "DropIndicesByTransformer": _mk(
+            lambda: O.DropIndicesByTransformer(
+                predicate=lambda c: c.indicator_value == NULL_INDICATOR),
+            _meta_vector(), check_serialization=False),  # needs graph metadata
+        "RealNNVectorizer": _mk(lambda: O.RealNNVectorizer(), _realnn()),
+        "DateToUnitCircleVectorizer": _mk(
+            lambda: O.DateToUnitCircleVectorizer(periods=["HourOfDay"]),
+            _date()),
+        "NumericBucketizer": _mk(
+            lambda: O.NumericBucketizer(splits=[-1.0, 0.0, 1.0]), _real()),
+        "ScalerTransformer": _mk(
+            lambda: O.ScalerTransformer(scaling_type="linear", slope=2.0,
+                                        intercept=1.0), _realnn()),
+        "BinaryMathTransformer": _mk(
+            lambda: O.BinaryMathTransformer(op="plus"),
+            _real(), _real(seed=64)),
+        "ScalarMathTransformer": _mk(
+            lambda: O.ScalarMathTransformer(op="multiply", scalar=3.0),
+            _real()),
+        "UnaryMathTransformer": _mk(
+            lambda: O.UnaryMathTransformer(op="abs"), _real()),
+    }
+
+
+def _estimator_inventory():
+    import transmogrifai_tpu.models as M
+    import transmogrifai_tpu.ops as O
+    from transmogrifai_tpu.automl.sanity_checker import (
+        MinVarianceFilter, SanityChecker)
+    return {
+        "RealVectorizer": _mk(
+            lambda: O.RealVectorizer(), _real(), _real(seed=71)),
+        "IntegralVectorizer": _mk(lambda: O.IntegralVectorizer(), _integral()),
+        "BinaryVectorizer": _mk(lambda: O.BinaryVectorizer(), _binary()),
+        "OneHotVectorizer": _mk(
+            lambda: O.OneHotVectorizer(top_k=3, min_support=1), _picklist()),
+        "MultiPickListVectorizer": _mk(
+            lambda: O.MultiPickListVectorizer(top_k=3, min_support=1),
+            lambda: Column.from_values(
+                T.MultiPickList,
+                [{"a", "b"} if i % 3 else None for i in range(N)])),
+        "SmartTextVectorizer": _mk(
+            lambda: O.SmartTextVectorizer(max_cardinality=3, min_support=1,
+                                          num_features=8), _text()),
+        "GeolocationVectorizer": _mk(
+            lambda: O.GeolocationVectorizer(), _geo()),
+        "OpScalarStandardScaler": _mk(
+            lambda: O.OpScalarStandardScaler(), _realnn()),
+        "FillMissingWithMean": _mk(lambda: O.FillMissingWithMean(), _real()),
+        "PercentileCalibrator": _mk(
+            lambda: O.PercentileCalibrator(buckets=10), _realnn()),
+        "DecisionTreeNumericBucketizer": _mk(
+            lambda: O.DecisionTreeNumericBucketizer(max_depth=2),
+            _label(), _real()),
+        "OpStringIndexer": _mk(
+            lambda: O.OpStringIndexer(handle_invalid="keep"), _picklist()),
+        "NumericMapVectorizer": _mk(
+            lambda: O.NumericMapVectorizer(), _real_map()),
+        "TextMapPivotVectorizer": _mk(
+            lambda: O.TextMapPivotVectorizer(top_k=3, min_support=1),
+            _text_map()),
+        "SmartTextMapVectorizer": _mk(
+            lambda: O.SmartTextMapVectorizer(max_cardinality=5, min_support=1,
+                                             num_features=8), _text_map()),
+        "PhoneMapVectorizer": _mk(
+            lambda: O.PhoneMapVectorizer(),
+            lambda: Column.from_values(
+                T.PhoneMap, [{"h": "4155552671"} if i % 2 else None
+                             for i in range(N)])),
+        "OpCountVectorizer": _mk(
+            lambda: O.OpCountVectorizer(min_df=1.0), _textlist()),
+        "OpWord2Vec": _mk(
+            lambda: O.OpWord2Vec(vector_size=4, min_count=1, window=2,
+                                 num_iter=1), _textlist()),
+        "OpLDA": _mk(lambda: O.OpLDA(k=2, max_iter=5), _counts_vector()),
+        "SanityChecker": _mk(
+            lambda: SanityChecker(max_correlation=2.0), _label(), _vector()),
+        "MinVarianceFilter": _mk(lambda: MinVarianceFilter(), _vector()),
+        "OpLogisticRegression": _mk(
+            lambda: M.OpLogisticRegression(max_iter=5), _label(), _vector()),
+        "OpLinearRegression": _mk(
+            lambda: M.OpLinearRegression(), _realnn(), _vector()),
+        "OpLinearSVC": _mk(
+            lambda: M.OpLinearSVC(max_iter=5), _label(), _vector()),
+        "OpNaiveBayes": _mk(
+            lambda: M.OpNaiveBayes(), _label(), _counts_vector()),
+        "OpRandomForestClassifier": _mk(
+            lambda: M.OpRandomForestClassifier(n_trees=3, max_depth=2,
+                                               max_bins=8),
+            _label(), _vector()),
+        "OpGBTRegressor": _mk(
+            lambda: M.OpGBTRegressor(n_estimators=3, max_depth=2, max_bins=8),
+            _realnn(), _vector()),
+        "IsotonicRegressionCalibrator": _mk(
+            lambda: M.IsotonicRegressionCalibrator(), _realnn(), _realnn()),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_transformer_inventory()))
+def test_transformer_contract(name):
+    factory, cols, kw = _transformer_inventory()[name]
+    check_transformer_contract(factory, cols, **kw)
+
+
+@pytest.mark.parametrize("name", sorted(_estimator_inventory()))
+def test_estimator_contract(name):
+    factory, cols, kw = _estimator_inventory()[name]
+    check_estimator_contract(factory, cols, ctx=FitContext(n_rows=N), **kw)

@@ -1,0 +1,196 @@
+"""Selector extras + serialization-at-scale (VERDICT r1 #10):
+RandomParamBuilder, SelectedModelCombiner, DropIndicesBy, warm start,
+npz array payloads.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+
+def _binary_ds(n=240, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = (x1 + 0.5 * x2 + rng.normal(0, 0.6, size=n) > 0).astype(np.float64)
+    return Dataset({"x1": x1, "x2": x2, "y": y},
+                   {"x1": T.Real, "x2": T.Real, "y": T.Integral})
+
+
+class TestRandomParamBuilder:
+    def test_draws_respect_bounds(self):
+        from transmogrifai_tpu.selector import RandomParamBuilder
+        grids = (RandomParamBuilder(seed=3)
+                 .uniform("reg_param", 0.001, 0.1)
+                 .exponential("lr", 1e-4, 1e-1)
+                 .uniform_int("depth", 2, 6)
+                 .subset("bins", [16, 32]).build(50))
+        assert len(grids) == 50
+        for g in grids:
+            assert 0.001 <= g["reg_param"] <= 0.1
+            assert 1e-4 <= g["lr"] <= 1e-1
+            assert 2 <= g["depth"] <= 6 and isinstance(g["depth"], int)
+            assert g["bins"] in (16, 32)
+        # log-uniform: median far below the arithmetic midpoint
+        lrs = sorted(g["lr"] for g in grids)
+        assert lrs[25] < 0.02
+
+    def test_random_grid_runs_in_selector(self):
+        from transmogrifai_tpu.automl import transmogrify
+        from transmogrifai_tpu.models import OpLogisticRegression
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector, DataSplitter,
+            RandomParamBuilder)
+        ds = _binary_ds()
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = transmogrify(preds)
+        grids = RandomParamBuilder(seed=1).exponential(
+            "reg_param", 1e-4, 1e-1).build(5)
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            models=[(OpLogisticRegression(max_iter=15), grids)], n_folds=2,
+            splitter=DataSplitter(reserve_test_fraction=0.15))
+        pf = sel.set_input(label, vec).get_output()
+        model = (Workflow().set_result_features(pf, label)
+                 .set_input_dataset(ds).train())
+        summary = model.fitted[pf.origin_stage.uid].summary
+        assert len(summary.validation_results) == 5
+
+
+class TestSelectedModelCombiner:
+    def _two_selectors(self, ds):
+        from transmogrifai_tpu.automl import transmogrify
+        from transmogrifai_tpu.models import (
+            OpLogisticRegression, OpRandomForestClassifier)
+        from transmogrifai_tpu.selector import (
+            BinaryClassificationModelSelector, DataSplitter)
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = transmogrify(preds)
+        s1 = BinaryClassificationModelSelector.with_cross_validation(
+            models=[(OpLogisticRegression(max_iter=15),
+                     [{"reg_param": 0.001}])], n_folds=2,
+            splitter=DataSplitter(reserve_test_fraction=0.15))
+        s2 = BinaryClassificationModelSelector.with_cross_validation(
+            models=[(OpRandomForestClassifier(n_trees=5, max_bins=16),
+                     [{"max_depth": 3}])], n_folds=2,
+            splitter=DataSplitter(reserve_test_fraction=0.15, seed=7))
+        p1 = s1.set_input(label, vec).get_output()
+        p2 = s2.set_input(label, vec).get_output()
+        return label, p1, p2
+
+    @pytest.mark.parametrize("strategy", ["best", "weighted", "equal"])
+    def test_combiner_strategies(self, strategy):
+        from transmogrifai_tpu.selector import SelectedModelCombiner
+        ds = _binary_ds()
+        label, p1, p2 = self._two_selectors(ds)
+        combined = SelectedModelCombiner(strategy=strategy).set_input(
+            label, p1, p2).get_output()
+        model = (Workflow().set_result_features(combined, label)
+                 .set_input_dataset(ds).train())
+        out = model.score(ds)
+        pred = out[combined.name]
+        prob = np.asarray(pred.data["probability"])
+        assert prob.shape == (len(ds), 2)
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=1e-5)
+        cm = model.fitted[combined.origin_stage.uid]
+        if strategy == "best":
+            assert {cm.weight1, cm.weight2} == {0.0, 1.0}
+        elif strategy == "equal":
+            assert cm.weight1 == cm.weight2 == 0.5
+        else:
+            assert abs(cm.weight1 + cm.weight2 - 1.0) < 1e-9
+            assert 0 < cm.weight1 < 1
+
+
+class TestDropIndicesBy:
+    def test_drop_null_indicators(self):
+        from transmogrifai_tpu.automl import transmogrify
+        from transmogrifai_tpu.data.metadata import NULL_INDICATOR
+        from transmogrifai_tpu.ops import DropIndicesByTransformer
+        rng = np.random.default_rng(1)
+        n = 60
+        vals = rng.normal(size=n)
+        vals[::5] = np.nan
+        ds = Dataset({"x": vals, "y": np.ones(n)},
+                     {"x": T.Real, "y": T.Integral})
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = transmogrify(preds)
+        pruned = DropIndicesByTransformer(
+            lambda c: c.indicator_value == NULL_INDICATOR
+        ).set_input(vec).get_output()
+        model = (Workflow().set_result_features(pruned, label)
+                 .set_input_dataset(ds).train())
+        cols = model.score(ds, keep_intermediate=True)
+        full_w = np.asarray(cols[vec.uid].data).shape[1]
+        kept_w = np.asarray(cols[pruned.uid].data).shape[1]
+        assert kept_w == full_w - 1  # exactly the null indicator removed
+        meta = cols[pruned.uid].meta
+        assert all(c.indicator_value != NULL_INDICATOR
+                   for c in meta.columns)
+
+
+class TestWarmStart:
+    def test_with_model_stages_reuses_fits(self):
+        """Warm start (OpWorkflow.withModelStages, OpWorkflow.scala:468):
+        matching fitted stages are reused, only new estimators train."""
+        from transmogrifai_tpu.automl import transmogrify
+        from transmogrifai_tpu.automl.sanity_checker import SanityChecker
+        from transmogrifai_tpu.models import OpLogisticRegression
+
+        ds = _binary_ds()
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = transmogrify(preds)
+        checked = SanityChecker(max_correlation=2.0).set_input(
+            label, vec).get_output()
+        pf = OpLogisticRegression(max_iter=15).set_input(
+            label, checked).get_output()
+        wf = (Workflow().set_result_features(pf, label)
+              .set_input_dataset(ds))
+        m1 = wf.train()
+
+        calls = {"n": 0}
+        orig = SanityChecker.fit_model
+
+        def counting(self, cols, ctx):
+            calls["n"] += 1
+            return orig(self, cols, ctx)
+
+        SanityChecker.fit_model = counting
+        try:
+            m2 = (Workflow().set_result_features(pf, label)
+                  .set_input_dataset(ds)
+                  .with_model_stages(m1).train())
+        finally:
+            SanityChecker.fit_model = orig
+        assert calls["n"] == 0  # warm-started, not refit
+        p1 = np.asarray(m1.score(ds)[pf.name].data["prediction"])
+        p2 = np.asarray(m2.score(ds)[pf.name].data["prediction"])
+        np.testing.assert_array_equal(p1, p2)
+
+
+class TestNpzSerialization:
+    def test_large_arrays_offload_to_npz(self, tmp_path):
+        from transmogrifai_tpu.automl import transmogrify
+        from transmogrifai_tpu.models import OpRandomForestClassifier
+        ds = _binary_ds()
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = transmogrify(preds)
+        pf = OpRandomForestClassifier(n_trees=10, max_bins=16).set_input(
+            label, vec).get_output()
+        model = (Workflow().set_result_features(pf, label)
+                 .set_input_dataset(ds).train())
+        path = str(tmp_path / "m")
+        model.save(path)
+        assert os.path.exists(os.path.join(path, "arrays.npz"))
+        # the JSON manifest must not carry the big tree arrays inline
+        manifest = open(os.path.join(path, "op-model.json")).read()
+        assert len(manifest) < 200_000
+        back = WorkflowModel.load(path)
+        p1 = np.asarray(model.score(ds)[pf.name].data["prediction"])
+        p2 = np.asarray(back.score(ds)[pf.name].data["prediction"])
+        np.testing.assert_array_equal(p1, p2)

@@ -337,11 +337,15 @@ class SanityChecker(Estimator):
             cy = jnp.asarray(y_np.astype(np.float32))
 
         need_ff = self.max_feature_corr < 1.0
-        redc = {k: np.asarray(v)
-                for k, v in _column_reductions(Cx, cy).items()}
-        red = ({k: np.asarray(v)
-                for k, v in _column_reductions(X_dev).items()}
-               if spearman else redc)
+        if need_ff:  # corr comes from the Gram pass; only raw moments here
+            red = {k: np.asarray(v)
+                   for k, v in _column_reductions(X_dev).items()}
+        else:        # label terms ride the same single reduction pass
+            redc = {k: np.asarray(v)
+                    for k, v in _column_reductions(Cx, cy).items()}
+            red = ({k: np.asarray(v)
+                    for k, v in _column_reductions(X_dev).items()}
+                   if spearman else redc)
         mean = red["sx"] / max(n, 1)
         var = (red["sxx"] - n * mean ** 2) / max(n - 1, 1)
         var = np.maximum(var, 0.0)

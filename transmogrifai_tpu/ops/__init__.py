@@ -30,6 +30,7 @@ from transmogrifai_tpu.ops.enrich import (
     NameEntityRecognizer)
 from transmogrifai_tpu.ops.text_advanced import (
     OpStopWordsRemover, OpNGram, OpCountVectorizer, OpWord2Vec, OpLDA)
+from transmogrifai_tpu.ops.drop_indices import DropIndicesByTransformer
 from transmogrifai_tpu.ops.maps import (
     NumericMapVectorizer, TextMapPivotVectorizer, SmartTextMapVectorizer,
     MultiPickListMapVectorizer, PhoneMapVectorizer, GeolocationMapVectorizer,
@@ -58,7 +59,7 @@ __all__ = [
     "PhoneIsValidTransformer", "PhoneVectorizer", "MimeTypeDetector",
     "LangDetector", "HumanNameDetector", "NameEntityRecognizer",
     "OpStopWordsRemover", "OpNGram", "OpCountVectorizer", "OpWord2Vec",
-    "OpLDA",
+    "OpLDA", "DropIndicesByTransformer",
     "NumericMapVectorizer", "TextMapPivotVectorizer",
     "SmartTextMapVectorizer", "MultiPickListMapVectorizer",
     "PhoneMapVectorizer", "GeolocationMapVectorizer", "DateMapVectorizer",

@@ -89,7 +89,9 @@ class DateToUnitCircleVectorizer(Transformer):
         stacked = []
         for i in range(0, len(parts), 2):
             s, c = parts[i], parts[i + 1]
-            inter = jnp.stack([s, c], axis=2).reshape(s.shape[0], -1)
+            # explicit width: reshape(n, -1) breaks on 0-row batches
+            inter = jnp.stack([s, c], axis=2).reshape(
+                s.shape[0], 2 * s.shape[1])
             stacked.append(inter)
         return jnp.concatenate(stacked, axis=1)
 

@@ -1,0 +1,69 @@
+"""DropIndicesByTransformer: drop vector columns by metadata predicate.
+
+Reference parity: `core/.../feature/DropIndicesByTransformer.scala` —
+`vector.dropIndicesBy(_.isNullIndicator)` style pruning driven by
+`OpVectorColumnMetadata`. The predicate receives each column's
+VectorColumnMetadata; matched columns are removed. Fitted form is a static
+column gather (same device shape as SanityCheckerModel)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.metadata import VectorMetadata
+from transmogrifai_tpu.stages.base import FitContext, Transformer
+from transmogrifai_tpu.utils.fnser import decode_fn, encode_fn
+
+
+class DropIndicesByTransformer(Transformer):
+    """OPVector → OPVector minus the columns whose metadata matches
+    `predicate`. Indices resolve lazily from the input metadata on first
+    use (the metadata is static per fitted DAG, so the gather is static)."""
+
+    in_types = (T.OPVector,)
+    out_type = T.OPVector
+
+    def __init__(self, predicate: Callable, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.predicate = decode_fn(predicate)
+        self._indices = None
+        self._meta = None
+
+    def _resolve(self, meta: Optional[VectorMetadata], d: int):
+        if self._indices is not None:
+            return
+        if meta is None or meta.size != d:
+            raise ValueError(
+                "DropIndicesByTransformer requires vector column metadata")
+        keep = [i for i, c in enumerate(meta.columns)
+                if not self.predicate(c)]
+        if not keep:
+            raise ValueError("predicate matched every column")
+        self._indices = keep
+        self._meta = meta.select(keep)
+
+    def host_prepare(self, cols: Sequence[Optional[Column]]):
+        c = cols[0]
+        if c is not None:
+            self._resolve(c.meta, int(np.asarray(c.data).shape[1]))
+        return None
+
+    def device_apply(self, enc, dev):
+        X = jnp.asarray(dev[-1])
+        if self._indices is None:
+            # metadata travels on the feature, not the device pytree
+            meta = getattr(self.input_features[0].origin_stage,
+                           "output_meta", lambda: None)()
+            self._resolve(meta, int(X.shape[1]))
+        return X[:, jnp.asarray(self._indices, dtype=jnp.int32)]
+
+    def output_meta(self) -> Optional[VectorMetadata]:
+        return self._meta
+
+    def get_params(self):
+        return {"predicate": encode_fn(self.predicate)}

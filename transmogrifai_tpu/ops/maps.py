@@ -83,7 +83,9 @@ class NumericMapModel(Transformer):
             v, m = jnp.asarray(e["value"]), jnp.asarray(e["mask"])
             filled = v * m + self.fills[i][None, :] * (1.0 - m)
             if self.track_nulls:
-                both = jnp.stack([filled, 1.0 - m], axis=2).reshape(v.shape[0], -1)
+                # explicit width: reshape(n, -1) breaks on 0-row batches
+                both = jnp.stack([filled, 1.0 - m], axis=2).reshape(
+                    v.shape[0], 2 * v.shape[1])
                 parts.append(both)
             else:
                 parts.append(filled)

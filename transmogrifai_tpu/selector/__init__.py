@@ -7,8 +7,11 @@ from transmogrifai_tpu.selector.model_selector import (
     ModelSelector, ModelSelectorSummary,
     BinaryClassificationModelSelector, MultiClassificationModelSelector,
     RegressionModelSelector)
+from transmogrifai_tpu.selector.combiner import (
+    SelectedCombinerModel, SelectedModelCombiner)
 
 __all__ = [
+    "SelectedModelCombiner", "SelectedCombinerModel",
     "DataSplitter", "DataBalancer", "DataCutter", "SplitterSummary",
     "OpCrossValidation", "OpTrainValidationSplit",
     "ParamGridBuilder", "RandomParamBuilder",

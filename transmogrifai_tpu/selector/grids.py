@@ -50,8 +50,19 @@ class RandomParamBuilder:
             (param, lambda: float(np.exp(self._rng.uniform(llo, lhi)))))
         return self
 
+    def uniform_int(self, param: str, lo: int, hi: int) -> "RandomParamBuilder":
+        """Inclusive integer draw (RandomParamBuilder.scala uniform on
+        IntParam)."""
+        if hi < lo:
+            raise ValueError("uniform_int: hi < lo")
+        self._specs.append(
+            (param, lambda: int(self._rng.integers(lo, hi + 1))))
+        return self
+
     def subset(self, param: str, values: Sequence[Any]) -> "RandomParamBuilder":
         vals = list(values)
+        if not vals:
+            raise ValueError("subset: empty choices")
         self._specs.append(
             (param, lambda: vals[int(self._rng.integers(len(vals)))]))
         return self

@@ -1,0 +1,6 @@
+"""FeatureAsserts (testkit/.../FeatureAsserts.scala) — re-exported from
+feature_builder where TestFeatureBuilder lives."""
+
+from transmogrifai_tpu.testkit.feature_builder import assert_feature
+
+__all__ = ["assert_feature"]

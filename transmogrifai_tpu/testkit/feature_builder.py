@@ -1,0 +1,85 @@
+"""TestFeatureBuilder + FeatureAsserts: fixture factories for stage tests.
+
+Reference parity: `testkit/.../TestFeatureBuilder.scala:50-400` (materialize
+a DataFrame + typed features from tuples of values, incl. `random`) and
+`testkit/.../FeatureAsserts.scala` (assertFeature: type + values +
+metadata checks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.features.feature import Feature
+from transmogrifai_tpu.stages.base import FeatureGeneratorStage
+
+
+class TestFeatureBuilder:
+    """Materialize a Dataset + raw Features from rows of typed values:
+
+        ds, (age, name) = TestFeatureBuilder.build(
+            [(32.0, "ann"), (None, "bob")], types=[T.Real, T.Text])
+    """
+
+    @staticmethod
+    def build(rows: Sequence[Tuple], types: Sequence[type],
+              names: Optional[Sequence[str]] = None,
+              response_index: Optional[int] = None
+              ) -> Tuple[Dataset, List[Feature]]:
+        k = len(types)
+        names = list(names) if names is not None \
+            else [f"f{i}" for i in range(k)]
+        if len(names) != k:
+            raise ValueError("names/types length mismatch")
+        record_rows = []
+        for row in rows:
+            if len(row) != k:
+                raise ValueError(f"row arity {len(row)} != {k}")
+            record_rows.append(dict(zip(names, row)))
+        schema = dict(zip(names, types))
+        ds = Dataset.from_rows(record_rows, schema=schema)
+        features = []
+        for i, (name, ftype) in enumerate(zip(names, types)):
+            stage = FeatureGeneratorStage(
+                name=name, ftype=ftype, column=name,
+                is_response=(i == response_index))
+            features.append(stage.get_output())
+        return ds, features
+
+    @staticmethod
+    def random(n: int, types: Sequence[type], seed: int = 42,
+               probability_of_empty: float = 0.1,
+               names: Optional[Sequence[str]] = None
+               ) -> Tuple[Dataset, List[Feature]]:
+        """Random typed rows via the testkit generators
+        (TestFeatureBuilder.random, :298)."""
+        from transmogrifai_tpu.testkit.random_data import random_values
+        cols = [random_values(t, n, seed=seed + i,
+                              probability_of_empty=probability_of_empty)
+                for i, t in enumerate(types)]
+        rows = list(zip(*cols)) if cols else []
+        return TestFeatureBuilder.build(rows, types, names=names)
+
+
+def assert_feature(feature: Feature, dataset: Dataset,
+                   expected_type: Optional[type] = None,
+                   expected_values: Optional[Sequence[Any]] = None) -> Column:
+    """FeatureAsserts.assertFeature: materialize through the origin stage
+    and check type + values. Returns the column for further checks."""
+    if expected_type is not None:
+        assert feature.ftype is expected_type, (
+            f"{feature.name}: ftype {feature.ftype.__name__} != "
+            f"{expected_type.__name__}")
+    col = feature.origin_stage.materialize(dataset)
+    assert len(col) == len(dataset)
+    if expected_values is not None:
+        got = [v.value for v in col.to_values()]
+        want = [v.value if isinstance(v, T.FeatureType) else v
+                for v in expected_values]
+        assert got == want, f"{feature.name}: {got} != {want}"
+    return col

@@ -285,3 +285,73 @@ class RandomVector:
               seed: int = 42) -> RandomStream:
         return RandomStream(
             T.OPVector, lambda r: r.normal(mean, sigma, dim).tolist(), seed=seed)
+
+
+
+def _default_stream(ftype: type) -> RandomStream:
+    """A sensible default generator per feature type (TestFeatureBuilder
+    `random`)."""
+    if issubclass(ftype, T.RealNN):
+        return RandomStream(T.RealNN, lambda r: float(r.normal()))
+    if issubclass(ftype, (T.Date, T.DateTime)):
+        return RandomIntegral.datetimes() if issubclass(ftype, T.DateTime) \
+            else RandomIntegral.dates()
+    if issubclass(ftype, T.Binary):
+        return RandomBinary.of()
+    if issubclass(ftype, T.Integral):
+        return RandomIntegral.integers(ftype=ftype)
+    if issubclass(ftype, T.Real):
+        return RandomReal.normal(ftype=ftype)
+    if issubclass(ftype, T.Email):
+        return RandomText.emails()
+    if issubclass(ftype, T.URL):
+        return RandomText.urls()
+    if issubclass(ftype, T.Phone):
+        return RandomText.phones()
+    if issubclass(ftype, T.Base64):
+        return RandomText.base64()
+    if issubclass(ftype, T.ID):
+        return RandomText.ids()
+    if issubclass(ftype, (T.PickList, T.ComboBox)):
+        return RandomText.picklists(["a", "b", "c", "d"])
+    if issubclass(ftype, T.Country):
+        return RandomText.countries()
+    if issubclass(ftype, T.State):
+        return RandomText.states()
+    if issubclass(ftype, T.City):
+        return RandomText.cities()
+    if issubclass(ftype, T.Street):
+        return RandomText.streets()
+    if issubclass(ftype, T.TextArea):
+        return RandomText.textareas()
+    if issubclass(ftype, T.TextList):
+        return RandomList.of_texts()
+    if issubclass(ftype, (T.DateList,)):
+        return RandomList.of_dates()
+    if issubclass(ftype, T.MultiPickList):
+        return RandomSet.of(["x", "y", "z"])
+    if issubclass(ftype, T.Geolocation):
+        return RandomStream(
+            T.Geolocation,
+            lambda r: [float(r.uniform(-90, 90)), float(r.uniform(-180, 180)),
+                       float(r.integers(1, 10))])
+    if issubclass(ftype, T.OPVector):
+        return RandomVector.dense(4)
+    if issubclass(ftype, T.OPMap):
+        base = {
+            T.RealMap: RandomReal.normal(), T.IntegralMap:
+            RandomIntegral.integers(), T.BinaryMap: RandomBinary.of(),
+        }.get(ftype, RandomText.strings())
+        return RandomMap.of(base, keys=["k1", "k2"], ftype=ftype)
+    if issubclass(ftype, T.Text):
+        return RandomText.strings()
+    raise T.FeatureTypeError(f"No default random stream for {ftype.__name__}")
+
+
+def random_values(ftype: type, n: int, seed: int = 42,
+                  probability_of_empty: float = 0.1):
+    """n raw python values of `ftype` (None for empties)."""
+    stream = _default_stream(ftype).with_seed(seed)
+    if probability_of_empty > 0 and not issubclass(ftype, T.NonNullable):
+        stream = stream.with_prob_of_empty(probability_of_empty)
+    return [v.value if not v.is_empty else None for v in stream.take(n)]

@@ -26,7 +26,10 @@ def encode_fn(fn: Optional[Callable]) -> Any:
         return None
     mod = getattr(fn, "__module__", None)
     qual = getattr(fn, "__qualname__", "")
-    if mod and qual and "<" not in qual and "." not in qual:
+    # __main__ refs would resolve against whatever entrypoint LOADS the
+    # model (or fail) — pickle those like lambdas
+    if mod and mod != "__main__" and qual and "<" not in qual \
+            and "." not in qual:
         try:  # prefer a readable module:name reference when it resolves
             if getattr(importlib.import_module(mod), qual, None) is fn:
                 return {_REF_KEY: f"{mod}:{qual}"}

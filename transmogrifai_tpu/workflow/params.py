@@ -105,8 +105,11 @@ def apply_stage_params(stages, stage_params: Dict[str, Dict[str, Any]],
         for key in (type(stage).__name__, stage.operation_name, stage.uid):
             overrides = stage_params.get(key)
             if overrides:
+                # REBIND params: clone_graph's shallow copy shares the
+                # params dict with the user's original stage — in-place
+                # mutation would leak overrides out of the private clone
+                stage.params = {**stage.params, **overrides}
                 for name, value in overrides.items():
-                    stage.params[name] = value
                     if hasattr(stage, name):
                         setattr(stage, name, value)
                 touched += 1

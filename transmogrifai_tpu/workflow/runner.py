@@ -174,7 +174,14 @@ class WorkflowRunner:
         if self.evaluator is not None and self.label_feature is not None \
                 and self.prediction_feature is not None:
             with profile.phase(profiling.EVALUATION):
-                metrics["evaluation"] = self._eval_scores(model, ds, scores)
+                try:
+                    metrics["evaluation"] = self._eval_scores(
+                        model, ds, scores)
+                except KeyError:
+                    # scoring data legitimately has no label column —
+                    # scores are still written, evaluation just skips
+                    log.info("score: label column absent, skipping "
+                             "evaluation")
         return RunResult("score", metrics=metrics, write_location=loc)
 
     def _streaming_score(self, params: OpParams,

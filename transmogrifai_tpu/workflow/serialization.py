@@ -17,13 +17,61 @@ import json
 import os
 from typing import Any, Dict, List
 
+import numpy as np
+
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.features.feature import Feature
 from transmogrifai_tpu.stages.base import (
     FeatureGeneratorStage, StageRegistry, Transformer)
 
 MANIFEST = "op-model.json"
+ARRAYS = "arrays.npz"
 VERSION = 1
+NPZ_MIN_SIZE = 64  # numeric payloads at/above this many elements offload
+
+
+def _offload_arrays(value: Any, store: Dict[str, np.ndarray],
+                    prefix: str) -> Any:
+    """Replace large numeric lists/arrays inside stage params with
+    `{"__npz__": key}` references; the arrays land in one arrays.npz
+    beside the manifest (OpWorkflowModelWriter's per-stage payload dirs,
+    sized for real models — a 20-tree forest no longer round-trips
+    through JSON text)."""
+    if isinstance(value, dict):
+        return {k: _offload_arrays(v, store, f"{prefix}.{k}")
+                for k, v in value.items()}
+    if isinstance(value, (np.ndarray, list)):
+        try:
+            arr = np.asarray(value)
+        except Exception:
+            arr = None
+        if arr is not None and arr.dtype != object \
+                and arr.dtype.kind in "biuf" and arr.size >= NPZ_MIN_SIZE:
+            key = f"{prefix}#{len(store)}"
+            store[key] = arr
+            return {"__npz__": key}
+        if isinstance(value, np.ndarray):
+            return value.tolist()
+        return [_offload_arrays(v, store, f"{prefix}[{i}]")
+                for i, v in enumerate(value)]
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    return value
+
+
+def _restore_arrays(value: Any, npz) -> Any:
+    if isinstance(value, dict):
+        if set(value.keys()) == {"__npz__"}:
+            if npz is None:
+                raise ValueError(
+                    "manifest references arrays.npz but the file is missing")
+            return npz[value["__npz__"]]
+        return {k: _restore_arrays(v, npz) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_restore_arrays(v, npz) for v in value]
+    return value
 
 
 def _feature_entry(f: Feature) -> Dict[str, Any]:
@@ -51,6 +99,7 @@ def save_model(model, path: str, overwrite: bool = True) -> None:
 
     stage_entries = []
     seen = set()
+    arrays: Dict[str, np.ndarray] = {}
     for f in features.values():
         stage = f.origin_stage
         if stage is None or stage.uid in seen:
@@ -61,10 +110,12 @@ def save_model(model, path: str, overwrite: bool = True) -> None:
             "uid": stage.uid,
             "class": type(fitted).__name__,
             "estimator_class": type(getattr(stage, "_estimator", stage)).__name__,
-            "params": fitted.get_params(),
+            "params": _offload_arrays(fitted.get_params(), arrays, stage.uid),
             "inputs": [p.uid for p in stage.input_features],
         }
         stage_entries.append(entry)
+    if arrays:
+        np.savez_compressed(os.path.join(path, ARRAYS), **arrays)
 
     manifest = {
         "version": VERSION,
@@ -84,11 +135,13 @@ def load_model(path: str):
     if manifest["version"] != VERSION:
         raise ValueError(f"Unsupported model version {manifest['version']}")
 
+    npz_path = os.path.join(path, ARRAYS)
+    npz = np.load(npz_path) if os.path.exists(npz_path) else None
     stage_specs = {s["uid"]: s for s in manifest["stages"]}
     stages: Dict[str, Any] = {}
     for uid, spec in stage_specs.items():
         cls = StageRegistry.get(spec["class"])
-        params = dict(spec["params"])
+        params = _restore_arrays(dict(spec["params"]), npz)
         if cls is FeatureGeneratorStage:
             params["ftype"] = T.feature_type_by_name(params.pop("ftype"))
         stages[uid] = cls(uid=uid, **params)

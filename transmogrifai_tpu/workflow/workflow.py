@@ -38,6 +38,7 @@ class Workflow:
         self._rff_score_source = None
         self.blocklist: List[str] = []
         self._workflow_cv = False
+        self._warm_models: Dict[str, Transformer] = {}
 
     def set_result_features(self, *features) -> "Workflow":
         self.result_features = tuple(features)
@@ -60,6 +61,14 @@ class Workflow:
             self.parameters = params.to_json()
         else:
             self.parameters = dict(params)
+        return self
+
+    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+        """Warm start (OpWorkflow.withModelStages, OpWorkflow.scala:468-472):
+        estimators whose uid matches a fitted stage in `model` reuse that
+        fitted transformer instead of refitting — only new estimators
+        train."""
+        self._warm_models.update(model.fitted)
         return self
 
     def with_workflow_cv(self) -> "Workflow":
@@ -145,6 +154,13 @@ class Workflow:
                 # original estimator (copyWithNewStages swap, stages/base.py)
                 est = getattr(stage, "_estimator", None) or stage
                 if isinstance(est, Estimator):
+                    warm = self._warm_models.get(est.uid)
+                    if warm is not None and not isinstance(warm, Estimator):
+                        # warm start: reuse the previously fitted model
+                        fitted[est.uid] = warm
+                        columns[stage.get_output().uid] = warm.transform(
+                            inputs, ctx)
+                        continue
                     stage_ctx = ctx.child(li)
                     if self._workflow_cv and self._is_selector(est):
                         stage_ctx.cv_refit = self._make_cv_refit(
