@@ -147,3 +147,28 @@ class TestUnicodeTokenParity:
         import pyarrow as pa
         t_ = pa.table({"g": pa.array([[37.7, -122.4, 1.0], None])})
         assert Dataset.from_arrow(t_).schema["g"] is T.Geolocation
+
+
+class TestNativeHashKernel:
+    def test_native_matches_python_murmur(self, rng):
+        from transmogrifai_tpu.native import get_murmur3
+        if get_murmur3() is None:
+            pytest.skip("no C toolchain")
+        from transmogrifai_tpu.ops.text import (
+            TokenHasher, _hash_counts, tokenize)
+        words = ["alpha", "beta", "gamma", "日本語", "café", None, "",
+                 "one two three two"]
+        values = [words[i] for i in rng.integers(len(words), size=500)]
+        got = _hash_counts(values, TokenHasher(64, seed=7), False, False)
+        want = np.zeros_like(got)
+        h = TokenHasher(64, seed=7)
+        for i, v in enumerate(values):
+            for tok in tokenize(v or ""):
+                want[i, h(tok)] += 1.0
+        np.testing.assert_array_equal(got, want)
+
+    def test_binary_mode(self, rng):
+        from transmogrifai_tpu.ops.text import TokenHasher, _hash_counts
+        values = ["dup dup dup", "solo", None] * 20
+        got = _hash_counts(values, TokenHasher(16), True, False)
+        assert got.max() == 1.0

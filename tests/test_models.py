@@ -188,3 +188,94 @@ def test_tree_fold_mask_weights():
     a1 = (np.asarray(m1.predict_arrays(X[:200])["prediction"]) == np.asarray(y[:200])).mean()
     a2 = (np.asarray(m2.predict_arrays(X[:200])["prediction"]) == np.asarray(y[:200])).mean()
     assert abs(a1 - a2) < 0.1
+
+
+class TestMulticlassGBT:
+    def test_xgb_multiclass_beats_chance(self, rng):
+        import jax.numpy as jnp
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+        from transmogrifai_tpu.stages.base import FitContext
+        n, k = 400, 3
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = np.argmax(X[:, :k] + 0.3 * rng.normal(size=(n, k)), axis=1)
+        est = OpXGBoostClassifier(n_estimators=20, max_depth=3, max_bins=16)
+        m = est.fit_arrays(jnp.asarray(X), jnp.asarray(y.astype(np.float32)),
+                           jnp.ones(n, jnp.float32), FitContext(n_rows=n))
+        pred = np.asarray(m.predict_arrays(jnp.asarray(X))["prediction"])
+        acc = (pred == y).mean()
+        assert acc > 0.85, acc
+        prob = np.asarray(m.predict_arrays(jnp.asarray(X))["probability"])
+        assert prob.shape == (n, k)
+        np.testing.assert_allclose(prob.sum(1), 1.0, rtol=1e-4)
+
+    def test_multiclass_save_load(self, rng, tmp_path):
+        import jax.numpy as jnp
+        from transmogrifai_tpu.models import OpGBTClassifier
+        from transmogrifai_tpu.models.trees import GBTMulticlassModel
+        from transmogrifai_tpu.stages.base import FitContext, StageRegistry
+        n, k = 120, 3
+        X = rng.normal(size=(n, 3)).astype(np.float32)
+        y = rng.integers(k, size=n).astype(np.float32)
+        est = OpGBTClassifier(n_estimators=4, max_depth=2, max_bins=8)
+        m = est.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                           jnp.ones(n, jnp.float32), FitContext(n_rows=n))
+        assert isinstance(m, GBTMulticlassModel)
+        clone = StageRegistry.get("GBTMulticlassModel")(**m.get_params())
+        p1 = np.asarray(m.predict_arrays(jnp.asarray(X))["prediction"])
+        p2 = np.asarray(clone.predict_arrays(jnp.asarray(X))["prediction"])
+        np.testing.assert_array_equal(p1, p2)
+
+    def test_xgb_regularization_params_take_effect(self, rng):
+        import jax.numpy as jnp
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+        from transmogrifai_tpu.stages.base import FitContext
+        n = 300
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        ctx = FitContext(n_rows=n)
+        plain = OpXGBoostClassifier(n_estimators=10, max_depth=3, max_bins=16)
+        harsh = OpXGBoostClassifier(n_estimators=10, max_depth=3, max_bins=16,
+                                    gamma=1e9)  # no split clears the bar
+        mp = plain.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                              jnp.ones(n, jnp.float32), ctx)
+        mh = harsh.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                              jnp.ones(n, jnp.float32), ctx)
+        pp = np.asarray(mp.predict_arrays(jnp.asarray(X))["probability"])[:, 1]
+        ph = np.asarray(mh.predict_arrays(jnp.asarray(X))["probability"])[:, 1]
+        assert np.std(pp) > np.std(ph)  # gamma=inf → stumps never split
+        # subsample/colsample change the fit (different random stream use)
+        sub = OpXGBoostClassifier(n_estimators=10, max_depth=3, max_bins=16,
+                                  subsample=0.5, colsample_bytree=0.5)
+        ms = sub.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                            jnp.ones(n, jnp.float32), ctx)
+        ps = np.asarray(ms.predict_arrays(jnp.asarray(X))["probability"])[:, 1]
+        assert not np.allclose(ps, pp)
+
+    def test_multiclass_selector_sweep_with_xgb(self, rng):
+        from transmogrifai_tpu.automl import transmogrify
+        from transmogrifai_tpu.data import Dataset
+        from transmogrifai_tpu.features import FeatureBuilder
+        from transmogrifai_tpu.models import OpXGBoostClassifier
+        from transmogrifai_tpu.selector import (
+            DataCutter, MultiClassificationModelSelector)
+        from transmogrifai_tpu.workflow import Workflow
+        import transmogrifai_tpu.types as t
+        n, k = 300, 3
+        Xn = rng.normal(size=(n, 3))
+        y = np.argmax(Xn + 0.4 * rng.normal(size=(n, 3)), axis=1)
+        ds = Dataset({"a": Xn[:, 0], "b": Xn[:, 1], "c": Xn[:, 2],
+                      "y": y.astype(np.float64)},
+                     {"a": t.Real, "b": t.Real, "c": t.Real, "y": t.Integral})
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = transmogrify(preds)
+        sel = MultiClassificationModelSelector.with_cross_validation(
+            models=[(OpXGBoostClassifier(n_estimators=8, max_bins=8),
+                     [{"max_depth": 2}, {"max_depth": 3}])],
+            n_folds=2)
+        pf = sel.set_input(label, vec).get_output()
+        model = (Workflow().set_result_features(pf, label)
+                 .set_input_dataset(ds).train())
+        summary = model.fitted[pf.origin_stage.uid].summary
+        assert all(np.isfinite(r.mean_metric)
+                   for r in summary.validation_results)
+        assert summary.holdout_metrics.get("F1", 0) > 0.5

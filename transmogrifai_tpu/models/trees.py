@@ -83,7 +83,7 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               max_depth: int, n_bins: int, reg_lambda: float = 1.0,
               min_child_weight: float = 1.0, min_gain: float = 0.0,
               feature_mask: Optional[jnp.ndarray] = None,
-              active_depth=None) -> Dict:
+              active_depth=None, alpha: float = 0.0) -> Dict:
     """Grow one fixed-depth tree. Returns dense arrays:
 
     {"feat": (depth, 2^depth) int32, "bin": (depth, 2^depth) int32,
@@ -135,6 +135,8 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
 
     leaf_g = jnp.zeros((max_nodes, m), G.dtype).at[node_idx].add(G)
     leaf_h = jnp.zeros((max_nodes,), H.dtype).at[node_idx].add(H)
+    # L1 (alpha) soft-thresholds the leaf numerator (XGBoost leaf formula)
+    leaf_g = jnp.sign(leaf_g) * jnp.maximum(jnp.abs(leaf_g) - alpha, 0.0)
     leaf = leaf_g / (leaf_h + reg_lambda)[:, None]
     return {"feat": feats, "bin": bins, "leaf": leaf}
 
@@ -200,10 +202,15 @@ def predict_forest(trees: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
                                    "objective"))
 def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
             learning_rate, reg_lambda, objective: str = "logistic",
-            min_child_weight: float = 1.0, active_depth=None):
+            min_child_weight: float = 1.0, active_depth=None,
+            gamma=0.0, alpha=0.0, subsample=1.0, colsample=1.0, seed=0):
     """Returns (trees, final_margin): the scan carry already holds the full
-    training-matrix margin, so sweep callers need not re-walk the forest."""
-    n = Xb.shape[0]
+    training-matrix margin, so sweep callers need not re-walk the forest.
+
+    XGBoost param surface (OpXGBoostClassifier.scala / XGBoostParams.scala):
+    `gamma` = min split gain, `alpha` = leaf L1, `subsample` = per-round
+    row sampling, `colsample` = per-tree feature sampling."""
+    n, d = Xb.shape
 
     def grads(margin):
         if objective == "logistic":
@@ -211,18 +218,78 @@ def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
             return (p - y) * w, jnp.maximum(p * (1 - p), 1e-6) * w
         return (margin - y) * w, w  # squared error
 
-    def round_(margin, _):
+    def round_(margin, key):
+        k1, k2 = jax.random.split(key)
+        # uniform draws in [0,1): rate 1.0 keeps everything (no-op default)
+        rows = (jax.random.uniform(k1, (n,)) < subsample).astype(jnp.float32)
+        fmask = jax.random.uniform(k2, (d,)) < colsample
         g, h = grads(margin)
-        tree = grow_tree(Xb, (-g)[:, None], h, max_depth, n_bins,
-                         reg_lambda=reg_lambda,
+        tree = grow_tree(Xb, (-g * rows)[:, None], h * rows, max_depth,
+                         n_bins, reg_lambda=reg_lambda,
                          min_child_weight=min_child_weight,
-                         active_depth=active_depth)
+                         min_gain=gamma, feature_mask=fmask,
+                         active_depth=active_depth, alpha=alpha)
         margin = margin + learning_rate * predict_tree(tree, Xb)[:, 0]
         return margin, tree
 
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_estimators)
     base = jnp.zeros(n, jnp.float32)
-    margin, trees = jax.lax.scan(round_, base, None, length=n_estimators)
+    margin, trees = jax.lax.scan(round_, base, keys)
     return trees, margin
+
+
+@partial(jax.jit, static_argnames=("n_estimators", "max_depth", "n_bins",
+                                   "n_classes"))
+def fit_gbt_multiclass(Xb, y, w, n_estimators: int, max_depth: int,
+                       n_bins: int, n_classes: int, learning_rate,
+                       reg_lambda, min_child_weight: float = 1.0,
+                       active_depth=None, gamma=0.0, alpha=0.0,
+                       subsample=1.0, colsample=1.0, seed=0):
+    """Softmax boosting: K one-vs-rest trees per round grown from the
+    multinomial gradients (the reference's XGBoost multi:softprob —
+    OpXGBoostClassifier.scala:47 supports multiclass; the r1 facade was
+    binary-only). Returns (trees with (T, K, ...) leaves, (n, K) margin)."""
+    n, d = Xb.shape
+    Y = jax.nn.one_hot(y.astype(jnp.int32), n_classes)
+
+    def round_(margin, key):
+        k1, k2 = jax.random.split(key)
+        rows = (jax.random.uniform(k1, (n,)) < subsample).astype(jnp.float32)
+        fmask = jax.random.uniform(k2, (d,)) < colsample
+        p = jax.nn.softmax(margin, axis=1)
+        G = (p - Y) * w[:, None]
+        Hs = jnp.maximum(p * (1.0 - p), 1e-6) * w[:, None]
+
+        def per_class(g, h):
+            return grow_tree(Xb, (-g * rows)[:, None], h * rows, max_depth,
+                             n_bins, reg_lambda=reg_lambda,
+                             min_child_weight=min_child_weight,
+                             min_gain=gamma, feature_mask=fmask,
+                             active_depth=active_depth, alpha=alpha)
+
+        trees_k = jax.vmap(per_class, in_axes=(1, 1))(G, Hs)  # (K, ...)
+        upd = jax.vmap(lambda t: predict_tree(t, Xb)[:, 0])(trees_k)  # (K, n)
+        return margin + learning_rate * upd.T, trees_k
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_estimators)
+    base = jnp.zeros((n, n_classes), jnp.float32)
+    margin, trees = jax.lax.scan(round_, base, keys)
+    return trees, margin
+
+
+@partial(jax.jit, static_argnames=())
+def predict_gbt_multiclass_margin(trees: Dict, Xb: jnp.ndarray,
+                                  learning_rate) -> jnp.ndarray:
+    """(n, K) margin from (T, K, ...) stacked round trees."""
+    per_round = jax.vmap(         # over rounds
+        jax.vmap(lambda t: predict_tree(t, Xb)[:, 0]))(trees)  # (T, K, n)
+    return learning_rate * per_round.sum(axis=0).T
+
+
+def gbt_multiclass_pred_from_margin(margin: jnp.ndarray) -> Dict:
+    probs = jax.nn.softmax(margin, axis=1)
+    return {"prediction": jnp.argmax(probs, 1).astype(jnp.float32),
+            "rawPrediction": margin, "probability": probs}
 
 
 @partial(jax.jit, static_argnames=())
@@ -270,8 +337,9 @@ class _TreeModelBase(PredictionModel):
         self.trees = {k: np.asarray(v) for k, v in trees.items()}
 
     def get_params(self):
-        return {"edges": self.edges.tolist(),
-                "trees": {k: v.tolist() for k, v in self.trees.items()}}
+        # ndarrays straight through: serialization offloads them to npz —
+        # .tolist() would round-trip megabytes of leaves as PyObjects
+        return {"edges": self.edges, "trees": dict(self.trees)}
 
     def _binned(self, X):
         return bin_features(jnp.asarray(X), jnp.asarray(self.edges))
@@ -314,6 +382,16 @@ class GBTRegressionModel(GBTClassificationModel):
         margin = predict_gbt_margin(self._tree_pytree(), self._binned(X),
                                     jnp.float32(self.learning_rate))
         return gbt_pred_from_margin(margin, "squared")
+
+
+class GBTMulticlassModel(GBTClassificationModel):
+    """Softmax forest: trees stacked (rounds, classes, ...)."""
+
+    def predict_arrays(self, X):
+        margin = predict_gbt_multiclass_margin(
+            self._tree_pytree(), self._binned(X),
+            jnp.float32(self.learning_rate))
+        return gbt_multiclass_pred_from_margin(margin)
 
 
 class _TreeEstimatorBase(PredictorEstimator):
@@ -414,32 +492,63 @@ class OpDecisionTreeRegressor(OpRandomForestRegressor):
 
 
 class OpGBTClassifier(_TreeEstimatorBase):
-    """Binary-only (Spark GBTClassifier parity); XGBoost-style 2nd order."""
+    """Gradient-boosted classifier, XGBoost-style 2nd order: binary via
+    sigmoid margin, multiclass via softmax boosting (K trees/round)."""
 
     def __init__(self, n_estimators: int = 20, max_depth: int = 3,
                  learning_rate: float = 0.1, reg_lambda: float = 1.0,
                  max_bins: int = DEFAULT_MAX_BINS, min_child_weight: float = 1.0,
-                 uid: Optional[str] = None):
+                 gamma: float = 0.0, alpha: float = 0.0,
+                 subsample: float = 1.0, colsample_bytree: float = 1.0,
+                 n_classes: Optional[int] = None, uid: Optional[str] = None):
         super().__init__(uid=uid, n_estimators=n_estimators, max_depth=max_depth,
                          learning_rate=learning_rate, reg_lambda=reg_lambda,
-                         max_bins=max_bins, min_child_weight=min_child_weight)
+                         max_bins=max_bins, min_child_weight=min_child_weight,
+                         gamma=gamma, alpha=alpha, subsample=subsample,
+                         colsample_bytree=colsample_bytree, n_classes=n_classes)
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.learning_rate = learning_rate
         self.reg_lambda = reg_lambda
         self.max_bins = max_bins
         self.min_child_weight = min_child_weight
+        self.gamma = gamma
+        self.alpha = alpha
+        self.subsample = subsample
+        self.colsample_bytree = colsample_bytree
+        self.n_classes = n_classes
 
     _objective = "logistic"
     _model_cls = GBTClassificationModel
 
     def fit_arrays(self, X, y, w, ctx: FitContext):
         edges, Xb = self._edges_binned(X, ctx)
+        seed = ctx.seed if ctx is not None else 0
+        if self._objective == "logistic":
+            k = self.n_classes or infer_n_classes(np.asarray(y))
+        else:
+            k = 2
+        if self._objective == "logistic" and k > 2:
+            trees, _ = fit_gbt_multiclass(
+                Xb, y, w, self.n_estimators, self.max_depth, self.max_bins,
+                k, jnp.float32(self.learning_rate),
+                jnp.float32(self.reg_lambda), self.min_child_weight,
+                gamma=jnp.float32(self.gamma), alpha=jnp.float32(self.alpha),
+                subsample=jnp.float32(self.subsample),
+                colsample=jnp.float32(self.colsample_bytree), seed=seed)
+            return GBTMulticlassModel(
+                edges, {k2: np.asarray(v) for k2, v in trees.items()},
+                self.learning_rate)
         trees, _ = fit_gbt(Xb, y, w, self.n_estimators, self.max_depth,
                            self.max_bins, jnp.float32(self.learning_rate),
                            jnp.float32(self.reg_lambda), self._objective,
-                           self.min_child_weight)
-        return self._model_cls(edges, {k: np.asarray(v) for k, v in trees.items()},
+                           self.min_child_weight,
+                           gamma=jnp.float32(self.gamma),
+                           alpha=jnp.float32(self.alpha),
+                           subsample=jnp.float32(self.subsample),
+                           colsample=jnp.float32(self.colsample_bytree),
+                           seed=seed)
+        return self._model_cls(edges, {k2: np.asarray(v) for k2, v in trees.items()},
                                self.learning_rate)
 
 
@@ -449,18 +558,26 @@ class OpGBTRegressor(OpGBTClassifier):
 
 
 class OpXGBoostClassifier(OpGBTClassifier):
-    """XGBoost-parameter facade (OpXGBoostClassifier.scala): the in-tree GBT
-    already implements the XGBoost histogram + second-order algorithm; Rabit
-    allreduce becomes a psum over the sharded batch axis."""
+    """XGBoost parameter surface (OpXGBoostClassifier.scala:47,
+    XGBoostParams.scala:55-69): eta / gamma / alpha / lambda / subsample /
+    colsample_bytree / min_child_weight, binary AND multiclass objectives.
+    The in-tree GBT implements the XGBoost histogram + second-order
+    algorithm natively; Rabit allreduce becomes a psum over the sharded
+    batch axis."""
 
     def __init__(self, n_estimators: int = 50, max_depth: int = 6,
                  eta: float = 0.3, reg_lambda: float = 1.0,
                  max_bins: int = DEFAULT_MAX_BINS,
-                 min_child_weight: float = 1.0, uid: Optional[str] = None):
+                 min_child_weight: float = 1.0, gamma: float = 0.0,
+                 alpha: float = 0.0, subsample: float = 1.0,
+                 colsample_bytree: float = 1.0,
+                 n_classes: Optional[int] = None, uid: Optional[str] = None):
         super().__init__(n_estimators=n_estimators, max_depth=max_depth,
                          learning_rate=eta, reg_lambda=reg_lambda,
                          max_bins=max_bins, min_child_weight=min_child_weight,
-                         uid=uid)
+                         gamma=gamma, alpha=alpha, subsample=subsample,
+                         colsample_bytree=colsample_bytree,
+                         n_classes=n_classes, uid=uid)
         self.params["eta"] = eta
         self.params.pop("learning_rate", None)
 

@@ -1,0 +1,68 @@
+"""Lazy cc build + ctypes binding for the native host-encode kernels."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "murmur3.c")
+
+
+def _build(so_path: str) -> bool:
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            res = subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", so_path],
+                capture_output=True, timeout=120)
+            if res.returncode == 0:
+                return True
+            log.debug("%s failed: %s", cc, res.stderr.decode()[:500])
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def get_murmur3() -> Optional[ctypes.CDLL]:
+    """The compiled kernel library, or None (callers fall back to python)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so_path = os.path.join(os.path.dirname(__file__), "_murmur3.so")
+        try:
+            if not os.path.exists(so_path) or \
+                    os.path.getmtime(so_path) < os.path.getmtime(_SRC):
+                if not _build(so_path):
+                    return None
+            lib = ctypes.CDLL(so_path)
+            for name, argtypes in (
+                ("murmur3_buckets_i32",
+                 [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                  ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p]),
+                ("murmur3_buckets_i64",
+                 [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                  ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p]),
+                ("murmur3_hash_counts_i32",
+                 [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                  ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32,
+                  ctypes.c_void_p]),
+            ):
+                fn = getattr(lib, name)
+                fn.argtypes = argtypes
+                fn.restype = None
+            _lib = lib
+        except Exception:
+            log.exception("native murmur3 unavailable; using python path")
+            _lib = None
+        return _lib

@@ -174,6 +174,41 @@ class TextTokenizer(HostTransformer):
 # Hashing vectorizer (OPCollectionHashingVectorizer)
 # ---------------------------------------------------------------------------
 
+def _native_hash_counts(flat, rows_np: np.ndarray, hasher: TokenHasher,
+                        out: np.ndarray) -> bool:
+    """Fused C kernel over the arrow StringArray's (offsets, data) buffers
+    (native/murmur3.c) — zero per-token Python objects. Returns False when
+    the native library or a flat buffer layout is unavailable."""
+    import ctypes
+
+    from transmogrifai_tpu.native import get_murmur3
+    lib = get_murmur3()
+    if lib is None:
+        return False
+    if flat.null_count or flat.offset != 0:
+        flat = flat.combine_chunks() if hasattr(flat, "combine_chunks") else flat
+        if flat.null_count or flat.offset != 0:
+            return False
+    bufs = flat.buffers()
+    if len(bufs) < 3 or bufs[2] is None:
+        return False
+    import pyarrow as pa
+    offsets_buf, data_buf = bufs[1], bufs[2]
+    rows = np.ascontiguousarray(rows_np, dtype=np.int64)
+    fn = (lib.murmur3_hash_counts_i32
+          if pa.types.is_string(flat.type) else None)
+    if fn is None:
+        return False
+    fn(ctypes.c_void_p(data_buf.address),
+       ctypes.c_void_p(offsets_buf.address),
+       rows.ctypes.data_as(ctypes.c_void_p),
+       ctypes.c_int64(len(flat)),
+       ctypes.c_uint32(hasher.seed & 0xFFFFFFFF),
+       ctypes.c_uint32(hasher.num_features),
+       out.ctypes.data_as(ctypes.c_void_p))
+    return True
+
+
 def _hash_counts(values, hasher: TokenHasher, binary: bool,
                  pre_tokenized: bool) -> np.ndarray:
     """Vectorized hashed token counts (VERDICT r1 weak#5): Arrow C++ utf8
@@ -189,13 +224,16 @@ def _hash_counts(values, hasher: TokenHasher, binary: bool,
             rows_np, flat = _flat_tokens_arrow(values)
             if len(rows_np) == 0:
                 return out
-            d = flat.dictionary_encode()
-            uniq = d.dictionary.to_pylist()
-            idx = np.asarray(d.indices.to_numpy(zero_copy_only=False),
-                             dtype=np.int64)
-            buckets_u = np.fromiter((hasher(t) for t in uniq), np.int64,
-                                    len(uniq))
-            np.add.at(out, (rows_np, buckets_u[idx]), 1.0)
+            if _native_hash_counts(flat, rows_np, hasher, out):
+                pass  # fused C kernel: hash + scatter straight off arrow
+            else:
+                d = flat.dictionary_encode()
+                uniq = d.dictionary.to_pylist()
+                idx = np.asarray(d.indices.to_numpy(zero_copy_only=False),
+                                 dtype=np.int64)
+                buckets_u = np.fromiter((hasher(t) for t in uniq), np.int64,
+                                        len(uniq))
+                np.add.at(out, (rows_np, buckets_u[idx]), 1.0)
             if binary:
                 np.minimum(out, 1.0, out=out)
             return out

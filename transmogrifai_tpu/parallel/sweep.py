@@ -53,8 +53,10 @@ from transmogrifai_tpu.models.trees import (
     OpDecisionTreeClassifier, OpDecisionTreeRegressor, OpGBTClassifier,
     OpGBTRegressor, OpRandomForestClassifier, OpRandomForestRegressor,
     OpXGBoostClassifier, OpXGBoostRegressor,
-    bin_features, fit_forest, fit_gbt, forest_classification_pred,
-    forest_regression_pred, gbt_pred_from_margin, quantile_bin_edges)
+    bin_features, fit_forest, fit_gbt, fit_gbt_multiclass,
+    forest_classification_pred, forest_regression_pred,
+    gbt_multiclass_pred_from_margin, gbt_pred_from_margin,
+    quantile_bin_edges)
 
 log = logging.getLogger(__name__)
 
@@ -350,6 +352,11 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
 def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     xb_by_bins = _binned_cache(est, grids, X, ctx)
     objective = est._objective
+    n_classes = 2
+    if objective == "logistic":
+        n_classes = getattr(est, "n_classes", None) or \
+            infer_n_classes(np.asarray(y))
+    seed = ctx.seed if ctx is not None else 0
 
     def lr_of(grid) -> float:
         v = grid.get("eta", grid.get("learning_rate"))
@@ -363,11 +370,19 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         pad_depth = _pad_depth_of(est, grids, idxs)
 
         def fit_predict(d, w):
+            common = dict(min_child_weight=d["mcw"], active_depth=d["depth"],
+                          gamma=d["gamma"], alpha=d["alpha"],
+                          subsample=d["subsample"], colsample=d["colsample"],
+                          seed=seed)
+            if objective == "logistic" and n_classes > 2:
+                _, margin = fit_gbt_multiclass(
+                    Xb, y, w, n_estimators, pad_depth, max_bins, n_classes,
+                    d["lr"], d["lam"], **common)
+                return gbt_multiclass_pred_from_margin(margin)
             # the scan carry is the final training-matrix margin — no
             # post-fit forest re-walk needed
             _, margin = fit_gbt(Xb, y, w, n_estimators, pad_depth, max_bins,
-                                d["lr"], d["lam"], objective, d["mcw"],
-                                active_depth=d["depth"])
+                                d["lr"], d["lam"], objective, **common)
             return gbt_pred_from_margin(margin, objective)
         return fit_predict
 
@@ -379,7 +394,12 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
             "depth": int(_grid_param(est, g, "max_depth")),
             "lr": lr_of(g),
             "lam": float(_grid_param(est, g, "reg_lambda")),
-            "mcw": float(_grid_param(est, g, "min_child_weight"))},
+            "mcw": float(_grid_param(est, g, "min_child_weight")),
+            "gamma": float(_grid_param(est, g, "gamma") or 0.0),
+            "alpha": float(_grid_param(est, g, "alpha") or 0.0),
+            "subsample": float(_grid_param(est, g, "subsample") or 1.0),
+            "colsample": float(
+                _grid_param(est, g, "colsample_bytree") or 1.0)},
         build=build,
         grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6)
 

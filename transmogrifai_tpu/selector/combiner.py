@@ -80,11 +80,13 @@ class SelectedModelCombiner(Estimator):
             raise ValueError(
                 "SelectedModelCombiner inputs must be ModelSelector outputs "
                 f"(no summary on {feature.name!r})")
-        metric = summary.holdout_metrics.get(summary.metric_name) or \
-            summary.train_metrics.get(summary.metric_name)
+        metric = summary.holdout_metrics.get(summary.metric_name)
+        if metric is None:  # a real 0.0 must NOT fall through
+            metric = summary.train_metrics.get(summary.metric_name)
         if metric is None:
+            sign = 1.0 if getattr(summary, "larger_is_better", True) else -1.0
             best = max(summary.validation_results,
-                       key=lambda r: r.mean_metric)
+                       key=lambda r: sign * r.mean_metric)
             metric = best.mean_metric
         return float(metric), summary
 
@@ -98,7 +100,12 @@ class SelectedModelCombiner(Estimator):
             w1, w2 = (1.0, 0.0) if first_wins else (0.0, 1.0)
         elif self.strategy == WEIGHTED:
             total = m1 + m2
-            w1, w2 = (m1 / total, m2 / total) if total else (0.5, 0.5)
+            if not total:
+                w1, w2 = 0.5, 0.5
+            elif larger_better:
+                w1, w2 = m1 / total, m2 / total
+            else:  # smaller-is-better (RMSE): invert so the better model
+                w1, w2 = m2 / total, m1 / total  # gets the larger weight
         else:
             w1, w2 = 0.5, 0.5
         model = SelectedCombinerModel(

@@ -175,13 +175,15 @@ class WorkflowRunner:
                 and self.prediction_feature is not None:
             with profile.phase(profiling.EVALUATION):
                 try:
-                    metrics["evaluation"] = self._eval_scores(
-                        model, ds, scores)
+                    label_col = self.label_feature.origin_stage.materialize(ds)
                 except KeyError:
                     # scoring data legitimately has no label column —
                     # scores are still written, evaluation just skips
                     log.info("score: label column absent, skipping "
                              "evaluation")
+                else:
+                    metrics["evaluation"] = self._eval_scores(
+                        model, ds, scores, label_col)
         return RunResult("score", metrics=metrics, write_location=loc)
 
     def _streaming_score(self, params: OpParams,
@@ -259,10 +261,11 @@ class WorkflowRunner:
     # ------------------------------------------------------------------ #
 
     def _eval_scores(self, model: WorkflowModel, ds: Dataset,
-                     scores: Dict[str, Any]) -> Dict[str, Any]:
+                     scores: Dict[str, Any], label_col=None) -> Dict[str, Any]:
         from transmogrifai_tpu import types as T
         from transmogrifai_tpu.data.columns import Column
-        label_col = self.label_feature.origin_stage.materialize(ds)
+        if label_col is None:
+            label_col = self.label_feature.origin_stage.materialize(ds)
         # look the prediction up on the LOADED model's graph: derived
         # feature names embed process-local uid counters, so the rebuilt
         # app graph's name need not match the saved one
