@@ -1,0 +1,191 @@
+"""Avro container-file codec + reader tests (AvroReaders.scala parity)."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.data.avro import (
+    _Names, _decoder, _encoder, _read_long, _write_long, avro_ftype,
+    dataset_avro_schema, read_container, write_container)
+from transmogrifai_tpu.readers import DataReaders
+
+
+def test_zigzag_varint_roundtrip():
+    for n in (0, 1, -1, 63, -64, 64, 1 << 20, -(1 << 20), (1 << 62),
+              -(1 << 62)):
+        out = io.BytesIO()
+        _write_long(out, n)
+        assert _read_long(io.BytesIO(out.getvalue())) == n
+
+
+def test_known_zigzag_bytes():
+    # spec examples: 0→00, -1→01, 1→02, -2→03, 2→04
+    for n, b in ((0, b"\x00"), (-1, b"\x01"), (1, b"\x02"), (-2, b"\x03"),
+                 (2, b"\x04"), (-64, b"\x7f"), (64, b"\x80\x01")):
+        out = io.BytesIO()
+        _write_long(out, n)
+        assert out.getvalue() == b
+
+
+SCHEMA = {
+    "type": "record", "name": "Passenger",
+    "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "name", "type": ["null", "string"], "default": None},
+        {"name": "age", "type": ["null", "double"], "default": None},
+        {"name": "survived", "type": "boolean"},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "scores", "type": {"type": "map", "values": "double"}},
+        {"name": "klass", "type": {"type": "enum", "name": "K",
+                                   "symbols": ["a", "b", "c"]}},
+    ],
+}
+
+RECORDS = [
+    {"id": 1, "name": "Ann", "age": 31.5, "survived": True,
+     "tags": ["x", "y"], "scores": {"m": 1.0}, "klass": "a"},
+    {"id": 2, "name": None, "age": None, "survived": False,
+     "tags": [], "scores": {}, "klass": "c"},
+    {"id": 3, "name": "Bob", "age": 4.0, "survived": True,
+     "tags": ["z"], "scores": {"m": -2.5, "n": 0.0}, "klass": "b"},
+]
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_container_roundtrip(tmp_path, codec):
+    path = str(tmp_path / f"p_{codec}.avro")
+    write_container(path, SCHEMA, RECORDS, codec=codec)
+    schema, records = read_container(path)
+    assert schema == SCHEMA
+    assert records == RECORDS
+
+
+def test_container_multiblock(tmp_path):
+    path = str(tmp_path / "blocks.avro")
+    many = [{"id": i, "name": f"r{i}", "age": float(i), "survived": i % 2 == 0,
+             "tags": [], "scores": {}, "klass": "a"} for i in range(1000)]
+    write_container(path, SCHEMA, many, block_records=64)
+    _, records = read_container(path)
+    assert records == many
+
+
+def test_binary_encode_decode_all_types():
+    sch = {"type": "record", "name": "R", "fields": [
+        {"name": "i", "type": "int"},
+        {"name": "f", "type": "float"},
+        {"name": "by", "type": "bytes"},
+        {"name": "fx", "type": {"type": "fixed", "name": "F", "size": 3}},
+        {"name": "u", "type": ["null", "long", "string"]},
+    ]}
+    rec = {"i": -7, "f": 2.5, "by": b"\x00\x01", "fx": b"abc", "u": "s"}
+    names = _Names()
+    enc = _encoder(sch, names)
+    out = io.BytesIO()
+    enc(out, rec)
+    dec = _decoder(sch, _Names())
+    got = dec(io.BytesIO(out.getvalue()))
+    assert got == rec
+
+
+def test_avro_ftype_mapping():
+    names = _Names()
+    assert avro_ftype("long", names) is T.Integral
+    assert avro_ftype("double", names) is T.Real
+    assert avro_ftype("boolean", names) is T.Binary
+    assert avro_ftype("string", names) is T.Text
+    assert avro_ftype(["null", "string"], names) is T.Text
+    assert avro_ftype({"type": "array", "items": "string"}, names) is T.TextList
+    assert avro_ftype({"type": "array", "items": "double"}, names) is T.Geolocation
+    assert avro_ftype({"type": "map", "values": "double"}, names) is T.TextMap
+    assert avro_ftype({"type": "enum", "name": "E", "symbols": ["x"]},
+                      names) is T.PickList
+    assert avro_ftype({"type": "long", "logicalType": "timestamp-millis"},
+                      names) is T.DateTime
+
+
+def test_dataset_from_avro(tmp_path):
+    path = str(tmp_path / "ds.avro")
+    write_container(path, SCHEMA, RECORDS)
+    ds = Dataset.from_avro(path)
+    assert ds.n_rows == 3
+    assert ds.schema["id"] is T.Integral
+    assert ds.schema["name"] is T.Text
+    assert ds.schema["age"] is T.Real
+    assert ds.schema["survived"] is T.Binary
+    assert ds.schema["tags"] is T.TextList
+    assert ds.schema["klass"] is T.PickList
+    age = ds.column("age")
+    assert age[0] == 31.5 and np.isnan(age[1])
+    assert list(ds.column("name")) == ["Ann", None, "Bob"]
+
+
+def test_dataset_avro_roundtrip(tmp_path):
+    ds = Dataset.from_rows(
+        [{"x": 1.5, "n": 3, "s": "a", "b": True, "lst": ["p", "q"],
+          "mp": {"k": 1.0}},
+         {"x": None, "n": None, "s": None, "b": None, "lst": None,
+          "mp": None}],
+        schema={"x": T.Real, "n": T.Integral, "s": T.Text, "b": T.Binary,
+                "lst": T.TextList, "mp": T.RealMap})
+    path = str(tmp_path / "rt.avro")
+    ds.to_avro(path)
+    back = Dataset.from_avro(path, schema=dict(ds.schema))
+    assert back.n_rows == 2
+    assert back.column("x")[0] == 1.5 and np.isnan(back.column("x")[1])
+    assert back.column("n")[0] == 3
+    assert back.column("s")[0] == "a" and back.column("s")[1] is None
+    assert back.column("lst")[0] == ["p", "q"]
+    assert back.column("mp")[0] == {"k": 1.0}
+
+
+def test_avro_reader_and_stream(tmp_path):
+    path = str(tmp_path / "r.avro")
+    write_container(path, SCHEMA, RECORDS)
+    reader = DataReaders.avro(path, key_column="id")
+    ds = reader.read()
+    assert ds.n_rows == 3
+    from transmogrifai_tpu.readers.readers import KEY_COLUMN
+    assert list(ds.column(KEY_COLUMN)) == ["1", "2", "3"]
+
+    sr = DataReaders.stream(avro_path=path, batch_size=2)
+    batches = list(sr.stream())
+    assert [b.n_rows for b in batches] == [2, 1]
+    assert batches[0].schema["age"] is T.Real
+
+
+def test_workflow_trains_from_avro(tmp_path):
+    """End-to-end: avro file → reader → transmogrify → LR train → score."""
+    rng = np.random.default_rng(0)
+    n = 120
+    x = rng.normal(size=n)
+    recs = [{"x": float(x[i]),
+             "c": ["u", "v"][int(rng.integers(2))],
+             "y": float(x[i] + rng.normal(0, 0.3) > 0)} for i in range(n)]
+    sch = {"type": "record", "name": "Row", "fields": [
+        {"name": "x", "type": ["null", "double"], "default": None},
+        {"name": "c", "type": ["null", "string"], "default": None},
+        {"name": "y", "type": "double"},
+    ]}
+    path = str(tmp_path / "train.avro")
+    write_container(path, sch, recs)
+
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.workflow import Workflow
+
+    ds = Dataset.from_avro(path, schema={"c": T.PickList, "y": T.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = transmogrify(preds)
+    pf = OpLogisticRegression(max_iter=15).set_input(label, vec).get_output()
+    model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
+    out = model.score(ds)
+    pred = np.asarray(out[pf.name].data["prediction"])
+    assert pred.shape == (n,)
+    acc = float((pred == np.array([r["y"] for r in recs])).mean())
+    assert acc > 0.8

@@ -272,6 +272,18 @@ class Dataset:
         import pyarrow as pa
         return Dataset.from_arrow(pa.Table.from_pandas(df), schema=schema)
 
+    @staticmethod
+    def from_avro(path: str,
+                  schema: Optional[Mapping[str, type]] = None) -> "Dataset":
+        """Read an Avro Object Container File (AvroReaders.scala analogue);
+        FeatureTypes inferred from the writer schema unless overridden."""
+        from transmogrifai_tpu.data.avro import dataset_from_avro
+        return dataset_from_avro(path, schema=schema)
+
+    def to_avro(self, path: str, codec: str = "deflate") -> None:
+        from transmogrifai_tpu.data.avro import dataset_to_avro
+        dataset_to_avro(self, path, codec=codec)
+
     def to_parquet(self, path: str) -> None:
         import pyarrow as pa
         import pyarrow.parquet as pq

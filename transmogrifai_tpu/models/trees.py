@@ -66,16 +66,37 @@ def bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
 # the level-wise learner                                                      #
 # --------------------------------------------------------------------------- #
 
-def _histograms(Xb, node_idx, G, H, n_nodes: int, n_bins: int):
-    """hist_G: (nodes, d, bins, m); hist_H: (nodes, d, bins)."""
-    n, d = Xb.shape
+def bins_onehot(Xb: jnp.ndarray, n_bins: int) -> jnp.ndarray:
+    """(n, d, bins) bf16 one-hot of the binned matrix — the histogram
+    reduction operand, built ONCE per training matrix and reused across
+    every level, tree, round, fold, and grid config. 0/1 is exact in
+    bf16, so histogram counts lose no precision while the matmuls run at
+    full MXU rate."""
+    return jax.nn.one_hot(Xb, n_bins, dtype=jnp.bfloat16)
+
+
+def _histograms(B, node_idx, G, H, n_nodes: int):
+    """hist_G: (m, nodes, d, bins); hist_H: (nodes, d, bins).
+
+    One-hot MATMUL histograms: hist[node, f, b] = Σ_r A[r,node]·B[r,f,b]·v[r]
+    computed as (nodes, n) @ (n, d·bins) on the MXU — where the FLOPs live
+    on TPU. A scatter-add formulation is 20-50× slower here (TPU scatters
+    serialize) and its (n, d, m) update tensor tile-pads the tiny class
+    axis to 128 lanes (the r2 152 GB OOM). Contraction over the row axis
+    also means a mesh-sharded batch reduces via an XLA-inserted psum —
+    the Rabit-allreduce analogue (SURVEY.md §2.9)."""
+    n, d, nb = B.shape
     m = G.shape[1]
-    hg = jnp.zeros((n_nodes, d, n_bins, m), G.dtype)
-    hh = jnp.zeros((n_nodes, d, n_bins), H.dtype)
-    feat = jnp.arange(d, dtype=jnp.int32)[None, :]
-    node = node_idx[:, None]
-    hg = hg.at[node, feat, Xb].add(G[:, None, :])
-    hh = hh.at[node, feat, Xb].add(H[:, None])
+    A = jax.nn.one_hot(node_idx, n_nodes, dtype=jnp.bfloat16)  # (n, nodes)
+    Bf = B.reshape(n, d * nb)
+
+    def red(vec):  # (n,) weights → (nodes, d, bins) f32
+        Ag = A * vec[:, None].astype(jnp.bfloat16)
+        out = jnp.matmul(Ag.T, Bf, preferred_element_type=jnp.float32)
+        return out.reshape(n_nodes, d, nb)
+
+    hh = red(H)
+    hg = jnp.stack([red(G[:, c]) for c in range(m)])
     return hg, hh
 
 
@@ -83,7 +104,8 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               max_depth: int, n_bins: int, reg_lambda: float = 1.0,
               min_child_weight: float = 1.0, min_gain: float = 0.0,
               feature_mask: Optional[jnp.ndarray] = None,
-              active_depth=None, alpha: float = 0.0) -> Dict:
+              active_depth=None, alpha: float = 0.0,
+              B: Optional[jnp.ndarray] = None) -> Dict:
     """Grow one fixed-depth tree. Returns dense arrays:
 
     {"feat": (depth, 2^depth) int32, "bin": (depth, 2^depth) int32,
@@ -102,21 +124,23 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     node_idx = jnp.zeros(n, dtype=jnp.int32)
     feats = jnp.zeros((max_depth, max_nodes), jnp.int32)
     bins = jnp.full((max_depth, max_nodes), n_bins, jnp.int32)  # n_bins = "no split"
+    if B is None:
+        B = bins_onehot(Xb, n_bins)
 
     for level in range(max_depth):
         n_nodes = 2 ** level
-        hg, hh = _histograms(Xb, node_idx, G, H, n_nodes, n_bins)
-        cg = jnp.cumsum(hg, axis=2)           # left sums at split-bin b
-        ch = jnp.cumsum(hh, axis=2)
-        tg = cg[:, :, -1:, :]
-        th = ch[:, :, -1:]
-        score = lambda g, h: (g ** 2).sum(-1) / (h + reg_lambda)  # noqa: E731
+        hg, hh = _histograms(B, node_idx, G, H, n_nodes)
+        cg = jnp.cumsum(hg, axis=-1)          # left sums at split-bin b
+        ch = jnp.cumsum(hh, axis=-1)          # (nodes, d, bins)
+        tg = cg[..., -1:]
+        th = ch[..., -1:]
+        score = lambda g, h: (g ** 2).sum(0) / (h + reg_lambda)  # noqa: E731
         gain = score(cg, ch) + score(tg - cg, th - ch) - score(tg, th)
         valid = (ch >= min_child_weight) & ((th - ch) >= min_child_weight)
         gain = jnp.where(valid, gain, -jnp.inf)
         if feature_mask is not None:
             gain = jnp.where(feature_mask[None, :, None], gain, -jnp.inf)
-        flat = gain.reshape(n_nodes, -1)
+        flat = gain.reshape(n_nodes, -1)      # (nodes, d*bins)
         best = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
         bf = (best // n_bins).astype(jnp.int32)
@@ -158,16 +182,20 @@ def predict_tree(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
 # Random forest / decision tree                                               #
 # --------------------------------------------------------------------------- #
 
+_TREE_CHUNK_BUDGET = 1 << 26  # live per-tree working-set elements (bf16)
+
+
 @partial(jax.jit, static_argnames=("n_trees", "max_depth", "n_bins",
                                    "n_outputs", "subsample_features",
-                                   "bootstrap"))
+                                   "bootstrap", "tree_budget_divisor"))
 def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
                n_outputs: int, seed, subsample_features: bool = True,
                min_child_weight: float = 1.0, active_depth=None,
-               bootstrap: bool = True):
+               bootstrap: bool = True, tree_budget_divisor: int = 1):
     n, d = Xb.shape
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
     n_sub = max(int(np.sqrt(d)), 1) if subsample_features else d
+    B = bins_onehot(Xb, n_bins)  # shared across all trees
 
     def one_tree(key):
         k1, k2 = jax.random.split(key)
@@ -183,9 +211,30 @@ def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
             fmask = jnp.ones((d,), bool)
         return grow_tree(Xb, Y * boot[:, None], boot, max_depth, n_bins,
                          reg_lambda=1e-6, min_child_weight=min_child_weight,
-                         feature_mask=fmask, active_depth=active_depth)
+                         feature_mask=fmask, active_depth=active_depth, B=B)
 
-    return jax.vmap(one_tree)(keys)
+    # Bound simultaneous per-tree working set: each live instance holds the
+    # (n, nodes) one-hot routing matrix at the deepest level plus O(n·d)
+    # gather state — cap the vmapped width and lax.map over chunks
+    # (sequential, still one compile). Callers that add further batch axes
+    # (the sweep's grid×fold vmaps) shrink the budget via
+    # `tree_budget_divisor` so the product of live axes stays bounded.
+    budget = _TREE_CHUNK_BUDGET // max(int(tree_budget_divisor), 1)
+    per_instance = n * (d + 2 ** min(max_depth, 14))
+    chunk = max(1, min(n_trees, budget // max(per_instance, 1)))
+    if chunk == n_trees:
+        return jax.vmap(one_tree)(keys)
+    # pad the key array to a chunk multiple (extra trees are grown and
+    # sliced off) rather than shrinking to a divisor — a prime n_trees
+    # must not collapse to fully sequential growth
+    n_chunks = -(-n_trees // chunk)
+    pad = n_chunks * chunk - n_trees
+    if pad:
+        keys = jnp.concatenate([keys, keys[:pad]])
+    chunked = keys.reshape(n_chunks, chunk, *keys.shape[1:])
+    trees = jax.lax.map(jax.vmap(one_tree), chunked)
+    return jax.tree.map(
+        lambda a: a.reshape(n_chunks * chunk, *a.shape[2:])[:n_trees], trees)
 
 
 @partial(jax.jit, static_argnames=())
@@ -212,6 +261,8 @@ def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
     row sampling, `colsample` = per-tree feature sampling."""
     n, d = Xb.shape
 
+    B = bins_onehot(Xb, n_bins)  # shared across all boosting rounds
+
     def grads(margin):
         if objective == "logistic":
             p = jax.nn.sigmoid(margin)
@@ -228,7 +279,7 @@ def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
                          n_bins, reg_lambda=reg_lambda,
                          min_child_weight=min_child_weight,
                          min_gain=gamma, feature_mask=fmask,
-                         active_depth=active_depth, alpha=alpha)
+                         active_depth=active_depth, alpha=alpha, B=B)
         margin = margin + learning_rate * predict_tree(tree, Xb)[:, 0]
         return margin, tree
 
@@ -251,6 +302,7 @@ def fit_gbt_multiclass(Xb, y, w, n_estimators: int, max_depth: int,
     binary-only). Returns (trees with (T, K, ...) leaves, (n, K) margin)."""
     n, d = Xb.shape
     Y = jax.nn.one_hot(y.astype(jnp.int32), n_classes)
+    B = bins_onehot(Xb, n_bins)  # shared across rounds and classes
 
     def round_(margin, key):
         k1, k2 = jax.random.split(key)
@@ -265,7 +317,7 @@ def fit_gbt_multiclass(Xb, y, w, n_estimators: int, max_depth: int,
                              n_bins, reg_lambda=reg_lambda,
                              min_child_weight=min_child_weight,
                              min_gain=gamma, feature_mask=fmask,
-                             active_depth=active_depth, alpha=alpha)
+                             active_depth=active_depth, alpha=alpha, B=B)
 
         trees_k = jax.vmap(per_class, in_axes=(1, 1))(G, Hs)  # (K, ...)
         upd = jax.vmap(lambda t: predict_tree(t, Xb)[:, 0])(trees_k)  # (K, n)

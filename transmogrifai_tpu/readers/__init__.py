@@ -2,6 +2,7 @@
 
 from transmogrifai_tpu.readers.readers import (
     AggregateDataReader,
+    AvroReader,
     ConditionalDataReader,
     CSVReader,
     DataReaders,
@@ -12,7 +13,7 @@ from transmogrifai_tpu.readers.readers import (
 )
 
 __all__ = [
-    "AggregateDataReader", "ConditionalDataReader", "CSVReader",
+    "AggregateDataReader", "AvroReader", "ConditionalDataReader", "CSVReader",
     "DataReaders", "JoinedDataReader", "Reader", "SimpleReader",
     "StreamingReader",
 ]

@@ -154,6 +154,25 @@ class SimpleReader(Reader):
         return ds
 
 
+def _with_key_column(ds: Dataset, key_column: Optional[str]) -> Dataset:
+    """Stringify a key column into the reserved KEY_COLUMN; integral-typed
+    keys format without the float-storage ".0" suffix."""
+    if not key_column or key_column not in ds.columns \
+            or KEY_COLUMN in ds.columns:
+        return ds
+    ftype = ds.schema.get(key_column)
+    integral = ftype is not None and issubclass(
+        ftype, (T.Integral, T.Date, T.DateTime))
+
+    def fmt(v) -> str:
+        if integral and isinstance(v, float) and not np.isnan(v) \
+                and v == int(v):
+            return str(int(v))
+        return str(v)
+    keys = np.array([fmt(v) for v in ds.column(key_column)], dtype=object)
+    return ds.with_column(KEY_COLUMN, keys, T.ID)
+
+
 class CSVReader(SimpleReader):
     """CSV-file reader (CSVAutoReaders/CSVReaders analogue): schema inferred
     unless given."""
@@ -171,12 +190,23 @@ class CSVReader(SimpleReader):
     def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
         ds = Dataset.from_csv(self.path, schema=self._schema,
                               delimiter=self.delimiter)
-        if self.key_column and self.key_column in ds.columns \
-                and KEY_COLUMN not in ds.columns:
-            keys = np.array([str(v) for v in ds.column(self.key_column)],
-                            dtype=object)
-            ds = ds.with_column(KEY_COLUMN, keys, T.ID)
-        return ds
+        return _with_key_column(ds, self.key_column)
+
+
+class AvroReader(Reader):
+    """Avro container-file reader (AvroReaders.scala analogue): decoded by
+    the in-tree pure-Python container codec (data/avro.py)."""
+
+    def __init__(self, path: str, schema: Optional[Mapping[str, type]] = None,
+                 key_column: Optional[str] = None):
+        self.path = path
+        self._schema = schema
+        self.key_column = key_column
+        self.features = None
+
+    def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
+        ds = Dataset.from_avro(self.path, schema=self._schema)
+        return _with_key_column(ds, self.key_column)
 
 
 class ParquetReader(Reader):
@@ -192,12 +222,7 @@ class ParquetReader(Reader):
 
     def read(self, raw_features: Optional[Sequence] = None) -> Dataset:
         ds = Dataset.from_parquet(self.path, schema=self._schema)
-        if self.key_column and self.key_column in ds.columns \
-                and KEY_COLUMN not in ds.columns:
-            keys = np.array([str(v) for v in ds.column(self.key_column)],
-                            dtype=object)
-            ds = ds.with_column(KEY_COLUMN, keys, T.ID)
-        return ds
+        return _with_key_column(ds, self.key_column)
 
 
 def _group_events(records: Iterable[Mapping[str, Any]],
@@ -487,20 +512,35 @@ class StreamingReader(Reader):
     def __init__(self, records: Optional[Iterable[Mapping[str, Any]]] = None,
                  csv_path: Optional[str] = None,
                  parquet_path: Optional[str] = None, batch_size: int = 1024,
-                 schema: Optional[Mapping[str, type]] = None):
-        sources = sum(x is not None for x in (records, csv_path, parquet_path))
+                 schema: Optional[Mapping[str, type]] = None,
+                 avro_path: Optional[str] = None):
+        sources = sum(x is not None
+                      for x in (records, csv_path, parquet_path, avro_path))
         if sources != 1:
             raise ValueError("StreamingReader: pass exactly one of "
-                             "records/csv_path/parquet_path")
+                             "records/csv_path/parquet_path/avro_path")
         self.records = records
         self.csv_path = csv_path
         self.parquet_path = parquet_path
+        self.avro_path = avro_path
         self.batch_size = int(batch_size)
         self.schema = schema
 
     def _record_iter(self) -> Iterator[Mapping[str, Any]]:
         if self.records is not None:
             yield from self.records
+            return
+        if self.avro_path is not None:
+            from transmogrifai_tpu.data.avro import (
+                _Names, _decoder, avro_ftype, read_container)
+            avsc, recs = read_container(self.avro_path)
+            if self.schema is None and isinstance(avsc, dict) \
+                    and avsc.get("type") == "record":
+                names = _Names()
+                _decoder(avsc, names)
+                self.schema = {f["name"]: avro_ftype(f["type"], names)
+                               for f in avsc["fields"]}
+            yield from recs
             return
         # parse CSV cells with the same typed inference as Dataset.from_csv
         # so the streaming path matches DataReaders.csv on the same file
@@ -562,6 +602,10 @@ class DataReaders:
         return ParquetReader(path, schema=schema, key_column=key_column)
 
     @staticmethod
+    def avro(path, schema=None, key_column=None) -> "AvroReader":
+        return AvroReader(path, schema=schema, key_column=key_column)
+
+    @staticmethod
     def aggregate(records, key_fn, time_fn, cutoff=None,
                   features=None) -> AggregateDataReader:
         return AggregateDataReader(records, key_fn, time_fn, cutoff=cutoff,
@@ -582,7 +626,8 @@ class DataReaders:
 
     @staticmethod
     def stream(records=None, csv_path=None, parquet_path=None,
-               batch_size=1024, schema=None) -> StreamingReader:
+               batch_size=1024, schema=None, avro_path=None) -> StreamingReader:
         return StreamingReader(records=records, csv_path=csv_path,
                                parquet_path=parquet_path,
-                               batch_size=batch_size, schema=schema)
+                               batch_size=batch_size, schema=schema,
+                               avro_path=avro_path)
