@@ -1,0 +1,113 @@
+"""Sweep checkpoint/resume (SURVEY.md §5.4 — long-sweep resumability)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.selector.model_selector as ms_mod
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.models import OpLogisticRegression, OpNaiveBayes
+from transmogrifai_tpu.selector import ModelSelector, DataSplitter
+from transmogrifai_tpu.selector.validators import OpCrossValidation
+from transmogrifai_tpu.stages.base import FitContext
+from transmogrifai_tpu.data.columns import Column
+import transmogrifai_tpu.types as T
+
+
+def _cols(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(0, 0.4, n) > 0).astype(np.float64)
+    label = Column(T.RealNN, {"value": y, "mask": np.ones(n, bool)})
+    vec = Column(T.OPVector, X.astype(np.float32))
+    return label, vec
+
+
+def _selector(tmp, models=None):
+    return ModelSelector(
+        models=models or [
+            (OpLogisticRegression(max_iter=10),
+             [{"reg_param": 0.01}, {"reg_param": 0.1}]),
+            (OpLogisticRegression(max_iter=5), [{"reg_param": 0.05}]),
+        ],
+        validator=OpCrossValidation(n_folds=2),
+        splitter=DataSplitter(reserve_test_fraction=0.2),
+        evaluator=BinaryClassificationEvaluator(),
+        checkpoint_dir=str(tmp))
+
+
+def test_checkpoint_files_written_and_reused(tmp_path, monkeypatch):
+    label, vec = _cols()
+    sel = _selector(tmp_path)
+    m1 = sel.fit_model([label, vec], FitContext(n_rows=200, seed=7))
+    files = sorted(glob.glob(str(tmp_path / "sweep_*.json")))
+    assert len(files) == 2, files
+
+    calls = {"n": 0}
+    real = ms_mod.run_sweep
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ms_mod, "run_sweep", counting)
+    sel2 = _selector(tmp_path)
+    m2 = sel2.fit_model([label, vec], FitContext(n_rows=200, seed=7))
+    assert calls["n"] == 0, "resume should not re-run any family sweep"
+    s1, s2 = m1.summary, m2.summary
+    assert [r.fold_metrics for r in s1.validation_results] == \
+        [r.fold_metrics for r in s2.validation_results]
+    assert s1.best_grid == s2.best_grid
+
+
+def test_partial_resume_runs_only_missing_family(tmp_path, monkeypatch):
+    label, vec = _cols()
+    sel = _selector(tmp_path)
+    sel.fit_model([label, vec], FitContext(n_rows=200, seed=7))
+    files = sorted(glob.glob(str(tmp_path / "sweep_*.json")))
+    os.remove(files[0])  # simulate dying before family 0 persisted
+
+    calls = {"n": 0}
+    real = ms_mod.run_sweep
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ms_mod, "run_sweep", counting)
+    _selector(tmp_path).fit_model([label, vec], FitContext(n_rows=200, seed=7))
+    assert calls["n"] == 1
+
+
+def test_signature_invalidates_on_different_data_or_grids(tmp_path):
+    label, vec = _cols()
+    sel = _selector(tmp_path)
+    sel.fit_model([label, vec], FitContext(n_rows=200, seed=7))
+    n_before = len(glob.glob(str(tmp_path / "sweep_*.json")))
+
+    # different seed → different signature → new checkpoint files
+    sel2 = _selector(tmp_path)
+    sel2.fit_model([label, vec], FitContext(n_rows=200, seed=8))
+    n_after = len(glob.glob(str(tmp_path / "sweep_*.json")))
+    assert n_after == 2 * n_before
+
+    # corrupted checkpoint falls back to re-running, not crashing
+    files = sorted(glob.glob(str(tmp_path / "sweep_*.json")))
+    with open(files[0], "w") as f:
+        f.write("{not json")
+    sel3 = _selector(tmp_path)
+    m3 = sel3.fit_model([label, vec], FitContext(n_rows=200, seed=7))
+    assert np.isfinite(
+        [r.mean_metric for r in m3.summary.validation_results]).all()
+
+
+def test_no_checkpoint_dir_is_default_noop(tmp_path):
+    label, vec = _cols()
+    sel = ModelSelector(
+        models=[(OpLogisticRegression(max_iter=5), [{"reg_param": 0.1}])],
+        validator=OpCrossValidation(n_folds=2),
+        evaluator=BinaryClassificationEvaluator())
+    sel.fit_model([label, vec], FitContext(n_rows=200, seed=7))
+    assert not glob.glob(str(tmp_path / "sweep_*.json"))

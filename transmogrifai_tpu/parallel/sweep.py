@@ -113,18 +113,21 @@ class HostMetricFallback:
         self.evaluator = evaluator
 
 
-def _shard_dyn(dyn: Dict[str, jnp.ndarray], sharding) -> Dict[str, jnp.ndarray]:
+def _shard_dyn(dyn: Dict[str, jnp.ndarray],
+               sharding) -> Tuple[Dict[str, jnp.ndarray], int]:
+    """Place the grid axis on the mesh's sweep axis; PAD a non-divisible
+    group to the next multiple by repeating the last config (padded rows
+    compute real but discarded fits — cheaper than replicating the whole
+    group on every shard). Returns (dyn, original_g)."""
     if sharding is None:
-        return dyn
+        return dyn, next(iter(dyn.values())).shape[0]
     g = next(iter(dyn.values())).shape[0]
     n_shards = sharding.mesh.shape[sharding.spec[0]] if sharding.spec else 1
     if n_shards > 1 and g % n_shards != 0:
-        log.warning(
-            "sweep axis: grid group of %d configs is not divisible by the "
-            "%d-way sweep mesh axis; leaving the grid axis replicated", g,
-            n_shards)
-        return dyn
-    return {k: jax.device_put(v, sharding) for k, v in dyn.items()}
+        pad = n_shards - g % n_shards
+        dyn = {k: jnp.concatenate([v, jnp.repeat(v[-1:], pad, 0)])
+               for k, v in dyn.items()}
+    return {k: jax.device_put(v, sharding) for k, v in dyn.items()}, g
 
 
 def _run_block(one_cfg: Callable, dyn: Dict[str, jnp.ndarray], sharding,
@@ -137,12 +140,13 @@ def _run_block(one_cfg: Callable, dyn: Dict[str, jnp.ndarray], sharding,
     jax output (a (g, k) metric array, or a prediction pytree with leading
     (g, k) axes on the host-metric fallback path).
     """
-    dyn = _shard_dyn(dyn, sharding)
+    dyn, g = _shard_dyn(dyn, sharding)
     if grid_vmap or sharding is not None:
         prog = jax.jit(jax.vmap(one_cfg))
     else:
         prog = jax.jit(lambda d: jax.lax.map(one_cfg, d))
-    return jax.block_until_ready(prog(dyn))
+    out = jax.block_until_ready(prog(dyn))
+    return jax.tree_util.tree_map(lambda a: a[:g], out)  # drop pad rows
 
 
 def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
@@ -375,18 +379,15 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
             return pred_fn(trees, Xb)
         return fit_predict
 
-    # unsharded host dispatch runs one grid×fold per call, so there is no
-    # reason to pad shallow trees to the group's deepest config — group by
-    # depth instead (one compile per distinct depth, no wasted levels).
-    # The sharded path keeps one padded group so the grid axis can shard.
-    depth_key = ((lambda g: (int(_grid_param(est, g, "max_depth")),))
-                 if sharding is None else (lambda g: ()))
+    # one PADDED compile per family group (traced active_depth masks the
+    # unused levels): sweep wall-clock on a fresh process is dominated by
+    # the remote AOT compiles (~15-50s each), not the sub-second padded
+    # executions, so fewer compiles beats depth-exact programs
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
         static_of=lambda g: (int(_grid_param(est, g, "n_trees")),
                              int(_grid_param(est, g, "max_bins")),
-                             bool(_grid_param(est, g, "subsample_features")))
-        + depth_key(g),
+                             bool(_grid_param(est, g, "subsample_features"))),
         dyn_of=lambda g: {
             "depth": int(_grid_param(est, g, "max_depth")),
             "mcw": float(_grid_param(est, g, "min_child_weight"))},
@@ -432,13 +433,10 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
             return gbt_pred_from_margin(margin, objective)
         return fit_predict
 
-    depth_key = ((lambda g: (int(_grid_param(est, g, "max_depth")),))
-                 if sharding is None else (lambda g: ()))
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
         static_of=lambda g: (int(_grid_param(est, g, "n_estimators")),
-                             int(_grid_param(est, g, "max_bins")))
-        + depth_key(g),
+                             int(_grid_param(est, g, "max_bins"))),
         dyn_of=lambda g: {
             "depth": int(_grid_param(est, g, "max_depth")),
             "lr": lr_of(g),

@@ -95,13 +95,20 @@ class ModelSelector(Estimator):
 
     def __init__(self, models: Sequence[Tuple[Estimator, List[Dict]]],
                  validator=None, splitter=None, evaluator=None,
-                 problem_type: str = "binary", uid: Optional[str] = None):
+                 problem_type: str = "binary", uid: Optional[str] = None,
+                 checkpoint_dir: Optional[str] = None):
         super().__init__(uid=uid)
         self.models = list(models)
         self.validator = validator or OpCrossValidation()
         self.splitter = splitter
         self.evaluator = evaluator or BinaryClassificationEvaluator()
         self.problem_type = problem_type
+        # sweep checkpointing (SURVEY.md §5.4 — the reference has no
+        # mid-sweep resume; long TPU sweeps need one): per-family metric
+        # matrices persist as JSON after each family completes, keyed by a
+        # signature of the family + grids + data shape + seed, so a killed
+        # sweep resumes at the first un-swept family
+        self.checkpoint_dir = checkpoint_dir
 
     def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
         label_col, vec_col = cols
@@ -134,8 +141,18 @@ class ModelSelector(Estimator):
         if ctx.cv_refit is None:
             for mi, (est, grids) in enumerate(self.models):
                 try:
-                    grid_fold = run_sweep(est, grids, X, y_dev, folds,
-                                          self.evaluator, ctx, sharding=sharding)
+                    ckpt = self._checkpoint_path(
+                        mi, est, grids, X, y_dev, folds, ctx)
+                    cached = self._load_checkpoint(ckpt)
+                    if cached is not None:
+                        grid_fold = cached
+                        log.info("sweep checkpoint hit: %s (%d grids)",
+                                 type(est).__name__, len(grid_fold))
+                    else:
+                        grid_fold = run_sweep(est, grids, X, y_dev, folds,
+                                              self.evaluator, ctx,
+                                              sharding=sharding)
+                        self._save_checkpoint(ckpt, grid_fold)
                     for grid, fm in zip(grids, grid_fold):
                         results.append(ValidationResult(
                             model=type(est).__name__, grid=grid,
@@ -155,6 +172,79 @@ class ModelSelector(Estimator):
         finite = [r for r in results if np.isfinite(r.mean_metric)]
         return self._finish(ctx, results, finite, sign, X, X_full, y_np,
                             y_dev, train_idx, test_idx, split_summary)
+
+    # -- sweep checkpointing ------------------------------------------- #
+
+    def _checkpoint_path(self, mi, est, grids, X, y, folds,
+                         ctx) -> Optional[str]:
+        """Checkpoint file keyed by everything that determines the metric
+        matrix: family + params + grids, the TRAINING DATA CONTENT (sha256
+        of X and y bytes — same-shaped different data must miss), the fold
+        structure, the evaluator class + metric, and the fit seed. Never
+        raises: checkpointing is an optimization, so any failure degrades
+        to 'no checkpoint' (the caller's try covers the rest)."""
+        if self.checkpoint_dir is None:
+            return None
+        import hashlib
+        import json as _json
+        import os
+        try:
+            hasher = hashlib.sha256()
+            hasher.update(np.ascontiguousarray(np.asarray(X)).tobytes())
+            hasher.update(np.ascontiguousarray(np.asarray(y)).tobytes())
+            val = self.validator
+            sig = _json.dumps({
+                "family": type(est).__name__, "index": mi,
+                "params": {k: repr(v) for k, v in sorted(est.params.items())
+                           if k != "uid"},
+                "grids": grids, "shape": list(map(int, X.shape)),
+                "data": hasher.hexdigest(),
+                "folds": len(folds),
+                "validator": [type(val).__name__,
+                              getattr(val, "n_folds", None),
+                              getattr(val, "train_ratio", None),
+                              getattr(val, "seed", None)],
+                "seed": getattr(ctx, "seed", None),
+                "evaluator": [type(self.evaluator).__name__,
+                              getattr(self.evaluator, "metric", None)],
+            }, sort_keys=True, default=repr)
+            h = hashlib.sha256(sig.encode()).hexdigest()[:16]
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            return os.path.join(self.checkpoint_dir,
+                                f"sweep_{mi}_{type(est).__name__}_{h}.json")
+        except Exception:
+            log.warning("sweep checkpointing disabled for this fit "
+                        "(checkpoint_dir unusable)", exc_info=True)
+            return None
+
+    @staticmethod
+    def _load_checkpoint(path: Optional[str]):
+        import json as _json
+        import os
+        if path is None or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return _json.load(f)["grid_fold"]
+        except Exception:
+            log.warning("unreadable sweep checkpoint %s; re-running", path)
+            return None
+
+    @staticmethod
+    def _save_checkpoint(path: Optional[str], grid_fold) -> None:
+        if path is None:
+            return
+        import json as _json
+        import os
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                _json.dump({"grid_fold": [[float(m) for m in row]
+                                          for row in grid_fold]}, f)
+            os.replace(tmp, path)  # atomic: a killed write never half-loads
+        except OSError:
+            log.warning("could not write sweep checkpoint %s", path,
+                        exc_info=True)
 
     def _sweep_with_workflow_cv(self, ctx, folds, train_idx, y_dev, sharding):
         """Workflow-level CV (OpWorkflowCore.withWorkflowCV → cutDAG,
@@ -285,26 +375,28 @@ class BinaryClassificationModelSelector:
     def with_cross_validation(
             models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
             n_folds: int = 3, validation_metric: str = "AuPR",
-            splitter=None, seed: int = 42) -> ModelSelector:
+            splitter=None, seed: int = 42,
+            checkpoint_dir: Optional[str] = None) -> ModelSelector:
         return ModelSelector(
             models=models or _default_binary_models(),
             validator=OpCrossValidation(n_folds=n_folds, seed=seed),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
             evaluator=BinaryClassificationEvaluator(metric=validation_metric),
-            problem_type="binary")
+            problem_type="binary", checkpoint_dir=checkpoint_dir)
 
     @staticmethod
     def with_train_validation_split(
             models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
             train_ratio: float = 0.75, validation_metric: str = "AuPR",
-            splitter=None, seed: int = 42) -> ModelSelector:
+            splitter=None, seed: int = 42,
+            checkpoint_dir: Optional[str] = None) -> ModelSelector:
         from transmogrifai_tpu.selector.validators import OpTrainValidationSplit
         return ModelSelector(
             models=models or _default_binary_models(),
             validator=OpTrainValidationSplit(train_ratio=train_ratio, seed=seed),
             splitter=splitter if splitter is not None else DataBalancer(seed=seed),
             evaluator=BinaryClassificationEvaluator(metric=validation_metric),
-            problem_type="binary")
+            problem_type="binary", checkpoint_dir=checkpoint_dir)
 
 
 class MultiClassificationModelSelector:
@@ -312,26 +404,28 @@ class MultiClassificationModelSelector:
     def with_cross_validation(
             models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
             n_folds: int = 3, validation_metric: str = "F1",
-            splitter=None, seed: int = 42) -> ModelSelector:
+            splitter=None, seed: int = 42,
+            checkpoint_dir: Optional[str] = None) -> ModelSelector:
         return ModelSelector(
             models=models or _default_multiclass_models(),
             validator=OpCrossValidation(n_folds=n_folds, seed=seed),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             evaluator=MultiClassificationEvaluator(metric=validation_metric),
-            problem_type="multiclass")
+            problem_type="multiclass", checkpoint_dir=checkpoint_dir)
 
     @staticmethod
     def with_train_validation_split(
             models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
             train_ratio: float = 0.75, validation_metric: str = "F1",
-            splitter=None, seed: int = 42) -> ModelSelector:
+            splitter=None, seed: int = 42,
+            checkpoint_dir: Optional[str] = None) -> ModelSelector:
         from transmogrifai_tpu.selector.validators import OpTrainValidationSplit
         return ModelSelector(
             models=models or _default_multiclass_models(),
             validator=OpTrainValidationSplit(train_ratio=train_ratio, seed=seed),
             splitter=splitter if splitter is not None else DataCutter(seed=seed),
             evaluator=MultiClassificationEvaluator(metric=validation_metric),
-            problem_type="multiclass")
+            problem_type="multiclass", checkpoint_dir=checkpoint_dir)
 
 
 class RegressionModelSelector:
@@ -339,23 +433,25 @@ class RegressionModelSelector:
     def with_cross_validation(
             models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
             n_folds: int = 3, validation_metric: str = "RMSE",
-            splitter=None, seed: int = 42) -> ModelSelector:
+            splitter=None, seed: int = 42,
+            checkpoint_dir: Optional[str] = None) -> ModelSelector:
         return ModelSelector(
             models=models or _default_regression_models(),
             validator=OpCrossValidation(n_folds=n_folds, seed=seed),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             evaluator=RegressionEvaluator(metric=validation_metric),
-            problem_type="regression")
+            problem_type="regression", checkpoint_dir=checkpoint_dir)
 
     @staticmethod
     def with_train_validation_split(
             models: Optional[Sequence[Tuple[Estimator, List[Dict]]]] = None,
             train_ratio: float = 0.75, validation_metric: str = "RMSE",
-            splitter=None, seed: int = 42) -> ModelSelector:
+            splitter=None, seed: int = 42,
+            checkpoint_dir: Optional[str] = None) -> ModelSelector:
         from transmogrifai_tpu.selector.validators import OpTrainValidationSplit
         return ModelSelector(
             models=models or _default_regression_models(),
             validator=OpTrainValidationSplit(train_ratio=train_ratio, seed=seed),
             splitter=splitter if splitter is not None else DataSplitter(seed=seed),
             evaluator=RegressionEvaluator(metric=validation_metric),
-            problem_type="regression")
+            problem_type="regression", checkpoint_dir=checkpoint_dir)

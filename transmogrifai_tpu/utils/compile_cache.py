@@ -1,0 +1,30 @@
+"""Persistent XLA compilation cache.
+
+The sweep engine's cost on a fresh process is compile-dominated (each
+tree-family program takes 15-50s through the remote AOT compile service;
+warm executions are sub-second). JAX's persistent compilation cache works
+with this backend, so enabling it makes every run after the first start
+warm. Called by bench.py, __graft_entry__, the WorkflowRunner/CLI, and the
+examples; tests keep the default (CPU compiles are cheap and hermetic).
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT = os.path.expanduser("~/.cache/transmogrifai_tpu/xla-cache")
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Best-effort: an unwritable HOME/cache dir must never break startup
+    (returns None and leaves JAX's default config in place)."""
+    import jax
+
+    path = path or os.environ.get("TRANSMOGRIFAI_TPU_CACHE", _DEFAULT)
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        return path
+    except OSError:
+        return None
