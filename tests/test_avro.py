@@ -189,3 +189,43 @@ def test_workflow_trains_from_avro(tmp_path):
     assert pred.shape == (n,)
     acc = float((pred == np.array([r["y"] for r in recs])).mean())
     assert acc > 0.8
+
+
+def test_cli_gen_from_avsc_and_avro(tmp_path):
+    """`gen` from a bare .avsc schema (reference CLI's schemaFile mode) and
+    from an .avro data file."""
+    import json as _json
+    import sys
+    from transmogrifai_tpu.cli import main
+
+    avsc = {"type": "record", "name": "Lead", "fields": [
+        {"name": "converted", "type": "boolean"},
+        {"name": "revenue", "type": ["null", "double"], "default": None},
+        {"name": "source", "type": ["null", "string"], "default": None},
+        {"name": "visits", "type": ["null", "long"], "default": None},
+    ]}
+    avsc_path = tmp_path / "lead.avsc"
+    avsc_path.write_text(_json.dumps(avsc))
+    out = tmp_path / "lead_app.py"
+    rc = main(["gen", "--input", str(avsc_path), "--response", "converted",
+               "--output", str(out)])
+    assert rc == 0
+    code = out.read_text()
+    assert "BinaryClassificationModelSelector" in code  # boolean response
+    assert 'FeatureBuilder.RealNN("converted")' in code
+    assert "DataReaders.avro" in code
+    assert 'FeatureBuilder.Integral("visits")' in code
+
+    # data-file mode: problem kind inferred from the actual column values
+    path = str(tmp_path / "train.avro")
+    write_container(path, SCHEMA, RECORDS)
+    out2 = tmp_path / "passenger_app.py"
+    rc = main(["gen", "--input", path, "--response", "survived",
+               "--output", str(out2)])
+    assert rc == 0
+    code2 = out2.read_text()
+    assert "DataReaders.avro" in code2
+    sys.path.insert(0, str(tmp_path))
+    import importlib
+    mod = importlib.import_module("lead_app")
+    assert mod.workflow.result_features

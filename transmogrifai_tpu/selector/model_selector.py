@@ -139,10 +139,12 @@ class ModelSelector(Estimator):
         results: List[ValidationResult] = []
         failures = 0
         if ctx.cv_refit is None:
+            data_digest = (self._data_digest(X, y_dev)
+                           if self.checkpoint_dir is not None else None)
             for mi, (est, grids) in enumerate(self.models):
                 try:
                     ckpt = self._checkpoint_path(
-                        mi, est, grids, X, y_dev, folds, ctx)
+                        mi, est, grids, X, data_digest, folds, ctx)
                     cached = self._load_checkpoint(ckpt)
                     if cached is not None:
                         grid_fold = cached
@@ -175,30 +177,41 @@ class ModelSelector(Estimator):
 
     # -- sweep checkpointing ------------------------------------------- #
 
-    def _checkpoint_path(self, mi, est, grids, X, y, folds,
+    @staticmethod
+    def _data_digest(X, y) -> Optional[str]:
+        """sha256 of the training data bytes, computed ONCE per fit (the
+        device→host materialization is shared by every family's key)."""
+        import hashlib
+        try:
+            hasher = hashlib.sha256()
+            hasher.update(np.ascontiguousarray(np.asarray(X)).tobytes())
+            hasher.update(np.ascontiguousarray(np.asarray(y)).tobytes())
+            return hasher.hexdigest()
+        except Exception:
+            return None
+
+    def _checkpoint_path(self, mi, est, grids, X, data_digest, folds,
                          ctx) -> Optional[str]:
         """Checkpoint file keyed by everything that determines the metric
-        matrix: family + params + grids, the TRAINING DATA CONTENT (sha256
-        of X and y bytes — same-shaped different data must miss), the fold
-        structure, the evaluator class + metric, and the fit seed. Never
-        raises: checkpointing is an optimization, so any failure degrades
-        to 'no checkpoint' (the caller's try covers the rest)."""
-        if self.checkpoint_dir is None:
+        matrix: family + params + grids, the TRAINING DATA CONTENT (the
+        digest of X and y bytes — same-shaped different data must miss),
+        the fold structure, the evaluator class + metric, and the fit
+        seed. Never raises: checkpointing is an optimization, so any
+        failure degrades to 'no checkpoint' (the caller's try covers the
+        rest)."""
+        if self.checkpoint_dir is None or data_digest is None:
             return None
         import hashlib
         import json as _json
         import os
         try:
-            hasher = hashlib.sha256()
-            hasher.update(np.ascontiguousarray(np.asarray(X)).tobytes())
-            hasher.update(np.ascontiguousarray(np.asarray(y)).tobytes())
             val = self.validator
             sig = _json.dumps({
                 "family": type(est).__name__, "index": mi,
                 "params": {k: repr(v) for k, v in sorted(est.params.items())
                            if k != "uid"},
                 "grids": grids, "shape": list(map(int, X.shape)),
-                "data": hasher.hexdigest(),
+                "data": data_digest,
                 "folds": len(folds),
                 "validator": [type(val).__name__,
                               getattr(val, "n_folds", None),
