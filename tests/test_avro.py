@@ -229,3 +229,27 @@ def test_cli_gen_from_avsc_and_avro(tmp_path):
     import importlib
     mod = importlib.import_module("lead_app")
     assert mod.workflow.result_features
+    mod2 = importlib.import_module("passenger_app")
+    assert mod2.workflow.result_features
+
+
+def test_avsc_named_type_reference(tmp_path):
+    """A field referencing an earlier named type (standard Avro reuse)
+    maps to the same FeatureType as the definition."""
+    import json as _json
+    from transmogrifai_tpu.cli import main
+
+    avsc = {"type": "record", "name": "R", "fields": [
+        {"name": "status", "type": {"type": "enum", "name": "Status",
+                                    "symbols": ["a", "b"]}},
+        {"name": "status2", "type": "Status"},
+        {"name": "label", "type": "double"},
+    ]}
+    p = tmp_path / "named.avsc"
+    p.write_text(_json.dumps(avsc))
+    out = tmp_path / "named_app.py"
+    assert main(["gen", "--input", str(p), "--response", "label",
+                 "--output", str(out)]) == 0
+    code = out.read_text()
+    assert 'FeatureBuilder.PickList("status")' in code
+    assert 'FeatureBuilder.PickList("status2")' in code

@@ -190,3 +190,45 @@ class TestReferenceDepth:
         assert abs(s["sampleFraction"] - 0.2) < 1e-9
         # statistics still sound on the sample
         assert abs(s["stats"][0]["corrLabel"]) > 0.5
+
+
+class TestWideFeatureAxis:
+    """Blocked Gram path for wide X (SURVEY.md §5.7): no (d, d) matrix."""
+
+    def test_blocked_matches_dense(self):
+        from transmogrifai_tpu.automl.sanity_checker import (
+            _corr_label_and_hits_blocked, _corr_matrix)
+        import jax.numpy as jnp
+        rng = np.random.default_rng(5)
+        n, d = 300, 37
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        X[:, 7] = X[:, 3] * 2.0 + 1e-6          # duplicate pair (7, 3)
+        X[:, 20] = -X[:, 11]                     # anti-correlated pair
+        y = (X[:, 0] > 0).astype(np.float32)
+        corr_y, pairs = _corr_label_and_hits_blocked(
+            jnp.asarray(X), jnp.asarray(y), thr=0.95, block=8)
+        dense = _corr_matrix(jnp.asarray(
+            np.concatenate([X, y[:, None]], axis=1)))
+        np.testing.assert_allclose(corr_y, dense[:d, d], atol=1e-4)
+        assert 7 in pairs and pairs[7][0][0] == 3
+        assert 20 in pairs and pairs[20][0][0] == 11
+        assert abs(pairs[7][0][1] - dense[7, 3]) < 1e-4
+        # no spurious pairs beyond the two planted ones
+        assert set(pairs) == {7, 20}
+
+    def test_wide_duplicate_column_dropped(self, monkeypatch):
+        import transmogrifai_tpu.automl.sanity_checker as sc_mod
+        monkeypatch.setattr(sc_mod, "_WIDE_D", 16)  # force the wide path
+        rng = np.random.default_rng(6)
+        n, d = 400, 24
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        X[:, 13] = X[:, 4]
+        y = (X[:, 0] + rng.normal(0, 0.5, n) > 0).astype(np.float64)
+        label = Column(t.RealNN, {"value": y, "mask": np.ones(n, bool)})
+        vec = Column(t.OPVector, X)
+        model = SanityChecker(max_feature_corr=0.99).fit_model(
+            [label, vec], FitContext(n_rows=n, seed=0))
+        kept = model.indices
+        assert 4 in kept and 13 not in kept  # later duplicate dropped
+        reasons = model.summary["stats"][13]["dropped"]
+        assert any("corr" in r for r in reasons)

@@ -19,12 +19,16 @@ on host at fit time; the fitted model is a static-index column gather.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.nn
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.data.columns import Column
@@ -140,6 +144,65 @@ def _corr_matrix(Z: jnp.ndarray) -> np.ndarray:
     with np.errstate(divide="ignore", invalid="ignore"):
         corr = np.where(denom > 0, np.asarray(cov) / denom, 0.0)
     return corr
+
+
+_WIDE_D = 8192  # feature count beyond which the (d, d) corr never materializes
+
+
+def _corr_label_and_hits_blocked(Cx: jnp.ndarray, cy: jnp.ndarray,
+                                 thr: float, block: Optional[int] = None):
+    """Wide-feature-axis path (SURVEY.md §5.7): label-correlation vector +
+    the SPARSE set of feature-feature pairs with |corr| > thr, computed in
+    column blocks of the Gram product — the full (d, d) matrix (17G entries
+    at the 2^17 hashing limit) never exists. Each block is one MXU matmul
+    with the row axis `psum`-ready; hit pairs extract on device via a
+    fixed-size nonzero so only O(hits) crosses back to host.
+
+    Returns (corr_y (d,), {i: [(j, corr_ij), ...] with j < i}).
+    """
+    n, d = Cx.shape
+    mean = Cx.mean(0)
+    Zc = Cx - mean
+    sd = jnp.sqrt(jnp.maximum((Zc * Zc).sum(0), 0.0))
+    U = jnp.where(sd > 0, Zc / sd, 0.0)
+    yc = cy - cy.mean()
+    ysd = jnp.sqrt(jnp.maximum((yc * yc).sum(), 0.0))
+    uy = jnp.where(ysd > 0, yc / ysd, 0.0)
+    corr_y = np.asarray(U.T @ uy, dtype=np.float64)
+
+    if block is None:  # ≤ ~128M-entry (512MB f32) block products
+        block = max(128, min(d, (1 << 27) // max(d, 1)))
+    cap = 16 * block  # duplicates are sparse; truncation is logged
+
+    @jax.jit
+    def block_hits(Ub, a):  # Ub (n, block), a = column offset
+        C = Ub.T @ U  # (block, d)
+        rows = a + jnp.arange(Ub.shape[1])[:, None]
+        cols = jnp.arange(d)[None, :]
+        mask = (jnp.abs(C) > thr) & (cols < rows)
+        ri, ci = jnp.nonzero(mask, size=cap, fill_value=-1)
+        return ri, ci, C[ri, ci], mask.sum()
+
+    pairs: Dict[int, List[Tuple[int, float]]] = {}
+    pad = (-d) % block
+    Upad = jnp.pad(U, ((0, 0), (0, pad))) if pad else U
+    for a in range(0, d, block):
+        ri, ci, vals, total = block_hits(
+            jax.lax.dynamic_slice_in_dim(Upad, a, block, 1), a)
+        ri, ci, vals = np.asarray(ri), np.asarray(ci), np.asarray(vals)
+        k = int((ri >= 0).sum())
+        if int(total) > cap:
+            log.warning(
+                "feature-feature corr: %d hits in block %d..%d truncated "
+                "to %d — raise max_feature_corr or lower the hash width",
+                int(total), a, min(a + block, d), cap)
+        for t in range(k):
+            i, j = int(ri[t]) + a, int(ci[t])
+            if i < d:  # pad columns are all-zero and never hit, but guard
+                pairs.setdefault(i, []).append((j, float(vals[t])))
+    for i in pairs:
+        pairs[i].sort()
+    return corr_y, pairs
 
 
 def _rank_transform(A: np.ndarray) -> np.ndarray:
@@ -349,7 +412,14 @@ class SanityChecker(Estimator):
         mean = red["sx"] / max(n, 1)
         var = (red["sxx"] - n * mean ** 2) / max(n - 1, 1)
         var = np.maximum(var, 0.0)
-        if need_ff:
+        hit_pairs: Dict[int, List[Tuple[int, float]]] = {}
+        if need_ff and d > _WIDE_D:
+            # wide-X: blocked Gram — label corr + sparse duplicate pairs,
+            # no (d, d) materialization (SURVEY.md §5.7)
+            corr, hit_pairs = _corr_label_and_hits_blocked(
+                Cx, cy, self.max_feature_corr)
+            feat_corr = None
+        elif need_ff:
             # full corr matrix of [X | y]: ONE Gram matmul on the MXU
             corr_all = _corr_matrix(jnp.concatenate([Cx, cy[:, None]], 1))
             corr = corr_all[:d, d]
@@ -402,12 +472,13 @@ class SanityChecker(Estimator):
 
         # feature-feature duplicates: vectorized candidate pairs, then the
         # "later column drops" scan ("dropping the later features",
-        # DerivedFeatureFilterUtils:376)
-        hit_lists: Dict[int, np.ndarray] = {}
-        if self.max_feature_corr < 1.0 and d > 1:
+        # DerivedFeatureFilterUtils:376). The wide path already produced
+        # `hit_pairs`; the dense path extracts them from the matrix.
+        if feat_corr is not None and self.max_feature_corr < 1.0 and d > 1:
             hit = np.abs(np.tril(feat_corr, k=-1)) > self.max_feature_corr
             for i in np.flatnonzero(hit.any(axis=1)):
-                hit_lists[int(i)] = np.flatnonzero(hit[i])
+                hit_pairs[int(i)] = [(int(j), float(feat_corr[i, j]))
+                                     for j in np.flatnonzero(hit[i])]
 
         stats: List[ColumnStats] = []
         kept: List[int] = []
@@ -421,10 +492,10 @@ class SanityChecker(Estimator):
                 reasons.append(f"label corr {ac:.3f} > {self.max_correlation}")
             elif self.min_correlation > 0 and ac < self.min_correlation:
                 reasons.append(f"label corr {ac:.3f} < {self.min_correlation}")
-            for j in hit_lists.get(i, ()):
+            for j, cij in hit_pairs.get(i, ()):
                 if j not in dropped_so_far:
                     reasons.append(
-                        f"corr {feat_corr[i, j]:.3f} with column "
+                        f"corr {cij:.3f} with column "
                         f"{names[j]!r} > {self.max_feature_corr}")
                     break
             gs = group_stats.get(i)

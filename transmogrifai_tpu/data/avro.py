@@ -354,6 +354,10 @@ def avro_ftype(field_schema: Any, names: Optional[_Names] = None) -> type:
         return avro_ftype(non_null[0], names) if non_null else T.Text
     if isinstance(s, dict):
         t = s["type"]
+        if t in ("record", "error", "enum", "fixed") and s.get("name"):
+            # register named types so later by-name references resolve
+            # (schema-only gen walks fields without building a decoder)
+            names.types[s["name"]] = s
         if s.get("logicalType") in ("timestamp-millis", "timestamp-micros",
                                     "local-timestamp-millis", "date"):
             return T.DateTime

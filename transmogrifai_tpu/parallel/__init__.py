@@ -1,4 +1,6 @@
-from transmogrifai_tpu.parallel.mesh import make_mesh, sweep_sharding, data_sharding
+from transmogrifai_tpu.parallel.mesh import (
+    data_sharding, make_mesh, make_multislice_mesh, sweep_sharding)
 from transmogrifai_tpu.parallel.sweep import run_sweep
 
-__all__ = ["make_mesh", "sweep_sharding", "data_sharding", "run_sweep"]
+__all__ = ["data_sharding", "make_mesh", "make_multislice_mesh",
+           "sweep_sharding", "run_sweep"]

@@ -44,6 +44,47 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(grid, axis_names)
 
 
+def make_multislice_mesh(n_slices: int,
+                         devices_per_slice: Optional[int] = None,
+                         data_per_slice: Optional[int] = None) -> Mesh:
+    """(sweep, data) mesh laid out for a multi-slice pod (SURVEY.md §5.8).
+
+    Slice boundaries land on the SWEEP axis: fold×grid programs are
+    independent, so the only cross-slice (DCN) traffic is final metric
+    gathers, while the data axis — whose `psum` reductions need bandwidth —
+    stays inside a slice (ICI). Uses the runtime's slice topology when
+    exposed (`device.slice_index`), otherwise falls back to contiguous
+    grouping, which matches how hosts enumerate devices on real pods and
+    on `--xla_force_host_platform_device_count` test meshes.
+
+    `data_per_slice` splits each slice's devices further into a per-slice
+    data axis (default: all of a slice's devices on data).
+    """
+    devices = jax.devices()
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    if len(by_slice) >= n_slices > 1:
+        groups = [by_slice[k] for k in sorted(by_slice)[:n_slices]]
+        per = min(len(g) for g in groups)
+    else:  # single real slice (or CPU test mesh): contiguous grouping
+        per = devices_per_slice or len(devices) // n_slices
+        if per < 1 or per * n_slices > len(devices):
+            raise ValueError(
+                f"need {max(per, 1) * n_slices} devices for {n_slices} "
+                f"slices × {max(per, 1)}, have {len(devices)}")
+        groups = [devices[i * per:(i + 1) * per] for i in range(n_slices)]
+    dps = data_per_slice or per
+    if per % dps != 0:
+        raise ValueError(f"data_per_slice={dps} must divide {per}")
+    # grid: (n_slices * per//dps, dps) — slice-major on the sweep axis
+    rows = []
+    for g in groups:
+        for s in range(per // dps):
+            rows.append(g[s * dps:(s + 1) * dps])
+    return Mesh(np.array(rows), (SWEEP_AXIS, DATA_AXIS))
+
+
 def sweep_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (grid×fold) axis over the sweep dimension."""
     return NamedSharding(mesh, P(SWEEP_AXIS))
