@@ -253,3 +253,15 @@ def test_avsc_named_type_reference(tmp_path):
     code = out.read_text()
     assert 'FeatureBuilder.PickList("status")' in code
     assert 'FeatureBuilder.PickList("status2")' in code
+
+
+def test_nested_named_type_registration():
+    from transmogrifai_tpu.data.avro import _Names, avro_ftype
+    names = _Names()
+    # enum defined inside an array's items, referenced later by name
+    assert avro_ftype({"type": "array",
+                       "items": {"type": "enum", "name": "Tag",
+                                 "namespace": "com.x",
+                                 "symbols": ["a", "b"]}}, names) is T.TextList
+    assert avro_ftype("Tag", names) is T.PickList
+    assert avro_ftype("com.x.Tag", names) is T.PickList

@@ -172,3 +172,99 @@ class TestNativeHashKernel:
         values = ["dup dup dup", "solo", None] * 20
         got = _hash_counts(values, TokenHasher(16), True, False)
         assert got.max() == 1.0
+
+
+class TestNativeCsvParser:
+    """One-pass C numeric CSV kernel (native/csv_parse.c) vs python path."""
+
+    def _write(self, tmp_path, text, name="n.csv"):
+        p = tmp_path / name
+        p.write_text(text)
+        return str(p)
+
+    def test_numeric_fast_path_matches_python(self, tmp_path):
+        import io as _io
+        rng = np.random.default_rng(0)
+        n = 500
+        lines = ["a,b,c,d"]
+        for i in range(n):
+            cells = [f"{rng.normal():.6f}", str(int(rng.integers(100))),
+                     "" if i % 7 == 0 else f"{rng.normal():.3f}",
+                     "NA" if i % 11 == 0 else str(i)]
+            lines.append(",".join(cells))
+        text = "\n".join(lines) + "\n"
+        path = self._write(tmp_path, text)
+        ds_fast = Dataset.from_csv(path)
+        ds_py = Dataset.from_csv(_io.StringIO(text))
+        assert ds_fast.n_rows == ds_py.n_rows == n
+        for col in ("a", "b", "c", "d"):
+            f, p = ds_fast.column(col), ds_py.column(col)
+            assert f.dtype == np.float64
+            np.testing.assert_allclose(
+                np.where(np.isnan(f), -9e9, f),
+                np.where(np.isnan(np.asarray(p, float)), -9e9,
+                         np.asarray(p, float)))
+        assert ds_fast.schema["b"] is T.Integral
+        assert ds_fast.schema["a"] is T.Real
+
+    def test_mixed_text_falls_back(self, tmp_path):
+        path = self._write(tmp_path, "x,s\n1.5,hello\n2.5,world\n")
+        ds = Dataset.from_csv(path)
+        assert list(ds.column("s")) == ["hello", "world"]
+        assert ds.schema["s"] is T.Text
+
+    def test_quoted_and_crlf(self, tmp_path):
+        path = self._write(tmp_path, 'x,y\r\n"1.5",2\r\n"3.25",4\r\n')
+        ds = Dataset.from_csv(path)
+        np.testing.assert_allclose(ds.column("x"), [1.5, 3.25])
+        np.testing.assert_allclose(ds.column("y"), [2.0, 4.0])
+
+    def test_short_rows_and_no_trailing_newline(self, tmp_path):
+        path = self._write(tmp_path, "x,y\n1,2\n3\n5,6")
+        ds = Dataset.from_csv(path)
+        assert ds.n_rows == 3
+        np.testing.assert_allclose(ds.column("x"), [1.0, 3.0, 5.0])
+        y = ds.column("y")
+        assert y[0] == 2.0 and np.isnan(y[1]) and y[2] == 6.0
+
+    def test_native_kernel_is_used(self, tmp_path):
+        from transmogrifai_tpu.native import get_csv_parser
+        if get_csv_parser() is None:
+            pytest.skip("no C toolchain in image")
+        path = self._write(tmp_path, "x\n1\n2\n")
+        ds = Dataset._from_csv_native(path, None, ",")
+        assert ds is not None and ds.n_rows == 2
+
+    def test_late_text_and_bigint_fall_back(self, tmp_path):
+        # text appears after the inference sample -> python path must win
+        lines = ["v"] + [str(i) for i in range(2500)] + ["ERROR", "7"]
+        p1 = self._write(tmp_path, "\n".join(lines) + "\n", "late.csv")
+        ds = Dataset.from_csv(p1)
+        col = ds.column("v")
+        assert col[2500] == "ERROR"  # preserved, not NaN'd
+
+        # big exact ints keep object storage (join keys must not round)
+        big = 9007199254740993
+        p2 = self._write(tmp_path, f"id\n{big}\n{big + 2}\n", "big.csv")
+        ds2 = Dataset.from_csv(p2)
+        assert ds2.column("id")[0] == big  # exact
+
+    def test_trailing_blank_line_matches_python(self, tmp_path):
+        import io as _io
+        text = "x,y\n1,2\n\n"
+        p = self._write(tmp_path, text, "blank.csv")
+        ds_fast = Dataset.from_csv(p)
+        ds_py = Dataset.from_csv(_io.StringIO(text))
+        assert ds_fast.n_rows == ds_py.n_rows
+
+    def test_bare_cr_row_breaks(self, tmp_path):
+        import io as _io
+        # old-Mac \r row separators past any sample: python csv splits on
+        # them and so must the C kernel
+        text = "x,y\n1,2\r3,4\r\n5,6\n"
+        p = self._write(tmp_path, text, "cr.csv")
+        ds_fast = Dataset.from_csv(p)
+        ds_py = Dataset.from_csv(_io.StringIO(text, newline=""))
+        assert ds_fast.n_rows == ds_py.n_rows == 3
+        np.testing.assert_allclose(ds_fast.column("x"), [1.0, 3.0, 5.0])
+        np.testing.assert_allclose(ds_fast.column("y"), [2.0, 4.0, 6.0])

@@ -342,6 +342,34 @@ def write_container(path: str, schema: Any, records: List[Any],
 # FeatureType mapping                                                         #
 # --------------------------------------------------------------------------- #
 
+def register_named_types(schema: Any, names: _Names) -> None:
+    """Recursively register every named type (record/enum/fixed) under
+    both its short name and namespace-qualified fullname, so by-name
+    references anywhere in the schema — including inside array items, map
+    values, and nested record fields — resolve during schema-only walks
+    (the decoder/encoder builders register as they traverse; `avro_ftype`
+    alone does not recurse into branches it never visits)."""
+    if isinstance(schema, list):
+        for s in schema:
+            register_named_types(s, names)
+        return
+    if not isinstance(schema, dict):
+        return
+    t = schema.get("type")
+    if t in ("record", "error", "enum", "fixed") and schema.get("name"):
+        names.types[schema["name"]] = schema
+        ns = schema.get("namespace")
+        if ns:
+            names.types[f"{ns}.{schema['name']}"] = schema
+    if t in ("record", "error"):
+        for f in schema.get("fields", []):
+            register_named_types(f.get("type"), names)
+    elif t == "array":
+        register_named_types(schema.get("items"), names)
+    elif t == "map":
+        register_named_types(schema.get("values"), names)
+
+
 def avro_ftype(field_schema: Any, names: Optional[_Names] = None) -> type:
     """Avro field schema → FeatureType (FeatureSparkTypes.scala:54-96 via
     spark-avro conversion parity). Unions strip the null branch."""
@@ -354,10 +382,7 @@ def avro_ftype(field_schema: Any, names: Optional[_Names] = None) -> type:
         return avro_ftype(non_null[0], names) if non_null else T.Text
     if isinstance(s, dict):
         t = s["type"]
-        if t in ("record", "error", "enum", "fixed") and s.get("name"):
-            # register named types so later by-name references resolve
-            # (schema-only gen walks fields without building a decoder)
-            names.types[s["name"]] = s
+        register_named_types(s, names)  # incl. nested/namespaced defs
         if s.get("logicalType") in ("timestamp-millis", "timestamp-micros",
                                     "local-timestamp-millis", "date"):
             return T.DateTime

@@ -3,11 +3,11 @@
 The reference's runtime leans on JVM-native paths (Spark shuffle, Rabit
 allreduce, Lucene); here the TPU compute path is XLA and the host runtime's
 hot loops are C: murmur3 feature hashing directly over Arrow string
-buffers (SURVEY §2.9 — components whose equivalents cannot be Python
+buffers and one-pass CSV numeric-column parsing into float64+NaN storage (SURVEY §2.9 — components whose equivalents cannot be Python
 stand-ins). Compiled lazily with the in-image gcc; every caller falls back
 to the pure-python implementation when the toolchain is unavailable.
 """
 
-from transmogrifai_tpu.native.build import get_murmur3
+from transmogrifai_tpu.native.build import get_csv_parser, get_murmur3
 
-__all__ = ["get_murmur3"]
+__all__ = ["get_csv_parser", "get_murmur3"]

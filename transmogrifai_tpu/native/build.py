@@ -12,17 +12,16 @@ from typing import Optional
 log = logging.getLogger(__name__)
 
 _lock = threading.Lock()
-_lib = None
-_tried = False
+_libs: dict = {}
 
-_SRC = os.path.join(os.path.dirname(__file__), "murmur3.c")
+_DIR = os.path.dirname(__file__)
 
 
-def _build(so_path: str) -> bool:
+def _build(src: str, so_path: str) -> bool:
     for cc in ("cc", "gcc", "clang"):
         try:
             res = subprocess.run(
-                [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", so_path],
+                [cc, "-O3", "-shared", "-fPIC", src, "-o", so_path],
                 capture_output=True, timeout=120)
             if res.returncode == 0:
                 return True
@@ -32,37 +31,47 @@ def _build(so_path: str) -> bool:
     return False
 
 
-def get_murmur3() -> Optional[ctypes.CDLL]:
-    """The compiled kernel library, or None (callers fall back to python)."""
-    global _lib, _tried
+def _load(stem: str, signatures) -> Optional[ctypes.CDLL]:
+    """Compile-on-first-use + bind; None → callers use the python path."""
     with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        so_path = os.path.join(os.path.dirname(__file__), "_murmur3.so")
+        if stem in _libs:
+            return _libs[stem]
+        _libs[stem] = None
+        src = os.path.join(_DIR, stem + ".c")
+        so_path = os.path.join(_DIR, f"_{stem}.so")
         try:
             if not os.path.exists(so_path) or \
-                    os.path.getmtime(so_path) < os.path.getmtime(_SRC):
-                if not _build(so_path):
+                    os.path.getmtime(so_path) < os.path.getmtime(src):
+                if not _build(src, so_path):
                     return None
             lib = ctypes.CDLL(so_path)
-            for name, argtypes in (
-                ("murmur3_buckets_i32",
-                 [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-                  ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p]),
-                ("murmur3_buckets_i64",
-                 [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-                  ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p]),
-                ("murmur3_hash_counts_i32",
-                 [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-                  ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32,
-                  ctypes.c_void_p]),
-            ):
+            for name, argtypes, restype in signatures:
                 fn = getattr(lib, name)
                 fn.argtypes = argtypes
-                fn.restype = None
-            _lib = lib
+                fn.restype = restype
+            _libs[stem] = lib
         except Exception:
-            log.exception("native murmur3 unavailable; using python path")
-            _lib = None
-        return _lib
+            log.exception("native %s unavailable; using python path", stem)
+        return _libs[stem]
+
+
+def get_murmur3() -> Optional[ctypes.CDLL]:
+    p = ctypes.c_void_p
+    return _load("murmur3", [
+        ("murmur3_buckets_i32",
+         [p, p, ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32, p], None),
+        ("murmur3_buckets_i64",
+         [p, p, ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32, p], None),
+        ("murmur3_hash_counts_i32",
+         [p, p, p, ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32, p],
+         None),
+    ])
+
+
+def get_csv_parser() -> Optional[ctypes.CDLL]:
+    p = ctypes.c_void_p
+    return _load("csv_parse", [
+        ("csv_numeric_fill",
+         [p, ctypes.c_int64, ctypes.c_int32, p, ctypes.c_int32,
+          ctypes.c_char, p, p, ctypes.c_int64], ctypes.c_int64),
+    ])

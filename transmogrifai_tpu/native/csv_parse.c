@@ -1,0 +1,211 @@
+/* Native CSV numeric-column parser (host ingestion hot path).
+ *
+ * Reference parity: the reference's row extraction runs on the JVM inside
+ * Spark (readers/.../DataReader.scala:174-259, CSVReaders.scala); this is
+ * the TPU build's native equivalent for the dominant case — filling the
+ * float64+NaN columnar storage for numeric columns in one pass over the
+ * file buffer, so million-row ingestion does not serialize through
+ * python's csv module. Quoted fields (RFC 4180, incl. embedded delimiters
+ * and doubled quotes) are handled; embedded newlines inside quotes are
+ * treated as row text, not row breaks.
+ *
+ * csv_numeric_fill:
+ *   buf, len        — file contents AFTER the header line
+ *   n_cols          — total columns per row
+ *   sel, n_sel      — indices of the numeric columns to extract
+ *   out             — (max_rows, n_sel) doubles, row-major
+ *   missing         — per-cell flag: 0 = value, 1 = missing token
+ *                     (""/na/n/a/null/none/nan), 2 = NOT PARSEABLE as a
+ *                     double or an integer too long for exact float64
+ *                     (>15 digits) — the caller must fall back to the
+ *                     python path on any 2 so text sentinels and big IDs
+ *                     are never silently NaN'd/rounded
+ *   returns number of rows parsed (≤ max_rows), or -1 on malformed input
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <math.h>
+
+/* Fast double parse for the common form [+-]ddd[.ddd][eE[+-]dd]: digits
+ * accumulate exactly in uint64 (≤15 → < 2^53) and the scale is an EXACT
+ * power of ten, so the single division/multiplication rounds correctly —
+ * identical to strtod (this is the fast path real strtod implementations
+ * use). Returns 0 and falls back for anything unusual (hex, inf/nan
+ * spellings, >15 sig digits, |net exponent| > 22). ~10x faster than
+ * glibc strtod, which dominated the kernel profile. */
+static const double POW10[23] = {
+    1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11,
+    1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+static int fast_parse_double(const char *s, int64_t n, double *out) {
+    int64_t i = 0;
+    int neg = 0, exp_neg = 0;
+    uint64_t mant = 0;
+    int digits = 0, any = 0, frac = 0, seen_point = 0, exp10 = 0;
+    if (i < n && (s[i] == '+' || s[i] == '-')) neg = s[i++] == '-';
+    if (i >= n) return 0;
+    for (; i < n; i++) {
+        char c = s[i];
+        if (c >= '0' && c <= '9') {
+            any = 1;
+            if (digits >= 15) return 0;
+            mant = mant * 10u + (uint64_t)(c - '0');
+            if (mant) digits++;
+            if (seen_point) frac++;
+        } else if (c == '.') {
+            if (seen_point) return 0;
+            seen_point = 1;
+        } else if (c == 'e' || c == 'E') {
+            if (!any) return 0;
+            i++;
+            if (i < n && (s[i] == '+' || s[i] == '-'))
+                exp_neg = s[i++] == '-';
+            if (i >= n) return 0;
+            for (; i < n; i++) {
+                if (s[i] < '0' || s[i] > '9') return 0;
+                exp10 = exp10 * 10 + (s[i] - '0');
+                if (exp10 > 400) return 0;
+            }
+            break;
+        } else return 0;
+    }
+    if (!any) return 0;
+    {
+        int net = (exp_neg ? -exp10 : exp10) - frac;
+        double v = (double)mant;
+        if (net >= 0) {
+            if (net > 22) return 0;
+            v *= POW10[net];
+        } else {
+            if (net < -22) return 0;
+            v /= POW10[-net];
+        }
+        *out = neg ? -v : v;
+        return 1;
+    }
+}
+
+static int is_missing_token(const char *s, int64_t n) {
+    /* "", na, n/a, null, none, nan — case-insensitive (Dataset._MISSING) */
+    char low[8];
+    int64_t i;
+    if (n == 0) return 1;
+    if (n > 4) return 0;
+    for (i = 0; i < n; i++) {
+        char c = s[i];
+        low[i] = (c >= 'A' && c <= 'Z') ? (char)(c + 32) : c;
+    }
+    low[n] = 0;
+    return strcmp(low, "na") == 0 || strcmp(low, "n/a") == 0 ||
+           strcmp(low, "null") == 0 || strcmp(low, "none") == 0 ||
+           strcmp(low, "nan") == 0;
+}
+
+int64_t csv_numeric_fill(const char *buf, int64_t len, int32_t n_cols,
+                         const int32_t *sel, int32_t n_sel, char delim,
+                         double *out, uint8_t *missing, int64_t max_rows) {
+    /* sel must be ascending; map col index -> slot (or -1) */
+    int32_t *slot = (int32_t *)malloc((size_t)n_cols * sizeof(int32_t));
+    int64_t pos = 0, row = 0;
+    int32_t c;
+    if (!slot) return -1;
+    for (c = 0; c < n_cols; c++) slot[c] = -1;
+    for (c = 0; c < n_sel; c++) {
+        if (sel[c] < 0 || sel[c] >= n_cols) { free(slot); return -1; }
+        slot[sel[c]] = c;
+    }
+
+    while (pos < len && row < max_rows) {
+        int32_t col = 0;
+        while (col < n_cols && pos <= len) {
+            int64_t start, end;
+            int quoted = 0;
+            if (pos < len && buf[pos] == '"') {
+                quoted = 1;
+                pos++;
+                start = pos;
+                while (pos < len) {
+                    if (buf[pos] == '"') {
+                        if (pos + 1 < len && buf[pos + 1] == '"') pos += 2;
+                        else break;
+                    } else pos++;
+                }
+                end = pos;
+                if (pos < len) pos++; /* closing quote */
+            } else {
+                start = pos;
+                while (pos < len && buf[pos] != delim && buf[pos] != '\n'
+                       && buf[pos] != '\r')
+                    pos++;
+                end = pos;
+            }
+            if (slot[col] >= 0) {
+                int64_t n = end - start;
+                double *cell = out + row * n_sel + slot[col];
+                uint8_t *miss = missing + row * n_sel + slot[col];
+                /* trim spaces */
+                while (n > 0 && (buf[start] == ' ' || buf[start] == '\t')) {
+                    start++; n--;
+                }
+                while (n > 0 && (buf[start + n - 1] == ' ' ||
+                                 buf[start + n - 1] == '\t'))
+                    n--;
+                if (is_missing_token(buf + start, n)) {
+                    *cell = 0.0; *miss = 1;
+                } else if (fast_parse_double(buf + start, n, cell)) {
+                    *miss = 0;
+                } else if (n < 64) {
+                    char tmp[64];
+                    char *endp;
+                    double v;
+                    int64_t digits = 0, k;
+                    int intlike = 1;
+                    memcpy(tmp, buf + start, (size_t)n);
+                    tmp[n] = 0;
+                    v = strtod(tmp, &endp);
+                    if (endp != tmp + n) { *cell = 0.0; *miss = 2; }
+                    else {
+                        for (k = 0; k < n; k++) {
+                            char ch = tmp[k];
+                            if (ch >= '0' && ch <= '9') digits++;
+                            else if (!(ch == '+' || ch == '-')) intlike = 0;
+                        }
+                        if (intlike && digits > 15) {
+                            /* exact int may exceed 2^53 — python keeps
+                             * object storage for these */
+                            *cell = 0.0; *miss = 2;
+                        } else { *cell = v; *miss = 0; }
+                    }
+                } else { *cell = 0.0; *miss = 2; }
+            }
+            col++;
+            if (pos < len && buf[pos] == delim && col < n_cols) {
+                pos++;
+                continue;
+            }
+            break;
+        }
+        /* fill unseen selected columns of a short row as missing */
+        for (; col < n_cols; col++) {
+            if (slot[col] >= 0) {
+                out[row * n_sel + slot[col]] = 0.0;
+                missing[row * n_sel + slot[col]] = 1;
+            }
+        }
+        /* advance to next line; bare '\r' is a row break too (python's
+         * csv module splits on it), and a trailing blank line parses as
+         * an all-missing row exactly like the python csv path */
+        while (pos < len && buf[pos] != '\n' && buf[pos] != '\r') pos++;
+        if (pos < len) {
+            if (buf[pos] == '\r') {
+                pos++;
+                if (pos < len && buf[pos] == '\n') pos++;
+            } else pos++;
+        }
+        row++;
+    }
+    free(slot);
+    return row;
+}

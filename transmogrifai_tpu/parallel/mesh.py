@@ -67,6 +67,13 @@ def make_multislice_mesh(n_slices: int,
     if len(by_slice) >= n_slices > 1:
         groups = [by_slice[k] for k in sorted(by_slice)[:n_slices]]
         per = min(len(g) for g in groups)
+        if devices_per_slice is not None:  # honored on real pods too
+            if devices_per_slice > per:
+                raise ValueError(
+                    f"devices_per_slice={devices_per_slice} exceeds the "
+                    f"smallest slice ({per} devices)")
+            per = devices_per_slice
+        groups = [g[:per] for g in groups]
     else:  # single real slice (or CPU test mesh): contiguous grouping
         per = devices_per_slice or len(devices) // n_slices
         if per < 1 or per * n_slices > len(devices):
