@@ -265,3 +265,14 @@ def test_nested_named_type_registration():
                                  "symbols": ["a", "b"]}}, names) is T.TextList
     assert avro_ftype("Tag", names) is T.PickList
     assert avro_ftype("com.x.Tag", names) is T.PickList
+
+
+def test_namespace_inheritance():
+    from transmogrifai_tpu.data.avro import _Names, register_named_types, avro_ftype
+    names = _Names()
+    register_named_types({
+        "type": "record", "name": "Outer", "namespace": "com.x",
+        "fields": [{"name": "tag",
+                    "type": {"type": "enum", "name": "Tag",
+                             "symbols": ["a"]}}]}, names)
+    assert avro_ftype("com.x.Tag", names) is T.PickList

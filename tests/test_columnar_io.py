@@ -268,3 +268,20 @@ class TestNativeCsvParser:
         assert ds_fast.n_rows == ds_py.n_rows == 3
         np.testing.assert_allclose(ds_fast.column("x"), [1.0, 3.0, 5.0])
         np.testing.assert_allclose(ds_fast.column("y"), [2.0, 4.0, 6.0])
+
+    def test_quoted_junk_falls_back(self, tmp_path):
+        import io as _io
+        text = 'x,y\n"1.5"x,9\n2,3\n'
+        p = self._write(tmp_path, text, "junk.csv")
+        ds_fast = Dataset.from_csv(p)
+        ds_py = Dataset.from_csv(_io.StringIO(text, newline=""))
+        # fast path must defer (python concatenates '1.5x' -> Text column)
+        assert list(ds_fast.column("x")) == list(ds_py.column("x"))
+        assert ds_py.column("x")[0] == "1.5x"
+
+    def test_int_then_float_widens_to_real(self, tmp_path):
+        lines = ["v"] + [str(i) for i in range(2500)] + ["2.5"]
+        p = self._write(tmp_path, "\n".join(lines) + "\n", "widen.csv")
+        ds = Dataset.from_csv(p)
+        assert ds.schema["v"] is T.Real
+        assert ds.column("v")[2500] == 2.5

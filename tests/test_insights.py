@@ -16,7 +16,9 @@ from transmogrifai_tpu.data import Dataset
 from transmogrifai_tpu.features import FeatureBuilder
 from transmogrifai_tpu.insights import (
     ModelInsights, RecordInsightsLOCO, RecordInsightsParser)
+from transmogrifai_tpu.data.columns import Column
 from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.stages.base import FitContext
 from transmogrifai_tpu.workflow import Workflow
 
 
@@ -130,3 +132,61 @@ class TestLOCO:
         parsed = RecordInsightsParser.parse_column(out)
         assert len(parsed) == 5
         assert all(isinstance(p, dict) for p in parsed)
+
+
+class TestRecordInsightsCorr:
+    """RecordInsightsCorr.scala parity: corr × normalized feature, top-K."""
+
+    def _fit_inputs(self, n=300, d=6, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d)).astype(np.float64)
+        X[:, 2] *= 0.0  # constant column: corr NaN -> importance 0
+        logits = 2.0 * X[:, 0] - 1.0 * X[:, 1]
+        p1 = 1.0 / (1.0 + np.exp(-logits))
+        prob = np.stack([1 - p1, p1], axis=1)
+        pred = Column(T.Prediction, {
+            "prediction": (p1 > 0.5).astype(np.float64),
+            "rawPrediction": np.log(prob + 1e-9), "probability": prob})
+        vec = Column(T.OPVector, X.astype(np.float32))
+        return pred, vec, X
+
+    def test_fit_transform_topk_and_parser(self):
+        from transmogrifai_tpu.insights import (
+            RecordInsightsCorr, RecordInsightsParser)
+        pred, vec, X = self._fit_inputs()
+        est = RecordInsightsCorr(top_k=3)
+        model = est.fit_model([pred, vec], FitContext(n_rows=300, seed=0))
+        out = model.transform([pred, vec])
+        assert out.kind == "map"
+        rows = RecordInsightsParser.parse_column(out)
+        assert len(rows) == 300
+        # every record keeps exactly top_k features, each with p entries
+        assert all(len(r) == 3 for r in rows)
+        first = next(iter(rows[0].values()))
+        assert len(first) == 2  # binary: two prediction columns
+        # the strongest driver column (0) should appear for most records
+        c0 = sum("column_0" in r for r in rows)
+        assert c0 > 250
+
+    def test_norm_types_and_spearman(self):
+        from transmogrifai_tpu.insights import RecordInsightsCorr
+        pred, vec, X = self._fit_inputs()
+        for nt in ("minmax", "znorm", "minmax_centered"):
+            m = RecordInsightsCorr(top_k=2, norm_type=nt).fit_model(
+                [pred, vec], FitContext(n_rows=300, seed=0))
+            out = m.transform([pred, vec])
+            assert len(out.data) == 300
+        m = RecordInsightsCorr(top_k=2, correlation_type="spearman") \
+            .fit_model([pred, vec], FitContext(n_rows=300, seed=0))
+        assert m.transform([pred, vec]).kind == "map"
+
+    def test_corr_values_match_numpy(self):
+        from transmogrifai_tpu.insights import RecordInsightsCorr
+        pred, vec, X = self._fit_inputs()
+        m = RecordInsightsCorr().fit_model(
+            [pred, vec], FitContext(n_rows=300, seed=0))
+        prob = np.asarray(pred.data["probability"])
+        for j in (0, 1, 3):
+            expect = np.corrcoef(prob[:, 1], X[:, j])[0, 1]
+            assert abs(m.corr[1, j] - expect) < 1e-5  # f32 device storage
+        assert np.isnan(m.corr[:, 2]).all()  # constant column

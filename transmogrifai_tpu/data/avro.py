@@ -342,32 +342,35 @@ def write_container(path: str, schema: Any, records: List[Any],
 # FeatureType mapping                                                         #
 # --------------------------------------------------------------------------- #
 
-def register_named_types(schema: Any, names: _Names) -> None:
+def register_named_types(schema: Any, names: _Names,
+                         enclosing_ns: Optional[str] = None) -> None:
     """Recursively register every named type (record/enum/fixed) under
     both its short name and namespace-qualified fullname, so by-name
     references anywhere in the schema — including inside array items, map
     values, and nested record fields — resolve during schema-only walks
     (the decoder/encoder builders register as they traverse; `avro_ftype`
-    alone does not recurse into branches it never visits)."""
+    alone does not recurse into branches it never visits). Nested types
+    without their own `namespace` inherit the enclosing schema's, per the
+    Avro spec's fullname rules."""
     if isinstance(schema, list):
         for s in schema:
-            register_named_types(s, names)
+            register_named_types(s, names, enclosing_ns)
         return
     if not isinstance(schema, dict):
         return
     t = schema.get("type")
+    ns = schema.get("namespace", enclosing_ns)
     if t in ("record", "error", "enum", "fixed") and schema.get("name"):
         names.types[schema["name"]] = schema
-        ns = schema.get("namespace")
         if ns:
             names.types[f"{ns}.{schema['name']}"] = schema
     if t in ("record", "error"):
         for f in schema.get("fields", []):
-            register_named_types(f.get("type"), names)
+            register_named_types(f.get("type"), names, ns)
     elif t == "array":
-        register_named_types(schema.get("items"), names)
+        register_named_types(schema.get("items"), names, ns)
     elif t == "map":
-        register_named_types(schema.get("values"), names)
+        register_named_types(schema.get("values"), names, ns)
 
 
 def avro_ftype(field_schema: Any, names: Optional[_Names] = None) -> type:

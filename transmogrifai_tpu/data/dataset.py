@@ -271,10 +271,12 @@ class Dataset:
                 sch[name] = ftype
             numeric_ok = (T.Real, T.RealNN, T.Integral, T.Percent,
                           T.Currency, T.Date, T.DateTime)
-            if not all(issubclass(t_, numeric_ok)
-                       and not issubclass(t_, T.Binary)
-                       for t_ in sch.values()):
+            if not all(issubclass(t_, numeric_ok) for t_ in sch.values()):
                 return None
+            inferred_integral = {
+                j for j, name in enumerate(names)
+                if (schema or {}).get(name) is None
+                and issubclass(sch[name], T.Integral)}
             body = head[nl + 1:] + fb.read()
         if not body:
             return None
@@ -302,6 +304,11 @@ class Dataset:
             # the python path owns these
             return None
         out = out[:n]
+        # float-lexical cells past the sample widen an INFERRED Integral
+        # column to Real — matching what whole-file python inference sees
+        for j in inferred_integral:
+            if (miss[:, j] == 4).any():
+                sch[names[j]] = T.Real
         out[miss == 1] = np.nan
         return Dataset({name: out[:, j].copy()
                         for j, name in enumerate(names)}, sch)

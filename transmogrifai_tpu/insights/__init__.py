@@ -1,9 +1,12 @@
-"""Model explanation artifacts: ModelInsights + per-record LOCO."""
+"""Model explanation artifacts: ModelInsights + per-record LOCO/corr."""
 
-from transmogrifai_tpu.insights.model_insights import (
-    DerivedFeatureInsights, FeatureInsights, ModelInsights)
+from transmogrifai_tpu.insights.corr import (
+    RecordInsightsCorr, RecordInsightsCorrModel)
 from transmogrifai_tpu.insights.loco import (
     RecordInsightsLOCO, RecordInsightsParser)
+from transmogrifai_tpu.insights.model_insights import (
+    DerivedFeatureInsights, FeatureInsights, ModelInsights)
 
 __all__ = ["DerivedFeatureInsights", "FeatureInsights", "ModelInsights",
+           "RecordInsightsCorr", "RecordInsightsCorrModel",
            "RecordInsightsLOCO", "RecordInsightsParser"]

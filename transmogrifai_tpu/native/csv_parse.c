@@ -14,12 +14,16 @@
  *   n_cols          — total columns per row
  *   sel, n_sel      — indices of the numeric columns to extract
  *   out             — (max_rows, n_sel) doubles, row-major
- *   missing         — per-cell flag: 0 = value, 1 = missing token
+ *   missing         — per-cell flag: 0 = integer-lexical value,
+ *                     4 = float-lexical value (decimal point/exponent —
+ *                     callers use this to widen sample-inferred Integral
+ *                     columns to Real), 1 = missing token
  *                     (""/na/n/a/null/none/nan), 2 = NOT PARSEABLE as a
- *                     double or an integer too long for exact float64
- *                     (>15 digits) — the caller must fall back to the
- *                     python path on any 2 so text sentinels and big IDs
- *                     are never silently NaN'd/rounded
+ *                     double, an integer too long for exact float64
+ *                     (>15 digits), or a malformed quoted field — the
+ *                     caller must fall back to the python path on any 2
+ *                     so text sentinels and big IDs are never silently
+ *                     NaN'd/rounded
  *   returns number of rows parsed (≤ max_rows), or -1 on malformed input
  */
 
@@ -39,7 +43,8 @@ static const double POW10[23] = {
     1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11,
     1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
 
-static int fast_parse_double(const char *s, int64_t n, double *out) {
+static int fast_parse_double(const char *s, int64_t n, double *out,
+                             int *floaty) {
     int64_t i = 0;
     int neg = 0, exp_neg = 0;
     uint64_t mant = 0;
@@ -83,6 +88,7 @@ static int fast_parse_double(const char *s, int64_t n, double *out) {
             v /= POW10[-net];
         }
         *out = neg ? -v : v;
+        *floaty = seen_point || exp10 || exp_neg;
         return 1;
     }
 }
@@ -121,9 +127,8 @@ int64_t csv_numeric_fill(const char *buf, int64_t len, int32_t n_cols,
         int32_t col = 0;
         while (col < n_cols && pos <= len) {
             int64_t start, end;
-            int quoted = 0;
+            int bad = 0;
             if (pos < len && buf[pos] == '"') {
-                quoted = 1;
                 pos++;
                 start = pos;
                 while (pos < len) {
@@ -134,6 +139,11 @@ int64_t csv_numeric_fill(const char *buf, int64_t len, int32_t n_cols,
                 }
                 end = pos;
                 if (pos < len) pos++; /* closing quote */
+                /* junk between closing quote and delimiter: the python
+                 * csv module concatenates ('"1.5"x' -> '1.5x') — defer */
+                if (pos < len && buf[pos] != delim && buf[pos] != '\n'
+                    && buf[pos] != '\r')
+                    bad = 1;
             } else {
                 start = pos;
                 while (pos < len && buf[pos] != delim && buf[pos] != '\n'
@@ -152,10 +162,13 @@ int64_t csv_numeric_fill(const char *buf, int64_t len, int32_t n_cols,
                 while (n > 0 && (buf[start + n - 1] == ' ' ||
                                  buf[start + n - 1] == '\t'))
                     n--;
-                if (is_missing_token(buf + start, n)) {
+                int floaty = 0;
+                if (bad) {
+                    *cell = 0.0; *miss = 2;
+                } else if (is_missing_token(buf + start, n)) {
                     *cell = 0.0; *miss = 1;
-                } else if (fast_parse_double(buf + start, n, cell)) {
-                    *miss = 0;
+                } else if (fast_parse_double(buf + start, n, cell, &floaty)) {
+                    *miss = floaty ? 4 : 0;
                 } else if (n < 64) {
                     char tmp[64];
                     char *endp;
@@ -176,7 +189,7 @@ int64_t csv_numeric_fill(const char *buf, int64_t len, int32_t n_cols,
                             /* exact int may exceed 2^53 — python keeps
                              * object storage for these */
                             *cell = 0.0; *miss = 2;
-                        } else { *cell = v; *miss = 0; }
+                        } else { *cell = v; *miss = intlike ? 0 : 4; }
                     }
                 } else { *cell = 0.0; *miss = 2; }
             }
