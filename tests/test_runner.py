@@ -155,3 +155,37 @@ def test_cli_run_subprocess(titanic_run, tmp_path):
     out = json.loads(proc.stdout[proc.stdout.index("{"):])
     assert out["metrics"]["n_rows"] == 891
     assert os.path.exists(tmp_path / "out" / "scores.parquet")
+
+
+def test_cli_gen_project_skeleton(tmp_path):
+    """gen --project-dir writes a runnable skeleton and `run` trains from
+    its params.json end-to-end (templates/simple analogue)."""
+    import json as _json
+    import subprocess
+    import sys
+
+    from transmogrifai_tpu.cli import main
+
+    app_path = tmp_path / "proj_app.py"
+    proj = tmp_path / "proj"
+    rc = main(["gen", "--input", TITANIC, "--response", "survived",
+               "--output", str(app_path), "--project-dir", str(proj)])
+    assert rc == 0
+    params = _json.loads((proj / "params.json").read_text())
+    assert params["model_location"].endswith("model")
+    assert "stage_params" in params
+    assert "run --app proj_app:runner" in (proj / "README.md").read_text()
+
+    repo_root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [str(tmp_path), repo_root,
+                    os.environ.get("PYTHONPATH", "")]))
+    out = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.cli", "run",
+         "--app", "proj_app:runner", "--run-type", "train",
+         "--params", str(proj / "params.json")],
+        capture_output=True, text=True, env=env)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert (proj / "model").is_dir()
+    assert (proj / "metrics" / "train-metrics.json").exists()
