@@ -432,15 +432,32 @@ class _TreeModelBase(PredictionModel):
                 "bin": jnp.asarray(self.trees["bin"], jnp.int32),
                 "leaf": jnp.asarray(self.trees["leaf"], jnp.float32)}
 
+    # megabyte-scale fitted arrays (a depth-12 forest is ~8MB) flow into
+    # the compiled scorer as jit arguments, not closure constants
+    def device_constants(self):
+        return {"edges": jnp.asarray(self.edges),
+                "trees": self._tree_pytree()}
+
+    def device_apply_with(self, consts, enc, dev):
+        return self._apply_arrays(consts["trees"],
+                                  bin_features(jnp.asarray(dev[-1]),
+                                               consts["edges"]))
+
+    def predict_arrays(self, X):
+        return self._apply_arrays(self._tree_pytree(), self._binned(X))
+
+    def _apply_arrays(self, trees, Xb):
+        raise NotImplementedError(type(self).__name__)
+
 
 class ForestClassificationModel(_TreeModelBase):
-    def predict_arrays(self, X):
-        return forest_classification_pred(self._tree_pytree(), self._binned(X))
+    def _apply_arrays(self, trees, Xb):
+        return forest_classification_pred(trees, Xb)
 
 
 class ForestRegressionModel(_TreeModelBase):
-    def predict_arrays(self, X):
-        return forest_regression_pred(self._tree_pytree(), self._binned(X))
+    def _apply_arrays(self, trees, Xb):
+        return forest_regression_pred(trees, Xb)
 
 
 class GBTClassificationModel(_TreeModelBase):
@@ -454,15 +471,15 @@ class GBTClassificationModel(_TreeModelBase):
         d["learning_rate"] = self.learning_rate
         return d
 
-    def predict_arrays(self, X):
-        margin = predict_gbt_margin(self._tree_pytree(), self._binned(X),
+    def _apply_arrays(self, trees, Xb):
+        margin = predict_gbt_margin(trees, Xb,
                                     jnp.float32(self.learning_rate))
         return gbt_pred_from_margin(margin, "logistic")
 
 
 class GBTRegressionModel(GBTClassificationModel):
-    def predict_arrays(self, X):
-        margin = predict_gbt_margin(self._tree_pytree(), self._binned(X),
+    def _apply_arrays(self, trees, Xb):
+        margin = predict_gbt_margin(trees, Xb,
                                     jnp.float32(self.learning_rate))
         return gbt_pred_from_margin(margin, "squared")
 
@@ -470,10 +487,9 @@ class GBTRegressionModel(GBTClassificationModel):
 class GBTMulticlassModel(GBTClassificationModel):
     """Softmax forest: trees stacked (rounds, classes, ...)."""
 
-    def predict_arrays(self, X):
+    def _apply_arrays(self, trees, Xb):
         margin = predict_gbt_multiclass_margin(
-            self._tree_pytree(), self._binned(X),
-            jnp.float32(self.learning_rate))
+            trees, Xb, jnp.float32(self.learning_rate))
         return gbt_multiclass_pred_from_margin(margin)
 
 

@@ -169,6 +169,22 @@ class Transformer(Stage):
         """Pure-jnp compute over encoded + parent device values."""
         raise NotImplementedError(type(self).__name__)
 
+    def device_constants(self) -> Any:
+        """Large fitted arrays the compiled scorer should pass as jit
+        ARGUMENTS instead of letting device_apply close over them:
+        closure-captured arrays are re-staged host→device on every
+        execution through the serving tunnel (~100ms per 20MB), so
+        megabyte-scale model parameters (tree tables) must flow as
+        arguments. None (default) = nothing big; device_apply reads self.
+        """
+        return None
+
+    def device_apply_with(self, consts: Any, enc: Any,
+                          dev: Sequence[Any]) -> Any:
+        """device_apply with `device_constants()` threaded back in as a
+        traced argument. Default ignores consts."""
+        return self.device_apply(enc, dev)
+
     def output_meta(self) -> Optional[VectorMetadata]:
         """Static vector metadata (set at fit time for fitted models)."""
         return None

@@ -76,18 +76,31 @@ class CompiledScorer:
         self.device_stages: List[Transformer] = [
             s for kind, stages in self.segments if kind == "device"
             for s in stages]
+        # megabyte-scale fitted arrays (tree tables) flow into the jitted
+        # segments as ARGUMENTS: closure constants are re-staged
+        # host→device on every execution through the serving tunnel
+        self._consts: Dict[str, Any] = {}
+        for s in self.device_stages:
+            c = s.device_constants()
+            if c is not None:
+                self._consts[s.uid] = c
 
     # ------------------------------------------------------------------ #
 
     def _make_segment_fn(self, stages: List[Transformer]):
         out_uid = self._stage_out_uid
 
-        def seg_fn(encs: Dict[str, Any], dev_vals: Dict[str, Any]):
+        def seg_fn(consts: Dict[str, Any], encs: Dict[str, Any],
+                   dev_vals: Dict[str, Any]):
             vals = dict(dev_vals)
             outs: Dict[str, Any] = {}
             for stage in stages:
                 dev_inputs = [vals.get(f.uid) for f in stage.input_features]
-                out = stage.device_apply(encs.get(stage.uid), dev_inputs)
+                if stage.uid in consts:
+                    out = stage.device_apply_with(
+                        consts[stage.uid], encs.get(stage.uid), dev_inputs)
+                else:
+                    out = stage.device_apply(encs.get(stage.uid), dev_inputs)
                 vals[out_uid[stage.uid]] = out
                 outs[out_uid[stage.uid]] = out
             return outs
@@ -179,7 +192,7 @@ class CompiledScorer:
                     enc = stage.host_prepare(cols)
                     if enc is not None:
                         encs[stage.uid] = enc
-                dev_vals.update(jfn(encs, dev_vals))
+                dev_vals.update(jfn(self._consts, encs, dev_vals))
         return dev_vals, columns
 
     def __call__(self, dataset: Dataset) -> Dict[str, Any]:

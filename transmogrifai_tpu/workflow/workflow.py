@@ -339,7 +339,7 @@ class WorkflowModel:
 
         def finish(host_out):
             encs, raw_dev, columns = host_out
-            out = device_fn(encs, raw_dev)
+            out = device_fn(scorer._consts, encs, raw_dev)
             result: Dict[str, Any] = {}
             for f in self.result_features:
                 result[f.name] = (out[f.uid] if f.uid in out
