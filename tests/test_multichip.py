@@ -144,3 +144,21 @@ def test_multislice_mesh_trains():
              .set_input_dataset(ds).train(mesh=mesh))
     summary = model.fitted[pf.origin_stage.uid].summary
     assert np.isfinite([r.mean_metric for r in summary.validation_results]).all()
+
+
+def test_sharded_batch_scoring_parity():
+    """score_compiled(sharding=data_sharding(mesh)) spreads the batch over
+    the mesh and matches unsharded scores (r1 weak#4: the arg used to be
+    dead)."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from transmogrifai_tpu.parallel.mesh import data_sharding
+
+    model, ds, pf = ge._fit_flagship(n=256)
+    base = np.asarray(model.score_compiled(ds)[pf.name]["prediction"])
+    mesh = make_mesh(8, sweep=1)  # all devices on the data axis
+    sh = data_sharding(mesh)
+    out = model.score_compiled(ds, sharding=sh)
+    sharded = np.asarray(out[pf.name]["prediction"])
+    np.testing.assert_array_equal(base, sharded)

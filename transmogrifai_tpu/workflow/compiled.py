@@ -50,7 +50,12 @@ def _column_from_device(ftype: type, dev) -> Column:
 class CompiledScorer:
     def __init__(self, model, sharding: Optional[Any] = None):
         self.model = model
-        self.sharding = sharding  # optional jax.sharding.NamedSharding for batch
+        # optional jax.sharding.NamedSharding for the batch (row) axis:
+        # raw device inputs are placed with it, so the fused program's
+        # elementwise/encode work shards across the mesh and XLA inserts
+        # any cross-shard collectives (batch scoring is embarrassingly
+        # row-parallel, so there are none in practice)
+        self.sharding = sharding
         layers = topological_layers(model.result_features)
         self.generators: List[FeatureGeneratorStage] = list(layers[0]) if layers else []
         ordered: List[Transformer] = []
@@ -154,7 +159,29 @@ class CompiledScorer:
                 dv = c.device_value()
                 if dv is not None:
                     raw_dev[uid] = dv
-        return encs, raw_dev, columns
+        return self._place(encs), self._place(raw_dev), columns
+
+    def _place(self, pytree):
+        """Shard batch-axis arrays over the configured row sharding."""
+        if self.sharding is None:
+            return pytree
+        import jax.tree_util as jtu
+
+        # only dim 0 of the spec shards the row axis; its entry may be an
+        # axis name or a tuple of axis names
+        spec = self.sharding.spec
+        dim0 = spec[0] if len(spec) else None
+        axes = (dim0 if isinstance(dim0, tuple)
+                else (dim0,) if dim0 is not None else ())
+        shards = int(np.prod([self.sharding.mesh.shape[a]
+                              for a in axes])) if axes else 1
+
+        def put(a):
+            arr = np.asarray(a) if not hasattr(a, "sharding") else a
+            if getattr(arr, "ndim", 0) >= 1 and arr.shape[0] % shards == 0:
+                return jax.device_put(arr, self.sharding)
+            return a
+        return jtu.tree_map(put, pytree)
 
     # ------------------------------------------------------------------ #
 
@@ -168,6 +195,7 @@ class CompiledScorer:
             columns[f.uid] = c
             if c.kind not in _HOST_KINDS:
                 dev_vals[f.uid] = c.device_value()
+        dev_vals = self._place(dev_vals)
 
         for (kind, stages), jfn in zip(self.segments, self._seg_fns):
             if kind == "host":
@@ -184,7 +212,7 @@ class CompiledScorer:
                     columns[uid] = out_col
                     dv = out_col.device_value()
                     if dv is not None:
-                        dev_vals[uid] = dv
+                        dev_vals[uid] = self._place(dv)
             else:
                 encs: Dict[str, Any] = {}
                 for stage in stages:
@@ -192,7 +220,8 @@ class CompiledScorer:
                     enc = stage.host_prepare(cols)
                     if enc is not None:
                         encs[stage.uid] = enc
-                dev_vals.update(jfn(self._consts, encs, dev_vals))
+                dev_vals.update(jfn(self._consts, self._place(encs),
+                                    dev_vals))
         return dev_vals, columns
 
     def __call__(self, dataset: Dataset) -> Dict[str, Any]:

@@ -304,14 +304,20 @@ class WorkflowModel:
             return columns
         return {f.name: columns[f.uid] for f in self.result_features}
 
-    def score_compiled(self, dataset: Dataset) -> Dict[str, Any]:
-        """Fused-XLA scoring path (the `local/` + MLeap equivalent)."""
-        if self._compiled is None:
-            from transmogrifai_tpu.workflow.compiled import CompiledScorer
-            self._compiled = CompiledScorer(self)
+    def score_compiled(self, dataset: Dataset,
+                       sharding=None) -> Dict[str, Any]:
+        """Fused-XLA scoring path (the `local/` + MLeap equivalent).
+
+        `sharding`: optional row-axis NamedSharding (e.g.
+        `parallel.data_sharding(mesh)`) — batch inputs are placed with it
+        so the fused program's work spreads across the mesh."""
+        from transmogrifai_tpu.workflow.compiled import CompiledScorer
+        if self._compiled is None or \
+                getattr(self._compiled, "sharding", None) != sharding:
+            self._compiled = CompiledScorer(self, sharding=sharding)
         return self._compiled(dataset)
 
-    def score_stream(self, batches, prefetch: int = 2):
+    def score_stream(self, batches, prefetch: int = 2, sharding=None):
         """Streaming micro-batch scoring with host/device overlap
         (OpWorkflowRunner streaming loop, OpWorkflowRunner.scala:233-262 —
         TPU-first: the NEXT batch's host encode runs in a background thread
@@ -325,8 +331,9 @@ class WorkflowModel:
         from concurrent.futures import ThreadPoolExecutor
 
         from transmogrifai_tpu.workflow.compiled import CompiledScorer
-        if self._compiled is None:
-            self._compiled = CompiledScorer(self)
+        if self._compiled is None or \
+                getattr(self._compiled, "sharding", None) != sharding:
+            self._compiled = CompiledScorer(self, sharding=sharding)
         scorer = self._compiled
         try:
             device_fn = scorer.fused_jitted()  # shared compile cache
