@@ -1,0 +1,82 @@
+"""Extract-fn registry + strict save (`utils/fnser.py`): the reference
+persists macro-captured extract-fn class names
+(`FeatureBuilderMacros.scala:40-95`, `FeatureGeneratorStage.scala:129`);
+the `@extract_fn` registry is the name-stable analogue, and
+`save_model(strict_fns=True)` refuses bytecode-pinned closures."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu import extract_fn
+from transmogrifai_tpu.automl import transmogrify
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.utils import fnser
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+
+@extract_fn("fare_log1p")
+def fare_log1p(row):
+    return float(np.log1p(row["fare"]))
+
+
+def _dataset(n=150, seed=0):
+    rng = np.random.default_rng(seed)
+    fare = rng.lognormal(2.5, 1.0, n)
+    age = rng.uniform(1, 80, n)
+    logit = 0.5 * np.log1p(fare) - 0.04 * age
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(int)
+    return Dataset.from_rows(
+        [{"fare": float(fare[i]), "age": float(age[i]), "y": int(y[i])}
+         for i in range(n)],
+        schema={"fare": t.Real, "age": t.Real, "y": t.Integral})
+
+
+def _train(ds, extract):
+    f_fare = FeatureBuilder.Real("fare_feat").extract(extract).as_predictor()
+    f_age = FeatureBuilder.Real("age").from_column("age").as_predictor()
+    label = FeatureBuilder.RealNN("y").from_column("y").as_response()
+    vec = transmogrify([f_fare, f_age])
+    pred = OpLogisticRegression(reg_param=0.01, max_iter=30) \
+        .set_input(label, vec).get_output()
+    return pred, Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+
+
+def test_registry_roundtrip(tmp_path):
+    ds = _dataset()
+    pred, model = _train(ds, fare_log1p)
+    path = str(tmp_path / "m")
+    model.save(path, strict_fns=True)  # registered fn → strict save OK
+    # the manifest stores the NAME, not a pickle payload
+    manifest = (tmp_path / "m" / "op-model.json").read_text()
+    assert "fare_log1p" in manifest and "__pyfn__" not in manifest
+    loaded = WorkflowModel.load(path)
+    a = np.asarray(model.score(ds)[pred.name].data["probability"])
+    b = np.asarray(loaded.score(ds)[pred.name].data["probability"])
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_strict_save_raises_on_closure(tmp_path):
+    ds = _dataset()
+    pred, model = _train(ds, lambda row: float(np.log1p(row["fare"])))
+    with pytest.raises(ValueError, match="extract_fn"):
+        model.save(str(tmp_path / "strict"), strict_fns=True)
+    # non-strict still round-trips via cloudpickle
+    model.save(str(tmp_path / "loose"))
+    loaded = WorkflowModel.load(str(tmp_path / "loose"))
+    a = np.asarray(model.score(ds)[pred.name].data["probability"])
+    b = np.asarray(loaded.score(ds)[pred.name].data["probability"])
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_duplicate_registration_rejected():
+    with pytest.raises(ValueError, match="already registered"):
+        extract_fn("fare_log1p")(lambda r: 0.0)
+
+
+def test_unregistered_load_error_is_helpful():
+    with pytest.raises(KeyError, match="not registered"):
+        fnser.registered_fn("never_registered_name")

@@ -1,0 +1,333 @@
+"""Reference-shaped default sweep parity: elastic-net linear models, RF
+minInfoGain/minInstancesPerNode axes, XGBoost early stopping
+(`DefaultSelectorParams.scala:35-76`,
+`BinaryClassificationModelSelector.scala:70-137`)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.models import (
+    OpLinearRegression, OpLogisticRegression, OpRandomForestClassifier,
+    OpXGBoostClassifier)
+from transmogrifai_tpu.models.linear import fit_linreg, fit_linreg_enet
+from transmogrifai_tpu.models.logistic import fit_logreg, fit_logreg_enet
+from transmogrifai_tpu.models.trees import (
+    bin_features, fit_gbt, fit_gbt_hosted, quantile_bin_edges)
+from transmogrifai_tpu.parallel.sweep import run_sweep
+from transmogrifai_tpu.selector.model_selector import (
+    _default_binary_models, _default_multiclass_models,
+    _default_regression_models)
+from transmogrifai_tpu.stages.base import FitContext
+
+
+@pytest.fixture(scope="module")
+def binary_data():
+    rng = np.random.default_rng(0)
+    n, d = 1500, 10
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    logits = 2 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    return X, y
+
+
+def _folds(n, k=3):
+    return [((np.arange(n) % k != f).astype(np.float32),
+             (np.arange(n) % k == f).astype(np.float32)) for f in range(k)]
+
+
+# --------------------------------------------------------------------------- #
+# elastic net                                                                 #
+# --------------------------------------------------------------------------- #
+
+def test_enet_l1_zero_matches_lbfgs(binary_data):
+    X, y = binary_data
+    w = jnp.ones(len(y), jnp.float32)
+    ref = fit_logreg(jnp.asarray(X), jnp.asarray(y), w, jnp.float32(0.01),
+                     2, 100)
+    fista = fit_logreg_enet(jnp.asarray(X), jnp.asarray(y), w,
+                            jnp.float32(0.0), jnp.float32(0.01), 2, 400)
+    np.testing.assert_allclose(np.asarray(fista["W"]), np.asarray(ref["W"]),
+                               atol=5e-3)
+
+
+def test_enet_l1_produces_sparsity(binary_data):
+    X, y = binary_data
+    w = jnp.ones(len(y), jnp.float32)
+    p = fit_logreg_enet(jnp.asarray(X), jnp.asarray(y), w,
+                        jnp.float32(0.05), jnp.float32(0.0), 2, 400)
+    Wd = np.asarray(p["W"][:, 1] - p["W"][:, 0])
+    nonzero = (np.abs(Wd) > 1e-6).sum()
+    assert nonzero < len(Wd)          # some coefficients exactly zeroed
+    assert nonzero >= 3               # ...but the true signal survives
+
+
+def test_linreg_enet_matches_ridge_and_sparsifies():
+    rng = np.random.default_rng(1)
+    n, d = 800, 12
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.zeros(d); beta[:4] = [2.0, -1.0, 0.5, 1.5]
+    y = (X @ beta + 0.3 + rng.normal(0, 0.05, n)).astype(np.float32)
+    w = jnp.ones(n, jnp.float32)
+    ridge = fit_linreg(jnp.asarray(X), jnp.asarray(y), w, jnp.float32(0.01))
+    fista = fit_linreg_enet(jnp.asarray(X), jnp.asarray(y), w,
+                            jnp.float32(0.0), jnp.float32(0.01))
+    np.testing.assert_allclose(np.asarray(fista["beta"]),
+                               np.asarray(ridge["beta"]), atol=2e-3)
+    lasso = fit_linreg_enet(jnp.asarray(X), jnp.asarray(y), w,
+                            jnp.float32(0.1), jnp.float32(0.0))
+    b = np.asarray(lasso["beta"])
+    assert (np.abs(b) > 1e-6).sum() <= 6  # noise columns zeroed
+
+
+def test_lr_estimator_enet_param(binary_data):
+    X, y = binary_data
+    est = OpLogisticRegression(reg_param=0.05, elastic_net_param=0.5,
+                               max_iter=50)
+    ctx = FitContext(n_rows=len(y), seed=0)
+    model = est.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                           jnp.ones(len(y), jnp.float32), ctx)
+    pred = model.predict_arrays(jnp.asarray(X))
+    acc = float((np.asarray(pred["prediction"]) == y).mean())
+    assert acc > 0.8
+    # the L1 half of the penalty must zero at least the weakest coords
+    Wd = np.abs(model.W[:, 1] - model.W[:, 0])
+    assert (Wd < 1e-6).sum() >= 1
+
+
+def test_lr_enet_sweep_grid(binary_data):
+    X, y = binary_data
+    est = OpLogisticRegression(max_iter=50)
+    grids = [{"reg_param": r, "elastic_net_param": a}
+             for a in (0.1, 0.5) for r in (0.001, 0.01, 0.1, 0.2)]
+    m = run_sweep(est, grids, jnp.asarray(X), jnp.asarray(y),
+                  _folds(len(y)), BinaryClassificationEvaluator(metric="AuPR"),
+                  FitContext(n_rows=len(y), seed=0))
+    m = np.asarray(m, dtype=float)
+    assert m.shape == (8, 3)
+    assert np.all(np.isfinite(m)) and np.all(m > 0.6)
+    # heavier L1 (alpha .5, reg .2) must actually change the metric
+    assert not np.allclose(m[0], m[7])
+
+
+# --------------------------------------------------------------------------- #
+# forest grid axes                                                            #
+# --------------------------------------------------------------------------- #
+
+def test_rf_min_info_gain_prunes_splits(binary_data):
+    X, y = binary_data
+    ctx = FitContext(n_rows=len(y), seed=0)
+    w = jnp.ones(len(y), jnp.float32)
+    loose = OpRandomForestClassifier(n_trees=5, max_depth=6,
+                                     min_info_gain=0.0)
+    tight = OpRandomForestClassifier(n_trees=5, max_depth=6,
+                                     min_info_gain=0.3)
+    m_loose = loose.fit_arrays(jnp.asarray(X), jnp.asarray(y), w, ctx)
+    m_tight = tight.fit_arrays(jnp.asarray(X), jnp.asarray(y), w, ctx)
+    # bin == n_bins means "no split": the tight threshold must prune more
+    splits_loose = (m_loose.trees["bin"] < loose.max_bins).sum()
+    splits_tight = (m_tight.trees["bin"] < tight.max_bins).sum()
+    assert splits_tight < splits_loose
+
+
+def test_rf_min_instances_per_node(binary_data):
+    X, y = binary_data
+    ctx = FitContext(n_rows=len(y), seed=0)
+    w = jnp.ones(len(y), jnp.float32)
+    many = OpRandomForestClassifier(n_trees=3, max_depth=8,
+                                    min_instances_per_node=1.0)
+    few = OpRandomForestClassifier(n_trees=3, max_depth=8,
+                                   min_instances_per_node=200.0)
+    s_many = (many.fit_arrays(jnp.asarray(X), jnp.asarray(y), w, ctx)
+              .trees["bin"] < 32).sum()
+    s_few = (few.fit_arrays(jnp.asarray(X), jnp.asarray(y), w, ctx)
+             .trees["bin"] < 32).sum()
+    assert s_few < s_many
+
+
+def test_rf_sweep_reference_grid(binary_data):
+    X, y = binary_data
+    est = OpRandomForestClassifier(n_trees=10)
+    grids = [{"max_depth": d, "min_info_gain": g, "min_instances_per_node": m}
+             for d in (3, 6) for g in (0.001, 0.1) for m in (10.0, 100.0)]
+    m = run_sweep(est, grids, jnp.asarray(X), jnp.asarray(y),
+                  _folds(len(y)), BinaryClassificationEvaluator(metric="AuPR"),
+                  FitContext(n_rows=len(y), seed=0))
+    m = np.asarray(m, dtype=float)
+    assert m.shape == (8, 3) and np.all(np.isfinite(m))
+    # the min_info_gain axis must differentiate configs
+    assert not np.allclose(m[0].mean(), m[2].mean())
+
+
+# --------------------------------------------------------------------------- #
+# XGBoost early stopping                                                      #
+# --------------------------------------------------------------------------- #
+
+def _overfit_data():
+    """Tiny noisy problem a deep 0.5-eta booster overfits within a few
+    rounds — early stopping must freeze well before the round budget."""
+    rng = np.random.default_rng(3)
+    n, d = 400, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = ((X[:, 0] + rng.normal(0, 1.5, n)) > 0).astype(np.float32)
+    return X, y
+
+
+def _effective_rounds(trees) -> int:
+    leaves = np.asarray(trees["leaf"])
+    return int(np.any(np.abs(leaves.reshape(leaves.shape[0], -1)) > 0,
+                      axis=1).sum())
+
+
+def test_gbt_early_stopping_fewer_rounds():
+    X, y = _overfit_data()
+    n = len(y)
+    Xb = bin_features(jnp.asarray(X), jnp.asarray(quantile_bin_edges(X, 16)))
+    rng = np.random.default_rng(0)
+    val = jnp.asarray((rng.uniform(size=n) < 0.3), dtype=jnp.float32)
+    trees, _ = fit_gbt(Xb, jnp.asarray(y), 1.0 - val, 60, 5, 16,
+                       jnp.float32(0.5), jnp.float32(1.0), "logistic",
+                       seed=0, val_w=val, early_stopping_rounds=5)
+    stopped = _effective_rounds(trees)
+    trees_full, _ = fit_gbt(Xb, jnp.asarray(y), 1.0 - val, 60, 5, 16,
+                            jnp.float32(0.5), jnp.float32(1.0), "logistic",
+                            seed=0)
+    assert _effective_rounds(trees_full) == 60
+    assert stopped < 60
+
+
+def test_gbt_hosted_early_stop_skips_dispatches():
+    X, y = _overfit_data()
+    n = len(y)
+    Xb = bin_features(jnp.asarray(X), jnp.asarray(quantile_bin_edges(X, 16)))
+    rng = np.random.default_rng(0)
+    val = jnp.asarray((rng.uniform(size=n) < 0.3), dtype=jnp.float32)
+    trees, _ = fit_gbt_hosted(Xb, jnp.asarray(y), 1.0 - val, 60, 5, 16,
+                              jnp.float32(0.5), jnp.float32(1.0), "logistic",
+                              seed=0, val_w=val, early_stopping_rounds=5,
+                              rounds_per_dispatch=10)
+    # the host loop truncates: fewer trees MATERIALIZED, not just zeroed
+    assert np.asarray(trees["leaf"]).shape[0] < 60
+    # and the materialized prefix matches the monolithic ES fit bitwise
+    full, _ = fit_gbt(Xb, jnp.asarray(y), 1.0 - val, 60, 5, 16,
+                      jnp.float32(0.5), jnp.float32(1.0), "logistic",
+                      seed=0, val_w=val, early_stopping_rounds=5)
+    k = np.asarray(trees["leaf"]).shape[0]
+    np.testing.assert_array_equal(np.asarray(trees["leaf"]),
+                                  np.asarray(full["leaf"])[:k])
+
+
+def test_xgb_sweep_es_matches_refit(binary_data):
+    """The early-stopped sweep metric and a refit with the winning grid
+    must describe the same algorithm: refit on the sweep's train fold
+    reproduces the sweep's fold metric."""
+    X, y = binary_data
+    n = len(y)
+    est = OpXGBoostClassifier(n_estimators=30, eta=0.3, max_depth=3,
+                              early_stopping_rounds=5)
+    grids = [{"min_child_weight": 1.0}]
+    folds = _folds(n, 3)[:1]
+    ev = BinaryClassificationEvaluator(metric="AuPR")
+    ctx = FitContext(n_rows=n, seed=0)
+    m = run_sweep(est, grids, jnp.asarray(X), jnp.asarray(y), folds, ev, ctx)
+    tr, va = folds[0]
+    # refit with the SWEEP's fold semantics: train rows weighted by the
+    # fold mask, early-stop eval on the validation rows
+    trees, margin = fit_gbt_hosted(
+        bin_features(jnp.asarray(X),
+                     jnp.asarray(quantile_bin_edges(X, est.max_bins))),
+        jnp.asarray(y), jnp.asarray(tr), 30, 3, est.max_bins,
+        jnp.float32(0.3), jnp.float32(1.0), "logistic", seed=0,
+        val_w=jnp.asarray(va), early_stopping_rounds=5)
+    from transmogrifai_tpu.models.trees import gbt_pred_from_margin
+    from transmogrifai_tpu.data.columns import Column
+    import transmogrifai_tpu.types as t
+    pred = gbt_pred_from_margin(margin, "logistic")
+    idx = va > 0.5
+    pcol = Column(t.Prediction,
+                  {k: np.asarray(v)[idx] for k, v in pred.items()})
+    lcol = Column(t.RealNN, {"value": y[idx].astype(np.float64),
+                             "mask": np.ones(int(idx.sum()), bool)})
+    refit_metric = ev.metric_value(lcol, pcol)
+    assert m[0][0] == pytest.approx(refit_metric, abs=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# default grid shapes                                                         #
+# --------------------------------------------------------------------------- #
+
+def test_default_grid_shapes_match_reference():
+    binary = _default_binary_models()
+    assert [len(g) for _, g in binary] == [8, 18, 2]       # 28 configs
+    lr, rf, xgb = (e for e, _ in binary)
+    assert lr.max_iter == 50
+    assert rf.n_trees == 50
+    assert xgb.n_estimators == 200 and xgb.learning_rate == 0.02
+    assert xgb.max_depth == 10 and xgb.gamma == 0.8
+    assert xgb.early_stopping_rounds == 20
+    assert {g["min_child_weight"] for _, gs in binary[2:] for g in gs} \
+        == {1.0, 10.0}
+
+    multi = _default_multiclass_models()
+    assert [len(g) for _, g in multi] == [8, 18]           # 26 configs
+
+    reg = _default_regression_models()
+    assert [len(g) for _, g in reg] == [8, 18, 18]         # 44 configs
+    gbt = reg[2][0]
+    assert gbt.n_estimators == 20 and gbt.learning_rate == 0.1
+
+
+# --------------------------------------------------------------------------- #
+# GLM link functions                                                          #
+# --------------------------------------------------------------------------- #
+
+class TestGLMLinks:
+    @pytest.mark.parametrize("family,link", [
+        ("gaussian", "identity"), ("gaussian", "log"),
+        ("binomial", "logit"), ("binomial", "probit"),
+        ("binomial", "cloglog"), ("poisson", "log"), ("poisson", "sqrt"),
+        ("gamma", "log"), ("gamma", "inverse")])
+    def test_link_fits(self, family, link):
+        from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+        rng = np.random.default_rng(7)
+        n = 500
+        x = rng.uniform(0.2, 2.0, n).astype(np.float32)
+        X = x[:, None]
+        eta = 0.8 * x + 0.2
+        if family == "binomial":
+            mu = 1 / (1 + np.exp(-eta))
+            y = (rng.uniform(size=n) < mu).astype(np.float32)
+        elif family == "poisson":
+            y = rng.poisson(np.exp(0.3 * x)).astype(np.float32)
+        elif family == "gamma":
+            y = rng.gamma(2.0, np.exp(0.3 * x) / 2.0).astype(np.float32) + 1e-3
+        else:
+            y = (eta + rng.normal(0, 0.1, n)).astype(np.float32)
+        est = OpGeneralizedLinearRegression(family=family, link=link,
+                                            max_iter=60)
+        ctx = FitContext(n_rows=n, seed=0)
+        model = est.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                               jnp.ones(n, jnp.float32), ctx)
+        pred = np.asarray(model.predict_arrays(jnp.asarray(X))["prediction"])
+        assert np.all(np.isfinite(pred))
+        # predictions live in the family's mean domain
+        if family == "binomial":
+            assert np.all((pred >= 0) & (pred <= 1))
+            acc = ((pred > 0.5) == (y > 0.5)).mean()
+            assert acc > 0.5
+        elif family in ("poisson", "gamma"):
+            assert np.all(pred >= 0)
+
+    def test_invalid_link_rejected(self):
+        from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+        with pytest.raises(ValueError, match="invalid for family"):
+            OpGeneralizedLinearRegression(family="binomial", link="log")
+
+    def test_link_roundtrips_through_model_params(self):
+        from transmogrifai_tpu.models.glm import GLMModel
+        m = GLMModel(beta=[1.0], b=0.5, family="binomial", link="probit")
+        p = m.get_params()
+        assert p["link"] == "probit"
+        m2 = GLMModel(**{k: v for k, v in p.items()})
+        assert m2.link == "probit"

@@ -106,14 +106,15 @@ def test_binary_selector_end_to_end():
     model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
     fitted = model.fitted[pf.origin_stage.uid]
     s = fitted.summary
-    # reference-parity default families: LR (4 grids) + RF (6) + XGB (4)
+    # reference-shaped default grid (BinaryClassificationModelSelector.scala
+    # :70-137): LR 8 elastic-net configs + RF 18 + XGB 2 = 28
     assert s.best_model in ("OpLogisticRegression", "OpRandomForestClassifier",
                             "OpXGBoostClassifier")
-    assert len(s.validation_results) == 14
+    assert len(s.validation_results) == 28
     assert all(len(r.fold_metrics) == 3 for r in s.validation_results)
     assert s.holdout_metrics["AuPR"] > 0.7
     assert s.train_metrics["AuROC"] > 0.7
-    assert "Evaluated 14 model configs" in s.pretty()
+    assert "Evaluated 28 model configs" in s.pretty()
 
 
 def test_multiclass_selector():

@@ -21,6 +21,7 @@ Quickstart (mirrors reference README.md:31-61):
 """
 
 from transmogrifai_tpu.utils.uid import UID
+from transmogrifai_tpu.utils.fnser import extract_fn  # noqa: F401 — stable extract-fn names
 from transmogrifai_tpu.aggregators import CutOffTime, Event
 from transmogrifai_tpu.readers import DataReaders
 from transmogrifai_tpu.types import *  # noqa: F401,F403 — the feature type lattice

@@ -215,12 +215,24 @@ def _encoder(schema: Any, names: _Names) -> Callable[[io.BytesIO, Any], None]:
                 return bt in ("map", "record")
             return False
 
+        def exact(s, v) -> bool:
+            """Exact-type branch preference: a python int must pick long/int
+            over a widening double branch regardless of union order, or
+            integral map values lose typing (and exactness above 2^53)."""
+            bt = s if isinstance(s, str) else s.get("type")
+            if isinstance(v, bool):
+                return bt == "boolean"
+            if isinstance(v, int):
+                return bt in ("long", "int")
+            return False
+
         def eu(o, v, branches=branches):
-            for i, (s, enc) in enumerate(branches):
-                if matches(s, v):
-                    _write_long(o, i)
-                    enc(o, v)
-                    return
+            for pred in (exact, matches):
+                for i, (s, enc) in enumerate(branches):
+                    if pred(s, v):
+                        _write_long(o, i)
+                        enc(o, v)
+                        return
             raise ValueError(f"no union branch for {type(v).__name__}")
         return eu
     t = schema["type"]
@@ -432,8 +444,9 @@ def dataset_avro_schema(ds, name: str = "Record") -> Dict[str, Any]:
         elif issubclass(ftype, T.Geolocation):
             base = {"type": "array", "items": "double"}
         elif issubclass(ftype, T.OPMap):
-            base = {"type": "map", "values": ["null", "string", "double",
-                                              "boolean", "long"]}
+            # long BEFORE double so integral map values keep integer typing
+            base = {"type": "map", "values": ["null", "string", "long",
+                                              "double", "boolean"]}
         else:
             base = "string"
         fields.append({"name": col, "type": ["null", base], "default": None})

@@ -1,8 +1,11 @@
-"""Generalized linear models (gaussian/binomial/poisson/gamma/tweedie).
+"""Generalized linear models (gaussian/binomial/poisson/gamma/tweedie)
+with the full Spark family × link surface.
 
 Reference parity: `core/.../impl/regression/OpGeneralizedLinearRegression.scala`
-(Spark GLR: family+link, IRLS). Here: penalized negative log-likelihood
-minimized with L-BFGS in a fixed-length scan — same optimum, vmappable.
+(Spark GLR: family+link, IRLS; valid links per family listed in
+`DefaultSelectorParams.scala:57-64`). Here: penalized negative
+log-likelihood minimized with L-BFGS in a fixed-length scan — same
+optimum, vmappable.
 """
 
 from __future__ import annotations
@@ -19,7 +22,24 @@ from transmogrifai_tpu.models.base import PredictionModel, PredictorEstimator
 from transmogrifai_tpu.stages.base import FitContext
 
 FAMILIES = ("gaussian", "binomial", "poisson", "gamma", "tweedie")
+# Spark GLR's family → valid links table (first = canonical default,
+# DefaultSelectorParams.scala:58-64); tweedie uses a power link derived
+# from var_power instead of a named link
+VALID_LINKS = {
+    "gaussian": ("identity", "log", "inverse"),
+    "binomial": ("logit", "probit", "cloglog"),
+    "poisson": ("log", "identity", "sqrt"),
+    "gamma": ("inverse", "identity", "log"),
+    "tweedie": ("power",),
+}
 _EPS = 1e-8
+
+# links the pre-link-param builds hard-coded per family: manifests saved
+# without a "link" key were trained under THESE, so GLMModel must default
+# to them (not the Spark-canonical table) to keep old models predicting
+# identically
+_LEGACY_LINKS = {"gaussian": "identity", "binomial": "logit",
+                 "poisson": "log", "gamma": "log", "tweedie": "log"}
 
 
 def _neg_log_likelihood(family: str, mu, y, var_power: float = 1.5):
@@ -41,24 +61,86 @@ def _neg_log_likelihood(family: str, mu, y, var_power: float = 1.5):
     raise ValueError(f"Unknown family {family!r}")
 
 
-def _inverse_link(family: str, eta):
-    if family == "gaussian":
-        return eta  # identity
-    if family == "binomial":
-        return jax.nn.sigmoid(eta)  # logit link
-    return jnp.exp(eta)  # log link (poisson/gamma/tweedie)
+def canonical_link(family: str) -> str:
+    return VALID_LINKS[family][0]
 
 
-@partial(jax.jit, static_argnames=("family", "max_iter"))
+def _inverse_link(family: str, eta, link: Optional[str] = None,
+                  var_power: float = 1.5):
+    """mu = g⁻¹(eta) for every Spark GLR link. Non-canonical links clamp
+    eta into the link's domain instead of producing NaNs mid-optimization
+    (Spark's IRLS guards equivalently)."""
+    link = link or canonical_link(family)
+    if link == "identity":
+        return eta
+    if link == "log":
+        return jnp.exp(eta)
+    if link == "inverse":
+        return 1.0 / jnp.where(jnp.abs(eta) < _EPS,
+                               jnp.where(eta < 0, -_EPS, _EPS), eta)
+    if link == "logit":
+        return jax.nn.sigmoid(eta)
+    if link == "probit":
+        return jnp.clip(jax.scipy.stats.norm.cdf(eta), _EPS, 1 - _EPS)
+    if link == "cloglog":
+        return jnp.clip(-jnp.expm1(-jnp.exp(eta)), _EPS, 1 - _EPS)
+    if link == "sqrt":
+        return eta ** 2
+    if link == "power":  # tweedie: linkPower = 1 − var_power (Spark default)
+        lp = 1.0 - var_power
+        if abs(lp) < 1e-12:
+            return jnp.exp(eta)
+        return jnp.maximum(eta, _EPS) ** (1.0 / lp)
+    raise ValueError(f"Unknown link {link!r}")
+
+
+def _link_fwd(family: str, mu, link: Optional[str] = None,
+              var_power: float = 1.5):
+    """eta = g(mu) — used to initialize the intercept at g(mean(y)).
+    Zero-initialization breaks non-log links whose inverse clamps around
+    eta=0 (gamma's 1/eta, tweedie's power): the clamp's zero derivative
+    kills the whole gradient, so L-BFGS never moves. Starting at the
+    weighted mean (standard IRLS init) keeps eta in the link's domain."""
+    link = link or canonical_link(family)
+    if link == "identity":
+        return mu
+    if link == "log":
+        return jnp.log(jnp.maximum(mu, _EPS))
+    if link == "inverse":
+        return 1.0 / jnp.maximum(mu, _EPS)
+    if link == "logit":
+        mu = jnp.clip(mu, _EPS, 1 - _EPS)
+        return jnp.log(mu / (1 - mu))
+    if link == "probit":
+        from jax.scipy.special import ndtri
+        return ndtri(jnp.clip(mu, _EPS, 1 - _EPS))
+    if link == "cloglog":
+        mu = jnp.clip(mu, _EPS, 1 - _EPS)
+        return jnp.log(-jnp.log1p(-mu))
+    if link == "sqrt":
+        return jnp.sqrt(jnp.maximum(mu, 0.0))
+    if link == "power":
+        lp = 1.0 - var_power
+        if abs(lp) < 1e-12:
+            return jnp.log(jnp.maximum(mu, _EPS))
+        return jnp.maximum(mu, _EPS) ** lp
+    raise ValueError(f"Unknown link {link!r}")
+
+
+# var_power is static: the power-link branch (`abs(1 − var_power)`) is
+# python control flow, and sweep grids treat it as a static group key too
+@partial(jax.jit, static_argnames=("family", "max_iter", "link", "var_power"))
 def fit_glm(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, l2,
             family: str = "gaussian", max_iter: int = 100,
-            var_power: float = 1.5) -> Dict:
+            var_power: float = 1.5, link: Optional[str] = None) -> Dict:
     d = X.shape[1]
-    params = {"beta": jnp.zeros((d,), jnp.float32), "b": jnp.float32(0.0)}
+    mean_y = (y * w).sum() / jnp.maximum(w.sum(), 1.0)
+    b0 = _link_fwd(family, mean_y, link, var_power).astype(jnp.float32)
+    params = {"beta": jnp.zeros((d,), jnp.float32), "b": b0}
 
     def loss_fn(p):
         eta = X @ p["beta"] + p["b"]
-        mu = _inverse_link(family, eta)
+        mu = _inverse_link(family, eta, link, var_power)
         nll = _neg_log_likelihood(family, mu, y, var_power)
         return (nll * w).sum() / jnp.maximum(w.sum(), 1.0) \
             + 0.5 * l2 * (p["beta"] ** 2).sum()
@@ -77,43 +159,64 @@ def fit_glm(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, l2,
     return params
 
 
-def predict_glm(params: Dict, X: jnp.ndarray, family: str) -> Dict:
+def predict_glm(params: Dict, X: jnp.ndarray, family: str,
+                link: Optional[str] = None, var_power: float = 1.5) -> Dict:
     eta = X @ params["beta"] + params["b"]
-    mu = _inverse_link(family, eta)
+    mu = _inverse_link(family, eta, link, var_power)
     return {"prediction": mu, "rawPrediction": eta[:, None],
             "probability": jnp.zeros((X.shape[0], 0), X.dtype)}
 
 
 class GLMModel(PredictionModel):
     def __init__(self, beta=None, b: float = 0.0, family: str = "gaussian",
+                 link: Optional[str] = None, var_power: float = 1.5,
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.beta = np.asarray(beta, dtype=np.float32)
         self.b = float(b)
         self.family = family
+        # no-link default = LEGACY hard-coded link, so pre-link-param
+        # manifests (no "link" key) reload predicting exactly as saved;
+        # new fits always pass the resolved link explicitly
+        self.link = link or _LEGACY_LINKS[family]
+        self.var_power = float(var_power)
 
     def predict_arrays(self, X):
         return predict_glm({"beta": jnp.asarray(self.beta),
-                            "b": jnp.float32(self.b)}, X, self.family)
+                            "b": jnp.float32(self.b)}, X, self.family,
+                           self.link, self.var_power)
 
     def get_params(self):
-        return {"beta": self.beta.tolist(), "b": self.b, "family": self.family}
+        return {"beta": self.beta.tolist(), "b": self.b,
+                "family": self.family, "link": self.link,
+                "var_power": self.var_power}
 
 
 class OpGeneralizedLinearRegression(PredictorEstimator):
+    """family × link as in Spark GLR (`OpGeneralizedLinearRegression.scala`);
+    `link=None` means the family's canonical link. Invalid combinations
+    raise at construction, mirroring Spark's parameter validation."""
+
     def __init__(self, family: str = "gaussian", reg_param: float = 0.0,
                  max_iter: int = 100, var_power: float = 1.5,
-                 uid: Optional[str] = None):
+                 link: Optional[str] = None, uid: Optional[str] = None):
         if family not in FAMILIES:
             raise ValueError(f"family must be one of {FAMILIES}")
+        if link is not None and link not in VALID_LINKS[family]:
+            raise ValueError(
+                f"link {link!r} invalid for family {family!r}; "
+                f"valid: {VALID_LINKS[family]}")
         super().__init__(uid=uid, family=family, reg_param=reg_param,
-                         max_iter=max_iter, var_power=var_power)
+                         max_iter=max_iter, var_power=var_power, link=link)
         self.family = family
         self.reg_param = reg_param
         self.max_iter = max_iter
         self.var_power = var_power
+        self.link = link
 
     def fit_arrays(self, X, y, w, ctx: FitContext) -> GLMModel:
+        link = self.link or canonical_link(self.family)
         p = fit_glm(X, y, w, jnp.float32(self.reg_param), self.family,
-                    self.max_iter, self.var_power)
-        return GLMModel(np.asarray(p["beta"]), float(p["b"]), self.family)
+                    self.max_iter, self.var_power, link)
+        return GLMModel(np.asarray(p["beta"]), float(p["b"]), self.family,
+                        link, self.var_power)

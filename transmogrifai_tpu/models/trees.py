@@ -81,9 +81,13 @@ def _select_bin(Xb: jnp.ndarray, feat_idx: jnp.ndarray) -> jnp.ndarray:
 def bins_onehot(Xb: jnp.ndarray, n_bins: int) -> jnp.ndarray:
     """(n, d, bins) bf16 one-hot of the binned matrix — the histogram
     reduction operand, built ONCE per training matrix and reused across
-    every level, tree, round, fold, and grid config. 0/1 is exact in
-    bf16, so histogram counts lose no precision while the matmuls run at
-    full MXU rate."""
+    every level, tree, round, fold, and grid config. The 0/1 operand is
+    exact in bf16; the OTHER matmul operand (gradient/hessian values in
+    `_histograms`) is bf16-quantized to ~0.4% relative error — a
+    deliberate precision/throughput tradeoff (full MXU rate, f32
+    accumulation): near-tie split choices may differ from an f32
+    scatter-add histogram, which changes individual trees but not metric
+    quality (split ties are statistically arbitrary anyway)."""
     return jax.nn.one_hot(Xb, n_bins, dtype=jnp.bfloat16)
 
 
@@ -112,12 +116,51 @@ def _histograms(B, node_idx, G, H, n_nodes: int):
     return hg, hh
 
 
+def split_from_histograms(hg, hh, n_bins: int, reg_lambda,
+                          min_child_weight, min_gain, min_gain_norm,
+                          feature_mask, level: int, active_depth
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-node best (feature, bin) from (m, nodes, d, bins) gradient and
+    (nodes, d, bins) weight histograms — shared by the in-core level loop
+    and the chunked big-data path (`parallel/bigdata.py`)."""
+    n_nodes = hh.shape[0]
+    cg = jnp.cumsum(hg, axis=-1)          # left sums at split-bin b
+    ch = jnp.cumsum(hh, axis=-1)          # (nodes, d, bins)
+    tg = cg[..., -1:]
+    th = ch[..., -1:]
+    score = lambda g, h: (g ** 2).sum(0) / (h + reg_lambda)  # noqa: E731
+    gain = score(cg, ch) + score(tg - cg, th - ch) - score(tg, th)
+    valid = (ch >= min_child_weight) & ((th - ch) >= min_child_weight)
+    gain = jnp.where(valid, gain, -jnp.inf)
+    if feature_mask is not None:
+        gain = jnp.where(feature_mask[None, :, None], gain, -jnp.inf)
+    flat = gain.reshape(n_nodes, -1)      # (nodes, d*bins)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+    bf = (best // n_bins).astype(jnp.int32)
+    bb = (best % n_bins).astype(jnp.int32)
+    # a node with no usable gain "splits" at bin >= n_bins-1 → all left.
+    # Two threshold scales coexist: `min_gain` compares raw (XGBoost
+    # gamma), while `min_gain_norm` scales by the node's total weight —
+    # with one-hot G / count H the unified score satisfies
+    # (score_L + score_R − score_P)/h == Spark's gini/variance
+    # impurity improvement, so the normalized threshold is EXACTLY
+    # MLlib's minInfoGain scale ({0.001, 0.01, 0.1} in
+    # DefaultSelectorParams.scala:39). Both may be traced grid values.
+    splits = best_gain > jnp.maximum(min_gain, min_gain_norm * th[:, 0, 0])
+    if active_depth is not None:
+        splits = splits & (level < active_depth)
+    bb = jnp.where(splits, bb, n_bins)
+    return bf, bb
+
+
 def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               max_depth: int, n_bins: int, reg_lambda: float = 1.0,
               min_child_weight: float = 1.0, min_gain: float = 0.0,
               feature_mask: Optional[jnp.ndarray] = None,
               active_depth=None, alpha: float = 0.0,
-              B: Optional[jnp.ndarray] = None) -> Dict:
+              B: Optional[jnp.ndarray] = None,
+              min_gain_norm=0.0) -> Dict:
     """Grow one fixed-depth tree. Returns dense arrays:
 
     {"feat": (depth, 2^depth) int32, "bin": (depth, 2^depth) int32,
@@ -142,26 +185,9 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     for level in range(max_depth):
         n_nodes = 2 ** level
         hg, hh = _histograms(B, node_idx, G, H, n_nodes)
-        cg = jnp.cumsum(hg, axis=-1)          # left sums at split-bin b
-        ch = jnp.cumsum(hh, axis=-1)          # (nodes, d, bins)
-        tg = cg[..., -1:]
-        th = ch[..., -1:]
-        score = lambda g, h: (g ** 2).sum(0) / (h + reg_lambda)  # noqa: E731
-        gain = score(cg, ch) + score(tg - cg, th - ch) - score(tg, th)
-        valid = (ch >= min_child_weight) & ((th - ch) >= min_child_weight)
-        gain = jnp.where(valid, gain, -jnp.inf)
-        if feature_mask is not None:
-            gain = jnp.where(feature_mask[None, :, None], gain, -jnp.inf)
-        flat = gain.reshape(n_nodes, -1)      # (nodes, d*bins)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
-        bf = (best // n_bins).astype(jnp.int32)
-        bb = (best % n_bins).astype(jnp.int32)
-        # a node with no usable gain "splits" at bin >= n_bins-1 → all left
-        splits = best_gain > min_gain
-        if active_depth is not None:
-            splits = splits & (level < active_depth)
-        bb = jnp.where(splits, bb, n_bins)
+        bf, bb = split_from_histograms(
+            hg, hh, n_bins, reg_lambda, min_child_weight, min_gain,
+            min_gain_norm, feature_mask, level, active_depth)
         feats = feats.at[level, :n_nodes].set(bf)
         bins = bins.at[level, :n_nodes].set(bb)
         if n_nodes <= 256:
@@ -222,7 +248,8 @@ _TREE_CHUNK_BUDGET = 1 << 26  # live per-tree working-set elements (bf16)
 def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
                n_outputs: int, seed, subsample_features: bool = True,
                min_child_weight: float = 1.0, active_depth=None,
-               bootstrap: bool = True, tree_budget_divisor: int = 1):
+               bootstrap: bool = True, tree_budget_divisor: int = 1,
+               min_gain=0.0):
     n, d = Xb.shape
     keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
     n_sub = max(int(np.sqrt(d)), 1) if subsample_features else d
@@ -242,6 +269,7 @@ def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
             fmask = jnp.ones((d,), bool)
         return grow_tree(Xb, Y * boot[:, None], boot, max_depth, n_bins,
                          reg_lambda=1e-6, min_child_weight=min_child_weight,
+                         min_gain_norm=min_gain,
                          feature_mask=fmask, active_depth=active_depth, B=B)
 
     # Bound simultaneous per-tree working set: each live instance holds the
@@ -278,21 +306,36 @@ def predict_forest(trees: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
 # Gradient boosting (XGBoost-style second order)                              #
 # --------------------------------------------------------------------------- #
 
-@partial(jax.jit, static_argnames=("n_estimators", "max_depth", "n_bins",
-                                   "objective"))
-def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
-            learning_rate, reg_lambda, objective: str = "logistic",
-            min_child_weight: float = 1.0, active_depth=None,
-            gamma=0.0, alpha=0.0, subsample=1.0, colsample=1.0, seed=0):
-    """Returns (trees, final_margin): the scan carry already holds the full
-    training-matrix margin, so sweep callers need not re-walk the forest.
+def _gbt_val_loss(margin, y, val_w, objective: str):
+    """Per-round early-stopping metric on the held-out rows: weighted
+    logloss (binary) / MSE (squared) — both minimized. The reference's
+    default eval metric is aucpr (`DefaultSelectorParams.scala:71`); a
+    per-round device AuPR would add a 90k-row sort to every boosting round,
+    so the scan tracks the cheap strictly-proper logloss instead and the
+    selector still ranks configs by AuPR."""
+    vs = jnp.maximum(val_w.sum(), 1.0)
+    if objective == "logistic":
+        ll = jax.nn.softplus(margin) - y * margin  # -log p(y|margin)
+        return (ll * val_w).sum() / vs
+    return (((margin - y) ** 2) * val_w).sum() / vs
 
-    XGBoost param surface (OpXGBoostClassifier.scala / XGBoostParams.scala):
-    `gamma` = min split gain, `alpha` = leaf L1, `subsample` = per-round
-    row sampling, `colsample` = per-tree feature sampling."""
+
+def _gbt_scan(Xb, y, w, val_w, margin0, best0, since0, keys,
+              max_depth: int, n_bins: int, learning_rate, reg_lambda,
+              objective: str, min_child_weight, active_depth, gamma, alpha,
+              subsample, colsample, early_stopping_rounds: int,
+              min_gain_norm=0.0):
+    """Shared traced boosting loop. Carry = (margin, best_val, since);
+    with `early_stopping_rounds` > 0, a round whose start state has
+    `since >= early_stopping_rounds` grows a ZEROED tree (leaf *= 0), so
+    the margin freezes and the trailing trees are exact no-ops — the model
+    the scan returns is the early-stopped model even though the scan's
+    length is static (XGBoost semantics: stop adding trees once the eval
+    metric hasn't improved for N rounds,
+    `XGBoostParams.scala numEarlyStoppingRounds`)."""
     n, d = Xb.shape
-
     B = bins_onehot(Xb, n_bins)  # shared across all boosting rounds
+    esr = int(early_stopping_rounds)
 
     def grads(margin):
         if objective == "logistic":
@@ -300,7 +343,8 @@ def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
             return (p - y) * w, jnp.maximum(p * (1 - p), 1e-6) * w
         return (margin - y) * w, w  # squared error
 
-    def round_(margin, key):
+    def round_(carry, key):
+        margin, best, since = carry
         k1, k2 = jax.random.split(key)
         # uniform draws in [0,1): rate 1.0 keeps everything (no-op default)
         rows = (jax.random.uniform(k1, (n,)) < subsample).astype(jnp.float32)
@@ -309,14 +353,125 @@ def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
         tree = grow_tree(Xb, (-g * rows)[:, None], h * rows, max_depth,
                          n_bins, reg_lambda=reg_lambda,
                          min_child_weight=min_child_weight,
-                         min_gain=gamma, feature_mask=fmask,
+                         min_gain=gamma, min_gain_norm=min_gain_norm,
+                         feature_mask=fmask,
                          active_depth=active_depth, alpha=alpha, B=B)
+        if esr > 0:
+            live = (since < esr).astype(jnp.float32)
+            tree["leaf"] = tree["leaf"] * live
         margin = margin + learning_rate * predict_tree(tree, Xb)[:, 0]
-        return margin, tree
+        if esr > 0:
+            m = _gbt_val_loss(margin, y, val_w, objective)
+            improved = m < best - 1e-7
+            since = jnp.where(since >= esr, since,
+                              jnp.where(improved, 0, since + 1))
+            best = jnp.minimum(best, m)
+        return (margin, best, since), tree
 
+    return jax.lax.scan(round_, (margin0, best0, since0), keys)
+
+
+@partial(jax.jit, static_argnames=("n_estimators", "max_depth", "n_bins",
+                                   "objective", "early_stopping_rounds"))
+def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
+            learning_rate, reg_lambda, objective: str = "logistic",
+            min_child_weight: float = 1.0, active_depth=None,
+            gamma=0.0, alpha=0.0, subsample=1.0, colsample=1.0, seed=0,
+            val_w=None, early_stopping_rounds: int = 0, min_gain_norm=0.0):
+    """Returns (trees, final_margin): the scan carry already holds the full
+    training-matrix margin, so sweep callers need not re-walk the forest.
+
+    XGBoost param surface (OpXGBoostClassifier.scala / XGBoostParams.scala):
+    `gamma` = min split gain, `alpha` = leaf L1, `subsample` = per-round
+    row sampling, `colsample` = per-tree feature sampling; `val_w` +
+    `early_stopping_rounds` = numEarlyStoppingRounds over a held-out row
+    mask (trailing rounds after the stop are zeroed trees)."""
+    n = Xb.shape[0]
+    if val_w is None:
+        val_w = jnp.zeros(n, jnp.float32)
+        early_stopping_rounds = 0
     keys = jax.random.split(jax.random.PRNGKey(seed), n_estimators)
-    base = jnp.zeros(n, jnp.float32)
-    margin, trees = jax.lax.scan(round_, base, keys)
+    (margin, _, _), trees = _gbt_scan(
+        Xb, y, w, val_w, jnp.zeros(n, jnp.float32), jnp.float32(jnp.inf),
+        jnp.int32(0), keys, max_depth, n_bins, learning_rate, reg_lambda,
+        objective, min_child_weight, active_depth, gamma, alpha, subsample,
+        colsample, early_stopping_rounds, min_gain_norm)
+    return trees, margin
+
+
+@partial(jax.jit, static_argnames=("n_rounds", "max_depth", "n_bins",
+                                   "objective", "early_stopping_rounds"))
+def fit_gbt_chunk(Xb, y, w, val_w, margin, best, since, keys,
+                  n_rounds: int, max_depth: int, n_bins: int,
+                  learning_rate, reg_lambda, objective: str,
+                  min_child_weight, active_depth, gamma, alpha,
+                  subsample, colsample, early_stopping_rounds: int,
+                  min_gain_norm=0.0):
+    """One host-dispatched chunk of boosting rounds carrying the
+    early-stopping state. A 200-round depth-10 fit at 100k rows exceeds
+    the ~60s single-execution serving ceiling as ONE program; the sweep
+    engine instead calls this per `rounds_per_dispatch` slice of the key
+    array, keeping each execution seconds-long, and stops dispatching
+    entirely once every vmapped pair reports `since >= early_stopping_
+    rounds` — real compute savings on top of the in-scan masking.
+    Returns ((margin, best, since), trees_chunk)."""
+    return _gbt_scan(Xb, y, w, val_w, margin, best, since, keys,
+                     max_depth, n_bins, learning_rate, reg_lambda, objective,
+                     min_child_weight, active_depth, gamma, alpha,
+                     subsample, colsample, early_stopping_rounds,
+                     min_gain_norm)
+
+
+def _pick_rounds_per_dispatch(n_estimators: int, ideal: int) -> int:
+    """Largest divisor of `n_estimators` ≤ `ideal` — equal-size chunks mean
+    ONE compiled chunk shape. A pathological divisor structure (prime
+    round counts) falls back to `ideal` with a separately-compiled tail."""
+    ideal = max(1, min(ideal, n_estimators))
+    best = max(d for d in range(1, ideal + 1) if n_estimators % d == 0)
+    return best if best * 2 >= ideal else ideal
+
+
+def fit_gbt_hosted(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
+                   learning_rate, reg_lambda, objective: str = "logistic",
+                   min_child_weight: float = 1.0, gamma=0.0, alpha=0.0,
+                   subsample=1.0, colsample=1.0, seed=0, val_w=None,
+                   early_stopping_rounds: int = 0,
+                   rounds_per_dispatch: Optional[int] = None,
+                   min_gain_norm=0.0):
+    """Host-chunked boosting: bitwise-identical trees/margin to `fit_gbt`
+    (same key stream, same scan body) but dispatched `rounds_per_dispatch`
+    rounds at a time so no single XLA execution can hit the ~60s serving
+    kill, and early stopping SKIPS the remaining dispatches instead of
+    masking them. Used for refits whose full scan would be tens of
+    seconds (200-round depth-10 at 100k rows)."""
+    n, d = Xb.shape
+    esr = int(early_stopping_rounds) if val_w is not None else 0
+    if val_w is None:
+        val_w = jnp.zeros(n, jnp.float32)
+    if rounds_per_dispatch is None:
+        # ~0.2s/round at the r2-measured 1.1e-12 s/unit on 90k×55×32×2^10;
+        # target a handful of seconds per dispatch
+        unit = n * (2 ** min(max_depth, 14)) * d * n_bins
+        rounds_per_dispatch = _pick_rounds_per_dispatch(
+            n_estimators, max(1, int(2.5e13 // max(unit, 1))))
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_estimators)
+    margin = jnp.zeros(n, jnp.float32)
+    best = jnp.float32(jnp.inf)
+    since = jnp.int32(0)
+    chunks = []
+    done = 0
+    while done < n_estimators:
+        ks = keys[done:done + rounds_per_dispatch]
+        (margin, best, since), trees = fit_gbt_chunk(
+            Xb, y, w, val_w, margin, best, since, ks, int(ks.shape[0]),
+            max_depth, n_bins, learning_rate, reg_lambda, objective,
+            min_child_weight, None, gamma, alpha, subsample, colsample, esr,
+            min_gain_norm)
+        chunks.append(trees)
+        done += int(ks.shape[0])
+        if esr and int(since) >= esr:
+            break  # remaining rounds would all be zeroed no-op trees
+    trees = jax.tree.map(lambda *a: jnp.concatenate(a, 0), *chunks)
     return trees, margin
 
 
@@ -326,7 +481,8 @@ def fit_gbt_multiclass(Xb, y, w, n_estimators: int, max_depth: int,
                        n_bins: int, n_classes: int, learning_rate,
                        reg_lambda, min_child_weight: float = 1.0,
                        active_depth=None, gamma=0.0, alpha=0.0,
-                       subsample=1.0, colsample=1.0, seed=0):
+                       subsample=1.0, colsample=1.0, seed=0,
+                       min_gain_norm=0.0):
     """Softmax boosting: K one-vs-rest trees per round grown from the
     multinomial gradients (the reference's XGBoost multi:softprob —
     OpXGBoostClassifier.scala:47 supports multiclass; the r1 facade was
@@ -347,7 +503,8 @@ def fit_gbt_multiclass(Xb, y, w, n_estimators: int, max_depth: int,
             return grow_tree(Xb, (-g * rows)[:, None], h * rows, max_depth,
                              n_bins, reg_lambda=reg_lambda,
                              min_child_weight=min_child_weight,
-                             min_gain=gamma, feature_mask=fmask,
+                             min_gain=gamma, min_gain_norm=min_gain_norm,
+                             feature_mask=fmask,
                              active_depth=active_depth, alpha=alpha, B=B)
 
         trees_k = jax.vmap(per_class, in_axes=(1, 1))(G, Hs)  # (K, ...)
@@ -512,19 +669,35 @@ class _TreeEstimatorBase(PredictorEstimator):
 
 
 class OpRandomForestClassifier(_TreeEstimatorBase):
+    """Spark RandomForestClassifier param surface: `min_info_gain`
+    (minInfoGain — gini-improvement threshold, normalized gain scale) and
+    `min_instances_per_node` (minInstancesPerNode — with count weights this
+    is the child-weight bound) are grid axes in the reference defaults
+    (`DefaultSelectorParams.scala:38-39`)."""
+
     def __init__(self, n_trees: int = 20, max_depth: int = 5,
                  max_bins: int = DEFAULT_MAX_BINS, min_child_weight: float = 1.0,
-                 subsample_features: bool = True,
+                 subsample_features: bool = True, min_info_gain: float = 0.0,
+                 min_instances_per_node: float = 1.0,
                  n_classes: Optional[int] = None, uid: Optional[str] = None):
         super().__init__(uid=uid, n_trees=n_trees, max_depth=max_depth,
                          max_bins=max_bins, min_child_weight=min_child_weight,
-                         subsample_features=subsample_features, n_classes=n_classes)
+                         subsample_features=subsample_features,
+                         min_info_gain=min_info_gain,
+                         min_instances_per_node=min_instances_per_node,
+                         n_classes=n_classes)
         self.n_trees = n_trees
         self.max_depth = max_depth
         self.max_bins = max_bins
         self.min_child_weight = min_child_weight
         self.subsample_features = subsample_features
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
         self.n_classes = n_classes
+
+    def _effective_mcw(self) -> float:
+        return max(float(self.min_child_weight),
+                   float(self.min_instances_per_node))
 
     def fit_arrays(self, X, y, w, ctx: FitContext):
         k = self.n_classes or infer_n_classes(np.asarray(y))
@@ -532,7 +705,8 @@ class OpRandomForestClassifier(_TreeEstimatorBase):
         Y = jax.nn.one_hot(y.astype(jnp.int32), k)
         trees = fit_forest(Xb, Y, w, self.n_trees, self.max_depth,
                            self.max_bins, k, ctx.seed,
-                           self.subsample_features, self.min_child_weight)
+                           self.subsample_features, self._effective_mcw(),
+                           min_gain=jnp.float32(self.min_info_gain))
         return ForestClassificationModel(edges, {k2: np.asarray(v)
                                                  for k2, v in trees.items()})
 
@@ -542,7 +716,8 @@ class OpRandomForestRegressor(OpRandomForestClassifier):
         edges, Xb = self._edges_binned(X, ctx)
         trees = fit_forest(Xb, y[:, None], w, self.n_trees, self.max_depth,
                            self.max_bins, 1, ctx.seed,
-                           self.subsample_features, self.min_child_weight)
+                           self.subsample_features, self._effective_mcw(),
+                           min_gain=jnp.float32(self.min_info_gain))
         return ForestRegressionModel(edges, {k: np.asarray(v)
                                              for k, v in trees.items()})
 
@@ -551,13 +726,20 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
     """Single deterministic tree (no bootstrap, all features)."""
 
     def __init__(self, max_depth: int = 5, max_bins: int = DEFAULT_MAX_BINS,
-                 min_child_weight: float = 1.0, n_classes: Optional[int] = None,
+                 min_child_weight: float = 1.0, min_info_gain: float = 0.0,
+                 min_instances_per_node: float = 1.0,
+                 n_classes: Optional[int] = None,
                  uid: Optional[str] = None):
         super().__init__(n_trees=1, max_depth=max_depth, max_bins=max_bins,
                          min_child_weight=min_child_weight,
+                         min_info_gain=min_info_gain,
+                         min_instances_per_node=min_instances_per_node,
                          subsample_features=False, n_classes=n_classes, uid=uid)
         self.params = {"max_depth": max_depth, "max_bins": max_bins,
-                       "min_child_weight": min_child_weight, "n_classes": n_classes}
+                       "min_child_weight": min_child_weight,
+                       "min_info_gain": min_info_gain,
+                       "min_instances_per_node": min_instances_per_node,
+                       "n_classes": n_classes}
 
     def fit_arrays(self, X, y, w, ctx: FitContext):
         k = self.n_classes or infer_n_classes(np.asarray(y))
@@ -565,7 +747,8 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
         Y = jax.nn.one_hot(y.astype(jnp.int32), k)
         tree = grow_tree(Xb, Y * w[:, None], w, self.max_depth, self.max_bins,
                          reg_lambda=1e-6,
-                         min_child_weight=self.min_child_weight)
+                         min_child_weight=self._effective_mcw(),
+                         min_gain_norm=jnp.float32(self.min_info_gain))
         trees = jax.tree.map(lambda a: a[None], tree)  # (1, ...) forest shape
         return ForestClassificationModel(edges, {k2: np.asarray(v)
                                                  for k2, v in trees.items()})
@@ -573,18 +756,25 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
 
 class OpDecisionTreeRegressor(OpRandomForestRegressor):
     def __init__(self, max_depth: int = 5, max_bins: int = DEFAULT_MAX_BINS,
-                 min_child_weight: float = 1.0, uid: Optional[str] = None):
+                 min_child_weight: float = 1.0, min_info_gain: float = 0.0,
+                 min_instances_per_node: float = 1.0,
+                 uid: Optional[str] = None):
         super().__init__(n_trees=1, max_depth=max_depth, max_bins=max_bins,
                          min_child_weight=min_child_weight,
+                         min_info_gain=min_info_gain,
+                         min_instances_per_node=min_instances_per_node,
                          subsample_features=False, uid=uid)
         self.params = {"max_depth": max_depth, "max_bins": max_bins,
-                       "min_child_weight": min_child_weight}
+                       "min_child_weight": min_child_weight,
+                       "min_info_gain": min_info_gain,
+                       "min_instances_per_node": min_instances_per_node}
 
     def fit_arrays(self, X, y, w, ctx: FitContext):
         edges, Xb = self._edges_binned(X, ctx)
         tree = grow_tree(Xb, (y * w)[:, None], w, self.max_depth, self.max_bins,
                          reg_lambda=1e-6,
-                         min_child_weight=self.min_child_weight)
+                         min_child_weight=self._effective_mcw(),
+                         min_gain_norm=jnp.float32(self.min_info_gain))
         trees = jax.tree.map(lambda a: a[None], tree)
         return ForestRegressionModel(edges, {k: np.asarray(v)
                                              for k, v in trees.items()})
@@ -599,12 +789,18 @@ class OpGBTClassifier(_TreeEstimatorBase):
                  max_bins: int = DEFAULT_MAX_BINS, min_child_weight: float = 1.0,
                  gamma: float = 0.0, alpha: float = 0.0,
                  subsample: float = 1.0, colsample_bytree: float = 1.0,
+                 early_stopping_rounds: int = 0, min_info_gain: float = 0.0,
+                 min_instances_per_node: float = 1.0,
                  n_classes: Optional[int] = None, uid: Optional[str] = None):
         super().__init__(uid=uid, n_estimators=n_estimators, max_depth=max_depth,
                          learning_rate=learning_rate, reg_lambda=reg_lambda,
                          max_bins=max_bins, min_child_weight=min_child_weight,
                          gamma=gamma, alpha=alpha, subsample=subsample,
-                         colsample_bytree=colsample_bytree, n_classes=n_classes)
+                         colsample_bytree=colsample_bytree,
+                         early_stopping_rounds=early_stopping_rounds,
+                         min_info_gain=min_info_gain,
+                         min_instances_per_node=min_instances_per_node,
+                         n_classes=n_classes)
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.learning_rate = learning_rate
@@ -615,10 +811,28 @@ class OpGBTClassifier(_TreeEstimatorBase):
         self.alpha = alpha
         self.subsample = subsample
         self.colsample_bytree = colsample_bytree
+        self.early_stopping_rounds = early_stopping_rounds
+        # Spark GBTClassifier/Regressor parity knobs (the regression
+        # default grid sweeps them, DefaultSelectorParams.scala:38-39);
+        # min_info_gain uses the NORMALIZED gain scale, XGBoost's `gamma`
+        # stays raw
+        self.min_info_gain = min_info_gain
+        self.min_instances_per_node = min_instances_per_node
         self.n_classes = n_classes
+
+    def _effective_mcw(self) -> float:
+        return max(float(self.min_child_weight),
+                   float(self.min_instances_per_node))
 
     _objective = "logistic"
     _model_cls = GBTClassificationModel
+
+    # refit early-stopping eval fraction: like the XGBoost sklearn
+    # `eval_set` idiom, a seeded 20% of the training rows is held out of
+    # the boosting gradients and drives numEarlyStoppingRounds when no
+    # explicit eval split exists (the reference CV sweep evals on the
+    # fold's validation rows; the refit has no fold)
+    _ES_EVAL_FRACTION = 0.2
 
     def fit_arrays(self, X, y, w, ctx: FitContext):
         edges, Xb = self._edges_binned(X, ctx)
@@ -631,22 +845,35 @@ class OpGBTClassifier(_TreeEstimatorBase):
             trees, _ = fit_gbt_multiclass(
                 Xb, y, w, self.n_estimators, self.max_depth, self.max_bins,
                 k, jnp.float32(self.learning_rate),
-                jnp.float32(self.reg_lambda), self.min_child_weight,
+                jnp.float32(self.reg_lambda), self._effective_mcw(),
                 gamma=jnp.float32(self.gamma), alpha=jnp.float32(self.alpha),
                 subsample=jnp.float32(self.subsample),
-                colsample=jnp.float32(self.colsample_bytree), seed=seed)
+                colsample=jnp.float32(self.colsample_bytree), seed=seed,
+                min_gain_norm=jnp.float32(self.min_info_gain))
             return GBTMulticlassModel(
                 edges, {k2: np.asarray(v) for k2, v in trees.items()},
                 self.learning_rate)
-        trees, _ = fit_gbt(Xb, y, w, self.n_estimators, self.max_depth,
-                           self.max_bins, jnp.float32(self.learning_rate),
-                           jnp.float32(self.reg_lambda), self._objective,
-                           self.min_child_weight,
-                           gamma=jnp.float32(self.gamma),
-                           alpha=jnp.float32(self.alpha),
-                           subsample=jnp.float32(self.subsample),
-                           colsample=jnp.float32(self.colsample_bytree),
-                           seed=seed)
+        esr = int(self.early_stopping_rounds or 0)
+        val_w = None
+        train_w = w
+        if esr > 0:
+            rng = np.random.default_rng(seed)
+            hold = jnp.asarray(
+                rng.uniform(size=Xb.shape[0]) < self._ES_EVAL_FRACTION,
+                dtype=jnp.float32)
+            val_w = hold * w
+            train_w = (1.0 - hold) * w
+        trees, _ = fit_gbt_hosted(
+            Xb, y, train_w, self.n_estimators, self.max_depth,
+            self.max_bins, jnp.float32(self.learning_rate),
+            jnp.float32(self.reg_lambda), self._objective,
+            self._effective_mcw(),
+            gamma=jnp.float32(self.gamma),
+            alpha=jnp.float32(self.alpha),
+            subsample=jnp.float32(self.subsample),
+            colsample=jnp.float32(self.colsample_bytree),
+            seed=seed, val_w=val_w, early_stopping_rounds=esr,
+            min_gain_norm=jnp.float32(self.min_info_gain))
         return self._model_cls(edges, {k2: np.asarray(v) for k2, v in trees.items()},
                                self.learning_rate)
 
@@ -670,12 +897,17 @@ class OpXGBoostClassifier(OpGBTClassifier):
                  min_child_weight: float = 1.0, gamma: float = 0.0,
                  alpha: float = 0.0, subsample: float = 1.0,
                  colsample_bytree: float = 1.0,
+                 early_stopping_rounds: int = 0, min_info_gain: float = 0.0,
+                 min_instances_per_node: float = 1.0,
                  n_classes: Optional[int] = None, uid: Optional[str] = None):
         super().__init__(n_estimators=n_estimators, max_depth=max_depth,
                          learning_rate=eta, reg_lambda=reg_lambda,
                          max_bins=max_bins, min_child_weight=min_child_weight,
                          gamma=gamma, alpha=alpha, subsample=subsample,
                          colsample_bytree=colsample_bytree,
+                         early_stopping_rounds=early_stopping_rounds,
+                         min_info_gain=min_info_gain,
+                         min_instances_per_node=min_instances_per_node,
                          n_classes=n_classes, uid=uid)
         self.params["eta"] = eta
         self.params.pop("learning_rate", None)

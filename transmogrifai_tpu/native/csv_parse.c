@@ -46,7 +46,7 @@ static const double POW10[23] = {
 static int fast_parse_double(const char *s, int64_t n, double *out,
                              int *floaty) {
     int64_t i = 0;
-    int neg = 0, exp_neg = 0;
+    int neg = 0, exp_neg = 0, seen_exp = 0;
     uint64_t mant = 0;
     int digits = 0, any = 0, frac = 0, seen_point = 0, exp10 = 0;
     if (i < n && (s[i] == '+' || s[i] == '-')) neg = s[i++] == '-';
@@ -64,6 +64,7 @@ static int fast_parse_double(const char *s, int64_t n, double *out,
             seen_point = 1;
         } else if (c == 'e' || c == 'E') {
             if (!any) return 0;
+            seen_exp = 1;
             i++;
             if (i < n && (s[i] == '+' || s[i] == '-'))
                 exp_neg = s[i++] == '-';
@@ -88,7 +89,9 @@ static int fast_parse_double(const char *s, int64_t n, double *out,
             v /= POW10[-net];
         }
         *out = neg ? -v : v;
-        *floaty = seen_point || exp10 || exp_neg;
+        /* any exponent marker is floaty: python int("1e0") raises, so an
+         * "1e0" cell must widen an Integral column to Real */
+        *floaty = seen_point || seen_exp;
         return 1;
     }
 }
@@ -174,11 +177,16 @@ int64_t csv_numeric_fill(const char *buf, int64_t len, int32_t n_cols,
                     char *endp;
                     double v;
                     int64_t digits = 0, k;
-                    int intlike = 1;
+                    int intlike = 1, hex = 0;
                     memcpy(tmp, buf + start, (size_t)n);
                     tmp[n] = 0;
-                    v = strtod(tmp, &endp);
-                    if (endp != tmp + n) { *cell = 0.0; *miss = 2; }
+                    /* glibc strtod accepts hex literals ("0x1A" -> 26.0)
+                     * but python float("0x1A") raises — such cells must
+                     * take the text path, not silently parse numeric */
+                    for (k = 0; k < n; k++)
+                        if (tmp[k] == 'x' || tmp[k] == 'X') { hex = 1; break; }
+                    v = hex ? 0.0 : strtod(tmp, &endp);
+                    if (hex || endp != tmp + n) { *cell = 0.0; *miss = 2; }
                     else {
                         for (k = 0; k < n; k++) {
                             char ch = tmp[k];

@@ -260,29 +260,52 @@ class OpWord2Vec(Estimator):
         freq = np.asarray([counts[w] for w in vocab], dtype=np.float64) ** 0.75
         neg_p = freq / freq.sum()
         lr = self.learning_rate
+        # Flat skip-gram pair generation (r2 ran a python loop per token —
+        # O(corpus) interpreter time; this is vectorized over ALL
+        # positions): docs concatenate into one id stream with document
+        # boundaries, per-position dynamic window spans draw like the
+        # word2vec reference, and each offset o ∈ [1, window] contributes
+        # the (center, center±o) pairs where o ≤ span and both sides stay
+        # inside the document.
+        flat = np.concatenate([np.asarray(d) for d in docs])
+        doc_of = np.concatenate(
+            [np.full(len(d), i) for i, d in enumerate(docs)])
+        n_pos = len(flat)
+        batch = 8192
         for it in range(self.num_iter):
-            for ids in docs:
-                arr = np.asarray(ids)
-                L = len(arr)
-                for pos in range(L):
-                    w = arr[pos]
-                    span = rng.integers(1, self.window + 1)
-                    lo, hi = max(0, pos - span), min(L, pos + span + 1)
-                    ctx_ids = np.concatenate([arr[lo:pos], arr[pos + 1:hi]])
-                    if ctx_ids.size == 0:
-                        continue
-                    negs = rng.choice(V, size=self.negatives * ctx_ids.size,
-                                      p=neg_p)
-                    targets = np.concatenate([ctx_ids, negs])
-                    labels = np.concatenate([
-                        np.ones(ctx_ids.size, np.float32),
-                        np.zeros(negs.size, np.float32)])
-                    vin = W_in[w]                      # (D,)
-                    vout = W_out[targets]              # (m, D)
-                    scores = 1.0 / (1.0 + np.exp(-vout @ vin))
-                    g = (labels - scores) * lr         # (m,)
-                    W_in[w] += g @ vout
-                    np.add.at(W_out, targets, g[:, None] * vin[None, :])
+            spans = rng.integers(1, self.window + 1, size=n_pos)
+            centers_l, contexts_l = [], []
+            for o in range(1, self.window + 1):
+                ok = (spans >= o)
+                left = ok[o:] & (doc_of[o:] == doc_of[:-o])
+                idx = np.flatnonzero(left) + o
+                centers_l.append(flat[idx])          # context o to the left
+                contexts_l.append(flat[idx - o])
+                centers_l.append(flat[idx - o])      # and o to the right
+                contexts_l.append(flat[idx])
+            centers = np.concatenate(centers_l)
+            contexts = np.concatenate(contexts_l)
+            order = rng.permutation(len(centers))
+            centers, contexts = centers[order], contexts[order]
+            # minibatched SGNS: per batch one gathered matmul-free update
+            # (einsum over (B, k+1, D)); np.add.at applies the scatter
+            for s in range(0, len(centers), batch):
+                c = centers[s:s + batch]
+                pos_t = contexts[s:s + batch]
+                B = len(c)
+                negs = rng.choice(V, size=(B, self.negatives), p=neg_p)
+                targets = np.concatenate([pos_t[:, None], negs], axis=1)
+                labels = np.zeros((B, 1 + self.negatives), np.float32)
+                labels[:, 0] = 1.0
+                vin = W_in[c]                          # (B, D)
+                vout = W_out[targets]                  # (B, m, D)
+                scores = 1.0 / (1.0 + np.exp(
+                    -np.einsum("bmd,bd->bm", vout, vin)))
+                g = (labels - scores) * lr             # (B, m)
+                np.add.at(W_in, c, np.einsum("bm,bmd->bd", g, vout))
+                np.add.at(W_out, targets.reshape(-1),
+                          (g[:, :, None] * vin[:, None, :]).reshape(
+                              -1, D))
         return Word2VecModel({w: W_in[i] for i, w in enumerate(vocab)}, D)
 
 

@@ -40,11 +40,12 @@ from transmogrifai_tpu.models.base import infer_n_classes
 from transmogrifai_tpu.models.glm import (
     OpGeneralizedLinearRegression, fit_glm, predict_glm)
 from transmogrifai_tpu.models.linear import (
-    OpLinearRegression, fit_linreg, predict_linreg)
+    OpLinearRegression, fit_linreg, fit_linreg_enet, predict_linreg)
 from transmogrifai_tpu.models.linear_svc import (
     OpLinearSVC, fit_linear_svc, predict_linear_svc)
 from transmogrifai_tpu.models.logistic import (
-    OpLogisticRegression, fit_logreg, predict_logreg)
+    OpLogisticRegression, enet_iters, fit_logreg, fit_logreg_enet,
+    predict_logreg)
 from transmogrifai_tpu.models.mlp import (
     OpMultilayerPerceptronClassifier, fit_mlp, predict_mlp)
 from transmogrifai_tpu.models.naive_bayes import (
@@ -157,6 +158,9 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                   host_dispatch: bool = False,
                   pair_width: Callable[[Tuple, List[int], int], int]
                   = lambda s, i, k: 1,
+                  calibrate: Optional[Callable[[Tuple, List[int], float, int,
+                                                int], int]] = None,
+                  fit_takes_val: bool = False,
                   ) -> List[List[float]]:
     """Shared scaffold: group grids by static params; per group, stack the
     dynamic params into traced vectors and run fit→predict→metric as one
@@ -193,7 +197,8 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
 
         if host_dispatch and sharding is None:
             def one_pair(d, w, v, fit_predict=fit_predict):
-                pred = fit_predict(d, w)
+                pred = (fit_predict(d, w, v) if fit_takes_val
+                        else fit_predict(d, w))
                 return pred if host else metric_fn(y, pred, v)
 
             n_folds = int(np.asarray(W).shape[0])
@@ -206,15 +211,23 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
             # keeps per-dispatch exec under the serving ceiling while the
             # per-call RPC overhead amortizes over `width` fits. Each
             # chunk is scored/materialized before the next dispatch, so
-            # peak HBM is one chunk, not the whole group.
+            # peak HBM is one chunk, not the whole group. `calibrate`
+            # may resize `width` between dispatches from measured wall
+            # time (a resize recompiles, so it only fires when the
+            # remaining work amortizes the new compile).
+            import time as _time
             prog = jax.jit(jax.vmap(one_pair))
-            for s in range(0, n_pairs, width):
+            s = 0
+            while s < n_pairs:
                 ps = [min(s + t, n_pairs - 1) for t in range(width)]
                 gs = [p // n_folds for p in ps]
                 fs = [p % n_folds for p in ps]
                 dchunk = {k: v[jnp.asarray(gs)] for k, v in dyn.items()}
+                t0 = _time.perf_counter()
                 out = jax.block_until_ready(
                     prog(dchunk, W[jnp.asarray(fs)], V[jnp.asarray(fs)]))
+                dt = _time.perf_counter() - t0
+                SWEEP_STATS.record((id(prog), static, width), dt)
                 out_np = jax.tree_util.tree_map(np.asarray, out)
                 for t in range(min(width, n_pairs - s)):
                     row_i, j = divmod(s + t, n_folds)
@@ -228,11 +241,22 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                     else:
                         metrics[idxs[row_i]][j] = \
                             float(out_np[t])  # type: ignore
+                s += width
+                if calibrate is not None and s < n_pairs:
+                    new_w = max(1, min(calibrate(static, idxs, dt, width,
+                                                 n_pairs - s), n_pairs - s))
+                    if new_w != width:
+                        # same jitted fn — the new chunk shape compiles on
+                        # first use and persists in the compile cache
+                        log.info("sweep dispatch width recalibrated "
+                                 "%d -> %d (measured %.1fs)", width, new_w, dt)
+                        width = new_w
             continue
 
         def one_cfg(d, fit_predict=fit_predict):
             def one_fold(w, v):
-                pred = fit_predict(d, w)
+                pred = (fit_predict(d, w, v) if fit_takes_val
+                        else fit_predict(d, w))
                 return pred if host else metric_fn(y, pred, v)
             return jax.vmap(one_fold)(W, V)
 
@@ -256,23 +280,51 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
 # family handlers                                                             #
 # --------------------------------------------------------------------------- #
 
+def _enet_of(est, g) -> float:
+    return float(_grid_param(est, g, "elastic_net_param") or 0.0)
+
+
+def _l1_l2_of(est, g) -> Dict[str, float]:
+    """Spark penalty split: reg·α → L1, reg·(1−α) → L2
+    (`DefaultSelectorParams.scala:48` ElasticNet {0.1, 0.5})."""
+    reg = float(_grid_param(est, g, "reg_param"))
+    alpha = _enet_of(est, g)
+    return {"l1": reg * alpha, "l2": reg * (1.0 - alpha)}
+
+
 def _sweep_logistic(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     n_classes = est.n_classes or infer_n_classes(np.asarray(y))
+
+    def build(st, idxs):
+        max_iter, enet = st
+        if enet:  # FISTA path — one compile covers the whole (l1, l2) grid
+            iters = enet_iters(max_iter)
+            return lambda d, w: predict_logreg(
+                fit_logreg_enet(X, y, w, d["l1"], d["l2"], n_classes,
+                                iters), X)
+        return lambda d, w: predict_logreg(
+            fit_logreg(X, y, w, d["l2"], n_classes, max_iter), X)
+
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (int(_grid_param(est, g, "max_iter")),),
-        dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
-        build=lambda st, idxs: lambda d, w: predict_logreg(
-            fit_logreg(X, y, w, d["reg"], n_classes, st[0]), X))
+        static_of=lambda g: (int(_grid_param(est, g, "max_iter")),
+                             _enet_of(est, g) > 0.0),
+        dyn_of=lambda g: _l1_l2_of(est, g),
+        build=build)
 
 
 def _sweep_linreg(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    def build(st, idxs):
+        if st[0]:  # any L1 in the group → FISTA elastic net
+            return lambda d, w: predict_linreg(
+                fit_linreg_enet(X, y, w, d["l1"], d["l2"]), X)
+        return lambda d, w: predict_linreg(fit_linreg(X, y, w, d["l2"]), X)
+
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (),
-        dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
-        build=lambda st, idxs: lambda d, w: predict_linreg(
-            fit_linreg(X, y, w, d["reg"]), X))
+        static_of=lambda g: (_enet_of(est, g) > 0.0,),
+        dyn_of=lambda g: _l1_l2_of(est, g),
+        build=build)
 
 
 def _sweep_svc(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -286,20 +338,36 @@ def _sweep_svc(est, grids, X, y, W, V, metric_fn, ctx, sharding):
 
 def _sweep_glm(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     def build(st, idxs):
-        family, max_iter, var_power = st
+        family, max_iter, var_power, link = st
         return lambda d, w: predict_glm(
-            fit_glm(X, y, w, d["reg"], family, max_iter, var_power), X, family)
+            fit_glm(X, y, w, d["reg"], family, max_iter, var_power, link),
+            X, family, link, var_power)
+
+    def link_of(g):
+        ln = _grid_param(est, g, "link")
+        return str(ln) if ln is not None else None
+
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
         static_of=lambda g: (str(_grid_param(est, g, "family")),
                              int(_grid_param(est, g, "max_iter")),
-                             float(_grid_param(est, g, "var_power"))),
+                             float(_grid_param(est, g, "var_power")),
+                             link_of(g)),
         dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
         build=build)
 
 
 def _sweep_nb(est, grids, X, y, W, V, metric_fn, ctx, sharding):
-    if bool(jnp.any(X < 0)):  # Spark parity: family fails, selector drops it
+    # Spark parity: family fails on negative features, selector drops it.
+    # The host read is a blocking device sync (~1s through the tunnel), so
+    # the verdict is cached per training matrix on the FitContext — one
+    # sync per selector fit, not one per NB sweep/fold.
+    cache = getattr(ctx, "_nb_nonneg_cache", None) if ctx is not None else None
+    if cache is None or cache[0] is not X:
+        cache = (X, bool(jnp.any(X < 0)))
+        if ctx is not None:
+            ctx._nb_nonneg_cache = cache
+    if cache[1]:
         raise ValueError(
             "NaiveBayes requires non-negative features (Spark parity)")
     n_classes = est.n_classes or infer_n_classes(np.asarray(y))
@@ -334,19 +402,122 @@ def _sweep_mlp(est, grids, X, y, W, V, metric_fn, ctx, sharding):
 
 # host-dispatch batching model: how many grid×fold pairs fit in one
 # dispatch. The work unit is learners × rows × nodes × features × bins —
-# the histogram-matmul FLOP shape — with per-family constants fit from
-# measured v5e exec (~0.9s for a 20-tree depth-12 forest pair and ~0.55s
-# for a 50-round depth-6 GBT pair, both on 90k×55×32-bin). The exec
-# target keeps a >2x margin under the ~60s serving ceiling, and the
-# memory bound caps the simultaneous (n, 2^depth) routing one-hots.
+# the histogram-matmul FLOP shape. The INITIAL per-family constants were
+# fit on one v5e at 90k×55×32-bin; every real dispatch is then timed and
+# the measured sec/unit (EMA, RPC overhead subtracted) replaces the guess
+# for the rest of the process — a different TPU generation or feature
+# width recalibrates itself after one dispatch instead of over/under-
+# shooting the ~60s serving ceiling. The exec target keeps a >2x margin
+# under that ceiling; the memory bound caps the simultaneous bin one-hots
+# (n·d·bins bf16) plus deepest-level routing one-hots (n·2^depth bf16).
 _PAIR_EXEC_TARGET_S = 25.0
 _PAIR_MEM_BYTES = 4 << 30
-# measured fits are 6.9e-14 (forest: 0.9s / 20·90000·2^12·55·32) and
-# 1.1e-12 (gbt: 0.55s / 50·90000·2^6·55·32); the constants carry a
-# deliberate 2-4x safety margin so tunnel exec variance cannot push a
-# dispatch over the serving ceiling
-_SEC_PER_UNIT_FOREST = 2.8e-13
-_SEC_PER_UNIT_GBT = 2.3e-12
+_DISPATCH_OVERHEAD_S = 0.7  # tunnel RPC per dispatch, excluded from calib
+# initial guesses (r2-measured with a 2-4x safety margin): forest 0.9s /
+# 20·90000·2^12·55·32, gbt 0.55s / 50·90000·2^6·55·32
+_CALIB_INIT = {"forest": 2.8e-13, "gbt": 2.3e-12}
+_CALIB: Dict[str, float] = {}
+_CALIB_LOADED = False
+
+
+class SweepStats:
+    """Per-process dispatch accounting (SURVEY §5.1 'measure instead'):
+    how much of a sweep's wall-clock the device dispatch loop actually
+    occupies, and how much went to first-execution (compile) overhead.
+    `bench.py` resets before a sweep and reports the fractions."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.dispatch_s = 0.0
+        self.dispatches = 0
+        self.first_s = 0.0       # first execution of each program shape
+        self.firsts = 0
+        self._seen: set = set()
+
+    def record(self, key, seconds: float) -> None:
+        self.dispatch_s += seconds
+        self.dispatches += 1
+        if key not in self._seen:
+            self._seen.add(key)
+            self.first_s += seconds
+            self.firsts += 1
+
+    def compile_estimate_s(self) -> float:
+        """First-execution seconds minus what those executions would cost
+        warm (estimated from the observed warm mean) ≈ compile + cache-
+        lookup overhead."""
+        warm_n = self.dispatches - self.firsts
+        if warm_n <= 0:
+            return self.first_s
+        warm_mean = (self.dispatch_s - self.first_s) / warm_n
+        return max(0.0, self.first_s - warm_mean * self.firsts)
+
+
+SWEEP_STATS = SweepStats()
+
+
+def _calib_path() -> str:
+    import os
+    return os.path.join(os.path.expanduser("~/.cache/transmogrifai_tpu"),
+                        "sweep_calib.json")
+
+
+def _load_calib() -> None:
+    """Measured sec/unit persists beside the XLA compile cache so a NEW
+    process starts from the previous run's measurements — widths converge
+    to the same values run over run, which also keeps dispatch shapes
+    stable for the persistent compile cache."""
+    global _CALIB_LOADED
+    if _CALIB_LOADED:
+        return
+    _CALIB_LOADED = True
+    import json as _json
+    import os
+    try:
+        if os.path.exists(_calib_path()):
+            with open(_calib_path()) as f:
+                _CALIB.update({k: float(v) for k, v in _json.load(f).items()})
+    except Exception:
+        pass
+
+
+def _save_calib() -> None:
+    import json as _json
+    import os
+    try:
+        os.makedirs(os.path.dirname(_calib_path()), exist_ok=True)
+        tmp = _calib_path() + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(_CALIB, f)
+        os.replace(tmp, _calib_path())
+    except OSError:
+        pass
+
+
+def _sec_per_unit(kind: str) -> float:
+    _load_calib()
+    return _CALIB.get(kind, _CALIB_INIT[kind])
+
+
+def _record_calib(kind: str, seconds: float, units: float) -> float:
+    """Fold one measured dispatch into the family's sec/unit estimate.
+    Conservative EMA: jumps fast on slower-than-expected, slow on faster
+    (serving-kill risk is asymmetric)."""
+    if units <= 0:
+        return _sec_per_unit(kind)
+    measured = max(seconds - _DISPATCH_OVERHEAD_S, 0.02) / units
+    prev = _sec_per_unit(kind) if kind in _CALIB else None
+    if prev is None:
+        new = measured
+    elif measured > prev:
+        new = 0.3 * prev + 0.7 * measured
+    else:
+        new = 0.7 * prev + 0.3 * measured
+    _CALIB[kind] = new
+    _save_calib()
+    return new
 
 
 def _tree_pair_width(n: int, d: int, n_bins: int, learners: int,
@@ -354,7 +525,7 @@ def _tree_pair_width(n: int, d: int, n_bins: int, learners: int,
     nodes = 2 ** min(pad_depth, 14)
     est_s = max(0.05, float(learners) * n * nodes * d * n_bins
                 * sec_per_unit)
-    mem_per_pair = n * (d + nodes) * 2  # bf16 bytes
+    mem_per_pair = n * (d * n_bins + nodes) * 2  # bf16 bytes
     w_exec = int(_PAIR_EXEC_TARGET_S / est_s)
     w_mem = int(_PAIR_MEM_BYTES // max(mem_per_pair, 1))
     return max(1, min(w_exec, w_mem))
@@ -417,8 +588,26 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
         # fit_forest chunk budget in step with actual live instances
         return min(len(idxs) * n_folds,
                    _tree_pair_width(n_rows, int(X.shape[1]), max_bins,
-                                    n_trees, _SEC_PER_UNIT_FOREST,
+                                    n_trees, _sec_per_unit("forest"),
                                     pad_depth))
+
+    def calibrate(st, idxs, seconds, width, remaining):
+        n_trees, max_bins, _ = st[:3]
+        pad_depth = _pad_depth_of(est, grids, idxs)
+        units = (float(width) * n_trees * n_rows
+                 * (2 ** min(pad_depth, 14)) * int(X.shape[1]) * max_bins)
+        spu = _record_calib("forest", seconds, units)
+        if seconds > 0.75 * 60.0:  # dangerously near the serving kill
+            return max(1, width // 2)
+        ideal = _tree_pair_width(n_rows, int(X.shape[1]), max_bins,
+                                 n_trees, spu, pad_depth)
+        # a resize recompiles (remote AOT ~15-50s): grow only when the
+        # dispatch badly underfills the exec target AND enough pairs
+        # remain to amortize the new program
+        if (ideal >= 2 * width and remaining >= 2 * width
+                and seconds < 0.3 * _PAIR_EXEC_TARGET_S):
+            return min(ideal, remaining)
+        return width
 
     def build(st, idxs):
         n_trees, max_bins, subsample = st[:3]
@@ -435,9 +624,17 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
             trees = fit_forest(Xb, Y, w, n_trees, pad_depth, max_bins,
                                n_out, seed, subsample, d["mcw"],
                                active_depth=d["depth"], bootstrap=bootstrap,
-                               tree_budget_divisor=divisor)
+                               tree_budget_divisor=divisor,
+                               min_gain=d["min_gain"])
             return pred_fn(trees, Xb)
         return fit_predict
+
+    def dyn_of(g):
+        mcw = max(float(_grid_param(est, g, "min_child_weight") or 1.0),
+                  float(_grid_param(est, g, "min_instances_per_node") or 1.0))
+        return {"depth": int(_grid_param(est, g, "max_depth")),
+                "mcw": mcw,
+                "min_gain": float(_grid_param(est, g, "min_info_gain") or 0.0)}
 
     # one PADDED compile per family group (traced active_depth masks the
     # unused levels): sweep wall-clock on a fresh process is dominated by
@@ -448,16 +645,17 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
         static_of=lambda g: (int(_grid_param(est, g, "n_trees")),
                              int(_grid_param(est, g, "max_bins")),
                              bool(_grid_param(est, g, "subsample_features"))),
-        dyn_of=lambda g: {
-            "depth": int(_grid_param(est, g, "max_depth")),
-            "mcw": float(_grid_param(est, g, "min_child_weight"))},
+        dyn_of=dyn_of,
         build=build,
         grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6,
         host_dispatch=True,
-        pair_width=lambda st, idxs, k: width_of(st, idxs))
+        pair_width=lambda st, idxs, k: width_of(st, idxs),
+        calibrate=calibrate)
 
 
 def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
+    from transmogrifai_tpu.models.trees import (
+        _pick_rounds_per_dispatch, fit_gbt_chunk)
     xb_by_bins = _binned_cache(est, grids, X, ctx)
     objective = est._objective
     n_classes = 2
@@ -465,6 +663,7 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         n_classes = getattr(est, "n_classes", None) or \
             infer_n_classes(np.asarray(y))
     seed = ctx.seed if ctx is not None else 0
+    multiclass = objective == "logistic" and n_classes > 2
 
     def lr_of(grid) -> float:
         v = grid.get("eta", grid.get("learning_rate"))
@@ -473,57 +672,191 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         return float(v)
 
     n_rows = int(np.asarray(y).shape[0])
+    d_feat = int(X.shape[1])
+    n_folds = int(np.asarray(W).shape[0]) if hasattr(W, "shape") else len(W)
 
-    n_folds_g = int(np.asarray(W).shape[0]) if hasattr(W, "shape") else len(W)
+    def static_of(g):
+        return (int(_grid_param(est, g, "n_estimators")),
+                int(_grid_param(est, g, "max_bins")),
+                int(_grid_param(est, g, "early_stopping_rounds") or 0))
 
-    def width_of(st, idxs):
-        n_estimators, max_bins = st[:2]
-        pad_depth = _pad_depth_of(est, grids, idxs)
-        return min(len(idxs) * n_folds_g,
-                   _tree_pair_width(n_rows, int(X.shape[1]), max_bins,
-                                    n_estimators, _SEC_PER_UNIT_GBT,
-                                    pad_depth))
-
-    def build(st, idxs):
-        n_estimators, max_bins = st[:2]
-        Xb = xb_by_bins[max_bins]
-        pad_depth = _pad_depth_of(est, grids, idxs)
-
-        def fit_predict(d, w):
-            common = dict(min_child_weight=d["mcw"], active_depth=d["depth"],
-                          gamma=d["gamma"], alpha=d["alpha"],
-                          subsample=d["subsample"], colsample=d["colsample"],
-                          seed=seed)
-            if objective == "logistic" and n_classes > 2:
-                _, margin = fit_gbt_multiclass(
-                    Xb, y, w, n_estimators, pad_depth, max_bins, n_classes,
-                    d["lr"], d["lam"], **common)
-                return gbt_multiclass_pred_from_margin(margin)
-            # the scan carry is the final training-matrix margin — no
-            # post-fit forest re-walk needed
-            _, margin = fit_gbt(Xb, y, w, n_estimators, pad_depth, max_bins,
-                                d["lr"], d["lam"], objective, **common)
-            return gbt_pred_from_margin(margin, objective)
-        return fit_predict
-
-    return _sweep_blocks(
-        grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (int(_grid_param(est, g, "n_estimators")),
-                             int(_grid_param(est, g, "max_bins"))),
-        dyn_of=lambda g: {
+    def dyn_of(g):
+        mcw = max(float(_grid_param(est, g, "min_child_weight") or 1.0),
+                  float(_grid_param(est, g, "min_instances_per_node") or 1.0))
+        return {
             "depth": int(_grid_param(est, g, "max_depth")),
             "lr": lr_of(g),
             "lam": float(_grid_param(est, g, "reg_lambda")),
-            "mcw": float(_grid_param(est, g, "min_child_weight")),
+            "mcw": mcw,
             "gamma": float(_grid_param(est, g, "gamma") or 0.0),
             "alpha": float(_grid_param(est, g, "alpha") or 0.0),
             "subsample": float(_grid_param(est, g, "subsample") or 1.0),
             "colsample": float(
-                _grid_param(est, g, "colsample_bytree") or 1.0)},
-        build=build,
-        grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6,
-        host_dispatch=True,
-        pair_width=lambda st, idxs, k: width_of(st, idxs))
+                _grid_param(est, g, "colsample_bytree") or 1.0),
+            "min_gain_norm": float(
+                _grid_param(est, g, "min_info_gain") or 0.0)}
+
+    if sharding is not None or multiclass:
+        # mesh-sharded grids (dryrun/pod shapes) and multiclass keep the
+        # single-program path: the whole fit (with in-scan early-stop
+        # masking for binary/squared — same key stream and state
+        # transitions as the chunked loop, so metrics agree) vmaps over
+        # the grid axis
+        def build(st, idxs):
+            n_estimators, max_bins, esr = st
+            Xb = xb_by_bins[max_bins]
+            pad_depth = _pad_depth_of(est, grids, idxs)
+
+            def fit_predict(d, w, v):
+                common = dict(min_child_weight=d["mcw"],
+                              active_depth=d["depth"],
+                              gamma=d["gamma"], alpha=d["alpha"],
+                              subsample=d["subsample"],
+                              colsample=d["colsample"], seed=seed)
+                if multiclass:
+                    _, margin = fit_gbt_multiclass(
+                        Xb, y, w, n_estimators, pad_depth, max_bins,
+                        n_classes, d["lr"], d["lam"],
+                        min_gain_norm=d["min_gain_norm"], **common)
+                    return gbt_multiclass_pred_from_margin(margin)
+                # the scan carry is the final training-matrix margin — no
+                # post-fit forest re-walk needed
+                _, margin = fit_gbt(Xb, y, w, n_estimators, pad_depth,
+                                    max_bins, d["lr"], d["lam"], objective,
+                                    val_w=v, early_stopping_rounds=esr,
+                                    min_gain_norm=d["min_gain_norm"],
+                                    **common)
+                return gbt_pred_from_margin(margin, objective)
+            return fit_predict
+
+        def width_of(st, idxs):
+            n_estimators, max_bins, _ = st
+            pad_depth = _pad_depth_of(est, grids, idxs)
+            return min(len(idxs) * n_folds,
+                       _tree_pair_width(n_rows, d_feat, max_bins,
+                                        n_estimators, _sec_per_unit("gbt"),
+                                        pad_depth))
+
+        return _sweep_blocks(
+            grids, y, W, V, metric_fn, sharding,
+            static_of=static_of, dyn_of=dyn_of, build=build,
+            grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6,
+            host_dispatch=sharding is None,
+            pair_width=lambda st, idxs, k: width_of(st, idxs),
+            fit_takes_val=True)
+
+    # ---- single-device binary/squared: ROUND-CHUNKED host dispatch ---- #
+    # A 200-round depth-10 fit at 100k rows is a >60s single execution
+    # (the serving infrastructure kills it); instead each dispatch runs
+    # `rpd` boosting rounds for `width` vmapped grid×fold pairs, carrying
+    # (margin, best_val, since) across dispatches, and once EVERY pair in
+    # the chunk reports since >= early_stopping_rounds the remaining
+    # rounds are skipped outright — the host-loop analogue of the
+    # reference's numEarlyStoppingRounds (DefaultSelectorParams.scala:74).
+    import time as _time
+    groups: Dict[Tuple, List[int]] = {}
+    for i, g in enumerate(grids):
+        groups.setdefault(static_of(g), []).append(i)
+    metrics: List[Optional[List[float]]] = [None] * len(grids)
+    host = isinstance(metric_fn, HostMetricFallback)
+    y_np = np.asarray(y) if host else None
+    V_np = np.asarray(V) if host else None
+
+    for static, idxs in groups.items():
+        n_est, max_bins, esr = static
+        Xb = xb_by_bins[max_bins]
+        pad_depth = _pad_depth_of(est, grids, idxs)
+        dyn_dicts = [dyn_of(grids[i]) for i in idxs]
+        dyn = {k: jnp.asarray([dd[k] for dd in dyn_dicts],
+                              jnp.int32 if isinstance(dyn_dicts[0][k], int)
+                              else jnp.float32)
+               for k in dyn_dicts[0]}
+        n_pairs = len(idxs) * n_folds
+        nodes = 2 ** min(pad_depth, 14)
+        upr = float(n_rows) * nodes * d_feat * max_bins  # units/round/pair
+        mem_per_pair = n_rows * (d_feat * max_bins + nodes) * 2
+        w_mem = max(1, int(_PAIR_MEM_BYTES // mem_per_pair))
+
+        def chunk_pair(d, w, v, margin, best, since, ks):
+            (m, b, s), _ = fit_gbt_chunk(
+                Xb, y, w, v, margin, best, since, ks, int(ks.shape[0]),
+                pad_depth, max_bins, d["lr"], d["lam"], objective,
+                d["mcw"], d["depth"], d["gamma"], d["alpha"],
+                d["subsample"], d["colsample"], esr, d["min_gain_norm"])
+            return m, b, s
+
+        prog = jax.jit(jax.vmap(chunk_pair,
+                                in_axes=(0, 0, 0, 0, 0, 0, None)))
+        if host:
+            pred_prog = jax.jit(jax.vmap(
+                lambda m: gbt_pred_from_margin(m, objective)))
+        else:
+            metric_prog = jax.jit(jax.vmap(
+                lambda m, v: metric_fn(
+                    y, gbt_pred_from_margin(m, objective), v)))
+        keys_all = jax.random.split(jax.random.PRNGKey(seed), n_est)
+
+        s = 0
+        while s < n_pairs:
+            spu = _sec_per_unit("gbt")
+            width = max(1, min(n_pairs - s, w_mem,
+                               int(_PAIR_EXEC_TARGET_S
+                                   / max(n_est * upr * spu, 1e-9))))
+            rpd = _pick_rounds_per_dispatch(
+                n_est, max(1, int(_PAIR_EXEC_TARGET_S
+                                  / max(width * upr * spu, 1e-9))))
+            ps = [min(s + t, n_pairs - 1) for t in range(width)]
+            gs = [p // n_folds for p in ps]
+            fs = [p % n_folds for p in ps]
+            dchunk = {k: v_[jnp.asarray(gs)] for k, v_ in dyn.items()}
+            Wsel = W[jnp.asarray(fs)]
+            Vsel = V[jnp.asarray(fs)]
+            margin = jnp.zeros((width, n_rows), jnp.float32)
+            best = jnp.full((width,), jnp.inf, jnp.float32)
+            since = jnp.zeros((width,), jnp.int32)
+            done = 0
+            while done < n_est:
+                ks = keys_all[done:done + rpd]
+                t0 = _time.perf_counter()
+                margin, best, since = jax.block_until_ready(
+                    prog(dchunk, Wsel, Vsel, margin, best, since, ks))
+                dt = _time.perf_counter() - t0
+                SWEEP_STATS.record(
+                    (id(prog), static, width, int(ks.shape[0])), dt)
+                done += int(ks.shape[0])
+                spu = _record_calib(
+                    "gbt", dt, float(width) * int(ks.shape[0]) * upr)
+                if (esr > 0 and done < n_est
+                        and bool(np.all(np.asarray(since) >= esr))):
+                    log.info("gbt sweep: early stop after %d/%d rounds "
+                             "(%d pairs)", done, n_est, width)
+                    break
+                if done < n_est and dt > 0.75 * 60.0 and rpd > 1:
+                    # measured too close to the serving kill: halve (the
+                    # shorter chunk compiles once, then persists in cache)
+                    new_rpd = _pick_rounds_per_dispatch(n_est, rpd // 2)
+                    log.info("gbt sweep: rounds/dispatch recalibrated "
+                             "%d -> %d (measured %.1fs)", rpd, new_rpd, dt)
+                    rpd = new_rpd
+            if host:
+                pred_np = jax.tree_util.tree_map(np.asarray,
+                                                 pred_prog(margin))
+                row_metrics = [
+                    _metric(metric_fn.evaluator, y_np,
+                            jax.tree_util.tree_map(
+                                lambda a, t=t: a[t], pred_np),
+                            V_np[fs[t]])
+                    for t in range(width)]
+            else:
+                row_metrics = [float(m) for m in
+                               np.asarray(metric_prog(margin, Vsel))]
+            for t in range(min(width, n_pairs - s)):
+                row_i, j = divmod(s + t, n_folds)
+                if metrics[idxs[row_i]] is None:
+                    metrics[idxs[row_i]] = [None] * n_folds  # type: ignore
+                metrics[idxs[row_i]][j] = row_metrics[t]  # type: ignore
+            s += width
+    return metrics  # type: ignore[return-value]
 
 
 # --------------------------------------------------------------------------- #

@@ -151,9 +151,8 @@ class ModelSelector(Estimator):
                         log.info("sweep checkpoint hit: %s (%d grids)",
                                  type(est).__name__, len(grid_fold))
                     else:
-                        grid_fold = run_sweep(est, grids, X, y_dev, folds,
-                                              self.evaluator, ctx,
-                                              sharding=sharding)
+                        grid_fold = self._run_sweep_with_retry(
+                            est, grids, X, y_dev, folds, ctx, sharding)
                         self._save_checkpoint(ckpt, grid_fold)
                     for grid, fm in zip(grids, grid_fold):
                         results.append(ValidationResult(
@@ -174,6 +173,29 @@ class ModelSelector(Estimator):
         finite = [r for r in results if np.isfinite(r.mean_metric)]
         return self._finish(ctx, results, finite, sign, X, X_full, y_np,
                             y_dev, train_idx, test_idx, split_summary)
+
+    def _run_sweep_with_retry(self, est, grids, X, y_dev, folds, ctx,
+                              sharding, retries: int = 2):
+        """The serving tunnel's remote-compile RPC occasionally drops a
+        response mid-read (transient INTERNAL error, r3 bench); dropping a
+        whole model family for that throws away real work. Retry runtime
+        errors with a short backoff — the persistent compile cache makes
+        the retry cheap — and only then let the family-drop fault
+        tolerance (OpValidator.scala:344-347 parity) take over."""
+        import time as _time
+        for attempt in range(retries + 1):
+            try:
+                return run_sweep(est, grids, X, y_dev, folds,
+                                 self.evaluator, ctx, sharding=sharding)
+            except Exception as e:
+                transient = "remote_compile" in str(e) or \
+                    type(e).__name__ == "JaxRuntimeError"
+                if attempt >= retries or not transient:
+                    raise
+                log.warning("sweep for %s hit transient runtime error "
+                            "(attempt %d/%d): %s — retrying",
+                            type(est).__name__, attempt + 1, retries, e)
+                _time.sleep(3.0 * (attempt + 1))
 
     # -- sweep checkpointing ------------------------------------------- #
 
@@ -345,39 +367,59 @@ class ModelSelector(Estimator):
 # Factories (ModelSelectorFactory + per-problem selectors)                    #
 # --------------------------------------------------------------------------- #
 
+# the reference's shared grid axes (DefaultSelectorParams.scala:35-76)
+_REGULARIZATION = (0.001, 0.01, 0.1, 0.2)
+_ELASTIC_NET = (0.1, 0.5)
+_MAX_DEPTH = (3, 6, 12)
+_MIN_INFO_GAIN = (0.001, 0.01, 0.1)
+_MIN_INSTANCES = (10.0, 100.0)
+
+
+def _lr_grid() -> List[Dict]:
+    """LR/linear: ElasticNet {0.1, 0.5} × Regularization {0.001..0.2} = 8."""
+    return [{"reg_param": r, "elastic_net_param": a}
+            for a in _ELASTIC_NET for r in _REGULARIZATION]
+
+
+def _rf_grid() -> List[Dict]:
+    """RF/DT: MaxDepth × MinInfoGain × MinInstancesPerNode = 18."""
+    return [{"max_depth": d, "min_info_gain": g, "min_instances_per_node": m}
+            for d in _MAX_DEPTH for g in _MIN_INFO_GAIN
+            for m in _MIN_INSTANCES]
+
+
 def _default_binary_models() -> List[Tuple[Estimator, List[Dict]]]:
     """Reference defaults: LR + RF + XGB
-    (BinaryClassificationModelSelector.scala:62-64), grids from
-    DefaultSelectorParams (maxDepth {3,6,12}, reg {0.001..0.2})."""
+    (BinaryClassificationModelSelector.scala:62-64, grids :70-137): LR 8
+    elastic-net configs at maxIter 50, RF 18 tree-shape configs at
+    numTrees 50, XGB numRound 200 / eta 0.02 / depth 10 / gamma 0.8 /
+    early stopping 20 × minChildWeight {1, 10} — 28 configs total."""
     from transmogrifai_tpu.models import (
         OpRandomForestClassifier, OpXGBoostClassifier)
-    lr_grid = [{"reg_param": r} for r in (0.001, 0.01, 0.1, 0.2)]
-    rf_grid = [{"max_depth": d, "min_child_weight": m}
-               for d in (3, 6, 12) for m in (1.0, 10.0)]
-    xgb_grid = [{"eta": e, "max_depth": d}
-                for e in (0.1, 0.3) for d in (3, 6)]
-    return [(OpLogisticRegression(max_iter=50), lr_grid),
-            (OpRandomForestClassifier(n_trees=20), rf_grid),
-            (OpXGBoostClassifier(n_estimators=50), xgb_grid)]
+    xgb_grid = [{"min_child_weight": m} for m in (1.0, 10.0)]
+    return [(OpLogisticRegression(max_iter=50), _lr_grid()),
+            (OpRandomForestClassifier(n_trees=50), _rf_grid()),
+            (OpXGBoostClassifier(n_estimators=200, eta=0.02, max_depth=10,
+                                 gamma=0.8, early_stopping_rounds=20),
+             xgb_grid)]
 
 
 def _default_multiclass_models() -> List[Tuple[Estimator, List[Dict]]]:
+    """LR + RF (MultiClassificationModelSelector.scala:61-88) — 26 configs."""
     from transmogrifai_tpu.models import OpRandomForestClassifier
-    lr_grid = [{"reg_param": r} for r in (0.001, 0.01, 0.1, 0.2)]
-    rf_grid = [{"max_depth": d} for d in (3, 6, 12)]
-    return [(OpLogisticRegression(max_iter=50), lr_grid),
-            (OpRandomForestClassifier(n_trees=20), rf_grid)]
+    return [(OpLogisticRegression(max_iter=50), _lr_grid()),
+            (OpRandomForestClassifier(n_trees=50), _rf_grid())]
 
 
 def _default_regression_models() -> List[Tuple[Estimator, List[Dict]]]:
+    """Linear + RF + GBT (RegressionModelSelector.scala:61-99): linear 8
+    elastic-net configs, RF 18, Spark-GBT 18 at maxIter 20 / stepSize 0.1
+    — 44 configs total."""
     from transmogrifai_tpu.models import (
         OpGBTRegressor, OpRandomForestRegressor)
-    lin_grid = [{"reg_param": r} for r in (0.0, 0.001, 0.01, 0.1)]
-    rf_grid = [{"max_depth": d} for d in (3, 6, 12)]
-    gbt_grid = [{"max_depth": d} for d in (3, 6)]
-    return [(OpLinearRegression(), lin_grid),
-            (OpRandomForestRegressor(n_trees=20), rf_grid),
-            (OpGBTRegressor(n_estimators=50), gbt_grid)]
+    return [(OpLinearRegression(), _lr_grid()),
+            (OpRandomForestRegressor(n_trees=50), _rf_grid()),
+            (OpGBTRegressor(n_estimators=20, learning_rate=0.1), _rf_grid())]
 
 
 class BinaryClassificationModelSelector:

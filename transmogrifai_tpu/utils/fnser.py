@@ -1,29 +1,93 @@
 """Function (de)serialization for stage params.
 
 The reference persists macro-captured extract-fn sources and named classes
-(FeatureGeneratorStageReaderWriter, FeatureBuilderMacros.scala:40-95);
-python's equivalent fidelity is cloudpickle: lambdas and closures
-round-trip byte-exactly. Named module-level functions are stored as
-`module:qualname` references (readable + stable across versions); anything
-else falls back to a cloudpickle payload.
+(FeatureGeneratorStageReaderWriter, FeatureBuilderMacros.scala:40-95).
+Three fidelity tiers here, most-stable first:
 
-Loading a model therefore executes pickled code — the same trust model as
-every pickle-based ML model format; only load models you produced.
+1. `@extract_fn("name")` registry — the name is the persisted artifact
+   (readable manifests, survives refactors as long as the registration
+   exists at load time). The macro-captured-class-name analogue.
+2. Named module-level functions as `module:qualname` references.
+3. cloudpickle payload for lambdas/closures — byte-exact round-trip but
+   tied to the writing interpreter's code.
+
+`save_model(strict_fns=True)` refuses tier 3 so production models never
+silently depend on pickled bytecode.
+
+Loading a model may execute pickled code — the same trust model as every
+pickle-based ML model format; only load models you produced.
 """
 
 from __future__ import annotations
 
 import base64
 import importlib
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 _REF_KEY = "__pyref__"
 _PICKLE_KEY = "__pyfn__"
+_REG_KEY = "__pyregistry__"
+
+_EXTRACT_REGISTRY: Dict[str, Callable] = {}
 
 
-def encode_fn(fn: Optional[Callable]) -> Any:
+def extract_fn(name: str) -> Callable[[Callable], Callable]:
+    """Decorator registering a stable name for an extract/row function:
+
+        @extract_fn("age_years")
+        def age_years(rec): ...
+
+    Registered callables persist as their NAME (the reference's
+    macro-captured class name, `FeatureGeneratorStage.scala:129`); loading
+    re-resolves through the registry, so the defining module just has to
+    be imported before `load_model`."""
+    def deco(fn: Callable) -> Callable:
+        existing = _EXTRACT_REGISTRY.get(name)
+        if existing is not None and existing is not fn:
+            raise ValueError(f"extract_fn name {name!r} already registered")
+        _EXTRACT_REGISTRY[name] = fn
+        fn.__extract_name__ = name
+        return fn
+    return deco
+
+
+def registered_fn(name: str) -> Callable:
+    if name not in _EXTRACT_REGISTRY:
+        raise KeyError(
+            f"extract fn {name!r} is not registered; import the module "
+            f"that defines it (with its @extract_fn decorator) before "
+            f"loading this model")
+    return _EXTRACT_REGISTRY[name]
+
+
+# process-wide strict mode, toggled by save_model(strict_fns=True) around
+# manifest building (get_params() implementations call encode_fn with no
+# way to thread a flag through)
+_STRICT_DEPTH = 0
+
+
+def push_strict() -> int:
+    global _STRICT_DEPTH
+    _STRICT_DEPTH += 1
+    return _STRICT_DEPTH
+
+
+def pop_strict(token: int) -> None:
+    global _STRICT_DEPTH
+    _STRICT_DEPTH = max(0, _STRICT_DEPTH - 1)
+
+
+def encode_fn(fn: Optional[Callable], strict: bool = False) -> Any:
+    """`strict=True` (or an active `push_strict()` scope) raises instead
+    of emitting a cloudpickle payload — used by
+    `save_model(strict_fns=True)` so unregistered closures fail LOUDLY at
+    save time rather than shipping bytecode-pinned models."""
+    strict = strict or _STRICT_DEPTH > 0
     if fn is None:
         return None
+    name = getattr(fn, "__extract_name__", None)
+    if name is not None and _EXTRACT_REGISTRY.get(name) is fn:
+        return {_REG_KEY: name}
     mod = getattr(fn, "__module__", None)
     qual = getattr(fn, "__qualname__", "")
     # __main__ refs would resolve against whatever entrypoint LOADS the
@@ -35,6 +99,11 @@ def encode_fn(fn: Optional[Callable]) -> Any:
                 return {_REF_KEY: f"{mod}:{qual}"}
         except Exception:
             pass
+    if strict:
+        raise ValueError(
+            f"cannot serialize {qual or fn!r} without a cloudpickle "
+            f"payload: register it with @extract_fn(name) or define it "
+            f"at module level (strict_fns=True forbids pickled closures)")
     import cloudpickle
     return {_PICKLE_KEY: base64.b64encode(cloudpickle.dumps(fn)).decode()}
 
@@ -43,6 +112,8 @@ def decode_fn(obj: Any) -> Optional[Callable]:
     if obj is None or callable(obj):
         return obj
     if isinstance(obj, dict):
+        if _REG_KEY in obj:
+            return registered_fn(obj[_REG_KEY])
         if _REF_KEY in obj:
             mod, qual = obj[_REF_KEY].split(":", 1)
             target: Any = importlib.import_module(mod)

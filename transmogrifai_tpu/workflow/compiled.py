@@ -159,10 +159,17 @@ class CompiledScorer:
                 dv = c.device_value()
                 if dv is not None:
                     raw_dev[uid] = dv
-        return self._place(encs), self._place(raw_dev), columns
+        n_rows = len(dataset)
+        return (self._place(encs, n_rows), self._place(raw_dev, n_rows),
+                columns)
 
-    def _place(self, pytree):
-        """Shard batch-axis arrays over the configured row sharding."""
+    def _place(self, pytree, n_rows: int):
+        """Shard arrays whose leading dim IS the batch axis over the row
+        sharding. Matching on `n_rows` (not mere divisibility) keeps
+        non-batch arrays — e.g. a (d,) encoding vector whose length
+        happens to divide by the shard count — replicated instead of
+        feature-axis-sharded (which would be value-correct but insert
+        pointless resharding collectives)."""
         if self.sharding is None:
             return pytree
         import jax.tree_util as jtu
@@ -178,7 +185,8 @@ class CompiledScorer:
 
         def put(a):
             arr = np.asarray(a) if not hasattr(a, "sharding") else a
-            if getattr(arr, "ndim", 0) >= 1 and arr.shape[0] % shards == 0:
+            if (getattr(arr, "ndim", 0) >= 1 and arr.shape[0] == n_rows
+                    and n_rows % shards == 0):
                 return jax.device_put(arr, self.sharding)
             return a
         return jtu.tree_map(put, pytree)
@@ -187,6 +195,7 @@ class CompiledScorer:
 
     def run(self, dataset: Dataset):
         """Execute all segments; returns (dev_vals, columns)."""
+        n_rows = len(dataset)
         columns: Dict[str, Column] = {}
         dev_vals: Dict[str, Any] = {}
         for gen in self.generators:
@@ -195,7 +204,7 @@ class CompiledScorer:
             columns[f.uid] = c
             if c.kind not in _HOST_KINDS:
                 dev_vals[f.uid] = c.device_value()
-        dev_vals = self._place(dev_vals)
+        dev_vals = self._place(dev_vals, n_rows)
 
         for (kind, stages), jfn in zip(self.segments, self._seg_fns):
             if kind == "host":
@@ -212,7 +221,7 @@ class CompiledScorer:
                     columns[uid] = out_col
                     dv = out_col.device_value()
                     if dv is not None:
-                        dev_vals[uid] = self._place(dv)
+                        dev_vals[uid] = self._place(dv, n_rows)
             else:
                 encs: Dict[str, Any] = {}
                 for stage in stages:
@@ -220,7 +229,7 @@ class CompiledScorer:
                     enc = stage.host_prepare(cols)
                     if enc is not None:
                         encs[stage.uid] = enc
-                dev_vals.update(jfn(self._consts, self._place(encs),
+                dev_vals.update(jfn(self._consts, self._place(encs, n_rows),
                                     dev_vals))
         return dev_vals, columns
 

