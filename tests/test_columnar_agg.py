@@ -1,0 +1,136 @@
+"""Vectorized event-time aggregation vs the per-record Python fold
+(the semantic oracle): exact parity + the 1M-event speed bar
+(VERDICT r2 #7, `DataReader.scala:216-330`)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.aggregators import CutOffTime
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.readers.readers import (
+    AggregateDataReader, ConditionalDataReader)
+
+
+def _features():
+    preds = [
+        FeatureBuilder.Real("amount").from_column("amount").as_predictor(),
+        FeatureBuilder.Integral("clicks").from_column("clicks").as_predictor(),
+        FeatureBuilder.Binary("active").from_column("active").as_predictor(),
+        FeatureBuilder.Date("last_seen").from_column("last_seen")
+        .as_predictor(),
+        FeatureBuilder.Percent("rate").from_column("rate").as_predictor(),
+    ]
+    resp = FeatureBuilder.RealNN("label").from_column("label").as_response()
+    return preds + [resp]
+
+
+def _events(n=3000, n_keys=300, seed=0, with_condition=False):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(n_keys, size=n)
+    times = rng.integers(0, 1_000_000, size=n)
+    rows = []
+    for i in range(n):
+        rows.append({
+            "key": f"k{keys[i]}",
+            "t": int(times[i]),
+            "amount": (float(rng.normal()) if rng.uniform() > 0.1 else None),
+            "clicks": int(rng.integers(0, 5)),
+            "active": bool(rng.uniform() > 0.5),
+            "last_seen": int(times[i]),
+            "rate": float(rng.uniform()),
+            "label": float(rng.uniform() > 0.5),
+            "hit": bool(rng.uniform() > 0.7) if with_condition else False,
+        })
+    schema = {"key": t.ID, "t": t.Integral, "amount": t.Real,
+              "clicks": t.Integral, "active": t.Binary,
+              "last_seen": t.Date, "rate": t.Percent, "label": t.RealNN,
+              "hit": t.Binary}
+    ds = Dataset.from_rows(rows, schema=schema)
+    return rows, ds
+
+
+def _compare(ds_row: Dataset, ds_col: Dataset, features):
+    # row order: both keyed datasets sorted by key for comparison
+    def by_key(ds):
+        keys = list(ds.column("key"))
+        order = np.argsort(keys)
+        return {name: np.asarray(ds.column(name), dtype=object)[order]
+                for name in ds.names()}
+    a, b = by_key(ds_row), by_key(ds_col)
+    assert list(a["key"]) == list(b["key"])
+    for f in features:
+        for va, vb in zip(a[f.name], b[f.name]):
+            if va is None or (isinstance(va, float) and np.isnan(va)):
+                assert vb is None or (isinstance(vb, float) and np.isnan(vb)), \
+                    (f.name, va, vb)
+            elif isinstance(va, float):
+                assert vb == pytest.approx(va, rel=1e-9), (f.name, va, vb)
+            else:
+                assert va == vb, (f.name, va, vb)
+
+
+@pytest.mark.parametrize("cutoff", [
+    None, CutOffTime.unix_epoch(500_000), CutOffTime.infinite_future()])
+def test_aggregate_columnar_parity(cutoff):
+    rows, ds = _events()
+    features = _features()
+    row_reader = AggregateDataReader(
+        rows, key_fn=lambda r: r["key"], time_fn=lambda r: r["t"],
+        cutoff=cutoff)
+    col_reader = AggregateDataReader(
+        ds, cutoff=cutoff, key_column="key", time_column="t")
+    _compare(row_reader.read(features), col_reader.read(features), features)
+
+
+@pytest.mark.parametrize("keep,drop", [
+    ("min", False), ("max", True), ("random", False), ("random", True)])
+def test_conditional_columnar_parity(keep, drop):
+    rows, ds = _events(with_condition=True)
+    features = _features()
+    row_reader = ConditionalDataReader(
+        rows, key_fn=lambda r: r["key"], time_fn=lambda r: r["t"],
+        target_condition=lambda r: r["hit"], time_stamp_to_keep=keep,
+        drop_if_not_met=drop, seed=7)
+    col_reader = ConditionalDataReader(
+        ds, time_stamp_to_keep=keep, drop_if_not_met=drop, seed=7,
+        key_column="key", time_column="t", condition_column="hit")
+    _compare(row_reader.read(features), col_reader.read(features), features)
+
+
+def test_columnar_aggregate_1m_events_fast():
+    """The scale bar: 1M events aggregate in seconds (the row path takes
+    minutes at this size)."""
+    n, n_keys = 1_000_000, 50_000
+    rng = np.random.default_rng(1)
+    ds = Dataset(
+        {"key": np.char.add("k", rng.integers(
+            n_keys, size=n).astype(str)).astype(object),
+         "t": rng.integers(0, 1_000_000, size=n).astype(np.float64),
+         "amount": rng.normal(size=n),
+         "label": (rng.uniform(size=n) > 0.5).astype(np.float64)},
+        {"key": t.ID, "t": t.Integral, "amount": t.Real, "label": t.RealNN})
+    features = [
+        FeatureBuilder.Real("amount").from_column("amount").as_predictor(),
+        FeatureBuilder.RealNN("label").from_column("label").as_response()]
+    reader = AggregateDataReader(
+        ds, cutoff=CutOffTime.unix_epoch(500_000),
+        key_column="key", time_column="t")
+    t0 = time.time()
+    out = reader.read(features)
+    dt = time.time() - t0
+    assert len(out) == n_keys
+    assert dt < 30.0, f"1M-event columnar aggregate took {dt:.1f}s"
+    # spot-check one key against the oracle fold
+    kcol = np.asarray(out.column("key"))
+    target = kcol[0]
+    keys_all = np.asarray(ds.column("key")).astype(str)
+    sel = keys_all == target
+    ts = np.asarray(ds.column("t"))[sel]
+    am = np.asarray(ds.column("amount"))[sel]
+    expected = am[ts < 500_000].sum()
+    got = out.column("amount")[0]
+    assert got == pytest.approx(expected, rel=1e-9)

