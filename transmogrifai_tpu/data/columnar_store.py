@@ -83,14 +83,17 @@ class ColumnarStore:
                feature_names: Optional[List[str]] = None,
                label_dtype: str = "float32") -> "ColumnarStoreWriter":
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, MANIFEST), "w") as fh:
-            json.dump({"n_rows": n_rows, "n_features": n_features,
-                       "dtype": dtype, "label_dtype": label_dtype,
-                       "feature_names": feature_names}, fh)
-        return ColumnarStoreWriter(path, n_rows, n_features,
-                                   np.dtype(dtype),
-                                   np.dtype(label_dtype) if with_labels
-                                   else None)
+        # stale manifest from an interrupted generation must not make a
+        # half-written store look complete (reuse= would read zeros)
+        stale = os.path.join(path, MANIFEST)
+        if os.path.exists(stale):
+            os.unlink(stale)
+        return ColumnarStoreWriter(
+            path, n_rows, n_features, np.dtype(dtype),
+            np.dtype(label_dtype) if with_labels else None,
+            manifest={"n_rows": n_rows, "n_features": n_features,
+                      "dtype": dtype, "label_dtype": label_dtype,
+                      "feature_names": feature_names})
 
     # -- stats ---------------------------------------------------------- #
 
@@ -110,10 +113,12 @@ class ColumnarStore:
 
 class ColumnarStoreWriter:
     def __init__(self, path: str, n_rows: int, n_features: int,
-                 dtype: np.dtype, label_dtype: Optional[np.dtype]):
+                 dtype: np.dtype, label_dtype: Optional[np.dtype],
+                 manifest: Optional[Dict] = None):
         self.path = path
         self.n_rows = n_rows
         self.n_features = n_features
+        self._manifest = manifest
         self._X = np.memmap(os.path.join(path, X_FILE), dtype=dtype,
                             mode="w+", shape=(n_rows, n_features))
         self._y = (np.memmap(os.path.join(path, Y_FILE), dtype=label_dtype,
@@ -133,6 +138,13 @@ class ColumnarStoreWriter:
         self._X.flush()
         if self._y is not None:
             self._y.flush()
+        # the manifest is the completion sentinel: written LAST so an
+        # interrupted generation never passes the reuse= check
+        if self._manifest is not None:
+            tmp = os.path.join(self.path, MANIFEST + ".tmp")
+            with open(tmp, "w") as fh:
+                json.dump(self._manifest, fh)
+            os.replace(tmp, os.path.join(self.path, MANIFEST))
         return ColumnarStore(self.path)
 
 

@@ -391,10 +391,10 @@ def grow_tree_big(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     return {"feat": feats, "bin": bins, "leaf": leaf}
 
 
-@partial(jax.jit, static_argnames=("max_depth", "n_bins", "n_outputs",
+@partial(jax.jit, static_argnames=("max_depth", "n_bins",
                                    "chunk", "bootstrap", "n_sub"))
 def _forest_trees_big(Xb, Y, w, keys, max_depth: int, n_bins: int,
-                      n_outputs: int, min_child_weight=1.0, min_gain=0.0,
+                      min_child_weight=1.0, min_gain=0.0,
                       n_sub: Optional[int] = None, bootstrap: bool = True,
                       chunk: int = HIST_CHUNK_ROWS):
     """Grow keys.shape[0] trees SEQUENTIALLY inside one program
@@ -459,18 +459,19 @@ def fit_forest_big(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
     for t0 in range(0, n_trees, tpd):
         ks = keys[t0:t0 + tpd]
         parts.append(_forest_trees_big(
-            Xb, Y, w, ks, max_depth, n_bins, n_outputs,
+            Xb, Y, w, ks, max_depth, n_bins,
             min_child_weight, min_gain, n_sub, bootstrap, chunk))
     return jax.tree.map(lambda *a: jnp.concatenate(a), *parts)
 
 
 @partial(jax.jit, static_argnames=("max_depth", "n_bins", "objective",
                                    "chunk"))
-def _gbt_round_big(Xb, y, w, margin, key, max_depth: int, n_bins: int,
+def _gbt_round_big(Xb, y, w, margin, max_depth: int, n_bins: int,
                    learning_rate, reg_lambda, objective: str,
                    min_child_weight=1.0, gamma=0.0,
                    chunk: int = HIST_CHUNK_ROWS):
-    n, d = Xb.shape
+    """One deterministic boosting round (the big path has no row/column
+    subsampling, so no PRNG plumbing)."""
     if objective == "logistic":
         p = jax.nn.sigmoid(margin)
         g, h = (p - y) * w, jnp.maximum(p * (1 - p), 1e-6) * w
@@ -491,12 +492,11 @@ def fit_gbt_big(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
                 ) -> Tuple[Dict, jnp.ndarray]:
     """Host loop over boosting rounds carrying the device margin."""
     n = Xb.shape[0]
-    keys = jax.random.split(jax.random.PRNGKey(seed), n_estimators)
     margin = jnp.zeros(n, jnp.float32)
     trees = []
     for r in range(n_estimators):
         margin, tree = _gbt_round_big(
-            Xb, y, w, margin, keys[r], max_depth, n_bins,
+            Xb, y, w, margin, max_depth, n_bins,
             jnp.float32(learning_rate), jnp.float32(reg_lambda), objective,
             min_child_weight, jnp.float32(gamma), chunk)
         trees.append(tree)

@@ -520,6 +520,10 @@ def _record_calib(kind: str, seconds: float, units: float) -> float:
     return new
 
 
+def _pow2_floor(x: int) -> int:
+    return 1 << max(0, int(x).bit_length() - 1)
+
+
 def _tree_pair_width(n: int, d: int, n_bins: int, learners: int,
                      sec_per_unit: float, pad_depth: int) -> int:
     nodes = 2 ** min(pad_depth, 14)
@@ -528,7 +532,10 @@ def _tree_pair_width(n: int, d: int, n_bins: int, learners: int,
     mem_per_pair = n * (d * n_bins + nodes) * 2  # bf16 bytes
     w_exec = int(_PAIR_EXEC_TARGET_S / est_s)
     w_mem = int(_PAIR_MEM_BYTES // max(mem_per_pair, 1))
-    return max(1, min(w_exec, w_mem))
+    # power-of-2 width: small calibration drift between runs must not
+    # change the dispatch shape (every distinct width is a fresh remote
+    # AOT compile that misses the persistent cache)
+    return _pow2_floor(max(1, min(w_exec, w_mem)))
 
 def _binned_cache(est, grids, X, ctx) -> Dict[int, jnp.ndarray]:
     """Bin X once per distinct max_bins ACROSS tree families in a sweep:
@@ -799,12 +806,17 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         s = 0
         while s < n_pairs:
             spu = _sec_per_unit("gbt")
-            width = max(1, min(n_pairs - s, w_mem,
-                               int(_PAIR_EXEC_TARGET_S
-                                   / max(n_est * upr * spu, 1e-9))))
+            # power-of-2 width + divisor-quantized rounds: calibration
+            # drift between runs must not change compiled dispatch shapes.
+            # NOT clamped to the remaining pair count — the pair-index
+            # padding (`ps` repeats the last pair) keeps the tail chunk at
+            # the same compiled shape instead of forcing a second compile
+            width = _pow2_floor(max(1, min(
+                n_pairs, w_mem, int(_PAIR_EXEC_TARGET_S
+                                    / max(n_est * upr * spu, 1e-9)))))
             rpd = _pick_rounds_per_dispatch(
-                n_est, max(1, int(_PAIR_EXEC_TARGET_S
-                                  / max(width * upr * spu, 1e-9))))
+                n_est, _pow2_floor(max(1, int(
+                    _PAIR_EXEC_TARGET_S / max(width * upr * spu, 1e-9)))))
             ps = [min(s + t, n_pairs - 1) for t in range(width)]
             gs = [p // n_folds for p in ps]
             fs = [p % n_folds for p in ps]

@@ -38,8 +38,7 @@ _VEC_OPS: Dict[str, Tuple[np.ufunc, float]] = {
 
 def vector_op_of(agg_name: str) -> Optional[Tuple[str, np.ufunc, float]]:
     for prefix, (ufunc, fill) in _VEC_OPS.items():
-        if agg_name.startswith(prefix) or \
-                (prefix in ("Max",) and agg_name.startswith("MaxDate")):
+        if agg_name.startswith(prefix):
             return prefix, ufunc, fill
     return None
 
@@ -130,6 +129,7 @@ def aggregate_columnar(dataset, key_column: str, time_column: str,
 
     out: Dict[str, List[Any]] = {}
     slow_cols: Dict[str, np.ndarray] = {}
+    rows_cache: List = []  # materialized once, shared by extract features
     for f in raw_features:
         stage = f.origin_stage
         agg = stage.params.get("aggregator") or default_aggregator(f.ftype)
@@ -175,9 +175,11 @@ def aggregate_columnar(dataset, key_column: str, time_column: str,
             # monoids, extract-fn features)
             if f.name not in slow_cols:
                 if stage.extract is not None:
-                    rows = dataset.to_rows()
+                    if not rows_cache:
+                        rows_cache.append(dataset.to_rows())
                     slow_cols[f.name] = np.array(
-                        [stage.extract(r) for r in rows], dtype=object)
+                        [stage.extract(r) for r in rows_cache[0]],
+                        dtype=object)
                 else:
                     raw = np.asarray(dataset.column(stage.column))
                     slow_cols[f.name] = raw

@@ -362,6 +362,19 @@ class WorkflowModel:
                                   else columns[f.uid].data)
             return result
 
+        import jax as _jax
+
+        def encode(ds):
+            encs, raw_dev, columns = scorer.host_phase(ds)
+            # pre-stage the bulk input transfer from the WORKER thread so
+            # uploads of batch i+1 overlap the device execution of batch
+            # i (the transfer otherwise serializes inside dispatch)
+            try:
+                raw_dev = _jax.device_put(raw_dev)
+            except Exception:
+                pass  # non-array leaves: let dispatch transfer lazily
+            return encs, raw_dev, columns
+
         with ThreadPoolExecutor(max_workers=max(1, host_workers)) as pool:
             encoded = deque()    # host-encode futures
             in_flight = deque()  # dispatched (async) device results
@@ -372,7 +385,7 @@ class WorkflowModel:
                     in_flight.append(dispatch(encoded.popleft().result()))
 
             for ds in batches:
-                encoded.append(pool.submit(scorer.host_phase, ds))
+                encoded.append(pool.submit(encode, ds))
                 pump()
                 while len(in_flight) > max(1, device_depth):
                     yield in_flight.popleft()
