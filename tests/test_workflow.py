@@ -117,3 +117,24 @@ def test_untrained_estimator_score_fails():
     model = WorkflowModel(result_features=(pf,), fitted={})
     with pytest.raises(RuntimeError, match="no\\s+.*fitted|fitted"):
         model.score(ds)
+
+
+def test_finite_checks(trained):
+    """§5.2 sanitizer discipline: with_finite_checks raises on a stage
+    producing NaN, passes on a healthy pipeline. Runs entirely on a
+    deepcopy so the module-scoped fixture never carries the flag into
+    other tests, even when an assertion fails."""
+    import copy
+    ds, label, pred_feature, model = trained
+    good = copy.deepcopy(model).with_finite_checks()
+    out = good.score(ds)  # healthy pipeline: no raise
+    assert pred_feature.name in out
+    # poison one fitted model's params -> the check must name the stage
+    bad = copy.deepcopy(model).with_finite_checks()
+    for uid, fitted in bad.fitted.items():
+        W = getattr(fitted, "W", None)
+        if W is not None:
+            fitted.W = np.full_like(W, np.nan)
+            break
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        bad.score(ds)

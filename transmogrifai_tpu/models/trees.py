@@ -100,7 +100,13 @@ def _histograms(B, node_idx, G, H, n_nodes: int):
     serialize) and its (n, d, m) update tensor tile-pads the tiny class
     axis to 128 lanes (the r2 152 GB OOM). Contraction over the row axis
     also means a mesh-sharded batch reduces via an XLA-inserted psum —
-    the Rabit-allreduce analogue (SURVEY.md §2.9)."""
+    the Rabit-allreduce analogue (SURVEY.md §2.9).
+
+    Per-value-column matmuls (B read m+1 times) measure FASTER here than
+    stacking [G, H] into one ((m+1)·nodes, n) operand: at in-core shapes
+    (d ≈ 55) the A-side (n, (m+1)·nodes) materialization costs more than
+    the saved B reads — the OPPOSITE tradeoff from the out-of-core path
+    (d=500, B per-chunk rebuilt), where `parallel/bigdata.py` stacks."""
     n, d, nb = B.shape
     m = G.shape[1]
     A = jax.nn.one_hot(node_idx, n_nodes, dtype=jnp.bfloat16)  # (n, nodes)

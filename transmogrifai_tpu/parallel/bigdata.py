@@ -444,7 +444,9 @@ def fit_forest_big(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
     """Host loop dispatching `trees_per_dispatch`-tree scan programs —
     no single execution can hit the ~60s serving kill, and the per-
     dispatch RPC amortizes over the batch. Returns stacked (T, ...)
-    tree arrays like `fit_forest`."""
+    tree arrays like `fit_forest`. (`n_outputs` is accepted for
+    `fit_forest` signature parity; the output width comes from Y's
+    trailing dim.)"""
     n, d = int(Xb.shape[0]), int(Xb.shape[1])
     n_sub = max(int(np.sqrt(d)), 1) if subsample_features else None
     if trees_per_dispatch is None:
@@ -490,7 +492,10 @@ def fit_gbt_big(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
                 min_child_weight: float = 1.0, gamma: float = 0.0,
                 seed: int = 0, chunk: int = HIST_CHUNK_ROWS
                 ) -> Tuple[Dict, jnp.ndarray]:
-    """Host loop over boosting rounds carrying the device margin."""
+    """Host loop over boosting rounds carrying the device margin.
+    `seed` is accepted for signature parity with `fit_gbt` but currently
+    unused — the big path has no row/column subsampling (deterministic
+    rounds)."""
     n = Xb.shape[0]
     margin = jnp.zeros(n, jnp.float32)
     trees = []

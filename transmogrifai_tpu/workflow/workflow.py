@@ -272,6 +272,35 @@ class WorkflowModel:
         self._compiled = None
         self.rff_results = None   # RawFeatureFilterResults when RFF ran
         self.blocklist: List[str] = []
+        self._check_finite = False
+
+    def with_finite_checks(self, enabled: bool = True) -> "WorkflowModel":
+        """Numeric-sanitizer discipline (SURVEY §5.2 — the build's
+        analogue of the reference's serializability validation): when
+        enabled, every fitted transform's numeric output is checked for
+        NaN/Inf on PRESENT values during eager scoring, raising with the
+        producing stage's name instead of letting a poisoned column
+        propagate into a silent bad model score."""
+        self._check_finite = enabled
+        return self
+
+    @staticmethod
+    def _assert_finite(stage, col: Column) -> None:
+        data = col.data
+        leaves = (data.values() if isinstance(data, dict) else [data])
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            if arr.dtype.kind != "f":
+                continue
+            if isinstance(data, dict) and "mask" in data:
+                mask = np.asarray(data["mask"]).astype(bool)
+                if arr.shape[:1] == mask.shape[:1]:
+                    arr = arr[mask]
+            if arr.size and not np.isfinite(arr).all():
+                raise FloatingPointError(
+                    f"Stage {stage.operation_name} ({stage.uid}) produced "
+                    f"non-finite values (NaN/Inf) in its output — enable "
+                    f"upstream imputation or inspect the fitted params")
 
     # ------------------------------------------------------------------ #
     # execution                                                          #
@@ -292,7 +321,10 @@ class WorkflowModel:
                         f"Stage {stage.operation_name} ({stage.uid}) has no "
                         "fitted model — did train() run?")
                 inputs = [columns[f.uid] for f in stage.input_features]
-                columns[stage.get_output().uid] = model.transform(inputs)
+                out_col = model.transform(inputs)
+                if self._check_finite:
+                    self._assert_finite(stage, out_col)
+                columns[stage.get_output().uid] = out_col
         return columns
 
     def score(self, dataset: Dataset,
