@@ -31,10 +31,21 @@ def test_store_roundtrip(small_store):
     # chunk iteration covers every row exactly once
     total = sum(len(c) for _, c in st.iter_chunks(700))
     assert total == 5000
-    # reuse=True returns the existing store without regenerating
-    st3 = synth_binary_store(st.path, 5000, 12, seed=999)
+    # reuse=True returns the existing store without regenerating — but
+    # only when the generation parameters match (seed lives in the
+    # manifest; a different seed must NOT silently return other data)
+    st3 = synth_binary_store(st.path, 5000, 12, seed=3)
     np.testing.assert_array_equal(np.asarray(st3.chunk(0, 50)),
                                   np.asarray(st.chunk(0, 50)))
+
+
+def test_store_reuse_regenerates_on_seed_mismatch(tmp_path):
+    path = str(tmp_path / "seeded")
+    a = synth_binary_store(path, 1000, 6, seed=3, chunk_rows=512)
+    first = np.asarray(a.chunk(0, 50)).copy()
+    b = synth_binary_store(path, 1000, 6, seed=999, chunk_rows=512)
+    assert b.meta.get("synth_seed") == 999
+    assert not np.array_equal(np.asarray(b.chunk(0, 50)), first)
 
 
 def test_device_matrix_upload(small_store):

@@ -39,6 +39,7 @@ class ColumnarStore:
         self.path = path
         with open(os.path.join(path, MANIFEST)) as fh:
             m = json.load(fh)
+        self.meta: Dict = m
         self.n_rows: int = m["n_rows"]
         self.n_features: int = m["n_features"]
         self.dtype = np.dtype(m["dtype"])
@@ -81,19 +82,22 @@ class ColumnarStore:
     def create(path: str, n_rows: int, n_features: int,
                dtype: str = "float16", with_labels: bool = True,
                feature_names: Optional[List[str]] = None,
-               label_dtype: str = "float32") -> "ColumnarStoreWriter":
+               label_dtype: str = "float32",
+               extra_manifest: Optional[Dict] = None) -> "ColumnarStoreWriter":
         os.makedirs(path, exist_ok=True)
         # stale manifest from an interrupted generation must not make a
         # half-written store look complete (reuse= would read zeros)
         stale = os.path.join(path, MANIFEST)
         if os.path.exists(stale):
             os.unlink(stale)
+        manifest = {"n_rows": n_rows, "n_features": n_features,
+                    "dtype": dtype, "label_dtype": label_dtype,
+                    "feature_names": feature_names}
+        manifest.update(extra_manifest or {})
         return ColumnarStoreWriter(
             path, n_rows, n_features, np.dtype(dtype),
             np.dtype(label_dtype) if with_labels else None,
-            manifest={"n_rows": n_rows, "n_features": n_features,
-                      "dtype": dtype, "label_dtype": label_dtype,
-                      "feature_names": feature_names})
+            manifest=manifest)
 
     # -- stats ---------------------------------------------------------- #
 
@@ -156,22 +160,26 @@ def synth_binary_store(path: str, n_rows: int, n_features: int,
     target 4 shape): standard-normal features, a sparse planted linear
     signal plus one pairwise interaction, labels from the logistic model.
     Never holds more than one chunk in RAM. `reuse=True` returns an
-    existing store with a matching manifest (bench runs re-use the
-    on-disk matrix across rounds)."""
+    existing store with a matching manifest — shape AND generation
+    parameters (seed/informative live in the manifest, so a request for a
+    different seed regenerates instead of silently returning other data)."""
+    informative = min(informative, n_features)
     if reuse and os.path.exists(os.path.join(path, MANIFEST)):
         try:
             st = ColumnarStore(path)
             if (st.n_rows == n_rows and st.n_features == n_features
-                    and st.y is not None):
+                    and st.y is not None
+                    and st.meta.get("synth_seed") == seed
+                    and st.meta.get("synth_informative") == informative):
                 return st
         except Exception:
             pass
     rng = np.random.default_rng(seed)
     beta = np.zeros(n_features, np.float32)
-    informative = min(informative, n_features)
     inf_idx = rng.choice(n_features, size=informative, replace=False)
     beta[inf_idx] = rng.normal(0, 1.2, informative)
-    w = ColumnarStore.create(path, n_rows, n_features)
+    w = ColumnarStore.create(path, n_rows, n_features, extra_manifest={
+        "synth_seed": seed, "synth_informative": informative})
     for r0 in range(0, n_rows, chunk_rows):
         c = min(chunk_rows, n_rows - r0)
         Xc = rng.standard_normal((c, n_features), dtype=np.float32)
