@@ -279,3 +279,38 @@ class TestMulticlassGBT:
         assert all(np.isfinite(r.mean_metric)
                    for r in summary.validation_results)
         assert summary.holdout_metrics.get("F1", 0) > 0.5
+
+
+class TestEarlyStoppingRefit:
+    def test_refit_trains_on_all_rows(self, rng, monkeypatch):
+        """With early_stopping_rounds>0 the SHIPPED model must train on
+        the full weights — the 80/20 holdout only picks the round count
+        (xgboost4j-spark trainTestRatio default 1.0; r3 advisor medium)."""
+        import transmogrifai_tpu.models.trees as trees_mod
+        from transmogrifai_tpu.stages.base import FitContext
+
+        n = 500
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+        w = jnp.ones(n, jnp.float32)
+        calls = []
+        real = trees_mod.fit_gbt_hosted
+
+        def spy(Xb, yy, ww, n_est, *a, **k):
+            calls.append({"w": np.asarray(ww), "n_est": int(n_est),
+                          "esr": int(k.get("early_stopping_rounds", 0) or 0)})
+            return real(Xb, yy, ww, n_est, *a, **k)
+
+        monkeypatch.setattr(trees_mod, "fit_gbt_hosted", spy)
+        est = OpXGBoostClassifier(n_estimators=20, max_depth=3, max_bins=16,
+                                  early_stopping_rounds=3)
+        m = est.fit_arrays(jnp.asarray(X), jnp.asarray(y), w,
+                           FitContext(n_rows=n, seed=7))
+        assert len(calls) == 2
+        probe, refit = calls
+        assert probe["esr"] == 3 and (probe["w"] < 1.0).any()  # holdout
+        assert refit["esr"] == 0
+        np.testing.assert_array_equal(refit["w"], np.ones(n))  # ALL rows
+        assert refit["n_est"] <= 20
+        pred = np.asarray(m.predict_arrays(jnp.asarray(X))["prediction"])
+        assert ((pred == np.asarray(y)).mean()) > 0.8

@@ -104,15 +104,21 @@ class MonoidAggregator:
     def __init__(self, name: str,
                  prepare: Callable[[Event], Any],
                  combine: Callable[[Any, Any], Any],
-                 present: Callable[[Optional[Any]], Any]):
+                 present: Callable[[Optional[Any]], Any],
+                 zero: Any = None):
         self.name = name
         self._prepare = prepare
         self._combine = combine
         self._present = present
+        # monoid zero: the fold's START state, so an EMPTY fold presents
+        # the zero instead of missing — the reference distinguishes e.g.
+        # SumReal (zero=None → empty folds to null) from SumRealNN
+        # (zero=Some(0.0) → empty folds to 0.0), Numerics.scala:18-21
+        self._zero = zero
 
     def __call__(self, events: Sequence[Event]) -> Any:
         """Fold events → final value (None-states are identity)."""
-        acc = None
+        acc = self._zero
         for e in events:
             s = self._prepare(e)
             if s is None:
@@ -130,38 +136,48 @@ def _value_prepare(e: Event) -> Any:
 
 # -- numeric ----------------------------------------------------------- #
 
-def sum_agg(name: str = "Sum", integral: bool = False) -> MonoidAggregator:
-    """SumReal/SumIntegral/SumCurrency/SumRealNN (aggregators/Numerics.scala)."""
+def sum_agg(name: str = "Sum", integral: bool = False,
+            zero: Any = None) -> MonoidAggregator:
+    """SumReal/SumIntegral/SumCurrency (zero=None → empty folds missing);
+    SumRealNN passes zero=0.0 (aggregators/Numerics.scala:18-21)."""
     def present(s):
         if s is None:
             return None
         return int(s) if integral else float(s)
-    return MonoidAggregator(name, _value_prepare, lambda a, b: a + b, present)
+    return MonoidAggregator(name, _value_prepare, lambda a, b: a + b, present,
+                            zero=zero)
 
 
-def mean_agg(name: str = "Mean") -> MonoidAggregator:
-    """MeanReal/MeanPercent/MeanCurrency: intermediate (sum, count)."""
+def mean_agg(name: str = "Mean", zero: Any = None) -> MonoidAggregator:
+    """MeanReal/MeanPercent/MeanCurrency: intermediate (sum, count).
+    MeanRealNN passes zero=(0.0, 0), presenting 0.0 on an empty fold
+    (Numerics.scala MeanDouble present: count==0 → 0.0)."""
     return MonoidAggregator(
         name,
         lambda e: None if e.value is None else (float(e.value), 1),
         lambda a, b: (a[0] + b[0], a[1] + b[1]),
-        lambda s: None if s is None else s[0] / s[1])
+        lambda s: None if s is None else (s[0] / s[1] if s[1] else 0.0),
+        zero=zero)
 
 
-def min_agg(name: str = "Min", integral: bool = False) -> MonoidAggregator:
+def min_agg(name: str = "Min", integral: bool = False,
+            zero: Any = None) -> MonoidAggregator:
+    """MinReal/... (MinRealNN passes zero=+inf, Numerics.scala:41)."""
     def present(s):
         if s is None:
             return None
         return int(s) if integral else float(s)
-    return MonoidAggregator(name, _value_prepare, min, present)
+    return MonoidAggregator(name, _value_prepare, min, present, zero=zero)
 
 
-def max_agg(name: str = "Max", integral: bool = False) -> MonoidAggregator:
+def max_agg(name: str = "Max", integral: bool = False,
+            zero: Any = None) -> MonoidAggregator:
+    """MaxReal/... (MaxRealNN passes zero=-inf, Numerics.scala:34)."""
     def present(s):
         if s is None:
             return None
         return int(s) if integral else float(s)
-    return MonoidAggregator(name, _value_prepare, max, present)
+    return MonoidAggregator(name, _value_prepare, max, present, zero=zero)
 
 
 def logical_or_agg() -> MonoidAggregator:
@@ -388,7 +404,11 @@ def default_aggregator(ftype: type) -> MonoidAggregator:
         return max_agg("MaxDate", integral=True)
     if issubclass(t, (T.Integral,)):
         return sum_agg("SumIntegral", integral=True)
-    if issubclass(t, (T.Currency, T.RealNN, T.Real)):
+    if issubclass(t, T.RealNN):
+        # RealNN is non-nullable: its sum carries a real monoid zero, so
+        # an empty fold is 0.0, not missing (SumRealNN, Numerics.scala:21)
+        return sum_agg("SumRealNN", zero=0.0)
+    if issubclass(t, (T.Currency, T.Real)):
         return sum_agg("SumReal")
     # text
     if issubclass(t, T.PickList):
