@@ -1,0 +1,168 @@
+# -*- coding: utf-8 -*-
+"""Language identification accuracy (VERDICT r3 #4): ≥95% on a
+mixed-language fixture of ≥20 languages. Fixture sentences are disjoint
+from the profile seed text in `utils/language.py`.
+
+Reference bar: `OptimaizeLanguageDetector.scala:45` (n-gram profiles over
+~70 languages); this covers the same technique over ~45."""
+
+from transmogrifai_tpu.utils.language import detect, detect_language
+
+# (language, sentence) — everyday prose, NOT the profile seed sentences
+FIXTURE = [
+    ("en", "She opened the window because the room felt warm this morning."),
+    ("en", "Our train leaves early, so please bring your tickets tonight."),
+    ("de", "Wir haben gestern einen langen Spaziergang durch den Wald gemacht."),
+    ("de", "Können Sie mir bitte sagen, wo sich der nächste Bahnhof befindet?"),
+    ("fr", "Nous avons mangé du pain frais avec du fromage près de la rivière."),
+    ("fr", "Elle voudrait apprendre à jouer du piano depuis son enfance."),
+    ("es", "Mañana vamos a visitar a nuestros abuelos en el pueblo."),
+    ("es", "El niño corrió rápidamente hacia la playa con su perro."),
+    ("it", "Domani andremo al mercato per comprare frutta e verdura fresca."),
+    ("it", "Mi piacerebbe vedere quel film insieme ai miei amici stasera."),
+    ("pt", "Amanhã vamos à praia se o tempo estiver bom e ensolarado."),
+    ("pt", "Ela gosta de cozinhar peixe fresco com azeite e alho."),
+    ("nl", "Morgen gaan we met de fiets naar de markt in het dorp."),
+    ("nl", "Hij heeft gisteren een nieuw boek gekocht over oude schepen."),
+    ("pl", "Jutro pojedziemy pociągiem do babci na wieś pod miastem."),
+    ("pl", "Dzieci bawiły się wesoło w ogrodzie przez całe popołudnie."),
+    ("cs", "Zítra pojedeme vlakem k babičce na venkov za městem."),
+    ("cs", "Děti si celé odpoledne hrály na zahradě u rybníka."),
+    ("ro", "Mâine mergem cu trenul la bunica noastră de la țară."),
+    ("ro", "Copiii s-au jucat toată după-amiaza în grădina din spatele casei."),
+    ("hu", "Holnap vonattal megyünk a nagymamához vidékre a város mellé."),
+    ("hu", "A gyerekek egész délután a kertben játszottak a ház mögött."),
+    ("fi", "Huomenna menemme junalla mummolle maalle kaupungin ulkopuolelle."),
+    ("fi", "Lapset leikkivät koko iltapäivän puutarhassa talon takana."),
+    ("sv", "Imorgon åker vi tåg till mormor på landet utanför staden."),
+    ("sv", "Barnen lekte hela eftermiddagen i trädgården bakom huset."),
+    ("tr", "Yarın trenle şehir dışındaki büyükanneme gideceğiz."),
+    ("tr", "Çocuklar bütün öğleden sonra evin arkasındaki bahçede oynadı."),
+    ("vi", "Ngày mai chúng tôi sẽ đi tàu về quê thăm bà ngoại."),
+    ("vi", "Bọn trẻ chơi cả buổi chiều trong khu vườn sau nhà."),
+    ("id", "Besok kami akan naik kereta ke desa mengunjungi nenek."),
+    ("id", "Anak-anak bermain sepanjang sore di kebun belakang rumah."),
+    ("ru", "Завтра мы поедем на поезде к бабушке в деревню за городом."),
+    ("ru", "Дети весь день играли в саду за домом у реки."),
+    ("uk", "Завтра ми поїдемо потягом до бабусі в село за містом."),
+    ("uk", "Діти цілий день гралися в саду за будинком біля річки."),
+    ("bg", "Утре ще пътуваме с влак до баба на село извън града."),
+    ("el", "Αύριο θα πάμε με το τρένο στη γιαγιά στο χωριό."),
+    ("el", "Τα παιδιά έπαιζαν όλο το απόγευμα στον κήπο πίσω από το σπίτι."),
+    ("he", "מחר ניסע ברכבת לסבתא בכפר מחוץ לעיר הגדולה."),
+    ("ar", "غدا سنسافر بالقطار لزيارة جدتنا في القرية خارج المدينة."),
+    ("fa", "فردا با قطار به روستا می‌رویم تا مادربزرگ را ببینیم."),
+    ("hi", "कल हम ट्रेन से गाँव में दादी से मिलने जाएँगे।"),
+    ("th", "พรุ่งนี้เราจะนั่งรถไฟไปเยี่ยมคุณยายที่หมู่บ้านนอกเมือง"),
+    ("ko", "내일 우리는 기차를 타고 시골에 계신 할머니를 뵈러 갑니다."),
+    ("ja", "明日は電車で田舎のおばあちゃんに会いに行きます。"),
+    ("zh", "明天我们坐火车去乡下看望奶奶。"),
+    ("ka", "ხვალ მატარებლით სოფელში ბებიასთან მივდივართ."),
+    ("hy", "Վաղը գնացքով գյուղ ենք գնալու տատիկիս մոտ."),
+    ("ta", "நாளை நாங்கள் ரயிலில் கிராமத்துக்கு பாட்டியை பார்க்க போகிறோம்."),
+    ("bn", "আগামীকাল আমরা ট্রেনে গ্রামে দাদির সাথে দেখা করতে যাব।"),
+    ("te", "రేపు మేము రైలులో గ్రామానికి అమ్మమ్మను చూడటానికి వెళ్తాము."),
+]
+
+
+def test_accuracy_at_least_95_percent_over_20_languages():
+    langs = {lang for lang, _ in FIXTURE}
+    assert len(langs) >= 20
+    hits = sum(1 for lang, text in FIXTURE if detect(text) == lang)
+    acc = hits / len(FIXTURE)
+    wrong = [(lang, detect(text)) for lang, text in FIXTURE
+             if detect(text) != lang]
+    assert acc >= 0.95, f"accuracy {acc:.3f}; misses: {wrong}"
+
+
+def test_confidence_contract():
+    d = detect_language("The weather is nice today and the sky is clear.")
+    assert next(iter(d)) == "en"
+    assert all(0.0 < v <= 1.0 for v in d.values())
+    assert abs(sum(d.values()) - 1.0) < 1.01  # ranked subset of mass
+    assert detect_language("") == {}
+    assert detect_language(None) == {}
+    assert detect_language("12345 !!! ...") == {}
+
+
+def test_script_decided_languages():
+    assert detect("Η γλώσσα είναι ελληνική") == "el"
+    assert detect("これは日本語の文章です") == "ja"
+    assert detect("这是一个中文句子") == "zh"
+    assert detect("한국어 문장입니다") == "ko"
+
+
+class TestScriptAwareTokenizer:
+    """VERDICT r3 #4 'done' bar: tokenizer tests over CJK/Arabic/Cyrillic
+    fixtures (LuceneTextAnalyzer.scala:87 CJKAnalyzer bigram semantics)."""
+
+    def test_han_bigrams(self):
+        from transmogrifai_tpu.ops.text import tokenize
+        assert tokenize("这是中文") == ["这是", "是中", "中文"]
+        assert tokenize("山") == ["山"]
+
+    def test_japanese_mixed_kana_han(self):
+        from transmogrifai_tpu.ops.text import tokenize
+        toks = tokenize("日本語のテキスト")
+        assert "日本" in toks and "本語" in toks
+        assert all(len(t) <= 2 for t in toks)
+
+    def test_mixed_latin_cjk(self):
+        from transmogrifai_tpu.ops.text import tokenize
+        assert tokenize("Hello 世界 world") == ["hello", "世界", "world"]
+
+    def test_korean_words_kept_whole(self):
+        from transmogrifai_tpu.ops.text import tokenize
+        assert tokenize("한국어 문장") == ["한국어", "문장"]
+
+    def test_arabic_normalization(self):
+        from transmogrifai_tpu.ops.text import tokenize
+        # diacritics stripped, ta-marbuta folded to ha
+        assert tokenize("اللُّغَةُ") == ["اللغه"]
+        # alef variants folded
+        assert tokenize("أحمد إلى آخر") == ["احمد", "الي", "اخر"]
+
+    def test_thai_bigram_segmentation(self):
+        from transmogrifai_tpu.ops.text import tokenize
+        toks = tokenize("สวัสดี")
+        assert toks and all(len(t) == 2 for t in toks)
+
+    def test_cyrillic_words(self):
+        from transmogrifai_tpu.ops.text import tokenize
+        assert tokenize("Быстрая лиса") == ["быстрая", "лиса"]
+
+    def test_batch_matches_rowwise_on_mixed_column(self):
+        import numpy as np
+        from transmogrifai_tpu.ops.text import tokenize, tokenize_batch
+        col = np.array(["Hello world", "这是一个句子", None,
+                        "اللُّغَةُ العربية", "mixed 中文 text", ""],
+                       dtype=object)
+        batch = tokenize_batch(col)
+        for i, v in enumerate(col):
+            expect = tokenize(v) or None
+            assert batch[i] == expect, (i, batch[i], expect)
+
+    def test_tokenizer_stage_language_params(self):
+        from transmogrifai_tpu.ops.text import TextTokenizer
+        st = TextTokenizer(auto_detect_language=True,
+                           auto_detect_threshold=0.6)
+        assert st.language_of("Это предложение на русском языке") == "ru"
+        assert st.language_of("short") == "en"  # below threshold → default
+        assert TextTokenizer(language="fr").language_of("whatever") == "fr"
+
+    def test_tokenizer_stage_language_filters_stopwords(self):
+        import numpy as np
+        from transmogrifai_tpu.data.columns import Column
+        from transmogrifai_tpu.ops.text import TextTokenizer
+        import transmogrifai_tpu.types as T
+        col = Column(T.Text, np.array(
+            ["the cat sat on the mat", "der Hund und die Katze"],
+            dtype=object))
+        plain = TextTokenizer().transform([col])
+        assert "the" in plain.data[0]  # default: no filtering
+        en = TextTokenizer(language="en").transform([col])
+        assert "the" not in en.data[0] and "cat" in en.data[0]
+        auto = TextTokenizer(auto_detect_language=True,
+                             auto_detect_threshold=0.5).transform([col])
+        assert "the" not in auto.data[0]
+        assert "und" not in auto.data[1] and "hund" in auto.data[1]

@@ -87,19 +87,73 @@ class TokenHasher:
 
 
 # ---------------------------------------------------------------------------
-# Tokenizer (TextTokenizer.scala; simple analyzer stand-in for Lucene)
+# Tokenizer (TextTokenizer.scala → LuceneTextAnalyzer.scala:87 parity:
+# Unicode-script-aware analysis instead of one regex; VERDICT r3 #4)
 # ---------------------------------------------------------------------------
 
 _TOKEN_RE = re.compile(r"[^\W_]+", re.UNICODE)
 
+# script runs that need non-whitespace segmentation. Lucene's CJKAnalyzer
+# emits overlapping character bigrams for Han/kana runs; Thai/Lao/Khmer/
+# Myanmar (no inter-word spaces) get the same bigram treatment here as a
+# dictionary-segmentation stand-in (Lucene uses ICU break iterators).
+_BIGRAM_CLASS = (
+    "\u4e00-\u9fff\u3400-\u4dbf"   # Han
+    "\u3040-\u309f\u30a0-\u30ff"   # hiragana / katakana
+    "\u0e00-\u0e7f\u0e80-\u0eff"   # Thai / Lao
+    "\u1780-\u17ff\u1000-\u109f")  # Khmer / Myanmar
+_BIGRAM_RUN_RE = re.compile(f"([{_BIGRAM_CLASS}]+)")
+_ARABIC_RE = re.compile("[\u0600-\u06ff\u0750-\u077f]")
+# cheap probe: does the text contain ANY char needing the analyzer path?
+_NONSIMPLE_RE = re.compile(
+    f"[{_BIGRAM_CLASS}\u0600-\u06ff\u0750-\u077f]")
+
+# Arabic normalization (Lucene ArabicNormalizer): strip tatweel (0640) +
+# harakat diacritics (064B-065F, 0670), fold alef/yaa/ta-marbuta variants
+_AR_DIACRITICS = re.compile("[\u0640\u064b-\u065f\u0670]")
+_AR_FOLD = str.maketrans({"\u0622": "\u0627", "\u0623": "\u0627",
+                          "\u0625": "\u0627", "\u0649": "\u064a",
+                          "\u0629": "\u0647"})
+
+
+def _bigram_tokens(run: str) -> List[str]:
+    if len(run) == 1:
+        return [run]
+    return [run[i:i + 2] for i in range(len(run) - 1)]
+
+
+def _analyze(text: str, min_token_length: int) -> List[str]:
+    """Script-aware token stream: bigram CJK/SEA runs, normalized Arabic,
+    regex words elsewhere. CJK/SEA bigrams bypass min_token_length (a
+    2-char bigram IS the token unit for those scripts)."""
+    out: List[str] = []
+    for part in _BIGRAM_RUN_RE.split(text):
+        if not part:
+            continue
+        if _BIGRAM_RUN_RE.fullmatch(part):
+            out.extend(_bigram_tokens(part))
+            continue
+        if _ARABIC_RE.search(part):
+            part = _AR_DIACRITICS.sub("", part).translate(_AR_FOLD)
+        out.extend(t for t in _TOKEN_RE.findall(part)
+                   if len(t) >= min_token_length)
+    return out
+
 
 def tokenize(text: Optional[str], min_token_length: int = 1,
-             to_lowercase: bool = True) -> List[str]:
+             to_lowercase: bool = True,
+             language: Optional[str] = None) -> List[str]:
+    """Analyzer tokens. `language` is accepted for the TextTokenizer
+    API (reserved for per-language stopword/stemming rules); the script-
+    aware segmentation itself is language-independent."""
     if not text:
         return []
     if to_lowercase:
         text = text.lower()
-    return [t for t in _TOKEN_RE.findall(text) if len(t) >= min_token_length]
+    if _NONSIMPLE_RE.search(text) is None:  # fast path: simple scripts
+        return [t for t in _TOKEN_RE.findall(text)
+                if len(t) >= min_token_length]
+    return _analyze(text, min_token_length)
 
 
 def _flat_tokens_arrow(values, min_token_length: int = 1,
@@ -123,14 +177,43 @@ def _flat_tokens_arrow(values, min_token_length: int = 1,
     lens = np.nan_to_num(lens, nan=0.0).astype(np.int64)
     rows = np.repeat(np.arange(len(values), dtype=np.int64), lens)
     keep_np = keep.to_numpy(zero_copy_only=False)
-    return rows[keep_np], flat.filter(keep)
+    rows, flat = rows[keep_np], flat.filter(keep)
+    # rows containing CJK/SEA/Arabic codepoints need the script-aware
+    # analyzer (bigrams + normalization): find them columnar via RE2,
+    # re-tokenize row-wise, splice back in row order so every consumer
+    # (hash kernel, batch tokenizer) sees identical tokens to `tokenize`
+    sp = pc.fill_null(
+        pc.match_substring_regex(arr, _NONSIMPLE_RE.pattern), False)
+    sp_np = sp.to_numpy(zero_copy_only=False).astype(bool)
+    if sp_np.any():
+        if isinstance(flat, pa.ChunkedArray):
+            flat = flat.combine_chunks()
+        keep_rows = ~sp_np[rows]
+        rows_simple = rows[keep_rows]
+        flat_simple = flat.filter(pa.array(keep_rows))
+        add_rows: list = []
+        add_toks: list = []
+        for i in np.flatnonzero(sp_np):
+            ts = tokenize(values[i], min_token_length, to_lowercase)
+            add_rows.extend([i] * len(ts))
+            add_toks.extend(ts)
+        rows = np.concatenate(
+            [rows_simple, np.asarray(add_rows, np.int64)])
+        flat = pa.concat_arrays(
+            [flat_simple, pa.array(add_toks, pa.string())])
+        order = np.argsort(rows, kind="stable")
+        rows = rows[order]
+        flat = flat.take(pa.array(order))
+    return rows, flat
 
 
 def tokenize_batch(values, min_token_length: int = 1,
                    to_lowercase: bool = True) -> np.ndarray:
     """Whole-column tokenization: object array of per-row token lists
     (None where the row has no tokens), matching row-wise `tokenize`.
-    Arrow-backed with a row-loop fallback."""
+    Arrow-backed with a row-loop fallback; rows containing CJK/SEA/Arabic
+    codepoints are re-analyzed row-wise (script-aware bigrams +
+    normalization) after the columnar pass."""
     n = len(values)
     out = np.empty(n, dtype=object)
     try:
@@ -152,21 +235,67 @@ def tokenize_batch(values, min_token_length: int = 1,
 
 
 class TextTokenizer(HostTransformer):
-    """Text → TextList of analyzer tokens (host-only stage)."""
+    """Text → TextList of analyzer tokens (host-only stage).
+
+    Parameter surface mirrors `TextTokenizer.scala` (languageDetector /
+    analyzer / autoDetectLanguage / defaultLanguage / minTokenLength /
+    toLowercase): `auto_detect_language` runs the n-gram detector
+    (`utils/language.py`) and only accepts its verdict above
+    `auto_detect_threshold`, else `default_language` — the reference's
+    LanguageDetector confidence-threshold branch. A resolved language
+    (explicit `language=` or auto-detect) activates that language's
+    stopword filter, the analogue of Lucene's per-language analyzers;
+    with neither set (the default) tokens pass through unfiltered."""
 
     in_types = (T.Text,)
     out_type = T.TextList
 
     def __init__(self, min_token_length: int = 1, to_lowercase: bool = True,
+                 language: Optional[str] = None,
+                 auto_detect_language: bool = False,
+                 auto_detect_threshold: float = 0.99,
+                 default_language: str = "en",
                  uid: Optional[str] = None):
         super().__init__(uid=uid, min_token_length=min_token_length,
-                         to_lowercase=to_lowercase)
+                         to_lowercase=to_lowercase, language=language,
+                         auto_detect_language=auto_detect_language,
+                         auto_detect_threshold=auto_detect_threshold,
+                         default_language=default_language)
         self.min_token_length = min_token_length
         self.to_lowercase = to_lowercase
+        self.language = language
+        self.auto_detect_language = auto_detect_language
+        self.auto_detect_threshold = auto_detect_threshold
+        self.default_language = default_language
+
+    def language_of(self, text: Optional[str]) -> str:
+        """Effective language for a row (explicit > auto-detect > default)."""
+        if self.language:
+            return self.language
+        if self.auto_detect_language and text:
+            from transmogrifai_tpu.utils.language import detect_language
+            d = detect_language(text)
+            if d:
+                lang, conf = next(iter(d.items()))
+                if conf >= self.auto_detect_threshold:
+                    return lang
+        return self.default_language
 
     def transform(self, cols: Sequence[Column], ctx=None) -> Column:
-        out = tokenize_batch(cols[0].data, self.min_token_length,
-                             self.to_lowercase)
+        data = cols[0].data
+        out = tokenize_batch(data, self.min_token_length, self.to_lowercase)
+        if self.language or self.auto_detect_language:
+            from transmogrifai_tpu.utils.language import stopwords_for
+            stops_fixed = (stopwords_for(self.language)
+                           if self.language else None)
+            for i in range(len(out)):
+                if out[i] is None:
+                    continue
+                stops = (stops_fixed if stops_fixed is not None
+                         else stopwords_for(self.language_of(data[i])))
+                if stops:
+                    kept = [t for t in out[i] if t.lower() not in stops]
+                    out[i] = kept or None
         return Column(self.output_ftype(), out)
 
 
