@@ -146,6 +146,7 @@ def test_rf_min_instances_per_node(binary_data):
     assert s_few < s_many
 
 
+@pytest.mark.slow
 def test_rf_sweep_reference_grid(binary_data):
     X, y = binary_data
     est = OpRandomForestClassifier(n_trees=10)
@@ -218,6 +219,7 @@ def test_gbt_hosted_early_stop_skips_dispatches():
                                   np.asarray(full["leaf"])[:k])
 
 
+@pytest.mark.slow
 def test_xgb_sweep_es_matches_refit(binary_data):
     """The early-stopped sweep metric and a refit with the winning grid
     must describe the same algorithm: refit on the sweep's train fold

@@ -10,6 +10,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # runner end-to-end trains
+
 import transmogrifai_tpu.types as T
 from transmogrifai_tpu.data import Dataset
 from transmogrifai_tpu.workflow import OpParams, WorkflowRunner
@@ -189,3 +191,56 @@ def test_cli_gen_project_skeleton(tmp_path):
     assert out.returncode == 0, out.stderr[-1500:]
     assert (proj / "model").is_dir()
     assert (proj / "metrics" / "train-metrics.json").exists()
+
+
+def test_gen_all_field_kinds_trains_on_own_data(tmp_path):
+    """VERDICT r3 #9: gen covers every schema field kind with a
+    type-appropriate feature line, and the generated app (--light grid)
+    TRAINS on its own data end to end."""
+    import subprocess
+    import sys
+
+    rng = np.random.default_rng(3)
+    n = 160
+    rows = ["realcol,intcol,boolcol,cat,note,when,who,y"]
+    cats = ["alpha", "beta", "gamma"]
+    for i in range(n):
+        r = rng.normal()
+        y = int(r + rng.normal(0, 0.5) > 0)
+        rows.append(
+            f"{r:.4f},{rng.integers(0, 9)},{str(bool(rng.integers(2))).lower()},"
+            f"{cats[rng.integers(3)]},note text {i},2020-0{rng.integers(1, 9)}-01,"
+            f"user{i},{y}")
+    csv = tmp_path / "kinds.csv"
+    csv.write_text("\n".join(rows) + "\n")
+
+    from transmogrifai_tpu.cli import main
+    app_path = tmp_path / "kinds_app.py"
+    rc = main(["gen", "--input", str(csv), "--response", "y",
+               "--output", str(app_path), "--light"])
+    assert rc == 0
+    code = app_path.read_text()
+    # one builder line per column, with the inferred type surface
+    for expect in ('FeatureBuilder.Real("realcol")',
+                   'FeatureBuilder.Integral("intcol")',
+                   'FeatureBuilder.Binary("boolcol")',
+                   'FeatureBuilder.PickList("cat")',
+                   'FeatureBuilder.RealNN("y")'):
+        assert expect in code, expect
+    assert "note" in code and "when" in code and "who" in code
+
+    repo_root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [str(tmp_path), repo_root,
+                    os.environ.get("PYTHONPATH", "")]))
+    drive = (
+        "import kinds_app\n"
+        "from transmogrifai_tpu.workflow.params import OpParams\n"
+        "r = kinds_app.runner()\n"
+        f"res = r.run('train', OpParams(model_location=r'{tmp_path}/model'))\n"
+        "print('TRAINED', res.metrics is not None)\n")
+    out = subprocess.run([sys.executable, "-c", drive], capture_output=True,
+                         text=True, env=env)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "TRAINED" in out.stdout

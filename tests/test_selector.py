@@ -96,6 +96,7 @@ def _binary_ds(n=300, seed=0):
         [{"x1": float(x1[i]), "x2": float(x2[i]), "y": int(y[i])} for i in range(n)])
 
 
+@pytest.mark.slow
 def test_binary_selector_end_to_end():
     ds = _binary_ds()
     preds, label = FeatureBuilder.from_dataset(ds, response="y")
@@ -117,6 +118,7 @@ def test_binary_selector_end_to_end():
     assert "Evaluated 28 model configs" in s.pretty()
 
 
+@pytest.mark.slow
 def test_multiclass_selector():
     rng = np.random.default_rng(1)
     n = 400
@@ -136,6 +138,7 @@ def test_multiclass_selector():
     assert fitted.summary.holdout_metrics["F1"] > 0.6
 
 
+@pytest.mark.slow
 def test_regression_selector():
     rng = np.random.default_rng(2)
     n = 300

@@ -137,6 +137,7 @@ def test_sweep_logistic(clf_data):
                    X, y, folds, BinaryClassificationEvaluator())
 
 
+@pytest.mark.slow
 def test_sweep_forest_classifier_mixed_depths(clf_data):
     from transmogrifai_tpu.models import OpRandomForestClassifier
     X, y, folds = clf_data
@@ -156,6 +157,7 @@ def test_sweep_xgb_classifier(clf_data):
                    X, y, folds, BinaryClassificationEvaluator())
 
 
+@pytest.mark.slow
 def test_sweep_svc_and_nb_and_mlp(clf_data):
     from transmogrifai_tpu.models import OpLinearSVC, OpNaiveBayes
     from transmogrifai_tpu.models.mlp import OpMultilayerPerceptronClassifier
@@ -170,6 +172,7 @@ def test_sweep_svc_and_nb_and_mlp(clf_data):
                    X, y, folds, ev)
 
 
+@pytest.mark.slow
 def test_sweep_multiclass_forest():
     from transmogrifai_tpu.models import OpRandomForestClassifier
     rng = np.random.default_rng(5)
@@ -185,6 +188,7 @@ def test_sweep_multiclass_forest():
                    MultiClassificationEvaluator())
 
 
+@pytest.mark.slow
 def test_sweep_regression_families(reg_data):
     from transmogrifai_tpu.models import (
         OpGBTRegressor, OpLinearRegression, OpRandomForestRegressor)
@@ -201,6 +205,7 @@ def test_sweep_regression_families(reg_data):
                    [{"reg_param": r} for r in (0.0, 0.01)], X, y, folds, ev)
 
 
+@pytest.mark.slow
 def test_sweep_decision_tree_matches_deterministic_fit(clf_data):
     """DT sweeps must use the deterministic (no-bootstrap) tree the refit
     produces — metrics must match the eager fit_arrays path exactly."""
@@ -211,6 +216,7 @@ def test_sweep_decision_tree_matches_deterministic_fit(clf_data):
                    X, y, folds, BinaryClassificationEvaluator(), tol=1e-5)
 
 
+@pytest.mark.slow
 def test_padded_depth_equals_exact_depth(clf_data):
     """A {2, 5} depth grid (padded to 5, traced active_depth) must match
     fitting each depth at its exact static shape."""

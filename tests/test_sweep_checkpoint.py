@@ -38,6 +38,7 @@ def _selector(tmp, models=None):
         checkpoint_dir=str(tmp))
 
 
+@pytest.mark.slow
 def test_checkpoint_files_written_and_reused(tmp_path, monkeypatch):
     label, vec = _cols()
     sel = _selector(tmp_path)
@@ -62,6 +63,7 @@ def test_checkpoint_files_written_and_reused(tmp_path, monkeypatch):
     assert s1.best_grid == s2.best_grid
 
 
+@pytest.mark.slow
 def test_partial_resume_runs_only_missing_family(tmp_path, monkeypatch):
     label, vec = _cols()
     sel = _selector(tmp_path)
@@ -81,6 +83,7 @@ def test_partial_resume_runs_only_missing_family(tmp_path, monkeypatch):
     assert calls["n"] == 1
 
 
+@pytest.mark.slow
 def test_signature_invalidates_on_different_data_or_grids(tmp_path):
     label, vec = _cols()
     sel = _selector(tmp_path)

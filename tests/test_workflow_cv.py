@@ -9,6 +9,8 @@ cannot leak validation labels into the CV metric.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # workflow-level CV re-fits
+
 import transmogrifai_tpu.types as t
 from transmogrifai_tpu.data import Dataset
 from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
