@@ -215,7 +215,28 @@ class Currency(Real):
 
 
 class Date(Integral):
-    """Epoch milliseconds (day semantics)."""
+    """Epoch milliseconds (day semantics). Accepts ISO-8601 strings
+    ('2020-05-01', '2020-05-01 12:30[:45]', 'T' separator too) — the
+    reference's converter likewise parses temporal strings into epoch ms
+    (`FeatureTypeSparkConverter.scala` date handling)."""
+
+    @classmethod
+    def _convert(cls, value):
+        if isinstance(value, str):
+            import datetime as _dt
+            s = value.strip()
+            if not s:
+                return None
+            for fmt in ("%Y-%m-%d", "%Y-%m-%d %H:%M", "%Y-%m-%d %H:%M:%S",
+                        "%Y-%m-%dT%H:%M", "%Y-%m-%dT%H:%M:%S"):
+                try:
+                    d = _dt.datetime.strptime(s, fmt)
+                    d = d.replace(tzinfo=_dt.timezone.utc)
+                    return int(d.timestamp() * 1000)
+                except ValueError:
+                    continue
+            raise FeatureTypeError(f"{cls.__name__} cannot hold {value!r}")
+        return super()._convert(value)
 
 
 class DateTime(Date):
