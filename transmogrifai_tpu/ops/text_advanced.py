@@ -277,12 +277,19 @@ class OpWord2Vec(Estimator):
             centers_l, contexts_l = [], []
             for o in range(1, self.window + 1):
                 ok = (spans >= o)
-                left = ok[o:] & (doc_of[o:] == doc_of[:-o])
-                idx = np.flatnonzero(left) + o
-                centers_l.append(flat[idx])          # context o to the left
-                contexts_l.append(flat[idx - o])
-                centers_l.append(flat[idx - o])      # and o to the right
-                contexts_l.append(flat[idx])
+                same_doc = doc_of[o:] == doc_of[:-o]
+                # each side gates on the CENTER position's own span draw —
+                # word2vec's per-center dynamic window (r3 advisor: gating
+                # the right-side pair on the context's draw was equivalent
+                # only in expectation)
+                left = ok[o:] & same_doc    # center at idx, context idx-o
+                idx_l = np.flatnonzero(left) + o
+                centers_l.append(flat[idx_l])
+                contexts_l.append(flat[idx_l - o])
+                right = ok[:-o] & same_doc  # center at idx-o, context idx
+                idx_r = np.flatnonzero(right) + o
+                centers_l.append(flat[idx_r - o])
+                contexts_l.append(flat[idx_r])
             centers = np.concatenate(centers_l)
             contexts = np.concatenate(contexts_l)
             order = rng.permutation(len(centers))
