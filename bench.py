@@ -244,39 +244,71 @@ def run(platform: str) -> dict:
     from transmogrifai_tpu.readers import DataReaders
     pq_path = os.path.join(tempfile.mkdtemp(), "bench.parquet")
     ds.to_parquet(pq_path)
-    # 50k-row micro-batches: streaming through the tunnel is round-trip-
-    # latency bound, so tiny batches measure RPC latency, not the
-    # pipeline. SUSTAINED run (VERDICT r3 #5): keep cycling passes over
-    # the parquet until a wall-clock target is hit (BENCH_STREAM_S,
-    # default 90s in full mode, budget permitting) — steady-state
-    # rows/s, not a 2-pass burst.
-    batch = max(1, n_rows // 2)
+    # Full-size micro-batches: streaming through the tunnel is round-trip-
+    # latency bound (memory: ~0.25s/dispatch), so the batch IS the whole
+    # 100k-row file per pass. SUSTAINED run (VERDICT r3 #5): a feeder
+    # thread keeps re-reading the parquet into a bounded queue (so file
+    # reads overlap scoring) and passes keep flowing until a wall-clock
+    # target is hit (BENCH_STREAM_S, default 90s full mode, budget
+    # permitting) — steady-state rows/s, not a 2-pass burst.
+    import queue as _queue
+    import threading as _threading
+    batch = n_rows
     reader = DataReaders.stream(parquet_path=pq_path, batch_size=batch,
                                 schema=dict(ds.schema))
     for sout in model.score_stream(reader.stream()):  # warm the batch shape
         jax.block_until_ready(sout[pf.name])
         break
     if smoke:
-        stream_target_s, min_passes = 0.0, 2
+        stream_target_s = 0.0
     elif _remaining() < 60.0:
-        # budget already blown: one pass only, so the phase still reports
-        # a (burst) number instead of pushing past the driver's kill
-        stream_target_s, min_passes = 0.0, 1
+        # budget already blown: shortest honest measurement, so the phase
+        # still reports a number instead of pushing past the driver kill
+        stream_target_s = 0.0
     else:
         stream_target_s = min(float(os.environ.get("BENCH_STREAM_S", 90.0)),
                               max(30.0, _remaining() - 520.0))
-        min_passes = 1
+    stop = _threading.Event()
+    feed_q: "_queue.Queue" = _queue.Queue(maxsize=3)
+
+    def _feeder():
+        while not stop.is_set():
+            for b in reader.stream():
+                feed_q.put(b)
+                if stop.is_set():
+                    break
+        feed_q.put(None)
+
+    feeder = _threading.Thread(target=_feeder, daemon=True)
+    feeder.start()
+
+    def _batches():
+        min_batches = 2 if smoke else 1
+        got = 0
+        while True:
+            b = feed_q.get()
+            if b is None:
+                return
+            yield b
+            got += 1
+            if got >= min_batches and time.time() - t0 >= stream_target_s:
+                stop.set()
+                # drain so the feeder's blocking put can see the stop
+                while True:
+                    try:
+                        if feed_q.get_nowait() is None:
+                            return
+                    except _queue.Empty:
+                        return
+
     t0 = time.time()
     streamed = 0
     n_passes = 0
-    while True:
-        for sout in model.score_stream(reader.stream()):
-            jax.block_until_ready(sout[pf.name])
-            streamed += int(np.asarray(sout[pf.name]["prediction"]).shape[0])
+    for sout in model.score_stream(_batches(), host_workers=3,
+                                   device_depth=3):
+        streamed += int(np.asarray(sout[pf.name]["prediction"]).shape[0])
         n_passes += 1
-        t_stream = time.time() - t0
-        if n_passes >= min_passes and t_stream >= stream_target_s:
-            break
+    t_stream = time.time() - t0
     stream_rows_per_sec = streamed / t_stream
     # host-encode fraction of streaming wall-clock (pipelined encode runs
     # in worker threads; <0.5 means the device path, not host string

@@ -392,6 +392,15 @@ class WorkflowModel:
             for f in self.result_features:
                 result[f.name] = (out[f.uid] if f.uid in out
                                   else columns[f.uid].data)
+            # start the device→host result copy NOW (it queues behind the
+            # execution), so the consumer's np.asarray finds the bytes
+            # already on host instead of paying a blocking RPC per batch
+            try:
+                for leaf in _jax.tree_util.tree_leaves(result):
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+            except Exception:
+                pass
             return result
 
         import jax as _jax
