@@ -285,3 +285,29 @@ class TestNativeCsvParser:
         ds = Dataset.from_csv(p)
         assert ds.schema["v"] is T.Real
         assert ds.column("v")[2500] == 2.5
+
+
+class TestGroupedFetchStreaming:
+    def test_grouped_fetch_matches_per_batch(self, tmp_path, rng):
+        """fetch_group packs K batches' results into one device buffer +
+        one materialization; outputs must match per-batch fetching."""
+        import __graft_entry__ as ge
+        from transmogrifai_tpu.readers import DataReaders
+
+        model, ds, pf = ge._fit_flagship(n=200)
+        p = str(tmp_path / "score.parquet")
+        ds.to_parquet(p)
+        reader = DataReaders.stream(parquet_path=p, batch_size=32,
+                                    schema=dict(ds.schema))
+        base = [np.asarray(o[pf.name]["prediction"])
+                for o in model.score_stream(reader.stream())]
+        grouped = [np.asarray(o[pf.name]["prediction"])
+                   for o in model.score_stream(reader.stream(),
+                                               fetch_group=3)]
+        assert len(base) == len(grouped)
+        np.testing.assert_array_equal(np.concatenate(base),
+                                      np.concatenate(grouped))
+        probs = [o[pf.name]["probability"]
+                 for o in model.score_stream(reader.stream(),
+                                             fetch_group=3)]
+        assert all(isinstance(pb, np.ndarray) for pb in probs)

@@ -315,6 +315,30 @@ class TestEarlyStoppingRefit:
         pred = np.asarray(m.predict_arrays(jnp.asarray(X))["prediction"])
         assert ((pred == np.asarray(y)).mean()) > 0.8
 
+    def test_aupr_eval_metric_early_stopping(self, rng, monkeypatch):
+        """OpXGBoostClassifier defaults to the reference's maximized aucpr
+        early-stopping eval (DefaultSelectorParams.scala:71); the binned
+        device AuPR must drive the stop and still produce a good model."""
+        import transmogrifai_tpu.models.trees as trees_mod
+        from transmogrifai_tpu.stages.base import FitContext
+        n = 600
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+        est = OpXGBoostClassifier(n_estimators=30, max_depth=3, max_bins=16,
+                                  early_stopping_rounds=3)
+        assert est.eval_metric == "aupr"
+        m = est.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                           jnp.ones(n, jnp.float32), FitContext(n_rows=n))
+        pred = np.asarray(m.predict_arrays(jnp.asarray(X))["prediction"])
+        assert (pred == y).mean() > 0.85
+        # logloss mode still available and behaviorally distinct knob
+        est2 = OpXGBoostClassifier(n_estimators=30, max_depth=3, max_bins=16,
+                                   early_stopping_rounds=3,
+                                   eval_metric="logloss")
+        m2 = est2.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                             jnp.ones(n, jnp.float32), FitContext(n_rows=n))
+        p2 = np.asarray(m2.predict_arrays(jnp.asarray(X))["prediction"])
+        assert (p2 == y).mean() > 0.85
 
 class TestHistogramPrecision:
     """VERDICT r3 #8: the bf16-vs-f32 histogram tradeoff is explicit and
@@ -388,3 +412,4 @@ class TestHistogramPrecision:
             return (gl**2/(hl+lam) + (gt-gl)**2/(ht-hl+lam) - gt**2/(ht+lam))
         oracle = int(np.argmax([gain64(0), gain64(1)]))
         assert int(np.asarray(bf)[0]) == oracle
+

@@ -143,3 +143,22 @@ def test_ner_stage_heuristic_fallback():
     col = Column(T.Text, np.array(["Maria Lopez visited town"], dtype=object))
     out = st.transform([col])
     assert out.data[0] == {"Person": frozenset({"maria lopez"})}
+
+
+@needs_models
+def test_pos_tagger_perceptron():
+    """POSTaggerME over the shipped perceptron model + tag dictionary:
+    beam search with per-word allowed-tag constraints."""
+    from transmogrifai_tpu.utils.opennlp import POSTagger, load_tag_dictionary
+    path = _path("en-pos-perceptron.bin")
+    tagger = POSTagger(load_model(path), load_tag_dictionary(path))
+    toks = "The quick brown fox ran over the lazy dog .".split()
+    tags = tagger.tag(toks)
+    assert len(tags) == len(toks)
+    assert tags[0] == "DT" and tags[-1] == "."
+    assert tags[1] == "JJ" and tags[3] == "NN"
+    toks2 = "She quickly sold three beautiful houses .".split()
+    tags2 = tagger.tag(toks2)
+    # tagdict constrains She→{NN,PRP}, sold→{VBD,VBN}
+    assert tags2[0] == "PRP" and tags2[2] in ("VBD", "VBN")
+    assert tags2[3] == "CD" and tags2[5] == "NNS"

@@ -334,24 +334,89 @@ def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
         lambda a: a.reshape(n_chunks * chunk, *a.shape[2:])[:n_trees], trees)
 
 
-@partial(jax.jit, static_argnames=())
-def predict_forest(trees: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
-    preds = jax.vmap(lambda t: predict_tree(t, Xb))(trees)  # (T, n, m)
-    return preds.mean(axis=0)
+_PREDICT_TREE_CHUNK = 8
+
+
+def _predict_trees_sum(trees: Dict, Xb: jnp.ndarray,
+                       chunk: int = _PREDICT_TREE_CHUNK) -> jnp.ndarray:
+    """Σ_t predict_tree(t, Xb) as a scan of vmapped tree chunks.
+
+    A plain vmap-then-sum materializes the full (T, n, m) per-tree
+    score tensor; with the tiny class axis minor it tile-pads to 128
+    lanes — at sweep widths that one fusion output is tens of GB (the
+    r4 RF family drop: 8 pairs × 50 trees × 90k rows × pad-128 f32 =
+    18.4 GB). A tree-at-a-time scan bounds memory but serializes the
+    per-tree gathers (~2× slower fused scoring). The hybrid vmaps
+    `_PREDICT_TREE_CHUNK` trees per scan step: live memory is one
+    chunk's (c, n, m→128) slab, throughput stays near the vmap's.
+    Zero-padded trees (all-zero leaves) contribute nothing."""
+    n_trees = jax.tree_util.tree_leaves(trees)[0].shape[0]
+    m = trees["leaf"].shape[-1]
+    c = min(max(1, int(chunk)), n_trees)
+    n_chunks = -(-n_trees // c)
+    pad = n_chunks * c - n_trees
+    if pad:
+        trees = jax.tree.map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros_like(a[:pad])]), trees)
+    chunked = jax.tree.map(
+        lambda a: a.reshape(n_chunks, c, *a.shape[1:]), trees)
+
+    def body(acc, tc):
+        return acc + jax.vmap(
+            lambda t: predict_tree(t, Xb))(tc).sum(axis=0), None
+
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros((Xb.shape[0], m), jnp.float32), chunked)
+    return acc
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def predict_forest(trees: Dict, Xb: jnp.ndarray,
+                   chunk: int = 64) -> jnp.ndarray:
+    """Mean per-tree prediction (memory-bounded; `_predict_trees_sum`).
+
+    `chunk` trades live memory (chunk × n × 128-padded f32) for per-tree
+    parallelism: model scoring uses the big default; sweep dispatches —
+    where a width-8 pair vmap multiplies the slab — pass a small one."""
+    n_trees = jax.tree_util.tree_leaves(trees)[0].shape[0]
+    return _predict_trees_sum(trees, Xb, chunk) / jnp.float32(n_trees)
 
 
 # --------------------------------------------------------------------------- #
 # Gradient boosting (XGBoost-style second order)                              #
 # --------------------------------------------------------------------------- #
 
-def _gbt_val_loss(margin, y, val_w, objective: str):
-    """Per-round early-stopping metric on the held-out rows: weighted
-    logloss (binary) / MSE (squared) — both minimized. The reference's
-    default eval metric is aucpr (`DefaultSelectorParams.scala:71`); a
-    per-round device AuPR would add a 90k-row sort to every boosting round,
-    so the scan tracks the cheap strictly-proper logloss instead and the
-    selector still ranks configs by AuPR."""
+def _gbt_val_loss(margin, y, val_w, objective: str,
+                  eval_metric: str = "logloss"):
+    """Per-round early-stopping metric on the held-out rows, MINIMIZED.
+
+    "logloss": weighted logloss (binary) / MSE (squared) — cheap and
+    strictly proper. "aupr" (binary only): NEGATED sort-free binned AuPR
+    over 512 sigmoid buckets via one one-hot matmul — the reference's
+    default XGBoost eval is maximized aucpr
+    (`DefaultSelectorParams.scala:71` BinaryClassXGBEvaluationMetric), so
+    the stopping round matches reference semantics; an exact sorted AuPR
+    would serialize on TPU every round, the binned histogram stays on
+    the MXU (90k × 512 bf16 ≈ 0.1 GFLOP/round)."""
     vs = jnp.maximum(val_w.sum(), 1.0)
+    if objective == "logistic" and eval_metric == "aupr":
+        nb = 512
+        p = jax.nn.sigmoid(margin)
+        b = jnp.minimum((p * nb).astype(jnp.int32), nb - 1)
+        B = jax.nn.one_hot(b, nb, dtype=jnp.bfloat16)
+        h = jnp.matmul(jnp.stack([(val_w * y).astype(jnp.bfloat16),
+                                  val_w.astype(jnp.bfloat16)]), B,
+                       preferred_element_type=jnp.float32)  # (2, nb)
+        tp = jnp.cumsum(h[0, ::-1])
+        n_at = jnp.cumsum(h[1, ::-1])
+        n_pos = jnp.maximum(tp[-1], 1e-9)
+        prec = jnp.where(n_at > 0, tp / jnp.maximum(n_at, 1e-30), 1.0)
+        rec = tp / n_pos
+        r = jnp.concatenate([jnp.zeros(1), rec])
+        pr = jnp.concatenate([jnp.ones(1), prec])
+        aupr = ((r[1:] - r[:-1]) * (pr[1:] + pr[:-1]) * 0.5).sum()
+        return -aupr  # maximize aucpr == minimize its negation
     if objective == "logistic":
         ll = jax.nn.softplus(margin) - y * margin  # -log p(y|margin)
         return (ll * val_w).sum() / vs
@@ -362,7 +427,7 @@ def _gbt_scan(Xb, y, w, val_w, margin0, best0, since0, keys,
               max_depth: int, n_bins: int, learning_rate, reg_lambda,
               objective: str, min_child_weight, active_depth, gamma, alpha,
               subsample, colsample, early_stopping_rounds: int,
-              min_gain_norm=0.0):
+              min_gain_norm=0.0, eval_metric: str = "logloss"):
     """Shared traced boosting loop. Carry = (margin, best_val, since);
     with `early_stopping_rounds` > 0, a round whose start state has
     `since >= early_stopping_rounds` grows a ZEROED tree (leaf *= 0), so
@@ -399,7 +464,7 @@ def _gbt_scan(Xb, y, w, val_w, margin0, best0, since0, keys,
             tree["leaf"] = tree["leaf"] * live
         margin = margin + learning_rate * predict_tree(tree, Xb)[:, 0]
         if esr > 0:
-            m = _gbt_val_loss(margin, y, val_w, objective)
+            m = _gbt_val_loss(margin, y, val_w, objective, eval_metric)
             improved = m < best - 1e-7
             since = jnp.where(since >= esr, since,
                               jnp.where(improved, 0, since + 1))
@@ -410,12 +475,14 @@ def _gbt_scan(Xb, y, w, val_w, margin0, best0, since0, keys,
 
 
 @partial(jax.jit, static_argnames=("n_estimators", "max_depth", "n_bins",
-                                   "objective", "early_stopping_rounds"))
+                                   "objective", "early_stopping_rounds",
+                                   "eval_metric"))
 def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
             learning_rate, reg_lambda, objective: str = "logistic",
             min_child_weight: float = 1.0, active_depth=None,
             gamma=0.0, alpha=0.0, subsample=1.0, colsample=1.0, seed=0,
-            val_w=None, early_stopping_rounds: int = 0, min_gain_norm=0.0):
+            val_w=None, early_stopping_rounds: int = 0, min_gain_norm=0.0,
+            eval_metric: str = "logloss"):
     """Returns (trees, final_margin): the scan carry already holds the full
     training-matrix margin, so sweep callers need not re-walk the forest.
 
@@ -433,18 +500,19 @@ def fit_gbt(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
         Xb, y, w, val_w, jnp.zeros(n, jnp.float32), jnp.float32(jnp.inf),
         jnp.int32(0), keys, max_depth, n_bins, learning_rate, reg_lambda,
         objective, min_child_weight, active_depth, gamma, alpha, subsample,
-        colsample, early_stopping_rounds, min_gain_norm)
+        colsample, early_stopping_rounds, min_gain_norm, eval_metric)
     return trees, margin
 
 
 @partial(jax.jit, static_argnames=("n_rounds", "max_depth", "n_bins",
-                                   "objective", "early_stopping_rounds"))
+                                   "objective", "early_stopping_rounds",
+                                   "eval_metric"))
 def fit_gbt_chunk(Xb, y, w, val_w, margin, best, since, keys,
                   n_rounds: int, max_depth: int, n_bins: int,
                   learning_rate, reg_lambda, objective: str,
                   min_child_weight, active_depth, gamma, alpha,
                   subsample, colsample, early_stopping_rounds: int,
-                  min_gain_norm=0.0):
+                  min_gain_norm=0.0, eval_metric: str = "logloss"):
     """One host-dispatched chunk of boosting rounds carrying the
     early-stopping state. A 200-round depth-10 fit at 100k rows exceeds
     the ~60s single-execution serving ceiling as ONE program; the sweep
@@ -457,7 +525,7 @@ def fit_gbt_chunk(Xb, y, w, val_w, margin, best, since, keys,
                      max_depth, n_bins, learning_rate, reg_lambda, objective,
                      min_child_weight, active_depth, gamma, alpha,
                      subsample, colsample, early_stopping_rounds,
-                     min_gain_norm)
+                     min_gain_norm, eval_metric)
 
 
 def _pick_rounds_per_dispatch(n_estimators: int, ideal: int) -> int:
@@ -485,7 +553,7 @@ def fit_gbt_hosted(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
                    subsample=1.0, colsample=1.0, seed=0, val_w=None,
                    early_stopping_rounds: int = 0,
                    rounds_per_dispatch: Optional[int] = None,
-                   min_gain_norm=0.0):
+                   min_gain_norm=0.0, eval_metric: str = "logloss"):
     """Host-chunked boosting: bitwise-identical trees/margin to `fit_gbt`
     (same key stream, same scan body) but dispatched `rounds_per_dispatch`
     rounds at a time so no single XLA execution can hit the ~60s serving
@@ -511,7 +579,7 @@ def fit_gbt_hosted(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
             Xb, y, w, val_w, margin, best, since, ks, int(ks.shape[0]),
             max_depth, n_bins, learning_rate, reg_lambda, objective,
             min_child_weight, None, gamma, alpha, subsample, colsample, esr,
-            min_gain_norm)
+            min_gain_norm, eval_metric)
         chunks.append(trees)
         done += int(ks.shape[0])
         if esr and int(since) >= esr:
@@ -577,10 +645,10 @@ def gbt_multiclass_pred_from_margin(margin: jnp.ndarray) -> Dict:
             "rawPrediction": margin, "probability": probs}
 
 
-@partial(jax.jit, static_argnames=())
-def predict_gbt_margin(trees: Dict, Xb: jnp.ndarray, learning_rate) -> jnp.ndarray:
-    preds = jax.vmap(lambda t: predict_tree(t, Xb))(trees)  # (T, n, 1)
-    return learning_rate * preds[:, :, 0].sum(axis=0)
+@partial(jax.jit, static_argnames=("chunk",))
+def predict_gbt_margin(trees: Dict, Xb: jnp.ndarray, learning_rate,
+                       chunk: int = 64) -> jnp.ndarray:
+    return learning_rate * _predict_trees_sum(trees, Xb, chunk)[:, 0]
 
 
 # --------------------------------------------------------------------------- #
@@ -588,15 +656,17 @@ def predict_gbt_margin(trees: Dict, Xb: jnp.ndarray, learning_rate) -> jnp.ndarr
 # so sweep metrics always describe exactly what the refit model predicts)     #
 # --------------------------------------------------------------------------- #
 
-def forest_classification_pred(trees: Dict, Xb: jnp.ndarray) -> Dict:
-    probs = predict_forest(trees, Xb)
+def forest_classification_pred(trees: Dict, Xb: jnp.ndarray,
+                               chunk: int = 64) -> Dict:
+    probs = predict_forest(trees, Xb, chunk)
     probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), 1e-9)
     return {"prediction": jnp.argmax(probs, -1).astype(jnp.float32),
             "rawPrediction": probs, "probability": probs}
 
 
-def forest_regression_pred(trees: Dict, Xb: jnp.ndarray) -> Dict:
-    pred = predict_forest(trees, Xb)[:, 0]
+def forest_regression_pred(trees: Dict, Xb: jnp.ndarray,
+                           chunk: int = 64) -> Dict:
+    pred = predict_forest(trees, Xb, chunk)[:, 0]
     return {"prediction": pred, "rawPrediction": pred[:, None],
             "probability": jnp.zeros((Xb.shape[0], 0), jnp.float32)}
 
@@ -836,6 +906,7 @@ class OpGBTClassifier(_TreeEstimatorBase):
                  subsample: float = 1.0, colsample_bytree: float = 1.0,
                  early_stopping_rounds: int = 0, min_info_gain: float = 0.0,
                  min_instances_per_node: float = 1.0,
+                 eval_metric: str = "logloss",
                  n_classes: Optional[int] = None, uid: Optional[str] = None):
         super().__init__(uid=uid, n_estimators=n_estimators, max_depth=max_depth,
                          learning_rate=learning_rate, reg_lambda=reg_lambda,
@@ -845,6 +916,7 @@ class OpGBTClassifier(_TreeEstimatorBase):
                          early_stopping_rounds=early_stopping_rounds,
                          min_info_gain=min_info_gain,
                          min_instances_per_node=min_instances_per_node,
+                         eval_metric=eval_metric,
                          n_classes=n_classes)
         self.n_estimators = n_estimators
         self.max_depth = max_depth
@@ -863,6 +935,10 @@ class OpGBTClassifier(_TreeEstimatorBase):
         # stays raw
         self.min_info_gain = min_info_gain
         self.min_instances_per_node = min_instances_per_node
+        # early-stopping eval: "logloss" (Spark-ish strictly-proper
+        # default) or "aupr" (the reference's maximized XGBoost aucpr,
+        # DefaultSelectorParams.scala:71 — OpXGBoostClassifier's default)
+        self.eval_metric = eval_metric
         self.n_classes = n_classes
 
     def _effective_mcw(self) -> float:
@@ -921,7 +997,8 @@ class OpGBTClassifier(_TreeEstimatorBase):
                 subsample=jnp.float32(self.subsample),
                 colsample=jnp.float32(self.colsample_bytree),
                 seed=seed, val_w=hold * w, early_stopping_rounds=esr,
-                min_gain_norm=jnp.float32(self.min_info_gain))
+                min_gain_norm=jnp.float32(self.min_info_gain),
+                eval_metric=self.eval_metric)
             # stopped rounds grow ZEROED trees; a live-but-fully-pruned
             # tree is also all-zero but contributes nothing either way
             leaf = np.asarray(probe["leaf"])
@@ -977,6 +1054,7 @@ class OpXGBoostClassifier(OpGBTClassifier):
                  colsample_bytree: float = 1.0,
                  early_stopping_rounds: int = 0, min_info_gain: float = 0.0,
                  min_instances_per_node: float = 1.0,
+                 eval_metric: str = "aupr",
                  n_classes: Optional[int] = None, uid: Optional[str] = None):
         super().__init__(n_estimators=n_estimators, max_depth=max_depth,
                          learning_rate=eta, reg_lambda=reg_lambda,
@@ -986,6 +1064,7 @@ class OpXGBoostClassifier(OpGBTClassifier):
                          early_stopping_rounds=early_stopping_rounds,
                          min_info_gain=min_info_gain,
                          min_instances_per_node=min_instances_per_node,
+                         eval_metric=eval_metric,
                          n_classes=n_classes, uid=uid)
         self.params["eta"] = eta
         self.params.pop("learning_rate", None)

@@ -672,7 +672,9 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
                                active_depth=d["depth"], bootstrap=bootstrap,
                                tree_budget_divisor=divisor,
                                min_gain=d["min_gain"])
-            return pred_fn(trees, Xb)
+            # small predict chunk: the dispatch vmaps `divisor` pairs, so
+            # the per-chunk (c, n, m->128) slab multiplies by the width
+            return pred_fn(trees, Xb, chunk=8)
         return fit_predict
 
     def dyn_of(g):
@@ -722,6 +724,8 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     n_rows = int(np.asarray(y).shape[0])
     d_feat = int(X.shape[1])
     n_folds = int(np.asarray(W).shape[0]) if hasattr(W, "shape") else len(W)
+
+    eval_metric = str(getattr(est, "eval_metric", "logloss") or "logloss")
 
     def static_of(g):
         return (int(_grid_param(est, g, "n_estimators")),
@@ -774,7 +778,7 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
                                     max_bins, d["lr"], d["lam"], objective,
                                     val_w=v, early_stopping_rounds=esr,
                                     min_gain_norm=d["min_gain_norm"],
-                                    **common)
+                                    eval_metric=eval_metric, **common)
                 return gbt_pred_from_margin(margin, objective)
             return fit_predict
 
@@ -831,7 +835,8 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
                 Xb, y, w, v, margin, best, since, ks, int(ks.shape[0]),
                 pad_depth, max_bins, d["lr"], d["lam"], objective,
                 d["mcw"], d["depth"], d["gamma"], d["alpha"],
-                d["subsample"], d["colsample"], esr, d["min_gain_norm"])
+                d["subsample"], d["colsample"], esr, d["min_gain_norm"],
+                eval_metric)
             return m, b, s
 
         prog = jax.jit(jax.vmap(chunk_pair,

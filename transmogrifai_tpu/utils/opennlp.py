@@ -43,7 +43,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "MaxentModel", "SentenceDetector", "TokenizerME", "NameFinder",
-    "load_model", "model_dir", "available_models",
+    "POSTagger", "load_model", "load_tag_dictionary", "model_dir",
+    "available_models",
 ]
 
 
@@ -455,3 +456,106 @@ class NameFinder:
         if start is not None:
             out.append((start, len(tags), ent))
         return out
+
+
+# --------------------------------------------------------------------- #
+# POS tagger (POSTaggerME: perceptron/maxent + optional tag dictionary) #
+# --------------------------------------------------------------------- #
+
+def load_tag_dictionary(path: str) -> Dict[str, List[str]]:
+    """tags.tagdict XML inside a pos model container: token → allowed
+    tags (POSDictionary; constrains the beam for known words)."""
+    import xml.etree.ElementTree as ET
+    with zipfile.ZipFile(path) as z:
+        if "tags.tagdict" not in z.namelist():
+            return {}
+        root = ET.fromstring(z.read("tags.tagdict"))
+    out: Dict[str, List[str]] = {}
+    for entry in root.iter("entry"):
+        tags = (entry.get("tags") or "").split()
+        tok = entry.findtext("token")
+        if tok and tags:
+            out[tok] = tags
+    return out
+
+
+class POSTagger:
+    """POSTaggerME over the shipped perceptron/maxent models: per-token
+    eval with prev-tag features ("t=", "t2=") beam-searched; rare-word
+    prefix/suffix/shape features mirror POSContextGenerator (recovered
+    from the model's own predicate vocabulary: w/p/pp/n/nn, pre/suf 1-4,
+    c/d/h, default)."""
+
+    BEAM = 3
+
+    def __init__(self, model: MaxentModel,
+                 tagdict: Optional[Dict[str, List[str]]] = None):
+        self.model = model
+        self.tagdict = tagdict or {}
+
+    @staticmethod
+    def _context(tokens: List[str], i: int, prev: str, pprev: str
+                 ) -> List[str]:
+        # boundary literals from the model's own vocabulary: previous
+        # words beyond the start are "*SB*", next words beyond the end
+        # "*SE*"; prev-TAG features are simply omitted at the start (the
+        # t=/t2= vocab has no bos value)
+        n = len(tokens)
+        w = tokens[i]
+        feats = ["default", "w=" + w]
+        feats.append("p=" + (tokens[i - 1] if i > 0 else "*SB*"))
+        feats.append("pp=" + (tokens[i - 2] if i > 1 else "*SB*"))
+        feats.append("n=" + (tokens[i + 1] if i + 1 < n else "*SE*"))
+        feats.append("nn=" + (tokens[i + 2] if i + 2 < n else "*SE*"))
+        if prev:
+            feats.append("t=" + prev)
+            if pprev:
+                feats.append("t2=" + pprev + "," + prev)
+        for L in (1, 2, 3, 4):
+            if len(w) > L:
+                feats.append("pre=" + w[:L])
+                feats.append("suf=" + w[-L:])
+        if any(c.isupper() for c in w):
+            feats.append("c")
+        if any(c.isdigit() for c in w):
+            feats.append("d")
+        if "-" in w:
+            feats.append("h")
+        return feats
+
+    def tag(self, tokens: List[str]) -> List[str]:
+        if not tokens:
+            return []
+        beam: List[Tuple[float, List[str]]] = [(0.0, [])]
+        for i, w in enumerate(tokens):
+            allowed = set(self.tagdict.get(w, ()))
+            nxt: List[Tuple[float, List[str]]] = []
+            for score, seq in beam:
+                prev = seq[-1] if seq else ""
+                pprev = seq[-2] if len(seq) > 1 else ""
+                probs = self.model.eval(self._context(tokens, i, prev, pprev))
+                # log domain, no probability cutoff: perceptron score
+                # gaps can exceed softmax's f64 range, and with a
+                # tagdict constraint the allowed tag may hold ~0 mass —
+                # it must still be rankable, not dropped
+                for oi, p in enumerate(probs):
+                    o = self.model.outcomes[oi]
+                    if allowed and o not in allowed:
+                        continue
+                    nxt.append((score + math.log(max(p, 1e-300)),
+                                seq + [o]))
+            if not nxt:
+                # tagdict entry shares no tags with the model's outcome
+                # set (custom/corrupt dictionary): fall back to the
+                # unconstrained distribution rather than dying
+                for score, seq in beam:
+                    prev = seq[-1] if seq else ""
+                    pprev = seq[-2] if len(seq) > 1 else ""
+                    probs = self.model.eval(
+                        self._context(tokens, i, prev, pprev))
+                    for oi, p in enumerate(probs):
+                        nxt.append((score + math.log(max(p, 1e-300)),
+                                    seq + [self.model.outcomes[oi]]))
+            nxt.sort(key=lambda sp: -sp[0])
+            beam = nxt[:self.BEAM]
+        return beam[0][1]
