@@ -275,3 +275,29 @@ class TestTransmogrifyCoverage:
         assert any(p.startswith("email") for p in parents), parents
         assert any(p.startswith("url") for p in parents), parents
         assert any(p.startswith("b64") for p in parents), parents
+
+
+class TestPhoneRegions:
+    """libphonenumber-lite upgrade (VERDICT r3 missing #4): ~50-region
+    length windows, foreign-code longest-prefix resolution, NANP
+    N[2-9]XX structure, trunk-zero stripping."""
+
+    def test_nanp_structure(self):
+        from transmogrifai_tpu.ops.enrich import is_valid_phone as v
+        assert v("(415) 555-2671") is True
+        assert v("041 555 2671") is False   # area code starts with 0
+        assert v("415 155 2671") is False   # exchange starts with 1
+        assert v("+1 415 555 2671") is True
+
+    def test_foreign_codes_resolve_to_their_region(self):
+        from transmogrifai_tpu.ops.enrich import is_valid_phone as v
+        assert v("+44 20 7946 0958") is True    # GB from US default
+        assert v("+33 1 42 68 53 00") is True   # FR
+        assert v("+33 1 42") is False           # FR too short
+        assert v("+65 6123 4567") is True       # SG (3+ digit cc region)
+        assert v("+999 123456789012345678") is False
+
+    def test_trunk_zero(self):
+        from transmogrifai_tpu.ops.enrich import is_valid_phone as v
+        assert v("06 12 34 56 78", "FR") is True
+        assert v("020 7946 0958", "GB") is True

@@ -1,0 +1,34 @@
+"""Walkthrough notebooks (`examples/notebooks/`, the reference's
+`helloworld/notebooks/` analogue): structural validation for all three,
+full code-cell execution for the quickest one."""
+
+import os
+
+import nbformat
+import pytest
+
+NB_DIR = os.path.join(os.path.dirname(__file__), "..", "examples",
+                      "notebooks")
+ALL = ["OpTitanicSimple.ipynb", "OpIris.ipynb", "OpBostonHousing.ipynb"]
+
+
+@pytest.mark.parametrize("name", ALL)
+def test_notebook_well_formed(name):
+    nb = nbformat.read(os.path.join(NB_DIR, name), as_version=4)
+    nbformat.validate(nb)
+    kinds = {c.cell_type for c in nb.cells}
+    assert "code" in kinds and "markdown" in kinds
+    src = "\n".join(c.source for c in nb.cells if c.cell_type == "code")
+    assert "transmogrify" in src and "Workflow" in src
+
+
+@pytest.mark.slow
+def test_iris_notebook_executes(tmp_path, monkeypatch):
+    """Concatenated code cells run end to end (train + score) from the
+    notebook's own working directory."""
+    nb = nbformat.read(os.path.join(NB_DIR, "OpIris.ipynb"), as_version=4)
+    code = "\n\n".join(c.source for c in nb.cells if c.cell_type == "code")
+    monkeypatch.chdir(NB_DIR)
+    ns: dict = {}
+    exec(compile(code, "OpIris.ipynb", "exec"), ns)  # noqa: S102
+    assert "model" in ns and "summary" in ns

@@ -352,6 +352,29 @@ class Dataset:
                 else:
                     arr = arr.astype(np.float64, copy=False)
                 cols[name] = arr
+            elif (pa.types.is_string(at) or pa.types.is_large_string(at)) \
+                    and not issubclass(ftype, T.OPNumeric):
+                # dictionary-encode instead of to_pylist: building 100k
+                # python strings is ~0.45s of GIL-bound work per column,
+                # while int32 indices + a small level table cost ~2ms and
+                # the object column holds REFERENCES into the level array
+                # (low-cardinality categoricals share a handful of strs)
+                import pyarrow.compute as pc
+                ca = col.combine_chunks() if hasattr(col, "combine_chunks") \
+                    else col
+                d = pc.dictionary_encode(ca)
+                if isinstance(d, pa.ChunkedArray):
+                    d = d.combine_chunks()
+                idx = d.indices.to_numpy(zero_copy_only=False)
+                levels = np.empty(len(d.dictionary), dtype=object)
+                levels[:] = d.dictionary.to_pylist()
+                arr = np.empty(len(idx), dtype=object)
+                valid = ~np.isnan(idx) if idx.dtype.kind == "f" else \
+                    np.ones(len(idx), dtype=bool)
+                arr[valid] = levels[idx[valid].astype(np.int64)]
+                if not valid.all():
+                    arr[~valid] = None
+                cols[name] = arr
             else:
                 values = col.to_pylist()
                 if pa.types.is_map(at):  # arrow maps arrive as (k, v) pairs

@@ -156,41 +156,100 @@ class UrlProtocolTransformer(HostTransformer):
 # phone                                                                       #
 # --------------------------------------------------------------------------- #
 
-# national number length rules per region (libphonenumber-lite):
+# national number length rules per region (libphonenumber-lite: the
+# reference wraps full libphonenumber metadata; this table carries the
+# country code + national-number length window for the ~40 most common
+# calling regions, plus NANP structural rules below):
 # region → (country_code, min_len, max_len)
 _PHONE_REGIONS: Dict[str, tuple] = {
     "US": ("1", 10, 10), "CA": ("1", 10, 10), "GB": ("44", 9, 10),
     "DE": ("49", 6, 11), "FR": ("33", 9, 9), "IN": ("91", 10, 10),
     "AU": ("61", 9, 9), "JP": ("81", 9, 10), "BR": ("55", 10, 11),
     "MX": ("52", 10, 10), "CN": ("86", 10, 11), "ES": ("34", 9, 9),
-    "IT": ("39", 8, 11), "NL": ("31", 9, 9),
+    "IT": ("39", 8, 11), "NL": ("31", 9, 9), "SE": ("46", 7, 9),
+    "NO": ("47", 8, 8), "DK": ("45", 8, 8), "FI": ("358", 5, 10),
+    "PL": ("48", 9, 9), "CZ": ("420", 9, 9), "SK": ("421", 9, 9),
+    "AT": ("43", 7, 11), "CH": ("41", 9, 9), "BE": ("32", 8, 9),
+    "PT": ("351", 9, 9), "GR": ("30", 10, 10), "IE": ("353", 7, 9),
+    "RU": ("7", 10, 10), "UA": ("380", 9, 9), "TR": ("90", 10, 10),
+    "IL": ("972", 8, 9), "SA": ("966", 8, 9), "AE": ("971", 8, 9),
+    "EG": ("20", 8, 10), "ZA": ("27", 9, 9), "NG": ("234", 7, 10),
+    "KE": ("254", 9, 9), "KR": ("82", 8, 10), "SG": ("65", 8, 8),
+    "HK": ("852", 8, 8), "TW": ("886", 8, 9), "TH": ("66", 8, 9),
+    "VN": ("84", 9, 10), "ID": ("62", 8, 12), "MY": ("60", 9, 10),
+    "PH": ("63", 8, 10), "PK": ("92", 9, 10), "BD": ("880", 8, 10),
+    "AR": ("54", 10, 10), "CL": ("56", 9, 9), "CO": ("57", 10, 10),
+    "PE": ("51", 9, 9), "NZ": ("64", 8, 10),
 }
+
+# country code → (min_len, max_len) for resolving "+cc..." numbers from
+# OTHER regions against their own length window (longest-prefix match)
+_CC_LENGTHS: Dict[str, tuple] = {}
+for _region, (_cc, _lo, _hi) in _PHONE_REGIONS.items():
+    prev = _CC_LENGTHS.get(_cc)
+    _CC_LENGTHS[_cc] = ((min(prev[0], _lo), max(prev[1], _hi))
+                        if prev else (_lo, _hi))
+
+
+def _nanp_valid(national: str) -> bool:
+    """NANP structure (US/CA): NXX-NXX-XXXX with N in 2-9 for the area
+    and exchange codes (libphonenumber's generalDesc pattern)."""
+    return (len(national) == 10 and national[0] not in "01"
+            and national[3] not in "01")
 
 
 def is_valid_phone(s: Optional[str], default_region: str = "US",
                    strict: bool = False) -> Optional[bool]:
     """Region-aware validity (PhoneNumberParser.scala: validity against a
-    default region; non-strict mode tolerates missing country code)."""
+    default region; non-strict mode tolerates missing country code).
+    "+cc" numbers from a different region validate against THAT region's
+    length window via longest-code match; NANP numbers additionally check
+    the N[2-9]XX area/exchange structure."""
     if s is None:
         return None
     digits = re.sub(r"[^\d+]", "", s.strip())
     if not digits:
         return False
-    cc, lo, hi = _PHONE_REGIONS.get(default_region.upper(), ("1", 7, 15))
+    region = default_region.upper()
+    known = region in _PHONE_REGIONS
+    cc, lo, hi = _PHONE_REGIONS.get(region, ("", 7, 15))
+
+    def _check(cc_used: str, national: str, lo_: int, hi_: int) -> bool:
+        if cc_used == "1":
+            return _nanp_valid(national)
+        return lo_ <= len(national) <= hi_
+
     if digits.startswith("+"):
         body = digits[1:]
         if not body.isdigit():
             return False
-        if body.startswith(cc):
-            national = body[len(cc):]
-            return lo <= len(national) <= hi
-        # other country code: generic E.164 bound
-        return 7 <= len(body) <= 15
+        if known and body.startswith(cc):
+            return _check(cc, body[len(cc):], lo, hi)
+        # another country's code: longest-prefix match into the table
+        for plen in (3, 2, 1):
+            pref = body[:plen]
+            if pref in _CC_LENGTHS:
+                flo, fhi = _CC_LENGTHS[pref]
+                return _check(pref, body[plen:], flo, fhi)
+        return 7 <= len(body) <= 15  # unknown code: generic E.164 bound
     if not digits.isdigit():
         return False
-    if digits.startswith(cc) and lo <= len(digits) - len(cc) <= hi:
-        return not strict or default_region.upper() in ("US", "CA")
-    return lo <= len(digits) <= hi
+    if known and digits.startswith(cc) and \
+            _check(cc, digits[len(cc):], lo, hi):
+        return not strict or region in ("US", "CA")
+    # bare national number: NANP structure only for NANP default regions;
+    # unknown regions keep the generic (7, 15) window
+    if known and cc == "1":
+        return _nanp_valid(digits)
+    if lo <= len(digits) <= hi:
+        return True
+    # national trunk prefix: most non-NANP regions write national numbers
+    # with a leading 0 that is not part of the significant number
+    # (libphonenumber's nationalPrefix strip); Italy-style kept-zero
+    # numbers already matched the plain window above
+    if digits.startswith("0") and lo <= len(digits) - 1 <= hi:
+        return True
+    return False
 
 
 def phone_valid_block(values, default_region: str,

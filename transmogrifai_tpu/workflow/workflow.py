@@ -489,7 +489,9 @@ class WorkflowModel:
                         host[f] = v
                 for fname, k, shape in meta:
                     size = int(np.prod(shape))
-                    piece = buf[off:off + size].reshape(shape)
+                    # copy: a view would pin the WHOLE group buffer for
+                    # as long as any one batch's array is retained
+                    piece = buf[off:off + size].reshape(shape).copy()
                     if k is None:
                         host[fname] = piece
                     else:
