@@ -413,3 +413,23 @@ class TestHistogramPrecision:
         oracle = int(np.argmax([gain64(0), gain64(1)]))
         assert int(np.asarray(bf)[0]) == oracle
 
+
+
+def test_predict_tree_dense_bit_parity(rng):
+    """The tensorized no-gather predict must match the level walk
+    bit-for-bit at several depths (see predict_tree_dense docstring for
+    the measured perf tradeoff)."""
+    from transmogrifai_tpu.models.trees import (
+        bin_features, grow_tree, predict_tree, predict_tree_dense,
+        quantile_bin_edges)
+    for depth, nb in [(3, 8), (6, 16), (10, 32)]:
+        n, d = 2000, 9
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * rng.normal(size=n) > 0)
+        edges = quantile_bin_edges(X, nb)
+        Xb = bin_features(jnp.asarray(X), jnp.asarray(edges))
+        G = jnp.asarray(np.stack([y, 1 - y], 1).astype(np.float32))
+        tree = grow_tree(Xb, G, jnp.ones(n, jnp.float32), depth, nb)
+        a = np.asarray(predict_tree(tree, Xb))
+        b = np.asarray(predict_tree_dense(tree, Xb))
+        np.testing.assert_array_equal(a, b)

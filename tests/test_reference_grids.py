@@ -235,13 +235,16 @@ def test_xgb_sweep_es_matches_refit(binary_data):
     m = run_sweep(est, grids, jnp.asarray(X), jnp.asarray(y), folds, ev, ctx)
     tr, va = folds[0]
     # refit with the SWEEP's fold semantics: train rows weighted by the
-    # fold mask, early-stop eval on the validation rows
+    # fold mask, early-stop eval on the validation rows with the
+    # estimator's eval metric (OpXGBoostClassifier defaults to the
+    # reference's maximized aucpr)
     trees, margin = fit_gbt_hosted(
         bin_features(jnp.asarray(X),
                      jnp.asarray(quantile_bin_edges(X, est.max_bins))),
         jnp.asarray(y), jnp.asarray(tr), 30, 3, est.max_bins,
         jnp.float32(0.3), jnp.float32(1.0), "logistic", seed=0,
-        val_w=jnp.asarray(va), early_stopping_rounds=5)
+        val_w=jnp.asarray(va), early_stopping_rounds=5,
+        eval_metric=est.eval_metric)
     from transmogrifai_tpu.models.trees import gbt_pred_from_margin
     from transmogrifai_tpu.data.columns import Column
     import transmogrifai_tpu.types as t
