@@ -415,6 +415,7 @@ class TestHistogramPrecision:
 
 
 
+@pytest.mark.slow
 def test_predict_tree_dense_bit_parity(rng):
     """The tensorized no-gather predict must match the level walk
     bit-for-bit at several depths (see predict_tree_dense docstring for
