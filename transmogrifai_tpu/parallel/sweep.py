@@ -188,7 +188,7 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
     host = isinstance(metric_fn, HostMetricFallback)
     y_np = np.asarray(y) if host else None
     V_np = np.asarray(V) if host else None
-    for static, idxs in groups.items():
+    def _run_group(static, idxs):
         dyn_dicts = [dyn_of(grids[i]) for i in idxs]
         dyn = {k: jnp.asarray([d[k] for d in dyn_dicts],
                               jnp.int32 if isinstance(dyn_dicts[0][k], int)
@@ -252,7 +252,7 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                         log.info("sweep dispatch width recalibrated "
                                  "%d -> %d (measured %.1fs)", width, new_w, dt)
                         width = new_w
-            continue
+            return
 
         def one_cfg(d, fit_predict=fit_predict):
             def one_fold(w, v):
@@ -274,6 +274,17 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
             gk = np.asarray(gk)
             for row_i, grid_i in enumerate(idxs):
                 metrics[grid_i] = [float(m) for m in gk[row_i]]
+
+    # groups run SEQUENTIALLY on purpose (families already overlap on
+    # the selector's thread pool): fanning groups out as well would (a)
+    # multiply concurrently-live dispatch chunks past the per-dispatch
+    # _PAIR_MEM_BYTES budget (device OOM faults poison the process on
+    # this serving stack), (b) poison the persisted width calibration
+    # with queue-contention time — and width feeds compiled dispatch
+    # shapes, defeating the stable-shape/persistent-cache strategy, and
+    # (c) let later groups reuse calibration learned by earlier ones.
+    for st, ix in groups.items():
+        _run_group(st, ix)
     return metrics  # type: ignore[return-value]
 
 
