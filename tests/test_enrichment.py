@@ -162,6 +162,28 @@ class TestAdvancedText:
         assert sim("cat", "dog") > sim("cat", "stock")
         assert sim("stock", "bond") > sim("dog", "bond")
 
+    def test_word2vec_adversarial_corpus_stays_finite(self):
+        # A degenerate two-token corpus (a near-categorical text column)
+        # made the un-capped batched SGNS diverge even at the DEFAULT
+        # learning rate: np.add.at sums ~batch/V duplicate stale-gradient
+        # steps per word, logits blow past ±700, the naive
+        # 1/(1+exp(-x)) overflows (the r4 verdict #10 RuntimeWarning) and
+        # the embeddings run to NaN. The vocab-capped batch + stable
+        # sigmoid must keep every vector finite and warning-free; the
+        # absurd lr=5.0 additionally exercises the absolute update clip.
+        import warnings
+        from transmogrifai_tpu.ops.text_advanced import OpWord2Vec
+        docs = [["hot", "cold"] * 20 for _ in range(80)]
+        col = self._toklist(docs)
+        for lr in (0.025, 5.0):
+            with warnings.catch_warnings():
+                warnings.simplefilter("error", RuntimeWarning)
+                m = OpWord2Vec(vector_size=8, window=2, min_count=1,
+                               num_iter=25, learning_rate=lr, negatives=3,
+                               seed=0).fit_model([col], FitContext(len(docs)))
+            for w, v in m.vectors.items():
+                assert np.all(np.isfinite(v)), (lr, w)
+
     def test_lda_separates_topics(self):
         from transmogrifai_tpu.ops.text_advanced import OpLDA
         rng = np.random.default_rng(0)

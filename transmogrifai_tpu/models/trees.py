@@ -104,10 +104,13 @@ def bins_onehot(Xb: jnp.ndarray, n_bins: int) -> jnp.ndarray:
 #     true f32 even where the platform runs plain f32 matmuls at bf16) —
 #     the reference bar (MLlib/XGBoost exact f32/f64 scatter histograms)
 #     at roughly 1/4-1/8 the MXU throughput.
-# Process-level switch: TRANSMOGRIFAI_HIST_PRECISION=f32 (read at trace
-# time; changing it invalidates compiled programs naturally since it
-# changes the traced graph). test_models.py bounds the divergence of both
-# modes against an f64 oracle on near-tie data.
+# Process-level switch: TRANSMOGRIFAI_HIST_PRECISION=f32, read ONCE at
+# import. jax.jit caches executables by shape/static-args only, so
+# mutating this global (or the env var) after fit functions have traced
+# silently keeps the OLD precision for already-compiled shapes — set the
+# env var before importing this module and never mutate it mid-process
+# (r4 advisor). test_models.py bounds the divergence of both modes
+# against an f64 oracle on near-tie data.
 HIST_PRECISION = os.environ.get("TRANSMOGRIFAI_HIST_PRECISION", "bf16")
 
 
@@ -1045,11 +1048,14 @@ class OpGBTClassifier(_TreeEstimatorBase):
                 seed=seed, val_w=hold * w, early_stopping_rounds=esr,
                 min_gain_norm=jnp.float32(self.min_info_gain),
                 eval_metric=self.eval_metric)
-            # stopped rounds grow ZEROED trees; a live-but-fully-pruned
-            # tree is also all-zero but contributes nothing either way
+            # stopped rounds grow ZEROED trees, so the probe's stopping
+            # round is the LAST live tree's index + 1 — counting live
+            # trees instead would undercount when a mid-sequence tree is
+            # fully pruned by gamma/min_info_gain (all-zero leaves while
+            # boosting continued; r4 advisor)
             leaf = np.asarray(probe["leaf"])
             live = np.any(leaf != 0, axis=tuple(range(1, leaf.ndim)))
-            n_live = max(int(live.sum()), 1)
+            n_live = int(np.flatnonzero(live).max()) + 1 if live.any() else 1
             # quantize UP to a multiple of the probe's dispatch chunk so
             # the refit reuses the already-compiled chunk program (a
             # fresh XLA shape costs 15-50s through the remote-AOT

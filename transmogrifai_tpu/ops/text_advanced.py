@@ -271,7 +271,15 @@ class OpWord2Vec(Estimator):
         doc_of = np.concatenate(
             [np.full(len(d), i) for i, d in enumerate(docs)])
         n_pos = len(flat)
-        batch = 8192
+        # Batch caps at 8·vocab pairs: `np.add.at` SUMS every in-batch
+        # duplicate of a word as one stale-gradient step, so a tiny
+        # vocabulary (near-categorical text columns) under a large batch
+        # takes effective steps of ~(batch/V)·lr·‖v‖ — divergent even at
+        # the default lr. Bounding duplicates-per-word at ~8 keeps the
+        # batched update within a small factor of gensim/Spark's
+        # sequential SGD (their batch is effectively 1); natural corpora
+        # (V ≥ 1024) keep the full throughput batch.
+        batch = int(min(8192, max(16, 8 * V)))
         for it in range(self.num_iter):
             spans = rng.integers(1, self.window + 1, size=n_pos)
             centers_l, contexts_l = [], []
@@ -306,13 +314,28 @@ class OpWord2Vec(Estimator):
                 labels[:, 0] = 1.0
                 vin = W_in[c]                          # (B, D)
                 vout = W_out[targets]                  # (B, m, D)
-                scores = 1.0 / (1.0 + np.exp(
-                    -np.einsum("bmd,bd->bm", vout, vin)))
+                # numerically stable sigmoid: exp only ever sees -|x|, so
+                # huge logits (adversarial corpora drive dot products past
+                # ±700 where exp overflows to inf) stay finite
+                logits = np.einsum("bmd,bd->bm", vout, vin)
+                ez = np.exp(-np.abs(logits))
+                scores = np.where(logits >= 0, 1.0 / (1.0 + ez),
+                                  ez / (1.0 + ez))
                 g = (labels - scores) * lr             # (B, m)
-                np.add.at(W_in, c, np.einsum("bm,bmd->bd", g, vout))
-                np.add.at(W_out, targets.reshape(-1),
-                          (g[:, :, None] * vin[:, None, :]).reshape(
-                              -1, D))
+                # no-NaN guarantee: a raw update is ≤ lr·‖v‖ — growth
+                # MULTIPLICATIVE in the weight scale, so a huge
+                # user-supplied lr turns wrong-direction saturation into
+                # an exponential run to ±inf (whose 0·inf / inf−inf
+                # products are where NaNs are born). An absolute ±1e3
+                # per-element update clip (far above any useful gradient;
+                # trained embeddings live at ‖v‖ ≲ 10) caps growth at
+                # linear, keeping every value finite forever while never
+                # binding during sane training.
+                gin = np.clip(np.einsum("bm,bmd->bd", g, vout), -1e3, 1e3)
+                gout = np.clip((g[:, :, None] * vin[:, None, :]).reshape(
+                    -1, D), -1e3, 1e3)
+                np.add.at(W_in, c, gin)
+                np.add.at(W_out, targets.reshape(-1), gout)
         return Word2VecModel({w: W_in[i] for i, w in enumerate(vocab)}, D)
 
 

@@ -90,8 +90,9 @@ def _sweep_generic(est, grids: List[Dict], X, y, folds, evaluator,
             clone._bin_cache = bin_cache
         row = []
         for tr, va in folds:
-            model = clone.fit_arrays(X, y, jnp.asarray(tr), ctx)
-            pred = model.predict_arrays(X)
+            with _DispatchSpan():  # visible to tree-family calib timing
+                model = clone.fit_arrays(X, y, jnp.asarray(tr), ctx)
+                pred = model.predict_arrays(X)
             row.append(_metric(evaluator, y_np,
                                {k: np.asarray(v) for k, v in pred.items()}, va))
         out.append(row)
@@ -147,7 +148,11 @@ def _run_block(one_cfg: Callable, dyn: Dict[str, jnp.ndarray], sharding,
         prog = jax.jit(jax.vmap(one_cfg))
     else:
         prog = jax.jit(lambda d: jax.lax.map(one_cfg, d))
-    out = jax.block_until_ready(prog(dyn))
+    # span-wrapped (even though THIS site never feeds calibration) so a
+    # tree family timing a dispatch on another thread sees the overlap —
+    # a linear-family execution queues tree dispatches just the same
+    with _DispatchSpan():
+        out = jax.block_until_ready(prog(dyn))
     return jax.tree_util.tree_map(lambda a: a[:g], out)  # drop pad rows
 
 
@@ -160,7 +165,7 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                   pair_width: Callable[[Tuple, List[int], int], int]
                   = lambda s, i, k: 1,
                   calibrate: Optional[Callable[[Tuple, List[int], float, int,
-                                                int], int]] = None,
+                                                int, bool], int]] = None,
                   fit_takes_val: bool = False,
                   ) -> List[List[float]]:
     """Shared scaffold: group grids by static params; per group, stack the
@@ -224,11 +229,13 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                 gs = [p // n_folds for p in ps]
                 fs = [p % n_folds for p in ps]
                 dchunk = {k: v[jnp.asarray(gs)] for k, v in dyn.items()}
-                t0 = _time.perf_counter()
-                out = jax.block_until_ready(
-                    prog(dchunk, W[jnp.asarray(fs)], V[jnp.asarray(fs)]))
-                dt = _time.perf_counter() - t0
-                SWEEP_STATS.record((id(prog), static, width), dt)
+                with _DispatchSpan() as span:
+                    t0 = _time.perf_counter()
+                    out = jax.block_until_ready(
+                        prog(dchunk, W[jnp.asarray(fs)], V[jnp.asarray(fs)]))
+                    dt = _time.perf_counter() - t0
+                SWEEP_STATS.record((id(prog), static, width), dt,
+                                   clean=span.clean)
                 out_np = jax.tree_util.tree_map(np.asarray, out)
                 for t in range(min(width, n_pairs - s)):
                     row_i, j = divmod(s + t, n_folds)
@@ -245,7 +252,8 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                 s += width
                 if calibrate is not None and s < n_pairs:
                     new_w = max(1, min(calibrate(static, idxs, dt, width,
-                                                 n_pairs - s), n_pairs - s))
+                                                 n_pairs - s, span.clean),
+                                       n_pairs - s))
                     if new_w != width:
                         # same jitted fn — the new chunk shape compiles on
                         # first use and persists in the compile cache
@@ -446,14 +454,28 @@ class SweepStats:
         with self._lock:
             self.dispatch_s = 0.0
             self.dispatches = 0
-            self.first_s = 0.0   # first execution of each program shape
+            # CLEAN = no other dispatch overlapped the measurement; only
+            # clean numbers feed the warm-mean/compile estimate — an
+            # overlapped wall-clock includes another family's queue time
+            # (r4 advisor, medium)
+            self.clean_s = 0.0
+            self.cleans = 0
+            self.first_s = 0.0   # first CLEAN execution of a program shape
             self.firsts = 0
             self._seen: set = set()
 
-    def record(self, key, seconds: float) -> None:
+    def record(self, key, seconds: float, clean: bool = True) -> None:
         with self._lock:
             self.dispatch_s += seconds
             self.dispatches += 1
+            if not clean:
+                # mark seen so a later clean run of the same program is
+                # not miscounted as a first, but keep the contaminated
+                # seconds out of both the first and the warm pools
+                self._seen.add(key)
+                return
+            self.clean_s += seconds
+            self.cleans += 1
             if key not in self._seen:
                 self._seen.add(key)
                 self.first_s += seconds
@@ -461,16 +483,53 @@ class SweepStats:
 
     def compile_estimate_s(self) -> float:
         """First-execution seconds minus what those executions would cost
-        warm (estimated from the observed warm mean) ≈ compile + cache-
-        lookup overhead."""
-        warm_n = self.dispatches - self.firsts
+        warm (estimated from the observed clean warm mean) ≈ compile +
+        cache-lookup overhead. Uses only clean dispatches on both sides."""
+        warm_n = self.cleans - self.firsts
         if warm_n <= 0:
             return self.first_s
-        warm_mean = (self.dispatch_s - self.first_s) / warm_n
+        warm_mean = (self.clean_s - self.first_s) / warm_n
         return max(0.0, self.first_s - warm_mean * self.firsts)
 
 
 SWEEP_STATS = SweepStats()
+
+
+# Concurrent-dispatch detection: families sweep on the selector's thread
+# pool, so one family's `block_until_ready` wall-clock can include time
+# queued behind ANOTHER family's device execution. Feeding that inflated
+# measurement into `_record_calib` persists a too-slow sec/unit (the EMA
+# leans 0.7 toward slower), which shrinks dispatch widths and forces
+# fresh compiled shapes mid-sweep — exactly the instabilities the
+# sequential-groups comment in `_sweep_blocks` guards against (r4
+# advisor, medium). Every timed device dispatch wraps itself in
+# `_DispatchSpan`; a measurement is CLEAN only if no other span was live
+# at entry and none started before it exited.
+_SPAN_LOCK = threading.Lock()
+_SPAN_ACTIVE = 0
+_SPAN_STARTS = 0
+
+
+class _DispatchSpan:
+    """Context manager around one timed device dispatch; `.clean` (valid
+    after exit) is True iff no other dispatch overlapped it."""
+
+    def __enter__(self):
+        global _SPAN_ACTIVE, _SPAN_STARTS
+        with _SPAN_LOCK:
+            _SPAN_ACTIVE += 1
+            _SPAN_STARTS += 1
+            self._epoch = _SPAN_STARTS
+            self.clean = _SPAN_ACTIVE == 1
+        return self
+
+    def __exit__(self, *exc):
+        global _SPAN_ACTIVE
+        with _SPAN_LOCK:
+            _SPAN_ACTIVE -= 1
+            if _SPAN_STARTS != self._epoch:  # someone started during us
+                self.clean = False
+        return False
 
 
 def _calib_path() -> str:
@@ -648,14 +707,24 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
                                     n_trees, _sec_per_unit("forest"),
                                     pad_depth))
 
-    def calibrate(st, idxs, seconds, width, remaining):
+    def calibrate(st, idxs, seconds, width, remaining, clean):
         n_trees, max_bins, _ = st[:3]
         pad_depth = _pad_depth_of(est, grids, idxs)
         units = (float(width) * n_trees * n_rows
                  * (2 ** min(pad_depth, 14)) * int(X.shape[1]) * max_bins)
-        spu = _record_calib("forest", seconds, units)
+        # an overlapped wall-clock includes another family's queue time —
+        # never let it reach the persisted calibration or GROW compiled
+        # dispatch shapes (r4 advisor, medium)...
+        spu = (_record_calib("forest", seconds, units) if clean
+               else _sec_per_unit("forest"))
+        # ...but the serving-kill halving fires regardless: overlap only
+        # ever OVERSTATES device time, so halving on a contaminated >45s
+        # reading is conservatively safe, while skipping it could let the
+        # next dispatch cross the ~60s exec kill
         if seconds > 0.75 * 60.0:  # dangerously near the serving kill
             return max(1, width // 2)
+        if not clean:
+            return width
         ideal = _tree_pair_width(n_rows, int(X.shape[1]), max_bins,
                                  n_trees, spu, pad_depth)
         # a resize recompiles (remote AOT ~15-50s): grow only when the
@@ -887,20 +956,27 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
             done = 0
             while done < n_est:
                 ks = keys_all[done:done + rpd]
-                t0 = _time.perf_counter()
-                margin, best, since = jax.block_until_ready(
-                    prog(dchunk, Wsel, Vsel, margin, best, since, ks))
-                dt = _time.perf_counter() - t0
+                with _DispatchSpan() as span:
+                    t0 = _time.perf_counter()
+                    margin, best, since = jax.block_until_ready(
+                        prog(dchunk, Wsel, Vsel, margin, best, since, ks))
+                    dt = _time.perf_counter() - t0
                 SWEEP_STATS.record(
-                    (id(prog), static, width, int(ks.shape[0])), dt)
+                    (id(prog), static, width, int(ks.shape[0])), dt,
+                    clean=span.clean)
                 done += int(ks.shape[0])
-                spu = _record_calib(
-                    "gbt", dt, float(width) * int(ks.shape[0]) * upr)
+                if span.clean:  # overlapped wall-clock never enters calib
+                    _record_calib(
+                        "gbt", dt, float(width) * int(ks.shape[0]) * upr)
                 if (esr > 0 and done < n_est
                         and bool(np.all(np.asarray(since) >= esr))):
                     log.info("gbt sweep: early stop after %d/%d rounds "
                              "(%d pairs)", done, n_est, width)
                     break
+                # NOT gated on span.clean: overlap only ever OVERSTATES
+                # device time, so halving on a contaminated >45s reading
+                # is conservatively safe — while skipping it could let
+                # the next dispatch cross the ~60s serving exec kill
                 if done < n_est and dt > 0.75 * 60.0 and rpd > 1:
                     # measured too close to the serving kill: halve (the
                     # shorter chunk compiles once, then persists in cache)
