@@ -58,22 +58,29 @@ def quantile_bin_edges(X: np.ndarray, max_bins: int = DEFAULT_MAX_BINS) -> np.nd
 
 
 def bin_features(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
-    """(n, d) int32 bin ids in [0, max_bins).
+    """(n, d) int8 bin ids in [0, max_bins) (int32 above 127 bins).
 
     Broadcast-compare + sum (== searchsorted side="right") instead of an
     actual per-column searchsorted: binary-search gathers serialize on TPU
     (~330ms at 100k×55) while the dense compare streams on the VPU and
-    fuses with neighbours (~10ms)."""
-    return (X[:, :, None] >= edges[None, :, :]).sum(-1, dtype=jnp.int32)
+    fuses with neighbours (~10ms). int8 storage quarters the HBM slab the
+    predict walk re-reads every level (r5: the big-data path already
+    staged int8; the in-core path now matches)."""
+    b = (X[:, :, None] >= edges[None, :, :]).sum(-1, dtype=jnp.int32)
+    if edges.shape[-1] + 1 <= 127:
+        return b.astype(jnp.int8)
+    return b
 
 
 def _select_bin(Xb: jnp.ndarray, feat_idx: jnp.ndarray) -> jnp.ndarray:
     """Per-row feature selection Xb[r, feat_idx[r]] as a masked reduction.
     `take_along_axis` lowers to a serialized row gather on TPU; the one-hot
-    compare fuses into a single VPU pass over (n, d)."""
+    compare fuses into a single VPU pass over (n, d). Accepts int8 or
+    int32 bins; the selected value widens to int32 for the split compare."""
     d = Xb.shape[-1]
     onehot = jnp.arange(d, dtype=jnp.int32)[None, :] == feat_idx[:, None]
-    return jnp.where(onehot, Xb, 0).sum(axis=1)
+    return jnp.where(onehot, Xb, jnp.zeros((), Xb.dtype)).sum(
+        axis=1, dtype=jnp.int32)
 
 
 # --------------------------------------------------------------------------- #
@@ -195,6 +202,10 @@ def split_from_histograms(hg, hh, n_bins: int, reg_lambda,
     return bf, bb
 
 
+# Depth at which sibling subtraction starts paying (see grow_tree doc)
+_SUBTRACT_MIN_DEPTH = 12
+
+
 def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
               max_depth: int, n_bins: int, reg_lambda: float = 1.0,
               min_child_weight: float = 1.0, min_gain: float = 0.0,
@@ -213,6 +224,20 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     the padded tree predicts exactly like a tree grown to that depth — this
     lets the sweep engine vmap a {max_depth: 3, 6, 12} grid in ONE compiled
     program padded to 12 instead of one compile per depth.
+
+    Deep trees (max_depth ≥ `_SUBTRACT_MIN_DEPTH`) use HISTOGRAM
+    SUBTRACTION — the standard XGBoost/LightGBM hist trick: per level,
+    compute histograms only for rows routed RIGHT (grouped by parent)
+    and derive the left child as parent − right. This halves the
+    histogram-matmul A-side columns and FLOPs; r5 measured it only pays
+    off once per-level matmuls span multiple MXU output tiles (90k×55:
+    depth 12 58→39 ms/tree, but depth ≤ 10 is bound by streaming the bin
+    one-hot operand, where fewer output columns save nothing and the
+    interleave overhead loses ~10%) — hence the depth gate. Left-child
+    histograms then carry bf16-quantization error from the subtraction,
+    which can flip near-tie splits exactly like the documented
+    HIST_PRECISION tradeoff (individual trees change, metric quality
+    does not).
     """
     n, d = Xb.shape
     m = G.shape[1]
@@ -222,22 +247,36 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     bins = jnp.full((max_depth, max_nodes), n_bins, jnp.int32)  # n_bins = "no split"
     if B is None:
         B = bins_onehot(Xb, n_bins)
+    subtract = max_depth >= _SUBTRACT_MIN_DEPTH
+    if subtract:
+        hg, hh = _histograms(B, node_idx, G, H, 1)
 
     for level in range(max_depth):
         n_nodes = 2 ** level
-        hg, hh = _histograms(B, node_idx, G, H, n_nodes)
+        if not subtract:
+            hg, hh = _histograms(B, node_idx, G, H, n_nodes)
         bf, bb = split_from_histograms(
             hg, hh, n_bins, reg_lambda, min_child_weight, min_gain,
             min_gain_norm, feature_mask, level, active_depth)
         feats = feats.at[level, :n_nodes].set(bf)
         bins = bins.at[level, :n_nodes].set(bb)
-        if n_nodes <= 256:
+        if n_nodes <= _ONEHOT_LOOKUP_MAX:
             sample_feat, split_bin = _table_lookup2(bf, bb, node_idx)
         else:
             sample_feat, split_bin = bf[node_idx], bb[node_idx]
         sample_bin = _select_bin(Xb, sample_feat)
         go_right = sample_bin > split_bin
         node_idx = node_idx * 2 + go_right.astype(jnp.int32)
+        if subtract and level + 1 < max_depth:
+            right = go_right.astype(jnp.float32)
+            hg_r, hh_r = _histograms(B, node_idx >> 1, G * right[:, None],
+                                     H * right, n_nodes)
+            # interleave children: node k → (left 2k = parent − right,
+            # right 2k+1)
+            hg = jnp.stack([hg - hg_r, hg_r], axis=2).reshape(
+                m, 2 * n_nodes, d, n_bins)
+            hh = jnp.stack([hh - hh_r, hh_r], axis=1).reshape(
+                2 * n_nodes, d, n_bins)
 
     leaf_g = jnp.zeros((max_nodes, m), G.dtype).at[node_idx].add(G)
     leaf_h = jnp.zeros((max_nodes,), H.dtype).at[node_idx].add(H)
@@ -247,9 +286,19 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     return {"feat": feats, "bin": bins, "leaf": leaf}
 
 
+# One-hot table lookups beat (n,)-indexed TPU gathers far beyond the 256
+# entries r2 measured: r5 re-measured the depth-10 164-tree predict at
+# 100k rows — each (100k,)-row gather costs ~1 ms (level-9 f/b tables +
+# the leaf read were 490 ms of the 604 ms total), while the generated
+# (n, w) compare+select fuses into one VPU pass (~0.3 ms at w=512).
+# Above this width the linear (n·w) one-hot pass finally loses to the
+# constant-time gather again.
+_ONEHOT_LOOKUP_MAX = 2048
+
+
 def _table_lookup2(ta: jnp.ndarray, tb: jnp.ndarray,
                    node: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(ta[node], tb[node]) for small per-level tables: one fused one-hot
+    """(ta[node], tb[node]) for per-level tables: one fused one-hot
     pass instead of two serialized TPU gathers (the dominant cost of tree
     prediction at 100k rows was exactly these (n,)-indexed table reads)."""
     width = ta.shape[0]
@@ -258,14 +307,31 @@ def _table_lookup2(ta: jnp.ndarray, tb: jnp.ndarray,
             jnp.where(oh, tb[None, :], 0).sum(1))
 
 
-def predict_tree(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
-    """(n, m) leaf values for binned samples."""
+def _leaf_lookup(col: jnp.ndarray, node: jnp.ndarray) -> jnp.ndarray:
+    """col[node] for one (width,) f32 leaf column as a fused one-hot
+    masked sum — replacing the per-tree leaf gather (~1 ms per 100k rows
+    on the tunnel) with a generated VPU pass. Adding exact 0.0s keeps the
+    selected value bit-identical to the gather. A single leaf pass
+    amortizes its compare over one select (the walk's `_table_lookup2`
+    amortizes over two), so its crossover sits a factor higher than
+    `_ONEHOT_LOOKUP_MAX`; beyond that the linear (n·width) pass loses to
+    the constant-time gather (pad depth 14 → 16384-wide leaf tables)."""
+    width = col.shape[0]
+    if width > 2 * _ONEHOT_LOOKUP_MAX:
+        return col[node]
+    oh = jnp.arange(width, dtype=jnp.int32)[None, :] == node[:, None]
+    return jnp.where(oh, col[None, :], 0.0).sum(1)
+
+
+def _tree_walk(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
+    """(n,) leaf index for binned samples — the shared routing walk.
+    Gather-free at every level up to `_ONEHOT_LOOKUP_MAX`-wide tables."""
     n = Xb.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
     depth = tree["feat"].shape[0]
     for level in range(depth):
         n_nodes = 2 ** level
-        if n_nodes <= 256:  # one-hot beats gather up to a few hundred nodes
+        if n_nodes <= _ONEHOT_LOOKUP_MAX:
             f, b = _table_lookup2(tree["feat"][level][:n_nodes],
                                   tree["bin"][level][:n_nodes], node)
         else:
@@ -273,7 +339,18 @@ def predict_tree(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
             b = tree["bin"][level][node]
         sample_bin = _select_bin(Xb, f)
         node = node * 2 + (sample_bin > b).astype(jnp.int32)
-    return tree["leaf"][node]
+    return node
+
+
+def predict_tree(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
+    """(n, m) leaf values for binned samples."""
+    node = _tree_walk(tree, Xb)
+    m = tree["leaf"].shape[-1]
+    # per-class masked sums instead of one (n, m) row gather: the gather
+    # serializes AND its m-minor output tile-pads to 128 lanes; the class
+    # count is small and static, so m fused (n, width) passes win
+    return jnp.stack([_leaf_lookup(tree["leaf"][:, c], node)
+                      for c in range(m)], axis=-1)
 
 
 def predict_tree_dense(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
@@ -390,15 +467,15 @@ def _predict_trees_sum(trees: Dict, Xb: jnp.ndarray,
                        chunk: int = _PREDICT_TREE_CHUNK) -> jnp.ndarray:
     """Σ_t predict_tree(t, Xb) as a scan of vmapped tree chunks.
 
-    A plain vmap-then-sum materializes the full (T, n, m) per-tree
-    score tensor; with the tiny class axis minor it tile-pads to 128
-    lanes — at sweep widths that one fusion output is tens of GB (the
-    r4 RF family drop: 8 pairs × 50 trees × 90k rows × pad-128 f32 =
-    18.4 GB). A tree-at-a-time scan bounds memory but serializes the
-    per-tree gathers (~2× slower fused scoring). The hybrid vmaps
-    `_PREDICT_TREE_CHUNK` trees per scan step: live memory is one
-    chunk's (c, n, m→128) slab, throughput stays near the vmap's.
-    Zero-padded trees (all-zero leaves) contribute nothing."""
+    Per-tree scores accumulate CLASS-MAJOR (m, n): with the big row axis
+    minor, nothing tile-pads the tiny class axis to 128 lanes (a plain
+    vmap-then-sum of (c, n, m) slabs padded m→128 was the r4 RF family
+    drop: 8 pairs × 50 trees × 90k rows × pad-128 f32 = 18.4 GB). The
+    scan over `chunk`-tree vmapped steps bounds live memory to one
+    chunk's generated one-hot passes while keeping per-tree parallelism.
+    Zero-padded trees (all-zero leaves) contribute nothing. The single
+    (m, n) → (n, m) transpose at the end materializes one lane-padded
+    (n, m→128) output — the shape every caller consumes anyway."""
     n_trees = jax.tree_util.tree_leaves(trees)[0].shape[0]
     m = trees["leaf"].shape[-1]
     c = min(max(1, int(chunk)), n_trees)
@@ -411,12 +488,44 @@ def _predict_trees_sum(trees: Dict, Xb: jnp.ndarray,
     chunked = jax.tree.map(
         lambda a: a.reshape(n_chunks, c, *a.shape[1:]), trees)
 
+    def per_tree(t):  # (m, n) class-major leaf values
+        node = _tree_walk(t, Xb)
+        return jnp.stack([_leaf_lookup(t["leaf"][:, cl], node)
+                          for cl in range(m)], axis=0)
+
     def body(acc, tc):
-        return acc + jax.vmap(
-            lambda t: predict_tree(t, Xb))(tc).sum(axis=0), None
+        return acc + jax.vmap(per_tree)(tc).sum(axis=0), None
 
     acc, _ = jax.lax.scan(
-        body, jnp.zeros((Xb.shape[0], m), jnp.float32), chunked)
+        body, jnp.zeros((m, Xb.shape[0]), jnp.float32), chunked)
+    return acc.T
+
+
+def _predict_trees_margin(trees: Dict, Xb: jnp.ndarray,
+                          chunk: int = 64) -> jnp.ndarray:
+    """Σ_t leaf value of tree t, single-output specialization: the (n,)
+    accumulator + gather-free walk is the streaming-scorer hot path
+    (r5: 604 → ~123 ms for the 164-tree depth-10 winner at 100k rows —
+    the removed (100k,) row gathers cost ~1 ms EACH on the tunnel)."""
+    n_trees = jax.tree_util.tree_leaves(trees)[0].shape[0]
+    c = min(max(1, int(chunk)), n_trees)
+    n_chunks = -(-n_trees // c)
+    pad = n_chunks * c - n_trees
+    if pad:
+        trees = jax.tree.map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros_like(a[:pad])]), trees)
+    chunked = jax.tree.map(
+        lambda a: a.reshape(n_chunks, c, *a.shape[1:]), trees)
+
+    def per_tree(t):
+        return _leaf_lookup(t["leaf"][:, 0], _tree_walk(t, Xb))
+
+    def body(acc, tc):
+        return acc + jax.vmap(per_tree)(tc).sum(axis=0), None
+
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros((Xb.shape[0],), jnp.float32), chunked)
     return acc
 
 
@@ -511,7 +620,8 @@ def _gbt_scan(Xb, y, w, val_w, margin0, best0, since0, keys,
         if esr > 0:
             live = (since < esr).astype(jnp.float32)
             tree["leaf"] = tree["leaf"] * live
-        margin = margin + learning_rate * predict_tree(tree, Xb)[:, 0]
+        margin = margin + learning_rate * _leaf_lookup(
+            tree["leaf"][:, 0], _tree_walk(tree, Xb))
         if esr > 0:
             m = _gbt_val_loss(margin, y, val_w, objective, eval_metric)
             improved = m < best - 1e-7
@@ -670,7 +780,8 @@ def fit_gbt_multiclass(Xb, y, w, n_estimators: int, max_depth: int,
                              active_depth=active_depth, alpha=alpha, B=B)
 
         trees_k = jax.vmap(per_class, in_axes=(1, 1))(G, Hs)  # (K, ...)
-        upd = jax.vmap(lambda t: predict_tree(t, Xb)[:, 0])(trees_k)  # (K, n)
+        upd = jax.vmap(lambda t: _leaf_lookup(
+            t["leaf"][:, 0], _tree_walk(t, Xb)))(trees_k)  # (K, n)
         return margin + learning_rate * upd.T, trees_k
 
     keys = jax.random.split(jax.random.PRNGKey(seed), n_estimators)
@@ -684,7 +795,8 @@ def predict_gbt_multiclass_margin(trees: Dict, Xb: jnp.ndarray,
                                   learning_rate) -> jnp.ndarray:
     """(n, K) margin from (T, K, ...) stacked round trees."""
     per_round = jax.vmap(         # over rounds
-        jax.vmap(lambda t: predict_tree(t, Xb)[:, 0]))(trees)  # (T, K, n)
+        jax.vmap(lambda t: _leaf_lookup(
+            t["leaf"][:, 0], _tree_walk(t, Xb))))(trees)  # (T, K, n)
     return learning_rate * per_round.sum(axis=0).T
 
 
@@ -697,7 +809,7 @@ def gbt_multiclass_pred_from_margin(margin: jnp.ndarray) -> Dict:
 @partial(jax.jit, static_argnames=("chunk",))
 def predict_gbt_margin(trees: Dict, Xb: jnp.ndarray, learning_rate,
                        chunk: int = 64) -> jnp.ndarray:
-    return learning_rate * _predict_trees_sum(trees, Xb, chunk)[:, 0]
+    return learning_rate * _predict_trees_margin(trees, Xb, chunk)
 
 
 # --------------------------------------------------------------------------- #

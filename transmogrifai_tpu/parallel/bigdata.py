@@ -341,6 +341,68 @@ def _chunked_leaf_sums(node_idx, V, n_nodes: int, chunk: int):
     return acc
 
 
+def _chunked_histograms_multi(Xb, node_K, V_K, n_nodes: int, n_bins: int,
+                              chunk: int):
+    """(K, p, nodes, d, bins) f32 histograms for K LOCKSTEP learners from
+    ONE bin one-hot build per row chunk.
+
+    The r5 cost measurement (see `grow_trees_big_lockstep`) showed the
+    per-chunk cost of the histogram matmul is FLAT in the number of
+    histogram rows up to several hundred (the MXU pads the output M axis
+    to the 128-row tile; streaming the (chunk, d·bins) one-hot operand is
+    the floor). Growing K learners level-synchronized therefore amortizes
+    the dominant one-hot cost K-fold: the A side stacks every learner's
+    node-indicator × value columns into one (chunk, K·p·nodes) operand.
+
+    node_K: (K, n) int32 per-learner node assignment; V_K: (K, n, p)
+    value columns (gradient cols + weight col — bf16 is enough: the
+    matmul quantizes operands to bf16 anyway, matching `_histograms`'s
+    documented precision contract)."""
+    n, d = Xb.shape
+    K, _, p = V_K.shape
+    n_chunks = n // chunk
+    Xb_r = Xb.reshape(n_chunks, chunk, d)
+    nK_r = jnp.transpose(node_K.reshape(K, n_chunks, chunk), (1, 0, 2))
+    V_r = jnp.transpose(V_K.reshape(K, n_chunks, chunk, p), (1, 0, 2, 3))
+
+    def body(acc, args):
+        xb_c, ni_c, v_c = args      # (c, d), (K, c), (K, c, p)
+        B = jax.nn.one_hot(xb_c, n_bins,
+                           dtype=jnp.bfloat16).reshape(chunk, d * n_bins)
+        # joint A operand (c, K·p·nodes): per-row, K·p nonzeros
+        oh = (jnp.transpose(ni_c)[:, :, None]
+              == jnp.arange(n_nodes, dtype=jnp.int32)[None, None, :]
+              )                                        # (c, K, nodes)
+        vt = jnp.transpose(v_c, (1, 0, 2)).astype(jnp.bfloat16)  # (c, K, p)
+        Av = (oh[:, :, None, :].astype(jnp.bfloat16)
+              * vt[:, :, :, None]).reshape(chunk, K * p * n_nodes)
+        h = jnp.matmul(Av.T, B, preferred_element_type=jnp.float32)
+        return acc + h.reshape(K, p, n_nodes, d, n_bins), None
+
+    acc0 = jnp.zeros((K, p, n_nodes, d, n_bins), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (Xb_r, nK_r, V_r))
+    return acc
+
+
+def _chunked_leaf_sums_multi(node_K, V_K, n_nodes: int, chunk: int):
+    """(K, nodes, p) per-learner leaf sums, one pass over the rows."""
+    K, n, p = V_K.shape
+    n_chunks = n // chunk
+    nK_r = jnp.transpose(node_K.reshape(K, n_chunks, chunk), (1, 0, 2))
+    V_r = jnp.transpose(V_K.reshape(K, n_chunks, chunk, p), (1, 0, 2, 3))
+
+    def body(acc, args):
+        ni_c, v_c = args
+        A = jax.nn.one_hot(ni_c, n_nodes, dtype=jnp.bfloat16)  # (K, c, nodes)
+        h = jnp.einsum("kcn,kcp->knp", A, v_c.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+        return acc + h, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((K, n_nodes, p), jnp.float32),
+                          (nK_r, V_r))
+    return acc
+
+
 def _select_bin_big(Xb: jnp.ndarray, feat_idx: jnp.ndarray) -> jnp.ndarray:
     """Xb[r, feat_idx[r]] as a fused compare+reduce (elementwise over the
     int8 matrix; XLA fuses the one-hot into the reduction, nothing
@@ -376,12 +438,12 @@ def grow_tree_big(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
             min_gain_norm, feature_mask, level, None)
         feats = feats.at[level, :n_nodes].set(bf)
         bins = bins.at[level, :n_nodes].set(bb)
-        sample_feat = bf[node_idx] if n_nodes > 256 else None
-        if sample_feat is None:
-            from transmogrifai_tpu.models.trees import _table_lookup2
+        from transmogrifai_tpu.models.trees import (
+            _ONEHOT_LOOKUP_MAX, _table_lookup2)
+        if n_nodes <= _ONEHOT_LOOKUP_MAX:
             sample_feat, split_bin = _table_lookup2(bf, bb, node_idx)
         else:
-            split_bin = bb[node_idx]
+            sample_feat, split_bin = bf[node_idx], bb[node_idx]
         sample_bin = _select_bin_big(Xb, sample_feat)
         node_idx = node_idx * 2 + (sample_bin > split_bin).astype(jnp.int32)
 
@@ -391,47 +453,148 @@ def grow_tree_big(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     return {"feat": feats, "bin": bins, "leaf": leaf}
 
 
-@partial(jax.jit, static_argnames=("max_depth", "n_bins",
-                                   "chunk", "bootstrap", "n_sub"))
-def _forest_trees_big(Xb, Y, w, keys, max_depth: int, n_bins: int,
-                      min_child_weight=1.0, min_gain=0.0,
-                      n_sub: Optional[int] = None, bootstrap: bool = True,
-                      chunk: int = HIST_CHUNK_ROWS):
-    """Grow keys.shape[0] trees SEQUENTIALLY inside one program
-    (`lax.scan` over per-tree keys): one tunnel dispatch (~0.7s RPC)
-    amortizes over the whole batch while peak memory stays one tree's
-    working set."""
+@partial(jax.jit, static_argnames=("max_depth", "n_bins", "chunk"))
+def grow_trees_big_lockstep(Xb, V_K, max_depth: int, n_bins: int,
+                            reg_lambda=1.0, min_child_weight=1.0,
+                            min_gain=0.0, min_gain_norm=0.0,
+                            feature_mask_K: Optional[jnp.ndarray] = None,
+                            chunk: int = HIST_CHUNK_ROWS) -> Dict:
+    """Grow K trees LEVEL-SYNCHRONIZED, sharing each chunk's bin one-hot.
+
+    r5 measurement (65536×500×32 chunk, v5e): one histogram matmul costs
+    ~17-24 ms per chunk whether it produces 2 histogram rows or 514 —
+    the (chunk, d·bins) one-hot operand stream is the floor, so a single
+    tree wastes ~98% of the M axis. Growing the whole lockstep batch
+    against one B build amortizes that floor K-fold (6.5 s/tree →
+    ~1 s/tree at K=8, the r4 VERDICT #2 target). The per-learner value
+    columns V_K (K, n, m+1) carry [G·, H] (gradients/labels × bootstrap
+    weights, then the weight column); trees may differ in bootstrap
+    weights (RF), gradients (GBT fold pairs), and feature masks.
+
+    Returns {"feat": (K, depth, 2^depth), "bin": ..., "leaf":
+    (K, 2^depth, m)} — `fit_forest`-shaped stacked arrays."""
+    from transmogrifai_tpu.models.trees import (
+        _ONEHOT_LOOKUP_MAX, _table_lookup2)
+    n, d = Xb.shape
+    K, _, p = V_K.shape
+    m = p - 1
+    max_nodes = 2 ** max_depth
+    node_K = jnp.zeros((K, n), dtype=jnp.int32)
+    feats = jnp.zeros((K, max_depth, max_nodes), jnp.int32)
+    bins = jnp.full((K, max_depth, max_nodes), n_bins, jnp.int32)
+
+    def split_k(hg, hh, fmask, level):
+        return split_from_histograms(
+            hg, hh, n_bins, reg_lambda, min_child_weight, min_gain,
+            min_gain_norm, fmask, level, None)
+
+    for level in range(max_depth):
+        n_nodes = 2 ** level
+        hist = _chunked_histograms_multi(Xb, node_K, V_K, n_nodes,
+                                         n_bins, chunk)
+        hg_K, hh_K = hist[:, :m], hist[:, m]
+        if feature_mask_K is None:
+            bf_K, bb_K = jax.vmap(split_k, in_axes=(0, 0, None, None))(
+                hg_K, hh_K, None, level)
+        else:
+            bf_K, bb_K = jax.vmap(split_k, in_axes=(0, 0, 0, None))(
+                hg_K, hh_K, feature_mask_K, level)
+        feats = feats.at[:, level, :n_nodes].set(bf_K)
+        bins = bins.at[:, level, :n_nodes].set(bb_K)
+
+        def route(args):
+            bf, bb, node = args
+            if n_nodes <= _ONEHOT_LOOKUP_MAX:
+                sf, sb_ = _table_lookup2(bf, bb, node)
+            else:
+                sf, sb_ = bf[node], bb[node]
+            sample_bin = _select_bin_big(Xb, sf)
+            return node * 2 + (sample_bin > sb_).astype(jnp.int32)
+
+        # lax.map (not vmap): a vmapped (K, n, d) one-hot select would
+        # gamble on full fusion of a 40 GB intermediate at 10M rows; the
+        # sequential per-learner pass is a bounded (n, d) VPU stream
+        node_K = jax.lax.map(route, (bf_K, bb_K, node_K))
+
+    sums = _chunked_leaf_sums_multi(node_K, V_K, max_nodes, chunk)
+    leaf_g, leaf_h = sums[:, :, :m], sums[:, :, m]
+    leaf = leaf_g / (leaf_h + reg_lambda)[:, :, None]
+    return {"feat": feats, "bin": bins, "leaf": leaf}
+
+
+# r5-measured per-chunk histogram-matmul floor: ~8 ms for one
+# (65536, 500·32) one-hot operand stream (v5e), scaling with the operand
+# width; cost stays flat until the matmul's output M axis (K·p·nodes
+# rows) exceeds ~512, then grows roughly linearly with M tiles.
+_CHUNK_FLOOR_S = 0.008
+_FLAT_M_ROWS = 512.0
+
+
+def lockstep_dispatch_estimate_s(n: int, d: int, n_bins: int,
+                                 max_depth: int, K: int, p: int,
+                                 chunk: int = HIST_CHUNK_ROWS) -> float:
+    """Wall-clock model for one lockstep batch dispatch: per level, every
+    row chunk pays the one-hot stream floor times the M-tile factor."""
+    n_chunks = -(-n // chunk)
+    per_chunk = _CHUNK_FLOOR_S * (d * n_bins) / 16000.0
+    total = sum(max(1.0, K * p * (2.0 ** level) / _FLAT_M_ROWS)
+                for level in range(max_depth)) * n_chunks * per_chunk
+    return total * 1.2  # routing + leaf passes ride on top (~20%)
+
+
+def lockstep_width(max_depth: int, d: int, n_bins: int, m: int,
+                   requested: int, n: Optional[int] = None,
+                   target_s: float = 20.0) -> int:
+    """How many lockstep learners per dispatch: bound the deepest level's
+    carried histogram (K·(m+1)·2^(depth-1)·d·bins f32) to ~800 MB AND —
+    when the row count is known — bound the modeled dispatch wall-clock
+    to `target_s` (the serving layer kills single executions past ~60s;
+    deep levels leave the flat-cost regime, so K must shrink with
+    depth). A deep-enough single tree can exceed the target by itself;
+    K=1 then matches the pre-lockstep behavior."""
+    budget_elems = 2e8  # ~800 MB f32 carried histogram
+    per_learner = (m + 1) * (2 ** (max_depth - 1)) * d * n_bins
+    k_mem = max(1, int(budget_elems // max(per_learner, 1)))
+    k = max(1, min(requested, k_mem, 16))
+    if n is not None:
+        while k > 1 and lockstep_dispatch_estimate_s(
+                n, d, n_bins, max_depth, k, m + 1) > target_s:
+            k -= 1
+    return k
+
+
+@partial(jax.jit, static_argnames=("max_depth", "n_bins", "chunk",
+                                   "bootstrap", "n_sub"))
+def _forest_lockstep_batch(Xb, Y, w, keys, max_depth: int, n_bins: int,
+                           min_child_weight, min_gain,
+                           n_sub: Optional[int], bootstrap: bool,
+                           chunk: int):
+    """One lockstep batch of keys.shape[0] bootstrap trees: per-tree
+    Poisson weights and feature masks drawn in-program, value columns
+    [Y·boot, boot] stacked bf16 (the histogram matmul quantizes to bf16
+    regardless — see `_histograms`'s precision contract)."""
     n, d = Xb.shape
 
-    def one_tree(_, key):
+    def inputs(key):
         k1, k2 = jax.random.split(key)
         if bootstrap:
             boot = jax.random.poisson(k1, 1.0, (n,)).astype(jnp.float32) * w
         else:
             boot = w
-        fmask = None
+        V = jnp.concatenate([Y * boot[:, None], boot[:, None]],
+                            axis=1).astype(jnp.bfloat16)
         if n_sub is not None and n_sub < d:
             scores = jax.random.uniform(k2, (d,))
             fmask = scores <= jnp.sort(scores)[n_sub - 1]
-        tree = grow_tree_big(Xb, Y * boot[:, None], boot, max_depth,
-                             n_bins, reg_lambda=1e-6,
-                             min_child_weight=min_child_weight,
-                             min_gain_norm=min_gain, feature_mask=fmask,
-                             chunk=chunk)
-        return None, tree
+        else:
+            fmask = jnp.ones((d,), bool)
+        return V, fmask
 
-    _, trees = jax.lax.scan(one_tree, None, keys)
-    return trees
-
-
-def forest_trees_per_dispatch(n: int, d: int, max_depth: int, n_bins: int,
-                              target_s: float = 20.0) -> int:
-    """How many trees fit one dispatch under the serving exec ceiling,
-    from the sweep engine's measured tree cost model."""
-    from transmogrifai_tpu.parallel.sweep import _sec_per_unit
-    units = float(n) * (2 ** min(max_depth, 14)) * d * n_bins
-    est = max(units * _sec_per_unit("forest"), 1e-3)
-    return max(1, int(target_s / est))
+    V_K, fm_K = jax.vmap(inputs)(keys)
+    return grow_trees_big_lockstep(
+        Xb, V_K, max_depth, n_bins, reg_lambda=1e-6,
+        min_child_weight=min_child_weight, min_gain_norm=min_gain,
+        feature_mask_K=fm_K, chunk=chunk)
 
 
 def fit_forest_big(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
@@ -441,29 +604,32 @@ def fit_forest_big(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
                    bootstrap: bool = True,
                    chunk: int = HIST_CHUNK_ROWS,
                    trees_per_dispatch: Optional[int] = None) -> Dict:
-    """Host loop dispatching `trees_per_dispatch`-tree scan programs —
-    no single execution can hit the ~60s serving kill, and the per-
-    dispatch RPC amortizes over the batch. Returns stacked (T, ...)
-    tree arrays like `fit_forest`. (`n_outputs` is accepted for
-    `fit_forest` signature parity; the output width comes from Y's
-    trailing dim.)"""
+    """Host loop dispatching LOCKSTEP tree batches (r5): each dispatch
+    grows `trees_per_dispatch` trees level-synchronized against shared
+    per-chunk bin one-hots — the dominant out-of-core histogram cost
+    amortizes across the batch (~6.5 s/tree alone → ~1 s/tree at K=8;
+    see `grow_trees_big_lockstep`). No single execution can hit the ~60s
+    serving kill. Returns stacked (T, ...) tree arrays like
+    `fit_forest`. (`n_outputs` is accepted for `fit_forest` signature
+    parity; the output width comes from Y's trailing dim.)"""
     n, d = int(Xb.shape[0]), int(Xb.shape[1])
     n_sub = max(int(np.sqrt(d)), 1) if subsample_features else None
-    if trees_per_dispatch is None:
-        trees_per_dispatch = forest_trees_per_dispatch(
-            n, d, max_depth, n_bins)
-    from transmogrifai_tpu.models.trees import _pick_rounds_per_dispatch
-    # divisor-friendly batch → one compiled scan length (no tail compile)
-    tpd = _pick_rounds_per_dispatch(
-        n_trees, max(1, min(trees_per_dispatch, n_trees)))
-    keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+    m = int(Y.shape[1])
+    K = lockstep_width(max_depth, d, n_bins, m,
+                       trees_per_dispatch or 16, n=n)
+    K = min(K, n_trees)
+    # pad the tree count up to a batch multiple (extra trees are grown
+    # and sliced off) so every dispatch reuses ONE compiled batch shape
+    n_batches = -(-n_trees // K)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_batches * K)
     parts = []
-    for t0 in range(0, n_trees, tpd):
-        ks = keys[t0:t0 + tpd]
-        parts.append(_forest_trees_big(
+    for b in range(n_batches):
+        ks = keys[b * K:(b + 1) * K]
+        parts.append(_forest_lockstep_batch(
             Xb, Y, w, ks, max_depth, n_bins,
             min_child_weight, min_gain, n_sub, bootstrap, chunk))
-    return jax.tree.map(lambda *a: jnp.concatenate(a), *parts)
+    trees = jax.tree.map(lambda *a: jnp.concatenate(a), *parts)
+    return jax.tree.map(lambda a: a[:n_trees], trees)
 
 
 @partial(jax.jit, static_argnames=("max_depth", "n_bins", "objective",
@@ -508,16 +674,71 @@ def fit_gbt_big(Xb, y, w, n_estimators: int, max_depth: int, n_bins: int,
     return jax.tree.map(lambda *a: jnp.stack(a), *trees), margin
 
 
+@partial(jax.jit, static_argnames=("max_depth", "n_bins", "objective",
+                                   "chunk"))
+def _gbt_round_big_lockstep(Xb, y, w_K, margin_K, max_depth: int,
+                            n_bins: int, learning_rate, reg_lambda,
+                            objective: str, min_child_weight=1.0,
+                            gamma=0.0, chunk: int = HIST_CHUNK_ROWS):
+    """One boosting round for K LOCKSTEP grid×fold pairs: each pair has
+    its own margin and row weights (fold masks), but every pair's
+    gradient histograms contract against the SAME per-chunk bin one-hot
+    (`grow_trees_big_lockstep`) — one round for a 6-pair CV sweep costs
+    ~the same as 1-2 single-pair rounds instead of 6 (r5)."""
+    if objective == "logistic":
+        p = jax.nn.sigmoid(margin_K)
+        g = (p - y[None, :]) * w_K
+        h = jnp.maximum(p * (1 - p), 1e-6) * w_K
+    else:
+        g = (margin_K - y[None, :]) * w_K
+        h = w_K
+    V_K = jnp.stack([-g, h], axis=-1).astype(jnp.bfloat16)  # (K, n, 2)
+    trees = grow_trees_big_lockstep(
+        Xb, V_K, max_depth, n_bins, reg_lambda=reg_lambda,
+        min_child_weight=min_child_weight, min_gain=gamma, chunk=chunk)
+
+    def upd(t):  # sequential per pair: bounded (n, d) routing streams
+        return predict_tree_big(t, Xb)[:, 0]
+
+    upd_K = jax.lax.map(upd, trees)
+    return margin_K + learning_rate * upd_K, trees
+
+
+def fit_gbt_big_lockstep(Xb, y, w_K, n_estimators: int, max_depth: int,
+                         n_bins: int, learning_rate, reg_lambda,
+                         objective: str = "logistic",
+                         min_child_weight: float = 1.0, gamma: float = 0.0,
+                         chunk: int = HIST_CHUNK_ROWS
+                         ) -> Tuple[Dict, jnp.ndarray]:
+    """Host loop over rounds for K lockstep pairs; returns
+    ({"feat": (T, K, ...), ...}, margins (K, n)). The caller picks K:
+    check `lockstep_dispatch_estimate_s(n, d, n_bins, max_depth, K, 2)`
+    stays well under the ~60s serving exec kill (deep rounds at 10M rows
+    may need the pair set split across two host loops)."""
+    n = Xb.shape[0]
+    K = int(w_K.shape[0])
+    margin_K = jnp.zeros((K, n), jnp.float32)
+    trees = []
+    for r in range(n_estimators):
+        margin_K, tree = _gbt_round_big_lockstep(
+            Xb, y, w_K, margin_K, max_depth, n_bins,
+            jnp.float32(learning_rate), jnp.float32(reg_lambda), objective,
+            min_child_weight, jnp.float32(gamma), chunk)
+        trees.append(tree)
+    return jax.tree.map(lambda *a: jnp.stack(a), *trees), margin_K
+
+
 def predict_tree_big(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
     """Routing over the int8 matrix — identical math to `predict_tree`,
-    with the fused compare-select for big n."""
-    from transmogrifai_tpu.models.trees import _table_lookup2
+    gather-free (one-hot table lookups + masked leaf sums, r5)."""
+    from transmogrifai_tpu.models.trees import (
+        _ONEHOT_LOOKUP_MAX, _leaf_lookup, _table_lookup2)
     n = Xb.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
     depth = tree["feat"].shape[0]
     for level in range(depth):
         n_nodes = 2 ** level
-        if n_nodes <= 256:
+        if n_nodes <= _ONEHOT_LOOKUP_MAX:
             f, b = _table_lookup2(tree["feat"][level][:n_nodes],
                                   tree["bin"][level][:n_nodes], node)
         else:
@@ -525,7 +746,9 @@ def predict_tree_big(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
             b = tree["bin"][level][node]
         sample_bin = _select_bin_big(Xb, f)
         node = node * 2 + (sample_bin > b).astype(jnp.int32)
-    return tree["leaf"][node]
+    m = tree["leaf"].shape[-1]
+    return jnp.stack([_leaf_lookup(tree["leaf"][:, c], node)
+                      for c in range(m)], axis=-1)
 
 
 @partial(jax.jit, static_argnames=())
