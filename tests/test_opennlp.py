@@ -2,10 +2,10 @@
 """OpenNLP binary model loader + decoders (VERDICT r3 #4 NER upgrade).
 
 The loader reads the PUBLIC Apache OpenNLP 1.5 model format; these tests
-exercise it against real trained models when a model directory is
-available (`TRANSMOGRIFAI_OPENNLP_DIR`, or the reference checkout's
-`models/src/main/resources/OpenNLP`), and always cover the format parser
-with a synthetic model."""
+exercise it against the PACKAGED models (`transmogrifai_tpu/resources/
+opennlp/`, discovered by default — r4 VERDICT #5: a standalone checkout
+with no env configuration runs real maxent decoding), and always cover
+the format parser with a synthetic model."""
 
 import io
 import os
@@ -16,14 +16,26 @@ import pytest
 
 from transmogrifai_tpu.utils.opennlp import (
     MaxentModel, NameFinder, SentenceDetector, TokenizerME, load_model,
-    token_class)
+    model_dir, token_class)
 
-_REF_DIR = "/root/reference/models/src/main/resources/OpenNLP"
-_DIR = os.environ.get("TRANSMOGRIFAI_OPENNLP_DIR") or (
-    _REF_DIR if os.path.isdir(_REF_DIR) else None)
+_DIR = model_dir()
 
 needs_models = pytest.mark.skipif(
     _DIR is None, reason="no OpenNLP model directory available")
+
+
+def test_packaged_models_discovered_without_env(monkeypatch):
+    """With TRANSMOGRIFAI_OPENNLP_DIR unset the packaged resources dir
+    is found — standalone deployments never silently fall back to
+    heuristics."""
+    monkeypatch.delenv("TRANSMOGRIFAI_OPENNLP_DIR", raising=False)
+    d = model_dir()
+    assert d is not None and d.endswith(os.path.join("resources", "opennlp"))
+    from transmogrifai_tpu.utils.opennlp import available_models
+    mods = available_models(d)
+    for key in ("en-sent", "en-token", "en-pos-perceptron",
+                "es-ner-person", "es-ner-location"):
+        assert key in mods, key
 
 
 def _path(name):

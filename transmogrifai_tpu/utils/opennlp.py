@@ -29,7 +29,11 @@ punctuation tokenization, multi-token person names).
 
 Model discovery: set `TRANSMOGRIFAI_OPENNLP_DIR` (or pass `model_dir`)
 to a directory of OpenNLP `.bin` files named like `en-sent.bin`,
-`en-token.bin`, `es-ner-person.bin`.
+`en-token.bin`, `es-ner-person.bin`; with nothing configured, the
+PACKAGED models under `transmogrifai_tpu/resources/opennlp/` (a curated
+subset of the Apache-licensed binaries the reference ships as its
+`models/` module) are used, so standalone deployments get real
+maxent/perceptron decoding by default (r4 VERDICT #5).
 """
 
 from __future__ import annotations
@@ -147,9 +151,18 @@ def load_model(path: str) -> MaxentModel:
         return _read_maxent(z.read(entry))
 
 
+_PACKAGED_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "resources", "opennlp")
+
+
 def model_dir() -> Optional[str]:
     d = os.environ.get("TRANSMOGRIFAI_OPENNLP_DIR")
-    return d if d and os.path.isdir(d) else None
+    if d and os.path.isdir(d):
+        return d
+    if os.path.isdir(_PACKAGED_DIR):
+        return _PACKAGED_DIR
+    return None
 
 
 def available_models(directory: Optional[str] = None) -> Dict[str, str]:
