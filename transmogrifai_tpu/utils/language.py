@@ -671,9 +671,17 @@ def detect_language(text: Optional[str]) -> Dict[str, float]:
             return {"ar": conf}
         if top == "hebrew":
             # Yiddish uses the Hebrew script with digraph letters (װ ײ ױ)
-            # and pointed alef (אַ אָ) that Modern Hebrew text lacks
+            # and pointed alef (אַ אָ) as ordinary letters. Pointed alef
+            # alone is NOT Yiddish evidence when the text carries the
+            # rest of the niqqud inventory (shva/hiriq/tsere/…): that is
+            # vocalized HEBREW (prayer books, children's text), which
+            # Yiddish orthography never uses
+            other_niqqud = sum(
+                text.count(c) for c in
+                "ְֱֲֳִֵֶֹֻ")
             if (sum(text.count(c) for c in "װײױ") >= 1
-                    or text.count("אַ") + text.count("אָ") >= 2):
+                    or (text.count("אַ") + text.count("אָ") >= 2
+                        and other_niqqud == 0)):
                 return {"yi": conf}
             return {"he": conf}
         if top == "devanagari":
