@@ -30,7 +30,7 @@ SCHEMA = {
 }
 
 
-def build_pipeline():
+def build_pipeline(models=None):
     crim = FeatureBuilder.RealNN("crim").from_column("crim").as_predictor()
     zn = FeatureBuilder.RealNN("zn").from_column("zn").as_predictor()
     indus = FeatureBuilder.RealNN("indus").from_column("indus").as_predictor()
@@ -51,13 +51,13 @@ def build_pipeline():
          lstat])
     checked = medv.sanity_check(features, remove_bad_features=True)
     prediction = RegressionModelSelector.with_train_validation_split(
-    ).set_input(medv, checked).get_output()
+        models=models).set_input(medv, checked).get_output()
     return medv, prediction
 
 
-def run(csv_path: str = DATA):
+def run(csv_path: str = DATA, models=None):
     ds = Dataset.from_csv(csv_path, schema=SCHEMA)
-    medv, prediction = build_pipeline()
+    medv, prediction = build_pipeline(models)
     model = (Workflow()
              .set_result_features(prediction, medv)
              .set_input_dataset(ds)

@@ -36,8 +36,10 @@ SCHEMA = {
 }
 
 
-def build_pipeline():
-    """Raw + derived features exactly as OpTitanicSimple.scala:102-134."""
+def build_pipeline(models=None):
+    """Raw + derived features exactly as OpTitanicSimple.scala:102-134.
+    `models` optionally overrides the default selector grids (the fast
+    parity smoke passes a 2-config grid)."""
     survived = FeatureBuilder.RealNN("survived").from_column("survived").as_response()
     pclass = FeatureBuilder.PickList("pClass").from_column("pClass").as_predictor()
     name = FeatureBuilder.Text("name").from_column("name").as_predictor()
@@ -64,13 +66,13 @@ def build_pipeline():
         family_size, estimated_cost, pivoted_sex, age_group, normed_age])
     checked = survived.sanity_check(features, remove_bad_features=True)
     prediction = BinaryClassificationModelSelector.with_train_validation_split(
-    ).set_input(survived, checked).get_output()
+        models=models).set_input(survived, checked).get_output()
     return survived, prediction
 
 
-def run(csv_path: str = DATA):
+def run(csv_path: str = DATA, models=None):
     ds = Dataset.from_csv(csv_path, schema=SCHEMA)
-    survived, prediction = build_pipeline()
+    survived, prediction = build_pipeline(models)
     model = (Workflow()
              .set_result_features(prediction, survived)
              .set_input_dataset(ds)
