@@ -473,9 +473,106 @@ def run_big(platform: str, payload: dict) -> None:
     W_np = [(fold_of != f) & (fold_of >= 0) for f in range(3)]
     V_np = [fold_of == f for f in range(3)]
 
-    # ---- linear family: full default 8-grid × 3-fold elastic-net sweep #
+    # ---- tree families FIRST (r5): the lockstep tree measurements are
+    # the round's headline; running them before the LR phase means an
+    # LR-side tunnel stall (r5 watched one 10M materialization hang for
+    # 15+ minutes) cannot eat the budget before they are captured ------ #
+    def _emit_extrapolation(lr3_s: float, rf_s: float, xgb_s: float,
+                            estimated_lr: bool) -> None:
+        payload["big_lr_estimated"] = estimated_lr
+        total = lr3_s + rf_s + xgb_s
+        payload["big_sweep84_extrapolated_s"] = round(total, 1)
+        # the sweep axis (grids × folds × trees) is embarrassingly
+        # parallel — the multichip dryrun proves grid-axis mesh sharding
+        # end to end — so the pod figure divides the single-chip
+        # extrapolation by the BASELINE "pod scale-out" chip count
+        payload["big_sweep84_pod256_extrapolated_s"] = round(total / 256.0, 1)
+
     t0 = time.time()
-    X16 = bd.device_matrix(store)
+    edges = store.quantile_edges(32)
+    rf_s = xgb_s = None
+    try:
+        # leave ≥180s of budget for the lockstep measurements themselves
+        Xb = bd.device_binned(
+            store, edges, deadline_s=max(_remaining() - 180.0, 60.0))
+    except TimeoutError as e:
+        payload["big_trees_skipped"] = f"bin upload too slow: {e}"
+        _emit(payload)
+        Xb = None  # fall through: the LR phase may still fit the budget
+    if Xb is not None:
+        jax.block_until_ready(Xb)
+        t_binned = time.time() - t0
+        payload["big_bin_upload_s"] = round(t_binned, 1)
+        Y1 = jax.nn.one_hot(y_dev.astype(jnp.int32), 2)
+        w_full = jnp.asarray(W_np[0], jnp.float32)
+
+        # LOCKSTEP measurement (r5): trees/pairs grow level-synchronized
+        # sharing each chunk's bin one-hot — the dominant out-of-core
+        # cost — so the honest per-tree figure is the amortized batch
+        # cost. Warm each program shape once so the measured per-unit
+        # costs are steady-state execution, not remote-AOT compile time;
+        # the K-tree batch is ONE compiled shape reused by the timed run.
+        RF_K = 16
+        np.asarray(bd.fit_forest_big(
+            Xb, Y1, w_full, RF_K, 6, 32, 2, seed=3,
+            trees_per_dispatch=RF_K)["leaf"])
+        t0 = time.time()
+        trees = bd.fit_forest_big(Xb, Y1, w_full, RF_K, 6, 32, 2, seed=3,
+                                  trees_per_dispatch=RF_K)
+        np.asarray(trees["leaf"])  # host materialization closes timing
+        per_tree_d6 = (time.time() - t0) / RF_K
+        payload["big_rf_tree_d6_s"] = round(per_tree_d6, 2)
+        payload["big_rf_lockstep_k"] = RF_K
+        _emit(payload)  # RF lockstep number driver-captured from here on
+
+        # GBT: the big-sweep shape is 2 XGB configs × 3 folds = 6 pairs;
+        # one lockstep round grows all 6 pair-trees vs shared one-hots
+        w6 = jnp.tile(w_full[None], (6, 1))
+        np.asarray(bd.fit_gbt_big_lockstep(
+            Xb, y_dev, w6, 1, 6, 32, 0.1, 1.0, "logistic")[1])
+        t0 = time.time()
+        _, margin = bd.fit_gbt_big_lockstep(
+            Xb, y_dev, w6, 2, 6, 32, 0.1, 1.0, "logistic")
+        np.asarray(margin)
+        round6_d6 = (time.time() - t0) / 2.0  # one 6-pair round
+        payload["big_gbt_round6p_d6_s"] = round(round6_d6, 2)
+        payload["big_gbt_round_d6_s"] = round(round6_d6 / 6.0, 2)
+
+        # level-cost model: a depth-D learner costs ≈ per_d6 · ΣD/Σ6
+        # where Σℓ = 2^ℓ − 1 node-levels (histogram work doubles per
+        # level). The full reference-shaped 84-fit sweep at 10M×500:
+        #   RF 54 fits × 50 trees, depth {3,6,12} — lockstep-amortized
+        #     per-tree cost (lockstep_width shrinks K for deep trees,
+        #     roughly offset by the flat-cost regime shallow levels
+        #     stay in)
+        #   XGB 6 fits × 200 rounds, depth 10 — ONE 6-pair lockstep
+        #     round per boosting round covers all 6 fits
+        #   LR 24 fits — measured below when the budget allows; until
+        #     then the r4-measured 66-86s range enters as 75s, flagged
+        #     estimated
+        def scale(depth):
+            return (2.0 ** depth - 1) / (2.0 ** 6 - 1)
+        rf_s = 18 * (scale(3) + scale(6) + scale(12)) * 50 * per_tree_d6
+        xgb_s = 200 * scale(10) * round6_d6
+        _emit_extrapolation(75.0, rf_s, xgb_s, estimated_lr=True)
+        del Xb, trees, margin
+        gc.collect()
+        _emit(payload)
+        note("tree families freed; uploading bf16")
+
+    # ---- linear family: full default 8-grid × 3-fold elastic-net sweep #
+    if _remaining() < 200:
+        payload["big_lr_skipped"] = f"{_remaining():.0f}s left (<200s)"
+        _emit(payload)
+        return
+    t0 = time.time()
+    try:
+        X16 = bd.device_matrix(
+            store, deadline_s=max(_remaining() - 150.0, 60.0))
+    except TimeoutError as e:
+        payload["big_lr_skipped"] = f"bf16 upload too slow: {e}"
+        _emit(payload)
+        return
     jax.block_until_ready(X16)
     t_upload = time.time() - t0
     payload["big_upload_bf16_s"] = round(t_upload, 1)
@@ -547,82 +644,15 @@ def run_big(platform: str, payload: dict) -> None:
     np.asarray(scores1[:, :1, 1])  # host materialization ends the timing
     t_score = time.time() - t0
     payload["big_score_rows_per_sec"] = round(n_rows / t_score, 1)
-    _emit(payload)  # LR phase is now driver-captured
+
+    # replace the estimated LR leg of the extrapolation with the
+    # measured one (scaled to 3 folds if the budget truncated; only
+    # when the tree phase ran — rf_s/xgb_s are None otherwise)
+    if folds_done and rf_s is not None:
+        _emit_extrapolation(t_lr_sweep * (3.0 / folds_done), rf_s, xgb_s,
+                            estimated_lr=False)
 
     del X16, winner, params, scores1
-    gc.collect()
-    note("linear family freed; binning")
-
-    # ---- tree families: measured slice + extrapolation ---------------- #
-    if _remaining() < 150:
-        payload["big_trees_skipped"] = f"{_remaining():.0f}s left (<150s)"
-        _emit(payload)
-        return
-    t0 = time.time()
-    edges = store.quantile_edges(32)
-    Xb = bd.device_binned(store, edges)
-    jax.block_until_ready(Xb)
-    t_binned = time.time() - t0
-    payload["big_bin_upload_s"] = round(t_binned, 1)
-    Y1 = jax.nn.one_hot(y_dev.astype(jnp.int32), 2)
-    w_full = jnp.asarray(W_np[0], jnp.float32)
-
-    # LOCKSTEP measurement (r5): trees/pairs grow level-synchronized
-    # sharing each chunk's bin one-hot — the dominant out-of-core cost —
-    # so the honest per-tree figure is the amortized batch cost. Warm
-    # each program shape once so the measured per-unit costs are
-    # steady-state execution, not remote-AOT compile time; the K-tree
-    # batch is ONE compiled shape reused by the timed run.
-    RF_K = 16
-    np.asarray(bd.fit_forest_big(
-        Xb, Y1, w_full, RF_K, 6, 32, 2, seed=3,
-        trees_per_dispatch=RF_K)["leaf"])
-    t0 = time.time()
-    trees = bd.fit_forest_big(Xb, Y1, w_full, RF_K, 6, 32, 2, seed=3,
-                              trees_per_dispatch=RF_K)
-    np.asarray(trees["leaf"])  # host materialization closes the timing
-    per_tree_d6 = (time.time() - t0) / RF_K
-    payload["big_rf_tree_d6_s"] = round(per_tree_d6, 2)
-    payload["big_rf_lockstep_k"] = RF_K
-
-    # GBT: the big-sweep shape is 2 XGB configs × 3 folds = 6 pairs; one
-    # lockstep round grows all 6 pair-trees against shared one-hots
-    w6 = jnp.tile(w_full[None], (6, 1))
-    np.asarray(bd.fit_gbt_big_lockstep(
-        Xb, y_dev, w6, 1, 6, 32, 0.1, 1.0, "logistic")[1])
-    t0 = time.time()
-    _, margin = bd.fit_gbt_big_lockstep(
-        Xb, y_dev, w6, 2, 6, 32, 0.1, 1.0, "logistic")
-    np.asarray(margin)
-    round6_d6 = (time.time() - t0) / 2.0  # one 6-pair round
-    payload["big_gbt_round6p_d6_s"] = round(round6_d6, 2)
-    payload["big_gbt_round_d6_s"] = round(round6_d6 / 6.0, 2)
-
-    # level-cost model: a depth-D learner costs ≈ per_d6 · ΣD/Σ6 where
-    # Σℓ = 2^ℓ − 1 node-levels (histogram work doubles per level). The
-    # full reference-shaped 84-fit default sweep at 10M×500:
-    #   RF 54 fits × 50 trees, depth {3,6,12} evenly — lockstep-amortized
-    #     per-tree cost (lockstep_width shrinks K for deep trees, roughly
-    #     offset by the flat-cost regime the shallow levels stay in)
-    #   XGB 6 fits × 200 rounds, depth 10 — ONE 6-pair lockstep round
-    #     per boosting round covers all 6 fits
-    #   LR 24 fits — measured directly above (scaled to 3 folds if the
-    #   budget truncated the measured fold count)
-    def scale(depth):
-        return (2.0 ** depth - 1) / (2.0 ** 6 - 1)
-    rf_s = 18 * (scale(3) + scale(6) + scale(12)) * 50 * per_tree_d6
-    xgb_s = 200 * scale(10) * round6_d6
-    lr3_s = t_lr_sweep * (3.0 / max(folds_done, 1))
-    sweep84_extrapolated = lr3_s + rf_s + xgb_s
-    # the sweep axis (grids × folds × trees) is embarrassingly parallel —
-    # the multichip dryrun proves grid-axis mesh sharding end to end —
-    # so the pod figure divides the single-chip extrapolation by the
-    # BASELINE "pod scale-out" chip count
-    payload["big_sweep84_extrapolated_s"] = round(sweep84_extrapolated, 1)
-    payload["big_sweep84_pod256_extrapolated_s"] = round(
-        sweep84_extrapolated / 256.0, 1)
-
-    del Xb, trees, margin
     gc.collect()
     _emit(payload)
 

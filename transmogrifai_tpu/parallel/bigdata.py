@@ -58,14 +58,25 @@ def _write_rows(buf, chunk, r0):
 
 
 def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
-                  chunk_rows: int = UPLOAD_CHUNK_ROWS) -> jnp.ndarray:
+                  chunk_rows: int = UPLOAD_CHUNK_ROWS,
+                  deadline_s: Optional[float] = None) -> jnp.ndarray:
     """Stream the store into one (n_pad, d) device buffer. Rows pad to a
     chunk multiple with zeros (weight-masked everywhere downstream).
-    Donation makes each write in-place: peak HBM = buffer + one chunk."""
+    Donation makes each write in-place: peak HBM = buffer + one chunk.
+
+    `deadline_s`: optional wall-clock budget — tunnel upload bandwidth
+    varies 100× between sessions (r4: 18-44 MB/s; r5 observed ~5 MB/s),
+    and an un-bounded upload can silently eat a benchmark's entire
+    budget. Past the deadline the loop raises TimeoutError for the
+    caller to turn into an explicit skip marker."""
     n_pad = _pad_rows(store.n_rows, chunk_rows)
     buf = jnp.zeros((n_pad, store.n_features), dtype)
     t0 = time.perf_counter()
     for r0, c in store.iter_chunks(chunk_rows):
+        if deadline_s is not None and time.perf_counter() - t0 > deadline_s:
+            raise TimeoutError(
+                f"device_matrix upload past {deadline_s:.0f}s deadline at "
+                f"row {r0}/{store.n_rows}")
         if len(c) < chunk_rows:  # pad the tail chunk to the static shape
             c = np.concatenate(
                 [c, np.zeros((chunk_rows - len(c), store.n_features),
@@ -86,18 +97,24 @@ def _bin_write_rows(buf, chunk_f16, edges, r0):
 
 
 def device_binned(store: ColumnarStore, edges: np.ndarray,
-                  chunk_rows: int = UPLOAD_CHUNK_ROWS) -> jnp.ndarray:
+                  chunk_rows: int = UPLOAD_CHUNK_ROWS,
+                  deadline_s: Optional[float] = None) -> jnp.ndarray:
     """(n_pad, d) int8 quantile-binned device buffer. Chunks upload as
     f16 and bin ON DEVICE (broadcast-compare, VPU): the r3 host
     `searchsorted` loop cost ~420 s at 10M×500 while re-shipping f16 and
     binning device-side costs one more ~50 s upload pass — transfer is
-    cheaper than host-side bin search at this scale."""
+    cheaper than host-side bin search at this scale. `deadline_s` as in
+    `device_matrix`."""
     d = store.n_features
     n_pad = _pad_rows(store.n_rows, chunk_rows)
     buf = jnp.zeros((n_pad, d), jnp.int8)
     edges_dev = jnp.asarray(edges)
     t0 = time.perf_counter()
     for r0, c in store.iter_chunks(chunk_rows):
+        if deadline_s is not None and time.perf_counter() - t0 > deadline_s:
+            raise TimeoutError(
+                f"device_binned upload past {deadline_s:.0f}s deadline at "
+                f"row {r0}/{store.n_rows}")
         if len(c) < chunk_rows:
             c = np.concatenate(
                 [c, np.zeros((chunk_rows - len(c), d), c.dtype)])

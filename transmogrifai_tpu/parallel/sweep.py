@@ -224,6 +224,15 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
             import time as _time
             prog = jax.jit(jax.vmap(one_pair))
             s = 0
+            # device-metric path: every chunk's output is a tiny (width,)
+            # metric vector, but each np.asarray costs a ~0.7s tunnel
+            # fetch RPC regardless of size (r4 measurement) — so chunks
+            # accumulate as DEVICE arrays and materialize in ONE fetch
+            # after the loop (r5, the sweep analogue of
+            # score_stream(fetch_group)). The host-metric fallback still
+            # fetches per chunk: it needs the full prediction pytree and
+            # bounding peak HBM to one chunk matters there.
+            pend: List[Tuple[int, int, Any]] = []  # (s, width, device out)
             while s < n_pairs:
                 ps = [min(s + t, n_pairs - 1) for t in range(width)]
                 gs = [p // n_folds for p in ps]
@@ -236,19 +245,18 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                     dt = _time.perf_counter() - t0
                 SWEEP_STATS.record((id(prog), static, width), dt,
                                    clean=span.clean)
-                out_np = jax.tree_util.tree_map(np.asarray, out)
-                for t in range(min(width, n_pairs - s)):
-                    row_i, j = divmod(s + t, n_folds)
-                    if metrics[idxs[row_i]] is None:
-                        metrics[idxs[row_i]] = [None] * n_folds  # type: ignore
-                    if host:
+                if host:
+                    out_np = jax.tree_util.tree_map(np.asarray, out)
+                    for t in range(min(width, n_pairs - s)):
+                        row_i, j = divmod(s + t, n_folds)
+                        if metrics[idxs[row_i]] is None:
+                            metrics[idxs[row_i]] = [None] * n_folds  # type: ignore
                         metrics[idxs[row_i]][j] = _metric(  # type: ignore
                             metric_fn.evaluator, y_np,
                             jax.tree_util.tree_map(
                                 lambda a, t=t: a[t], out_np), V_np[j])
-                    else:
-                        metrics[idxs[row_i]][j] = \
-                            float(out_np[t])  # type: ignore
+                else:
+                    pend.append((s, width, out))
                 s += width
                 if calibrate is not None and s < n_pairs:
                     new_w = max(1, min(calibrate(static, idxs, dt, width,
@@ -260,6 +268,17 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                         log.info("sweep dispatch width recalibrated "
                                  "%d -> %d (measured %.1fs)", width, new_w, dt)
                         width = new_w
+            if pend:
+                flat = np.asarray(jnp.concatenate(
+                    [jnp.asarray(o, jnp.float32) for _, _, o in pend]))
+                off = 0
+                for s0, w0, _ in pend:
+                    for t in range(min(w0, n_pairs - s0)):
+                        row_i, j = divmod(s0 + t, n_folds)
+                        if metrics[idxs[row_i]] is None:
+                            metrics[idxs[row_i]] = [None] * n_folds  # type: ignore
+                        metrics[idxs[row_i]][j] = float(flat[off + t])  # type: ignore
+                    off += w0
             return
 
         def one_cfg(d, fit_predict=fit_predict):
