@@ -499,6 +499,15 @@ def run_big(platform: str, payload: dict) -> None:
         payload["big_trees_skipped"] = f"bin upload too slow: {e}"
         _emit(payload)
         Xb = None  # fall through: the LR phase may still fit the budget
+    if Xb is not None and _remaining() < 120:
+        # the upload consumed the phase budget: skip the lockstep fits
+        # (warmup + timed batches need ~2 min) instead of overrunning
+        payload["big_trees_skipped"] = (
+            f"{_remaining():.0f}s left after bin upload (<120s)")
+        _emit(payload)
+        del Xb
+        gc.collect()
+        Xb = None
     if Xb is not None:
         jax.block_until_ready(Xb)
         t_binned = time.time() - t0
@@ -525,8 +534,24 @@ def run_big(platform: str, payload: dict) -> None:
         payload["big_rf_lockstep_k"] = RF_K
         _emit(payload)  # RF lockstep number driver-captured from here on
 
+        # level-cost model: a depth-D learner costs ≈ per_d6 · ΣD/Σ6
+        # where Σℓ = 2^ℓ − 1 node-levels (histogram work doubles per
+        # level); scale() feeds the 84-fit extrapolation below
+        def scale(depth):
+            return (2.0 ** depth - 1) / (2.0 ** 6 - 1)
+        rf_s = 18 * (scale(3) + scale(6) + scale(12)) * 50 * per_tree_d6
+
         # GBT: the big-sweep shape is 2 XGB configs × 3 folds = 6 pairs;
         # one lockstep round grows all 6 pair-trees vs shared one-hots
+        if _remaining() < 90:
+            payload["big_gbt_skipped"] = (
+                f"{_remaining():.0f}s left after RF lockstep (<90s)")
+            _emit_extrapolation(75.0, rf_s, 0.0, estimated_lr=True)
+            del Xb, trees
+            gc.collect()
+            _emit(payload)
+            note("tree families freed (GBT skipped)")
+            return
         w6 = jnp.tile(w_full[None], (6, 1))
         np.asarray(bd.fit_gbt_big_lockstep(
             Xb, y_dev, w6, 1, 6, 32, 0.1, 1.0, "logistic")[1])
@@ -538,9 +563,7 @@ def run_big(platform: str, payload: dict) -> None:
         payload["big_gbt_round6p_d6_s"] = round(round6_d6, 2)
         payload["big_gbt_round_d6_s"] = round(round6_d6 / 6.0, 2)
 
-        # level-cost model: a depth-D learner costs ≈ per_d6 · ΣD/Σ6
-        # where Σℓ = 2^ℓ − 1 node-levels (histogram work doubles per
-        # level). The full reference-shaped 84-fit sweep at 10M×500:
+        # The full reference-shaped 84-fit sweep at 10M×500:
         #   RF 54 fits × 50 trees, depth {3,6,12} — lockstep-amortized
         #     per-tree cost (lockstep_width shrinks K for deep trees,
         #     roughly offset by the flat-cost regime shallow levels
@@ -550,9 +573,6 @@ def run_big(platform: str, payload: dict) -> None:
         #   LR 24 fits — measured below when the budget allows; until
         #     then the r4-measured 66-86s range enters as 75s, flagged
         #     estimated
-        def scale(depth):
-            return (2.0 ** depth - 1) / (2.0 ** 6 - 1)
-        rf_s = 18 * (scale(3) + scale(6) + scale(12)) * 50 * per_tree_d6
         xgb_s = 200 * scale(10) * round6_d6
         _emit_extrapolation(75.0, rf_s, xgb_s, estimated_lr=True)
         del Xb, trees, margin

@@ -190,6 +190,21 @@ def _transformer_inventory():
                         for i in range(N)])),
         "PhoneIsValidTransformer": _mk(
             lambda: O.PhoneIsValidTransformer(), _phone()),
+        "PhoneIsValidWithRegionTransformer": _mk(
+            lambda: O.PhoneIsValidWithRegionTransformer(), _phone(),
+            lambda: Column.from_values(
+                T.Text, [["US", "Germany", None][i % 3] for i in range(N)])),
+        "PhoneParseTransformer": _mk(
+            lambda: O.PhoneParseTransformer(), _phone()),
+        "PhoneParseWithRegionTransformer": _mk(
+            lambda: O.PhoneParseWithRegionTransformer(), _phone(),
+            lambda: Column.from_values(
+                T.Text, [["GB", "France", None][i % 3] for i in range(N)])),
+        "PhoneMapIsValidTransformer": _mk(
+            lambda: O.PhoneMapIsValidTransformer(),
+            lambda: Column.from_values(
+                T.PhoneMap, [{"h": "4155552671", "w": "12"} if i % 2 else None
+                             for i in range(N)])),
         "PhoneVectorizer": _mk(lambda: O.PhoneVectorizer(), _phone()),
         "MimeTypeDetector": _mk(
             lambda: O.MimeTypeDetector(),

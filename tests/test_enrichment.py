@@ -64,6 +64,63 @@ class TestPhone:
         np.testing.assert_array_equal(block[:, 0], [1.0, 0.0, 0.0])
         np.testing.assert_array_equal(block[:, 1], [0.0, 0.0, 1.0])
 
+    def test_parse_normalizes_to_e164(self):
+        from transmogrifai_tpu.ops.enrich import parse_phone
+        assert parse_phone("(415) 555-2671") == "+14155552671"
+        assert parse_phone("+1 415 555 2671") == "+14155552671"
+        assert parse_phone("030 12 34 56", default_region="DE") == "+4930123456"
+        assert parse_phone("+44 20 7946 0958") == "+442079460958"
+        assert parse_phone("555-2671") is None        # invalid → None
+        assert parse_phone("not a phone") is None
+        assert parse_phone(None) is None
+
+    def test_resolve_region(self):
+        from transmogrifai_tpu.ops.enrich import (
+            INTERNATIONAL_REGION, resolve_region)
+        # "+" numbers carry their own region
+        assert resolve_region("+4420794", "US") == INTERNATIONAL_REGION
+        # recognized region codes win
+        assert resolve_region("0301234567", "DE") == "DE"
+        # country NAMES resolve by bigram similarity
+        assert resolve_region("12345678", "Germany") == "DE"
+        assert resolve_region("12345678", "United States") == "US"
+        assert resolve_region("12345678", "Brasil") == "BR"
+        # nothing to go on → default region
+        assert resolve_region("12345678", None, default_region="GB") == "GB"
+
+    def test_with_region_transformers(self):
+        from transmogrifai_tpu.ops.enrich import (
+            PhoneIsValidWithRegionTransformer, PhoneParseWithRegionTransformer)
+        phones = _col(T.Phone, ["020 7946 0958", "(415) 555-2671",
+                                "+81 3 1234 5678", None])
+        regions = _col(T.Text, ["United Kingdom", "US", "ignored", "FR"])
+        valid = PhoneIsValidWithRegionTransformer().transform(
+            [phones, regions])
+        np.testing.assert_array_equal(valid.data["value"][:3], 1.0)
+        assert not valid.data["mask"][3]  # None phone → None validity
+        parsed = PhoneParseWithRegionTransformer().transform(
+            [phones, regions])
+        assert parsed.data[0] == "+442079460958"  # trunk 0 stripped
+        assert parsed.data[1] == "+14155552671"
+        assert parsed.data[2] == "+81312345678"
+        assert parsed.data[3] is None
+
+    def test_phone_map_validity(self):
+        from transmogrifai_tpu.ops.enrich import PhoneMapIsValidTransformer
+        col = _col(T.PhoneMap, [
+            {"home": "4155552671", "work": "bad", "none": None},
+            None])
+        out = PhoneMapIsValidTransformer().transform([col])
+        assert out.data[0] == {"home": True, "work": False}  # None dropped
+        assert out.data[1] is None
+
+    def test_parse_unknown_cc_returns_none(self):
+        from transmogrifai_tpu.ops.enrich import is_valid_phone, parse_phone
+        # length-plausible but unresolvable calling code: lenient validity,
+        # strict normalization (reference isValidNumber gate)
+        assert is_valid_phone("+999 1234 5678") is True
+        assert parse_phone("+999 1234 5678") is None
+
 
 class TestMime:
     def test_magic_bytes(self):

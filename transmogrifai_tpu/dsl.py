@@ -199,9 +199,37 @@ def to_protocol(self: Feature) -> Feature:
     return _stage(UrlProtocolTransformer, self)
 
 
-def is_valid_phone(self: Feature, default_region: str = "US") -> Feature:
-    from transmogrifai_tpu.ops.enrich import PhoneIsValidTransformer
+def is_valid_phone(self: Feature, *, region: Optional[Feature] = None,
+                   default_region: str = "US") -> Feature:
+    """RichTextFeature.isValidPhoneDefaultCountry / isValidPhoneNumber
+    (RichTextFeature.scala:493-545): pass a region-code/country-name Text
+    feature to resolve the validation region per row."""
+    from transmogrifai_tpu.ops.enrich import (
+        PhoneIsValidTransformer, PhoneIsValidWithRegionTransformer)
+    if region is not None:
+        return _stage(PhoneIsValidWithRegionTransformer, self, region,
+                      default_region=default_region)
     return _stage(PhoneIsValidTransformer, self, default_region=default_region)
+
+
+def parse_phone(self: Feature, *, region: Optional[Feature] = None,
+                default_region: str = "US") -> Feature:
+    """RichTextFeature.parsePhone / parsePhoneDefaultCountry
+    (RichTextFeature.scala:466-493): normalized "+cc…" Phone, None when
+    invalid."""
+    from transmogrifai_tpu.ops.enrich import (
+        PhoneParseTransformer, PhoneParseWithRegionTransformer)
+    if region is not None:
+        return _stage(PhoneParseWithRegionTransformer, self, region,
+                      default_region=default_region)
+    return _stage(PhoneParseTransformer, self, default_region=default_region)
+
+
+def is_valid_phone_map(self: Feature, default_region: str = "US") -> Feature:
+    """RichMapFeature phone-map validity (IsValidPhoneMapDefaultCountry)."""
+    from transmogrifai_tpu.ops.enrich import PhoneMapIsValidTransformer
+    return _stage(PhoneMapIsValidTransformer, self,
+                  default_region=default_region)
 
 
 def detect_mime_types(self: Feature, type_hint=None) -> Feature:
@@ -351,7 +379,9 @@ _METHODS = {
     "is_valid_email": is_valid_email, "to_email_domain": to_email_domain,
     "to_email_parts": to_email_parts, "is_valid_url": is_valid_url,
     "to_domain": to_domain, "to_protocol": to_protocol,
-    "is_valid_phone": is_valid_phone, "detect_mime_types": detect_mime_types,
+    "is_valid_phone": is_valid_phone, "parse_phone": parse_phone,
+    "is_valid_phone_map": is_valid_phone_map,
+    "detect_mime_types": detect_mime_types,
     "detect_languages": detect_languages, "detect_name": detect_name,
     "recognize_entities": recognize_entities,
     "remove_stop_words": remove_stop_words, "ngram": ngram,

@@ -26,6 +26,8 @@ from transmogrifai_tpu.ops.enrich import (
     ValidEmailTransformer, EmailDomainTransformer,
     EmailToPickListMapTransformer, UrlIsValidTransformer,
     UrlDomainTransformer, UrlProtocolTransformer, PhoneIsValidTransformer,
+    PhoneIsValidWithRegionTransformer, PhoneParseTransformer,
+    PhoneParseWithRegionTransformer, PhoneMapIsValidTransformer,
     PhoneVectorizer, MimeTypeDetector, LangDetector, HumanNameDetector,
     NameEntityRecognizer)
 from transmogrifai_tpu.ops.text_advanced import (
@@ -56,7 +58,9 @@ __all__ = [
     "ValidEmailTransformer", "EmailDomainTransformer",
     "EmailToPickListMapTransformer", "UrlIsValidTransformer",
     "UrlDomainTransformer", "UrlProtocolTransformer",
-    "PhoneIsValidTransformer", "PhoneVectorizer", "MimeTypeDetector",
+    "PhoneIsValidTransformer", "PhoneIsValidWithRegionTransformer",
+    "PhoneParseTransformer", "PhoneParseWithRegionTransformer",
+    "PhoneMapIsValidTransformer", "PhoneVectorizer", "MimeTypeDetector",
     "LangDetector", "HumanNameDetector", "NameEntityRecognizer",
     "OpStopWordsRemover", "OpNGram", "OpCountVectorizer", "OpWord2Vec",
     "OpLDA", "DropIndicesByTransformer",

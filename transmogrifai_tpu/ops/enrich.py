@@ -198,18 +198,47 @@ def _nanp_valid(national: str) -> bool:
             and national[3] not in "01")
 
 
-def is_valid_phone(s: Optional[str], default_region: str = "US",
-                   strict: bool = False) -> Optional[bool]:
-    """Region-aware validity (PhoneNumberParser.scala: validity against a
-    default region; non-strict mode tolerates missing country code).
-    "+cc" numbers from a different region validate against THAT region's
-    length window via longest-code match; NANP numbers additionally check
-    the N[2-9]XX area/exchange structure."""
-    if s is None:
-        return None
+# libphonenumber's region sentinel for "+"-prefixed numbers whose region
+# is carried by the number itself (PhoneNumberParser.scala:256)
+INTERNATIONAL_REGION = "ZZ"
+
+# region → comma-separated country names, the resolution table behind
+# country-name region matching (PhoneNumberParser.DefaultCountryCodes,
+# PhoneNumberParser.scala:327-…; ours covers every region in
+# _PHONE_REGIONS rather than only the NANP islands)
+_COUNTRY_NAMES: Dict[str, str] = {
+    "US": "USA, United States of America, United States",
+    "CA": "Canada", "GB": "United Kingdom, Great Britain, England",
+    "DE": "Germany, Deutschland", "FR": "France", "IN": "India",
+    "AU": "Australia", "JP": "Japan", "BR": "Brazil, Brasil",
+    "MX": "Mexico", "CN": "China", "ES": "Spain, Espana",
+    "IT": "Italy, Italia", "NL": "Netherlands, Holland", "SE": "Sweden",
+    "NO": "Norway", "DK": "Denmark", "FI": "Finland", "PL": "Poland",
+    "CZ": "Czech Republic, Czechia", "SK": "Slovakia", "AT": "Austria",
+    "CH": "Switzerland", "BE": "Belgium", "PT": "Portugal",
+    "GR": "Greece", "IE": "Ireland", "RU": "Russia, Russian Federation",
+    "UA": "Ukraine", "TR": "Turkey, Turkiye", "IL": "Israel",
+    "SA": "Saudi Arabia", "AE": "United Arab Emirates, UAE",
+    "EG": "Egypt", "ZA": "South Africa", "NG": "Nigeria", "KE": "Kenya",
+    "KR": "South Korea, Korea, Republic of Korea", "SG": "Singapore",
+    "HK": "Hong Kong", "TW": "Taiwan", "TH": "Thailand",
+    "VN": "Vietnam, Viet Nam", "ID": "Indonesia", "MY": "Malaysia",
+    "PH": "Philippines", "PK": "Pakistan", "BD": "Bangladesh",
+    "AR": "Argentina", "CL": "Chile", "CO": "Colombia", "PE": "Peru",
+    "NZ": "New Zealand",
+}
+
+
+def _parse_parts(s: str, default_region: str = "US", strict: bool = False):
+    """(valid, country_code, national_number) for a non-None input.
+
+    The shared core behind `is_valid_phone` and `parse_phone`
+    (PhoneNumberParser.scala parsePhoneNumber/validate/parse:270-322).
+    `country_code` is "" when the calling code cannot be resolved
+    (unknown "+cc" prefix) and None when invalid."""
     digits = re.sub(r"[^\d+]", "", s.strip())
     if not digits:
-        return False
+        return False, None, None
     region = default_region.upper()
     known = region in _PHONE_REGIONS
     cc, lo, hi = _PHONE_REGIONS.get(region, ("", 7, 15))
@@ -222,34 +251,127 @@ def is_valid_phone(s: Optional[str], default_region: str = "US",
     if digits.startswith("+"):
         body = digits[1:]
         if not body.isdigit():
-            return False
+            return False, None, None
         if known and body.startswith(cc):
-            return _check(cc, body[len(cc):], lo, hi)
+            nat = body[len(cc):]
+            return _check(cc, nat, lo, hi), cc, nat
         # another country's code: longest-prefix match into the table
         for plen in (3, 2, 1):
             pref = body[:plen]
             if pref in _CC_LENGTHS:
                 flo, fhi = _CC_LENGTHS[pref]
-                return _check(pref, body[plen:], flo, fhi)
-        return 7 <= len(body) <= 15  # unknown code: generic E.164 bound
+                nat = body[plen:]
+                return _check(pref, nat, flo, fhi), pref, nat
+        # unknown code: generic E.164 bound; calling code unresolvable
+        return 7 <= len(body) <= 15, "", body
     if not digits.isdigit():
-        return False
+        return False, None, None
+    if region == INTERNATIONAL_REGION:
+        # "ZZ" carries no national metadata — only "+" numbers resolve
+        # (libphonenumber parse throws for ZZ without "+")
+        return False, None, None
     if known and digits.startswith(cc) and \
             _check(cc, digits[len(cc):], lo, hi):
-        return not strict or region in ("US", "CA")
+        return ((not strict or region in ("US", "CA")),
+                cc, digits[len(cc):])
     # bare national number: NANP structure only for NANP default regions;
     # unknown regions keep the generic (7, 15) window
     if known and cc == "1":
-        return _nanp_valid(digits)
+        return _nanp_valid(digits), cc, digits
     if lo <= len(digits) <= hi:
-        return True
+        # normalization strips the national trunk 0 where the remainder
+        # still fits the window — Italy keeps its leading zero as part of
+        # the significant number (libphonenumber nationalPrefix metadata)
+        if (digits.startswith("0") and region != "IT"
+                and lo <= len(digits) - 1 <= hi):
+            return True, cc, digits[1:]
+        return True, cc, digits
     # national trunk prefix: most non-NANP regions write national numbers
     # with a leading 0 that is not part of the significant number
-    # (libphonenumber's nationalPrefix strip); Italy-style kept-zero
-    # numbers already matched the plain window above
-    if digits.startswith("0") and lo <= len(digits) - 1 <= hi:
-        return True
-    return False
+    # (libphonenumber's nationalPrefix strip); Italy's zero is significant,
+    # so IT numbers must fit the window zero included (branch above)
+    if (digits.startswith("0") and region != "IT"
+            and lo <= len(digits) - 1 <= hi):
+        return True, cc, digits[1:]
+    return False, None, None
+
+
+def is_valid_phone(s: Optional[str], default_region: str = "US",
+                   strict: bool = False) -> Optional[bool]:
+    """Region-aware validity (PhoneNumberParser.scala: validity against a
+    default region; non-strict mode tolerates missing country code).
+    "+cc" numbers from a different region validate against THAT region's
+    length window via longest-code match; NANP numbers additionally check
+    the N[2-9]XX area/exchange structure."""
+    if s is None:
+        return None
+    return _parse_parts(s, default_region, strict)[0]
+
+
+def parse_phone(s: Optional[str], default_region: str = "US",
+                strict: bool = False) -> Optional[str]:
+    """Normalize to "+{countryCode}{nationalNumber}" when valid, else None
+    (PhoneNumberParser.parse, PhoneNumberParser.scala:314-322). Numbers
+    whose calling code cannot be resolved (unknown "+cc") return None even
+    when length-valid, matching the reference's isValidNumber gate."""
+    if s is None:
+        return None
+    valid, cc, nat = _parse_parts(s, default_region, strict)
+    if not valid or not cc:
+        return None
+    return f"+{cc}{nat}"
+
+
+def _char_bigrams(s: str):
+    return {s[i:i + 2] for i in range(len(s) - 1)}
+
+
+def _name_bigrams(codes: Dict[str, str]):
+    return [(reg, _char_bigrams(name.strip().upper()))
+            for reg, names in codes.items()
+            for name in str(names).split(",")]
+
+
+_DEFAULT_NAME_BIGRAMS = _name_bigrams(_COUNTRY_NAMES)
+_REGION_CACHE: Dict[str, str] = {}
+
+
+def resolve_region(phone: Optional[str], region_text: Optional[str] = None,
+                   default_region: str = "US",
+                   country_codes: Optional[Dict[str, str]] = None) -> str:
+    """Resolve the validation region for a (phone, region-text) pair
+    (PhoneNumberParser.validCountryCode, PhoneNumberParser.scala:285-305):
+    "+" numbers resolve to the international sentinel; a recognized region
+    code wins; otherwise the nearest country NAME by character-bigram
+    Jaccard similarity over `country_codes` (region → comma-separated
+    names; defaults to the built-in table); else the default region."""
+    if phone and phone.strip().startswith("+"):
+        return INTERNATIONAL_REGION
+    if region_text and region_text.strip():
+        rc = region_text.strip().upper()
+        codes = country_codes if country_codes else _COUNTRY_NAMES
+        if rc in codes or rc in _PHONE_REGIONS:
+            return rc
+        if country_codes:
+            entries = _name_bigrams(country_codes)
+        else:
+            # region texts are low-cardinality in practice — cache the
+            # name-match result so per-row calls don't rescan the table
+            if rc in _REGION_CACHE:
+                return _REGION_CACHE[rc]
+            entries = _DEFAULT_NAME_BIGRAMS
+        q = _char_bigrams(rc)
+        best, best_sim = None, 0.0
+        for reg, b in entries:
+            union = len(q | b)
+            sim = len(q & b) / union if union else 0.0
+            if sim > best_sim:
+                best, best_sim = reg, sim
+        if best is not None:
+            if not country_codes and len(_REGION_CACHE) < 4096:
+                _REGION_CACHE[rc] = best
+            return best
+    return default_region.upper()
 
 
 def phone_valid_block(values, default_region: str,
@@ -285,6 +407,103 @@ class PhoneIsValidTransformer(HostTransformer):
         return Column.from_values(T.Binary, [
             is_valid_phone(v, self.default_region, self.strict)
             for v in cols[0].data])
+
+
+class PhoneIsValidWithRegionTransformer(HostTransformer):
+    """(Phone, Text region) → Binary validity with per-row region
+    resolution incl. country-name matching (IsValidPhoneNumber,
+    PhoneNumberParser.scala:198-215)."""
+
+    in_types = (T.Phone, T.Text)
+    out_type = T.Binary
+
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 country_codes: Optional[Dict[str, str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, default_region=default_region,
+                         strict=strict, country_codes=country_codes)
+        self.default_region = default_region
+        self.strict = strict
+        self.country_codes = country_codes
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        return Column.from_values(T.Binary, [
+            is_valid_phone(p, resolve_region(p, r, self.default_region,
+                                             self.country_codes),
+                           self.strict)
+            for p, r in zip(cols[0].data, cols[1].data)])
+
+
+class PhoneParseTransformer(HostTransformer):
+    """Phone → normalized "+cc…" Phone against the default region, None
+    when invalid (ParsePhoneDefaultCountry, PhoneNumberParser.scala:170-179)."""
+
+    in_types = (T.Phone,)
+    out_type = T.Phone
+
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, default_region=default_region, strict=strict)
+        self.default_region = default_region
+        self.strict = strict
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        return Column.from_values(T.Phone, [
+            parse_phone(v, self.default_region, self.strict)
+            for v in cols[0].data])
+
+
+class PhoneParseWithRegionTransformer(HostTransformer):
+    """(Phone, Text region) → normalized Phone with per-row region
+    resolution (ParsePhoneNumber, PhoneNumberParser.scala:143-159)."""
+
+    in_types = (T.Phone, T.Text)
+    out_type = T.Phone
+
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 country_codes: Optional[Dict[str, str]] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, default_region=default_region,
+                         strict=strict, country_codes=country_codes)
+        self.default_region = default_region
+        self.strict = strict
+        self.country_codes = country_codes
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        return Column.from_values(T.Phone, [
+            parse_phone(p, resolve_region(p, r, self.default_region,
+                                          self.country_codes),
+                        self.strict)
+            for p, r in zip(cols[0].data, cols[1].data)])
+
+
+class PhoneMapIsValidTransformer(HostTransformer):
+    """PhoneMap → BinaryMap per-key validity; keys whose value is None are
+    dropped, matching the reference's SomeValue collect
+    (IsValidPhoneMapDefaultCountry, PhoneNumberParser.scala:241-251)."""
+
+    in_types = (T.PhoneMap,)
+    out_type = T.BinaryMap
+
+    def __init__(self, default_region: str = "US", strict: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid, default_region=default_region, strict=strict)
+        self.default_region = default_region
+        self.strict = strict
+
+    def transform(self, cols: Sequence[Column], ctx=None) -> Column:
+        out: List[Optional[Dict[str, bool]]] = []
+        for m in cols[0].data:
+            if m is None:
+                out.append(None)
+                continue
+            d = {}
+            for k, v in m.items():
+                valid = is_valid_phone(v, self.default_region, self.strict)
+                if valid is not None:
+                    d[k] = valid
+            out.append(d)
+        return Column.from_values(T.BinaryMap, out)
 
 
 class PhoneVectorizer(Transformer):
