@@ -176,18 +176,36 @@ def test_cli_gen_project_skeleton(tmp_path):
     params = _json.loads((proj / "params.json").read_text())
     assert params["model_location"].endswith("model")
     assert "stage_params" in params
-    assert "run --app proj_app:runner" in (proj / "README.md").read_text()
+    readme = (proj / "README.md").read_text()
+    assert "run --app proj_app.app:runner" in readme
+    # buildable skeleton: package split + pyproject + test + gitignore
+    assert (proj / "proj_app" / "features.py").exists()
+    assert (proj / "proj_app" / "app.py").exists()
+    assert (proj / "proj_app" / "__init__.py").exists()
+    assert 'packages = ["proj_app"]' in (proj / "pyproject.toml").read_text()
+    assert (proj / ".gitignore").read_text().startswith("__pycache__")
+    assert "test_workflow_wires" in (proj / "tests" / "test_app.py").read_text()
+    assert "from proj_app.features import" in (
+        proj / "proj_app" / "app.py").read_text()
 
     repo_root = os.path.join(os.path.dirname(__file__), "..")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.pathsep.join(
-                   [str(tmp_path), repo_root,
+                   [str(proj), repo_root,
                     os.environ.get("PYTHONPATH", "")]))
+    # the generated project's own smoke test passes from the project root
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q"],
+        capture_output=True, text=True, env=env, cwd=str(proj),
+        timeout=420)
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    # training through the PACKAGE runner path works end to end
     out = subprocess.run(
         [sys.executable, "-m", "transmogrifai_tpu.cli", "run",
-         "--app", "proj_app:runner", "--run-type", "train",
+         "--app", "proj_app.app:runner", "--run-type", "train",
          "--params", str(proj / "params.json")],
-        capture_output=True, text=True, env=env)
+        capture_output=True, text=True, env=env, cwd=str(proj),
+        timeout=420)
     assert out.returncode == 0, out.stderr[-1500:]
     assert (proj / "model").is_dir()
     assert (proj / "metrics" / "train-metrics.json").exists()
