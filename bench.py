@@ -704,11 +704,31 @@ def main() -> None:
             payload["big_skipped"] = "BENCH_BIG=0"
             _emit(payload)
             return
-        try:
-            run_big(platform, payload)
-        except Exception as e:
-            payload["big_error"] = f"{type(e).__name__}: {e}"
+        # watchdog thread: a wedged tunnel RPC blocks INSIDE a transfer,
+        # so per-chunk deadlines can't fire (r5 watched device_binned sit
+        # 12+ min in one RPC). Joining with the remaining budget lets the
+        # bench emit a stall marker and exit 0 with everything measured
+        # so far instead of dying to the driver's SIGTERM mid-phase.
+        import threading
+
+        def _big():
+            try:
+                run_big(platform, payload)
+            except Exception as e:
+                payload["big_error"] = f"{type(e).__name__}: {e}"
+                _emit(payload)
+
+        th = threading.Thread(target=_big, daemon=True)
+        th.start()
+        th.join(timeout=max(_remaining(), 30.0) + 60.0)
+        if th.is_alive():
+            payload["big_stalled"] = (
+                f"big phase still blocked at budget+60s "
+                f"(likely a wedged tunnel RPC); partial results above")
             _emit(payload)
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(0)  # a wedged RPC also blocks interpreter teardown
 
 
 if __name__ == "__main__":
