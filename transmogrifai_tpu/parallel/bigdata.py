@@ -319,12 +319,17 @@ def _chunked_histograms(Xb, node_idx, V, n_nodes: int, n_bins: int,
     n, d = Xb.shape
     m = V.shape[1]
     n_chunks = n // chunk
-    Xb_r = Xb.reshape(n_chunks, chunk, d)
-    ni_r = node_idx.reshape(n_chunks, chunk)
-    V_r = V.reshape(n_chunks, chunk, m)
 
-    def body(acc, args):
-        xb_c, ni_c, v_c = args
+    # scan over chunk INDICES and dynamic-slice each operand: passing the
+    # reshaped (n_chunks, chunk, d) array as scan xs makes XLA materialize
+    # a re-laid-out copy of the whole multi-GB buffer (the r5 10M×500
+    # lockstep OOM'd by 62M with TWO such copies resident); aligned
+    # dynamic slices read the argument buffer in place
+    def body(acc, i):
+        r0 = i * chunk
+        xb_c = jax.lax.dynamic_slice(Xb, (r0, 0), (chunk, d))
+        ni_c = jax.lax.dynamic_slice(node_idx, (r0,), (chunk,))
+        v_c = jax.lax.dynamic_slice(V, (r0, 0), (chunk, m))
         B = jax.nn.one_hot(xb_c, n_bins,
                            dtype=jnp.bfloat16).reshape(chunk, d * n_bins)
         A = jax.nn.one_hot(ni_c, n_nodes, dtype=jnp.bfloat16)  # (c, nodes)
@@ -335,7 +340,7 @@ def _chunked_histograms(Xb, node_idx, V, n_nodes: int, n_bins: int,
         return acc + h.reshape(m, n_nodes, d, n_bins), None
 
     acc0 = jnp.zeros((m, n_nodes, d, n_bins), jnp.float32)
-    acc, _ = jax.lax.scan(body, acc0, (Xb_r, ni_r, V_r))
+    acc, _ = jax.lax.scan(body, acc0, jnp.arange(n_chunks, dtype=jnp.int32))
     return acc
 
 
@@ -344,17 +349,17 @@ def _chunked_leaf_sums(node_idx, V, n_nodes: int, chunk: int):
     serializes at 10M rows)."""
     n, m = V.shape
     n_chunks = n // chunk
-    ni_r = node_idx.reshape(n_chunks, chunk)
-    V_r = V.reshape(n_chunks, chunk, m)
 
-    def body(acc, args):
-        ni_c, v_c = args
+    def body(acc, i):
+        r0 = i * chunk
+        ni_c = jax.lax.dynamic_slice(node_idx, (r0,), (chunk,))
+        v_c = jax.lax.dynamic_slice(V, (r0, 0), (chunk, m))
         A = jax.nn.one_hot(ni_c, n_nodes, dtype=jnp.bfloat16)
         return acc + jnp.matmul(A.T, v_c.astype(jnp.bfloat16),
                                 preferred_element_type=jnp.float32), None
 
     acc, _ = jax.lax.scan(body, jnp.zeros((n_nodes, m), jnp.float32),
-                          (ni_r, V_r))
+                          jnp.arange(n_chunks, dtype=jnp.int32))
     return acc
 
 
@@ -378,12 +383,16 @@ def _chunked_histograms_multi(Xb, node_K, V_K, n_nodes: int, n_bins: int,
     n, d = Xb.shape
     K, _, p = V_K.shape
     n_chunks = n // chunk
-    Xb_r = Xb.reshape(n_chunks, chunk, d)
-    nK_r = jnp.transpose(node_K.reshape(K, n_chunks, chunk), (1, 0, 2))
-    V_r = jnp.transpose(V_K.reshape(K, n_chunks, chunk, p), (1, 0, 2, 3))
 
-    def body(acc, args):
-        xb_c, ni_c, v_c = args      # (c, d), (K, c), (K, c, p)
+    # index-scan + dynamic slices, NOT reshaped/transposed scan xs: the
+    # (n_chunks, chunk, d) view chose a transposed layout and XLA kept a
+    # second full copy of the 4.9 GB Xb — 9.7 GB of HLO temps that OOM'd
+    # the 10M×500 lockstep compile (r5); slices read the buffers in place
+    def body(acc, i):
+        r0 = i * chunk
+        xb_c = jax.lax.dynamic_slice(Xb, (r0, 0), (chunk, d))
+        ni_c = jax.lax.dynamic_slice(node_K, (0, r0), (K, chunk))
+        v_c = jax.lax.dynamic_slice(V_K, (0, r0, 0), (K, chunk, p))
         B = jax.nn.one_hot(xb_c, n_bins,
                            dtype=jnp.bfloat16).reshape(chunk, d * n_bins)
         # joint A operand (c, K·p·nodes): per-row, K·p nonzeros
@@ -397,7 +406,7 @@ def _chunked_histograms_multi(Xb, node_K, V_K, n_nodes: int, n_bins: int,
         return acc + h.reshape(K, p, n_nodes, d, n_bins), None
 
     acc0 = jnp.zeros((K, p, n_nodes, d, n_bins), jnp.float32)
-    acc, _ = jax.lax.scan(body, acc0, (Xb_r, nK_r, V_r))
+    acc, _ = jax.lax.scan(body, acc0, jnp.arange(n_chunks, dtype=jnp.int32))
     return acc
 
 
@@ -405,18 +414,18 @@ def _chunked_leaf_sums_multi(node_K, V_K, n_nodes: int, chunk: int):
     """(K, nodes, p) per-learner leaf sums, one pass over the rows."""
     K, n, p = V_K.shape
     n_chunks = n // chunk
-    nK_r = jnp.transpose(node_K.reshape(K, n_chunks, chunk), (1, 0, 2))
-    V_r = jnp.transpose(V_K.reshape(K, n_chunks, chunk, p), (1, 0, 2, 3))
 
-    def body(acc, args):
-        ni_c, v_c = args
+    def body(acc, i):
+        r0 = i * chunk
+        ni_c = jax.lax.dynamic_slice(node_K, (0, r0), (K, chunk))
+        v_c = jax.lax.dynamic_slice(V_K, (0, r0, 0), (K, chunk, p))
         A = jax.nn.one_hot(ni_c, n_nodes, dtype=jnp.bfloat16)  # (K, c, nodes)
         h = jnp.einsum("kcn,kcp->knp", A, v_c.astype(jnp.bfloat16),
                        preferred_element_type=jnp.float32)
         return acc + h, None
 
     acc, _ = jax.lax.scan(body, jnp.zeros((K, n_nodes, p), jnp.float32),
-                          (nK_r, V_r))
+                          jnp.arange(n_chunks, dtype=jnp.int32))
     return acc
 
 
