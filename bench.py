@@ -478,8 +478,11 @@ def run_big(platform: str, payload: dict) -> None:
     # LR-side tunnel stall (r5 watched one 10M materialization hang for
     # 15+ minutes) cannot eat the budget before they are captured ------ #
     def _emit_extrapolation(lr3_s: float, rf_s: float, xgb_s: float,
-                            estimated_lr: bool) -> None:
+                            estimated_lr: bool,
+                            estimated_xgb: bool = False) -> None:
         payload["big_lr_estimated"] = estimated_lr
+        if estimated_xgb:
+            payload["big_xgb_estimated"] = True
         total = lr3_s + rf_s + xgb_s
         payload["big_sweep84_extrapolated_s"] = round(total, 1)
         # the sweep axis (grids × folds × trees) is embarrassingly
@@ -546,7 +549,14 @@ def run_big(platform: str, payload: dict) -> None:
         if _remaining() < 90:
             payload["big_gbt_skipped"] = (
                 f"{_remaining():.0f}s left after RF lockstep (<90s)")
-            _emit_extrapolation(75.0, rf_s, 0.0, estimated_lr=True)
+            # estimate the XGB term from the MEASURED RF per-tree cost:
+            # the chunk one-hot stream cost is FLAT in K, so a 6-pair
+            # round costs about the full K-batch (per_tree·RF_K) plus
+            # ~50% margin/gradient overhead (r5 measured 18.45s vs the
+            # 12.2s K=16 batch) — flagged big_xgb_estimated
+            xgb_est = 200 * scale(10) * (per_tree_d6 * RF_K * 1.5)
+            _emit_extrapolation(75.0, rf_s, xgb_est, estimated_lr=True,
+                                estimated_xgb=True)
             del Xb, trees
             gc.collect()
             _emit(payload)
