@@ -311,3 +311,27 @@ class TestGroupedFetchStreaming:
                  for o in model.score_stream(reader.stream(),
                                              fetch_group=3)]
         assert all(isinstance(pb, np.ndarray) for pb in probs)
+
+    def test_coalesced_batches_match_per_batch(self, tmp_path, rng):
+        """coalesce_rows merges micro-batches into bigger dispatches but
+        must preserve the one-result-per-input-batch contract, batch
+        boundaries, and values — incl. a trailing partial super-batch."""
+        import __graft_entry__ as ge
+        from transmogrifai_tpu.readers import DataReaders
+
+        model, ds, pf = ge._fit_flagship(n=200)
+        p = str(tmp_path / "score.parquet")
+        ds.to_parquet(p)
+        # 200 rows / 32-row batches = 7 batches (last short); coalescing
+        # to >=96 rows gives super-batches of 96, 96, 8 — a partial tail
+        reader = DataReaders.stream(parquet_path=p, batch_size=32,
+                                    schema=dict(ds.schema))
+        base = [np.asarray(o[pf.name]["prediction"])
+                for o in model.score_stream(reader.stream())]
+        coal = [np.asarray(o[pf.name]["prediction"])
+                for o in model.score_stream(reader.stream(),
+                                            coalesce_rows=96,
+                                            fetch_group=2)]
+        assert [len(b) for b in base] == [len(c) for c in coal]
+        np.testing.assert_array_equal(np.concatenate(base),
+                                      np.concatenate(coal))

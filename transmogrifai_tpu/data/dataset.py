@@ -120,6 +120,22 @@ class Dataset:
     def take(self, idx) -> "Dataset":
         return Dataset({k: v[idx] for k, v in self.columns.items()}, dict(self.schema))
 
+    @staticmethod
+    def concat(parts: Sequence["Dataset"]) -> "Dataset":
+        """Row-wise concatenation of same-schema datasets (streaming
+        micro-batch coalescing)."""
+        if len(parts) == 1:
+            return parts[0]
+        first = parts[0]
+        for p in parts[1:]:
+            if set(p.columns) != set(first.columns):
+                raise ValueError(
+                    f"concat: column mismatch {sorted(first.columns)} vs "
+                    f"{sorted(p.columns)}")
+        cols = {k: np.concatenate([p.columns[k] for p in parts])
+                for k in first.columns}
+        return Dataset(cols, dict(first.schema))
+
     def with_column(self, name: str, values: np.ndarray, ftype: type) -> "Dataset":
         cols = dict(self.columns)
         cols[name] = values

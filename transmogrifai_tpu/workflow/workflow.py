@@ -351,7 +351,7 @@ class WorkflowModel:
 
     def score_stream(self, batches, prefetch: int = 2, sharding=None,
                      host_workers: int = 2, device_depth: int = 2,
-                     fetch_group: int = 1):
+                     fetch_group: int = 1, coalesce_rows: int = 0):
         """Streaming micro-batch scoring as a TWO-stage pipeline
         (OpWorkflowRunner streaming loop, OpWorkflowRunner.scala:233-262):
 
@@ -375,6 +375,15 @@ class WorkflowModel:
         fetches it with a single RPC, then yields the batches as
         host-materialized numpy results.
 
+        `coalesce_rows` > 0 merges incoming batches into super-batches of
+        at least that many rows before dispatch, then splits each result
+        back to the ORIGINAL batch boundaries — the output contract (one
+        result per input batch, in order) is unchanged. Through an
+        RPC-bound link every dispatch pays a fixed round-trip tax on top
+        of the device compute, so bigger dispatches raise throughput
+        roughly until compute dominates; stable input batch sizes keep
+        the coalesced shape stable (one compiled program).
+
         `batches`: iterable of Datasets (e.g. `StreamingReader.stream()`).
         Yields {feature_name: result} per batch like `score_compiled`.
         """
@@ -382,6 +391,42 @@ class WorkflowModel:
         from concurrent.futures import ThreadPoolExecutor
 
         from transmogrifai_tpu.workflow.compiled import CompiledScorer
+
+        if coalesce_rows and coalesce_rows > 0:
+            split_sizes: deque = deque()
+
+            def _coalesced():
+                buf, rows = [], 0
+                for ds in batches:
+                    buf.append(ds)
+                    rows += ds.n_rows
+                    if rows >= coalesce_rows:
+                        split_sizes.append([b.n_rows for b in buf])
+                        yield Dataset.concat(buf)
+                        buf, rows = [], 0
+                if buf:
+                    split_sizes.append([b.n_rows for b in buf])
+                    yield Dataset.concat(buf)
+
+            def _slice(v, a, b):
+                if isinstance(v, dict):
+                    return {k: _slice(x, a, b) for k, x in v.items()}
+                if getattr(v, "ndim", 0) >= 1:
+                    return v[a:b]
+                return v
+
+            # results come back in dispatch order, so the FIFO of split
+            # sizes stays aligned with the inner generator's yields
+            for host in self.score_stream(
+                    _coalesced(), prefetch=prefetch, sharding=sharding,
+                    host_workers=host_workers, device_depth=device_depth,
+                    fetch_group=fetch_group):
+                off = 0
+                for s in split_sizes.popleft():
+                    yield {f: _slice(v, off, off + s)
+                           for f, v in host.items()}
+                    off += s
+            return
         if self._compiled is None or \
                 getattr(self._compiled, "sharding", None) != sharding:
             self._compiled = CompiledScorer(self, sharding=sharding)
