@@ -572,6 +572,8 @@ class WorkflowModel:
             # continued encode+dispatch instead of idling the device —
             # r5 measured the consumer-blocking fetch capping streaming
             # at ~1/8 of the device ceiling when the tunnel degraded.
+            # Exactly ONE worker: a same-session A/B with 2-3 parallel
+            # fetch RPCs measured ~20% SLOWER (server-side contention).
             depth = max(group_n, device_depth)
             with ThreadPoolExecutor(max_workers=1) as fetch_pool:
                 fetched = deque()  # materialize futures, arrival order
