@@ -576,6 +576,7 @@ def run_big(platform: str, payload: dict) -> None:
             xgb_est = 200 * scale(10) * (per_tree_d6 * RF_K * 1.5)
             _emit_extrapolation(75.0, rf_s, xgb_est, estimated_lr=True,
                                 estimated_xgb=True)
+            payload["big_lr_skipped"] = "budget exhausted with GBT"
             del Xb, trees
             gc.collect()
             _emit(payload)
