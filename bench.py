@@ -633,6 +633,33 @@ def run_big(platform: str, payload: dict) -> None:
             payload["big_gbt_d10_skipped"] = (
                 f"{_remaining():.0f}s left (<300s); xgb term uses the "
                 "scale() model")
+
+        # RF depth-12 — the LAST modeled extrapolation term (the 18
+        # depth-12 configs dominate the RF sum at scale(12)=63.5×).
+        # fit_forest_big picks K=1 at depth 12 (lockstep_width's
+        # dispatch-time bound), so one real single-tree fit IS the cost
+        # the sweep would pay per depth-12 tree.
+        if _remaining() > 300:
+            note("depth-12 RF tree (compile+warm) ...")
+            try:
+                np.asarray(bd.fit_forest_big(
+                    Xb, Y1, w_full, 1, 12, 32, 2, seed=5)["leaf"])
+                t0 = time.time()
+                t12 = bd.fit_forest_big(Xb, Y1, w_full, 1, 12, 32, 2,
+                                        seed=5)
+                np.asarray(t12["leaf"])
+                per_tree_d12 = time.time() - t0
+                payload["big_rf_tree_d12_s"] = round(per_tree_d12, 2)
+                rf_s = 18 * 50 * ((scale(3) + 1.0) * per_tree_d6
+                                  + per_tree_d12)
+                _emit_extrapolation(75.0, rf_s, xgb_s, estimated_lr=True)
+                del t12
+            except Exception as e:
+                payload["big_rf_d12_error"] = f"{type(e).__name__}: {e}"[:300]
+        else:
+            payload["big_rf_d12_skipped"] = (
+                f"{_remaining():.0f}s left (<300s); rf term uses the "
+                "scale() model")
         del Xb, trees, margin
         gc.collect()
         _emit(payload)
