@@ -1,0 +1,22 @@
+# Developer/CI gates. `make check` is the PR gate: the JAX-pitfall lint
+# must be clean over the package source, then the tier-1 test command
+# (ROADMAP.md) must pass.
+
+PY ?= python
+TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	-m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	-p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
+
+.PHONY: lint test check
+
+lint:
+	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
+
+test:
+	bash -c "$(TIER1)"
+
+check: lint test

@@ -97,8 +97,15 @@ def test_forest_and_gbt_big_learn():
                       ).astype(jnp.int8)
     Y = jax.nn.one_hot(jnp.asarray(y).astype(jnp.int32), 2)
     w = jnp.ones(n, jnp.float32)
+    # subsample_features=False: with only 4 trees each seeing sqrt(8)=2
+    # random features, learning y = X0 - X1 is seed luck (the in-core
+    # fit_forest produces the identical 0.58 accuracy at this seed); the
+    # "does the big path learn" check must not hinge on feature-draw
+    # luck — the lockstep/feature-mask machinery is covered exactly by
+    # test_lockstep_trees_match_single_grower
     trees = bd.fit_forest_big(Xb, Y, w, 4, 4, 16, 2, seed=1, chunk=512,
-                              trees_per_dispatch=2)
+                              trees_per_dispatch=2,
+                              subsample_features=False)
     probs = bd.predict_forest_big(trees, Xb)
     assert float((np.asarray(jnp.argmax(probs, -1)) == y).mean()) > 0.9
     _, margin = bd.fit_gbt_big(Xb, jnp.asarray(y), w, 6, 4, 16, 0.3, 1.0,

@@ -112,3 +112,49 @@ def test_response_propagation():
     assert out.is_response
     mixed = _Add().set_input(lbl, _raw("x")).get_output()
     assert not mixed.is_response
+
+
+def test_cycle_error_carries_path():
+    a, b = _raw("a"), _raw("b")
+    s1 = _Add().set_input(a, b)
+    out1 = s1.get_output()
+    s2 = _Add().set_input(out1, a)
+    out2 = s2.get_output()
+    s1.input_features = (out2, b)
+    with pytest.raises(FeatureCycleError) as ei:
+        topological_layers([out1])
+    # the error names the whole loop, not just one stage on it
+    assert "->" in str(ei.value)
+    assert ei.value.path and ei.value.path[0] == ei.value.path[-1]
+
+
+def test_clone_graph_isolates_mutable_params():
+    from transmogrifai_tpu.features.dag import clone_graph
+    a, b = _raw("a"), _raw("b")
+    st = _Add(knobs={"depth": 2}, tags=["x"])
+    st.set_input(a, b)
+    out = st.get_output()
+    (cloned,) = clone_graph([out])
+    cs = cloned.origin_stage
+    assert cs is not st and cs.uid == st.uid
+    # top-level params dict AND nested containers must not be shared
+    cs.params["knobs"]["depth"] = 99
+    cs.params["tags"].append("mutated")
+    cs.params["new_key"] = 1
+    assert st.params["knobs"]["depth"] == 2
+    assert st.params["tags"] == ["x"]
+    assert "new_key" not in st.params
+
+
+def test_rewire_without_isolates_mutable_params():
+    from transmogrifai_tpu.features.dag import rewire_without
+    a, b = _raw("a"), _raw("b")
+    st = _Add(knobs={"depth": 2})
+    st.set_input(a, b)
+    out = st.get_output()
+    # block a sibling raw result only — the _Add subtree survives intact
+    survived, dropped = rewire_without([out, _raw("c")], ["c"])
+    assert dropped == ["c"]
+    kept = next(f for f in survived if f.name == out.name)
+    kept.origin_stage.params["knobs"]["depth"] = 7
+    assert st.params["knobs"]["depth"] == 2

@@ -1,0 +1,630 @@
+"""Static analysis suite: the opcheck graph validator (`analysis/opcheck.py`)
+over seeded bad graphs, the JAX-pitfall linter (`analysis/lint.py`), and the
+retracing detector (`analysis/retrace.py`).
+
+Each bad-graph test wires one specific defect and asserts the exact issue
+code; the clean-graph test runs the full Titanic quickstart DAG through the
+validator and demands zero errors (no false positives)."""
+
+import logging
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.analysis import lint as L
+from transmogrifai_tpu.analysis.opcheck import (
+    E_ARITY, E_CYCLE, E_DUP_UID, E_HOST_INPUT, E_HOST_OUTPUT, E_LEAKAGE,
+    E_RAW, E_TYPE, GraphValidationError, W_DEAD, W_SPLIT, validate_graph)
+from transmogrifai_tpu.analysis.retrace import RetraceMonitor, instrumented_jit
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import Feature, FeatureBuilder
+from transmogrifai_tpu.features.dag import FeatureCycleError, topological_layers
+from transmogrifai_tpu.stages.base import HostTransformer, Transformer
+from transmogrifai_tpu.workflow import Workflow
+
+
+# --------------------------------------------------------------------------- #
+# graph builders                                                              #
+# --------------------------------------------------------------------------- #
+
+def _raws():
+    age = FeatureBuilder.Real("age").from_column("age").as_predictor()
+    fare = FeatureBuilder.Real("fare").from_column("fare").as_predictor()
+    name = FeatureBuilder.Text("name").from_column("name").as_predictor()
+    label = FeatureBuilder.RealNN("survived").from_column("survived") \
+        .as_response()
+    return age, fare, name, label
+
+
+def _codes(report):
+    return {i.code for i in report.errors}
+
+
+def _warn_codes(report):
+    return {i.code for i in report.warnings}
+
+
+# test-local stage classes (registered, but the contract-spec inventory is
+# explicit, so defining them here is inert outside this module)
+
+class _JitTextOut(Transformer):
+    """Jittable transformer that (wrongly) declares host-kind output."""
+
+    in_types = (t.Real,)
+    out_type = t.Text
+
+    def device_apply(self, enc, dev):
+        return dev[0]
+
+
+class _JitTextIn(Transformer):
+    """Jittable transformer consuming Text with no host_prepare override."""
+
+    in_types = (t.Text,)
+    out_type = t.OPVector
+
+    def device_apply(self, enc, dev):
+        return dev[0]
+
+
+class _PlainVec(Transformer):
+    """Well-formed jittable stage for wiring scaffolding."""
+
+    in_types = (t.Real, t.Real)
+    out_type = t.OPVector
+
+    def device_apply(self, enc, dev):
+        import jax.numpy as jnp
+        return jnp.stack([d["value"] for d in dev], axis=1)
+
+
+class _HostAlias(HostTransformer):
+    in_types = (t.Real,)
+    out_type = t.Real
+
+    def transform(self, cols, ctx=None):
+        return cols[0]
+
+
+# --------------------------------------------------------------------------- #
+# seeded bad graphs (>= 10, each asserting its specific code)                 #
+# --------------------------------------------------------------------------- #
+
+def test_bad_type_mismatch():
+    age, fare, name, label = _raws()
+    st = _PlainVec()
+    # bypass set_input's eager check — the validator must still catch it
+    st.input_features = (age, name)
+    out = st.get_output()
+    report = validate_graph([out])
+    assert E_TYPE in _codes(report)
+    issue = report.issues(E_TYPE)[0]
+    assert issue.stage_uid == st.uid
+    assert "name" in issue.message
+
+
+def test_bad_arity():
+    age, fare, name, label = _raws()
+    st = _PlainVec()
+    st.input_features = (age,)
+    report = validate_graph([st.get_output()])
+    assert E_ARITY in _codes(report)
+    assert report.issues(E_ARITY)[0].stage_uid == st.uid
+
+
+def test_bad_duplicate_feature_uid():
+    age, fare, name, label = _raws()
+    dup = Feature(name="age2", ftype=t.Real,
+                  origin_stage=fare.origin_stage, parents=(),
+                  uid=age.uid)  # same uid, different object
+    st = _PlainVec().set_input(age, dup)
+    report = validate_graph([st.get_output()])
+    assert E_DUP_UID in _codes(report)
+
+
+def test_bad_duplicate_stage_uid():
+    age, fare, name, label = _raws()
+    s1 = _PlainVec().set_input(age, fare)
+    s2 = _PlainVec(uid=s1.uid).set_input(fare, age)
+    comb = _PlainVec()
+    comb.input_features = (s1.get_output(), s2.get_output())
+    report = validate_graph([comb.get_output()])
+    assert E_DUP_UID in _codes(report)
+
+
+def test_bad_cycle_reports_path():
+    age, fare, name, label = _raws()
+    a = _PlainVec()
+    b = _PlainVec()
+    a.input_features = (age, fare)
+    b.input_features = (age, fare)
+    fa = a.get_output()
+    fb = b.get_output()
+    # rewire into a loop: a consumes b's output, b consumes a's
+    a.input_features = (age, fb)
+    b.input_features = (fa, fare)
+    fa.parents = (age, fb)
+    fb.parents = (fa, fare)
+    report = validate_graph([fa])
+    assert E_CYCLE in _codes(report)
+    msg = report.issues(E_CYCLE)[0].message
+    assert "->" in msg and "_PlainVec" in msg
+
+    # the scheduler's own error now carries the path too (satellite)
+    with pytest.raises(FeatureCycleError) as ei:
+        topological_layers([fa])
+    assert "->" in str(ei.value)
+    assert ei.value.path  # structured path attribute
+
+
+def test_bad_response_mixed_into_predictors():
+    age, fare, name, label = _raws()
+    st = _PlainVec()
+    st.input_features = (label, age)  # label mixed by a non-aware stage
+    report = validate_graph([st.get_output()])
+    assert E_LEAKAGE in _codes(report)
+    issue = report.issues(E_LEAKAGE)[0]
+    assert issue.stage_uid == st.uid
+    assert "survived" in issue.message
+
+
+def test_bad_response_inside_feature_vector():
+    from transmogrifai_tpu.automl.sanity_checker import SanityChecker
+    age, fare, name, label = _raws()
+    # sneak a label-derived feature into the checker's VECTOR slot
+    leaky = _PlainVec()
+    leaky.input_features = (label, age)
+    checked = SanityChecker().set_input(label, leaky.get_output())
+    report = validate_graph([checked.get_output()])
+    assert E_LEAKAGE in _codes(report)
+    uids = {i.stage_uid for i in report.issues(E_LEAKAGE)}
+    assert checked.uid in uids  # flagged at the vector slot too
+
+
+def test_bad_raw_feature_without_generator():
+    st = _PlainVec()
+    orphan = Feature(name="orphan", ftype=t.Real, origin_stage=st,
+                     parents=())
+    report = validate_graph([orphan])
+    assert E_RAW in _codes(report)
+    assert report.issues(E_RAW)[0].stage_uid == st.uid
+
+
+def test_bad_host_kind_output_from_jittable_stage():
+    age, fare, name, label = _raws()
+    st = _JitTextOut()
+    st.input_features = (age,)
+    report = validate_graph([st.get_output()])
+    assert E_HOST_OUTPUT in _codes(report)
+    assert report.issues(E_HOST_OUTPUT)[0].stage_uid == st.uid
+
+
+def test_bad_host_kind_input_without_host_prepare():
+    age, fare, name, label = _raws()
+    st = _JitTextIn().set_input(name)
+    report = validate_graph([st.get_output()])
+    assert E_HOST_INPUT in _codes(report)
+    assert report.issues(E_HOST_INPUT)[0].stage_uid == st.uid
+
+
+def test_warn_dead_stage_via_universe():
+    age, fare, name, label = _raws()
+    used = _PlainVec().set_input(age, fare)
+    dead = _PlainVec().set_input(fare, age)
+    report = validate_graph([used.get_output()],
+                            universe=[dead.get_output()])
+    assert report.ok  # warning, not error
+    assert W_DEAD in _warn_codes(report)
+
+
+def test_warn_segment_split():
+    age, fare, name, label = _raws()
+    dev = _PlainVec().set_input(age, fare)
+    # host stage consuming a device-produced vector → plan splits
+    host = _HostAlias()
+    host.input_features = (dev.get_output(),)
+    report = validate_graph([host.get_output()])
+    assert W_SPLIT in _warn_codes(report)
+
+
+# --------------------------------------------------------------------------- #
+# clean graphs: no false positives                                            #
+# --------------------------------------------------------------------------- #
+
+def test_clean_titanic_quickstart_dag():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "examples"))
+    try:
+        from op_titanic_simple import build_pipeline
+    finally:
+        sys.path.pop(0)
+    survived, prediction = build_pipeline()
+    report = validate_graph([prediction, survived])
+    assert report.ok, str(report)
+    # the two alias stages genuinely split the fused plan — that warning
+    # is true, and it must be the ONLY kind raised on this graph
+    assert _warn_codes(report) <= {W_SPLIT}
+    # the Feature-level entry point sees the same graph
+    assert prediction.validate().ok
+
+
+def test_clean_simple_trained_pipeline_validates_post_fit():
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.models import OpLogisticRegression
+    rng = np.random.default_rng(0)
+    n = 80
+    ds = Dataset.from_rows(
+        [{"age": float(rng.uniform(1, 80)), "fare": float(rng.lognormal()),
+          "y": int(rng.integers(2))} for _ in range(n)],
+        schema={"age": t.Real, "fare": t.Real, "y": t.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = transmogrify(preds)
+    pred = OpLogisticRegression(max_iter=10).set_input(label, vec) \
+        .get_output()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+    # post-fit graph (estimator→model swap) validates clean too
+    assert validate_graph(model.result_features).ok
+    out = model.score_compiled(ds)  # runs validation pre-compile
+    assert pred.name in out
+
+
+# --------------------------------------------------------------------------- #
+# Workflow.train wiring: fail fast, strict opt-out                            #
+# --------------------------------------------------------------------------- #
+
+def _leaky_workflow():
+    age, fare, name, label = _raws()
+    mixed = _PlainVec()
+    mixed.input_features = (label, age)
+    rng = np.random.default_rng(1)
+    ds = Dataset.from_rows(
+        [{"age": float(rng.uniform(1, 80)), "fare": 1.0, "name": "x",
+          "survived": int(rng.integers(2))} for _ in range(20)],
+        schema={"age": t.Real, "fare": t.Real, "name": t.Text,
+                "survived": t.Integral})
+    wf = Workflow().set_result_features(mixed.get_output(), label) \
+        .set_input_dataset(ds)
+    return wf, mixed
+
+
+def test_train_fails_fast_with_report():
+    wf, mixed = _leaky_workflow()
+    with pytest.raises(GraphValidationError) as ei:
+        wf.train()
+    assert mixed.uid in str(ei.value)  # names the offending stage
+    assert ei.value.report.issues(E_LEAKAGE)
+
+
+def test_train_strict_false_proceeds(caplog):
+    wf, mixed = _leaky_workflow()
+    with caplog.at_level(logging.WARNING):
+        model = wf.train(strict=False)
+    assert any("opcheck" in r.message for r in caplog.records)
+    assert model.fitted  # eager fit went through
+
+
+def test_train_fails_before_touching_data():
+    # validation runs before dataset resolution: no dataset wired at all,
+    # yet the report (not "No input data") surfaces
+    age, fare, name, label = _raws()
+    bad = _PlainVec()
+    bad.input_features = (age, name)
+    wf = Workflow().set_result_features(bad.get_output())
+    with pytest.raises(GraphValidationError):
+        wf.train()
+
+
+# --------------------------------------------------------------------------- #
+# the linter                                                                  #
+# --------------------------------------------------------------------------- #
+
+def _lint_codes(src):
+    return {f.code for f in L.lint_source(src)}
+
+
+def test_lint_numpy_in_device_apply():
+    src = '''
+class S(Transformer):
+    def device_apply(self, enc, dev):
+        x = np.asarray(dev[0])
+        return x * np.float32(2.0) + np.pi
+'''
+    findings = L.lint_source(src)
+    assert {f.code for f in findings} == {"L001"}
+    assert len(findings) == 1  # np.float32 / np.pi are whitelisted
+
+
+def test_lint_numpy_skipped_for_host_stages():
+    src = '''
+class S(Transformer):
+    jittable = False
+    def device_apply(self, enc, dev):
+        return np.asarray(dev[0])
+'''
+    assert "L001" not in _lint_codes(src)
+
+
+def test_lint_traced_branch():
+    src = '''
+class S(Transformer):
+    def device_apply(self, enc, dev):
+        x = dev[0]
+        if x > 0:
+            return x
+        while dev[1]:
+            pass
+        return x
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L002"]
+    assert len(findings) == 2
+
+
+def test_lint_container_truthiness_allowed():
+    src = '''
+class S(Transformer):
+    def device_apply(self, enc, dev):
+        if enc:
+            return dev[0]
+        return dev[1]
+'''
+    assert "L002" not in _lint_codes(src)
+
+
+def test_lint_traced_branch_in_jitted_function():
+    src = '''
+@partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    if x > 0:
+        return x
+    if n > 2:
+        return x * n
+    return x
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L002"]
+    assert len(findings) == 1  # static `n` branch is fine, traced `x` not
+
+
+def test_lint_unhashable_static_default():
+    src = '''
+@partial(jax.jit, static_argnames=("shape",))
+def f(x, shape=[1, 2]):
+    return x
+'''
+    assert "L003" in _lint_codes(src)
+
+
+def test_lint_nondeterminism_in_fit():
+    src = '''
+class E(Estimator):
+    def fit_model(self, cols, ctx):
+        seed = time.time()
+        noise = np.random.randn(3)
+        rng = np.random.default_rng()
+        return seed, noise, rng
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L004"]
+    assert len(findings) == 3
+
+
+def test_lint_jax_random_not_flagged():
+    src = '''
+class E(Estimator):
+    def fit_model(self, cols, ctx):
+        k = jax.random.split(jax.random.PRNGKey(ctx.seed))
+        return jax.random.uniform(k[0], (3,))
+'''
+    assert "L004" not in _lint_codes(src)
+
+
+def test_lint_host_prepare_device_input():
+    src = '''
+class S(Transformer):
+    in_types = (T.RealNN, T.Text)
+    def host_prepare(self, cols):
+        bad = cols[0].data
+        ok = cols[1].data
+        return bad, ok
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L005"]
+    assert len(findings) == 1
+
+
+def test_lint_repo_is_clean():
+    root = os.path.join(os.path.dirname(__file__), "..",
+                        "transmogrifai_tpu")
+    findings = L.lint_paths([root])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# --------------------------------------------------------------------------- #
+# retracing detector                                                          #
+# --------------------------------------------------------------------------- #
+
+def test_retrace_counts_traces_not_calls():
+    import jax.numpy as jnp
+    mon = RetraceMonitor(warn_after=2)
+    fn = instrumented_jit(lambda x: x * 2, label="t", monitor=mon)
+    a = jnp.ones((4,))
+    fn(a)
+    fn(a)          # cached — same shape
+    assert mon.count("t") == 1
+    fn(jnp.ones((8,)))   # new shape → retrace
+    assert mon.count("t") == 2
+
+
+def test_retrace_churn_warning(caplog):
+    import jax.numpy as jnp
+    mon = RetraceMonitor(warn_after=2)
+    fn = instrumented_jit(lambda x: x + 1, label="churny", monitor=mon)
+    with caplog.at_level(logging.WARNING,
+                         logger="transmogrifai_tpu.analysis.retrace"):
+        for n in range(1, 5):
+            fn(jnp.ones((n,)))   # every call a fresh shape
+    assert mon.count("churny") == 4
+    assert mon.churning() == {"churny": 4}
+    assert any("retrace churn" in r.message for r in caplog.records)
+    assert "CHURN" in mon.report()
+
+
+def test_compiled_scorer_segments_are_instrumented():
+    from transmogrifai_tpu.analysis.retrace import MONITOR
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.models import OpLogisticRegression
+    rng = np.random.default_rng(2)
+    ds = Dataset.from_rows(
+        [{"a": float(rng.normal()), "y": int(rng.integers(2))}
+         for _ in range(32)],
+        schema={"a": t.Real, "y": t.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = transmogrify(preds)
+    pred = OpLogisticRegression(max_iter=5).set_input(label, vec) \
+        .get_output()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+    MONITOR.reset()
+    model.score_compiled(ds)
+    labels = [k for k in MONITOR.counts() if k.startswith("compiled:seg")]
+    assert labels, MONITOR.counts()
+    # the fused segment is labeled with the FITTED stage names
+    assert "LogisticRegressionModel" in "".join(labels)
+
+
+def test_lint_host_exemption_inherited():
+    # host-ness via HostTransformer base, a same-module jittable=False
+    # base, and an AnnAssign — all exempt from device-body checks; an
+    # explicit jittable=True override re-enables them
+    src = '''
+class Base(Transformer):
+    jittable = False
+    def device_apply(self, enc, dev):
+        return np.asarray(dev[0])
+
+class Child(Base):
+    def device_apply(self, enc, dev):
+        return np.asarray(dev[0])
+
+class FromHost(HostTransformer):
+    def device_apply(self, enc, dev):
+        return np.asarray(dev[0])
+
+class Annotated(Transformer):
+    jittable: bool = False
+    def device_apply(self, enc, dev):
+        return np.asarray(dev[0])
+
+class BackToDevice(Base):
+    jittable = True
+    def device_apply(self, enc, dev):
+        return np.asarray(dev[0])
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L001"]
+    assert len(findings) == 1  # only BackToDevice
+
+
+def test_retrace_no_churn_across_instances():
+    # 7 distinct programs sharing one label, each compiled once: the
+    # aggregate count grows but nothing is churn (the warning must not
+    # fire for healthy one-trace-per-program processes)
+    import jax.numpy as jnp
+    mon = RetraceMonitor(warn_after=2)
+    a = jnp.ones((4,))
+    for i in range(7):
+        fn = instrumented_jit(lambda x: x * 2, label="shared", monitor=mon)
+        fn(a)
+    assert mon.count("shared") == 7
+    assert mon.churning() == {}
+    assert "CHURN" not in mon.report()
+
+
+def test_lint_variadic_ellipsis_name_host_prepare():
+    # the repo spells variadic in_types as `(T.X, Ellipsis)` — the NAME,
+    # not the literal `...`; both forms must resolve for L005
+    for spelling in ("Ellipsis", "..."):
+        src = f'''
+class S(Transformer):
+    in_types = (T.OPVector, {spelling})
+    def host_prepare(self, cols):
+        return cols[1].data
+'''
+        findings = [f for f in L.lint_source(src) if f.code == "L005"]
+        assert len(findings) == 1, spelling
+
+
+def test_lint_bare_truthiness_of_extracted_value():
+    src = '''
+class S(Transformer):
+    def device_apply(self, enc, dev):
+        x = dev[0]
+        if x:
+            return x
+        return dev[1]
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L002"]
+    assert len(findings) == 1
+
+
+def test_bad_device_planned_stage_without_device_apply():
+    # overriding transform() only covers the eager path — the compiled
+    # planner still places a jittable stage in a device segment where
+    # only device_apply runs; forgetting jittable=False must be an error
+    from transmogrifai_tpu.analysis.opcheck import E_NO_APPLY
+
+    class _EagerOnly(Transformer):
+        in_types = (t.Real,)
+        out_type = t.Text
+
+        def transform(self, cols, ctx=None):
+            return cols[0]
+
+    age, fare, name, label = _raws()
+    st = _EagerOnly().set_input(age)
+    report = validate_graph([st.get_output()])
+    codes = _codes(report)
+    assert E_NO_APPLY in codes
+    assert E_HOST_OUTPUT in codes  # host-kind output from a device segment
+    assert report.issues(E_NO_APPLY)[0].stage_uid == st.uid
+
+
+def test_lint_unhashable_static_kwonly_default():
+    src = '''
+@partial(jax.jit, static_argnames=("opts",))
+def step(x, *, opts=[]):
+    return x
+'''
+    assert "L003" in _lint_codes(src)
+
+
+def test_score_stream_and_score_function_validate(monkeypatch):
+    # every compiled entry point shares the validated scorer gate
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.models import OpLogisticRegression
+    rng = np.random.default_rng(3)
+    ds = Dataset.from_rows(
+        [{"a": float(rng.normal()), "y": int(rng.integers(2))}
+         for _ in range(16)],
+        schema={"a": t.Real, "y": t.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    pred = OpLogisticRegression(max_iter=5) \
+        .set_input(label, transmogrify(preds)).get_output()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+    # sabotage the fitted graph: jittable stage with no device_apply
+    class _Broken(Transformer):
+        in_types = (t.Real,)
+
+        def transform(self, cols, ctx=None):
+            return cols[0]
+
+    broken = _Broken()
+    broken.input_features = (preds[0],)
+    model.result_features = tuple(model.result_features) + \
+        (broken.get_output(),)
+    model._compiled = None
+    with pytest.raises(GraphValidationError):
+        list(model.score_stream([ds]))
+    with pytest.raises(GraphValidationError):
+        model.score_function()

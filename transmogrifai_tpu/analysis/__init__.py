@@ -1,0 +1,15 @@
+"""Static + dynamic pipeline analysis: fail before the fit or the compile.
+
+- `analysis.opcheck`  — static feature-DAG validator (wiring, types,
+  cycles, response leakage, host/device contract), run by default from
+  `Workflow.train()` and `WorkflowModel.score_compiled()`
+- `analysis.lint`     — AST-based JAX-pitfall linter over stage source
+  (`python -m transmogrifai_tpu.lint <paths>`)
+- `analysis.retrace`  — runtime retracing detector wrapping the repo's
+  jit entry points (recompile-churn accounting per stage/program)
+"""
+
+from transmogrifai_tpu.analysis.opcheck import (  # noqa: F401
+    GraphValidationError, ValidationIssue, ValidationReport, validate_graph)
+from transmogrifai_tpu.analysis.retrace import (  # noqa: F401
+    MONITOR, RetraceMonitor, instrumented_jit)

@@ -1,0 +1,507 @@
+"""AST-based JAX-pitfall linter over stage/kernel source.
+
+Where `analysis/opcheck.py` validates a WIRED graph, this pass reads the
+source of the stages themselves for the pitfalls that only show up as
+silent slowness or nondeterminism once XLA is in the loop:
+
+- ``L001 numpy-in-device``: ``np.``/``numpy.`` use inside a jittable
+  stage's ``device_apply``/``device_apply_with`` body. Host numpy inside
+  a traced function either breaks the trace or (worse) silently constant-
+  folds per compile. Whitelisted: pure constants and dtype names
+  (``np.inf``, ``np.pi``, ``np.float32``, ...).
+- ``L002 traced-branch``: Python ``if``/``while`` (or ternary) testing a
+  traced value inside a device body — a branch on the ``dev``/``enc``
+  parameters or a value subscripted out of them. Under ``jax.jit`` this
+  raises a ConcretizationTypeError or, with weak typing, silently bakes
+  one branch into the compiled program. Testing the *container* itself
+  (``if enc:``) is static and allowed.
+- ``L003 unhashable-static``: a parameter listed in ``static_argnames``
+  whose default value is a mutable literal (list/dict/set) — unhashable
+  statics fail at call time, and mutable defaults silently share state
+  between traces.
+- ``L004 nondeterminism-in-fit``: wall-clock or global-RNG calls
+  (``time.time``, ``datetime.now``, ``np.random.rand``, seedless
+  ``default_rng()``, ``random.random``, ``uuid.uuid4``) inside ``fit``/
+  ``fit_model``/``device_apply`` bodies. Fits must be replayable from
+  the FitContext seed.
+- ``L005 host-prepare-device-input``: ``host_prepare`` subscripting an
+  input column whose declared ``in_types`` kind is device
+  (scalar/vector/prediction) — the compiled scorer passes None for
+  device-kind columns on the host phase, so that read crashes or
+  silently degrades (the contract documented in stages/base.py).
+
+Classes that set ``jittable = False`` in their body are exempt from
+L001/L002 (their device_apply runs eagerly on host, where numpy and
+Python control flow are legal).
+
+Run: ``python -m transmogrifai_tpu.lint <paths...>`` (exit 1 on findings)
+or via the ``lint`` subcommand of ``transmogrifai_tpu.cli``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_DEVICE_FNS = ("device_apply", "device_apply_with")
+_FIT_FNS = ("fit", "fit_model", "fit_arrays") + _DEVICE_FNS
+
+_NP_CONST_WHITELIST = {
+    "pi", "e", "inf", "nan", "newaxis", "euler_gamma",
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "bfloat16",
+    "finfo", "iinfo",
+}
+
+# exact dotted names only: `random.x` must not match jax.random.x /
+# np.random.x (keyed jax RNG is deterministic; np.random handled apart)
+_NONDET_EXACT = {
+    "random.random", "random.randint", "random.choice", "random.shuffle",
+    "random.uniform", "random.randrange", "random.sample",
+    "uuid.uuid4", "uuid.uuid1",
+}
+# suffix-matched (module aliases like `dt.datetime.now` still resolve)
+_NONDET_SUFFIX = {
+    "time.time", "time.time_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today", "date.today",
+}
+_NONDET_NP_RANDOM = {
+    "rand", "randn", "randint", "random", "choice", "shuffle",
+    "permutation", "uniform", "normal", "random_sample",
+}
+
+_DEVICE_KINDS = ("scalar", "vector", "prediction")
+
+
+@dataclass
+class LintFinding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    return isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp))
+
+
+def _own_jittable(cls: ast.ClassDef) -> Optional[bool]:
+    """The class body's own `jittable = ...` value (Assign or AnnAssign),
+    or None when it doesn't set one."""
+    for stmt in cls.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "jittable":
+                if isinstance(value, ast.Constant) and \
+                        isinstance(value.value, bool):
+                    return value.value
+                return None  # computed value: assume nothing
+    return None
+
+
+def _class_is_host(cls: ast.ClassDef,
+                   classes: Optional[Dict[str, ast.ClassDef]] = None,
+                   _seen: Tuple[str, ...] = ()) -> bool:
+    """True when the stage is host-path: its body sets jittable=False, it
+    subclasses HostTransformer, or a same-module base is itself host. An
+    explicit jittable=True in the body overrides any inherited host-ness."""
+    own = _own_jittable(cls)
+    if own is not None:
+        return own is False
+    for base in cls.bases:
+        dotted = _dotted(base)
+        if dotted is None:
+            continue
+        last = dotted.rsplit(".", 1)[-1]
+        if last == "HostTransformer":
+            return True
+        if classes is not None and last in classes and last not in _seen:
+            if _class_is_host(classes[last], classes, _seen + (last,)):
+                return True
+    return False
+
+
+def _class_in_types(cls: ast.ClassDef) -> Optional[List[Optional[str]]]:
+    """Type NAMES from an `in_types = (T.X, T.Y)` class-body assignment;
+    Ellipsis entries become '...'. None when undeclared/opaque."""
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "in_types":
+                    v = stmt.value
+                    if not isinstance(v, (ast.Tuple, ast.List)):
+                        return None
+                    out: List[Optional[str]] = []
+                    for e in v.elts:
+                        # the repo convention spells variadic as the NAME
+                        # `Ellipsis` (parsed as ast.Name), literal `...`
+                        # parses as a Constant — both mean variadic
+                        if (isinstance(e, ast.Constant)
+                                and e.value is Ellipsis) or \
+                                (isinstance(e, ast.Name)
+                                 and e.id == "Ellipsis"):
+                            out.append("...")
+                        else:
+                            d = _dotted(e)
+                            out.append(d.rsplit(".", 1)[-1] if d else None)
+                    return out
+    return None
+
+
+def _kind_of_type_name(name: Optional[str]) -> Optional[str]:
+    if name in (None, "..."):
+        return None
+    try:
+        from transmogrifai_tpu import types as T
+        from transmogrifai_tpu.data.columns import kind_of
+        return kind_of(T.feature_type_by_name(name))
+    except Exception:
+        return None
+
+
+def _static_argnames(fn: ast.FunctionDef) -> Set[str]:
+    """static_argnames/static_argnums declared by jit decorators on `fn`."""
+    names: Set[str] = set()
+    params = [a.arg for a in fn.args.args]
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        target = _dotted(dec.func)
+        calls = [dec]
+        # @partial(jax.jit, static_argnames=...) nests the jit reference
+        if target in ("partial", "functools.partial") and dec.args:
+            inner = _dotted(dec.args[0])
+            if inner not in ("jax.jit", "jit"):
+                continue
+        elif target not in ("jax.jit", "jit"):
+            continue
+        for call in calls:
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    for e in ast.walk(kw.value):
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, str):
+                            names.add(e.value)
+                if kw.arg == "static_argnums":
+                    for e in ast.walk(kw.value):
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, int) and \
+                                0 <= e.value < len(params):
+                            names.add(params[e.value])
+    return names
+
+
+def _jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        d = _dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+        if d in ("jax.jit", "jit"):
+            return True
+        if isinstance(dec, ast.Call) and d in ("partial",
+                                               "functools.partial"):
+            if dec.args and _dotted(dec.args[0]) in ("jax.jit", "jit"):
+                return True
+    return False
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str,
+                 classes: Optional[Dict[str, ast.ClassDef]] = None):
+        self.path = path
+        self.findings: List[LintFinding] = []
+        self._class_stack: List[ast.ClassDef] = []
+        self._classes = classes or {}  # module classes, for base resolution
+
+    def _emit(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(LintFinding(
+            self.path, getattr(node, "lineno", 0), code, message))
+
+    # -- structure ------------------------------------------------------- #
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        cls = self._class_stack[-1] if self._class_stack else None
+        host_class = cls is not None and _class_is_host(cls, self._classes)
+        in_method = cls is not None
+        if node.name in _DEVICE_FNS and in_method and not host_class:
+            self._check_device_body(node)
+        if node.name in _FIT_FNS and in_method:
+            self._check_nondeterminism(node)
+        if node.name == "host_prepare" and in_method and cls is not None \
+                and not host_class:
+            # host-path stages (jittable=False) always see materialized
+            # columns — the None contract only binds device stages
+            self._check_host_prepare(node, cls)
+        statics = _static_argnames(node)
+        if statics:
+            self._check_static_defaults(node, statics)
+        if _jit_decorated(node):
+            self._check_traced_branches(
+                node, traced_params={a.arg for a in node.args.args}
+                - statics - {"self"})
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- L001 + L002 over device bodies ----------------------------------- #
+
+    def _check_device_body(self, fn: ast.FunctionDef) -> None:
+        params = [a.arg for a in fn.args.args if a.arg != "self"]
+        # device_apply(self, enc, dev) / device_apply_with(self, c, enc, dev)
+        traced = set(params)
+        self._check_numpy_use(fn)
+        self._check_traced_branches(fn, traced_params=traced)
+
+    def _check_numpy_use(self, fn: ast.FunctionDef) -> None:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id in ("np", "numpy") and \
+                    sub.attr not in _NP_CONST_WHITELIST:
+                self._emit(
+                    sub, "L001",
+                    f"numpy call `{sub.value.id}.{sub.attr}` inside "
+                    f"`{fn.name}` — host numpy breaks/escapes the XLA "
+                    "trace; use jax.numpy, or move the work to "
+                    "host_prepare")
+
+    def _check_traced_branches(self, fn: ast.FunctionDef,
+                               traced_params: Set[str]) -> None:
+        if not traced_params:
+            return
+        tainted = set(traced_params)
+        # one level of value flow: x = dev[0] / v = enc["k"] taints x/v
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Subscript):
+                base = sub.value.value
+                if isinstance(base, ast.Name) and base.id in traced_params:
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            tainted.add(tgt.id)
+
+        def test_is_traced(test: ast.AST) -> bool:
+            # `if enc:` (container truthiness) and `if dev[0] is None:`
+            # (identity vs None) are static under tracing — what breaks is
+            # a VALUE comparison/read: `if x > 0`, `while dev[1]:` etc.
+            exempt: set = set()
+            for n in ast.walk(test):
+                if isinstance(n, ast.Compare) and all(
+                        isinstance(o, (ast.Is, ast.IsNot)) for o in n.ops):
+                    for m in ast.walk(n):
+                        exempt.add(id(m))
+            for n in ast.walk(test):
+                if id(n) in exempt:
+                    continue
+                if isinstance(n, ast.Subscript) and \
+                        isinstance(n.value, ast.Name) and \
+                        n.value.id in tainted:
+                    return True
+                if isinstance(n, ast.Compare):
+                    for m in ast.walk(n):
+                        if isinstance(m, ast.Name) and m.id in tainted:
+                            return True
+                # bare truthiness of a VALUE pulled out of a param
+                # (`x = dev[0]` then `if x:`) raises
+                # TracerBoolConversionError; bare truthiness of the param
+                # itself stays exempt (container/pytree args are common)
+                if isinstance(n, ast.Name) and n.id in tainted and \
+                        n.id not in traced_params:
+                    return True
+            return False
+
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.If, ast.While, ast.IfExp)) and \
+                    test_is_traced(sub.test):
+                kind = type(sub).__name__.lower()
+                self._emit(
+                    sub, "L002",
+                    f"Python `{kind}` on a traced value inside "
+                    f"`{fn.name}` — use jnp.where/lax.cond (branching on "
+                    "tracers fails or bakes one path into the compile)")
+
+    # -- L003 -------------------------------------------------------------- #
+
+    def _check_static_defaults(self, fn: ast.FunctionDef,
+                               statics: Set[str]) -> None:
+        args = fn.args.args
+        defaults = fn.args.defaults
+        offset = len(args) - len(defaults)
+        pairs = [(args[offset + i].arg, d) for i, d in enumerate(defaults)]
+        # keyword-only statics carry their defaults in kw_defaults
+        pairs += [(a.arg, d) for a, d in zip(fn.args.kwonlyargs,
+                                             fn.args.kw_defaults)
+                  if d is not None]
+        for name, d in pairs:
+            if name in statics and _is_mutable_literal(d):
+                self._emit(
+                    d, "L003",
+                    f"static arg `{name}` of `{fn.name}` has a mutable "
+                    "default — statics must be hashable (tuple/frozenset/"
+                    "scalar), and mutable defaults alias across traces")
+
+    # -- L004 -------------------------------------------------------------- #
+
+    def _check_nondeterminism(self, fn: ast.FunctionDef) -> None:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func)
+            if dotted is None:
+                continue
+            if dotted in _NONDET_EXACT or dotted in _NONDET_SUFFIX or \
+                    any(dotted.endswith("." + c) for c in _NONDET_SUFFIX):
+                self._emit(
+                    sub, "L004",
+                    f"nondeterministic call `{dotted}` inside `{fn.name}` "
+                    "— fits must replay from the FitContext seed")
+                continue
+            parts = dotted.split(".")
+            if len(parts) >= 3 and parts[-2] == "random" and \
+                    parts[0] in ("np", "numpy"):
+                if parts[-1] in _NONDET_NP_RANDOM:
+                    self._emit(
+                        sub, "L004",
+                        f"global-state RNG `{dotted}` inside `{fn.name}` "
+                        "— use np.random.default_rng(ctx.seed)")
+                elif parts[-1] == "default_rng" and not sub.args:
+                    self._emit(
+                        sub, "L004",
+                        f"seedless `{dotted}()` inside `{fn.name}` — pass "
+                        "the FitContext seed")
+
+    # -- L005 -------------------------------------------------------------- #
+
+    def _check_host_prepare(self, fn: ast.FunctionDef,
+                            cls: ast.ClassDef) -> None:
+        in_types = _class_in_types(cls)
+        if not in_types:
+            return
+        params = [a.arg for a in fn.args.args if a.arg != "self"]
+        if not params:
+            return
+        cols_param = params[0]
+        variadic = len(in_types) == 2 and in_types[1] == "..."
+        for node in ast.walk(fn):
+            # only DIRECT dereferences `cols[i].attr` violate the contract;
+            # `c = cols[i]` followed by a None-guard is the sanctioned idiom
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Subscript)):
+                continue
+            sub = node.value
+            if not (isinstance(sub.value, ast.Name)
+                    and sub.value.id == cols_param):
+                continue
+            idx = sub.slice
+            if not (isinstance(idx, ast.Constant)
+                    and isinstance(idx.value, int)):
+                continue
+            i = idx.value
+            tname = in_types[0] if variadic else (
+                in_types[i] if 0 <= i < len(in_types) else None)
+            kind = _kind_of_type_name(tname)
+            if kind in _DEVICE_KINDS:
+                self._emit(
+                    sub, "L005",
+                    f"host_prepare reads cols[{i}] which is declared "
+                    f"{tname} ({kind} kind) — device-kind columns may be "
+                    "None on the compiled host phase; read them in "
+                    "device_apply via `dev` instead")
+
+
+# -- driver ----------------------------------------------------------------- #
+
+def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
+    """Lint one source string (unit-test entry point)."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [LintFinding(path, e.lineno or 0, "L000",
+                            f"syntax error: {e.msg}")]
+    classes = {n.name: n for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef)}
+    linter = _FileLinter(path, classes)
+    linter.visit(tree)
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
+
+
+def lint_file(path: str) -> List[LintFinding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def iter_py_files(paths: Sequence[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def lint_paths(paths: Sequence[str]) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m transmogrifai_tpu.lint",
+        description="JAX-pitfall lint over stage/kernel source")
+    parser.add_argument("paths", nargs="+",
+                        help=".py files or directories to lint")
+    args = parser.parse_args(argv)
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must not pass a CI gate as "0 findings"
+        for p in missing:
+            print(f"lint: path does not exist: {p}", file=sys.stderr)
+        return 2
+    findings: List[LintFinding] = []
+    n_files = 0
+    for path in iter_py_files(args.paths):
+        n_files += 1
+        findings.extend(lint_file(path))
+    for f in findings:
+        print(f)
+    print(f"lint: {len(findings)} finding(s) in {n_files} file(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,488 @@
+"""Static feature-DAG validation (`opcheck`): catch bad pipelines before
+paying for a fit or an XLA compile.
+
+The reference framework's core value proposition is failing BEFORE the
+expensive part (SanityChecker, RawFeatureFilter, typed Feature wiring —
+PAPER.md §1). The JAX port adds a second expensive part the reference never
+had: XLA compilation of the fused DAG. This module walks the lazy feature
+graph — no data, no tracing — and reports every statically detectable
+wiring defect as a structured `ValidationReport`:
+
+errors (fail the train under ``strict=True``, the default):
+
+- ``arity`` / ``type-mismatch``  — stage ``in_types`` vs. wired features,
+  re-checked per edge (graphs built via `clone_graph`, deserialization, or
+  direct `Feature(...)` construction bypass `set_input`'s eager check)
+- ``duplicate-uid``              — two distinct Feature/Stage objects
+  sharing one uid (breaks column keying and serialization)
+- ``cycle``                      — cyclic wiring, with the full offending
+  feature path in the message
+- ``response-leakage``           — a response-rooted feature reachable as
+  a predictor: either mixed with predictors by a stage that is not
+  ``response_aware``, or an ancestor of a response-aware stage's
+  feature-vector slot (the classic label leak)
+- ``raw-not-generator``          — a parentless feature whose origin is
+  not a FeatureGeneratorStage (the scheduler would place it in layer 0
+  and crash at materialization)
+- ``device-host-output``         — a jittable Transformer whose output
+  feature has host kind (text/list/map): `Transformer._wrap` raises at
+  the first transform
+- ``device-host-input``          — a jittable Transformer wired to a
+  host-kind input without a ``host_prepare`` override: ``device_apply``
+  would receive None for that column in the compiled plan
+- ``device-no-apply``            — a jittable Transformer implementing no
+  ``device_apply``: the compiled planner places it in a device segment
+  (a ``transform`` override only covers the eager path), so the first
+  compiled scoring call raises NotImplementedError
+
+warnings (never fail the train, reported for inspection):
+
+- ``dead-stage``     — a feature in ``universe`` that is not an ancestor
+  of any result feature (its stage fits for nothing)
+- ``segment-split``  — a host stage consuming a device-produced feature:
+  legal, but it splits the fused XLA program into segments and forces a
+  device→host materialization (see workflow/compiled.py)
+- ``wiring-drift``   — a feature's ``parents`` differ from its origin
+  stage's ``input_features`` (stale ``get_output`` after a re-wire)
+
+`Workflow.train()` and `WorkflowModel.score_compiled()` run this by
+default; pass ``strict=False`` to downgrade errors to logged warnings.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from transmogrifai_tpu.data.columns import kind_of
+from transmogrifai_tpu.stages.base import (
+    HOST_KINDS, FeatureGeneratorStage, Transformer, is_host_stage)
+
+log = logging.getLogger(__name__)
+
+# -- issue codes ----------------------------------------------------------- #
+
+E_ARITY = "arity"
+E_TYPE = "type-mismatch"
+E_DUP_UID = "duplicate-uid"
+E_CYCLE = "cycle"
+E_LEAKAGE = "response-leakage"
+E_RAW = "raw-not-generator"
+E_HOST_OUTPUT = "device-host-output"
+E_HOST_INPUT = "device-host-input"
+E_NO_APPLY = "device-no-apply"
+W_DEAD = "dead-stage"
+W_SPLIT = "segment-split"
+W_WIRING = "wiring-drift"
+
+@dataclass
+class ValidationIssue:
+    """One defect: machine-readable code + human hint, anchored to a stage."""
+
+    code: str
+    message: str
+    stage_uid: Optional[str] = None
+    feature: Optional[str] = None
+    hint: Optional[str] = None
+
+    def __str__(self) -> str:
+        loc = f" [stage {self.stage_uid}]" if self.stage_uid else ""
+        hint = f"\n    fix: {self.hint}" if self.hint else ""
+        return f"[{self.code}]{loc} {self.message}{hint}"
+
+
+@dataclass
+class ValidationReport:
+    errors: List[ValidationIssue] = field(default_factory=list)
+    warnings: List[ValidationIssue] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def issues(self, code: str) -> List[ValidationIssue]:
+        return [i for i in self.errors + self.warnings if i.code == code]
+
+    def raise_if_errors(self) -> "ValidationReport":
+        if self.errors:
+            raise GraphValidationError(self)
+        return self
+
+    def __str__(self) -> str:
+        lines = [f"Feature-DAG validation: {len(self.errors)} error(s), "
+                 f"{len(self.warnings)} warning(s)"]
+        for i in self.errors:
+            lines.append(f"  ERROR {i}")
+        for i in self.warnings:
+            lines.append(f"  WARN  {i}")
+        return "\n".join(lines)
+
+
+class GraphValidationError(RuntimeError):
+    """Raised by strict validation; `.report` carries the structured issues."""
+
+    def __init__(self, report: ValidationReport):
+        super().__init__(str(report))
+        self.report = report
+
+
+# -- helpers ---------------------------------------------------------------- #
+
+def _stage_kind(stage) -> str:
+    """'host' | 'device' | 'other' — delegates to the compiled planner's
+    own `is_host_stage` rule (stages/base.py) so the validator can never
+    drift from the plan the scorer actually builds; estimators and
+    generators are 'other'."""
+    if isinstance(stage, Transformer):
+        return "host" if is_host_stage(stage) else "device"
+    return "other"
+
+
+def _safe_kind(ftype) -> Optional[str]:
+    try:
+        return kind_of(ftype)
+    except TypeError:
+        return None
+
+
+def _type_name(t) -> str:
+    return getattr(t, "__name__", str(t))
+
+
+class _Walker:
+    """One DFS over stage edges collecting features/stages, detecting cycles
+    and duplicate uids. Identity-based memoization: uid collisions between
+    DISTINCT objects must be seen, not hidden."""
+
+    def __init__(self):
+        self.features: List = []          # in first-visit order
+        self.stages: List = []
+        self.feature_by_uid: Dict[str, object] = {}
+        self.stage_by_uid: Dict[str, object] = {}
+        self.issues: List[ValidationIssue] = []
+        self._seen_f: set = set()         # id(feature)
+        self._seen_s: set = set()         # id(stage)
+        self._stack: List = []            # stage objects on the DFS path
+
+    def visit_feature(self, f) -> None:
+        if id(f) in self._seen_f:
+            # re-entry through a memoized feature can still close a loop:
+            # its origin stage being on the current DFS path IS the cycle
+            s = f.origin_stage
+            if s is not None and any(s is x for x in self._stack):
+                self._report_cycle(s)
+            return
+        self._seen_f.add(id(f))
+        prev = self.feature_by_uid.get(f.uid)
+        if prev is None:
+            self.feature_by_uid[f.uid] = f
+        elif prev is not f:
+            self.issues.append(ValidationIssue(
+                E_DUP_UID,
+                f"feature uid {f.uid!r} is shared by two distinct features "
+                f"({prev.name!r} and {f.name!r})",
+                feature=f.name,
+                hint="uids key columns and serialization — regenerate one "
+                     "of the features instead of reusing the uid"))
+        self.features.append(f)
+        if f.origin_stage is not None:
+            self.visit_stage(f.origin_stage)
+
+    def visit_stage(self, s) -> None:
+        if id(s) in self._seen_s:
+            if any(s is x for x in self._stack):
+                self._report_cycle(s)
+            return
+        if any(s is x for x in self._stack):
+            self._report_cycle(s)
+            return
+        self._seen_s.add(id(s))
+        prev = self.stage_by_uid.get(s.uid)
+        if prev is None:
+            self.stage_by_uid[s.uid] = s
+        elif prev is not s:
+            self.issues.append(ValidationIssue(
+                E_DUP_UID,
+                f"stage uid {s.uid!r} is shared by two distinct "
+                f"{type(prev).__name__}/{type(s).__name__} instances",
+                stage_uid=s.uid,
+                hint="construct stages without passing an explicit reused "
+                     "uid (fitted models legitimately keep their "
+                     "estimator's uid, but only one of the pair may be "
+                     "wired into a graph)"))
+        self.stages.append(s)
+        self._stack.append(s)
+        try:
+            for p in s.input_features:
+                self.visit_feature(p)
+        finally:
+            self._stack.pop()
+
+    def _report_cycle(self, s) -> None:
+        start = next(i for i, x in enumerate(self._stack) if x is s)
+        path = [x.operation_name for x in self._stack[start:]] + \
+               [s.operation_name]
+        # one report per distinct cycle entry stage
+        if any(i.code == E_CYCLE and i.stage_uid == s.uid
+               for i in self.issues):
+            return
+        self.issues.append(ValidationIssue(
+            E_CYCLE,
+            "feature graph contains a cycle: " + " -> ".join(path),
+            stage_uid=s.uid,
+            hint="a feature cannot be (transitively) its own input; break "
+                 "the loop at one of the listed stages"))
+        self._seen_s.add(id(s))  # do not re-descend into the loop
+
+
+# -- the checks ------------------------------------------------------------- #
+
+def _check_arity_types(stage, out: List[ValidationIssue]) -> None:
+    if isinstance(stage, FeatureGeneratorStage):
+        return
+    feats = stage.input_features
+    if not feats:
+        out.append(ValidationIssue(
+            E_RAW,
+            f"{stage.operation_name} has no inputs but is not a feature "
+            "generator — the scheduler would place it in layer 0 and fail",
+            stage_uid=stage.uid,
+            hint="call set_input(...) before wiring its output, or use a "
+                 "FeatureGeneratorStage for raw features"))
+        return
+    spec = stage.in_types
+    if spec is None:
+        return
+    if len(spec) == 2 and spec[1] is Ellipsis:
+        elem = spec[0]
+        if elem is None:
+            return
+        for f in feats:
+            if not issubclass(f.ftype, elem):
+                out.append(ValidationIssue(
+                    E_TYPE,
+                    f"{stage.operation_name} requires inputs of type "
+                    f"{_type_name(elem)}; input {f.name!r} is "
+                    f"{_type_name(f.ftype)}",
+                    stage_uid=stage.uid, feature=f.name,
+                    hint=f"convert {f.name!r} to {_type_name(elem)} (or "
+                         "drop it from this stage's inputs)"))
+        return
+    if len(feats) != len(spec):
+        out.append(ValidationIssue(
+            E_ARITY,
+            f"{stage.operation_name} requires {len(spec)} input(s), got "
+            f"{len(feats)} ({', '.join(f.name for f in feats)})",
+            stage_uid=stage.uid,
+            hint="re-wire with exactly the declared arity via set_input"))
+        return
+    for f, t in zip(feats, spec):
+        if t is not None and not issubclass(f.ftype, t):
+            out.append(ValidationIssue(
+                E_TYPE,
+                f"{stage.operation_name} input {f.name!r}: expected "
+                f"{_type_name(t)}, got {_type_name(f.ftype)}",
+                stage_uid=stage.uid, feature=f.name,
+                hint=f"feed a {_type_name(t)}-typed feature into this "
+                     "slot"))
+
+
+def _check_host_device(stage, out_feature, errs: List[ValidationIssue],
+                       warns: List[ValidationIssue]) -> None:
+    kind = _stage_kind(stage)
+    if kind == "device":
+        # the compiled planner puts this stage in a DEVICE segment, where
+        # only device_apply runs — a transform() override cannot save it
+        # there (it would only cover the eager fit/score path)
+        own_apply = (
+            type(stage).device_apply is not Transformer.device_apply
+            or type(stage).device_apply_with
+            is not Transformer.device_apply_with)
+        own_prepare = (type(stage).host_prepare
+                       is not Transformer.host_prepare)
+        if not own_apply:
+            errs.append(ValidationIssue(
+                E_NO_APPLY,
+                f"{stage.operation_name} is jittable (device-planned) but "
+                "implements no device_apply — the compiled scorer would "
+                "raise NotImplementedError at the first scoring call",
+                stage_uid=stage.uid,
+                hint="implement device_apply(), or set jittable=False if "
+                     "the stage is host-side numpy (transform overrides "
+                     "only cover the eager path)"))
+        out_kind = (_safe_kind(out_feature.ftype)
+                    if out_feature is not None else None)
+        if out_kind in HOST_KINDS:
+            errs.append(ValidationIssue(
+                E_HOST_OUTPUT,
+                f"{stage.operation_name} is jittable but its output "
+                f"{out_feature.name!r} has host kind {out_kind!r} — "
+                "device segments cannot produce host-kind values "
+                "(Transformer._wrap raises on the eager path too)",
+                stage_uid=stage.uid, feature=out_feature.name,
+                hint="set jittable=False and override transform() (or "
+                     "subclass HostTransformer) for host-kind outputs"))
+        if not own_prepare:
+            for f in stage.input_features:
+                k = _safe_kind(f.ftype)
+                if k in HOST_KINDS:
+                    errs.append(ValidationIssue(
+                        E_HOST_INPUT,
+                        f"{stage.operation_name} is jittable and consumes "
+                        f"host-kind ({k}) input {f.name!r} but does not "
+                        "override host_prepare — device_apply would "
+                        "receive None for that column",
+                        stage_uid=stage.uid, feature=f.name,
+                        hint="encode the host column in host_prepare() and "
+                             "read it from `enc` in device_apply()"))
+    elif kind == "host":
+        for f in stage.input_features:
+            k = _safe_kind(f.ftype)
+            if (k is not None and k not in HOST_KINDS and not f.is_raw
+                    and _stage_kind(f.origin_stage) == "device"):
+                warns.append(ValidationIssue(
+                    W_SPLIT,
+                    f"host stage {stage.operation_name} consumes "
+                    f"device-produced feature {f.name!r} — the fused XLA "
+                    "program splits into segments here and the feature "
+                    "materializes device->host",
+                    stage_uid=stage.uid, feature=f.name,
+                    hint="if scoring throughput matters, move host-side "
+                         "work upstream of the device stages or make this "
+                         "stage jittable"))
+
+
+def _response_taint(features: Sequence) -> Dict[str, bool]:
+    """feature uid -> True when a response feature is reachable through
+    parents WITHOUT passing a response-aware stage (whose outputs — e.g. a
+    Prediction — are sanctioned, not leaks)."""
+    taint: Dict[str, bool] = {}
+
+    def visit(f) -> bool:
+        if f.uid in taint:
+            return taint[f.uid]
+        taint[f.uid] = False  # breaks cycles; cycle itself reported apart
+        if f.is_response:
+            t = True
+        elif f.origin_stage is not None and \
+                getattr(f.origin_stage, "response_aware", False):
+            t = False
+        else:
+            t = any(visit(p) for p in f.parents)
+        taint[f.uid] = t
+        return t
+
+    for f in features:
+        visit(f)
+    return taint
+
+
+def _leak_path(f, taint: Dict[str, bool]) -> List[str]:
+    """Name path from a response ancestor down to `f` (for the fix hint)."""
+    path: List[str] = []
+    cur = f
+    guard = 0
+    while cur is not None and guard < 1000:
+        guard += 1
+        path.append(cur.name)
+        if cur.is_response:
+            break
+        cur = next((p for p in cur.parents if taint.get(p.uid)), None)
+    return list(reversed(path))
+
+
+def _check_leakage(stage, taint: Dict[str, bool],
+                   errs: List[ValidationIssue]) -> None:
+    if isinstance(stage, FeatureGeneratorStage) or not stage.input_features:
+        return
+    feats = stage.input_features
+    if getattr(stage, "response_aware", False):
+        # slot 0 is the sanctioned label slot; predictor slots must be clean
+        for f in feats[1:]:
+            if taint.get(f.uid):
+                errs.append(ValidationIssue(
+                    E_LEAKAGE,
+                    f"response feature leaks into the predictor input "
+                    f"{f.name!r} of {stage.operation_name} "
+                    f"(path: {' -> '.join(_leak_path(f, taint))})",
+                    stage_uid=stage.uid, feature=f.name,
+                    hint="remove the response (or anything derived from "
+                         "it) from the feature-engineering inputs; only "
+                         "the label slot may see it"))
+        return
+    flags = [bool(taint.get(f.uid)) for f in feats]
+    if any(flags) and not all(flags):
+        bad = next(f for f, t in zip(feats, flags) if t)
+        errs.append(ValidationIssue(
+            E_LEAKAGE,
+            f"{stage.operation_name} mixes response-derived input "
+            f"{bad.name!r} with predictors "
+            f"(path: {' -> '.join(_leak_path(bad, taint))}) but is not a "
+            "response-aware stage",
+            stage_uid=stage.uid, feature=bad.name,
+            hint="only response-aware stages (models, SanityChecker, "
+                 "supervised bucketizers) may combine the label with "
+                 "predictors"))
+
+
+# -- entry point ------------------------------------------------------------ #
+
+def validate_graph(result_features: Sequence,
+                   universe: Optional[Sequence] = None) -> ValidationReport:
+    """Validate the DAG reachable from `result_features` without touching
+    data. `universe` (optional) is the full set of features the caller
+    declared; members that are not ancestors of any result get a
+    ``dead-stage`` warning. Never raises on a bad graph — returns the
+    report (use `.raise_if_errors()` for strict behavior)."""
+    walker = _Walker()
+    for f in result_features:
+        walker.visit_feature(f)
+
+    errors: List[ValidationIssue] = [
+        i for i in walker.issues]  # dup-uid + cycle from the walk
+    warnings: List[ValidationIssue] = []
+
+    taint = _response_taint(walker.features)
+    out_by_stage: Dict[int, object] = {}
+    for f in walker.features:  # first output feature wins, like the walk
+        if f.origin_stage is not None:
+            out_by_stage.setdefault(id(f.origin_stage), f)
+    seen_stage_uids = set()
+    for stage in walker.stages:
+        if stage.uid in seen_stage_uids:
+            continue
+        seen_stage_uids.add(stage.uid)
+        _check_arity_types(stage, errors)
+        out_feature = out_by_stage.get(id(stage))
+        _check_host_device(stage, out_feature, errors, warnings)
+        _check_leakage(stage, taint, errors)
+        if (out_feature is not None and stage.input_features
+                and tuple(out_feature.parents)
+                != tuple(stage.input_features)):
+            warnings.append(ValidationIssue(
+                W_WIRING,
+                f"{stage.operation_name}: output feature "
+                f"{out_feature.name!r} records different parents than the "
+                "stage's current input_features (stale get_output after a "
+                "re-wire?)",
+                stage_uid=stage.uid, feature=out_feature.name,
+                hint="call set_input(...) before get_output() and re-wire "
+                     "downstream consumers of the old output"))
+
+    if universe:
+        reachable = set(walker.feature_by_uid)
+        for f in universe:
+            if f.uid not in reachable:
+                warnings.append(ValidationIssue(
+                    W_DEAD,
+                    f"feature {f.name!r} "
+                    f"({f.origin_stage.operation_name if f.origin_stage else 'raw'}) "
+                    "is not an ancestor of any result feature — its stage "
+                    "would fit for nothing",
+                    stage_uid=(f.origin_stage.uid
+                               if f.origin_stage is not None else None),
+                    feature=f.name,
+                    hint="wire it into a result feature or drop it"))
+
+    return ValidationReport(errors=errors, warnings=warnings)

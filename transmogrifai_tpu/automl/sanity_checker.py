@@ -276,6 +276,7 @@ class SanityCheckerModel(Transformer):
     """Fitted checker: static column gather of the kept indices."""
 
     out_type = T.OPVector
+    response_aware = True  # inputs stay (label, vector) post-fit
 
     def __init__(self, indices: Sequence[int], meta: Optional[Dict] = None,
                  summary: Optional[Dict] = None, uid: Optional[str] = None):
@@ -313,6 +314,7 @@ class SanityChecker(Estimator):
 
     in_types = (T.RealNN, T.OPVector)
     out_type = T.OPVector
+    response_aware = True  # slot 0 is the label
 
     def __init__(self, max_correlation: float = MAX_CORRELATION,
                  min_correlation: float = MIN_CORRELATION,

@@ -20,7 +20,30 @@ from transmogrifai_tpu.stages.base import Estimator, FeatureGeneratorStage, Stag
 
 
 class FeatureCycleError(RuntimeError):
-    """The feature graph contains a cycle (FeatureCycleException analogue)."""
+    """The feature graph contains a cycle (FeatureCycleException analogue).
+
+    `path` carries the offending stage chain (operation names, first
+    repeated stage at both ends) so the error names the actual loop
+    instead of just one stage on it."""
+
+    def __init__(self, message: str, path: Sequence[str] = ()):
+        super().__init__(message)
+        self.path = list(path)
+
+
+def _clone_stage(stage: Stage) -> Stage:
+    """Shallow stage copy that does NOT share mutable param state.
+
+    A bare `copy.copy` aliases `params` (and any nested dict/list values)
+    between the clone and the original, so train-time mutations —
+    `apply_stage_params` overrides, estimators caching into their params —
+    would leak back into the user's graph. Containers are copied one level
+    deep; leaf values (arrays, fns, scalars) are shared intentionally."""
+    cs = copy.copy(stage)
+    cs.params = {
+        k: (v.copy() if isinstance(v, (dict, list, set)) else v)
+        for k, v in stage.params.items()}
+    return cs
 
 
 def clone_graph(result_features: Sequence) -> List:
@@ -47,7 +70,7 @@ def clone_graph(result_features: Sequence) -> List:
         stage = getattr(stage, "_estimator", None) or stage
         cs = smap.get(stage.uid)
         if cs is None:
-            cs = copy.copy(stage)
+            cs = _clone_stage(stage)
             cs._output = None
             smap[stage.uid] = cs
         if parents:
@@ -92,7 +115,7 @@ def rewire_without(result_features: Sequence, blocked_raw: Sequence[str]):
             return None
         cs = smap.get(stage.uid)
         if cs is None:
-            cs = copy.copy(stage)
+            cs = _clone_stage(stage)
             cs._output = None
             cs.input_features = kept
             smap[stage.uid] = cs
@@ -137,19 +160,33 @@ def topological_layers(result_features: Sequence) -> List[List[Stage]]:
     depth: Dict[str, int] = {}
     stages: Dict[str, Stage] = {}
     visiting: set = set()
+    stack: List[Stage] = []  # DFS path, for cycle reporting
 
     def visit(stage: Stage) -> int:
         if stage.uid in depth:
             return depth[stage.uid]
         if stage.uid in visiting:
+            start = next(i for i, s in enumerate(stack)
+                         if s.uid == stage.uid)
+            loop = stack[start:] + [stage]
+            names = [f"{s.operation_name}({s.get_output().name})"
+                     if s._output is not None else s.operation_name
+                     for s in loop]
             raise FeatureCycleError(
-                f"Cycle detected through stage {stage.operation_name} ({stage.uid})")
+                "Cycle detected in the feature graph: "
+                + " -> ".join(names)
+                + f" (stage uids: {', '.join(s.uid for s in loop)})",
+                path=[s.operation_name for s in loop])
         visiting.add(stage.uid)
-        if isinstance(stage, FeatureGeneratorStage) or not stage.input_features:
-            d = 0
-        else:
-            d = 1 + max(visit(p.origin_stage) for p in stage.input_features)
-        visiting.discard(stage.uid)
+        stack.append(stage)
+        try:
+            if isinstance(stage, FeatureGeneratorStage) or not stage.input_features:
+                d = 0
+            else:
+                d = 1 + max(visit(p.origin_stage) for p in stage.input_features)
+        finally:
+            stack.pop()
+            visiting.discard(stage.uid)
         depth[stage.uid] = d
         stages[stage.uid] = stage
         return d

@@ -80,6 +80,14 @@ class Feature:
             "stages": stages,
         }
 
+    def validate(self, universe: Sequence["Feature"] = ()):
+        """Static opcheck of the DAG rooted at this feature — wiring,
+        types, cycles, response leakage, host/device contract — without
+        touching data. Returns an `analysis.opcheck.ValidationReport`;
+        `Workflow.train()` runs the same pass over all result features."""
+        from transmogrifai_tpu.analysis.opcheck import validate_graph
+        return validate_graph([self], universe=universe)
+
     def __repr__(self) -> str:
         kind = "response" if self.is_response else "predictor"
         return f"Feature<{self.ftype.__name__}>({self.name!r}, {kind})"

@@ -44,6 +44,10 @@ def _pred_matrix(pred_col: Column) -> np.ndarray:
 class RecordInsightsCorrModel(Transformer):
     in_types = (T.Prediction, T.OPVector)
     out_type = T.TextMap
+    # host-path: transform() is numpy end-to-end and there is no
+    # device_apply — without this flag the compiled planner would trace
+    # the stage into a device segment and crash (opcheck device-no-apply)
+    jittable = False
 
     def __init__(self, corr=None, shift=None, scale=None, names=None,
                  top_k: int = 20, uid: Optional[str] = None):

@@ -1,0 +1,11 @@
+"""`python -m transmogrifai_tpu.lint <paths...>` — JAX-pitfall linter.
+
+Thin runnable alias for `transmogrifai_tpu.analysis.lint` (kept import-light:
+linting must not require a working JAX install)."""
+
+import sys
+
+from transmogrifai_tpu.analysis.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

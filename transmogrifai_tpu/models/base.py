@@ -29,6 +29,7 @@ class PredictionModel(Transformer):
     """Fitted predictor: device_apply returns the Prediction pytree."""
 
     out_type = T.Prediction
+    response_aware = True  # inputs are (label, features)
 
     def predict_arrays(self, X: jnp.ndarray) -> Dict[str, jnp.ndarray]:
         raise NotImplementedError
@@ -43,6 +44,7 @@ class PredictorEstimator(Estimator):
 
     in_types = (T.RealNN, T.OPVector)
     out_type = T.Prediction
+    response_aware = True  # slot 0 is the label
 
     def fit_arrays(self, X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
                    ctx: FitContext) -> PredictionModel:

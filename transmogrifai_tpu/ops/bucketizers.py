@@ -186,6 +186,7 @@ class DecisionTreeNumericBucketizer(Estimator):
 
     in_types = (T.OPNumeric, T.OPNumeric)  # (response, numeric predictor)
     out_type = T.OPVector
+    response_aware = True  # supervised: slot 0 is the label
 
     def __init__(self, max_depth: int = 2, min_info_gain: float = 1e-6,
                  min_instances_per_node: int = 1, track_nulls: bool = True,
@@ -222,6 +223,7 @@ class DecisionTreeBucketizerModel(Transformer):
 
     in_types = (T.OPNumeric, T.OPNumeric)
     out_type = T.OPVector
+    response_aware = True  # wiring keeps (label, numeric) post-fit
 
     def __init__(self, thresholds: Sequence[float], track_nulls: bool = True,
                  uid: Optional[str] = None):
@@ -277,6 +279,7 @@ class DecisionTreeNumericMapBucketizer(Estimator):
 
     in_types = (T.OPNumeric, T.OPMap)
     out_type = T.OPVector
+    response_aware = True  # supervised: slot 0 is the label
 
     def __init__(self, max_depth: int = 2, min_info_gain: float = 1e-6,
                  track_nulls: bool = True, uid: Optional[str] = None):
@@ -308,6 +311,7 @@ class DecisionTreeNumericMapBucketizer(Estimator):
 class DecisionTreeMapBucketizerModel(Transformer):
     in_types = (T.OPNumeric, T.OPMap)
     out_type = T.OPVector
+    response_aware = True  # wiring keeps (label, map) post-fit
     jittable = False  # map input needs host-side key extraction
 
     def __init__(self, splits_by_key, track_nulls: bool = True,

@@ -139,10 +139,18 @@ def time_period_value(ms: np.ndarray, period: str) -> np.ndarray:
 
 
 class TimePeriodTransformer(Transformer):
-    """Date → Integral calendar unit (`TimePeriodTransformer.scala`)."""
+    """Date → Integral calendar unit (`TimePeriodTransformer.scala`).
+
+    Host-path stage: ALL the work is datetime64 calendar math in
+    host_prepare (device_apply just forwards the encoding), and reading a
+    device-kind (Date/scalar) input from host_prepare violates the
+    compiled scorer's contract for jittable stages — inside a fused plan
+    the column may be None. jittable=False keeps it in host segments
+    where inputs are always materialized."""
 
     in_types = (T.Date,)
     out_type = T.Integral
+    jittable = False
 
     def __init__(self, period: str = "DayOfWeek", uid: Optional[str] = None):
         if period not in TIME_PERIODS:

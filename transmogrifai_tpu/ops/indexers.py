@@ -141,6 +141,7 @@ class PredictionDeIndexer(HostTransformer):
 
     in_types = (T.OPNumeric, T.Prediction)
     out_type = T.Text
+    response_aware = True  # slot 0 is the (indexed) label
 
     def __init__(self, labels: Optional[Sequence[str]] = None,
                  uid: Optional[str] = None):

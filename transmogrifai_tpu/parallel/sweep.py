@@ -134,7 +134,7 @@ def _shard_dyn(dyn: Dict[str, jnp.ndarray],
 
 
 def _run_block(one_cfg: Callable, dyn: Dict[str, jnp.ndarray], sharding,
-               grid_vmap: bool):
+               grid_vmap: bool, label: str = "sweep:block"):
     """Execute one grid block: one_cfg(dyn_slice) over the grid axis.
 
     vmap → parallel over grids (sharded across the mesh's sweep axis when
@@ -143,11 +143,13 @@ def _run_block(one_cfg: Callable, dyn: Dict[str, jnp.ndarray], sharding,
     jax output (a (g, k) metric array, or a prediction pytree with leading
     (g, k) axes on the host-metric fallback path).
     """
+    from transmogrifai_tpu.analysis.retrace import instrumented_jit
     dyn, g = _shard_dyn(dyn, sharding)
     if grid_vmap or sharding is not None:
-        prog = jax.jit(jax.vmap(one_cfg))
+        prog = instrumented_jit(jax.vmap(one_cfg), label=label)
     else:
-        prog = jax.jit(lambda d: jax.lax.map(one_cfg, d))
+        prog = instrumented_jit(lambda d: jax.lax.map(one_cfg, d),
+                                label=label)
     # span-wrapped (even though THIS site never feeds calibration) so a
     # tree family timing a dispatch on another thread sees the overlap —
     # a linear-family execution queues tree dispatches just the same
@@ -167,6 +169,7 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                   calibrate: Optional[Callable[[Tuple, List[int], float, int,
                                                 int, bool], int]] = None,
                   fit_takes_val: bool = False,
+                  family: str = "generic",
                   ) -> List[List[float]]:
     """Shared scaffold: group grids by static params; per group, stack the
     dynamic params into traced vectors and run fit→predict→metric as one
@@ -222,7 +225,11 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
             # time (a resize recompiles, so it only fires when the
             # remaining work amortizes the new compile).
             import time as _time
-            prog = jax.jit(jax.vmap(one_pair))
+
+            from transmogrifai_tpu.analysis.retrace import instrumented_jit
+            prog = instrumented_jit(
+                jax.vmap(one_pair),
+                label=f"sweep:{family}:{static!r}:pairs")
             s = 0
             # device-metric path: every chunk's output is a tiny (width,)
             # metric vector, but each np.asarray costs a ~0.7s tunnel
@@ -288,7 +295,8 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                 return pred if host else metric_fn(y, pred, v)
             return jax.vmap(one_fold)(W, V)
 
-        gk = _run_block(one_cfg, dyn, sharding, grid_vmap(static, idxs))
+        gk = _run_block(one_cfg, dyn, sharding, grid_vmap(static, idxs),
+                        label=f"sweep:{family}:{static!r}")
         if host:
             pred_np = jax.tree_util.tree_map(np.asarray, gk)
             for row_i, grid_i in enumerate(idxs):
@@ -349,7 +357,7 @@ def _sweep_logistic(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         static_of=lambda g: (int(_grid_param(est, g, "max_iter")),
                              _enet_of(est, g) > 0.0),
         dyn_of=lambda g: _l1_l2_of(est, g),
-        build=build)
+        build=build, family="logistic")
 
 
 def _sweep_linreg(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -363,7 +371,7 @@ def _sweep_linreg(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         grids, y, W, V, metric_fn, sharding,
         static_of=lambda g: (_enet_of(est, g) > 0.0,),
         dyn_of=lambda g: _l1_l2_of(est, g),
-        build=build)
+        build=build, family="linreg")
 
 
 def _sweep_svc(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -372,7 +380,8 @@ def _sweep_svc(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         static_of=lambda g: (int(_grid_param(est, g, "max_iter")),),
         dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
         build=lambda st, idxs: lambda d, w: predict_linear_svc(
-            fit_linear_svc(X, y, w, d["reg"], st[0]), X))
+            fit_linear_svc(X, y, w, d["reg"], st[0]), X),
+        family="svc")
 
 
 def _sweep_glm(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -393,7 +402,7 @@ def _sweep_glm(est, grids, X, y, W, V, metric_fn, ctx, sharding):
                              float(_grid_param(est, g, "var_power")),
                              link_of(g)),
         dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
-        build=build)
+        build=build, family="glm")
 
 
 def _sweep_nb(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -415,7 +424,8 @@ def _sweep_nb(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         static_of=lambda g: (),
         dyn_of=lambda g: {"smoothing": float(_grid_param(est, g, "smoothing"))},
         build=lambda st, idxs: lambda d, w: predict_naive_bayes(
-            fit_naive_bayes(X, y, w, d["smoothing"], n_classes), X))
+            fit_naive_bayes(X, y, w, d["smoothing"], n_classes), X),
+        family="naive_bayes")
 
 
 def _sweep_mlp(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -432,7 +442,7 @@ def _sweep_mlp(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         static_of=lambda g: (tuple(_grid_param(est, g, "hidden_layers")),
                              int(_grid_param(est, g, "max_iter"))),
         dyn_of=lambda g: {"lr": float(_grid_param(est, g, "learning_rate"))},
-        build=build)
+        build=build, family="mlp")
 
 
 # --------------------------------------------------------------------------- #
@@ -799,7 +809,7 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
         grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6,
         host_dispatch=True,
         pair_width=lambda st, idxs, k: width_of(st, idxs),
-        calibrate=calibrate)
+        calibrate=calibrate, family="forest")
 
 
 def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -895,7 +905,7 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
             grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6,
             host_dispatch=sharding is None,
             pair_width=lambda st, idxs, k: width_of(st, idxs),
-            fit_takes_val=True)
+            fit_takes_val=True, family="gbt")
 
     # ---- single-device binary/squared: ROUND-CHUNKED host dispatch ---- #
     # A 200-round depth-10 fit at 100k rows is a >60s single execution
@@ -938,15 +948,19 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
                 eval_metric)
             return m, b, s
 
-        prog = jax.jit(jax.vmap(chunk_pair,
-                                in_axes=(0, 0, 0, 0, 0, 0, None)))
+        from transmogrifai_tpu.analysis.retrace import instrumented_jit
+        prog = instrumented_jit(
+            jax.vmap(chunk_pair, in_axes=(0, 0, 0, 0, 0, 0, None)),
+            label=f"sweep:gbt:{static!r}:rounds")
         if host:
-            pred_prog = jax.jit(jax.vmap(
-                lambda m: gbt_pred_from_margin(m, objective)))
+            pred_prog = instrumented_jit(
+                jax.vmap(lambda m: gbt_pred_from_margin(m, objective)),
+                label=f"sweep:gbt:{static!r}:pred")
         else:
-            metric_prog = jax.jit(jax.vmap(
-                lambda m, v: metric_fn(
-                    y, gbt_pred_from_margin(m, objective), v)))
+            metric_prog = instrumented_jit(
+                jax.vmap(lambda m, v: metric_fn(
+                    y, gbt_pred_from_margin(m, objective), v)),
+                label=f"sweep:gbt:{static!r}:metric")
         keys_all = jax.random.split(jax.random.PRNGKey(seed), n_est)
 
         s = 0

@@ -27,6 +27,7 @@ class SelectedCombinerModel(Transformer):
     (or the weighted mean for regression raw predictions)."""
 
     out_type = T.Prediction
+    response_aware = True  # inputs are (label, pred, pred)
 
     def __init__(self, weight1: float = 0.5, weight2: float = 0.5,
                  strategy: str = BEST, metric_name: str = "",
@@ -62,6 +63,7 @@ class SelectedModelCombiner(Estimator):
 
     in_types = (T.RealNN, T.Prediction, T.Prediction)
     out_type = T.Prediction
+    response_aware = True  # slot 0 is the label
 
     def __init__(self, strategy: str = BEST, uid: Optional[str] = None):
         if strategy not in (BEST, WEIGHTED, EQUAL):

@@ -92,6 +92,7 @@ class ModelSelector(Estimator):
 
     in_types = (T.RealNN, T.OPVector)
     out_type = T.Prediction
+    response_aware = True  # slot 0 is the label
 
     def __init__(self, models: Sequence[Tuple[Estimator, List[Dict]]],
                  validator=None, splitter=None, evaluator=None,

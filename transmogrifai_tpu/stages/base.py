@@ -82,6 +82,14 @@ class Stage:
     in_types: Optional[Tuple] = None
     out_type: type = T.OPVector  # default output feature type
 
+    # Stages that may legitimately combine the response with predictors
+    # (models, SanityChecker, supervised bucketizers) set this True; by
+    # convention their slot 0 is the label slot. `analysis.opcheck` treats
+    # any other stage mixing response-derived features with predictors as
+    # response leakage, and outputs of response-aware stages (e.g. a
+    # Prediction) as sanctioned rather than tainted.
+    response_aware: bool = False
+
     def __init__(self, uid: Optional[str] = None, **params):
         self.uid = uid or UID(type(self))
         self.params: Dict[str, Any] = params
@@ -219,6 +227,21 @@ class HostTransformer(Transformer):
 
     def transform(self, cols: Sequence[Column], ctx: Optional[FitContext] = None) -> Column:
         raise NotImplementedError(type(self).__name__)
+
+
+# Column kinds that never cross to device (see data/columns.py kind table).
+HOST_KINDS = ("text", "list", "map")
+
+
+def is_host_stage(stage) -> bool:
+    """THE host/device segmentation rule — single source of truth shared by
+    the compiled scorer's planner (workflow/compiled.py) and the static
+    validator (analysis/opcheck.py): a Transformer runs on host when it
+    subclasses HostTransformer OR sets jittable=False (plain Transformers
+    like DateListVectorizer override transform() and must never be traced
+    into a device segment)."""
+    return isinstance(stage, Transformer) and (
+        isinstance(stage, HostTransformer) or not stage.jittable)
 
 
 class Estimator(Stage):

@@ -30,9 +30,8 @@ from transmogrifai_tpu.data.columns import Column
 from transmogrifai_tpu.data.dataset import Dataset
 from transmogrifai_tpu.features.dag import topological_layers
 from transmogrifai_tpu.stages.base import (
-    FeatureGeneratorStage, HostTransformer, Transformer)
-
-_HOST_KINDS = ("text", "list", "map")
+    HOST_KINDS as _HOST_KINDS, FeatureGeneratorStage, HostTransformer,
+    Transformer, is_host_stage)
 
 
 def _column_from_device(ftype: type, dev) -> Column:
@@ -67,17 +66,26 @@ class CompiledScorer:
                 ordered.append(fitted)
         self._stage_out_uid = {
             s.uid: s.get_output().uid for s in ordered}
-        # alternating host/device segments in topo order
+        # alternating host/device segments in topo order, split by the
+        # shared `is_host_stage` rule (stages/base.py) — the same rule the
+        # static validator checks against
         self.segments: List[Tuple[str, List[Transformer]]] = []
         for s in ordered:
-            kind = "host" if isinstance(s, HostTransformer) else "device"
+            kind = "host" if is_host_stage(s) else "device"
             if not self.segments or self.segments[-1][0] != kind:
                 self.segments.append((kind, []))
             self.segments[-1][1].append(s)
+        # instrumented jit: the retrace monitor counts traces per segment
+        # (label = stage ops), so per-batch shape drift shows up as churn
+        # on a NAMED program instead of silent recompiles
+        from transmogrifai_tpu.analysis.retrace import instrumented_jit
         self._seg_fns = [
-            (jax.jit(self._make_segment_fn(stages)) if kind == "device"
-             else None)
-            for kind, stages in self.segments]
+            (instrumented_jit(
+                self._make_segment_fn(stages),
+                label="compiled:seg%d[%s]" % (
+                    i, ",".join(s.operation_name for s in stages)))
+             if kind == "device" else None)
+            for i, (kind, stages) in enumerate(self.segments)]
         self.device_stages: List[Transformer] = [
             s for kind, stages in self.segments if kind == "device"
             for s in stages]

@@ -105,9 +105,10 @@ def apply_stage_params(stages, stage_params: Dict[str, Dict[str, Any]],
         for key in (type(stage).__name__, stage.operation_name, stage.uid):
             overrides = stage_params.get(key)
             if overrides:
-                # REBIND params: clone_graph's shallow copy shares the
-                # params dict with the user's original stage — in-place
-                # mutation would leak overrides out of the private clone
+                # REBIND params (defense in depth): clones now own their
+                # params dict (dag._clone_stage), but rebinding instead of
+                # mutating also keeps overrides out of any dict a caller
+                # obtained via get_params()/aliasing before this ran
                 stage.params = {**stage.params, **overrides}
                 for name, value in overrides.items():
                     if hasattr(stage, name):

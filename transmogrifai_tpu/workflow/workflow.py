@@ -26,6 +26,23 @@ from transmogrifai_tpu.stages.base import (
     Estimator, FeatureGeneratorStage, FitContext, Stage, Transformer)
 
 
+def _validate_or_raise(result_features, strict: bool, where: str) -> None:
+    """Run the static opcheck pass; raise on errors under strict, else log.
+    Warnings are always logged (they never block)."""
+    import logging
+
+    from transmogrifai_tpu.analysis.opcheck import validate_graph
+
+    log = logging.getLogger(__name__)
+    report = validate_graph(result_features)
+    if report.errors and strict:
+        report.raise_if_errors()
+    for issue in report.errors:
+        log.warning("opcheck (%s, strict=False): %s", where, issue)
+    for issue in report.warnings:
+        log.info("opcheck (%s): %s", where, issue)
+
+
 class Workflow:
     """Declarative workflow: wire result features, then `train()`."""
 
@@ -114,15 +131,22 @@ class Workflow:
         return ds
 
     def train(self, dataset: Optional[Dataset] = None, seed: int = 42,
-              mesh=None) -> "WorkflowModel":
+              mesh=None, strict: bool = True) -> "WorkflowModel":
         """Materialize raw features, then fit the DAG layer by layer
         (OpWorkflow.train → fitStages → fitAndTransformLayer).
 
         `mesh`: optional jax.sharding.Mesh — estimator fits that support it
-        (the ModelSelector sweep) shard their work across it."""
-        ds = self._resolve_dataset(dataset)
+        (the ModelSelector sweep) shard their work across it.
+
+        A static opcheck pass (`analysis.opcheck.validate_graph`) runs
+        FIRST — before any data materialization, fit, or XLA compile — and
+        raises `GraphValidationError` on a miswired DAG (type mismatches,
+        response leakage, cycles, host/device contract violations).
+        `strict=False` downgrades validation errors to logged warnings."""
         if not self.result_features:
             raise RuntimeError("set_result_features before train()")
+        _validate_or_raise(self.result_features, strict, where="train")
+        ds = self._resolve_dataset(dataset)
         rff_results = None
         source_features = self.result_features
         if self._rff is not None:
@@ -336,22 +360,37 @@ class WorkflowModel:
             return columns
         return {f.name: columns[f.uid] for f in self.result_features}
 
-    def score_compiled(self, dataset: Dataset,
-                       sharding=None) -> Dict[str, Any]:
+    def _ensure_compiled(self, sharding=None, strict: bool = True):
+        """Shared gate for EVERY compiled entry point (score_compiled,
+        score_stream, score_function): opcheck-validate the fitted graph
+        before building a new CompiledScorer. Post-train the graph's
+        origin stages ARE the fitted transformers (the estimator→model
+        swap in stages/base.py mutates the feature nodes in place), so
+        the device-contract checks see exactly what the planner traces."""
+        from transmogrifai_tpu.workflow.compiled import CompiledScorer
+        if self._compiled is None or \
+                getattr(self._compiled, "sharding", None) != sharding:
+            _validate_or_raise(self.result_features, strict,
+                               where="compile")
+            self._compiled = CompiledScorer(self, sharding=sharding)
+        return self._compiled
+
+    def score_compiled(self, dataset: Dataset, sharding=None,
+                       strict: bool = True) -> Dict[str, Any]:
         """Fused-XLA scoring path (the `local/` + MLeap equivalent).
 
         `sharding`: optional row-axis NamedSharding (e.g.
         `parallel.data_sharding(mesh)`) — batch inputs are placed with it
-        so the fused program's work spreads across the mesh."""
-        from transmogrifai_tpu.workflow.compiled import CompiledScorer
-        if self._compiled is None or \
-                getattr(self._compiled, "sharding", None) != sharding:
-            self._compiled = CompiledScorer(self, sharding=sharding)
-        return self._compiled(dataset)
+        so the fused program's work spreads across the mesh.
+
+        The fitted graph is opcheck-validated before the first compile
+        (`strict=False` downgrades errors to logged warnings)."""
+        return self._ensure_compiled(sharding, strict)(dataset)
 
     def score_stream(self, batches, prefetch: int = 2, sharding=None,
                      host_workers: int = 2, device_depth: int = 2,
-                     fetch_group: int = 1, coalesce_rows: int = 0):
+                     fetch_group: int = 1, coalesce_rows: int = 0,
+                     strict: bool = True):
         """Streaming micro-batch scoring as a TWO-stage pipeline
         (OpWorkflowRunner streaming loop, OpWorkflowRunner.scala:233-262):
 
@@ -390,8 +429,6 @@ class WorkflowModel:
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
-        from transmogrifai_tpu.workflow.compiled import CompiledScorer
-
         if coalesce_rows and coalesce_rows > 0:
             split_sizes: deque = deque()
 
@@ -420,17 +457,14 @@ class WorkflowModel:
             for host in self.score_stream(
                     _coalesced(), prefetch=prefetch, sharding=sharding,
                     host_workers=host_workers, device_depth=device_depth,
-                    fetch_group=fetch_group):
+                    fetch_group=fetch_group, strict=strict):
                 off = 0
                 for s in split_sizes.popleft():
                     yield {f: _slice(v, off, off + s)
                            for f, v in host.items()}
                     off += s
             return
-        if self._compiled is None or \
-                getattr(self._compiled, "sharding", None) != sharding:
-            self._compiled = CompiledScorer(self, sharding=sharding)
-        scorer = self._compiled
+        scorer = self._ensure_compiled(sharding, strict)
         try:
             device_fn = scorer.fused_jitted()  # shared compile cache
         except RuntimeError:
@@ -602,11 +636,11 @@ class WorkflowModel:
                 while fetched:
                     yield from fetched.popleft().result()
 
-    def score_function(self):
+    def score_function(self, strict: bool = True):
         """Row-level scoring closure: Map[str, Any] → Map[str, Any]
-        (local/.../OpWorkflowModelLocal.scala:79-122)."""
-        from transmogrifai_tpu.workflow.compiled import CompiledScorer
-        scorer = CompiledScorer(self)
+        (local/.../OpWorkflowModelLocal.scala:79-122). Shares the cached
+        validated scorer with score_compiled/score_stream."""
+        scorer = self._ensure_compiled(strict=strict)
 
         def score_row(row: Dict[str, Any]) -> Dict[str, Any]:
             ds = Dataset.from_rows([row])
