@@ -11,12 +11,18 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-.PHONY: lint test check
+.PHONY: lint serve-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
 
+# end-to-end serving smoke: train tiny -> save -> boot HTTP server on a
+# random port -> POST /score -> scrape /metrics (+ /healthz, /reload
+# no-op) -> clean shutdown. See transmogrifai_tpu/serving/smoke.py.
+serve-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.smoke
+
 test:
 	bash -c "$(TIER1)"
 
-check: lint test
+check: lint serve-smoke test

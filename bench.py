@@ -762,7 +762,106 @@ def run_big(platform: str, payload: dict) -> None:
     _emit(payload)
 
 
+def run_serving() -> None:
+    """Serving-mode bench (`python bench.py serve`): throughput/latency of
+    the online scoring service vs. batch-ladder config. Trains one small
+    model, then for each ladder drives concurrent single/multi-row
+    clients through the micro-batcher and emits one JSON line per
+    config: rows/s, request p50/p99, batches, padding fraction, sheds —
+    the knobs-vs-goodput curve the ML Goodput paper says to watch."""
+    import tempfile
+    import threading
+
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.serving.service import (
+        ScoringService, ServingConfig)
+    from transmogrifai_tpu.workflow import Workflow
+    from transmogrifai_tpu.workflow.serialization import model_fingerprint
+
+    platform = probe_backend()
+    ds = make_data(4000, n_numeric=8, seed=11)
+    preds, label = FeatureBuilder.from_dataset(ds, response="label")
+    vec = transmogrify(preds)
+    pred = OpLogisticRegression(max_iter=40).set_input(
+        label, vec).get_output()
+    t0 = time.time()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+    rows = ds.to_rows()
+    duration_s = float(os.environ.get("BENCH_SERVE_SECONDS", 3.0))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    with tempfile.TemporaryDirectory(prefix="bench-serve-") as tmp:
+        model.save(tmp)
+        version = model_fingerprint(tmp)
+        _emit({"metric": "serve_setup_s", "platform": platform,
+               "value": round(time.time() - t0, 2), "unit": "s",
+               "vs_baseline": 0.0, "model_version": version})
+        for max_batch in (8, 32, 128):
+            if _remaining() < duration_s + 30.0:
+                _emit({"metric": "serve_skipped", "value": float(max_batch),
+                       "unit": "config", "vs_baseline": 0.0,
+                       "reason": "budget"})
+                break
+            svc = ScoringService.from_path(tmp, config=ServingConfig(
+                max_batch=max_batch, batch_wait_ms=1.0, max_queue=1024))
+            svc.start()
+            stop_at = time.time() + duration_s
+            sent = [0] * n_clients
+            errors = [0] * n_clients
+
+            def client(i: int) -> None:
+                rng = np.random.default_rng(i)
+                while time.time() < stop_at:
+                    k = int(rng.integers(1, 5))  # mixed request sizes
+                    batch = [rows[int(j)] for j in
+                             rng.integers(0, len(rows), size=k)]
+                    try:
+                        svc.score(batch, deadline_ms=10_000)
+                        sent[i] += k
+                    except Exception:
+                        errors[i] += 1
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            t1 = time.time()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.time() - t1
+            reg = svc.registry.to_json()
+            lat = reg["serving_request_latency_seconds"]["series"][0]
+            pad = reg.get("serving_padded_rows_total",
+                          {"series": [{"value": 0}]})["series"][0]["value"]
+            scored = sum(sent)
+            svc.stop()
+            _emit({
+                "metric": "serve_rows_per_sec", "platform": platform,
+                "value": round(scored / max(wall, 1e-9), 1),
+                "unit": "rows/s", "vs_baseline": 0.0,
+                "max_batch": max_batch, "clients": n_clients,
+                "rows": scored, "errors": sum(errors),
+                "latency_p50_ms": (round(lat["p50"] * 1e3, 3)
+                                   if lat["p50"] is not None else None),
+                "latency_p99_ms": (round(lat["p99"] * 1e3, 3)
+                                   if lat["p99"] is not None else None),
+                "pad_fraction": round(pad / max(pad + scored, 1), 4),
+            })
+
+
 def main() -> None:
+    if "serve" in sys.argv[1:]:
+        try:
+            run_serving()
+        except Exception as e:
+            _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"serving bench failed: {type(e).__name__}: {e}",
+                   "trace_tail":
+                       traceback.format_exc().strip().splitlines()[-3:]})
+        return
     try:
         platform = probe_backend()
     except Exception as e:

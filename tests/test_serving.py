@@ -1,0 +1,901 @@
+"""serving/ subsystem: shape-bucketed micro-batching, AOT bucket warmup
+(zero recompiles under mixed-size traffic), bounded-queue load shedding,
+per-request deadlines, error quarantine, versioned hot-swap + rollback,
+and the metrics surface (JSON + Prometheus) — plus the satellite paths:
+ragged-tail padding in `score_stream` and the runner's per-batch latency
+histogram."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.automl import transmogrify
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.serving import (
+    MetricsRegistry, MicroBatcher, Request, ScoreError, ScoringService,
+    ServingConfig, bucket_for, bucket_ladder)
+from transmogrifai_tpu.serving.metrics import Histogram
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _make_ds(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(1, 80, n)
+    fare = rng.lognormal(2.5, 1.0, n)
+    sex = rng.choice(["male", "female"], n)
+    logit = (sex == "female") * 2.0 + (age < 12) * 1.0 + \
+        0.15 * np.log(fare) - 1.0
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    return Dataset(
+        {"age": age, "fare": fare, "sex": sex.astype(object), "survived": y},
+        {"age": t.Real, "fare": t.Real, "sex": t.PickList,
+         "survived": t.Integral})
+
+
+def _train(ds, reg_param=0.01, max_iter=60):
+    preds, label = FeatureBuilder.from_dataset(ds, response="survived")
+    vec = transmogrify(preds)
+    pred = OpLogisticRegression(reg_param=reg_param, max_iter=max_iter) \
+        .set_input(label, vec).get_output()
+    return Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+
+
+ROWS = [{"age": 30.0, "fare": 12.0, "sex": "male"},
+        {"age": 8.0, "fare": 30.0, "sex": "female"},
+        {"age": 55.0, "fare": 80.0, "sex": "female"},
+        {"age": 41.0, "fare": 7.0, "sex": "male"}]
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    """Two saved model versions (different fits → different fingerprints)
+    + the training dataset."""
+    base = tmp_path_factory.mktemp("serving-models")
+    ds = _make_ds()
+    m1 = _train(ds, reg_param=0.01)
+    m1.save(str(base / "v1"))
+    m2 = _train(ds, reg_param=0.5, max_iter=30)
+    m2.save(str(base / "v2"))
+    return ds, str(base / "v1"), str(base / "v2")
+
+
+@pytest.fixture(scope="module")
+def svc(model_dirs):
+    """Shared read-only service over v1 (warm, max_batch=8)."""
+    _, v1, _ = model_dirs
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=8, batch_wait_ms=1.0))
+    service.start()
+    yield service
+    service.stop()
+
+
+# --------------------------------------------------------------------- #
+# bucket ladder + batcher units                                         #
+# --------------------------------------------------------------------- #
+
+def test_bucket_ladder_and_lookup():
+    assert bucket_ladder(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert bucket_ladder(48) == (1, 2, 4, 8, 16, 32, 48)  # cap reachable
+    ladder = bucket_ladder(16, min_bucket=4)
+    assert ladder == (4, 8, 16)
+    assert bucket_for(1, ladder) == 4
+    assert bucket_for(9, ladder) == 16
+    with pytest.raises(ScoreError) as ei:
+        bucket_for(17, ladder)
+    assert ei.value.code == "bad_request"
+
+
+def test_microbatcher_coalesces_queued_requests():
+    """Everything queued when the scoring thread arrives lands in ONE
+    batch (up to max_batch rows)."""
+    mb = MicroBatcher(max_queue=16, max_batch=8, batch_wait_s=0.0)
+    ds = _make_ds(2)
+    reqs = [Request(ds, deadline=None) for _ in range(3)]
+    for r in reqs:
+        mb.put(r)
+    batch, expired = mb.next_batch()
+    assert batch == reqs and not expired
+    assert sum(r.n_rows for r in batch) == 6
+
+
+def test_microbatcher_bounded_queue_and_close():
+    mb = MicroBatcher(max_queue=2, max_batch=8)
+    ds = _make_ds(1)
+    mb.put(Request(ds, None))
+    mb.put(Request(ds, None))
+    with pytest.raises(ScoreError) as ei:
+        mb.put(Request(ds, None))
+    assert ei.value.code == "queue_full"
+    drained = mb.close()
+    assert len(drained) == 2
+    with pytest.raises(ScoreError) as ei:
+        mb.put(Request(ds, None))
+    assert ei.value.code == "shutdown"
+
+
+def test_microbatcher_carries_oversized_head():
+    """A request that does not fit the remaining budget waits for the
+    NEXT batch — never dropped, never reordered past its peers."""
+    mb = MicroBatcher(max_queue=16, max_batch=4, batch_wait_s=0.0)
+    big = Request(_make_ds(3), None)
+    big2 = Request(_make_ds(3), None)
+    mb.put(big)
+    mb.put(big2)
+    batch1, _ = mb.next_batch()
+    assert batch1 == [big]        # 3 + 3 > 4: second carries over
+    batch2, _ = mb.next_batch()
+    assert batch2 == [big2]
+
+
+# --------------------------------------------------------------------- #
+# metrics registry                                                      #
+# --------------------------------------------------------------------- #
+
+def test_histogram_quantiles():
+    h = Histogram(bounds=(0.1, 1.0, 10.0))
+    for v in (0.05,) * 50 + (5.0,) * 50:
+        h.observe(v)
+    assert h.count == 100
+    p50 = h.quantile(0.5)
+    assert p50 is not None and p50 <= 1.0
+    p99 = h.quantile(0.99)
+    assert 1.0 < p99 <= 10.0
+    s = h.summary()
+    assert s["count"] == 100 and s["max"] == 5.0 and s["p95"] > 1.0
+    assert Histogram().quantile(0.5) is None  # empty
+
+
+def test_registry_json_and_prometheus():
+    r = MetricsRegistry()
+    r.counter("reqs_total", "requests", route="score").inc(3)
+    r.gauge("depth", "queue depth").set(2)
+    r.histogram("lat_seconds", "latency").observe(0.02)
+    j = r.to_json()
+    assert j["reqs_total"]["series"][0] == {
+        "labels": {"route": "score"}, "value": 3.0}
+    assert j["lat_seconds"]["series"][0]["count"] == 1
+    text = r.to_prometheus()
+    assert '# TYPE reqs_total counter' in text
+    assert 'reqs_total{route="score"} 3.0' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert 'lat_seconds_count 1' in text
+    assert text.endswith("\n")
+    with pytest.raises(ValueError):
+        r.gauge("reqs_total")  # type clash
+
+
+# --------------------------------------------------------------------- #
+# padded scoring parity                                                 #
+# --------------------------------------------------------------------- #
+
+def test_score_padded_matches_unpadded(model_dirs):
+    from transmogrifai_tpu.workflow.serialization import load_model
+    ds, v1, _ = model_dirs
+    model = load_model(v1)
+    scorer = model._ensure_compiled()
+    small = ds.take(np.arange(5))
+    plain = scorer(small)
+    padded = scorer.score_padded(small, 16)
+    for name, v in plain.items():
+        pv = padded[name]
+        if isinstance(v, dict):
+            for k in v:
+                a, b = np.asarray(v[k]), np.asarray(pv[k])
+                assert a.shape == b.shape
+                if a.dtype != object:
+                    np.testing.assert_allclose(a, b, rtol=1e-5)
+        else:
+            assert np.asarray(pv).shape[0] == 5
+
+
+def test_pad_dataset_validates():
+    from transmogrifai_tpu.workflow.compiled import pad_dataset
+    ds = _make_ds(4)
+    assert pad_dataset(ds, 4) is ds
+    padded = pad_dataset(ds, 7)
+    assert len(padded) == 7
+    # pad rows repeat the LAST real row
+    assert padded.column("age")[6] == ds.column("age")[3]
+    with pytest.raises(ValueError):
+        pad_dataset(ds, 2)
+
+
+# --------------------------------------------------------------------- #
+# service: scoring, coalescing, warmup                                  #
+# --------------------------------------------------------------------- #
+
+def test_score_basic_and_row_shape(svc):
+    res = svc.score(list(ROWS))
+    assert res.n_rows == 4 and res.model_version
+    rows = res.rows()
+    assert len(rows) == 4
+    pred = next(v for v in rows[0].values()
+                if isinstance(v, dict) and "prediction" in v)
+    assert pred["prediction"] in (0.0, 1.0)
+    assert 0.0 <= pred["probability_1"] <= 1.0
+
+
+def test_concurrent_requests_coalesce_into_one_device_batch(model_dirs):
+    """N clients blocked behind a slow in-flight batch coalesce into ONE
+    device dispatch when the scoring thread frees up."""
+    _, v1, _ = model_dirs
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=8, batch_wait_ms=1.0))
+    service.start()
+    try:
+        started, release = threading.Event(), threading.Event()
+        version = service._active
+        orig = version.scorer.score_padded
+
+        def gate(ds, bucket):
+            started.set()
+            release.wait(10)
+            return orig(ds, bucket)
+
+        version.scorer.score_padded = gate
+        results = {}
+
+        def client(i):
+            results[i] = service.score([ROWS[i % len(ROWS)]])
+
+        t0 = threading.Thread(target=client, args=(99,))  # the gated warm
+        t0.start()
+        assert started.wait(10)   # scoring thread is now busy
+        clients = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for th in clients:
+            th.start()
+        time.sleep(0.2)           # let all four enqueue behind the gate
+        version.scorer.score_padded = orig
+        release.set()
+        t0.join(10)
+        for th in clients:
+            th.join(10)
+        assert len(results) == 5
+        versions = {r.model_version for r in results.values()}
+        assert versions == {version.version_id}
+        # exactly TWO device dispatches total: the gated warm request,
+        # then the four waiting requests coalesced into ONE batch
+        assert service._m_batches.value == 2
+        reg = service.registry.to_json()
+        per_bucket = {s["labels"]["bucket"]: s["value"] for s in
+                      reg["serving_bucket_requests_total"]["series"]}
+        assert per_bucket.get("4") == 4.0  # 4 rows → bucket 4
+    finally:
+        service.stop()
+
+
+def test_no_recompiles_after_warmup_across_mixed_sizes(svc):
+    """The acceptance property: AOT bucket warmup means mixed request
+    sizes cause ZERO new jit traces (retrace counters are flat)."""
+    from transmogrifai_tpu.analysis.retrace import MONITOR
+    svc.score([ROWS[0]])  # ensure steady state
+    before = MONITOR.snapshot()
+    for size in (1, 3, 5, 8, 2, 7, 4, 6):
+        rows = [ROWS[i % len(ROWS)] for i in range(size)]
+        res = svc.score(rows)
+        assert res.n_rows == size
+    delta = MONITOR.delta(before)
+    assert delta == {}, f"unexpected recompiles: {delta}"
+
+
+def test_warmup_compile_counts_exported(svc):
+    """Per-bucket compile counts from warmup land in the registry."""
+    reg = svc.registry.to_json()
+    series = reg["serving_bucket_compiles_total"]["series"]
+    buckets = {s["labels"]["bucket"] for s in series}
+    assert buckets == {"1", "2", "4", "8"}
+    assert all(s["value"] >= 1 for s in series)
+
+
+def test_warm_rows_larger_than_smallest_bucket(model_dirs):
+    """Caller-provided warm rows exceeding a bucket are truncated for
+    that bucket, not a construction-time crash."""
+    _, v1, _ = model_dirs
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=4),
+        warm_rows=[dict(ROWS[i % len(ROWS)]) for i in range(3)])
+    service.start()
+    try:
+        assert service.score([ROWS[0]]).n_rows == 1
+        counts = service._active.compile_counts
+        assert set(counts) == {1, 2, 4}
+    finally:
+        service.stop()
+
+
+def test_oversized_request_rejected_at_admission(svc):
+    with pytest.raises(ScoreError) as ei:
+        svc.score([dict(ROWS[0]) for _ in range(9)])  # max_batch=8
+    assert ei.value.code == "bad_request"
+    with pytest.raises(ScoreError) as ei:
+        svc.score([])
+    assert ei.value.code == "bad_request"
+
+
+# --------------------------------------------------------------------- #
+# overload: deadlines + load shedding + quarantine                      #
+# --------------------------------------------------------------------- #
+
+def _gated_service(v1, **cfg):
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=8, batch_wait_ms=1.0, **cfg))
+    service.start()
+    started, release = threading.Event(), threading.Event()
+    version = service._active
+    orig = version.scorer.score_padded
+
+    def gate(ds, bucket):
+        started.set()
+        release.wait(10)
+        return orig(ds, bucket)
+
+    version.scorer.score_padded = gate
+
+    def ungate():
+        version.scorer.score_padded = orig
+        release.set()
+
+    return service, started, ungate
+
+
+def test_deadline_exceeded_is_structured_and_service_survives(model_dirs):
+    _, v1, _ = model_dirs
+    service, started, ungate = _gated_service(v1)
+    try:
+        errs = {}
+
+        def client(key, **kw):
+            try:
+                errs[key] = service.score([ROWS[0]], **kw)
+            except ScoreError as e:
+                errs[key] = e
+
+        a = threading.Thread(target=client, args=("a",))
+        a.start()
+        assert started.wait(10)
+        b = threading.Thread(target=client, args=("b",),
+                             kwargs={"deadline_ms": 30})
+        b.start()
+        time.sleep(0.15)  # b's deadline passes while queued
+        ungate()
+        a.join(10)
+        b.join(10)
+        assert isinstance(errs["b"], ScoreError)
+        assert errs["b"].code == "deadline_exceeded"
+        assert not isinstance(errs["a"], ScoreError)  # batchmate unharmed
+        # the service keeps serving after the shed
+        res = service.score([ROWS[1]])
+        assert res.n_rows == 1
+        reg = service.registry.to_json()
+        sheds = {s["labels"]["reason"]: s["value"]
+                 for s in reg["serving_shed_total"]["series"]}
+        assert sheds.get("deadline_exceeded", 0) >= 1
+    finally:
+        service.stop()
+
+
+def test_queue_full_sheds_with_structured_error(model_dirs):
+    _, v1, _ = model_dirs
+    service, started, ungate = _gated_service(v1, max_queue=1)
+    try:
+        ok = {}
+
+        def client(key):
+            ok[key] = service.score([ROWS[0]], deadline_ms=20_000)
+
+        a = threading.Thread(target=client, args=("a",))
+        a.start()
+        assert started.wait(10)
+        b = threading.Thread(target=client, args=("b",))
+        b.start()
+        deadline = time.monotonic() + 5
+        while service._batcher.depth() < 1:  # b is queued
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        with pytest.raises(ScoreError) as ei:
+            service.score([ROWS[1]])  # queue at capacity → shed NOW
+        assert ei.value.code == "queue_full"
+        # queue gauge observed non-zero while saturated
+        depth = service.registry.gauge("serving_queue_depth").value
+        assert depth >= 1
+        ungate()
+        a.join(10)
+        b.join(10)
+        assert ok["a"].n_rows == 1 and ok["b"].n_rows == 1  # no drops
+        reg = service.registry.to_json()
+        sheds = {s["labels"]["reason"]: s["value"]
+                 for s in reg["serving_shed_total"]["series"]}
+        assert sheds.get("queue_full", 0) >= 1
+    finally:
+        service.stop()
+
+
+def test_error_quarantine_fails_one_request_not_the_batch(model_dirs):
+    """A poisoned batch re-scores request-by-request: the bad request
+    gets a structured record_error, its batchmates get answers."""
+    _, v1, _ = model_dirs
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=8, batch_wait_ms=1.0))
+    service.start()
+    try:
+        started, release = threading.Event(), threading.Event()
+        version = service._active
+        orig = version.scorer.score_padded
+
+        def poisoned(ds, bucket):
+            if not started.is_set():
+                started.set()
+                release.wait(10)
+                return orig(ds, bucket)
+            if np.any(np.asarray(ds.column("age")) == -999.0):
+                raise ValueError("poison record")
+            return orig(ds, bucket)
+
+        version.scorer.score_padded = poisoned
+        results = {}
+
+        def client(key, row):
+            try:
+                results[key] = service.score([row], deadline_ms=20_000)
+            except ScoreError as e:
+                results[key] = e
+
+        warm = threading.Thread(target=client, args=("warm", ROWS[0]))
+        warm.start()
+        assert started.wait(10)
+        bad_row = {"age": -999.0, "fare": 1.0, "sex": "male"}
+        bad = threading.Thread(target=client, args=("bad", bad_row))
+        good = threading.Thread(target=client, args=("good", ROWS[1]))
+        bad.start()
+        good.start()
+        time.sleep(0.2)  # both coalesce behind the gate
+        release.set()
+        for th in (warm, bad, good):
+            th.join(10)
+        version.scorer.score_padded = orig
+        assert isinstance(results["bad"], ScoreError)
+        assert results["bad"].code == "record_error"
+        assert results["good"].n_rows == 1  # batchmate survived
+        assert results["warm"].n_rows == 1
+        assert service.registry.counter("serving_errors_total").value == 1
+        res = service.score([ROWS[2]])  # still serving
+        assert res.n_rows == 1
+    finally:
+        service.stop()
+
+
+# --------------------------------------------------------------------- #
+# hot swap + rollback                                                   #
+# --------------------------------------------------------------------- #
+
+def test_hot_swap_under_load_never_misversions(model_dirs):
+    """Traffic runs THROUGH the swap: every request succeeds, versions
+    are only ever v1-then-v2 (monotonic per client), and rollback
+    restores v1 instantly."""
+    from transmogrifai_tpu.workflow.serialization import model_fingerprint
+    _, v1, v2 = model_dirs
+    fp1, fp2 = model_fingerprint(v1), model_fingerprint(v2)
+    assert fp1 != fp2
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=8, batch_wait_ms=0.5))
+    service.start()
+    try:
+        assert service.health()["model_version"] == fp1
+        stop_traffic = threading.Event()
+        seen = {0: [], 1: []}
+        failures = []
+
+        def client(i):
+            while not stop_traffic.is_set():
+                try:
+                    res = service.score([ROWS[i % len(ROWS)]],
+                                        deadline_ms=20_000)
+                    seen[i].append(res.model_version)
+                except ScoreError as e:  # pragma: no cover - must not happen
+                    failures.append(e)
+                    return
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in (0, 1)]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 10
+        while min(len(seen[0]), len(seen[1])) < 3:  # mid-flight traffic
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        swap = service.reload(v2)
+        assert swap == {"status": "swapped", "version": fp2,
+                        "previous": fp1}
+        deadline = time.monotonic() + 10
+        while not any(v == fp2 for v in seen[0][-3:]):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        stop_traffic.set()
+        for th in threads:
+            th.join(10)
+        assert not failures, failures
+        for i in (0, 1):
+            assert seen[i], "client scored nothing"
+            assert set(seen[i]) <= {fp1, fp2}
+            # monotonic: once v2 appears, v1 never comes back
+            if fp2 in seen[i]:
+                first_v2 = seen[i].index(fp2)
+                assert all(v == fp2 for v in seen[i][first_v2:])
+        # rollback: instant (already warm), traffic sees v1 again
+        rb = service.rollback()
+        assert rb["version"] == fp1 and rb["previous"] == fp2
+        assert service.score([ROWS[0]]).model_version == fp1
+        assert service.registry.counter(
+            "serving_model_swaps_total").value == 2.0
+    finally:
+        service.stop()
+
+
+def test_reload_same_dir_is_noop_and_rollback_without_history_errors(
+        model_dirs):
+    _, v1, _ = model_dirs
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=4))
+    service.start()
+    try:
+        first = service.health()["model_version"]
+        assert service.reload(v1) == {"status": "unchanged",
+                                      "version": first}
+        with pytest.raises(ScoreError) as ei:
+            service.rollback()
+        assert ei.value.code == "bad_request"
+    finally:
+        service.stop()
+
+
+# --------------------------------------------------------------------- #
+# HTTP frontend                                                         #
+# --------------------------------------------------------------------- #
+
+@pytest.fixture(scope="module")
+def http_server(svc):
+    from transmogrifai_tpu.serving.http import serve
+    server, _ = serve(svc, port=0, block=False)
+    yield f"http://127.0.0.1:{server.port}"
+    server.shutdown()
+    server.server_close()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_http_score_and_healthz(http_server):
+    status, body = _post(f"{http_server}/score", {"rows": ROWS[:2]})
+    assert status == 200
+    assert len(body["scores"]) == 2 and body["model_version"]
+    assert body["latency_ms"] > 0
+    status, single = _post(f"{http_server}/score", {"row": ROWS[0]})
+    assert status == 200 and len(single["scores"]) == 1
+    with urllib.request.urlopen(f"{http_server}/healthz",
+                                timeout=30) as resp:
+        health = json.loads(resp.read())
+    assert resp.status == 200 and health["status"] == "ok"
+    assert health["buckets"] == [1, 2, 4, 8]
+    assert health["model_version"]
+
+
+def test_http_metrics_prometheus_and_json(http_server):
+    with urllib.request.urlopen(f"{http_server}/metrics",
+                                timeout=30) as resp:
+        text = resp.read().decode()
+        ctype = resp.headers["Content-Type"]
+    assert "text/plain" in ctype
+    assert "# TYPE serving_request_latency_seconds histogram" in text
+    assert "serving_request_latency_seconds_count" in text
+    assert "# TYPE serving_queue_depth gauge" in text
+    with urllib.request.urlopen(f"{http_server}/metrics?format=json",
+                                timeout=30) as resp:
+        data = json.loads(resp.read())
+    lat = data["serving_request_latency_seconds"]["series"][0]
+    assert lat["count"] >= 1 and lat["p50"] > 0  # non-zero latency data
+    assert "serving_queue_depth" in data
+
+
+def test_http_error_mapping(http_server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{http_server}/score", {"rows": "nope"})
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["error"] == "bad_request"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{http_server}/score",
+              {"rows": [ROWS[0]] * 9})  # exceeds top bucket
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{http_server}/reload", {})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{http_server}/nope", {})
+    assert ei.value.code == 404
+
+
+def test_http_reload_bad_location_keeps_serving(http_server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{http_server}/reload",
+              {"model_location": "/does/not/exist"})
+    assert ei.value.code == 400
+    status, body = _post(f"{http_server}/score", {"rows": ROWS[:1]})
+    assert status == 200 and body["model_version"]
+
+
+# --------------------------------------------------------------------- #
+# satellites: ragged-tail streaming, runner latency, serve run type     #
+# --------------------------------------------------------------------- #
+
+def test_score_stream_ragged_tail_no_churn(model_dirs):
+    """The final partial micro-batch pads to the warm shape instead of
+    tracing a fresh program — retrace counters stay flat — and the
+    padded tail's scores match the unpadded reference."""
+    from transmogrifai_tpu.analysis.retrace import MONITOR
+    from transmogrifai_tpu.workflow.serialization import load_model
+    ds, v1, _ = model_dirs
+    model = load_model(v1)
+    n = len(ds)
+    full, tail = 64, 23
+    parts = [ds.take(np.arange(0, full)), ds.take(np.arange(full, 2 * full)),
+             ds.take(np.arange(2 * full, 2 * full + tail))]
+    # warm the full-batch shape once
+    list(model.score_stream(iter(parts[:1])))
+    before = MONITOR.snapshot()
+    outs = list(model.score_stream(iter(parts)))
+    assert MONITOR.delta(before) == {}, "ragged tail caused a retrace"
+    assert len(outs) == 3
+    pred_name = next(k for k, v in outs[2].items()
+                     if isinstance(v, dict) and "prediction" in v)
+    tail_probs = np.asarray(outs[2][pred_name]["probability"])
+    assert tail_probs.shape[0] == tail  # pad rows sliced off
+    ref = model.score_compiled(parts[2])[pred_name]
+    np.testing.assert_allclose(tail_probs, np.asarray(ref["probability"]),
+                               rtol=1e-5)
+    # control: with pad_tail=False a FRESH ragged shape (17 — nothing
+    # above traced it) recompiles, proving the monitor catches churn
+    before = MONITOR.snapshot()
+    list(model.score_stream(
+        iter([parts[0], ds.take(np.arange(17))]), pad_tail=False))
+    assert MONITOR.delta(before), "expected a retrace without tail padding"
+
+
+def test_runner_streaming_score_records_batch_latency(model_dirs,
+                                                      tmp_path):
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.workflow import OpParams, WorkflowRunner
+    ds, v1, _ = model_dirs
+    rows = ds.to_rows()
+    reader = DataReaders.stream(records=rows, batch_size=64)
+    runner = WorkflowRunner(Workflow(), score_reader=reader)
+    params = OpParams.from_json({"model_location": v1})
+    result = runner.run("streaming-score", params)
+    assert result.metrics["n_rows"] == len(ds)
+    lat = result.metrics["batch_latency"]
+    assert lat["count"] == result.batches
+    assert lat["p50"] is not None and lat["p50"] > 0
+    assert lat["p99"] >= lat["p50"]
+    # the histogram also rides in the run profile (RunProfile.histograms)
+    assert result.profile["histograms"][
+        "streaming_batch_latency_s"]["count"] == result.batches
+
+
+def test_runner_serve_run_type_bounded(model_dirs):
+    """`serve` as a WorkflowRunner run type: boots the HTTP frontend,
+    serves real requests for serve_duration_s, and reports the metrics
+    registry in the RunResult."""
+    from transmogrifai_tpu.workflow import OpParams, WorkflowRunner
+    from transmogrifai_tpu.workflow.params import ServingParams
+    _, v1, _ = model_dirs
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    params = OpParams(model_location=v1,
+                      serving=ServingParams(port=port, max_batch=4),
+                      custom_params={"serve_duration_s": 3.0})
+    runner = WorkflowRunner(Workflow())
+    box = {}
+
+    def run():
+        box["result"] = runner.run("serve", params)
+
+    th = threading.Thread(target=run)
+    th.start()
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 10
+    scored = None
+    while time.monotonic() < deadline and scored is None:
+        try:
+            _, scored = _post(f"{base}/score", {"rows": ROWS[:2]})
+        except (urllib.error.URLError, ConnectionError):
+            time.sleep(0.05)
+    assert scored is not None and len(scored["scores"]) == 2
+    th.join(30)
+    result = box["result"]
+    assert result.run_type == "serve"
+    assert result.metrics["port"] == port
+    assert result.metrics["model_version"] == scored["model_version"]
+    reqs = result.metrics["serving"]["serving_requests_total"]["series"]
+    assert reqs[0]["value"] >= 1
+
+
+def test_serving_params_json_round_trip():
+    from transmogrifai_tpu.workflow import OpParams
+    from transmogrifai_tpu.workflow.params import ServingParams
+    d = {"model_location": "/m",
+         "serving": {"port": 9999, "max_batch": 32, "buckets": [4, 16, 32],
+                     "batch_wait_ms": 5.0}}
+    p = OpParams.from_json(d)
+    assert isinstance(p.serving, ServingParams)
+    assert p.serving.port == 9999
+    cfg = p.serving.to_config()
+    assert cfg.ladder() == (4, 16, 32)
+    j = p.to_json()
+    assert j["serving"]["max_batch"] == 32
+    assert OpParams.from_json(json.loads(json.dumps(j))).serving.port == 9999
+    assert OpParams.from_json({"model_location": "/m"}).serving is None
+
+
+def test_lint_flags_fixed_leading_batch_dim():
+    """L006: device code must not bake a fixed leading batch dim
+    (incompatible with bucket padding); dynamic/-1 dims are clean."""
+    from transmogrifai_tpu.analysis.lint import lint_source
+    bad = (
+        "import jax.numpy as jnp\n"
+        "class S:\n"
+        "    jittable = True\n"
+        "    def device_apply(self, enc, dev):\n"
+        "        x = dev[0]\n"
+        "        y = x.reshape(128, -1)\n"
+        "        z = jnp.broadcast_to(x, (256, 4))\n"
+        "        return jnp.reshape(y, (64, 2))\n")
+    codes = [f.code for f in lint_source(bad, "bad.py")]
+    assert codes.count("L006") == 3
+    clean = (
+        "import jax.numpy as jnp\n"
+        "class S:\n"
+        "    jittable = True\n"
+        "    def device_apply(self, enc, dev):\n"
+        "        x = dev[0]\n"
+        "        y = x.reshape(x.shape[0], -1)\n"
+        "        z = x.reshape(-1, 1)\n"
+        "        w = jnp.broadcast_to(x, (x.shape[0], 4))\n"
+        "        return y.reshape(1, -1)\n")
+    assert [f.code for f in lint_source(clean, "clean.py")] == []
+    host = (
+        "class H:\n"
+        "    jittable = False\n"
+        "    def device_apply(self, enc, dev):\n"
+        "        return dev[0].reshape(128, -1)\n")
+    assert [f.code for f in lint_source(host, "host.py")] == []
+
+
+def test_mismatched_column_requests_quarantine_not_thread_death(model_dirs):
+    """Requests with different column sets can coalesce; the assembly
+    failure (Dataset.concat mismatch) must degrade to per-request scoring
+    — the full-schema request succeeds, the partial one gets a structured
+    error, and the scoring thread SURVIVES."""
+    _, v1, _ = model_dirs
+    service, started, ungate = _gated_service(v1)
+    try:
+        results = {}
+
+        def client(key, row):
+            try:
+                results[key] = service.score([row], deadline_ms=20_000)
+            except ScoreError as e:
+                results[key] = e
+
+        warm = threading.Thread(target=client, args=("warm", ROWS[0]))
+        warm.start()
+        assert started.wait(10)
+        partial_row = {"age": 30.0, "fare": 9.0}  # no "sex" column
+        a = threading.Thread(target=client, args=("full", ROWS[1]))
+        b = threading.Thread(target=client, args=("partial", partial_row))
+        a.start()
+        b.start()
+        time.sleep(0.2)  # coalesce both behind the gate
+        ungate()
+        for th in (warm, a, b):
+            th.join(10)
+        assert results["full"].n_rows == 1          # batchmate answered
+        assert isinstance(results["partial"], ScoreError)
+        # thread alive: the service still scores
+        assert service.score([ROWS[2]]).n_rows == 1
+    finally:
+        service.stop()
+
+
+def test_service_restarts_after_stop(model_dirs):
+    _, v1, _ = model_dirs
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=4))
+    service.start()
+    assert service.score([ROWS[0]]).n_rows == 1
+    service.stop()
+    with pytest.raises(ScoreError):
+        service.score([ROWS[0]])
+    service.start()  # restart reopens admissions
+    try:
+        assert service.score([ROWS[1]]).n_rows == 1
+    finally:
+        service.stop()
+
+
+def test_bad_deadline_is_bad_request(svc):
+    with pytest.raises(ScoreError) as ei:
+        svc.score([ROWS[0]], deadline_ms="fast")
+    assert ei.value.code == "bad_request"
+
+
+def test_rollback_updates_version_gauge(model_dirs):
+    _, v1, v2 = model_dirs
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=2))
+    service.start()
+    try:
+        gauge = service.registry.gauge("serving_model_versions")
+        assert gauge.value == 1.0
+        service.reload(v2)
+        assert gauge.value == 2.0
+        service.rollback()
+        assert gauge.value == 1.0
+    finally:
+        service.stop()
+
+
+def test_score_stream_midstream_small_batch_not_padded(model_dirs):
+    """Only the FINAL ragged batch pads; a mid-stream smaller batch is a
+    real workload shape and passes through untouched (no silent compute
+    multiplication)."""
+    from transmogrifai_tpu.workflow.serialization import load_model
+    ds, v1, _ = model_dirs
+    model = load_model(v1)
+    sizes = [40, 12, 40, 7]  # 12 is mid-stream, 7 is the tail
+    parts, off = [], 0
+    for s in sizes:
+        parts.append(ds.take(np.arange(off, off + s)))
+        off += s
+    outs = list(model.score_stream(iter(parts)))
+    pred_name = next(k for k, v in outs[0].items()
+                     if isinstance(v, dict) and "probability" in v)
+    got = [np.asarray(o[pred_name]["probability"]).shape[0] for o in outs]
+    assert got == sizes  # mid-stream 12 stayed 12; tail 7 sliced back
+
+
+def test_model_fingerprint_stability(model_dirs, tmp_path):
+    from transmogrifai_tpu.workflow.serialization import (
+        load_model, model_fingerprint)
+    _, v1, v2 = model_dirs
+    assert model_fingerprint(v1) == model_fingerprint(v1)  # deterministic
+    assert model_fingerprint(v1) != model_fingerprint(v2)
+    # a loaded model remembers its provenance, and a loaded-then-saved
+    # copy still scores identically to its source (round-trip integrity)
+    model = load_model(v1)
+    assert model.loaded_from == v1
+    resaved = tmp_path / "resaved"
+    model.save(str(resaved))
+    ds = _make_ds(8, seed=3)
+    a = load_model(v1).score_compiled(ds)
+    b = load_model(str(resaved)).score_compiled(ds)
+    for name in a:
+        if isinstance(a[name], dict) and "probability" in a[name]:
+            np.testing.assert_allclose(
+                np.asarray(a[name]["probability"]),
+                np.asarray(b[name]["probability"]), rtol=1e-6)

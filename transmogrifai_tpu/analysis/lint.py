@@ -29,6 +29,13 @@ silent slowness or nondeterminism once XLA is in the loop:
   (scalar/vector/prediction) — the compiled scorer passes None for
   device-kind columns on the host phase, so that read crashes or
   silently degrades (the contract documented in stages/base.py).
+- ``L006 fixed-batch-dim``: a ``reshape``/``broadcast_to`` inside a
+  device body whose LEADING target dim is an int literal > 1. The
+  serving batcher pads batches to a LADDER of bucket sizes and the
+  streaming tail re-pads to the warm shape, so device code that bakes a
+  specific leading batch dim into a shape is wrong the moment a
+  different bucket arrives — derive it from ``x.shape[0]`` (or use
+  ``-1``) instead.
 
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
@@ -278,6 +285,7 @@ class _FileLinter(ast.NodeVisitor):
         traced = set(params)
         self._check_numpy_use(fn)
         self._check_traced_branches(fn, traced_params=traced)
+        self._check_fixed_batch_dim(fn)
 
     def _check_numpy_use(self, fn: ast.FunctionDef) -> None:
         for sub in ast.walk(fn):
@@ -346,6 +354,48 @@ class _FileLinter(ast.NodeVisitor):
                     f"Python `{kind}` on a traced value inside "
                     f"`{fn.name}` — use jnp.where/lax.cond (branching on "
                     "tracers fails or bakes one path into the compile)")
+
+    # -- L006 -------------------------------------------------------------- #
+
+    _MODULE_RESHAPE_BASES = ("jnp", "np", "numpy", "jax", "lax")
+
+    def _check_fixed_batch_dim(self, fn: ast.FunctionDef) -> None:
+        """Flag reshape/broadcast_to whose leading TARGET dim is an int
+        literal > 1 inside a device body: bucket padding varies the
+        leading batch dim per dispatch, so a baked-in batch size either
+        crashes on the first off-size bucket or silently mis-shapes."""
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call) or \
+                    not isinstance(sub.func, ast.Attribute):
+                continue
+            attr = sub.func.attr
+            if attr not in ("reshape", "broadcast_to"):
+                continue
+            base = sub.func.value
+            module_form = (
+                isinstance(base, ast.Name)
+                and base.id in self._MODULE_RESHAPE_BASES) or \
+                (isinstance(base, ast.Attribute)
+                 and base.attr == "numpy")  # jax.numpy.reshape
+            # method form x.reshape(shape...): shape is args[0];
+            # module form jnp.reshape(x, shape): shape is args[1]
+            idx = 1 if module_form else 0
+            if attr == "broadcast_to" and not module_form:
+                continue  # no ndarray method broadcast_to in jnp
+            if len(sub.args) <= idx:
+                continue
+            shape = sub.args[idx]
+            lead = (shape.elts[0]
+                    if isinstance(shape, (ast.Tuple, ast.List))
+                    and shape.elts else shape)
+            if isinstance(lead, ast.Constant) and \
+                    isinstance(lead.value, int) and lead.value > 1:
+                self._emit(
+                    sub, "L006",
+                    f"`{attr}` in `{fn.name}` pins the leading dim to "
+                    f"{lead.value} — device code must not assume a fixed "
+                    "leading batch dim (bucket padding varies it); derive "
+                    "it from x.shape[0] or use -1")
 
     # -- L003 -------------------------------------------------------------- #
 

@@ -92,6 +92,21 @@ class RetraceMonitor:
                     out[label] = max(out.get(label, 0), n)
             return out
 
+    def snapshot(self) -> Dict[str, int]:
+        """Point-in-time copy of per-label trace counts, for differential
+        accounting around a scoped operation (e.g. the serving warmup
+        attributes compiles to each shape bucket by diffing snapshots)."""
+        return self.counts()
+
+    def delta(self, before: Dict[str, int]) -> Dict[str, int]:
+        """Traces recorded since `before` (a `snapshot()`), per label —
+        labels with no new traces are omitted, so an empty dict means the
+        jit cache fully absorbed the interval (zero recompiles)."""
+        now = self.counts()
+        out = {label: n - before.get(label, 0) for label, n in now.items()
+               if n - before.get(label, 0) > 0}
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self._counts.clear()

@@ -1,0 +1,28 @@
+"""serving/ — online scoring: shape-bucketed micro-batching, model
+hot-swap, runtime metrics, stdlib-HTTP frontend.
+
+The subsystem that turns a saved `WorkflowModel` into a servable,
+observable endpoint (ROADMAP north star: "serves heavy traffic ... as
+fast as the hardware allows"):
+
+- `metrics`  — Counter/Gauge/Histogram registry, JSON + Prometheus text
+- `batcher`  — bounded queue, deadlines, load-shedding, bucket ladder
+- `service`  — ScoringService: AOT bucket warmup, versioned hot-swap
+               with rollback, per-request error quarantine
+- `http`     — /score /healthz /metrics /reload over http.server
+- `smoke`    — self-contained boot-score-scrape-shutdown check
+               (`make serve-smoke`)
+"""
+
+from transmogrifai_tpu.serving.batcher import (  # noqa: F401
+    MicroBatcher, Request, ScoreError, bucket_for, bucket_ladder)
+from transmogrifai_tpu.serving.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry)
+from transmogrifai_tpu.serving.service import (  # noqa: F401
+    ModelVersion, ScoreResult, ScoringService, ServingConfig)
+
+__all__ = [
+    "MicroBatcher", "Request", "ScoreError", "bucket_for", "bucket_ladder",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "ModelVersion", "ScoreResult", "ScoringService", "ServingConfig",
+]

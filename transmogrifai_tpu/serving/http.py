@@ -1,0 +1,200 @@
+"""Stdlib-HTTP frontend for the scoring service.
+
+No web framework (the container constraint is also the right call for a
+latency path): ``http.server.ThreadingHTTPServer`` + JSON bodies. Each
+connection gets an OS thread that does only cheap work — row parsing and
+blocking on the request future; all device work stays on the service's
+single scoring thread.
+
+Endpoints:
+
+- ``POST /score``   ``{"rows": [{...}], "deadline_ms": 500}`` →
+  ``{"scores": [...], "model_version": "...", "latency_ms": ...}``;
+  a single ``{"row": {...}}`` is accepted as shorthand. Structured
+  errors map to status codes: 429 queue_full, 504 deadline_exceeded,
+  400 bad_request, 422 record_error, 503 shutdown, 500 internal.
+- ``GET /healthz``  liveness + active version + queue depth.
+- ``GET /metrics``  Prometheus text (default) or JSON with
+  ``?format=json``.
+- ``POST /reload``  ``{"model_location": "dir"}`` hot-swap, or
+  ``{"rollback": true}`` to restore the previous version.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from transmogrifai_tpu.serving.batcher import ScoreError
+from transmogrifai_tpu.serving.service import ScoringService
+
+log = logging.getLogger(__name__)
+
+_ERROR_STATUS = {
+    "queue_full": 429,
+    "deadline_exceeded": 504,
+    "bad_request": 400,
+    "record_error": 422,
+    "shutdown": 503,
+    "internal": 500,
+}
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the ScoringService reference."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address: Tuple[str, int], service: ScoringService):
+        super().__init__(address, _Handler)
+        self.service = service
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- helpers ----------------------------------------------------------- #
+
+    @property
+    def service(self) -> ScoringService:
+        return self.server.service  # type: ignore[attr-defined]
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        log.debug("http: " + fmt, *args)
+
+    def _send(self, status: int, body: bytes,
+              content_type: str = "application/json") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, payload: Dict[str, Any]) -> None:
+        self._send(status, json.dumps(payload, default=_jsonable).encode())
+
+    def _read_json(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            return {}
+        raw = self.rfile.read(length)
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ScoreError("bad_request", f"invalid JSON body: {e}")
+        if not isinstance(body, dict):
+            raise ScoreError("bad_request", "body must be a JSON object")
+        return body
+
+    # -- routes ------------------------------------------------------------ #
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler casing)
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            health = self.service.health()
+            status = 200 if health["status"] == "ok" else 503
+            self._send_json(status, health)
+        elif path == "/metrics":
+            if "format=json" in query:
+                self._send_json(200, self.service.registry.to_json())
+            else:
+                self._send(
+                    200, self.service.registry.to_prometheus().encode(),
+                    content_type="text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": "not_found",
+                                  "message": f"no route {path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = self.path.partition("?")[0]
+        try:
+            body = self._read_json()
+            if path == "/score":
+                self._score(body)
+            elif path == "/reload":
+                self._reload(body)
+            else:
+                self._send_json(404, {"error": "not_found",
+                                      "message": f"no route {path}"})
+        except ScoreError as e:
+            self._send_json(_ERROR_STATUS.get(e.code, 500), e.to_json())
+        except Exception as e:  # keep the server alive on handler bugs
+            log.exception("http: unhandled error on %s", path)
+            self._send_json(500, {"error": "internal",
+                                  "message": f"{type(e).__name__}: {e}"})
+
+    def _score(self, body: Dict[str, Any]) -> None:
+        rows = body.get("rows")
+        if rows is None and "row" in body:
+            rows = [body["row"]]
+        if not isinstance(rows, list) or not rows or \
+                not all(isinstance(r, dict) for r in rows):
+            raise ScoreError("bad_request",
+                             'expected {"rows": [{...}, ...]}')
+        result = self.service.score(rows,
+                                    deadline_ms=body.get("deadline_ms"))
+        self._send_json(200, {
+            "scores": result.rows(),
+            "model_version": result.model_version,
+            "latency_ms": round(result.latency_s * 1000.0, 3),
+        })
+
+    def _reload(self, body: Dict[str, Any]) -> None:
+        if body.get("rollback"):
+            self._send_json(200, self.service.rollback())
+            return
+        loc = body.get("model_location")
+        if not loc:
+            raise ScoreError(
+                "bad_request",
+                'expected {"model_location": "dir"} or {"rollback": true}')
+        try:
+            self._send_json(200, self.service.reload(loc))
+        except ScoreError:
+            raise
+        except Exception as e:
+            # a bad reload must leave the ACTIVE version serving
+            raise ScoreError("bad_request",
+                             f"reload failed, keeping current version: "
+                             f"{type(e).__name__}: {e}")
+
+
+def _jsonable(v: Any) -> Any:
+    import numpy as np
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return str(v)
+
+
+def serve(service: ScoringService, host: str = "127.0.0.1",
+          port: int = 0, block: bool = True
+          ) -> Tuple[ServingHTTPServer, Optional[threading.Thread]]:
+    """Boot the HTTP frontend over a (started) ScoringService.
+
+    ``port=0`` binds an OS-assigned free port (read it back from
+    ``server.port``). ``block=False`` runs serve_forever on a daemon
+    thread and returns immediately — the smoke test / embedded mode."""
+    server = ServingHTTPServer((host, port), service)
+    if block:
+        try:
+            server.serve_forever(poll_interval=0.2)
+        finally:
+            server.server_close()
+        return server, None
+    thread = threading.Thread(target=server.serve_forever,
+                              kwargs={"poll_interval": 0.2},
+                              name="serving-http", daemon=True)
+    thread.start()
+    return server, thread

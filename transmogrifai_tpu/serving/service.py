@@ -1,0 +1,499 @@
+"""ScoringService: the compiled scorer as a servable, observable endpoint.
+
+This is the productization layer the reference stops short of (SURVEY
+§2.13 ends at cluster-free batch scoring): an in-process service that
+
+- admits row-dict requests through a BOUNDED queue (load-shedding with
+  structured errors at capacity),
+- coalesces concurrent requests into one device batch padded to a
+  power-of-two shape bucket (``serving/batcher.py``) so the jit cache
+  stays warm — the retrace counters prove zero recompiles after warmup,
+- AOT-warms every bucket at model load (one compile per bucket, per
+  segment, before the first request arrives),
+- hot-swaps model versions under traffic: load a new serialization dir,
+  warm it OFF the serving path, then atomically swap; the previous
+  version is retained for one-call rollback,
+- quarantines per-request errors: a failing batch is re-scored request
+  by request so one bad record fails one request, not its batchmates,
+- exports latency/throughput/queue/shed/compile metrics through a
+  ``MetricsRegistry`` (JSON + Prometheus text).
+
+Threading model: callers (any thread) do host-side row→Dataset parsing
+and block on a per-request future; ONE scoring thread owns batch
+assembly and every device dispatch, so jit caches are touched without
+cross-thread interleaving. Model swap flips one attribute under a lock.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.serving.batcher import (
+    MicroBatcher, Request, ScoreError, bucket_for, bucket_ladder,
+    pad_requests)
+from transmogrifai_tpu.serving.metrics import MetricsRegistry
+from transmogrifai_tpu.workflow.compiled import slice_result_tree
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ServingConfig:
+    """Knobs for the scoring service (see also ServingParams in
+    workflow/params.py, its JSON-loadable mirror)."""
+
+    max_batch: int = 64            # top bucket = largest device batch
+    min_bucket: int = 1            # bottom rung of the ladder
+    buckets: Optional[Sequence[int]] = None  # explicit ladder override
+    max_queue: int = 256           # bounded admission queue
+    batch_wait_ms: float = 2.0     # linger to coalesce concurrent requests
+    default_deadline_ms: float = 2000.0  # per-request deadline
+    warm_on_load: bool = True      # AOT-compile every bucket at load
+    keep_versions: int = 2         # live + rollback
+
+    def ladder(self) -> Tuple[int, ...]:
+        if self.buckets:
+            ladder = tuple(sorted(set(int(b) for b in self.buckets)))
+            if ladder[0] < 1:
+                raise ValueError(f"bucket sizes must be >= 1: {ladder}")
+            return ladder
+        return bucket_ladder(self.max_batch, self.min_bucket)
+
+
+def raw_schema(model) -> Dict[str, type]:
+    """Raw input column name -> feature type, from the model's own graph
+    (the reader-schema derivation the runner uses, DataReader.scala:221)."""
+    schema: Dict[str, type] = {}
+    for rf in model.result_features:
+        for f in rf.raw_features():
+            schema[f.name] = f.ftype
+    return schema
+
+
+def _synthetic_rows(schema: Dict[str, type], n: int,
+                    response_names: Sequence[str] = ()) -> List[Dict[str, Any]]:
+    """Type-appropriate warmup rows: numerics 0, text-kinds None (the
+    missing-value path every fitted stage already handles). Only SHAPES
+    matter for warmup — the scores are discarded."""
+    row: Dict[str, Any] = {}
+    for name, ftype in schema.items():
+        if name in response_names:
+            continue
+        if issubclass(ftype, T.Binary):
+            row[name] = False
+        elif issubclass(ftype, T.OPNumeric):
+            row[name] = 0.0
+        else:
+            row[name] = None
+    return [dict(row) for _ in range(n)]
+
+
+class ModelVersion:
+    """One loaded + warmed model: the unit of hot-swap."""
+
+    def __init__(self, model, version_id: str,
+                 path: Optional[str] = None):
+        self.model = model
+        self.version_id = version_id
+        self.path = path or getattr(model, "loaded_from", None)
+        self.loaded_at = time.time()
+        self.scorer = model._ensure_compiled()
+        self.compile_counts: Dict[int, int] = {}  # bucket -> traces seen
+
+    def warm(self, ladder: Tuple[int, ...],
+             warm_rows: Optional[List[Dict[str, Any]]] = None) -> None:
+        """AOT-compile every bucket shape BEFORE serving traffic from this
+        version. Warm data is synthesized from the model's raw schema
+        (or caller-provided rows); per-bucket trace deltas are kept so
+        the metrics surface can report compile counts per bucket."""
+        from transmogrifai_tpu.analysis.retrace import MONITOR
+        schema = raw_schema(self.model)
+        responses = [f.name for rf in self.model.result_features
+                     for f in rf.raw_features() if f.is_response]
+        rows = warm_rows or _synthetic_rows(schema, 1, responses)
+        base = Dataset.from_rows(
+            rows, schema={k: v for k, v in schema.items()
+                          if k in rows[0]})
+        for bucket in ladder:
+            before = MONITOR.snapshot()
+            # score_padded only pads UP: truncate warm data for buckets
+            # smaller than the provided warm rows
+            sample = base if len(base) <= bucket \
+                else base.take(np.arange(bucket))
+            self.scorer.score_padded(sample, bucket)
+            new = sum(MONITOR.delta(before).values())
+            self.compile_counts[bucket] = \
+                self.compile_counts.get(bucket, 0) + new
+
+    def info(self) -> Dict[str, Any]:
+        return {"version": self.version_id, "path": self.path,
+                "loaded_at": self.loaded_at,
+                "compile_counts": {str(k): v
+                                   for k, v in self.compile_counts.items()}}
+
+
+@dataclass
+class ScoreResult:
+    """Per-request outcome: result feature name -> host arrays (sliced to
+    this request's rows) + the serving version that produced it."""
+
+    outputs: Dict[str, Any]
+    model_version: str
+    n_rows: int = 0
+    latency_s: float = 0.0
+
+    def rows(self) -> List[Dict[str, Any]]:
+        """Row-dict view of the outputs (the `/score` JSON shape),
+        matching `score_function`'s per-row conversion."""
+        out: List[Dict[str, Any]] = []
+        for i in range(self.n_rows):
+            row: Dict[str, Any] = {}
+            for name, v in self.outputs.items():
+                if isinstance(v, dict) and "prediction" in v:
+                    m: Dict[str, float] = {"prediction": float(
+                        np.asarray(v["prediction"])[i])}
+                    prob = np.asarray(v["probability"])[i]
+                    for j, x in enumerate(np.ravel(prob)):
+                        m[f"probability_{j}"] = float(x)
+                    row[name] = m
+                elif isinstance(v, dict) and "value" in v:
+                    present = bool(np.asarray(v["mask"])[i])
+                    row[name] = (float(np.asarray(v["value"])[i])
+                                 if present else None)
+                else:
+                    arr = np.asarray(v)
+                    first = arr[i]
+                    if arr.dtype == object:
+                        row[name] = first
+                    else:
+                        row[name] = (first.tolist() if arr.ndim > 1
+                                     else first.item())
+            out.append(row)
+        return out
+
+
+class ScoringService:
+    """Online scoring over a loaded WorkflowModel. See module docstring.
+
+    Usage::
+
+        svc = ScoringService.from_path("model_dir")
+        svc.start()
+        result = svc.score([{"age": 31.0, "sex": "male", ...}])
+        svc.reload("model_dir_v2")   # warm, then atomic swap
+        svc.rollback()               # back to the prior version
+        svc.stop()
+    """
+
+    def __init__(self, model=None, version_id: Optional[str] = None,
+                 config: Optional[ServingConfig] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 warm_rows: Optional[List[Dict[str, Any]]] = None):
+        self.config = config or ServingConfig()
+        self.ladder = self.config.ladder()
+        self.registry = registry or MetricsRegistry()
+        self.warm_rows = warm_rows
+        self._swap_lock = threading.Lock()
+        self._versions: List[ModelVersion] = []   # newest-last history
+        self._active: Optional[ModelVersion] = None
+        self._batcher = MicroBatcher(
+            self.config.max_queue, self.ladder[-1],
+            batch_wait_s=self.config.batch_wait_ms / 1000.0)
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.started_at = time.time()
+        self._schema: Dict[str, type] = {}
+        self._init_metrics()
+        if model is not None:
+            self._install(model, version_id or "v0")
+
+    # -- construction ------------------------------------------------------ #
+
+    @classmethod
+    def from_path(cls, model_location: str, **kwargs) -> "ScoringService":
+        from transmogrifai_tpu.workflow.serialization import (
+            load_model, model_fingerprint)
+        model = load_model(model_location)
+        return cls(model=model,
+                   version_id=model_fingerprint(model_location), **kwargs)
+
+    def _init_metrics(self) -> None:
+        r = self.registry
+        self._m_requests = r.counter(
+            "serving_requests_total", "scoring requests admitted")
+        self._m_rows = r.counter(
+            "serving_rows_total", "rows scored (valid rows, not padding)")
+        self._m_pad_rows = r.counter(
+            "serving_padded_rows_total", "pad rows added for shape buckets")
+        self._m_batches = r.counter(
+            "serving_batches_total", "device batches dispatched")
+        self._m_swaps = r.counter(
+            "serving_model_swaps_total", "successful model hot-swaps")
+        self._m_errors = r.counter(
+            "serving_errors_total", "requests failed with internal errors")
+        self._m_queue = r.gauge(
+            "serving_queue_depth", "requests waiting in the bounded queue")
+        self._m_latency = r.histogram(
+            "serving_request_latency_seconds",
+            "enqueue-to-resolve latency per request")
+        self._m_batch_lat = r.histogram(
+            "serving_batch_latency_seconds",
+            "device batch execution latency")
+
+    def _shed(self, reason: str):
+        return self.registry.counter(
+            "serving_shed_total", "requests shed under overload",
+            reason=reason)
+
+    def _install(self, model, version_id: str,
+                 path: Optional[str] = None) -> ModelVersion:
+        """Load-side half of a swap: compile + warm OFF the serving path,
+        then atomically flip `_active`."""
+        version = ModelVersion(model, version_id, path=path)
+        if self.config.warm_on_load:
+            version.warm(self.ladder, self.warm_rows)
+            # bucket label only (no version label): label cardinality must
+            # stay bounded by the ladder width, not grow per reload — the
+            # per-version breakdown lives in health()['versions'] instead
+            for bucket, n in version.compile_counts.items():
+                self.registry.counter(
+                    "serving_bucket_compiles_total",
+                    "XLA traces attributed to each shape bucket at warmup",
+                    bucket=bucket).inc(n)
+        with self._swap_lock:
+            self._versions.append(version)
+            keep = max(2, self.config.keep_versions)
+            del self._versions[:-keep]
+            self._active = version
+            self._schema = raw_schema(model)
+        self.registry.gauge(
+            "serving_model_versions", "versions held (active + rollback)"
+        ).set(len(self._versions))
+        return version
+
+    # -- lifecycle --------------------------------------------------------- #
+
+    def start(self) -> "ScoringService":
+        if self._active is None:
+            raise RuntimeError("no model installed — pass one or reload()")
+        if self._running:
+            return self
+        if self._batcher.closed:  # restart after stop(): fresh admissions
+            self._batcher = MicroBatcher(
+                self.config.max_queue, self.ladder[-1],
+                batch_wait_s=self.config.batch_wait_ms / 1000.0)
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="scoring-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._running = False
+        for req in self._batcher.close():
+            req.fail(ScoreError("shutdown", "service stopped"))
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def __enter__(self) -> "ScoringService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client API -------------------------------------------------------- #
+
+    def score(self, rows: List[Dict[str, Any]],
+              deadline_ms: Optional[float] = None,
+              timeout_s: Optional[float] = None) -> ScoreResult:
+        """Score `rows` (list of raw-column dicts). Blocks until the
+        micro-batcher resolves this request or its deadline passes.
+        Raises ScoreError with a structured code on shed/expiry/bad
+        input — the service keeps serving others regardless."""
+        if not self._running:
+            raise ScoreError("shutdown", "service is not running")
+        if not rows:
+            raise ScoreError("bad_request", "empty rows")
+        try:
+            ds = Dataset.from_rows(
+                rows, schema={k: v for k, v in self._schema.items()
+                              if k in rows[0]})
+        except Exception as e:
+            raise ScoreError("bad_request", f"unparseable rows: {e}")
+        bucket_for(len(ds), self.ladder)  # admission: must fit a bucket
+        if deadline_ms is None:
+            ddl_ms = self.config.default_deadline_ms
+        else:
+            try:
+                ddl_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                raise ScoreError(
+                    "bad_request",
+                    f"deadline_ms must be a number, got {deadline_ms!r}")
+        deadline = (time.monotonic() + ddl_ms / 1000.0) if ddl_ms > 0 \
+            else None
+        req = Request(ds, deadline)
+        try:
+            self._batcher.put(req)
+        except ScoreError as e:
+            self._shed(e.code).inc()
+            raise
+        self._m_requests.inc()
+        self._m_queue.set(self._batcher.depth())
+        wait_s = timeout_s if timeout_s is not None else (
+            ddl_ms / 1000.0 + 30.0 if ddl_ms > 0 else None)
+        outputs, version = req.wait(wait_s)
+        latency = time.monotonic() - req.enqueued_at
+        self._m_latency.observe(latency)
+        return ScoreResult(outputs=outputs, model_version=version,
+                           n_rows=req.n_rows, latency_s=latency)
+
+    def score_row(self, row: Dict[str, Any], **kw) -> Dict[str, Any]:
+        """Single-row convenience: returns the one result row dict."""
+        return self.score([row], **kw).rows()[0]
+
+    # -- hot swap ---------------------------------------------------------- #
+
+    def reload(self, model_location: str) -> Dict[str, Any]:
+        """Load + warm a new serialized model, then atomically swap it
+        under traffic. The displaced version stays resident for
+        `rollback()`. In-flight batches finish on the version they were
+        dispatched with — no request is ever mis-versioned."""
+        from transmogrifai_tpu.workflow.serialization import (
+            load_model, model_fingerprint)
+        vid = model_fingerprint(model_location)
+        active = self._active
+        if active is not None and active.version_id == vid:
+            return {"status": "unchanged", "version": vid}
+        model = load_model(model_location)
+        version = self._install(model, vid, path=model_location)
+        self._m_swaps.inc()
+        log.info("serving: swapped to model %s from %s", vid,
+                 model_location)
+        return {"status": "swapped", "version": version.version_id,
+                "previous": active.version_id if active else None}
+
+    def rollback(self) -> Dict[str, Any]:
+        """Re-activate the previous resident version (already warm —
+        rollback is instant, no compile)."""
+        with self._swap_lock:
+            if len(self._versions) < 2:
+                raise ScoreError("bad_request",
+                                 "no previous version to roll back to")
+            demoted = self._versions.pop()
+            restored = self._versions[-1]
+            self._active = restored
+            self._schema = raw_schema(restored.model)
+            n_versions = len(self._versions)
+        self.registry.gauge(
+            "serving_model_versions", "versions held (active + rollback)"
+        ).set(n_versions)
+        self._m_swaps.inc()
+        log.info("serving: rolled back %s -> %s", demoted.version_id,
+                 restored.version_id)
+        return {"status": "rolled_back", "version": restored.version_id,
+                "previous": demoted.version_id}
+
+    # -- introspection ----------------------------------------------------- #
+
+    def health(self) -> Dict[str, Any]:
+        active = self._active
+        return {
+            "status": "ok" if (self._running and active) else "down",
+            "model_version": active.version_id if active else None,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "queue_depth": self._batcher.depth(),
+            "buckets": list(self.ladder),
+            "versions": [v.info() for v in self._versions],
+        }
+
+    # -- scoring thread ---------------------------------------------------- #
+
+    def _serve_loop(self) -> None:
+        while self._running:
+            batch, expired = self._batcher.next_batch()
+            self._m_queue.set(self._batcher.depth())
+            for req in expired:
+                self._shed("deadline_exceeded").inc()
+                req.fail(ScoreError(
+                    "deadline_exceeded",
+                    "request deadline passed while queued"))
+            if not batch:
+                continue
+            try:
+                self._process(batch)
+            except Exception as e:  # the scoring thread must NEVER die
+                log.exception("serving: unexpected batch failure")
+                for req in batch:
+                    if not req._event.is_set():
+                        req.fail(ScoreError(
+                            "internal",
+                            f"unexpected serving failure: "
+                            f"{type(e).__name__}: {e}"))
+
+    def _process(self, batch: List[Request]) -> None:
+        version = self._active  # pinned: swaps cannot mis-version a batch
+        assert version is not None
+        t0 = time.monotonic()
+        try:
+            # batch ASSEMBLY is inside the quarantine too: two requests
+            # with mismatched column sets fail Dataset.concat, and that
+            # must degrade to per-request scoring, not kill the batch
+            ds, n_valid, bucket = pad_requests(batch, self.ladder)
+            out = version.scorer.score_padded(ds, bucket)
+        except Exception as e:
+            # error quarantine: one bad record must fail ONE request.
+            # Re-score each request alone so its batchmates still get
+            # answers; only the offender sees the error.
+            log.warning("serving: batch of %d requests failed (%s); "
+                        "quarantining per-request", len(batch), e)
+            for req in batch:
+                self._score_single(req, version)
+            return
+        self._account_batch(len(batch), n_valid, bucket,
+                            time.monotonic() - t0)
+        off = 0
+        for req in batch:
+            sliced = {name: slice_result_tree(v, off, off + req.n_rows)
+                      for name, v in out.items()}
+            req.resolve(sliced, version.version_id)
+            off += req.n_rows
+
+    def _score_single(self, req: Request, version: ModelVersion) -> None:
+        try:
+            bucket = bucket_for(req.n_rows, self.ladder)
+            t0 = time.monotonic()
+            out = version.scorer.score_padded(req.dataset, bucket)
+            self._account_batch(1, req.n_rows, bucket,
+                                time.monotonic() - t0)
+            req.resolve(out, version.version_id)
+        except Exception as e:
+            self._m_errors.inc()
+            req.fail(ScoreError(
+                "record_error",
+                f"request failed scoring in isolation: "
+                f"{type(e).__name__}: {e}"))
+
+    def _account_batch(self, n_requests: int, n_valid: int, bucket: int,
+                       latency_s: float) -> None:
+        self._m_batches.inc()
+        self._m_rows.inc(n_valid)
+        self._m_pad_rows.inc(bucket - n_valid)
+        self._m_batch_lat.observe(latency_s)
+        self.registry.counter(
+            "serving_bucket_batches_total",
+            "device batches dispatched per shape bucket",
+            bucket=bucket).inc()
+        self.registry.counter(
+            "serving_bucket_requests_total",
+            "requests coalesced per shape bucket",
+            bucket=bucket).inc(n_requests)

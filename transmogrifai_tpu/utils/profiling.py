@@ -45,6 +45,15 @@ class RunProfile:
     custom_tag_value: Optional[str] = None
     phases: List[PhaseMetric] = field(default_factory=list)
     started_at: float = field(default_factory=time.time)
+    histograms: Dict[str, Any] = field(default_factory=dict)
+
+    def record_histogram(self, name: str, hist) -> None:
+        """Attach a distribution summary (p50/p95/p99/count/...) to the
+        profile — `hist` is a `serving.metrics.Histogram` (or any object
+        with a `summary()` dict). Used by the streaming scorer for
+        per-batch latency, and by the serve run type for its registry."""
+        self.histograms[name] = hist.summary() if hasattr(hist, "summary") \
+            else dict(hist)
 
     @contextlib.contextmanager
     def phase(self, name: str, **extra):
@@ -70,6 +79,7 @@ class RunProfile:
                            if self.custom_tag_name else None),
             "app_duration_s": round(self.app_duration_s, 4),
             "phases": [p.to_json() for p in self.phases],
+            "histograms": self.histograms or None,
         }
 
     def write(self, path: str) -> None:

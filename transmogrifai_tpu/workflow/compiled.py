@@ -34,6 +34,38 @@ from transmogrifai_tpu.stages.base import (
     Transformer, is_host_stage)
 
 
+def pad_dataset(dataset: Dataset, target_rows: int) -> Dataset:
+    """Pad a Dataset to `target_rows` by repeating its last row.
+
+    Shape-bucket discipline: the serving batcher and the streaming
+    ragged-tail path never hand the compiled scorer a novel batch shape —
+    they pad up to an already-compiled bucket and slice the result back.
+    Repeating a REAL row (instead of synthesizing nulls) guarantees the
+    pad rows take the exact host-encode path the valid rows take, so
+    padding can never introduce a new code path or dtype."""
+    n = len(dataset)
+    if target_rows < n:
+        raise ValueError(f"cannot pad {n} rows down to {target_rows}")
+    if target_rows == n:
+        return dataset
+    if n == 0:
+        raise ValueError("cannot pad an empty dataset (no row to repeat)")
+    pad_idx = np.full(target_rows - n, n - 1, dtype=np.int64)
+    return Dataset.concat([dataset, dataset.take(pad_idx)])
+
+
+def slice_result_tree(value: Any, start: int, stop: int) -> Any:
+    """Slice every batch-leading array leaf of a scoring result pytree
+    (dicts of arrays, Prediction dicts, bare arrays) to rows
+    [start, stop) — the inverse of batch coalescing/padding."""
+    if isinstance(value, dict):
+        return {k: slice_result_tree(v, start, stop)
+                for k, v in value.items()}
+    if getattr(value, "ndim", 0) >= 1:
+        return value[start:stop]
+    return value
+
+
 def _column_from_device(ftype: type, dev) -> Column:
     """Wrap a device pytree back into a host Column (segment boundary)."""
     if isinstance(dev, dict) and "prediction" in dev:
@@ -250,3 +282,20 @@ class CompiledScorer:
             else:  # host-kind result feature
                 result[f.name] = columns[f.uid].data
         return result
+
+    def score_padded(self, dataset: Dataset,
+                     pad_to: int) -> Dict[str, Any]:
+        """Score `dataset` padded up to `pad_to` rows (a shape bucket),
+        returning results for ONLY the valid rows.
+
+        The validity mask is positional — pad rows are appended, so rows
+        [0, n_valid) of every result leaf are the real ones and the tail
+        is sliced off before anything leaves this call. Each distinct
+        `pad_to` value compiles once; every batch size <= `pad_to` then
+        reuses that program (the serving batcher's bucket ladder)."""
+        n_valid = len(dataset)
+        out = self(pad_dataset(dataset, pad_to))
+        if pad_to == n_valid:
+            return out
+        return {name: slice_result_tree(v, 0, n_valid)
+                for name, v in out.items()}

@@ -41,6 +41,48 @@ class ReaderParams:
 
 
 @dataclass
+class ServingParams:
+    """Online-serving runtime params (the `serving/` subsystem's
+    JSON-loadable config: the `serve` run type / CLI subcommand builds a
+    `serving.ServingConfig` + HTTP frontend from this)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8080               # 0 = OS-assigned free port
+    max_batch: int = 64
+    min_bucket: int = 1
+    buckets: Optional[list] = None  # explicit ladder; overrides max_batch
+    max_queue: int = 256
+    batch_wait_ms: float = 2.0
+    default_deadline_ms: float = 2000.0
+    warm_on_load: bool = True
+    keep_versions: int = 2
+
+    _FIELDS = ("host", "port", "max_batch", "min_bucket", "buckets",
+               "max_queue", "batch_wait_ms", "default_deadline_ms",
+               "warm_on_load", "keep_versions")
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ServingParams":
+        return ServingParams(**{k: d[k] for k in ServingParams._FIELDS
+                                if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+    def to_config(self):
+        """The serving.ServingConfig view (service knobs only — host/port
+        belong to the HTTP frontend)."""
+        from transmogrifai_tpu.serving.service import ServingConfig
+        return ServingConfig(
+            max_batch=self.max_batch, min_bucket=self.min_bucket,
+            buckets=self.buckets, max_queue=self.max_queue,
+            batch_wait_ms=self.batch_wait_ms,
+            default_deadline_ms=self.default_deadline_ms,
+            warm_on_load=self.warm_on_load,
+            keep_versions=self.keep_versions)
+
+
+@dataclass
 class OpParams:
     """Runtime workflow configuration (OpParams.scala:81-97)."""
 
@@ -55,11 +97,14 @@ class OpParams:
     log_stage_metrics: bool = False
     collect_stage_metrics: bool = True
     custom_params: Dict[str, Any] = field(default_factory=dict)
+    serving: Optional[ServingParams] = None
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
         readers = {k: ReaderParams.from_json(v)
                    for k, v in (d.get("reader_params") or {}).items()}
+        serving = (ServingParams.from_json(d["serving"])
+                   if d.get("serving") else None)
         return OpParams(
             stage_params=dict(d.get("stage_params") or {}),
             reader_params=readers,
@@ -71,7 +116,8 @@ class OpParams:
             custom_tag_value=d.get("custom_tag_value"),
             log_stage_metrics=bool(d.get("log_stage_metrics", False)),
             collect_stage_metrics=bool(d.get("collect_stage_metrics", True)),
-            custom_params=dict(d.get("custom_params") or {}))
+            custom_params=dict(d.get("custom_params") or {}),
+            serving=serving)
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -92,6 +138,7 @@ class OpParams:
             "log_stage_metrics": self.log_stage_metrics,
             "collect_stage_metrics": self.collect_stage_metrics,
             "custom_params": self.custom_params,
+            "serving": self.serving.to_json() if self.serving else None,
         }
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -29,7 +30,8 @@ from transmogrifai_tpu.workflow.workflow import Workflow, WorkflowModel
 
 log = logging.getLogger(__name__)
 
-RUN_TYPES = ("train", "score", "streaming-score", "features", "evaluate")
+RUN_TYPES = ("train", "score", "streaming-score", "features", "evaluate",
+             "serve")
 
 
 @dataclass
@@ -102,6 +104,7 @@ class WorkflowRunner:
             "train": self._train, "score": self._score,
             "streaming-score": self._streaming_score,
             "features": self._features, "evaluate": self._evaluate,
+            "serve": self._serve,
         }
         result = dispatch[run_type](params, profile)
         result.profile = profile.to_json()
@@ -198,7 +201,14 @@ class WorkflowRunner:
             os.makedirs(loc, exist_ok=True)
         n_batches = 0
         n_rows = 0
+        # per-batch consume-to-consume latency through the pipelined
+        # scorer, into the serving metrics histogram type — p50 tracks
+        # steady-state, p99 exposes stalls/recompiles (ML Goodput:
+        # untracked stalls, not FLOPs, dominate fleet efficiency)
+        from transmogrifai_tpu.serving.metrics import Histogram
+        batch_latency = Histogram()
         with profile.phase(profiling.SCORING):
+            t_prev = time.perf_counter()
             for out in model.score_stream(reader.stream()):
                 if loc:
                     _write_scores(out, model, os.path.join(
@@ -206,9 +216,60 @@ class WorkflowRunner:
                 first = next(iter(out.values()))
                 n_rows += _batch_len(first)
                 n_batches += 1
+                now = time.perf_counter()
+                batch_latency.observe(now - t_prev)
+                t_prev = now
+        profile.record_histogram("streaming_batch_latency_s", batch_latency)
         return RunResult("streaming-score",
-                         metrics={"n_rows": n_rows, "batches": n_batches},
+                         metrics={"n_rows": n_rows, "batches": n_batches,
+                                  "batch_latency": batch_latency.summary()},
                          write_location=loc, batches=n_batches)
+
+    def _serve(self, params: OpParams, profile: RunProfile) -> RunResult:
+        """Online scoring run type: load the model, AOT-warm the shape
+        buckets, and serve `/score` `/healthz` `/metrics` `/reload` until
+        interrupted (or for `custom_params["serve_duration_s"]` seconds —
+        the testable bounded mode). The serving metrics registry is
+        written into the run result, so a bounded serve doubles as a
+        micro-benchmark record."""
+        from transmogrifai_tpu.serving.http import serve as http_serve
+        from transmogrifai_tpu.serving.service import ScoringService
+        from transmogrifai_tpu.workflow.params import ServingParams
+
+        if not params.model_location:
+            raise ValueError("model_location required")
+        sp = params.serving or ServingParams()
+        with profile.phase(profiling.SCORING):
+            service = ScoringService.from_path(
+                params.model_location, config=sp.to_config())
+            service.start()
+        server, thread = http_serve(service, host=sp.host, port=sp.port,
+                                    block=False)
+        log.info("serving %s on http://%s:%d (buckets %s)",
+                 params.model_location, sp.host, server.port,
+                 list(service.ladder))
+        duration = params.custom_params.get("serve_duration_s")
+        try:
+            if duration is not None:
+                time.sleep(float(duration))
+            else:
+                while thread.is_alive():  # until KeyboardInterrupt
+                    thread.join(1.0)
+        except KeyboardInterrupt:
+            log.info("serve: interrupted, shutting down")
+        finally:
+            server.shutdown()
+            server.server_close()
+            service.stop()
+        profile.record_histogram(
+            "request_latency_s",
+            service.registry.histogram("serving_request_latency_seconds"))
+        return RunResult(
+            "serve",
+            metrics={"port": server.port,
+                     "model_version": service.health()["model_version"],
+                     "serving": service.registry.to_json()},
+            model_location=params.model_location)
 
     def _features(self, params: OpParams, profile: RunProfile) -> RunResult:
         """Materialize + write the transformed feature columns

@@ -142,9 +142,46 @@ def save_model(model, path: str, overwrite: bool = True,
         json.dump(manifest, fh)
 
 
+def model_fingerprint(path: str) -> str:
+    """Stable short id for a serialized model dir: sha256 over the
+    manifest bytes + the arrays.npz bytes. Deterministic per dir (the
+    bytes ARE the identity) and any retrain/param change moves it; two
+    separate save() calls need not match (npz zip metadata differs).
+    The serving layer uses this as the hot-swap version id, so '/reload'
+    of an unchanged dir is detectable as a no-op and a rollback target
+    is identified by content, not by path."""
+    import hashlib
+    h = hashlib.sha256()
+    with open(os.path.join(path, MANIFEST), "rb") as fh:
+        h.update(fh.read())
+    npz_path = os.path.join(path, ARRAYS)
+    if os.path.exists(npz_path):
+        with open(npz_path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()[:12]
+
+
+def _ensure_stage_library() -> None:
+    """Import the standard stage library so StageRegistry resolves every
+    built-in class. Training paths import these modules implicitly via
+    the app graph; a model-only process (e.g. `cli serve`, or a bare
+    `WorkflowModel.load`) has no app imports, so load must pull in the
+    registry population itself."""
+    import importlib
+    for mod in ("transmogrifai_tpu.ops", "transmogrifai_tpu.models",
+                "transmogrifai_tpu.automl", "transmogrifai_tpu.selector",
+                "transmogrifai_tpu.insights"):
+        try:
+            importlib.import_module(mod)
+        except Exception:  # a broken optional module must not block load;
+            pass           # a truly missing class still raises below
+
+
 def load_model(path: str):
     from transmogrifai_tpu.workflow.workflow import WorkflowModel
 
+    _ensure_stage_library()
     with open(os.path.join(path, MANIFEST)) as fh:
         manifest = json.load(fh)
     if manifest["version"] != VERSION:
@@ -179,4 +216,6 @@ def load_model(path: str):
         uid: stage for uid, stage in stages.items()
         if isinstance(stage, Transformer)}
     result = [features[uid] for uid in manifest["result_features"]]
-    return WorkflowModel(result_features=result, fitted=fitted)
+    model = WorkflowModel(result_features=result, fitted=fitted)
+    model.loaded_from = path  # provenance for serving hot-swap/reload
+    return model

@@ -297,6 +297,7 @@ class WorkflowModel:
         self.rff_results = None   # RawFeatureFilterResults when RFF ran
         self.blocklist: List[str] = []
         self._check_finite = False
+        self.loaded_from: Optional[str] = None  # set by load_model
 
     def with_finite_checks(self, enabled: bool = True) -> "WorkflowModel":
         """Numeric-sanitizer discipline (SURVEY §5.2 — the build's
@@ -390,7 +391,7 @@ class WorkflowModel:
     def score_stream(self, batches, prefetch: int = 2, sharding=None,
                      host_workers: int = 2, device_depth: int = 2,
                      fetch_group: int = 1, coalesce_rows: int = 0,
-                     strict: bool = True):
+                     strict: bool = True, pad_tail: bool = True):
         """Streaming micro-batch scoring as a TWO-stage pipeline
         (OpWorkflowRunner streaming loop, OpWorkflowRunner.scala:233-262):
 
@@ -423,11 +424,23 @@ class WorkflowModel:
         roughly until compute dominates; stable input batch sizes keep
         the coalesced shape stable (one compiled program).
 
+        `pad_tail` (default on) pads a RAGGED FINAL micro-batch up to the
+        largest batch shape already seen instead of tracing a fresh XLA
+        program for it: a 10M-row stream at batch 1024 ends with one
+        partial batch, and before this fix that one batch paid a full
+        recompile (seconds) to score a sliver of rows. Pad rows repeat
+        the last real row and are sliced back off before the yield, so
+        the output contract is unchanged (`analysis/retrace` counters
+        assert the no-churn property in tests).
+
         `batches`: iterable of Datasets (e.g. `StreamingReader.stream()`).
         Yields {feature_name: result} per batch like `score_compiled`.
         """
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
+
+        from transmogrifai_tpu.workflow.compiled import (
+            pad_dataset, slice_result_tree)
 
         if coalesce_rows and coalesce_rows > 0:
             split_sizes: deque = deque()
@@ -445,24 +458,58 @@ class WorkflowModel:
                     split_sizes.append([b.n_rows for b in buf])
                     yield Dataset.concat(buf)
 
-            def _slice(v, a, b):
-                if isinstance(v, dict):
-                    return {k: _slice(x, a, b) for k, x in v.items()}
-                if getattr(v, "ndim", 0) >= 1:
-                    return v[a:b]
-                return v
-
             # results come back in dispatch order, so the FIFO of split
             # sizes stays aligned with the inner generator's yields
             for host in self.score_stream(
                     _coalesced(), prefetch=prefetch, sharding=sharding,
                     host_workers=host_workers, device_depth=device_depth,
-                    fetch_group=fetch_group, strict=strict):
+                    fetch_group=fetch_group, strict=strict,
+                    pad_tail=pad_tail):
                 off = 0
                 for s in split_sizes.popleft():
-                    yield {f: _slice(v, off, off + s)
+                    yield {f: slice_result_tree(v, off, off + s)
                            for f, v in host.items()}
                     off += s
+            return
+        if pad_tail:
+            # ragged-tail fix: the FINAL partial batch re-pads to the
+            # largest shape already compiled (then slices the pad rows
+            # back off) instead of tracing a fresh program for one batch.
+            # Only the final batch: a mid-stream smaller batch is a real
+            # workload shape (variable-size sources), and padding every
+            # one of them to the max would silently multiply device work.
+            # One-item lookahead tells us which batch is last; an empty
+            # final batch passes through unpadded (nothing to repeat).
+            tail_sizes: deque = deque()
+
+            def _pad_tails():
+                it = iter(batches)
+                try:
+                    cur = next(it)
+                except StopIteration:
+                    return
+                prev = 0
+                for nxt in it:
+                    tail_sizes.append((cur.n_rows, cur.n_rows))
+                    yield cur
+                    prev = max(prev, cur.n_rows)
+                    cur = nxt
+                n = cur.n_rows
+                target = prev if (prev and 0 < n < prev) else n
+                tail_sizes.append((n, target))
+                yield pad_dataset(cur, target) if target > n else cur
+
+            for host in self.score_stream(
+                    _pad_tails(), prefetch=prefetch, sharding=sharding,
+                    host_workers=host_workers, device_depth=device_depth,
+                    fetch_group=fetch_group, strict=strict,
+                    pad_tail=False):
+                n, target = tail_sizes.popleft()
+                if target > n:
+                    yield {f: slice_result_tree(v, 0, n)
+                           for f, v in host.items()}
+                else:
+                    yield host
             return
         scorer = self._ensure_compiled(sharding, strict)
         try:
