@@ -3,6 +3,10 @@
 # (ROADMAP.md) must pass.
 
 PY ?= python
+# bash, not /bin/sh: TIER1 uses PIPESTATUS, and with a dash /bin/sh the
+# old `bash -c "$(TIER1)"` indirection broke — the OUTER shell expanded
+# ${PIPESTATUS[0]} inside the double quotes ("Bad substitution")
+SHELL := /bin/bash
 TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	-m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -11,10 +15,17 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-.PHONY: lint serve-smoke test check
+.PHONY: lint serve-smoke ingest-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
+
+# out-of-core ingest smoke: small synthetic ColumnarStore through the
+# pipelined one-pass dual-representation build (data/pipeline.py) —
+# asserts serial-parity results and that overlap metrics are emitted.
+ingest-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -c "from transmogrifai_tpu.data.pipeline \
+	import _smoke; raise SystemExit(_smoke())"
 
 # end-to-end serving smoke: train tiny -> save -> boot HTTP server on a
 # random port -> POST /score -> scrape /metrics (+ /healthz, /reload
@@ -23,6 +34,6 @@ serve-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.smoke
 
 test:
-	bash -c "$(TIER1)"
+	@$(TIER1)
 
-check: lint serve-smoke test
+check: lint serve-smoke ingest-smoke test

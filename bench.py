@@ -513,14 +513,49 @@ def run_big(platform: str, payload: dict) -> None:
     t0 = time.time()
     edges = store.quantile_edges(32)
     rf_s = xgb_s = None
+    # pipelined ingest (data/pipeline.py): worker threads read+cast
+    # chunks while up to `depth` donated writes are in flight — the r5
+    # serial loop burned 634.9s (63% of budget) on this upload
+    up_workers = int(os.environ.get("BENCH_UPLOAD_WORKERS",
+                                    bd.UPLOAD_WORKERS))
+    up_depth = int(os.environ.get("BENCH_UPLOAD_DEPTH", bd.UPLOAD_DEPTH))
+    from transmogrifai_tpu.utils.profiling import RunProfile
+    ingest_prof = RunProfile(run_type="bench-big-ingest")
+    # one-pass dual-representation build: bf16 + int8 from a SINGLE
+    # store sweep (one memmap read, one f16 wire pass) — but both
+    # buffers resident is 3 bytes/elem, plus the tree phase's ~2.5 GB
+    # of one-hot working set, so gate on the HBM plan actually fitting
+    # (10M×500 on a 16 GB v5e does NOT fit: 15 GB + 2.5 GB working set;
+    # BENCH_BIG_DUAL=1/0 forces, BENCH_HBM_GB overrides the budget)
+    hbm_gb = float(os.environ.get("BENCH_HBM_GB", 16.0))
+    dual_env = os.environ.get("BENCH_BIG_DUAL", "auto")
+    dual_fits = n_pad * d * 3 + 3.0e9 < hbm_gb * 1e9
+    use_dual = dual_env == "1" or (dual_env == "auto" and dual_fits)
+    payload["big_ingest_dual"] = use_dual
+    X16 = None
     try:
         # leave ≥180s of budget for the lockstep measurements themselves
-        Xb = bd.device_binned(
-            store, edges, deadline_s=max(_remaining() - 180.0, 60.0))
+        deadline = max(_remaining() - 180.0, 60.0)
+        if use_dual:
+            X16, Xb, up_stats = bd.dual_device_matrices(
+                store, edges, deadline_s=deadline, workers=up_workers,
+                depth=up_depth, profile=ingest_prof, return_stats=True)
+        else:
+            Xb, up_stats = bd.device_binned(
+                store, edges, deadline_s=deadline, workers=up_workers,
+                depth=up_depth, profile=ingest_prof, return_stats=True)
     except TimeoutError as e:
         payload["big_trees_skipped"] = f"bin upload too slow: {e}"
         _emit(payload)
+        X16 = None
         Xb = None  # fall through: the LR phase may still fit the budget
+    if Xb is not None:
+        payload["big_upload_gbps"] = round(up_stats.gbps, 4)
+        payload["big_upload_overlap_frac"] = round(up_stats.overlap_frac, 3)
+        payload["big_upload_workers"] = up_workers
+        payload["big_upload_depth"] = up_depth
+        payload["big_ingest_phases"] = [p.to_json()
+                                        for p in ingest_prof.phases]
     if Xb is not None and _remaining() < 120:
         # the upload consumed the phase budget: skip the lockstep fits
         # (warmup + timed batches need ~2 min) instead of overrunning
@@ -671,16 +706,26 @@ def run_big(platform: str, payload: dict) -> None:
         _emit(payload)
         return
     t0 = time.time()
-    try:
-        X16 = bd.device_matrix(
-            store, deadline_s=max(_remaining() - 150.0, 60.0))
-    except TimeoutError as e:
-        payload["big_lr_skipped"] = f"bf16 upload too slow: {e}"
-        _emit(payload)
-        return
-    jax.block_until_ready(X16)
-    t_upload = time.time() - t0
-    payload["big_upload_bf16_s"] = round(t_upload, 1)
+    if X16 is None:
+        try:
+            X16, bf_stats = bd.device_matrix(
+                store, deadline_s=max(_remaining() - 150.0, 60.0),
+                workers=up_workers, depth=up_depth, profile=ingest_prof,
+                return_stats=True)
+        except TimeoutError as e:
+            payload["big_lr_skipped"] = f"bf16 upload too slow: {e}"
+            _emit(payload)
+            return
+        jax.block_until_ready(X16)
+        payload["big_upload_bf16_s"] = round(time.time() - t0, 1)
+        payload["big_upload_bf16_gbps"] = round(bf_stats.gbps, 4)
+        payload["big_ingest_phases"] = [p.to_json()
+                                        for p in ingest_prof.phases]
+    # dual path: the bf16 matrix came out of the one-pass build, so
+    # there is no separate bf16 upload to time — big_ingest_dual marks
+    # it and big_bin_upload_s carries the (combined) pass; emitting a
+    # 0.0 here would read as a bogus upload-time-vanished improvement
+    # against rounds that timed a real second pass
     l1v, l2v = [], []
     for a in (0.1, 0.5):
         for r in (0.001, 0.01, 0.1, 0.2):

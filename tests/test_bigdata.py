@@ -48,6 +48,71 @@ def test_store_reuse_regenerates_on_seed_mismatch(tmp_path):
     assert not np.array_equal(np.asarray(b.chunk(0, 50)), first)
 
 
+def test_iter_chunks_ragged_tail(small_store):
+    """Non-dividing chunk size: offsets advance by the chunk size, the
+    final chunk carries exactly the remainder, and bytes match the
+    contiguous read."""
+    offsets, sizes = [], []
+    for r0, c in small_store.iter_chunks(700):
+        offsets.append(r0)
+        sizes.append(len(c))
+    assert offsets == list(range(0, 5000, 700))
+    assert sizes == [700] * 7 + [100]
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(c)
+                        for _, c in small_store.iter_chunks(700)]),
+        np.asarray(small_store.chunk(0, 5000)))
+
+
+def test_iter_chunks_larger_than_store(small_store):
+    chunks = list(small_store.iter_chunks(1_000_000))
+    assert len(chunks) == 1
+    r0, c = chunks[0]
+    assert r0 == 0 and c.shape == (5000, 12)
+
+
+def test_writer_dtype_roundtrip(tmp_path):
+    """Bytes written through ColumnarStoreWriter reopen exactly for a
+    matching dtype; an f16 store quantizes (round-trip through the
+    declared storage dtype, not silently through f32)."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(300, 5)).astype(np.float32)
+    y = rng.uniform(size=300).astype(np.float32)
+    w = ColumnarStore.create(str(tmp_path / "f32"), 300, 5, dtype="float32")
+    w.write_chunk(0, X[:200], y[:200])
+    w.write_chunk(200, X[200:], y[200:])
+    st = w.close()
+    assert st.dtype == np.float32
+    np.testing.assert_array_equal(np.asarray(st.chunk(0, 300)), X)
+    np.testing.assert_array_equal(np.asarray(st.y), y)
+
+    w16 = ColumnarStore.create(str(tmp_path / "f16"), 300, 5)
+    w16.write_chunk(0, X, y)
+    st16 = w16.close()
+    assert st16.dtype == np.float16
+    np.testing.assert_array_equal(np.asarray(st16.chunk(0, 300)),
+                                  X.astype(np.float16))
+    # reopening from disk reads the same quantized bytes
+    np.testing.assert_array_equal(
+        np.asarray(ColumnarStore(st16.path).chunk(0, 300)),
+        X.astype(np.float16))
+
+
+def test_zero_row_store(tmp_path):
+    """A zero-row store must round-trip (mmap can't map empty files):
+    chunk reads and iteration return empty, and the device builders
+    produce empty buffers instead of crashing."""
+    w = ColumnarStore.create(str(tmp_path / "empty"), 0, 7)
+    st = w.close()
+    assert st.n_rows == 0 and st.n_features == 7
+    assert list(st.iter_chunks(128)) == []
+    assert st.chunk(0, 10).shape == (0, 7)
+    st2 = ColumnarStore(st.path)  # reopen from manifest
+    assert st2.n_rows == 0
+    buf = bd.device_matrix(st2, chunk_rows=128)
+    assert buf.shape == (0, 7)
+
+
 def test_device_matrix_upload(small_store):
     buf = bd.device_matrix(small_store, chunk_rows=1024)
     assert buf.shape == (5120, 12) and buf.dtype == jnp.bfloat16

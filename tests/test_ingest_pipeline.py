@@ -1,0 +1,206 @@
+"""Pipelined host→device ingest (`data/pipeline.py` + the rewired
+upload builders in `parallel/bigdata.py`): serial-parity (bitwise),
+bounded in-flight depth, worker-exception propagation, deadline
+semantics, one-pass dual-representation builds, sharded placement, and
+RunProfile ingest timers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.data.columnar_store import synth_binary_store
+from transmogrifai_tpu.data.pipeline import IngestStats, run_chunk_pipeline
+from transmogrifai_tpu.models.trees import bin_features
+from transmogrifai_tpu.parallel import bigdata as bd
+from transmogrifai_tpu.utils.profiling import RunProfile
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("ingest") / "s1")
+    return synth_binary_store(path, 5000, 12, seed=9, chunk_rows=1024)
+
+
+def _serial_bf16(store):
+    """The pre-pipeline reference build: full host read → bf16 cast →
+    one transfer."""
+    ref = np.asarray(store.chunk(0, store.n_rows))
+    return np.asarray(jnp.asarray(ref, jnp.bfloat16))
+
+
+def _serial_binned(store, edges):
+    ref = np.asarray(store.chunk(0, store.n_rows))
+    return np.asarray(bin_features(jnp.asarray(ref, jnp.float32),
+                                   jnp.asarray(edges)).astype(jnp.int8))
+
+
+# -- serial parity (bitwise) ------------------------------------------------ #
+
+def test_device_matrix_bitwise_matches_serial(store):
+    n = store.n_rows
+    buf = bd.device_matrix(store, chunk_rows=1024, workers=3, depth=3)
+    got = np.asarray(buf[:n])
+    want = _serial_bf16(store)
+    assert got.dtype == want.dtype
+    assert got.tobytes() == want.tobytes()  # bitwise, not allclose
+    assert float(jnp.abs(buf[n:].astype(jnp.float32)).sum()) == 0.0
+
+
+def test_device_binned_bitwise_matches_serial(store):
+    edges = store.quantile_edges(16, sample=5000)
+    buf = bd.device_binned(store, edges, chunk_rows=1024, workers=3,
+                           depth=2)
+    np.testing.assert_array_equal(np.asarray(buf[:store.n_rows]),
+                                  _serial_binned(store, edges))
+
+
+def test_dual_build_matches_single_builders(store):
+    """ONE store sweep must produce exactly the buffers the two separate
+    pipelined builders produce (and therefore the serial references)."""
+    n = store.n_rows
+    edges = store.quantile_edges(16, sample=5000)
+    X16, Xb, stats = bd.dual_device_matrices(
+        store, edges, chunk_rows=1024, workers=2, depth=2,
+        return_stats=True)
+    assert np.asarray(X16[:n]).tobytes() == _serial_bf16(store).tobytes()
+    np.testing.assert_array_equal(np.asarray(Xb[:n]),
+                                  _serial_binned(store, edges))
+    # ONE pass: wire bytes ≈ one padded f16 matrix, not two matrices
+    n_pad = -(-n // 1024) * 1024
+    assert stats.bytes_wire == n_pad * store.n_features * 2
+    assert stats.chunks == -(-n // 1024)
+
+
+# -- pipeline mechanics ----------------------------------------------------- #
+
+def test_in_flight_depth_bounded():
+    """The pipeline must never hold more than `depth` un-drained
+    completion tokens — the depth bound is what back-pressures dispatch
+    so deadlines track real transfer progress."""
+    stats = IngestStats()
+    live = {"n": 0, "max": 0}
+
+    class Token:
+        def __init__(self):
+            live["n"] += 1
+            live["max"] = max(live["max"], live["n"])
+
+        def block_until_ready(self):
+            live["n"] -= 1
+
+    def prepare(i):
+        stats.note_read(0.0, 8)
+        stats.note_cast(0.0, 8)
+        return i
+
+    run_chunk_pipeline(range(32), prepare, lambda i: Token(),
+                       workers=2, depth=3, stats=stats)
+    assert stats.max_in_flight == 3         # reached, never exceeded
+    assert live["max"] <= 3 + 1             # transient: new token pre-trim
+    assert live["n"] == 0                   # fully drained on return
+    assert stats.chunks == 32
+
+
+def test_worker_exception_propagates():
+    def prepare(i):
+        if i == 5:
+            raise ValueError("bad chunk")
+        return i
+
+    with pytest.raises(ValueError, match="bad chunk"):
+        run_chunk_pipeline(range(16), prepare, lambda i: None,
+                           workers=2, depth=2)
+
+
+def test_upload_exception_propagates():
+    def upload(i):
+        if i == 3:
+            raise RuntimeError("device boom")
+        return None
+
+    with pytest.raises(RuntimeError, match="device boom"):
+        run_chunk_pipeline(range(8), lambda i: i, upload,
+                           workers=2, depth=2)
+
+
+def test_deadline_fires_on_elapsed(store):
+    with pytest.raises(TimeoutError, match="deadline"):
+        bd.device_matrix(store, chunk_rows=512, deadline_s=0.0)
+
+
+def test_empty_item_stream():
+    stats = run_chunk_pipeline([], lambda i: i, lambda i: None,
+                               workers=2, depth=2)
+    assert stats.chunks == 0 and stats.wall_s >= 0.0
+
+
+def test_prepare_materializes_off_the_memmap(store):
+    """Worker prepare must COPY the chunk out of the memmap: a lazy view
+    would defer the page faults (the actual disk read) to the main
+    thread's transfer, silently re-serializing the pipeline."""
+    stats = IngestStats()
+    prep = bd._chunk_prepare(store, 1024, store.dtype, stats)
+    _, c = prep(0)
+    assert not np.shares_memory(c, store._X)
+
+
+# -- stats / profile -------------------------------------------------------- #
+
+def test_stats_and_profile_recorded(store):
+    prof = RunProfile(run_type="test")
+    buf, stats = bd.device_matrix(store, chunk_rows=1024, profile=prof,
+                                  return_stats=True)
+    assert stats.chunks == -(-store.n_rows // 1024)
+    assert stats.bytes_read > 0 and stats.bytes_wire > 0
+    assert stats.wall_s > 0 and stats.gbps > 0
+    assert 0.0 <= stats.overlap_frac <= 1.0
+    assert stats.max_in_flight >= 1
+    [phase] = [p for p in prof.phases if p.name == "device_matrix_upload"]
+    for key in ("read_s", "cast_s", "upload_wait_s", "overlap_frac",
+                "gbps", "chunks", "depth", "max_in_flight"):
+        assert key in phase.extra, key
+    assert phase.duration_s == pytest.approx(stats.wall_s)
+    # the profile JSON round-trips the ingest extras
+    dumped = prof.to_json()["phases"][0]
+    assert dumped["overlap_frac"] == phase.extra["overlap_frac"]
+
+
+def test_wire_dtype_narrows_on_host(tmp_path):
+    """A WIDER-than-target store (f32 → bf16) must cast on the host so
+    the wire carries 2 bytes/elem — and still matches the serial
+    reference bitwise (single rounding step, same as jnp.asarray)."""
+    from transmogrifai_tpu.data.columnar_store import ColumnarStore
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(700, 6)).astype(np.float32)
+    w = ColumnarStore.create(str(tmp_path / "f32"), 700, 6, dtype="float32",
+                             with_labels=False)
+    w.write_chunk(0, X)
+    st = w.close()
+    buf, stats = bd.device_matrix(st, chunk_rows=256, return_stats=True)
+    want = np.asarray(jnp.asarray(X, jnp.bfloat16))
+    assert np.asarray(buf[:700]).tobytes() == want.tobytes()
+    n_pad = -(-700 // 256) * 256
+    assert stats.bytes_wire == n_pad * 6 * 2  # bf16 wire, not f32
+
+
+# -- sharded placement ------------------------------------------------------ #
+
+def test_sharded_upload_matches_unsharded(store):
+    """Per-chunk `device_put(chunk, sharding)`: the sharded build must
+    equal the single-device build and land with the requested sharding
+    (multichip uploads spread across the mesh)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    sharding = NamedSharding(mesh, P("data", None))
+    ref = bd.device_matrix(store, chunk_rows=1024)
+    buf = bd.device_binned(store, store.quantile_edges(16, sample=5000),
+                           chunk_rows=1024)
+    got = bd.device_matrix(store, chunk_rows=1024, sharding=sharding)
+    assert got.sharding.is_equivalent_to(sharding, got.ndim)
+    assert np.asarray(got).tobytes() == np.asarray(ref).tobytes()
+    gotb = bd.device_binned(store, store.quantile_edges(16, sample=5000),
+                            chunk_rows=1024, sharding=sharding)
+    np.testing.assert_array_equal(np.asarray(gotb), np.asarray(buf))

@@ -598,6 +598,60 @@ def step(x, *, opts=[]):
     assert "L003" in _lint_codes(src)
 
 
+def test_lint_serial_ingest_in_chunk_loop():
+    """L007: per-iteration host→device transfers inside chunk-stream
+    loops — the exact pre-pipeline upload shape, plus an un-depth-
+    bounded device_put over a reader stream."""
+    src = '''
+def upload(store, buf, dtype):
+    for r0, c in store.iter_chunks(1024):
+        buf = write(buf, jnp.asarray(c, dtype), r0)
+    return buf
+
+def feed(reader):
+    for b in reader.stream():
+        dispatch(jax.device_put(b))
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L007"]
+    assert len(findings) == 2
+
+
+def test_lint_serial_ingest_nested_loops_report_once():
+    """A transfer inside a chunk loop nested in another chunk loop must
+    produce ONE finding (the inner loop's), not one per enclosing
+    loop."""
+    src = '''
+def upload(stores, buf):
+    for st in batches:
+        for r0, c in st.iter_chunks(1024):
+            buf = write(buf, jnp.asarray(c), r0)
+    return buf
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L007"]
+    assert len(findings) == 1
+
+
+def test_lint_serial_ingest_not_flagged_elsewhere():
+    """No L007 for host-side fetches in chunk loops, transfers in
+    non-stream loops, or pipeline-routed uploads (no per-iteration
+    transfer call at all)."""
+    src = '''
+def host_fetch(chunks):
+    out = []
+    for c in chunks:
+        out.append(np.asarray(c).sum())   # device->host: fine
+    return out
+
+def grid_setup(grids):
+    for g in grids:
+        yield jnp.asarray(g)              # not a chunk stream
+
+def pipelined(store, prepare, upload):
+    run_chunk_pipeline(store.iter_chunks(1024), prepare, upload)
+'''
+    assert "L007" not in _lint_codes(src)
+
+
 def test_score_stream_and_score_function_validate(monkeypatch):
     # every compiled entry point shares the validated scorer gate
     from transmogrifai_tpu.automl import transmogrify

@@ -36,6 +36,16 @@ silent slowness or nondeterminism once XLA is in the loop:
   specific leading batch dim into a shape is wrong the moment a
   different bucket arrives — derive it from ``x.shape[0]`` (or use
   ``-1``) instead.
+- ``L007 serial-ingest``: a per-iteration ``jnp.asarray``/``jnp.array``/
+  ``jax.device_put`` inside a Python ``for`` loop that iterates a chunk
+  stream (an ``iter_chunks(...)``/``stream(...)`` call, or a plain
+  ``chunks``/``batches`` iterable). One synchronous host→device
+  transfer per loop body serializes host prep against the wire — the
+  r5 bench burned 63% of its big-mode budget in exactly this pattern —
+  and an un-depth-bounded ``device_put`` loop also lets dispatch run
+  arbitrarily far ahead of real transfer, breaking deadline math.
+  Route bulk uploads through ``data/pipeline.run_chunk_pipeline``
+  (worker prepare + bounded-depth overlapped writes) instead.
 
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
@@ -81,6 +91,13 @@ _NONDET_NP_RANDOM = {
 }
 
 _DEVICE_KINDS = ("scalar", "vector", "prediction")
+
+# L007: chunk-stream iterators (call names / bare iterable names) and the
+# per-iteration host→device transfer calls that serialize against them
+_INGEST_ITER_CALLS = {"iter_chunks", "stream"}
+_INGEST_ITER_NAMES = {"chunks", "batches"}
+_SERIAL_UPLOAD_CALLS = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
+                        "jax.numpy.array", "jax.device_put", "device_put"}
 
 
 @dataclass
@@ -276,6 +293,49 @@ class _FileLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_serial_ingest(node)
+        self.generic_visit(node)
+
+    # -- L007 -------------------------------------------------------------- #
+
+    @staticmethod
+    def _is_ingest_iter(it: ast.AST) -> bool:
+        if isinstance(it, ast.Call):
+            dotted = _dotted(it.func)
+            return dotted is not None and \
+                dotted.rsplit(".", 1)[-1] in _INGEST_ITER_CALLS
+        return isinstance(it, ast.Name) and it.id in _INGEST_ITER_NAMES
+
+    def _check_serial_ingest(self, node: ast.For) -> None:
+        """Per-iteration host→device transfers inside a chunk-stream
+        loop: the serial-ingest anti-pattern `data/pipeline.py` exists
+        to replace."""
+        if not self._is_ingest_iter(node.iter):
+            return
+        # skip NESTED chunk-stream loops: visit_For reaches them too,
+        # and walking into their bodies here would report each transfer
+        # twice
+        stack: List[ast.AST] = list(node.body)
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, ast.For) and self._is_ingest_iter(sub.iter):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func)
+            if dotted in _SERIAL_UPLOAD_CALLS:
+                self._emit(
+                    sub, "L007",
+                    f"per-iteration `{dotted}` inside a chunk-stream "
+                    "`for` loop — one synchronous (or un-depth-"
+                    "bounded) host→device transfer per chunk "
+                    "serializes host prep against the wire; route "
+                    "the upload through data/pipeline."
+                    "run_chunk_pipeline (bounded-depth overlapped "
+                    "writes) instead")
 
     # -- L001 + L002 over device bodies ----------------------------------- #
 

@@ -1,5 +1,7 @@
 from transmogrifai_tpu.data.columns import Column, kind_of
 from transmogrifai_tpu.data.metadata import VectorColumnMetadata, VectorMetadata
 from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.data.pipeline import IngestStats, run_chunk_pipeline
 
-__all__ = ["Column", "kind_of", "VectorColumnMetadata", "VectorMetadata", "Dataset"]
+__all__ = ["Column", "kind_of", "VectorColumnMetadata", "VectorMetadata",
+           "Dataset", "IngestStats", "run_chunk_pipeline"]

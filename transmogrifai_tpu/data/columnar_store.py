@@ -31,6 +31,18 @@ Y_FILE = "y.bin"
 DEFAULT_CHUNK_ROWS = 262_144
 
 
+def _open_matrix(path: str, dtype: np.dtype, mode: str,
+                 shape: Tuple[int, ...]) -> np.ndarray:
+    """memmap the file, except for EMPTY shapes: mmap cannot map zero
+    bytes, so a zero-row store round-trips through a plain ndarray (the
+    manifest still records the logical shape)."""
+    if int(np.prod(shape)) == 0:
+        if mode == "w+":  # completion sentinel consistency: file exists
+            open(path, "wb").close()
+        return np.zeros(shape, dtype)
+    return np.memmap(path, dtype=dtype, mode=mode, shape=shape)
+
+
 class ColumnarStore:
     """A (n_rows, n_features) numeric matrix + optional label vector,
     memory-mapped from disk and read in row chunks."""
@@ -45,13 +57,13 @@ class ColumnarStore:
         self.dtype = np.dtype(m["dtype"])
         self.feature_names: List[str] = m.get("feature_names") or [
             f"f{i}" for i in range(self.n_features)]
-        self._X = np.memmap(os.path.join(path, X_FILE), dtype=self.dtype,
-                            mode="r", shape=(self.n_rows, self.n_features))
+        self._X = _open_matrix(os.path.join(path, X_FILE), self.dtype,
+                               "r", (self.n_rows, self.n_features))
         ypath = os.path.join(path, Y_FILE)
-        self._y: Optional[np.memmap] = None
+        self._y: Optional[np.ndarray] = None
         if os.path.exists(ypath):
-            self._y = np.memmap(ypath, dtype=np.dtype(m.get(
-                "label_dtype", "float32")), mode="r", shape=(self.n_rows,))
+            self._y = _open_matrix(ypath, np.dtype(m.get(
+                "label_dtype", "float32")), "r", (self.n_rows,))
 
     # -- reading -------------------------------------------------------- #
 
@@ -123,10 +135,10 @@ class ColumnarStoreWriter:
         self.n_rows = n_rows
         self.n_features = n_features
         self._manifest = manifest
-        self._X = np.memmap(os.path.join(path, X_FILE), dtype=dtype,
-                            mode="w+", shape=(n_rows, n_features))
-        self._y = (np.memmap(os.path.join(path, Y_FILE), dtype=label_dtype,
-                             mode="w+", shape=(n_rows,))
+        self._X = _open_matrix(os.path.join(path, X_FILE), dtype,
+                               "w+", (n_rows, n_features))
+        self._y = (_open_matrix(os.path.join(path, Y_FILE), label_dtype,
+                                "w+", (n_rows,))
                    if label_dtype is not None else None)
 
     def write_chunk(self, r0: int, X_chunk: np.ndarray,
@@ -139,8 +151,9 @@ class ColumnarStoreWriter:
             self._y[r0:r1] = y_chunk
 
     def close(self) -> "ColumnarStore":
-        self._X.flush()
-        if self._y is not None:
+        if isinstance(self._X, np.memmap):
+            self._X.flush()
+        if isinstance(self._y, np.memmap):
             self._y.flush()
         # the manifest is the completion sentinel: written LAST so an
         # interrupted generation never passes the reuse= check

@@ -40,12 +40,15 @@ import numpy as np
 import optax
 
 from transmogrifai_tpu.data.columnar_store import ColumnarStore
+from transmogrifai_tpu.data.pipeline import IngestStats, run_chunk_pipeline
 from transmogrifai_tpu.models.trees import split_from_histograms
 
 log = logging.getLogger(__name__)
 
 UPLOAD_CHUNK_ROWS = 262_144   # ~256 MB f16 per upload dispatch at d=500
 HIST_CHUNK_ROWS = 65_536      # bounds per-chunk one-hot to ~2 GB at d=500
+UPLOAD_WORKERS = 2            # memmap read + cast threads (GIL-releasing)
+UPLOAD_DEPTH = 4              # donated writes in flight (amortizes RPC RTT)
 
 
 def _pad_rows(n: int, chunk: int) -> int:
@@ -53,39 +56,12 @@ def _pad_rows(n: int, chunk: int) -> int:
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _write_rows(buf, chunk, r0):
-    return jax.lax.dynamic_update_slice(buf, chunk, (r0, 0))
-
-
-def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
-                  chunk_rows: int = UPLOAD_CHUNK_ROWS,
-                  deadline_s: Optional[float] = None) -> jnp.ndarray:
-    """Stream the store into one (n_pad, d) device buffer. Rows pad to a
-    chunk multiple with zeros (weight-masked everywhere downstream).
-    Donation makes each write in-place: peak HBM = buffer + one chunk.
-
-    `deadline_s`: optional wall-clock budget — tunnel upload bandwidth
-    varies 100× between sessions (r4: 18-44 MB/s; r5 observed ~5 MB/s),
-    and an un-bounded upload can silently eat a benchmark's entire
-    budget. Past the deadline the loop raises TimeoutError for the
-    caller to turn into an explicit skip marker."""
-    n_pad = _pad_rows(store.n_rows, chunk_rows)
-    buf = jnp.zeros((n_pad, store.n_features), dtype)
-    t0 = time.perf_counter()
-    for r0, c in store.iter_chunks(chunk_rows):
-        if deadline_s is not None and time.perf_counter() - t0 > deadline_s:
-            raise TimeoutError(
-                f"device_matrix upload past {deadline_s:.0f}s deadline at "
-                f"row {r0}/{store.n_rows}")
-        if len(c) < chunk_rows:  # pad the tail chunk to the static shape
-            c = np.concatenate(
-                [c, np.zeros((chunk_rows - len(c), store.n_features),
-                             c.dtype)])
-        buf = _write_rows(buf, jnp.asarray(c, dtype), r0)
-        if r0 and (r0 // chunk_rows) % 8 == 0:
-            log.info("device_matrix: %d/%d rows (%.1fs)", r0, store.n_rows,
-                     time.perf_counter() - t0)
-    return buf
+def _write_cast_rows(buf, chunk, r0):
+    """Donated row write; widens/narrows the wire chunk to the buffer
+    dtype ON DEVICE (fused into the update), so the host ships the
+    narrowest representation."""
+    return jax.lax.dynamic_update_slice(
+        buf, chunk.astype(buf.dtype), (r0, 0))
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -96,34 +72,214 @@ def _bin_write_rows(buf, chunk_f16, edges, r0):
     return jax.lax.dynamic_update_slice(buf, binned, (r0, 0))
 
 
+@partial(jax.jit, donate_argnums=(0, 1))
+def _dual_write_rows(buf16, bufb, chunk_f16, edges, r0):
+    """ONE wire chunk → BOTH device representations: widen to the
+    linear-family dtype and quantile-bin to int8, each fused into its
+    donated row write. The store is read once and the bytes cross the
+    host→device link once."""
+    from transmogrifai_tpu.models.trees import bin_features
+    binned = bin_features(chunk_f16.astype(jnp.float32), edges) \
+        .astype(jnp.int8)
+    return (jax.lax.dynamic_update_slice(
+                buf16, chunk_f16.astype(buf16.dtype), (r0, 0)),
+            jax.lax.dynamic_update_slice(bufb, binned, (r0, 0)))
+
+
+@jax.jit
+def _probe(buf):
+    """Tiny array depending on `buf`: its readiness is the completion
+    token for the write that produced `buf` — blocking on it instead of
+    the (donated, multi-GB) buffer itself lets later writes stay in
+    flight."""
+    return buf[(0,) * buf.ndim]
+
+
+def _zeros(shape, dtype, sharding):
+    if sharding is None:
+        return jnp.zeros(shape, dtype)
+    # allocate ON the mesh (out_shardings) — a host-side zeros +
+    # device_put would ship shape-many zero bytes through the link
+    return jax.jit(lambda: jnp.zeros(shape, dtype),
+                   out_shardings=sharding)()
+
+
+def _put(chunk_np, sharding):
+    return (jnp.asarray(chunk_np) if sharding is None
+            else jax.device_put(chunk_np, sharding))
+
+
+def _pipelined_upload(store: ColumnarStore, chunk_rows: int,
+                      wire: np.dtype, label: str, bufs: dict, write, *,
+                      workers: int, depth: int,
+                      deadline_s: Optional[float], sharding,
+                      profile) -> IngestStats:
+    """Shared scaffold for the upload builders: timed prepare, bounded
+    pipeline, progress/summary logging, profile record. `write(bufs,
+    chunk_dev, r0)` dispatches the donated write(s), rebinding `bufs`
+    entries, and returns the completion token."""
+    stats = IngestStats(label=label, workers=workers, depth=depth)
+
+    def upload(prep):
+        r0, c = prep
+        token = write(bufs, _put(c, sharding), r0)
+        if r0 and (r0 // chunk_rows) % 8 == 0:
+            log.info("%s: %d/%d rows", label, r0, store.n_rows)
+        return token
+
+    run_chunk_pipeline(range(0, store.n_rows, chunk_rows),
+                       _chunk_prepare(store, chunk_rows, wire, stats),
+                       upload, workers=workers, depth=depth,
+                       deadline_s=deadline_s, label=f"{label} upload",
+                       stats=stats)
+    log.info("%s: %d rows in %.1fs (%.2f GB/s, overlap %.2f)", label,
+             store.n_rows, stats.wall_s, stats.gbps, stats.overlap_frac)
+    if profile is not None:
+        profile.record_ingest(f"{label}_upload", stats)
+    return stats
+
+
+def _chunk_prepare(store: ColumnarStore, chunk_rows: int, wire: np.dtype,
+                   stats: IngestStats):
+    """prepare(r0) for the upload pipelines: memmap read → wire-dtype
+    cast → tail pad, timed into `stats` (runs on worker threads; numpy
+    releases the GIL for the copy and the cast)."""
+    d = store.n_features
+
+    def prepare(r0: int):
+        t0 = time.perf_counter()
+        # copy=True: a memmap slice is a lazy VIEW — without the copy the
+        # page faults (the actual disk read) would happen on the MAIN
+        # thread inside the device transfer, silently re-serializing the
+        # pipeline and zeroing read_s
+        c = np.array(store.chunk(r0, r0 + chunk_rows), copy=True)
+        stats.note_read(time.perf_counter() - t0, c.nbytes)
+        t0 = time.perf_counter()
+        if c.dtype != wire:
+            c = c.astype(wire)
+        if len(c) < chunk_rows:  # pad the tail chunk to the static shape
+            c = np.concatenate(
+                [c, np.zeros((chunk_rows - len(c), d), wire)])
+        stats.note_cast(time.perf_counter() - t0, c.nbytes)
+        return r0, c
+
+    return prepare
+
+
+def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
+                  chunk_rows: int = UPLOAD_CHUNK_ROWS,
+                  deadline_s: Optional[float] = None, *,
+                  workers: int = UPLOAD_WORKERS, depth: int = UPLOAD_DEPTH,
+                  sharding=None, profile=None, return_stats: bool = False):
+    """Stream the store into one (n_pad, d) device buffer through the
+    bounded-depth chunk pipeline (`data/pipeline.py`): worker threads
+    read+cast upcoming chunks while up to `depth` donated writes are in
+    flight. Rows pad to a chunk multiple with zeros (weight-masked
+    everywhere downstream); donation keeps peak HBM = buffer + in-flight
+    chunks. The returned buffer is READY (the pipeline drains all
+    writes), so recorded timings are transfer time, not enqueue time.
+
+    The wire dtype is the narrower of (store dtype, `dtype`); widening
+    happens on device inside the donated write — an f16 store headed for
+    a bf16 buffer ships 2 bytes/elem and casts on the VPU, bit-identical
+    to a host-side cast (both round-to-nearest-even).
+
+    `sharding`: optional NamedSharding for the buffer — each chunk is
+    `jax.device_put` with the same spec, so multichip uploads spread
+    across the mesh (a feature-axis spec like P(None, "data") splits
+    every chunk's bytes across chips).
+
+    `deadline_s`: optional wall-clock budget — tunnel upload bandwidth
+    varies 100× between sessions (r4: 18-44 MB/s; r5 observed ~5 MB/s).
+    Depth backpressure makes the per-chunk check track real transfer
+    progress, so TimeoutError fires mid-upload for the caller to turn
+    into an explicit skip marker."""
+    n_pad = _pad_rows(store.n_rows, chunk_rows)
+    target = np.dtype(dtype)
+    wire = target if target.itemsize < store.dtype.itemsize else store.dtype
+    bufs = {"x": _zeros((n_pad, store.n_features), dtype, sharding)}
+
+    def write(bufs, cdev, r0):
+        bufs["x"] = _write_cast_rows(bufs["x"], cdev, r0)
+        return _probe(bufs["x"])
+
+    stats = _pipelined_upload(store, chunk_rows, wire, "device_matrix",
+                              bufs, write, workers=workers, depth=depth,
+                              deadline_s=deadline_s, sharding=sharding,
+                              profile=profile)
+    return (bufs["x"], stats) if return_stats else bufs["x"]
+
+
 def device_binned(store: ColumnarStore, edges: np.ndarray,
                   chunk_rows: int = UPLOAD_CHUNK_ROWS,
-                  deadline_s: Optional[float] = None) -> jnp.ndarray:
-    """(n_pad, d) int8 quantile-binned device buffer. Chunks upload as
-    f16 and bin ON DEVICE (broadcast-compare, VPU): the r3 host
-    `searchsorted` loop cost ~420 s at 10M×500 while re-shipping f16 and
-    binning device-side costs one more ~50 s upload pass — transfer is
-    cheaper than host-side bin search at this scale. `deadline_s` as in
+                  deadline_s: Optional[float] = None, *,
+                  workers: int = UPLOAD_WORKERS, depth: int = UPLOAD_DEPTH,
+                  sharding=None, profile=None, return_stats: bool = False):
+    """(n_pad, d) int8 quantile-binned device buffer through the same
+    chunk pipeline as `device_matrix`. Chunks ship as f16 and bin ON
+    DEVICE (broadcast-compare, VPU): the r3 host `searchsorted` loop
+    cost ~420 s at 10M×500 while f16 wire + device-side binning costs
+    one pipelined upload pass. `deadline_s`/`sharding`/`profile` as in
     `device_matrix`."""
+    n_pad = _pad_rows(store.n_rows, chunk_rows)
+    edges_dev = jnp.asarray(edges)
+    bufs = {"b": _zeros((n_pad, store.n_features), jnp.int8, sharding)}
+
+    def write(bufs, cdev, r0):
+        bufs["b"] = _bin_write_rows(bufs["b"], cdev, edges_dev, r0)
+        return _probe(bufs["b"])
+
+    stats = _pipelined_upload(store, chunk_rows, np.dtype(np.float16),
+                              "device_binned", bufs, write,
+                              workers=workers, depth=depth,
+                              deadline_s=deadline_s, sharding=sharding,
+                              profile=profile)
+    return (bufs["b"], stats) if return_stats else bufs["b"]
+
+
+def dual_device_matrices(store: ColumnarStore, edges: np.ndarray,
+                         dtype=jnp.bfloat16,
+                         chunk_rows: int = UPLOAD_CHUNK_ROWS,
+                         deadline_s: Optional[float] = None, *,
+                         workers: int = UPLOAD_WORKERS,
+                         depth: int = UPLOAD_DEPTH, sharding=None,
+                         profile=None, return_stats: bool = False):
+    """ONE pass over the store → BOTH device representations: the
+    (n_pad, d) `dtype` (bf16) linear-family matrix AND the (n_pad, d)
+    int8 quantile-binned matrix. Halves host IO versus running
+    `device_matrix` + `device_binned` back to back (the memmap is read
+    once) and halves wire traffic too: chunks ship once as f16 and each
+    donated write fans out device-side into the widen AND the bin.
+
+    For an f16 store the bf16 matrix is bit-identical to
+    `device_matrix`'s and the binned matrix to `device_binned`'s (same
+    f16 wire, same device ops). For wider stores the wire is still f16
+    — matching `device_binned`'s contract — so the bf16 matrix rounds
+    through f16 first; use the separate builders when that matters.
+
+    Both buffers must be HBM-resident simultaneously (3 bytes/elem
+    total) — at 10M×500 that is ~15 GB before tree working set, so the
+    bench gates this path on the memory plan fitting."""
     d = store.n_features
     n_pad = _pad_rows(store.n_rows, chunk_rows)
-    buf = jnp.zeros((n_pad, d), jnp.int8)
     edges_dev = jnp.asarray(edges)
-    t0 = time.perf_counter()
-    for r0, c in store.iter_chunks(chunk_rows):
-        if deadline_s is not None and time.perf_counter() - t0 > deadline_s:
-            raise TimeoutError(
-                f"device_binned upload past {deadline_s:.0f}s deadline at "
-                f"row {r0}/{store.n_rows}")
-        if len(c) < chunk_rows:
-            c = np.concatenate(
-                [c, np.zeros((chunk_rows - len(c), d), c.dtype)])
-        buf = _bin_write_rows(buf, jnp.asarray(c, jnp.float16), edges_dev,
-                              r0)
-        if r0 and (r0 // chunk_rows) % 8 == 0:
-            log.info("device_binned: %d/%d rows (%.1fs)", r0, store.n_rows,
-                     time.perf_counter() - t0)
-    return buf
+    bufs = {"x": _zeros((n_pad, d), dtype, sharding),
+            "b": _zeros((n_pad, d), jnp.int8, sharding)}
+
+    def write(bufs, cdev, r0):
+        bufs["x"], bufs["b"] = _dual_write_rows(bufs["x"], bufs["b"],
+                                                cdev, edges_dev, r0)
+        # one executable produces both buffers: either probe tokens the
+        # completion of the pair
+        return _probe(bufs["b"])
+
+    stats = _pipelined_upload(store, chunk_rows, np.dtype(np.float16),
+                              "dual", bufs, write, workers=workers,
+                              depth=depth, deadline_s=deadline_s,
+                              sharding=sharding, profile=profile)
+    if return_stats:
+        return bufs["x"], bufs["b"], stats
+    return bufs["x"], bufs["b"]
 
 
 # --------------------------------------------------------------------------- #

@@ -55,6 +55,15 @@ class RunProfile:
         self.histograms[name] = hist.summary() if hasattr(hist, "summary") \
             else dict(hist)
 
+    def record_ingest(self, name: str, stats) -> None:
+        """Attach a pipelined-ingest phase (`data.pipeline.IngestStats`
+        or any object with `wall_s` + `to_extra()`): per-stage
+        read/cast/upload-wait timers, overlap fraction, and GB/s become
+        the phase extras, so upload efficiency shows up next to the
+        framework phases in every profile dump."""
+        self.phases.append(PhaseMetric(
+            name, float(getattr(stats, "wall_s", 0.0)), stats.to_extra()))
+
     @contextlib.contextmanager
     def phase(self, name: str, **extra):
         """Time a named phase; nests with the jax profiler when tracing."""
