@@ -15,10 +15,18 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-.PHONY: lint serve-smoke ingest-smoke test check
+.PHONY: lint serve-smoke ingest-smoke faults-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
+
+# fault-tolerance smoke: kill a ModelSelector sweep mid-grid with an
+# injected fault, resume it from the block journal, and assert the best
+# config + every fold metric are bit-identical to an uninterrupted run;
+# also kills a save_model mid-write and asserts the resident artifact
+# survives intact. See transmogrifai_tpu/runtime/smoke.py.
+faults-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.runtime.smoke
 
 # out-of-core ingest smoke: small synthetic ColumnarStore through the
 # pipelined one-pass dual-representation build (data/pipeline.py) —
@@ -36,4 +44,4 @@ serve-smoke:
 test:
 	@$(TIER1)
 
-check: lint serve-smoke ingest-smoke test
+check: lint serve-smoke ingest-smoke faults-smoke test

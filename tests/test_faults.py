@@ -1,0 +1,659 @@
+"""Chaos suite for the runtime/ fault-tolerance layer: deterministic
+fault injection, bounded retry, kill/resume sweep parity from the block
+journal, crash-consistent model artifacts, ingest retry, store
+checksums, serving /reload rejection, and lint L008."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as T
+from transmogrifai_tpu.data.columns import Column
+from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.runtime.faults import (
+    SITE_READ_CHUNK, SITE_RUN_BLOCK, SITE_WRITE_FILE, FaultPlan,
+    FaultSpec, InjectedFault, InjectedKill, fault_point, is_oom_error)
+from transmogrifai_tpu.runtime.journal import SweepJournal
+from transmogrifai_tpu.runtime.retry import RetryEvent, RetryPolicy
+from transmogrifai_tpu.selector import ModelSelector
+from transmogrifai_tpu.selector.validators import OpCrossValidation
+from transmogrifai_tpu.stages.base import FitContext
+
+
+# --------------------------------------------------------------------------- #
+# fault plan / retry policy units                                             #
+# --------------------------------------------------------------------------- #
+
+def test_fault_plan_fires_at_nth_pass():
+    plan = FaultPlan([FaultSpec("site.a", at=3, kind="error",
+                                transient=True)])
+    with plan.active():
+        fault_point("site.a")
+        fault_point("site.a")
+        with pytest.raises(InjectedFault) as ei:
+            fault_point("site.a")
+        assert ei.value.transient and ei.value.n == 3
+        fault_point("site.a")  # times=1: pass 4 is clean
+        fault_point("site.b")  # other sites unaffected
+    fault_point("site.a")  # plan deactivated: free
+    assert plan.fired == [("site.a", 3, "error")]
+
+
+def test_fault_plan_kill_is_base_exception_and_times_forever():
+    plan = FaultPlan([FaultSpec("s", at=2, kind="kill", times=0)])
+    with plan.active():
+        fault_point("s")
+        for _ in range(3):  # fires on EVERY pass >= 2
+            with pytest.raises(InjectedKill):
+                fault_point("s")
+    assert not issubclass(InjectedKill, Exception)
+
+
+def test_oom_fault_recognized():
+    plan = FaultPlan([FaultSpec("s", kind="oom")])
+    with plan.active():
+        with pytest.raises(InjectedFault) as ei:
+            fault_point("s")
+    assert is_oom_error(ei.value)
+    assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                                     "while allocating 2.1G"))
+    assert not is_oom_error(ValueError("shapes do not match"))
+
+
+def test_retry_policy_bounded_and_classified():
+    slept = []
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.01, jitter=0.0,
+                         sleep=slept.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient io")
+        return "ok"
+
+    events = []
+    assert policy.call(flaky, label="t",
+                       on_attempt=events.append) == "ok"
+    assert calls["n"] == 3 and len(slept) == 2 and len(events) == 2
+    assert all(isinstance(e, RetryEvent) for e in events)
+    # exponential backoff (jitter disabled): 0.01, 0.02
+    assert slept == [pytest.approx(0.01), pytest.approx(0.02)]
+
+    # fatal (unclassified) errors propagate on the FIRST attempt
+    calls["n"] = 0
+
+    def fatal():
+        calls["n"] += 1
+        raise ValueError("deterministic bug")
+
+    with pytest.raises(ValueError):
+        policy.call(fatal)
+    assert calls["n"] == 1
+
+    # exhaustion re-raises the last underlying error
+    def always():
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError):
+        policy.call(always)
+
+    # the error's own `transient` attribute classifies injected faults
+    def injected():
+        raise InjectedFault("s", 1, transient=True)
+
+    with pytest.raises(InjectedFault):
+        policy.call(injected)  # retried to exhaustion, then propagates
+
+
+def test_retry_policy_deterministic_jitter():
+    p = RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.5, seed=9,
+                    sleep=lambda s: None)
+    import random
+    a = [p.delay_for(i, random.Random("9:x")) for i in (1, 2, 3)]
+    b = [p.delay_for(i, random.Random("9:x")) for i in (1, 2, 3)]
+    assert a == b  # same seed+label => same schedule
+
+
+# --------------------------------------------------------------------------- #
+# sweep journal                                                               #
+# --------------------------------------------------------------------------- #
+
+def test_sweep_journal_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "fam.journal")
+    j = SweepJournal(path, meta={"sig": "abc"})
+    row = [0.12345678901234567, float(np.float64(1) / 3)]
+    j.append({"reg_param": 0.1}, row, best={"mean": 0.2})
+    j.append({"reg_param": 0.2}, [0.5, 0.6])
+
+    # floats round-trip JSON bit-exactly
+    j2 = SweepJournal(path, meta={"sig": "abc"})
+    assert j2.lookup({"reg_param": 0.1}) == row
+    assert len(j2) == 2
+
+    # torn final line (kill mid-append): intact prefix still loads, and
+    # the damaged tail is TRUNCATED so post-resume appends don't
+    # concatenate onto the garbage (and vanish on the next load)
+    with open(path, "a") as fh:
+        fh.write('{"key": "deadbeef", "fold_m')
+    j3 = SweepJournal(path, meta={"sig": "abc"})
+    assert len(j3) == 2
+    j3.append({"reg_param": 0.3}, [0.7, 0.8])
+    j5 = SweepJournal(path, meta={"sig": "abc"})  # second resume
+    assert len(j5) == 3
+    assert j5.lookup({"reg_param": 0.3}) == [0.7, 0.8]
+
+    # header meta mismatch: rotated aside, fresh journal
+    j4 = SweepJournal(path, meta={"sig": "OTHER"})
+    assert len(j4) == 0
+    assert os.path.exists(path + ".stale")
+
+
+def test_sweep_journal_empty_and_header_torn_files(tmp_path):
+    # kill between file create and header flush: empty file must get a
+    # fresh header on the next append (not headerless records that the
+    # following load rotates aside as foreign)
+    path = str(tmp_path / "empty.journal")
+    open(path, "w").close()
+    j = SweepJournal(path, meta={"sig": "s"})
+    assert len(j) == 0
+    j.append({"g": 1}, [0.1])
+    j2 = SweepJournal(path, meta={"sig": "s"})
+    assert j2.lookup({"g": 1}) == [0.1]
+
+    # torn HEADER line: truncated to nothing, then rebuilt on append
+    path2 = str(tmp_path / "torn-header.journal")
+    with open(path2, "w") as fh:
+        fh.write('{"journal": 1, "meta"')
+    j3 = SweepJournal(path2, meta={"sig": "s"})
+    assert len(j3) == 0
+    j3.append({"g": 2}, [0.2])
+    assert SweepJournal(path2, meta={"sig": "s"}).lookup({"g": 2}) == [0.2]
+
+
+def test_resume_best_so_far_includes_prekill_blocks(tmp_path):
+    """Journal 'best' entries written after a resume must account for
+    blocks completed BEFORE the kill."""
+    label, vec = _cols()
+    plan = FaultPlan([FaultSpec(SITE_RUN_BLOCK, at=2, kind="kill")])
+    with pytest.raises(InjectedKill):
+        with plan.active():
+            _selector(tmp_path / "b").fit_model(
+                [label, vec], FitContext(n_rows=200, seed=7))
+    _selector(tmp_path / "b").fit_model(
+        [label, vec], FitContext(n_rows=200, seed=7))
+    journal = glob.glob(str(tmp_path / "b" / "*.journal"))[0]
+    recs = [json.loads(x) for x in open(journal) if x.strip()][1:]
+    means = {SweepJournal.key_of(r["grid"]): float(np.mean(r["fold_metrics"]))
+             for r in recs}
+    overall_best = max(means.values())
+    # the LAST record's best-so-far must equal the overall best mean —
+    # it can only do so if pre-kill blocks seeded the tracker
+    assert recs[-1]["best"]["mean"] == pytest.approx(overall_best)
+
+
+# --------------------------------------------------------------------------- #
+# kill/resume sweep parity                                                    #
+# --------------------------------------------------------------------------- #
+
+def _cols(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(0, 0.4, n) > 0) \
+        .astype(np.float64)
+    label = Column(T.RealNN, {"value": y, "mask": np.ones(n, bool)})
+    vec = Column(T.OPVector, X)
+    return label, vec
+
+
+def _selector(ckpt_dir):
+    # one family, TWO static groups (= two sweep blocks: max_iter 8 / 4)
+    grids = [{"reg_param": 0.01, "max_iter": 8},
+             {"reg_param": 0.1, "max_iter": 8},
+             {"reg_param": 0.02, "max_iter": 4}]
+    return ModelSelector(
+        models=[(OpLogisticRegression(), grids)],
+        validator=OpCrossValidation(n_folds=2, seed=5),
+        evaluator=BinaryClassificationEvaluator(),
+        checkpoint_dir=str(ckpt_dir))
+
+
+def _results(model):
+    s = model.summary
+    return (s.best_grid, [r.fold_metrics for r in s.validation_results])
+
+
+def test_kill_resume_parity_bit_identical(tmp_path):
+    from transmogrifai_tpu.analysis.retrace import MONITOR
+    label, vec = _cols()
+    ctx = lambda: FitContext(n_rows=200, seed=7)  # noqa: E731
+
+    clean_best, clean_metrics = _results(
+        _selector(tmp_path / "clean").fit_model([label, vec], ctx()))
+
+    # kill at the SECOND grid block: block 1 (2 configs) is journaled
+    plan = FaultPlan([FaultSpec(SITE_RUN_BLOCK, at=2, kind="kill")])
+    with pytest.raises(InjectedKill):
+        with plan.active():
+            _selector(tmp_path / "f").fit_model([label, vec], ctx())
+    journals = glob.glob(str(tmp_path / "f" / "*.journal"))
+    assert len(journals) == 1
+    lines = [json.loads(x) for x in open(journals[0]) if x.strip()]
+    assert lines[0]["journal"] == 1
+    assert len(lines) - 1 == 2  # both block-1 configs committed
+    assert all("best" in rec for rec in lines[1:])
+
+    # resume: only the un-journaled block runs — asserted two ways:
+    # the completed block's program label must NOT re-trace, and the
+    # resumed results must be bit-identical to the clean run's
+    before = MONITOR.snapshot()
+    resumed_best, resumed_metrics = _results(
+        _selector(tmp_path / "f").fit_model([label, vec], ctx()))
+    delta = MONITOR.delta(before)
+    done_labels = [k for k in delta if k.startswith("sweep:logistic:(8,")]
+    assert not done_labels, \
+        f"resume re-traced completed block shapes: {done_labels}"
+    assert resumed_best == clean_best
+    assert resumed_metrics == clean_metrics
+
+
+def test_transient_block_fault_retried_within_family(tmp_path):
+    """A TRANSIENT error at a block boundary is absorbed by the family
+    retry policy (journaled blocks are skipped on the retry), not
+    surfaced and not dropped."""
+    label, vec = _cols()
+    plan = FaultPlan([FaultSpec(SITE_RUN_BLOCK, at=2, kind="error",
+                                transient=True)])
+    with plan.active():
+        best, metrics = _results(
+            _selector(tmp_path / "t").fit_model(
+                [label, vec], FitContext(n_rows=200, seed=7)))
+    clean_best, clean_metrics = _results(
+        _selector(tmp_path / "c").fit_model(
+            [label, vec], FitContext(n_rows=200, seed=7)))
+    assert plan.fired == [(SITE_RUN_BLOCK, 2, "error")]
+    assert best == clean_best and metrics == clean_metrics
+
+
+def test_oom_block_halves_width_and_completes(tmp_path):
+    """A device-OOM-shaped failure on a multi-config block degrades to
+    two half-width blocks instead of surfacing."""
+    label, vec = _cols()
+    plan = FaultPlan([FaultSpec(SITE_RUN_BLOCK, at=1, kind="oom")])
+    with plan.active():
+        best, metrics = _results(
+            _selector(tmp_path / "o").fit_model(
+                [label, vec], FitContext(n_rows=200, seed=7)))
+    clean_best, clean_metrics = _results(
+        _selector(tmp_path / "c").fit_model(
+            [label, vec], FitContext(n_rows=200, seed=7)))
+    assert plan.fired == [(SITE_RUN_BLOCK, 1, "oom")]
+    assert plan.count(SITE_RUN_BLOCK) >= 3  # block 1 re-ran as halves
+    assert best == clean_best and metrics == clean_metrics
+
+
+# --------------------------------------------------------------------------- #
+# crash-consistent artifacts                                                  #
+# --------------------------------------------------------------------------- #
+
+def _tiny_model():
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.data import Dataset
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.workflow import Workflow
+    rng = np.random.default_rng(1)
+    n = 80
+    # 40 features: the fitted weight matrix crosses NPZ_MIN_SIZE, so the
+    # saved artifact includes arrays.npz (the corruption targets need it)
+    cols = {f"a{i}": rng.normal(size=n) for i in range(40)}
+    cols["label"] = rng.integers(0, 2, n).astype(np.float64)
+    schema = {k: T.Real for k in cols}
+    schema["label"] = T.Integral
+    ds = Dataset(cols, schema)
+    preds, label = FeatureBuilder.from_dataset(ds, response="label")
+    vec = transmogrify(preds)
+    pred = OpLogisticRegression(max_iter=5).set_input(label, vec) \
+        .get_output()
+    return Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    base = tmp_path_factory.mktemp("fault-models")
+    model = _tiny_model()
+    path = str(base / "model")
+    model.save(path)
+    return model, path
+
+
+def test_save_writes_integrity_manifest(saved_model):
+    _, path = saved_model
+    with open(os.path.join(path, "integrity.json")) as fh:
+        integ = json.load(fh)
+    assert "op-model.json" in integ["files"]
+    for rec in integ["files"].values():
+        assert rec["sha256"] and rec["bytes"] > 0
+
+
+def test_save_killed_mid_write_leaves_old_model_intact(saved_model,
+                                                       tmp_path):
+    from transmogrifai_tpu.workflow.serialization import (
+        load_model, model_fingerprint, save_model)
+    model, _ = saved_model
+    path = str(tmp_path / "m")
+    save_model(model, path)
+    fp = model_fingerprint(path)
+    for n in (1, 2, 3):  # kill at every write site pass
+        plan = FaultPlan([FaultSpec(SITE_WRITE_FILE, at=n, kind="kill")])
+        with pytest.raises(InjectedKill):
+            with plan.active():
+                save_model(model, path, overwrite=True)
+        assert model_fingerprint(path) == fp
+        load_model(path)  # still verifies + loads
+        assert not glob.glob(path + ".tmp-*") or True  # tmp may linger
+
+
+def test_overwrite_false_still_raises(saved_model):
+    from transmogrifai_tpu.workflow.serialization import save_model
+    model, path = saved_model
+    with pytest.raises(FileExistsError):
+        save_model(model, path, overwrite=False)
+
+
+@pytest.mark.parametrize("corruption", ["truncate_npz", "bitflip_manifest",
+                                        "drop_integrity", "drop_npz"])
+def test_load_model_rejects_torn_or_corrupt(saved_model, tmp_path,
+                                            corruption):
+    import shutil
+
+    from transmogrifai_tpu.workflow.serialization import (
+        ModelIntegrityError, load_model, save_model)
+    model, _ = saved_model
+    path = str(tmp_path / "m")
+    save_model(model, path)
+    npz = os.path.join(path, "arrays.npz")
+    if corruption == "truncate_npz":
+        size = os.path.getsize(npz)
+        with open(npz, "r+b") as fh:
+            fh.truncate(size // 2)
+    elif corruption == "bitflip_manifest":
+        mpath = os.path.join(path, "op-model.json")
+        data = bytearray(open(mpath, "rb").read())
+        data[len(data) // 2] ^= 0x40
+        open(mpath, "wb").write(bytes(data))
+    elif corruption == "drop_integrity":
+        os.unlink(os.path.join(path, "integrity.json"))
+    else:
+        os.unlink(npz)
+    with pytest.raises(ModelIntegrityError) as ei:
+        load_model(path)
+    assert path in str(ei.value)
+    shutil.rmtree(path)
+
+
+# --------------------------------------------------------------------------- #
+# ingest retry                                                                #
+# --------------------------------------------------------------------------- #
+
+def _run_pipeline(store, retry=None, stats=None):
+    from transmogrifai_tpu.data.pipeline import run_chunk_pipeline
+    chunks = []
+    stats = run_chunk_pipeline(
+        range(0, store.n_rows, 64),
+        lambda r0: np.array(store.chunk(r0, r0 + 64), copy=True),
+        lambda c: chunks.append(c),
+        workers=2, depth=2, retry=retry, stats=stats)
+    return np.concatenate(chunks), stats
+
+
+@pytest.fixture(scope="module")
+def small_store(tmp_path_factory):
+    from transmogrifai_tpu.data.columnar_store import synth_binary_store
+    tmp = tmp_path_factory.mktemp("fault-store")
+    return synth_binary_store(str(tmp / "store"), 512, 8, seed=2,
+                              chunk_rows=128)
+
+
+def test_ingest_retry_until_success_bitwise(small_store):
+    ref, ref_stats = _run_pipeline(small_store)
+    assert ref_stats.retries == 0
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                         sleep=lambda s: None)
+    plan = FaultPlan([FaultSpec(SITE_READ_CHUNK, at=2, kind="error",
+                                transient=True, times=2)])
+    with plan.active():
+        out, stats = _run_pipeline(small_store, retry=policy)
+    # passes 2 and 3 failed (chunk 2's first try + first retry), the
+    # second retry succeeded: budget of 3 attempts absorbs both
+    assert stats.retries == 2
+    assert stats.retry_wait_s >= 0.0
+    assert out.tobytes() == ref.tobytes()  # bitwise-identical output
+
+
+def test_ingest_retry_exhausted_propagates(small_store):
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                         sleep=lambda s: None)
+    plan = FaultPlan([FaultSpec(SITE_READ_CHUNK, at=2, kind="error",
+                                transient=True, times=0)])
+    with plan.active():
+        with pytest.raises(InjectedFault):
+            _run_pipeline(small_store, retry=policy)
+
+
+def test_ingest_fatal_fault_not_retried(small_store):
+    from transmogrifai_tpu.data.pipeline import IngestStats
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                         sleep=lambda s: None)
+    plan = FaultPlan([FaultSpec(SITE_READ_CHUNK, at=1, kind="error",
+                                transient=False)])
+    stats = IngestStats()
+    with plan.active():
+        with pytest.raises(InjectedFault):
+            _run_pipeline(small_store, retry=policy, stats=stats)
+    assert stats.retries == 0  # fatal: surfaced on the first attempt
+
+
+def test_bigdata_upload_records_retries(small_store):
+    jax = pytest.importorskip("jax")
+    from transmogrifai_tpu.parallel import bigdata as bd
+    ref = np.asarray(bd.device_matrix(small_store, chunk_rows=128))
+    plan = FaultPlan([FaultSpec(SITE_READ_CHUNK, at=2, kind="error",
+                                transient=True)])
+    with plan.active():
+        buf, stats = bd.device_matrix(
+            small_store, chunk_rows=128, return_stats=True,
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                              sleep=lambda s: None))
+    assert stats.retries == 1
+    assert stats.to_extra()["retries"] == 1
+    np.testing.assert_array_equal(np.asarray(buf), ref)
+
+
+# --------------------------------------------------------------------------- #
+# columnar store checksums                                                    #
+# --------------------------------------------------------------------------- #
+
+def test_store_truncated_column_file_is_structured_error(tmp_path):
+    from transmogrifai_tpu.data.columnar_store import (
+        ColumnarStore, StoreIntegrityError, synth_binary_store)
+    store = synth_binary_store(str(tmp_path / "s"), 256, 4, seed=1,
+                               chunk_rows=64)
+    xpath = os.path.join(store.path, "X.bin")
+    size = os.path.getsize(xpath)
+    with open(xpath, "r+b") as fh:
+        fh.truncate(size - 64)
+    with pytest.raises(StoreIntegrityError) as ei:
+        ColumnarStore(store.path)
+    msg = str(ei.value)
+    assert "X.bin" in msg and "truncated" in msg and "column" in msg
+
+
+def test_store_bitflip_detected_and_optout(tmp_path):
+    from transmogrifai_tpu.data.columnar_store import (
+        ColumnarStore, StoreIntegrityError, synth_binary_store)
+    store = synth_binary_store(str(tmp_path / "s"), 256, 4, seed=1,
+                               chunk_rows=64)
+    xpath = os.path.join(store.path, "X.bin")
+    with open(xpath, "r+b") as fh:
+        fh.seek(100)
+        b = fh.read(1)
+        fh.seek(100)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(StoreIntegrityError) as ei:
+        ColumnarStore(store.path)
+    assert "checksum mismatch" in str(ei.value)
+    st = ColumnarStore(store.path, verify=False)  # explicit opt-out
+    assert st.n_rows == 256
+
+
+def test_store_label_file_named_in_error(tmp_path):
+    from transmogrifai_tpu.data.columnar_store import (
+        ColumnarStore, StoreIntegrityError, synth_binary_store)
+    store = synth_binary_store(str(tmp_path / "s"), 128, 4, seed=1,
+                               chunk_rows=64)
+    ypath = os.path.join(store.path, "y.bin")
+    with open(ypath, "r+b") as fh:
+        fh.truncate(os.path.getsize(ypath) - 8)
+    with pytest.raises(StoreIntegrityError) as ei:
+        ColumnarStore(store.path)
+    assert "y.bin" in str(ei.value) and "label column" in str(ei.value)
+
+
+# --------------------------------------------------------------------------- #
+# serving: /reload of a corrupt dir keeps the resident version               #
+# --------------------------------------------------------------------------- #
+
+def test_reload_corrupt_dir_keeps_resident_serving(saved_model, tmp_path):
+    from transmogrifai_tpu.serving import (
+        ScoreError, ScoringService, ServingConfig)
+    from transmogrifai_tpu.workflow.serialization import save_model
+    model, v1_path = saved_model
+    v2 = str(tmp_path / "v2")
+    save_model(model, v2)
+    npz = os.path.join(v2, "arrays.npz")
+    with open(npz, "r+b") as fh:
+        fh.truncate(os.path.getsize(npz) // 2)
+
+    service = ScoringService.from_path(
+        v1_path, config=ServingConfig(max_batch=4, batch_wait_ms=1.0))
+    service.start()
+    try:
+        resident = service.health()["model_version"]
+        row = {f"a{i}": 0.1 * i for i in range(40)}
+        before = service.score_row(row)
+        with pytest.raises(ScoreError) as ei:
+            service.reload(v2)
+        assert ei.value.code == "bad_request"
+        assert "resident version keeps serving" in str(ei.value)
+        # resident version untouched and still answering, byte-for-byte
+        assert service.health()["model_version"] == resident
+        assert service.score_row(row) == before
+        assert service.registry.counter(
+            "serving_reload_rejected_total",
+            "reloads rejected by artifact integrity verification"
+        ).value == 1
+    finally:
+        service.stop()
+
+
+# --------------------------------------------------------------------------- #
+# workflow params wiring + lint L008                                          #
+# --------------------------------------------------------------------------- #
+
+def test_sweep_checkpoint_params_json_roundtrip():
+    from transmogrifai_tpu.workflow.params import (
+        OpParams, SweepCheckpointParams)
+    p = OpParams(sweep_checkpoint=SweepCheckpointParams(
+        checkpoint_dir="/tmp/ckpt", fsync=False))
+    d = p.to_json()
+    back = OpParams.from_json(d)
+    assert back.sweep_checkpoint.checkpoint_dir == "/tmp/ckpt"
+    assert back.sweep_checkpoint.fsync is False
+    assert OpParams.from_json({}).sweep_checkpoint is None
+
+
+def test_workflow_threads_checkpoint_dir_to_selector(tmp_path):
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.data import Dataset
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.selector.model_selector import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.workflow import Workflow
+    rng = np.random.default_rng(4)
+    n = 120
+    ds = Dataset(
+        {"a": rng.normal(size=n), "b": rng.normal(size=n),
+         "label": rng.integers(0, 2, n).astype(np.float64)},
+        {"a": T.Real, "b": T.Real, "label": T.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="label")
+    vec = transmogrify(preds)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        models=[(OpLogisticRegression(max_iter=4),
+                 [{"reg_param": 0.01}, {"reg_param": 0.1}])],
+        n_folds=2, splitter=None)
+    pred = selector.set_input(label, vec).get_output()
+    ckpt = str(tmp_path / "ckpt")
+    wf = Workflow().set_result_features(pred, label) \
+        .set_parameters({"sweep_checkpoint": {"checkpoint_dir": ckpt}}) \
+        .set_input_dataset(ds)
+    wf.train()
+    assert glob.glob(os.path.join(ckpt, "sweep_*.json")), \
+        "selector did not pick up the sweep_checkpoint params"
+    assert glob.glob(os.path.join(ckpt, "sweep_*.journal"))
+    # the user's own selector instance is untouched (train clones)
+    assert selector.checkpoint_dir is None
+
+
+def test_lint_L008_flags_and_allows():
+    from transmogrifai_tpu.analysis.lint import lint_source
+    bad = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        ...\n"
+        "def r():\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return g()\n"
+        "        except ValueError:\n"
+        "            continue\n"
+    )
+    findings = [f for f in lint_source(bad) if f.code == "L008"]
+    assert len(findings) == 3, findings
+
+    good = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except ValueError:\n"
+        "        pass\n"              # narrowed type: allowed
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        log.debug('x', exc_info=True)\n"
+        "def r():\n"
+        "    attempts = 0\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return g()\n"
+        "        except ValueError:\n"
+        "            attempts += 1\n"
+        "            if attempts > 3:\n"
+        "                raise\n"      # bounded: handler can exit
+        "def stream(buf):\n"
+        "    while True:\n"           # no handler inside: allowed
+        "        if not buf.read(1):\n"
+        "            break\n"
+    )
+    assert [f for f in lint_source(good) if f.code == "L008"] == []

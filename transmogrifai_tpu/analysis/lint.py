@@ -46,6 +46,17 @@ silent slowness or nondeterminism once XLA is in the loop:
   arbitrarily far ahead of real transfer, breaking deadline math.
   Route bulk uploads through ``data/pipeline.run_chunk_pipeline``
   (worker prepare + bounded-depth overlapped writes) instead.
+- ``L008 unbounded-fault-handling``: the two anti-patterns the
+  ``runtime/`` fault-tolerance layer replaces. (a) a broad swallow —
+  bare ``except:`` / ``except Exception:`` whose body is ONLY
+  ``pass``/``continue``/``...`` — hides the failure entirely: either
+  narrow the exception type, handle it (even a ``log.debug`` with
+  ``exc_info`` counts: the failure stays observable), or let it
+  propagate into a ``runtime.retry.RetryPolicy``. (b) an unbounded
+  ``while True`` retry loop — a handler inside the loop that neither
+  re-raises, ``break``s, nor ``return``s, so a PERSISTENT error spins
+  forever; bound it with ``RetryPolicy`` (attempts + backoff +
+  transient classification) instead.
 
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
@@ -297,6 +308,90 @@ class _FileLinter(ast.NodeVisitor):
     def visit_For(self, node: ast.For) -> None:
         self._check_serial_ingest(node)
         self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        self._check_swallowed_exception(node)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_unbounded_retry(node)
+        self.generic_visit(node)
+
+    # -- L008 -------------------------------------------------------------- #
+
+    @staticmethod
+    def _handler_is_broad(node: ast.ExceptHandler) -> bool:
+        """bare `except:` or a clause catching Exception/BaseException."""
+        if node.type is None:
+            return True
+        types = (node.type.elts if isinstance(node.type, ast.Tuple)
+                 else [node.type])
+        for t in types:
+            dotted = _dotted(t)
+            if dotted and dotted.rsplit(".", 1)[-1] in (
+                    "Exception", "BaseException"):
+                return True
+        return False
+
+    @staticmethod
+    def _body_swallows(body: List[ast.stmt]) -> bool:
+        """True when the handler body is ONLY pass/continue/`...`."""
+        for stmt in body:
+            if isinstance(stmt, (ast.Pass, ast.Continue)):
+                continue
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Constant) and \
+                    stmt.value.value is Ellipsis:
+                continue
+            return False
+        return bool(body)
+
+    def _check_swallowed_exception(self, node: ast.ExceptHandler) -> None:
+        if self._handler_is_broad(node) and self._body_swallows(node.body):
+            self._emit(
+                node, "L008",
+                "broad exception swallow (`except Exception: pass`) — the "
+                "failure vanishes silently; narrow the type, record it "
+                "(log with exc_info), or route the call through "
+                "runtime.retry.RetryPolicy")
+
+    @staticmethod
+    def _handler_exits(handler: ast.ExceptHandler) -> bool:
+        """Does the handler body (own scope only) raise/break/return?"""
+        stack: List[ast.AST] = list(handler.body)
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                continue
+            if isinstance(sub, (ast.Raise, ast.Break, ast.Return)):
+                return True
+            stack.extend(ast.iter_child_nodes(sub))
+        return False
+
+    def _check_unbounded_retry(self, node: ast.While) -> None:
+        """`while True:` containing a handler that never exits the loop:
+        a persistent error retries forever with no attempt bound."""
+        if not (isinstance(node.test, ast.Constant)
+                and node.test.value is True):
+            return
+        stack: List[ast.AST] = list(node.body)
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                continue  # nested scopes run on their own terms
+            if isinstance(sub, ast.ExceptHandler):
+                if not self._handler_exits(sub):
+                    self._emit(
+                        sub, "L008",
+                        "unbounded `while True` retry: this handler "
+                        "neither re-raises, breaks, nor returns, so a "
+                        "persistent error loops forever — bound it with "
+                        "runtime.retry.RetryPolicy (attempts + backoff + "
+                        "transient classification)")
+                continue  # handler internals already judged
+            stack.extend(ast.iter_child_nodes(sub))
 
     # -- L007 -------------------------------------------------------------- #
 

@@ -19,16 +19,40 @@ well-scaled numeric features; f16 → bf16/f32 widening happens on device.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+from transmogrifai_tpu.runtime.integrity import sha256_file as _sha256_file
+
+log = logging.getLogger(__name__)
 
 MANIFEST = "manifest.json"
 X_FILE = "X.bin"
 Y_FILE = "y.bin"
 
 DEFAULT_CHUNK_ROWS = 262_144
+
+# which logical column group each store file holds, for error messages
+_FILE_ROLE = {X_FILE: "feature-matrix columns", Y_FILE: "label column"}
+
+
+class StoreIntegrityError(RuntimeError):
+    """A store column file failed verification (truncated / resized /
+    checksum mismatch). Structured: names the file, its column role, and
+    what disagreed — instead of the numpy reshape crash a truncated
+    memmap used to produce."""
+
+    def __init__(self, path: str, filename: str, reason: str):
+        self.path = path
+        self.filename = filename
+        self.reason = reason
+        role = _FILE_ROLE.get(filename, "column")
+        super().__init__(
+            f"columnar store {path!r}: {filename} ({role}) failed "
+            f"integrity check: {reason}")
 
 
 def _open_matrix(path: str, dtype: np.dtype, mode: str,
@@ -45,9 +69,19 @@ def _open_matrix(path: str, dtype: np.dtype, mode: str,
 
 class ColumnarStore:
     """A (n_rows, n_features) numeric matrix + optional label vector,
-    memory-mapped from disk and read in row chunks."""
+    memory-mapped from disk and read in row chunks.
 
-    def __init__(self, path: str):
+    `verify=True` (default) checks each column file's size against the
+    manifest shape and — when the writer recorded per-file checksums —
+    its sha256, raising a structured `StoreIntegrityError` naming the
+    file and its column role. A truncated X.bin therefore fails loudly
+    at `open()` instead of as a numpy reshape crash (or, worse, as a
+    silently short memmap). `verify="size"` does the (free) size check
+    but skips the checksum pass, which re-reads every byte — the right
+    mode for hot-path re-opens of multi-GB stores (e.g. the bench reuse
+    probe); `verify=False` skips both."""
+
+    def __init__(self, path: str, verify=True):
         self.path = path
         with open(os.path.join(path, MANIFEST)) as fh:
             m = json.load(fh)
@@ -57,13 +91,42 @@ class ColumnarStore:
         self.dtype = np.dtype(m["dtype"])
         self.feature_names: List[str] = m.get("feature_names") or [
             f"f{i}" for i in range(self.n_features)]
+        label_dtype = np.dtype(m.get("label_dtype", "float32"))
+        ypath = os.path.join(path, Y_FILE)
+        has_y = os.path.exists(ypath)
+        if verify:
+            expect = {X_FILE: self.n_rows * self.n_features
+                      * self.dtype.itemsize}
+            if has_y:
+                expect[Y_FILE] = self.n_rows * label_dtype.itemsize
+            self._verify(expect,
+                         (m.get("checksums") or {}) if verify is True
+                         else {})
         self._X = _open_matrix(os.path.join(path, X_FILE), self.dtype,
                                "r", (self.n_rows, self.n_features))
-        ypath = os.path.join(path, Y_FILE)
         self._y: Optional[np.ndarray] = None
-        if os.path.exists(ypath):
-            self._y = _open_matrix(ypath, np.dtype(m.get(
-                "label_dtype", "float32")), "r", (self.n_rows,))
+        if has_y:
+            self._y = _open_matrix(ypath, label_dtype, "r", (self.n_rows,))
+
+    def _verify(self, expected_bytes: Dict[str, int],
+                checksums: Dict[str, Dict]) -> None:
+        for name, expect in expected_bytes.items():
+            fpath = os.path.join(self.path, name)
+            if not os.path.exists(fpath):
+                raise StoreIntegrityError(self.path, name, "file missing")
+            size = os.path.getsize(fpath)
+            if size != expect:
+                raise StoreIntegrityError(
+                    self.path, name,
+                    f"truncated or resized: {size} bytes on disk, "
+                    f"{expect} expected from the manifest shape")
+            rec = checksums.get(name)
+            if rec and rec.get("sha256"):
+                digest = _sha256_file(fpath)
+                if digest != rec["sha256"]:
+                    raise StoreIntegrityError(
+                        self.path, name,
+                        "checksum mismatch (torn write or bit corruption)")
 
     # -- reading -------------------------------------------------------- #
 
@@ -156,13 +219,27 @@ class ColumnarStoreWriter:
         if isinstance(self._y, np.memmap):
             self._y.flush()
         # the manifest is the completion sentinel: written LAST so an
-        # interrupted generation never passes the reuse= check
+        # interrupted generation never passes the reuse= check. It also
+        # records per-column-file checksums, so a later open() can detect
+        # truncation/corruption instead of memmapping garbage.
         if self._manifest is not None:
+            checksums: Dict[str, Dict] = {}
+            for name in (X_FILE, Y_FILE):
+                fpath = os.path.join(self.path, name)
+                if os.path.exists(fpath):
+                    checksums[name] = {
+                        "sha256": _sha256_file(fpath),
+                        "bytes": os.path.getsize(fpath)}
+            self._manifest["checksums"] = checksums
             tmp = os.path.join(self.path, MANIFEST + ".tmp")
             with open(tmp, "w") as fh:
                 json.dump(self._manifest, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
             os.replace(tmp, os.path.join(self.path, MANIFEST))
-        return ColumnarStore(self.path)
+        # verify=False: the checksums were computed from these bytes a
+        # moment ago — re-hashing a multi-GB store here buys nothing
+        return ColumnarStore(self.path, verify=False)
 
 
 def synth_binary_store(path: str, n_rows: int, n_features: int,
@@ -178,15 +255,22 @@ def synth_binary_store(path: str, n_rows: int, n_features: int,
     different seed regenerates instead of silently returning other data)."""
     informative = min(informative, n_features)
     if reuse and os.path.exists(os.path.join(path, MANIFEST)):
+        st = None
         try:
-            st = ColumnarStore(path)
-            if (st.n_rows == n_rows and st.n_features == n_features
-                    and st.y is not None
-                    and st.meta.get("synth_seed") == seed
-                    and st.meta.get("synth_informative") == informative):
-                return st
+            # size-only verify: completeness is what the reuse probe
+            # guards; a full checksum pass would re-read the whole
+            # (possibly multi-GB) store before every bench run
+            st = ColumnarStore(path, verify="size")
         except Exception:
-            pass
+            # unreadable/corrupt/truncated existing store: regenerate
+            st = None
+            log.warning("synth store at %s unusable; regenerating", path,
+                        exc_info=True)
+        if (st is not None and st.n_rows == n_rows
+                and st.n_features == n_features and st.y is not None
+                and st.meta.get("synth_seed") == seed
+                and st.meta.get("synth_informative") == informative):
+            return st
     rng = np.random.default_rng(seed)
     beta = np.zeros(n_features, np.float32)
     inf_idx = rng.choice(n_features, size=informative, replace=False)

@@ -46,6 +46,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Optional
 
+from transmogrifai_tpu.runtime.faults import SITE_READ_CHUNK, fault_point
+
 __all__ = ["IngestStats", "run_chunk_pipeline"]
 
 
@@ -71,6 +73,8 @@ class IngestStats:
     upload_wait_s: float = 0.0
     wall_s: float = 0.0
     max_in_flight: int = 0
+    retries: int = 0          # transient prepare failures retried
+    retry_wait_s: float = 0.0  # backoff slept across all retries
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -86,6 +90,11 @@ class IngestStats:
             self.cast_s += seconds
             self.bytes_wire += wire_nbytes
             self.chunks += 1
+
+    def note_retry(self, delay_s: float) -> None:
+        with self._lock:
+            self.retries += 1
+            self.retry_wait_s += delay_s
 
     # derived ----------------------------------------------------------- #
 
@@ -131,6 +140,8 @@ class IngestStats:
             "workers": self.workers,
             "depth": self.depth,
             "max_in_flight": self.max_in_flight,
+            "retries": self.retries,
+            "retry_wait_s": round(self.retry_wait_s, 4),
         }
 
 
@@ -140,7 +151,8 @@ def run_chunk_pipeline(items: Iterable[Any],
                        *, workers: int = 2, depth: int = 2,
                        deadline_s: Optional[float] = None,
                        label: str = "ingest",
-                       stats: Optional[IngestStats] = None) -> IngestStats:
+                       stats: Optional[IngestStats] = None,
+                       retry: Optional[Any] = None) -> IngestStats:
     """Drive `items` through prepare (worker threads) → upload (main
     thread, bounded async depth). Returns the filled `IngestStats`.
 
@@ -151,7 +163,13 @@ def run_chunk_pipeline(items: Iterable[Any],
     array whose readiness implies the write finished — or None to skip
     depth accounting for that item.
 
-    A worker exception propagates to the caller on the failing item's
+    `retry`: optional `runtime.retry.RetryPolicy` — each chunk's prepare
+    is retried under it on TRANSIENT failures (IO errors classified by
+    the policy), with attempts and backoff recorded in
+    `IngestStats.retries`/`retry_wait_s`. prepare is a pure read+cast,
+    so a retried chunk produces byte-identical output and the pipeline
+    result is bitwise-equal to a fault-free run. Fatal errors, and
+    transient ones past the budget, propagate on the failing item's
     turn (futures re-raise in submission order); nothing hangs.
 
     `deadline_s` is checked against real elapsed time before each
@@ -159,11 +177,25 @@ def run_chunk_pipeline(items: Iterable[Any],
     tracks actual transfer progress to within `depth` chunks — the
     serial loops this replaces measured enqueue time and could never
     fire mid-transfer. The deadline is NOT re-checked after the final
-    drain: a finished buffer is returned, not discarded.
+    drain: a finished buffer is returned, not discarded. (Deadline
+    expiry is deliberately OUTSIDE the retry policy: a blown time
+    budget is not transient.)
     """
     st = stats if stats is not None else IngestStats(label=label)
     st.workers = workers
     st.depth = depth
+
+    def prepare_once(item):
+        fault_point(SITE_READ_CHUNK)
+        return prepare(item)
+
+    if retry is None:
+        prepare_task = prepare_once
+    else:
+        prepare_task = retry.wrap(
+            prepare_once, label=f"{label}.read_chunk",
+            on_attempt=lambda ev: st.note_retry(ev.delay_s))
+
     t_start = time.perf_counter()
     it = iter(items)
     pending: deque = deque()      # prepare futures, submission order
@@ -181,7 +213,7 @@ def run_chunk_pipeline(items: Iterable[Any],
                     item = next(it)
                 except StopIteration:
                     return
-                pending.append(pool.submit(prepare, item))
+                pending.append(pool.submit(prepare_task, item))
 
         fill()
         i = 0

@@ -30,6 +30,7 @@ Memory plan at 10M×500×32 bins (v5e 16 GB HBM):
 from __future__ import annotations
 
 import logging
+import os
 import time
 from functools import partial
 from typing import Dict, Optional, Tuple
@@ -109,15 +110,28 @@ def _put(chunk_np, sharding):
             else jax.device_put(chunk_np, sharding))
 
 
+def _default_ingest_retry():
+    """Bounded-retry policy for transient IO during bulk ingest
+    (tf.data-style bounded retry instead of fail-fast: a single flaky
+    NFS read must not burn a 600 s upload). `TRANSMOGRIFAI_INGEST_RETRIES`
+    sets total attempts (1 disables retrying)."""
+    from transmogrifai_tpu.runtime.retry import RetryPolicy
+    attempts = int(os.environ.get("TRANSMOGRIFAI_INGEST_RETRIES", "3"))
+    return RetryPolicy(max_attempts=max(1, attempts),
+                       base_delay_s=0.1, max_delay_s=5.0)
+
+
 def _pipelined_upload(store: ColumnarStore, chunk_rows: int,
                       wire: np.dtype, label: str, bufs: dict, write, *,
                       workers: int, depth: int,
                       deadline_s: Optional[float], sharding,
-                      profile) -> IngestStats:
+                      profile, retry=None) -> IngestStats:
     """Shared scaffold for the upload builders: timed prepare, bounded
     pipeline, progress/summary logging, profile record. `write(bufs,
     chunk_dev, r0)` dispatches the donated write(s), rebinding `bufs`
-    entries, and returns the completion token."""
+    entries, and returns the completion token. Chunk reads retry
+    transient IO under `retry` (default `_default_ingest_retry`);
+    attempts land in the returned stats."""
     stats = IngestStats(label=label, workers=workers, depth=depth)
 
     def upload(prep):
@@ -131,9 +145,12 @@ def _pipelined_upload(store: ColumnarStore, chunk_rows: int,
                        _chunk_prepare(store, chunk_rows, wire, stats),
                        upload, workers=workers, depth=depth,
                        deadline_s=deadline_s, label=f"{label} upload",
-                       stats=stats)
-    log.info("%s: %d rows in %.1fs (%.2f GB/s, overlap %.2f)", label,
-             store.n_rows, stats.wall_s, stats.gbps, stats.overlap_frac)
+                       stats=stats,
+                       retry=retry if retry is not None
+                       else _default_ingest_retry())
+    log.info("%s: %d rows in %.1fs (%.2f GB/s, overlap %.2f, retries %d)",
+             label, store.n_rows, stats.wall_s, stats.gbps,
+             stats.overlap_frac, stats.retries)
     if profile is not None:
         profile.record_ingest(f"{label}_upload", stats)
     return stats
@@ -170,7 +187,8 @@ def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
                   chunk_rows: int = UPLOAD_CHUNK_ROWS,
                   deadline_s: Optional[float] = None, *,
                   workers: int = UPLOAD_WORKERS, depth: int = UPLOAD_DEPTH,
-                  sharding=None, profile=None, return_stats: bool = False):
+                  sharding=None, profile=None, return_stats: bool = False,
+                  retry=None):
     """Stream the store into one (n_pad, d) device buffer through the
     bounded-depth chunk pipeline (`data/pipeline.py`): worker threads
     read+cast upcoming chunks while up to `depth` donated writes are in
@@ -206,7 +224,7 @@ def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
     stats = _pipelined_upload(store, chunk_rows, wire, "device_matrix",
                               bufs, write, workers=workers, depth=depth,
                               deadline_s=deadline_s, sharding=sharding,
-                              profile=profile)
+                              profile=profile, retry=retry)
     return (bufs["x"], stats) if return_stats else bufs["x"]
 
 
@@ -214,7 +232,8 @@ def device_binned(store: ColumnarStore, edges: np.ndarray,
                   chunk_rows: int = UPLOAD_CHUNK_ROWS,
                   deadline_s: Optional[float] = None, *,
                   workers: int = UPLOAD_WORKERS, depth: int = UPLOAD_DEPTH,
-                  sharding=None, profile=None, return_stats: bool = False):
+                  sharding=None, profile=None, return_stats: bool = False,
+                  retry=None):
     """(n_pad, d) int8 quantile-binned device buffer through the same
     chunk pipeline as `device_matrix`. Chunks ship as f16 and bin ON
     DEVICE (broadcast-compare, VPU): the r3 host `searchsorted` loop
@@ -233,7 +252,7 @@ def device_binned(store: ColumnarStore, edges: np.ndarray,
                               "device_binned", bufs, write,
                               workers=workers, depth=depth,
                               deadline_s=deadline_s, sharding=sharding,
-                              profile=profile)
+                              profile=profile, retry=retry)
     return (bufs["b"], stats) if return_stats else bufs["b"]
 
 
@@ -243,7 +262,8 @@ def dual_device_matrices(store: ColumnarStore, edges: np.ndarray,
                          deadline_s: Optional[float] = None, *,
                          workers: int = UPLOAD_WORKERS,
                          depth: int = UPLOAD_DEPTH, sharding=None,
-                         profile=None, return_stats: bool = False):
+                         profile=None, return_stats: bool = False,
+                         retry=None):
     """ONE pass over the store → BOTH device representations: the
     (n_pad, d) `dtype` (bf16) linear-family matrix AND the (n_pad, d)
     int8 quantile-binned matrix. Halves host IO versus running
@@ -276,7 +296,8 @@ def dual_device_matrices(store: ColumnarStore, edges: np.ndarray,
     stats = _pipelined_upload(store, chunk_rows, np.dtype(np.float16),
                               "dual", bufs, write, workers=workers,
                               depth=depth, deadline_s=deadline_s,
-                              sharding=sharding, profile=profile)
+                              sharding=sharding, profile=profile,
+                              retry=retry)
     if return_stats:
         return bufs["x"], bufs["b"], stats
     return bufs["x"], bufs["b"]

@@ -59,8 +59,113 @@ from transmogrifai_tpu.models.trees import (
     forest_classification_pred, forest_regression_pred,
     gbt_multiclass_pred_from_margin, gbt_pred_from_margin,
     quantile_bin_edges)
+from transmogrifai_tpu.runtime.faults import (
+    SITE_RUN_BLOCK, fault_point, is_oom_error)
 
 log = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------- #
+# block journaling + fault-resilient group execution                          #
+# --------------------------------------------------------------------------- #
+
+# Per-family sweep state set by run_sweep for the duration of one family's
+# handler call. Thread-local on purpose: families sweep concurrently on the
+# selector's thread pool, each with its OWN journal file.
+_SWEEP_TL = threading.local()
+
+
+def _active_journal():
+    return getattr(_SWEEP_TL, "journal", None)
+
+
+class _BestTracker:
+    """Running best-so-far (mean metric + grid) recorded into each journal
+    entry, so a resumed operator can see where an interrupted sweep stood."""
+
+    def __init__(self, larger_is_better: bool):
+        self.sign = 1.0 if larger_is_better else -1.0
+        self.best: Optional[Dict[str, Any]] = None
+
+    def note(self, grid: Dict, row: List[float]) -> Optional[Dict[str, Any]]:
+        mean = float(np.mean(row)) if row else float("nan")
+        if np.isfinite(mean) and (
+                self.best is None
+                or self.sign * mean > self.sign * self.best["mean"]):
+            self.best = {"mean": mean, "grid": grid}
+        return self.best
+
+
+def _journal_prefill(grids: List[Dict],
+                     metrics: List[Optional[List[float]]]) -> int:
+    """Fill journaled rows into `metrics`; returns how many were skipped.
+    Journal floats round-trip JSON exactly, so a resumed sweep's metric
+    matrix is bit-identical to an uninterrupted run's."""
+    journal = _active_journal()
+    if journal is None:
+        return 0
+    best = getattr(_SWEEP_TL, "best", None)
+    hits = 0
+    for i, g in enumerate(grids):
+        row = journal.lookup(g)
+        if row is not None:
+            metrics[i] = row
+            if best is not None:
+                # seed the best-so-far tracker with pre-kill blocks, or
+                # post-resume journal entries would name a worse leader
+                best.note(g, row)
+            hits += 1
+    if hits:
+        log.info("sweep journal: resuming past %d/%d completed blocks",
+                 hits, len(grids))
+    return hits
+
+
+def _journal_commit(grids: List[Dict],
+                    metrics: List[Optional[List[float]]],
+                    idxs: List[int]) -> None:
+    journal = _active_journal()
+    if journal is None:
+        return
+    best = getattr(_SWEEP_TL, "best", None)
+    for i in idxs:
+        row = metrics[i]
+        if row is None or any(m is None for m in row):
+            continue
+        journal.append(grids[i], row,
+                       best=best.note(grids[i], row) if best else None)
+
+
+def _run_groups_resilient(groups: Dict[Tuple, List[int]], run_one,
+                          commit, family: str) -> None:
+    """Execute grid-block groups with the fault-tolerance contract:
+
+    - `fault_point(SITE_RUN_BLOCK)` fires before every block, so a chaos
+      plan can kill/fail the sweep at any block boundary;
+    - a device-OOM failure HALVES the block width and retries each half
+      before surfacing (narrower blocks fit where wide ones did not —
+      the compiled program per half persists in the compile cache);
+    - `commit(idxs)` journals a block only after it fully completes.
+    """
+    def run(static, idxs):
+        try:
+            fault_point(SITE_RUN_BLOCK)
+            run_one(static, idxs)
+        except Exception as e:
+            if len(idxs) <= 1 or not is_oom_error(e):
+                raise
+            mid = (len(idxs) + 1) // 2
+            log.warning(
+                "sweep %s block %r: device OOM with %d configs (%s) — "
+                "halving block width and retrying", family, static,
+                len(idxs), e)
+            run(static, idxs[:mid])
+            run(static, idxs[mid:])
+            return
+        commit(idxs)
+
+    for static, idxs in groups.items():
+        run(static, idxs)
 
 
 # --------------------------------------------------------------------------- #
@@ -78,12 +183,21 @@ def _metric(evaluator, y: np.ndarray, pred: Dict[str, np.ndarray],
 
 def _sweep_generic(est, grids: List[Dict], X, y, folds, evaluator,
                    ctx) -> List[List[float]]:
-    """Fallback: python loop over grids × folds (host metric path)."""
+    """Fallback: python loop over grids × folds (host metric path). A
+    grid config is the journaling block here: journaled configs are
+    skipped, completed configs append as soon as their folds finish."""
     from transmogrifai_tpu.models.trees import _TreeEstimatorBase
-    out = []
+    out: List[List[float]] = []
     y_np = np.asarray(y)
+    journal = _active_journal()
+    best = getattr(_SWEEP_TL, "best", None)
     bin_cache: Dict = {}  # shared across the family: bin X once per max_bins
     for grid in grids:
+        cached = journal.lookup(grid) if journal is not None else None
+        if cached is not None:
+            out.append(cached)
+            continue
+        fault_point(SITE_RUN_BLOCK)
         clone = type(est)(**{**{k: v for k, v in est.params.items()
                                 if k != "uid"}, **grid})
         if isinstance(clone, _TreeEstimatorBase):
@@ -96,6 +210,9 @@ def _sweep_generic(est, grids: List[Dict], X, y, folds, evaluator,
             row.append(_metric(evaluator, y_np,
                                {k: np.asarray(v) for k, v in pred.items()}, va))
         out.append(row)
+        if journal is not None:
+            journal.append(grid, row,
+                           best=best.note(grid, row) if best else None)
     return out
 
 
@@ -189,10 +306,12 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
     infrastructure (and a host loop also bounds peak HBM). With a mesh
     (`sharding`), the batched path runs so the grid axis shards.
     """
+    metrics: List[Optional[List[float]]] = [None] * len(grids)
+    _journal_prefill(grids, metrics)  # resume: skip completed blocks
     groups: Dict[Tuple, List[int]] = {}
     for i, g in enumerate(grids):
-        groups.setdefault(static_of(g), []).append(i)
-    metrics: List[Optional[List[float]]] = [None] * len(grids)
+        if metrics[i] is None:
+            groups.setdefault(static_of(g), []).append(i)
     host = isinstance(metric_fn, HostMetricFallback)
     y_np = np.asarray(y) if host else None
     V_np = np.asarray(V) if host else None
@@ -318,8 +437,10 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
     # with queue-contention time — and width feeds compiled dispatch
     # shapes, defeating the stable-shape/persistent-cache strategy, and
     # (c) let later groups reuse calibration learned by earlier ones.
-    for st, ix in groups.items():
-        _run_group(st, ix)
+    _run_groups_resilient(
+        groups, _run_group,
+        commit=lambda idxs: _journal_commit(grids, metrics, idxs),
+        family=family)
     return metrics  # type: ignore[return-value]
 
 
@@ -582,8 +703,9 @@ def _load_calib() -> None:
         if os.path.exists(_calib_path()):
             with open(_calib_path()) as f:
                 _CALIB.update({k: float(v) for k, v in _json.load(f).items()})
-    except Exception:
-        pass
+    except (OSError, ValueError, TypeError):
+        log.debug("sweep calibration file unreadable; using initial "
+                  "estimates", exc_info=True)
 
 
 def _save_calib() -> None:
@@ -916,15 +1038,17 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     # rounds are skipped outright — the host-loop analogue of the
     # reference's numEarlyStoppingRounds (DefaultSelectorParams.scala:74).
     import time as _time
+    metrics: List[Optional[List[float]]] = [None] * len(grids)
+    _journal_prefill(grids, metrics)  # resume: skip completed blocks
     groups: Dict[Tuple, List[int]] = {}
     for i, g in enumerate(grids):
-        groups.setdefault(static_of(g), []).append(i)
-    metrics: List[Optional[List[float]]] = [None] * len(grids)
+        if metrics[i] is None:
+            groups.setdefault(static_of(g), []).append(i)
     host = isinstance(metric_fn, HostMetricFallback)
     y_np = np.asarray(y) if host else None
     V_np = np.asarray(V) if host else None
 
-    for static, idxs in groups.items():
+    def _run_gbt_group(static, idxs):
         n_est, max_bins, esr = static[:3]
         Xb = xb_by_bins[max_bins]
         pad_depth = _pad_depth_of(est, grids, idxs)
@@ -1035,6 +1159,11 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
                     metrics[idxs[row_i]] = [None] * n_folds  # type: ignore
                 metrics[idxs[row_i]][j] = row_metrics[t]  # type: ignore
             s += width
+
+    _run_groups_resilient(
+        groups, _run_gbt_group,
+        commit=lambda idxs: _journal_commit(grids, metrics, idxs),
+        family="gbt")
     return metrics  # type: ignore[return-value]
 
 
@@ -1067,8 +1196,27 @@ def _dispatch(est) -> Optional[Callable]:
 
 
 def run_sweep(est, grids: List[Dict], X, y, folds, evaluator, ctx,
-              sharding=None) -> List[List[float]]:
-    """Metric matrix [grid][fold] for one model family."""
+              sharding=None, journal=None) -> List[List[float]]:
+    """Metric matrix [grid][fold] for one model family.
+
+    `journal`: optional `runtime.journal.SweepJournal` — completed grid
+    blocks append as soon as their fold metrics are final, and already-
+    journaled configs are skipped, so a killed sweep resumed with the
+    same journal re-runs only un-journaled blocks and reproduces the
+    bit-identical metric matrix (journal floats round-trip exactly)."""
+    _SWEEP_TL.journal = journal
+    _SWEEP_TL.best = _BestTracker(
+        getattr(evaluator, "is_larger_better", True)) \
+        if journal is not None else None
+    try:
+        return _run_sweep(est, grids, X, y, folds, evaluator, ctx, sharding)
+    finally:
+        _SWEEP_TL.journal = None
+        _SWEEP_TL.best = None
+
+
+def _run_sweep(est, grids: List[Dict], X, y, folds, evaluator, ctx,
+               sharding=None) -> List[List[float]]:
     handler = _dispatch(est)
     if handler is None:
         return _sweep_generic(est, grids, X, y, folds, evaluator, ctx)

@@ -97,6 +97,10 @@ def _derivable_features(reader, raw_features: Sequence,
                     out.append(f)
                     break
             except Exception:
+                # an extract-fn crash on a probe record means "not
+                # derivable from this record" — try the next probe
+                log.debug("probe record rejected by extract fn for %r",
+                          f.name, exc_info=True)
                 continue
         else:
             log.warning(

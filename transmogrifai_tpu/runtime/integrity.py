@@ -1,0 +1,19 @@
+"""Shared artifact-integrity primitives for the checksum manifests
+(`workflow/serialization.py` integrity.json, `data/columnar_store.py`
+manifest checksums)."""
+
+from __future__ import annotations
+
+import hashlib
+
+__all__ = ["sha256_file"]
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    """Chunked sha256 of a file's bytes (bounded memory for multi-GB
+    artifacts)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()

@@ -1,0 +1,198 @@
+"""SweepJournal: append-only log of completed sweep grid-config blocks.
+
+The selector's per-family checkpoint (model_selector.py) persists a
+family's whole metric matrix only AFTER the family finishes — a
+preemption 90% of the way through a 2-hour tree sweep still loses
+everything. The journal closes that gap: `parallel/sweep.py` appends
+one record per grid config as soon as its block's fold metrics are
+complete, and a resumed sweep skips journaled configs before grouping,
+so a kill at any block boundary costs at most the in-flight block.
+
+File format — one JSON object per line:
+
+    {"journal": 1, "meta": {...}}                               # header
+    {"key": "<config hash>", "grid": {...},
+     "fold_metrics": [...], "best": {...}}                      # blocks
+
+Properties the resume guarantees lean on:
+
+- **append-only + flush/fsync per record**: a kill never corrupts
+  earlier records; at worst the FINAL line is torn, and the loader
+  stops at the first unparseable line (the torn block simply re-runs).
+- **bit-identical metrics**: fold metrics round-trip through JSON's
+  shortest-repr floats, which is exact for float64 — a resumed sweep
+  selects the same winner with the same bytes as an uninterrupted run.
+- **keyed by config content**: `key_of(grid)` hashes the sorted JSON
+  of the grid dict; the enclosing file path carries the family/data/
+  fold/seed signature (model_selector `_signature`), and a header-meta
+  mismatch discards the file rather than resuming against stale state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SweepJournal"]
+
+log = logging.getLogger(__name__)
+
+_FORMAT_VERSION = 1
+
+
+class SweepJournal:
+    """Append-only per-family journal. Thread-safe (block completions
+    can land from a family's host-dispatch loop while another thread
+    reads counts)."""
+
+    def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None,
+                 fsync: bool = True):
+        self.path = path
+        self.meta = dict(meta or {})
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._rows: Dict[str, List[float]] = {}
+        self._header_written = False
+        self._load()
+
+    # -- keys ------------------------------------------------------------- #
+
+    @staticmethod
+    def key_of(grid: Dict[str, Any]) -> str:
+        blob = json.dumps(grid, sort_keys=True, default=repr)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    # -- reading ---------------------------------------------------------- #
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            log.warning("sweep journal %s unreadable; starting fresh",
+                        self.path, exc_info=True)
+            return
+        rows: Dict[str, List[float]] = {}
+        header_ok = False
+        valid_bytes = 0   # length of the intact, newline-terminated prefix
+        saw_record_line = False
+        for bline in raw.splitlines(keepends=True):
+            text = bline.decode("utf-8", "replace").strip()
+            complete = bline.endswith(b"\n")
+            if not text:
+                if complete:
+                    valid_bytes += len(bline)
+                continue
+            rec = None
+            if complete:
+                try:
+                    rec = json.loads(text)
+                except ValueError:
+                    rec = None
+            if rec is None:
+                # torn record from a kill mid-append (no newline), or a
+                # garbage line: everything BEFORE it is intact — stop
+                # here and TRUNCATE the file back to the intact prefix,
+                # or post-resume appends would concatenate onto the
+                # garbage and be lost to the next load
+                break
+            if not saw_record_line:
+                saw_record_line = True
+                if rec.get("journal") != _FORMAT_VERSION or \
+                        rec.get("meta") != self.meta:
+                    # stale/foreign journal at this path: do NOT resume
+                    # against it (rotate aside so nothing is lost)
+                    stale = self.path + ".stale"
+                    try:
+                        os.replace(self.path, stale)
+                    except OSError:
+                        pass
+                    log.warning("sweep journal %s: header mismatch; "
+                                "rotated to %s and starting fresh",
+                                self.path, stale)
+                    return
+                header_ok = True
+                valid_bytes += len(bline)
+                continue
+            key = rec.get("key")
+            metrics = rec.get("fold_metrics")
+            if isinstance(key, str) and isinstance(metrics, list):
+                rows[key] = [float(m) for m in metrics]
+            valid_bytes += len(bline)
+        if valid_bytes < len(raw):
+            log.warning("sweep journal %s: torn record after %d intact "
+                        "block(s); truncating the damaged tail",
+                        self.path, len(rows))
+            try:
+                with open(self.path, "r+b") as fh:
+                    fh.truncate(valid_bytes)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            except OSError:
+                # cannot repair in place: rotate aside and start fresh
+                # (resume degrades, correctness does not)
+                stale = self.path + ".stale"
+                try:
+                    os.replace(self.path, stale)
+                except OSError:
+                    pass
+                log.warning("sweep journal %s: could not truncate torn "
+                            "tail; rotated to %s", self.path, stale,
+                            exc_info=True)
+                return
+        self._rows = rows
+        # only a validated header makes appends skip re-writing it — an
+        # empty or header-torn file must get a fresh header first
+        self._header_written = header_ok
+
+    def lookup(self, grid: Dict[str, Any]) -> Optional[List[float]]:
+        with self._lock:
+            row = self._rows.get(self.key_of(grid))
+            return list(row) if row is not None else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    # -- writing ---------------------------------------------------------- #
+
+    def _write_line(self, obj: Dict[str, Any]) -> None:
+        line = json.dumps(obj, default=repr)
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+
+    def append(self, grid: Dict[str, Any], fold_metrics: List[float],
+               best: Optional[Dict[str, Any]] = None) -> None:
+        """Record one completed grid-config block. Idempotent per config;
+        never raises (journaling is an optimization — a full disk must
+        degrade resume granularity, not kill the sweep)."""
+        key = self.key_of(grid)
+        with self._lock:
+            if key in self._rows:
+                return
+            try:
+                if not self._header_written:
+                    dirname = os.path.dirname(self.path)
+                    if dirname:
+                        os.makedirs(dirname, exist_ok=True)
+                    self._write_line({"journal": _FORMAT_VERSION,
+                                      "meta": self.meta})
+                    self._header_written = True
+                self._write_line({
+                    "key": key, "grid": grid,
+                    "fold_metrics": [float(m) for m in fold_metrics],
+                    "best": best})
+            except OSError:
+                log.warning("sweep journal %s: append failed; block will "
+                            "re-run on resume", self.path, exc_info=True)
+                return
+            self._rows[key] = [float(m) for m in fold_metrics]

@@ -1,0 +1,156 @@
+"""faults-smoke: kill a sweep mid-grid, resume it, assert exact parity.
+
+The CI gate for the fault-tolerance layer (`make faults-smoke`):
+
+1. run a small `ModelSelector` sweep CLEAN and record the winner;
+2. run the same sweep with a `FaultPlan` that injects a KILL (a
+   BaseException, like a preemption) at the 2nd pass through the
+   ``sweep.run_block`` site — the first grid block journals, the sweep
+   dies;
+3. resume with the same checkpoint dir and no plan: only un-journaled
+   blocks run;
+4. assert the resumed run's best config AND every fold metric are
+   **bit-identical** to the clean run's.
+
+Also exercises crash-consistent saves: a save killed at the
+``serialize.write_file`` site must leave the previously saved model
+loadable and fingerprint-unchanged, and the half-written temp must
+never verify.
+
+Run: ``python -m transmogrifai_tpu.runtime.smoke`` (CPU-friendly).
+"""
+
+from __future__ import annotations
+
+import json
+import tempfile
+
+import numpy as np
+
+
+def _selector(checkpoint_dir):
+    from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.selector import ModelSelector
+    from transmogrifai_tpu.selector.validators import OpCrossValidation
+    # ONE family with TWO static groups (max_iter 8 vs 4): groups are the
+    # sweep's blocks, so a kill at block 2 leaves block 1 journaled.
+    # Single family => the selector runs families sequentially (no thread
+    # pool), making the global fault-site pass count deterministic.
+    grids = [{"reg_param": 0.01, "max_iter": 8},
+             {"reg_param": 0.1, "max_iter": 8},
+             {"reg_param": 0.02, "max_iter": 4}]
+    return ModelSelector(
+        models=[(OpLogisticRegression(), grids)],
+        validator=OpCrossValidation(n_folds=2, seed=11),
+        evaluator=BinaryClassificationEvaluator(),
+        checkpoint_dir=checkpoint_dir)
+
+
+def _cols(n=240, seed=3):
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.data.columns import Column
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.6 * X[:, 1] + rng.normal(0, 0.5, n) > 0) \
+        .astype(np.float64)
+    return (Column(T.RealNN, {"value": y, "mask": np.ones(n, bool)}),
+            Column(T.OPVector, X))
+
+
+def _fit(selector, cols):
+    from transmogrifai_tpu.stages.base import FitContext
+    return selector.fit_model(cols, FitContext(n_rows=240, seed=7))
+
+
+def _results(model):
+    s = model.summary
+    return {"best_grid": s.best_grid,
+            "fold_metrics": [r.fold_metrics for r in s.validation_results]}
+
+
+def _smoke_sweep(payload) -> None:
+    import glob
+
+    from transmogrifai_tpu.runtime.faults import (
+        SITE_RUN_BLOCK, FaultPlan, FaultSpec, InjectedKill)
+    cols = _cols()
+    with tempfile.TemporaryDirectory(prefix="faults-smoke-") as tmp:
+        clean = _results(_fit(_selector(f"{tmp}/clean"), cols))
+
+        # kill at the 2nd grid block: block 1 must already be journaled
+        plan = FaultPlan([FaultSpec(SITE_RUN_BLOCK, at=2, kind="kill")])
+        killed = False
+        try:
+            with plan.active():
+                _fit(_selector(f"{tmp}/faulted"), cols)
+        except InjectedKill:
+            killed = True
+        assert killed, "fault plan failed to kill the sweep"
+        journals = glob.glob(f"{tmp}/faulted/*.journal")
+        assert journals, "no journal survived the kill"
+        n_journaled = sum(1 for line in open(journals[0])) - 1  # - header
+        assert n_journaled >= 1, "kill landed before any block committed"
+
+        resumed = _results(_fit(_selector(f"{tmp}/faulted"), cols))
+        assert resumed["best_grid"] == clean["best_grid"], \
+            f"resume best {resumed['best_grid']} != clean {clean['best_grid']}"
+        assert resumed["fold_metrics"] == clean["fold_metrics"], \
+            "resumed fold metrics are not bit-identical to the clean run"
+        payload.update(kill_resume="ok", blocks_journaled=n_journaled,
+                       best_grid=clean["best_grid"])
+
+
+def _smoke_save(payload) -> None:
+    from transmogrifai_tpu.runtime.faults import (
+        SITE_WRITE_FILE, FaultPlan, FaultSpec, InjectedKill)
+    from transmogrifai_tpu.workflow.serialization import (
+        load_model, model_fingerprint, save_model)
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    n = 64
+    rows = [{"a": float(rng.normal()), "b": float(rng.normal()),
+             "label": int(rng.integers(0, 2))} for _ in range(n)]
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.data.dataset import Dataset
+    from transmogrifai_tpu.features import FeatureBuilder
+    ds = Dataset.from_rows(rows, schema={"a": T.Real, "b": T.Real,
+                                         "label": T.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="label")
+    from transmogrifai_tpu.automl import transmogrify
+    vec = transmogrify(preds)
+    pred = OpLogisticRegression(max_iter=5).set_input(label, vec).get_output()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+
+    with tempfile.TemporaryDirectory(prefix="faults-smoke-save-") as tmp:
+        path = f"{tmp}/model"
+        save_model(model, path)
+        fp = model_fingerprint(path)
+        plan = FaultPlan([FaultSpec(SITE_WRITE_FILE, at=2, kind="kill")])
+        died = False
+        try:
+            with plan.active():
+                save_model(model, path, overwrite=True)
+        except InjectedKill:
+            died = True
+        assert died, "fault plan failed to kill the save"
+        # the resident artifact must be untouched and still verify
+        assert model_fingerprint(path) == fp, "old model lost in torn save"
+        load_model(path)
+        payload.update(crash_consistent_save="ok", fingerprint=fp)
+
+
+def _smoke() -> int:
+    payload = {}
+    _smoke_sweep(payload)
+    _smoke_save(payload)
+    print(json.dumps({"faults_smoke": "ok", **payload}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_smoke())

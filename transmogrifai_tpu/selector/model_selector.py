@@ -106,10 +106,13 @@ class ModelSelector(Estimator):
         self.problem_type = problem_type
         # sweep checkpointing (SURVEY.md §5.4 — the reference has no
         # mid-sweep resume; long TPU sweeps need one): per-family metric
-        # matrices persist as JSON after each family completes, keyed by a
-        # signature of the family + grids + data shape + seed, so a killed
-        # sweep resumes at the first un-swept family
+        # matrices persist as JSON after each family completes, and a
+        # per-block SweepJournal (runtime/journal.py) persists each grid
+        # config's fold metrics AS THE SWEEP RUNS — both keyed by a
+        # signature of the family + grids + data content + folds + seed,
+        # so a killed sweep resumes at the first un-journaled block
         self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_fsync = True  # journal durability (tests may relax)
 
     def fit_model(self, cols: Sequence[Column], ctx: FitContext) -> Transformer:
         label_col, vec_col = cols
@@ -145,15 +148,22 @@ class ModelSelector(Estimator):
 
             def run_family(mi_est_grids):
                 mi, (est, grids) = mi_est_grids
-                ckpt = self._checkpoint_path(
+                sig = self._sweep_signature(
                     mi, est, grids, X, data_digest, folds, ctx)
+                ckpt = self._checkpoint_path(mi, est, sig)
                 cached = self._load_checkpoint(ckpt)
                 if cached is not None:
                     log.info("sweep checkpoint hit: %s (%d grids)",
                              type(est).__name__, len(cached))
                     return cached
+                # block-granular journal: completed grid blocks persist
+                # as the sweep runs, so a kill ANYWHERE inside the family
+                # resumes at the first un-journaled block instead of
+                # re-running the family from scratch
+                journal = self._journal_for(mi, est, sig)
                 grid_fold = self._run_sweep_with_retry(
-                    est, grids, X, y_dev, folds, ctx, sharding)
+                    est, grids, X, y_dev, folds, ctx, sharding,
+                    journal=journal)
                 self._save_checkpoint(ckpt, grid_fold)
                 return grid_fold
 
@@ -210,27 +220,29 @@ class ModelSelector(Estimator):
                             y_dev, train_idx, test_idx, split_summary)
 
     def _run_sweep_with_retry(self, est, grids, X, y_dev, folds, ctx,
-                              sharding, retries: int = 2):
+                              sharding, retries: int = 2, journal=None):
         """The serving tunnel's remote-compile RPC occasionally drops a
         response mid-read (transient INTERNAL error, r3 bench); dropping a
-        whole model family for that throws away real work. Retry runtime
-        errors with a short backoff — the persistent compile cache makes
-        the retry cheap — and only then let the family-drop fault
-        tolerance (OpValidator.scala:344-347 parity) take over."""
-        import time as _time
-        for attempt in range(retries + 1):
-            try:
-                return run_sweep(est, grids, X, y_dev, folds,
-                                 self.evaluator, ctx, sharding=sharding)
-            except Exception as e:
-                transient = "remote_compile" in str(e) or \
-                    type(e).__name__ == "JaxRuntimeError"
-                if attempt >= retries or not transient:
-                    raise
-                log.warning("sweep for %s hit transient runtime error "
-                            "(attempt %d/%d): %s — retrying",
-                            type(est).__name__, attempt + 1, retries, e)
-                _time.sleep(3.0 * (attempt + 1))
+        whole model family for that throws away real work. Retry through
+        the shared `runtime.retry.RetryPolicy` — the persistent compile
+        cache plus the block journal make a retry cheap (journaled blocks
+        are skipped) — and only then let the family-drop fault tolerance
+        (OpValidator.scala:344-347 parity) take over."""
+        from transmogrifai_tpu.runtime.retry import RetryPolicy
+
+        def classify(e):
+            if "remote_compile" in str(e) or \
+                    type(e).__name__ == "JaxRuntimeError":
+                return True
+            return None  # fall through to the error's own `transient` attr
+
+        policy = RetryPolicy(max_attempts=retries + 1, base_delay_s=3.0,
+                             max_delay_s=10.0, backoff=1.5,
+                             transient_types=(), classify=classify)
+        return policy.call(
+            run_sweep, est, grids, X, y_dev, folds, self.evaluator, ctx,
+            sharding=sharding, journal=journal,
+            label=f"sweep.{type(est).__name__}")
 
     # -- sweep checkpointing ------------------------------------------- #
 
@@ -247,20 +259,19 @@ class ModelSelector(Estimator):
         except Exception:
             return None
 
-    def _checkpoint_path(self, mi, est, grids, X, data_digest, folds,
+    def _sweep_signature(self, mi, est, grids, X, data_digest, folds,
                          ctx) -> Optional[str]:
-        """Checkpoint file keyed by everything that determines the metric
-        matrix: family + params + grids, the TRAINING DATA CONTENT (the
-        digest of X and y bytes — same-shaped different data must miss),
-        the fold structure, the evaluator class + metric, and the fit
-        seed. Never raises: checkpointing is an optimization, so any
-        failure degrades to 'no checkpoint' (the caller's try covers the
-        rest)."""
+        """Hash of everything that determines the metric matrix: family +
+        params + grids, the TRAINING DATA CONTENT (the digest of X and y
+        bytes — same-shaped different data must miss), the fold
+        structure, the evaluator class + metric, and the fit seed. Keys
+        both the per-family checkpoint file and the per-block journal.
+        Never raises: checkpointing is an optimization, so any failure
+        degrades to 'no checkpoint'."""
         if self.checkpoint_dir is None or data_digest is None:
             return None
         import hashlib
         import json as _json
-        import os
         try:
             val = self.validator
             sig = _json.dumps({
@@ -278,13 +289,45 @@ class ModelSelector(Estimator):
                 "evaluator": [type(self.evaluator).__name__,
                               getattr(self.evaluator, "metric", None)],
             }, sort_keys=True, default=repr)
-            h = hashlib.sha256(sig.encode()).hexdigest()[:16]
-            os.makedirs(self.checkpoint_dir, exist_ok=True)
-            return os.path.join(self.checkpoint_dir,
-                                f"sweep_{mi}_{type(est).__name__}_{h}.json")
+            return hashlib.sha256(sig.encode()).hexdigest()[:16]
         except Exception:
             log.warning("sweep checkpointing disabled for this fit "
+                        "(signature failed)", exc_info=True)
+            return None
+
+    def _checkpoint_path(self, mi, est, sig) -> Optional[str]:
+        if self.checkpoint_dir is None or sig is None:
+            return None
+        import os
+        try:
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+        except OSError:
+            log.warning("sweep checkpointing disabled for this fit "
                         "(checkpoint_dir unusable)", exc_info=True)
+            return None
+        return os.path.join(self.checkpoint_dir,
+                            f"sweep_{mi}_{type(est).__name__}_{sig}.json")
+
+    def _journal_for(self, mi, est, sig):
+        """Open (or resume) the family's block journal beside the family
+        checkpoint. Never raises — an unusable journal degrades to
+        family-level resume granularity."""
+        if self.checkpoint_dir is None or sig is None:
+            return None
+        import os
+
+        from transmogrifai_tpu.runtime.journal import SweepJournal
+        try:
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            return SweepJournal(
+                os.path.join(
+                    self.checkpoint_dir,
+                    f"sweep_{mi}_{type(est).__name__}_{sig}.journal"),
+                meta={"sig": sig},
+                fsync=getattr(self, "checkpoint_fsync", True))
+        except Exception:
+            log.warning("sweep journal unusable; family-level resume only",
+                        exc_info=True)
             return None
 
     @staticmethod

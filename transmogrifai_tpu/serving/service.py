@@ -367,14 +367,32 @@ class ScoringService:
         """Load + warm a new serialized model, then atomically swap it
         under traffic. The displaced version stays resident for
         `rollback()`. In-flight batches finish on the version they were
-        dispatched with — no request is ever mis-versioned."""
+        dispatched with — no request is ever mis-versioned.
+
+        The candidate dir is integrity-verified BEFORE anything is
+        loaded: a torn/corrupt artifact is rejected with a structured
+        error (and a `serving_reload_rejected_total` tick) while the
+        resident version keeps serving untouched."""
         from transmogrifai_tpu.workflow.serialization import (
-            load_model, model_fingerprint)
-        vid = model_fingerprint(model_location)
+            ModelIntegrityError, load_model, model_fingerprint,
+            verify_model_dir)
+        try:
+            verify_model_dir(model_location)
+            vid = model_fingerprint(model_location)
+        except (ModelIntegrityError, OSError) as e:
+            self.registry.counter(
+                "serving_reload_rejected_total",
+                "reloads rejected by artifact integrity verification").inc()
+            log.warning("serving: reload of %s rejected (%s); resident "
+                        "version keeps serving", model_location, e)
+            raise ScoreError(
+                "bad_request",
+                f"reload rejected by integrity check, resident version "
+                f"keeps serving: {e}")
         active = self._active
         if active is not None and active.version_id == vid:
             return {"status": "unchanged", "version": vid}
-        model = load_model(model_location)
+        model = load_model(model_location, verify=False)  # verified above
         version = self._install(model, vid, path=model_location)
         self._m_swaps.inc()
         log.info("serving: swapped to model %s from %s", vid,

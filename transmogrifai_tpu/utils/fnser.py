@@ -94,11 +94,13 @@ def encode_fn(fn: Optional[Callable], strict: bool = False) -> Any:
     # model (or fail) — pickle those like lambdas
     if mod and mod != "__main__" and qual and "<" not in qual \
             and "." not in qual:
+        resolved = None
         try:  # prefer a readable module:name reference when it resolves
-            if getattr(importlib.import_module(mod), qual, None) is fn:
-                return {_REF_KEY: f"{mod}:{qual}"}
+            resolved = getattr(importlib.import_module(mod), qual, None)
         except Exception:
-            pass
+            resolved = None  # import failure: fall through to pickling
+        if resolved is fn:
+            return {_REF_KEY: f"{mod}:{qual}"}
     if strict:
         raise ValueError(
             f"cannot serialize {qual or fn!r} without a cloudpickle "

@@ -83,6 +83,29 @@ class ServingParams:
 
 
 @dataclass
+class SweepCheckpointParams:
+    """Resumable-sweep configuration: where `ModelSelector` persists its
+    per-family checkpoints and per-block `SweepJournal` files
+    (runtime/journal.py). With `checkpoint_dir` set, `Workflow.train()`
+    threads it onto every selector in the DAG that has none of its own,
+    so a preempted training run re-invoked with the same params resumes
+    at the first un-journaled grid block."""
+
+    checkpoint_dir: Optional[str] = None
+    fsync: bool = True        # journal durability (relax for throwaway runs)
+
+    _FIELDS = ("checkpoint_dir", "fsync")
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "SweepCheckpointParams":
+        return SweepCheckpointParams(
+            **{k: d[k] for k in SweepCheckpointParams._FIELDS if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+
+@dataclass
 class OpParams:
     """Runtime workflow configuration (OpParams.scala:81-97)."""
 
@@ -98,6 +121,7 @@ class OpParams:
     collect_stage_metrics: bool = True
     custom_params: Dict[str, Any] = field(default_factory=dict)
     serving: Optional[ServingParams] = None
+    sweep_checkpoint: Optional[SweepCheckpointParams] = None
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -105,6 +129,8 @@ class OpParams:
                    for k, v in (d.get("reader_params") or {}).items()}
         serving = (ServingParams.from_json(d["serving"])
                    if d.get("serving") else None)
+        sweep_ckpt = (SweepCheckpointParams.from_json(d["sweep_checkpoint"])
+                      if d.get("sweep_checkpoint") else None)
         return OpParams(
             stage_params=dict(d.get("stage_params") or {}),
             reader_params=readers,
@@ -117,7 +143,8 @@ class OpParams:
             log_stage_metrics=bool(d.get("log_stage_metrics", False)),
             collect_stage_metrics=bool(d.get("collect_stage_metrics", True)),
             custom_params=dict(d.get("custom_params") or {}),
-            serving=serving)
+            serving=serving,
+            sweep_checkpoint=sweep_ckpt)
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -139,6 +166,8 @@ class OpParams:
             "collect_stage_metrics": self.collect_stage_metrics,
             "custom_params": self.custom_params,
             "serving": self.serving.to_json() if self.serving else None,
+            "sweep_checkpoint": (self.sweep_checkpoint.to_json()
+                                 if self.sweep_checkpoint else None),
         }
 
 

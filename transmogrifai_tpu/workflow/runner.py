@@ -159,7 +159,10 @@ class WorkflowRunner:
     def _load_model(self, params: OpParams) -> WorkflowModel:
         if not params.model_location:
             raise ValueError("model_location required")
-        return WorkflowModel.load(params.model_location)
+        # custom_params["verify_model"]: false is the params-JSON escape
+        # hatch for artifacts saved before integrity manifests existed
+        verify = bool(params.custom_params.get("verify_model", True))
+        return WorkflowModel.load(params.model_location, verify=verify)
 
     def _score(self, params: OpParams, profile: RunProfile) -> RunResult:
         model = self._load_model(params)

@@ -1,35 +1,91 @@
-"""Model persistence: JSON manifest + per-stage params.
+"""Model persistence: JSON manifest + per-stage params, crash-consistent.
 
 Reference parity: `core/.../OpWorkflowModelWriter.scala:56-207` (single
 `op-model.json` manifest: uids, features, stages, params, version) and
 `OpWorkflowModelReader.scala:63-300` (rebuild stages via registry, re-link
 features by uid — `resolveFeatures:182`).
 
-Layout: `<path>/op-model.json` + `<path>/arrays.npz`. Small stage params
-inline as JSON; numeric payloads of >= NPZ_MIN_SIZE elements offload to
-the npz (`_offload_arrays`) so megabyte-scale tree tables and weight
-matrices round-trip as binary arrays, not PyObject lists. Extract-fn raw
+Layout: `<path>/op-model.json` + `<path>/arrays.npz` + the integrity
+manifest `<path>/integrity.json`. Small stage params inline as JSON;
+numeric payloads of >= NPZ_MIN_SIZE elements offload to the npz
+(`_offload_arrays`) so megabyte-scale tree tables and weight matrices
+round-trip as binary arrays, not PyObject lists. Extract-fn raw
 features round-trip only through the `@extract_fn` registry
 (`utils/fnser.py`); saving an unregistered closure raises at save time.
+
+Crash consistency (`save_model`): every file is written into a TEMP
+SIBLING directory and fsynced; the integrity manifest (per-file sha256 +
+size) is written LAST; only then is the directory renamed into place —
+with any previous model renamed ASIDE first and deleted only after the
+new one is live, so a crash at any point leaves either the old model,
+the new model, or both recoverable, never a torn mix. `load_model`
+verifies the integrity manifest before deserializing anything: a
+truncated, bit-flipped, or mid-save-killed directory raises a structured
+`ModelIntegrityError` instead of loading garbage (the serving layer
+turns that into a rejected `/reload` while the resident version keeps
+serving).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
 from typing import Any, Dict, List
 
 import numpy as np
 
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.features.feature import Feature
+from transmogrifai_tpu.runtime.faults import SITE_WRITE_FILE, fault_point
+from transmogrifai_tpu.runtime.integrity import sha256_file as _sha256_file
 from transmogrifai_tpu.stages.base import (
     FeatureGeneratorStage, StageRegistry, Transformer)
 
+log = logging.getLogger(__name__)
+
 MANIFEST = "op-model.json"
 ARRAYS = "arrays.npz"
+INTEGRITY = "integrity.json"
 VERSION = 1
+INTEGRITY_VERSION = 1
 NPZ_MIN_SIZE = 64  # numeric payloads at/above this many elements offload
+
+
+class ModelIntegrityError(RuntimeError):
+    """A serialized model directory failed integrity verification
+    (missing/truncated/bit-flipped file, or a save that died before the
+    integrity manifest landed). Structured: carries the dir and reason."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = path
+        self.reason = reason
+        super().__init__(
+            f"model artifact {path!r} failed integrity check: {reason}")
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Durable directory entry (rename/create visibility). Best-effort:
+    not every platform lets you fsync a directory fd."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        log.debug("directory fsync unsupported for %s", path)
+    finally:
+        os.close(fd)
 
 
 def _offload_arrays(value: Any, store: Dict[str, np.ndarray],
@@ -87,7 +143,14 @@ def _feature_entry(f: Feature) -> Dict[str, Any]:
 
 def save_model(model, path: str, overwrite: bool = True,
                strict_fns: bool = False) -> None:
-    """`strict_fns=True` forbids cloudpickle payloads: every callable
+    """Crash-consistent save: serialize into a temp sibling dir, fsync,
+    write the integrity manifest LAST, then rename into place. With
+    `overwrite=True` an existing model is renamed ASIDE (never deleted
+    before the replacement is live) — a crash at any instruction leaves
+    a loadable old model, a loadable new model, or both; never a torn
+    directory that `load_model` would accept.
+
+    `strict_fns=True` forbids cloudpickle payloads: every callable
     param (extract fns, row-op lambdas) must be `@extract_fn`-registered
     or module-level, or the save RAISES — nothing bytecode-pinned ships
     silently (VERDICT r2 #6; reference analogue: macro-captured class
@@ -99,10 +162,9 @@ def save_model(model, path: str, overwrite: bool = True,
             return save_model(model, path, overwrite, strict_fns=False)
         finally:
             fnser.pop_strict(token)
-    os.makedirs(path, exist_ok=True)
-    out = os.path.join(path, MANIFEST)
-    if os.path.exists(out) and not overwrite:
-        raise FileExistsError(out)
+    path = os.path.normpath(path)
+    if os.path.exists(os.path.join(path, MANIFEST)) and not overwrite:
+        raise FileExistsError(os.path.join(path, MANIFEST))
 
     features: Dict[str, Feature] = {}
     order: List[str] = []
@@ -129,8 +191,6 @@ def save_model(model, path: str, overwrite: bool = True,
             "inputs": [p.uid for p in stage.input_features],
         }
         stage_entries.append(entry)
-    if arrays:
-        np.savez_compressed(os.path.join(path, ARRAYS), **arrays)
 
     manifest = {
         "version": VERSION,
@@ -138,8 +198,65 @@ def save_model(model, path: str, overwrite: bool = True,
         "features": [_feature_entry(features[uid]) for uid in order],
         "stages": stage_entries,
     }
-    with open(out, "w") as fh:
-        json.dump(manifest, fh)
+
+    # -- stage everything in a temp sibling (same filesystem => same-dir
+    #    rename is atomic); a kill in here never touches `path` ---------- #
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        names = []
+        if arrays:
+            fault_point(SITE_WRITE_FILE)
+            np.savez_compressed(os.path.join(tmp, ARRAYS), **arrays)
+            _fsync_file(os.path.join(tmp, ARRAYS))
+            names.append(ARRAYS)
+        fault_point(SITE_WRITE_FILE)
+        with open(os.path.join(tmp, MANIFEST), "w") as fh:
+            json.dump(manifest, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        names.append(MANIFEST)
+        # integrity manifest LAST: its presence asserts every other file
+        # is complete, its checksums pin their bytes
+        fault_point(SITE_WRITE_FILE)
+        integrity = {
+            "integrity_version": INTEGRITY_VERSION,
+            "files": {name: {
+                "sha256": _sha256_file(os.path.join(tmp, name)),
+                "bytes": os.path.getsize(os.path.join(tmp, name)),
+            } for name in names},
+        }
+        with open(os.path.join(tmp, INTEGRITY), "w") as fh:
+            json.dump(integrity, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        _fsync_dir(tmp)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+    # -- swap into place: the old model is renamed aside, not deleted,
+    #    until the new one is live --------------------------------------- #
+    if os.path.exists(path):
+        old = f"{path}.old-{os.getpid()}"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.rename(path, old)
+        try:
+            os.rename(tmp, path)
+        except BaseException:
+            os.rename(old, path)  # restore the displaced model
+            raise
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        os.rename(tmp, path)
+    parent = os.path.dirname(os.path.abspath(path))
+    _fsync_dir(parent)
 
 
 def model_fingerprint(path: str) -> str:
@@ -174,13 +291,64 @@ def _ensure_stage_library() -> None:
                 "transmogrifai_tpu.insights"):
         try:
             importlib.import_module(mod)
-        except Exception:  # a broken optional module must not block load;
-            pass           # a truly missing class still raises below
+        except Exception:
+            # a broken optional module must not block load; a truly
+            # missing class still raises at registry resolution below
+            log.debug("stage library module %s failed to import", mod,
+                      exc_info=True)
 
 
-def load_model(path: str):
+def verify_model_dir(path: str) -> Dict[str, Any]:
+    """Verify a serialized model dir against its integrity manifest;
+    returns the parsed manifest. Raises `ModelIntegrityError` for a
+    missing/unreadable integrity manifest (a save killed before the
+    final write — or a pre-integrity artifact: re-save, or load with
+    `verify=False`), a missing or truncated file, or a checksum
+    mismatch (torn write / bit corruption)."""
+    if not os.path.isdir(path):
+        raise ModelIntegrityError(path, "not a directory")
+    if not os.path.exists(os.path.join(path, MANIFEST)):
+        raise ModelIntegrityError(path, f"missing {MANIFEST}")
+    ipath = os.path.join(path, INTEGRITY)
+    if not os.path.exists(ipath):
+        raise ModelIntegrityError(
+            path, f"missing {INTEGRITY} — the save died before the "
+                  "integrity manifest landed (torn artifact), or this is "
+                  "a pre-integrity save (load with verify=False)")
+    try:
+        with open(ipath) as fh:
+            integrity = json.load(fh)
+    except ValueError as e:
+        raise ModelIntegrityError(path, f"unreadable {INTEGRITY}: {e}")
+    files = integrity.get("files")
+    if not isinstance(files, dict) or MANIFEST not in files:
+        raise ModelIntegrityError(path, f"malformed {INTEGRITY}")
+    for name, rec in files.items():
+        fpath = os.path.join(path, name)
+        if not os.path.exists(fpath):
+            raise ModelIntegrityError(path, f"{name} is missing")
+        size = os.path.getsize(fpath)
+        if size != rec.get("bytes"):
+            raise ModelIntegrityError(
+                path, f"{name} truncated or resized: {size} bytes on "
+                      f"disk, {rec.get('bytes')} recorded")
+        if _sha256_file(fpath) != rec.get("sha256"):
+            raise ModelIntegrityError(
+                path, f"{name} checksum mismatch (torn write or bit "
+                      "corruption)")
+    return integrity
+
+
+def load_model(path: str, verify: bool = True):
+    """Deserialize a model dir. `verify=True` (default) checks the
+    integrity manifest FIRST — a torn or corrupt dir raises
+    `ModelIntegrityError` and never reaches deserialization. Use
+    `verify=False` only for artifacts written before the integrity
+    manifest existed."""
     from transmogrifai_tpu.workflow.workflow import WorkflowModel
 
+    if verify:
+        verify_model_dir(path)
     _ensure_stage_library()
     with open(os.path.join(path, MANIFEST)) as fh:
         manifest = json.load(fh)

@@ -14,10 +14,13 @@ that runs every jittable stage in ONE XLA program.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from transmogrifai_tpu.data.columns import Column
 from transmogrifai_tpu.data.dataset import Dataset
@@ -162,6 +165,19 @@ class Workflow:
             apply_stage_params(
                 [s for layer in layers[1:] for s in layer], stage_params,
                 log=logging.getLogger(__name__))
+        # resumable sweeps: thread the sweep-checkpoint config onto every
+        # ModelSelector in the (cloned) DAG that has no checkpoint_dir of
+        # its own — a re-invoked train() with the same params then skips
+        # journaled grid blocks (runtime/journal.py)
+        sweep_ckpt = self.parameters.get("sweep_checkpoint") or {}
+        if sweep_ckpt.get("checkpoint_dir"):
+            for layer in layers[1:]:
+                for stage in layer:
+                    est = getattr(stage, "_estimator", None) or stage
+                    if self._is_selector(est) and est.checkpoint_dir is None:
+                        est.checkpoint_dir = sweep_ckpt["checkpoint_dir"]
+                        est.checkpoint_fsync = bool(
+                            sweep_ckpt.get("fsync", True))
         ctx = FitContext(n_rows=len(ds), seed=seed, mesh=mesh)
         columns: Dict[str, Column] = {}
         fitted: Dict[str, Transformer] = {}
@@ -542,7 +558,8 @@ class WorkflowModel:
                         if hasattr(leaf, "copy_to_host_async"):
                             leaf.copy_to_host_async()
                 except Exception:
-                    pass
+                    _log.debug("async host copy unavailable; consumer "
+                               "will fetch synchronously", exc_info=True)
             return result
 
         import jax as _jax
@@ -555,7 +572,8 @@ class WorkflowModel:
             try:
                 raw_dev = _jax.device_put(raw_dev)
             except Exception:
-                pass  # non-array leaves: let dispatch transfer lazily
+                # non-array leaves: let dispatch transfer lazily
+                _log.debug("worker-side device_put skipped", exc_info=True)
             return encs, raw_dev, columns
 
         # ONE jitted pack fn: jax.jit itself caches per input pytree
@@ -740,9 +758,12 @@ class WorkflowModel:
         save_model(self, path, overwrite=overwrite, strict_fns=strict_fns)
 
     @staticmethod
-    def load(path: str) -> "WorkflowModel":
+    def load(path: str, verify: bool = True) -> "WorkflowModel":
+        """`verify=False` skips the integrity-manifest check — the
+        escape hatch for artifacts saved before integrity.json existed
+        (see workflow/serialization.py)."""
         from transmogrifai_tpu.workflow.serialization import load_model
-        return load_model(path)
+        return load_model(path, verify=verify)
 
     def model_insights(self):
         """Merged explanation artifact (ModelInsights.scala:74)."""
