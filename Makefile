@@ -15,7 +15,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-.PHONY: lint serve-smoke ingest-smoke faults-smoke test check
+.PHONY: lint serve-smoke ingest-smoke faults-smoke trace-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -41,7 +41,15 @@ ingest-smoke:
 serve-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.smoke
 
+# observability smoke: tiny train+score through the runner with
+# --trace-out; validates the Perfetto JSON (well-formed events,
+# monotonic ts, parented spans), the GoodputReport buckets summing to
+# ~wall time, and the correlation-id-stamped JSONL event log. See
+# transmogrifai_tpu/obs/smoke.py.
+trace-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.obs.smoke
+
 test:
 	@$(TIER1)
 
-check: lint serve-smoke ingest-smoke faults-smoke test
+check: lint serve-smoke ingest-smoke faults-smoke trace-smoke test

@@ -48,7 +48,7 @@ BASELINE_ROWS_PER_SEC = 50_000.0  # documented estimate, BASELINE.md
 # is the primary figure, the multiplier is secondary.
 BASELINE_SWEEP_S = 1800.0
 
-_T0 = time.time()
+_T0 = time.perf_counter()
 
 
 def _budget_s() -> float:
@@ -57,12 +57,30 @@ def _budget_s() -> float:
 
 def _remaining() -> float:
     """Seconds left in the global bench budget."""
-    return _budget_s() - (time.time() - _T0)
+    return _budget_s() - (time.perf_counter() - _T0)
+
+
+_BENCH_ROOT = None     # bench-wide obs root span, opened by main()
+_BENCH_ROOT_CM = None  # its context manager — MUST stay referenced: a
+#                        dropped generator-CM is GC'd, which closes the
+#                        span immediately and kills the whole rollup
 
 
 def _emit(payload: dict) -> None:
     payload = dict(payload)
-    payload["elapsed_s"] = round(time.time() - _T0, 1)
+    payload["elapsed_s"] = round(time.perf_counter() - _T0, 1)
+    if _BENCH_ROOT is not None:
+        # goodput rollup over everything traced so far (recompile time,
+        # retry backoff, ingest upload-wait): every re-emit carries the
+        # newest decomposition, same contract as the other payload keys
+        try:
+            from transmogrifai_tpu.obs import goodput as _obs_goodput
+            from transmogrifai_tpu.obs.trace import TRACER as _TRACER
+            payload["goodput"] = _obs_goodput.build_report(
+                _BENCH_ROOT,
+                _TRACER.trace_spans(_BENCH_ROOT.trace_id)).to_json()
+        except Exception as e:
+            payload["goodput_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(payload))
     sys.stdout.flush()
 
@@ -154,9 +172,9 @@ def run(platform: str) -> dict:
     smoke = platform == "cpu" or os.environ.get("BENCH_SMOKE") == "1"
     n_rows = 10_000 if smoke else 100_000
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     ds = make_data(n_rows)
-    t_data = time.time() - t0
+    t_data = time.perf_counter() - t0
 
     preds, label = FeatureBuilder.from_dataset(ds, response="label")
     vector = transmogrify(preds)
@@ -168,9 +186,9 @@ def run(platform: str) -> dict:
         splitter=DataSplitter(reserve_test_fraction=0.1))
     pf = selector.set_input(label, checked).get_output()
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     model = Workflow().set_result_features(pf, label).set_input_dataset(ds).train()
-    t_train = time.time() - t0  # cold: includes every XLA compile
+    t_train = time.perf_counter() - t0  # cold: includes every XLA compile
 
     fitted = model.fitted[pf.origin_stage.uid]
     holdout = fitted.summary.holdout_metrics
@@ -197,9 +215,9 @@ def run(platform: str) -> dict:
         sel_inputs = [model.train_columns[f.uid]
                       for f in sel_stage.input_features]
         SWEEP_STATS.reset()
-        t0 = time.time()
+        t0 = time.perf_counter()
         sel_est.fit(sel_inputs, FitContext(n_rows=n_rows, seed=43))
-        t_sweep_warm = time.time() - t0
+        t_sweep_warm = time.perf_counter() - t0
         # device-dispatch occupancy of the sweep wall-clock + estimated
         # compile/first-exec overhead (SURVEY §6 "measure instead")
         # can exceed 1.0: dispatch seconds SUM across the family thread
@@ -209,14 +227,14 @@ def run(platform: str) -> dict:
         sweep_compile_s = SWEEP_STATS.compile_estimate_s()
 
     # fused scoring: warm up (compile), then measure
-    t0 = time.time()
+    t0 = time.perf_counter()
     out = model.score_compiled(ds)
     jax.block_until_ready(out[pf.name])
-    t_compile_score = time.time() - t0
-    t0 = time.time()
+    t_compile_score = time.perf_counter() - t0
+    t0 = time.perf_counter()
     out = model.score_compiled(ds)
     jax.block_until_ready(out[pf.name])
-    t_score = time.time() - t0
+    t_score = time.perf_counter() - t0
     rows_per_sec = n_rows / t_score
 
     # MFU of the fused scoring program: XLA's own FLOP estimate over the
@@ -232,9 +250,9 @@ def run(platform: str) -> dict:
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         flops = float(ca.get("flops", 0.0))
-        t0 = time.time()
+        t0 = time.perf_counter()
         jax.block_until_ready(jfn(scorer._consts, encs, raw_dev))
-        score_device_s = time.time() - t0
+        score_device_s = time.perf_counter() - t0
         peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e bf16
         if flops > 0 and score_device_s > 0:
             scoring_mfu = flops / score_device_s / peak
@@ -322,7 +340,7 @@ def run(platform: str) -> dict:
                 return
             yield b
             got += 1
-            if got >= min_batches and time.time() - t0 >= stream_target_s:
+            if got >= min_batches and time.perf_counter() - t0 >= stream_target_s:
                 stop.set()
                 # drain so the feeder's blocking put can see the stop
                 while True:
@@ -332,7 +350,7 @@ def run(platform: str) -> dict:
                     except _queue.Empty:
                         return
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     streamed = 0
     n_passes = 0
     # fetch_group=8: the tunnel's ~0.7s result-fetch RPC amortizes over 8
@@ -342,17 +360,17 @@ def run(platform: str) -> dict:
                                    coalesce_rows=coalesce):
         streamed += int(np.asarray(sout[pf.name]["prediction"]).shape[0])
         n_passes += 1
-    t_stream = time.time() - t0
+    t_stream = time.perf_counter() - t0
     stream_rows_per_sec = streamed / t_stream
     # host-encode fraction of streaming wall-clock (pipelined encode runs
     # in worker threads; <0.5 means the device path, not host string
     # work, bounds throughput)
     bds = next(iter(reader.stream()))
     model._compiled.host_phase(bds)
-    t0 = time.time()
+    t0 = time.perf_counter()
     for _ in range(4):
         model._compiled.host_phase(bds)
-    host_s_per_batch = (time.time() - t0) / 4
+    host_s_per_batch = (time.perf_counter() - t0) / 4
     stream_host_fraction = (host_s_per_batch * (streamed / batch)) / t_stream
 
     return {
@@ -472,9 +490,9 @@ def run_big(platform: str, payload: dict) -> None:
         _emit(payload)
         return
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     store = synth_binary_store(path, n_rows, d, seed=11)
-    t_gen = time.time() - t0
+    t_gen = time.perf_counter() - t0
     payload["big_rows"] = n_rows
     payload["big_d"] = d
     payload["big_datagen_s"] = round(t_gen, 1)
@@ -510,7 +528,7 @@ def run_big(platform: str, payload: dict) -> None:
         # extrapolation by the BASELINE "pod scale-out" chip count
         payload["big_sweep84_pod256_extrapolated_s"] = round(total / 256.0, 1)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     edges = store.quantile_edges(32)
     rf_s = xgb_s = None
     # pipelined ingest (data/pipeline.py): worker threads read+cast
@@ -567,7 +585,7 @@ def run_big(platform: str, payload: dict) -> None:
         Xb = None
     if Xb is not None:
         jax.block_until_ready(Xb)
-        t_binned = time.time() - t0
+        t_binned = time.perf_counter() - t0
         payload["big_bin_upload_s"] = round(t_binned, 1)
         Y1 = jax.nn.one_hot(y_dev.astype(jnp.int32), 2)
         w_full = jnp.asarray(W_np[0], jnp.float32)
@@ -582,11 +600,11 @@ def run_big(platform: str, payload: dict) -> None:
         np.asarray(bd.fit_forest_big(
             Xb, Y1, w_full, RF_K, 6, 32, 2, seed=3,
             trees_per_dispatch=RF_K)["leaf"])
-        t0 = time.time()
+        t0 = time.perf_counter()
         trees = bd.fit_forest_big(Xb, Y1, w_full, RF_K, 6, 32, 2, seed=3,
                                   trees_per_dispatch=RF_K)
         np.asarray(trees["leaf"])  # host materialization closes timing
-        per_tree_d6 = (time.time() - t0) / RF_K
+        per_tree_d6 = (time.perf_counter() - t0) / RF_K
         payload["big_rf_tree_d6_s"] = round(per_tree_d6, 2)
         payload["big_rf_lockstep_k"] = RF_K
         _emit(payload)  # RF lockstep number driver-captured from here on
@@ -620,11 +638,11 @@ def run_big(platform: str, payload: dict) -> None:
         w6 = jnp.tile(w_full[None], (6, 1))
         np.asarray(bd.fit_gbt_big_lockstep(
             Xb, y_dev, w6, 1, 6, 32, 0.1, 1.0, "logistic")[1])
-        t0 = time.time()
+        t0 = time.perf_counter()
         _, margin = bd.fit_gbt_big_lockstep(
             Xb, y_dev, w6, 2, 6, 32, 0.1, 1.0, "logistic")
         np.asarray(margin)
-        round6_d6 = (time.time() - t0) / 2.0  # one 6-pair round
+        round6_d6 = (time.perf_counter() - t0) / 2.0  # one 6-pair round
         payload["big_gbt_round6p_d6_s"] = round(round6_d6, 2)
         payload["big_gbt_round_d6_s"] = round(round6_d6 / 6.0, 2)
 
@@ -653,11 +671,11 @@ def run_big(platform: str, payload: dict) -> None:
             try:
                 np.asarray(bd.fit_gbt_big_lockstep(
                     Xb, y_dev, w6, 1, 10, 32, 0.1, 1.0, "logistic")[1])
-                t0 = time.time()
+                t0 = time.perf_counter()
                 _, m10 = bd.fit_gbt_big_lockstep(
                     Xb, y_dev, w6, 1, 10, 32, 0.1, 1.0, "logistic")
                 np.asarray(m10)
-                round6_d10 = time.time() - t0
+                round6_d10 = time.perf_counter() - t0
                 payload["big_gbt_round6p_d10_s"] = round(round6_d10, 2)
                 xgb_s = 200 * round6_d10
                 _emit_extrapolation(75.0, rf_s, xgb_s, estimated_lr=True)
@@ -679,11 +697,11 @@ def run_big(platform: str, payload: dict) -> None:
             try:
                 np.asarray(bd.fit_forest_big(
                     Xb, Y1, w_full, 1, 12, 32, 2, seed=5)["leaf"])
-                t0 = time.time()
+                t0 = time.perf_counter()
                 t12 = bd.fit_forest_big(Xb, Y1, w_full, 1, 12, 32, 2,
                                         seed=5)
                 np.asarray(t12["leaf"])
-                per_tree_d12 = time.time() - t0
+                per_tree_d12 = time.perf_counter() - t0
                 payload["big_rf_tree_d12_s"] = round(per_tree_d12, 2)
                 rf_s = 18 * 50 * ((scale(3) + 1.0) * per_tree_d6
                                   + per_tree_d12)
@@ -705,7 +723,7 @@ def run_big(platform: str, payload: dict) -> None:
         payload["big_lr_skipped"] = f"{_remaining():.0f}s left (<200s)"
         _emit(payload)
         return
-    t0 = time.time()
+    t0 = time.perf_counter()
     if X16 is None:
         try:
             X16, bf_stats = bd.device_matrix(
@@ -717,7 +735,7 @@ def run_big(platform: str, payload: dict) -> None:
             _emit(payload)
             return
         jax.block_until_ready(X16)
-        payload["big_upload_bf16_s"] = round(time.time() - t0, 1)
+        payload["big_upload_bf16_s"] = round(time.perf_counter() - t0, 1)
         payload["big_upload_bf16_gbps"] = round(bf_stats.gbps, 4)
         payload["big_ingest_phases"] = [p.to_json()
                                         for p in ingest_prof.phases]
@@ -735,11 +753,11 @@ def run_big(platform: str, payload: dict) -> None:
     l2v = jnp.asarray(l2v, jnp.float32)
     # compile warm-up (fold shapes are identical across folds)
     w0 = jnp.asarray(W_np[0], jnp.float32)
-    t0 = time.time()
+    t0 = time.perf_counter()
     jax.block_until_ready(bd.fit_logreg_enet_grids_big(
         X16, y_dev, w0, l1v, l2v, 2, 200)["W"])
-    note(f"LR fit compiled+run in {time.time() - t0:.1f}s")
-    t0 = time.time()
+    note(f"LR fit compiled+run in {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
     lr_metrics = np.zeros((8, 3))
     winner = None
     folds_done = 0
@@ -748,15 +766,15 @@ def run_big(platform: str, payload: dict) -> None:
             note(f"LR fold {f} skipped ({_remaining():.0f}s left)")
             break
         wf = jnp.asarray(W_np[f], jnp.float32)
-        t1 = time.time()
+        t1 = time.perf_counter()
         params = bd.fit_logreg_enet_grids_big(
             X16, y_dev, wf, l1v, l2v, 2, 200)
         jax.block_until_ready(params["W"])
-        note(f"LR fold {f} fit {time.time() - t1:.1f}s")
-        t1 = time.time()
+        note(f"LR fold {f} fit {time.perf_counter() - t1:.1f}s")
+        t1 = time.perf_counter()
         probs = bd.predict_logreg_grids_big(params["W"], params["b"], X16)
         jax.block_until_ready(probs)
-        note(f"LR fold {f} predict {time.time() - t1:.1f}s")
+        note(f"LR fold {f} predict {time.perf_counter() - t1:.1f}s")
         # per-grid binned AuPR on HOST from the materialized score
         # column (~330 MB/fold): exact sorts serialize on TPU at 10M
         # rows, and fresh chunked-scan metric programs hung the remote
@@ -765,18 +783,18 @@ def run_big(platform: str, payload: dict) -> None:
         # (Materialization here also absorbs the async fit/predict
         # execution time — the tunnel defers work past
         # block_until_ready, so the per-phase notes above understate.)
-        t1 = time.time()
+        t1 = time.perf_counter()
         scores_np = np.asarray(probs[:, :, 1], np.float32)  # (8, n)
         vmask = np.asarray(V_np[f])
         lr_metrics[:, f] = [
             _host_binned_aupr(y, scores_np[gi], vmask.astype(np.float64))
             for gi in range(8)]
-        note(f"LR fold {f} metric+materialize {time.time() - t1:.1f}s")
+        note(f"LR fold {f} metric+materialize {time.perf_counter() - t1:.1f}s")
         del probs, wf
         folds_done += 1
         if f == 0:
             winner = params
-    t_lr_sweep = time.time() - t0
+    t_lr_sweep = time.perf_counter() - t0
     best_lr_aupr = float(
         lr_metrics[:, :folds_done].mean(axis=1).max()) if folds_done else 0.0
     payload["big_lr_sweep24_s"] = round(t_lr_sweep, 1)
@@ -788,11 +806,11 @@ def run_big(platform: str, payload: dict) -> None:
     W1 = winner["W"][:1]
     b1 = winner["b"][:1]
     jax.block_until_ready(bd.predict_logreg_grids_big(W1, b1, X16))
-    t0 = time.time()
+    t0 = time.perf_counter()
     scores1 = bd.predict_logreg_grids_big(W1, b1, X16)
     jax.block_until_ready(scores1)
     np.asarray(scores1[:, :1, 1])  # host materialization ends the timing
-    t_score = time.time() - t0
+    t_score = time.perf_counter() - t0
     payload["big_score_rows_per_sec"] = round(n_rows / t_score, 1)
 
     # replace the estimated LR leg of the extrapolation with the
@@ -831,7 +849,7 @@ def run_serving() -> None:
     vec = transmogrify(preds)
     pred = OpLogisticRegression(max_iter=40).set_input(
         label, vec).get_output()
-    t0 = time.time()
+    t0 = time.perf_counter()
     model = Workflow().set_result_features(pred, label) \
         .set_input_dataset(ds).train()
     rows = ds.to_rows()
@@ -841,7 +859,7 @@ def run_serving() -> None:
         model.save(tmp)
         version = model_fingerprint(tmp)
         _emit({"metric": "serve_setup_s", "platform": platform,
-               "value": round(time.time() - t0, 2), "unit": "s",
+               "value": round(time.perf_counter() - t0, 2), "unit": "s",
                "vs_baseline": 0.0, "model_version": version})
         for max_batch in (8, 32, 128):
             if _remaining() < duration_s + 30.0:
@@ -852,13 +870,13 @@ def run_serving() -> None:
             svc = ScoringService.from_path(tmp, config=ServingConfig(
                 max_batch=max_batch, batch_wait_ms=1.0, max_queue=1024))
             svc.start()
-            stop_at = time.time() + duration_s
+            stop_at = time.perf_counter() + duration_s
             sent = [0] * n_clients
             errors = [0] * n_clients
 
             def client(i: int) -> None:
                 rng = np.random.default_rng(i)
-                while time.time() < stop_at:
+                while time.perf_counter() < stop_at:
                     k = int(rng.integers(1, 5))  # mixed request sizes
                     batch = [rows[int(j)] for j in
                              rng.integers(0, len(rows), size=k)]
@@ -870,12 +888,12 @@ def run_serving() -> None:
 
             threads = [threading.Thread(target=client, args=(i,))
                        for i in range(n_clients)]
-            t1 = time.time()
+            t1 = time.perf_counter()
             for th in threads:
                 th.start()
             for th in threads:
                 th.join()
-            wall = time.time() - t1
+            wall = time.perf_counter() - t1
             reg = svc.registry.to_json()
             lat = reg["serving_request_latency_seconds"]["series"][0]
             pad = reg.get("serving_padded_rows_total",
@@ -897,6 +915,15 @@ def run_serving() -> None:
 
 
 def main() -> None:
+    global _BENCH_ROOT, _BENCH_ROOT_CM
+    # root span for the whole bench: main-thread phase spans (train,
+    # ingest pipelines, sweeps) nest under it via the context var and the
+    # goodput rollup in _emit reads its subtree. Deliberately never
+    # exited — the report treats "now" as the end of a live root.
+    from transmogrifai_tpu.obs.trace import TRACER as _TRACER
+    _BENCH_ROOT_CM = _TRACER.span("run:bench", category="run",
+                                  new_trace=True)
+    _BENCH_ROOT = _BENCH_ROOT_CM.__enter__()
     if "serve" in sys.argv[1:]:
         try:
             run_serving()

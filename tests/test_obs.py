@@ -1,0 +1,676 @@
+"""obs/ — unified observability layer: hierarchical span tracing,
+Perfetto export, goodput accounting, process-wide metrics registry.
+
+The marquee test (`TestUnifiedTimeline`) is the PR's acceptance
+criterion: ONE trace in which fault-injected ingest retries, a killed
+and resumed sweep, retry-backoff spans, and recompile events all nest
+under a single run root span — and the GoodputReport attributes each
+injected badput to its bucket.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.obs import export as obsx
+from transmogrifai_tpu.obs import goodput as obsg
+from transmogrifai_tpu.obs.metrics import (
+    Histogram, MetricsRegistry, get_registry)
+from transmogrifai_tpu.obs.trace import TRACER, Span, Tracer, add_event
+
+
+# --------------------------------------------------------------------- #
+# span tracer                                                           #
+# --------------------------------------------------------------------- #
+
+class TestSpans:
+    def test_nesting_via_contextvar(self):
+        tr = Tracer()
+        with tr.span("outer") as outer:
+            with tr.span("inner") as inner:
+                assert tr.current() is inner
+            assert tr.current() is outer
+        assert tr.current() is None
+        assert inner.parent_id == outer.span_id
+        assert inner.trace_id == outer.trace_id
+
+    def test_new_trace_roots_fresh_correlation_id(self):
+        tr = Tracer()
+        with tr.span("a") as a:
+            with tr.span("b", new_trace=True) as b:
+                pass
+        assert b.parent_id is None
+        assert b.trace_id != a.trace_id
+
+    def test_new_trace_accepts_explicit_id(self):
+        # the runner passes its run_id so trace/profile/event-log share it
+        tr = Tracer()
+        with tr.span("r", new_trace=True, trace_id="run-7") as r:
+            with tr.span("c") as c:
+                pass
+        assert r.trace_id == "run-7"
+        assert c.trace_id == "run-7"
+
+    def test_attributes_and_events(self):
+        tr = Tracer()
+        with tr.span("s", category="test", k=1) as sp:
+            sp.set(rows=10)
+            sp.event("marker", x=2)
+        j = sp.to_json()
+        assert j["attributes"] == {"k": 1, "rows": 10}
+        assert j["events"][0]["name"] == "marker"
+        assert j["events"][0]["x"] == 2
+        assert 0 <= j["events"][0]["offset_s"]
+
+    def test_error_recorded_and_reraised(self):
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("boom") as sp:
+                raise ValueError("bad")
+        assert sp.error == "ValueError: bad"
+        assert sp.end_s is not None
+        assert tr.current() is None  # context restored despite the raise
+
+    def test_base_exception_recorded(self):
+        from transmogrifai_tpu.runtime.faults import InjectedKill
+        tr = Tracer()
+        with pytest.raises(InjectedKill):
+            with tr.span("killed") as sp:
+                raise InjectedKill("site", 1)
+        assert "InjectedKill" in sp.error
+
+    def test_explicit_parent_across_threads(self):
+        tr = Tracer()
+        got = {}
+
+        def worker(parent):
+            # a fresh thread has NO inherited context...
+            got["inherited"] = tr.current()
+            with tr.span("child", parent=parent) as c:
+                got["child"] = c
+
+        with tr.span("root") as root:
+            t = threading.Thread(target=worker, args=(root,))
+            t.start()
+            t.join()
+        assert got["inherited"] is None
+        assert got["child"].parent_id == root.span_id
+        assert got["child"].trace_id == root.trace_id
+        assert got["child"].thread_id != root.thread_id
+
+    def test_trace_spans_filters_and_sorts(self):
+        tr = Tracer()
+        with tr.span("r", new_trace=True) as r:
+            with tr.span("c1"):
+                pass
+            with tr.span("c2"):
+                pass
+        with tr.span("other", new_trace=True):
+            pass
+        spans = tr.trace_spans(r.trace_id)
+        assert [s.name for s in spans] == ["r", "c1", "c2"]
+
+    def test_live_spans_included(self):
+        tr = Tracer()
+        with tr.span("open", new_trace=True) as r:
+            spans = tr.trace_spans(r.trace_id)
+            assert [s.name for s in spans] == ["open"]
+            assert spans[0].duration_s >= 0.0  # live: end = now
+
+    def test_bounded_ring_counts_drops(self):
+        tr = Tracer(max_spans=4)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.spans()) == 4
+        assert tr.dropped == 6
+
+    def test_add_event_noop_without_span(self):
+        assert add_event("orphan") in (False, True)  # must not raise
+
+    def test_duration_uses_monotonic_clock(self, monkeypatch):
+        # a wall-clock step mid-span must not corrupt the duration
+        monkeypatch.setattr(time, "time", lambda: 4e9)
+        tr = Tracer()
+        with tr.span("steady") as sp:
+            pass
+        assert sp.duration_s < 1.0
+
+
+# --------------------------------------------------------------------- #
+# chrome-trace / Perfetto export + event log                            #
+# --------------------------------------------------------------------- #
+
+class TestExport:
+    def _tree(self):
+        tr = Tracer()
+        with tr.span("root", new_trace=True, run_id="abc") as root:
+            with tr.span("child", category="phase") as c:
+                c.event("recompile", trace_s=0.01)
+        return root, tr.trace_spans(root.trace_id)
+
+    def test_chrome_trace_shape(self):
+        root, spans = self._tree()
+        obj = obsx.chrome_trace(spans)
+        assert obj["traceEvents"]
+        xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"root", "child"}
+        child = next(e for e in xs if e["name"] == "child")
+        assert child["args"]["parent_id"] == root.span_id
+        assert child["cat"] == "phase"
+        instants = [e for e in obj["traceEvents"] if e["ph"] == "i"]
+        assert instants and instants[0]["name"] == "recompile"
+        metas = [e for e in obj["traceEvents"] if e["ph"] == "M"]
+        assert any(e["name"] == "process_name" for e in metas)
+        assert any(e["name"] == "thread_name" for e in metas)
+
+    def test_validate_accepts_good_trace(self):
+        _, spans = self._tree()
+        assert obsx.validate_chrome_trace(obsx.chrome_trace(spans)) == []
+
+    def test_validate_rejects_bad_traces(self):
+        assert obsx.validate_chrome_trace({}) != []
+        assert obsx.validate_chrome_trace({"traceEvents": []}) != []
+        bad_ts = {"traceEvents": [
+            {"ph": "X", "name": "x", "ts": -5, "dur": 1, "pid": 0,
+             "tid": 0, "args": {"span_id": 1, "parent_id": None}}]}
+        assert any("ts" in p for p in obsx.validate_chrome_trace(bad_ts))
+        orphan = {"traceEvents": [
+            {"ph": "X", "name": "x", "ts": 0, "dur": 10, "pid": 0,
+             "tid": 0, "args": {"span_id": 1, "parent_id": 99}}]}
+        assert any("parent" in p for p in obsx.validate_chrome_trace(orphan))
+        outside = {"traceEvents": [
+            {"ph": "X", "name": "p", "ts": 0, "dur": 10, "pid": 0,
+             "tid": 0, "args": {"span_id": 1, "parent_id": None}},
+            {"ph": "X", "name": "c", "ts": 50_000, "dur": 10, "pid": 0,
+             "tid": 0, "args": {"span_id": 2, "parent_id": 1}}]}
+        assert any("outside parent" in p
+                   for p in obsx.validate_chrome_trace(outside))
+
+    def test_write_and_reload(self, tmp_path):
+        _, spans = self._tree()
+        path = obsx.write_chrome_trace(str(tmp_path / "t.json"), spans)
+        with open(path) as f:
+            assert obsx.validate_chrome_trace(json.load(f)) == []
+
+    def test_event_log_correlation_ids(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        log = obsx.EventLog(path, run_id="run-42")
+        obsx.install_event_log(log)
+        try:
+            obsx.emit_event("retry", site="ingest", attempt=1)
+            obsx.emit_event("fault", site="sweep.run_block")
+        finally:
+            obsx.uninstall_event_log(log)
+            log.close()
+        obsx.emit_event("dropped")  # uninstalled: must be a no-op
+        recs = [json.loads(line) for line in open(path)]
+        assert [r["kind"] for r in recs] == ["retry", "fault"]
+        assert all(r["run_id"] == "run-42" for r in recs)
+        assert all("ts" in r for r in recs)
+
+    def test_uninstall_only_clears_own_log(self, tmp_path):
+        outer = obsx.EventLog(str(tmp_path / "a.jsonl"), run_id="a")
+        stale = obsx.EventLog(str(tmp_path / "b.jsonl"), run_id="b")
+        obsx.install_event_log(outer)
+        try:
+            obsx.uninstall_event_log(stale)  # not installed: no effect
+            assert obsx.active_event_log() is outer
+        finally:
+            obsx.uninstall_event_log(outer)
+            outer.close()
+            stale.close()
+
+
+# --------------------------------------------------------------------- #
+# goodput accounting                                                    #
+# --------------------------------------------------------------------- #
+
+class TestGoodput:
+    def test_buckets_sum_to_wall(self):
+        tr = Tracer()
+        with tr.span("run", new_trace=True) as root:
+            with tr.span("retry:x", category="retry"):
+                time.sleep(0.02)
+            with tr.span("ingest:m", category="ingest") as ing:
+                ing.set(upload_wait_s=0.005)
+            root.event("recompile", trace_s=0.003)
+            time.sleep(0.01)
+        report = obsg.build_report(root, tr.trace_spans(root.trace_id))
+        assert report.wall_s > 0
+        assert report.buckets["retry_backoff_s"] >= 0.015
+        assert report.buckets["ingest_wait_s"] == pytest.approx(0.005)
+        assert report.buckets["recompile_s"] == pytest.approx(0.003)
+        assert sum(report.buckets.values()) == pytest.approx(
+            report.wall_s, rel=1e-6)
+        assert 0.0 <= report.goodput_frac < 1.0
+        assert report.counts["retries"] == 1
+        assert report.counts["recompiles"] == 1
+
+    def test_savings_and_redo_events(self):
+        tr = Tracer()
+        with tr.span("run", new_trace=True) as root:
+            root.event("journal_resume", blocks=3, saved_s=1.5)
+            root.event("oom_redo", wasted_s=0.01)
+            root.event("fault", site="s")
+            time.sleep(0.03)  # badput must fit inside wall (no clamping)
+        report = obsg.build_report(root, tr.trace_spans(root.trace_id))
+        assert report.savings["resume_saved_s"] == pytest.approx(1.5)
+        assert report.counts["resumed_blocks"] == 3
+        assert report.buckets["oom_redo_s"] == pytest.approx(0.01)
+        assert report.counts["faults_injected"] == 1
+
+    def test_overlapped_badput_clamped_to_wall(self):
+        # worker-thread backoffs can overlap: badput must not exceed wall
+        tr = Tracer()
+        with tr.span("run", new_trace=True) as root:
+            spans = []
+            threads = [threading.Thread(
+                target=lambda: spans.append(None) or time.sleep(0.03))
+                for _ in range(1)]
+            with tr.span("retry:a", category="retry", parent=root):
+                time.sleep(0.01)
+        # synthesize a second overlapping retry span wider than wall
+        fake = Span("retry:b", category="retry", parent=root)
+        fake.end_s = fake.start_s + 10 * root.duration_s
+        report = obsg.build_report(
+            root, list(tr.trace_spans(root.trace_id)) + [fake])
+        assert sum(report.buckets.values()) == pytest.approx(
+            report.wall_s, rel=1e-6)
+        assert report.buckets["productive_s"] >= 0.0
+
+    def test_report_json_shape(self):
+        tr = Tracer()
+        with tr.span("run", new_trace=True) as root:
+            pass
+        j = obsg.build_report(root, []).to_json()
+        assert set(j) == {"wall_s", "trace_id", "goodput_frac", "buckets",
+                          "savings", "counts"}
+        assert "productive_s" in j["buckets"]
+
+
+# --------------------------------------------------------------------- #
+# metrics registry: exposition + concurrency (satellite)                #
+# --------------------------------------------------------------------- #
+
+class TestMetricsExposition:
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("esc_total", "h", path='a"b\\c\nd').inc()
+        text = reg.to_prometheus()
+        series = [ln for ln in text.splitlines()
+                  if ln.startswith("esc_total{")]
+        assert len(series) == 1  # the newline in the value did not split
+        assert r'path="a\"b\\c\nd"' in series[0]
+
+    def test_help_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("h_total", "line1\nline2 \\ backslash").inc()
+        help_lines = [ln for ln in reg.to_prometheus().splitlines()
+                      if ln.startswith("# HELP")]
+        assert help_lines == [r"# HELP h_total line1\nline2 \\ backslash"]
+
+    def test_histogram_bucket_ordering(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "h", bounds=(0.1, 0.5, 2.0))
+        for v in (0.05, 0.3, 0.3, 1.0, 99.0):
+            h.observe(v)
+        pairs = h.bucket_counts()
+        assert [b for b, _ in pairs] == [0.1, 0.5, 2.0, float("inf")]
+        counts = [c for _, c in pairs]
+        assert counts == sorted(counts), "cumulative counts must not dip"
+        assert pairs[-1] == (float("inf"), 5)
+        # text form: ascending le with +Inf last, _count matches
+        lines = [ln for ln in reg.to_prometheus().splitlines()
+                 if ln.startswith("lat_seconds_bucket")]
+        assert [ln.rsplit(" ", 1)[1] for ln in lines] == \
+            ["1", "3", "4", "5"]
+        assert 'le="+Inf"' in lines[-1]
+        assert "lat_seconds_count 5" in reg.to_prometheus()
+
+    def test_concurrent_counter_and_histogram(self):
+        reg = MetricsRegistry()
+        c = reg.counter("conc_total")
+        h = reg.histogram("conc_seconds", bounds=(0.5, 1.0))
+        n_threads, per = 8, 500
+
+        def work(i):
+            for k in range(per):
+                c.inc()
+                h.observe((i + k) % 2)
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * per
+        assert h.count == n_threads * per
+        assert h.bucket_counts()[-1][1] == n_threads * per
+
+    def test_concurrent_family_registration(self):
+        reg = MetricsRegistry()
+        out = []
+
+        def grab():
+            out.append(reg.counter("same_total", "h", shard="x"))
+
+        threads = [threading.Thread(target=grab) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(o is out[0] for o in out), "one series, not eight"
+
+    def test_serving_import_path_still_works(self):
+        # the compatibility contract: serving.metrics is obs.metrics
+        from transmogrifai_tpu.obs import metrics as om
+        from transmogrifai_tpu.serving import metrics as sm
+        assert sm.MetricsRegistry is om.MetricsRegistry
+        assert sm.Histogram is om.Histogram
+        assert sm.REGISTRY is om.REGISTRY
+
+    def test_serve_metrics_surface_includes_global_registry(self):
+        # /metrics = service registry + process-global obs registry
+        from transmogrifai_tpu.serving.http import metrics_json, metrics_text
+
+        class _Stub:
+            registry = MetricsRegistry()
+
+        svc = _Stub()
+        svc.registry.counter("serving_requests_total", "h").inc(3)
+        get_registry().counter(
+            "train_stages_fitted_total", "estimators fitted").inc(0)
+        get_registry().counter(
+            "ingest_chunks_total", "chunks").inc(0)
+        text = metrics_text(svc)
+        assert "serving_requests_total 3.0" in text
+        assert "train_stages_fitted_total" in text
+        assert "ingest_chunks_total" in text
+        merged = metrics_json(svc)
+        assert "serving_requests_total" in merged
+        assert "train_stages_fitted_total" in merged
+
+
+# --------------------------------------------------------------------- #
+# RunProfile satellites                                                 #
+# --------------------------------------------------------------------- #
+
+class TestRunProfile:
+    def test_phase_records_on_error_and_reraises(self):
+        from transmogrifai_tpu.utils.profiling import RunProfile
+        prof = RunProfile(run_type="t")
+        with pytest.raises(RuntimeError):
+            with prof.phase("Training", n_rows=5):
+                raise RuntimeError("fit exploded")
+        assert len(prof.phases) == 1
+        p = prof.phases[0]
+        assert p.name == "Training"
+        assert p.extra["n_rows"] == 5
+        assert p.extra["error"] == "RuntimeError: fit exploded"
+        assert p.duration_s >= 0.0
+
+    def test_phase_records_on_injected_kill(self):
+        from transmogrifai_tpu.runtime.faults import InjectedKill
+        from transmogrifai_tpu.utils.profiling import RunProfile
+        prof = RunProfile(run_type="t")
+        with pytest.raises(InjectedKill):
+            with prof.phase("Training"):
+                raise InjectedKill("site", 1)
+        assert prof.phases and "InjectedKill" in prof.phases[0].extra["error"]
+
+    def test_durations_survive_wall_clock_steps(self, monkeypatch):
+        from transmogrifai_tpu.utils import profiling
+        prof = profiling.RunProfile(run_type="t")
+        # simulate an NTP step: wall clock jumps forward mid-phase
+        monkeypatch.setattr(profiling.time, "time",
+                            lambda: 4_000_000_000.0)
+        with prof.phase("Scoring"):
+            pass
+        assert prof.phases[0].duration_s < 1.0
+        assert prof.app_duration_s < 60.0
+        # started_at stays an epoch TIMESTAMP (set before the patch)
+        assert prof.started_at < 4_000_000_000.0
+
+    def test_phase_opens_obs_span(self):
+        from transmogrifai_tpu.utils.profiling import RunProfile
+        prof = RunProfile(run_type="t")
+        with TRACER.span("root", new_trace=True) as root:
+            with prof.phase("Scoring"):
+                pass
+        names = [s.name for s in TRACER.trace_spans(root.trace_id)]
+        assert "phase:Scoring" in names
+
+    def test_to_json_carries_run_id_and_goodput(self):
+        from transmogrifai_tpu.utils.profiling import RunProfile
+        prof = RunProfile(run_type="t", run_id="r-1")
+        prof.goodput = {"wall_s": 1.0}
+        j = prof.to_json()
+        assert j["run_id"] == "r-1"
+        assert j["goodput"] == {"wall_s": 1.0}
+
+
+# --------------------------------------------------------------------- #
+# lint L009 (satellite)                                                 #
+# --------------------------------------------------------------------- #
+
+class TestLintL009:
+    def _lint(self, src):
+        from transmogrifai_tpu.analysis.lint import lint_source
+        return [f for f in lint_source(src) if f.code == "L009"]
+
+    def test_flags_duration_subtraction(self):
+        src = ("import time\n"
+               "def f():\n"
+               "    t0 = time.time()\n"
+               "    return time.time() - t0\n")
+        assert len(self._lint(src)) == 1
+
+    def test_flags_aliased_module(self):
+        src = ("import time as _time\n"
+               "def f(t0):\n"
+               "    return _time.time() - t0\n")
+        assert len(self._lint(src)) == 1
+
+    def test_flags_either_operand(self):
+        src = ("import time\n"
+               "def f(deadline):\n"
+               "    return deadline - time.time()\n")
+        assert len(self._lint(src)) == 1
+
+    def test_timestamps_and_methods_are_fine(self):
+        src = ("import time, datetime\n"
+               "def f(x):\n"
+               "    stamp = time.time()\n"
+               "    t = datetime.datetime.now().time()\n"
+               "    d = x - perf()\n"
+               "    return stamp\n")
+        assert self._lint(src) == []
+
+    def test_repo_is_clean(self):
+        import os
+        from transmogrifai_tpu.analysis.lint import lint_paths
+        pkg = os.path.join(os.path.dirname(__file__), "..",
+                           "transmogrifai_tpu")
+        assert [f for f in lint_paths([pkg]) if f.code == "L009"] == []
+
+
+# --------------------------------------------------------------------- #
+# the acceptance criterion: one unified timeline                        #
+# --------------------------------------------------------------------- #
+
+def _sweep_inputs(n=160, seed=3):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(seed)
+    X = jnp.asarray(rng.normal(size=(n, 5)).astype(np.float32))
+    y = jnp.asarray((rng.normal(size=n) > 0).astype(np.float32))
+    folds = [((np.arange(n) % 2 != f).astype(np.float32),
+              (np.arange(n) % 2 == f).astype(np.float32))
+             for f in range(2)]
+    return X, y, folds
+
+
+class TestUnifiedTimeline:
+    @pytest.fixture(autouse=True)
+    def _fresh_tracer(self):
+        TRACER.reset()
+        yield
+        TRACER.reset()
+
+    def test_faulted_train_run_yields_one_attributed_trace(self, tmp_path):
+        """Fault-injected ingest retries + a killed-then-resumed sweep,
+        all inside one root span: ingest worker spans, sweep-block
+        spans, retry-backoff spans, and recompile events parent under
+        the run root, and the GoodputReport lands each injected badput
+        in its bucket."""
+        from transmogrifai_tpu.data.pipeline import run_chunk_pipeline
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.models import OpLogisticRegression
+        from transmogrifai_tpu.parallel.sweep import run_sweep
+        from transmogrifai_tpu.runtime.faults import (
+            SITE_READ_CHUNK, SITE_RUN_BLOCK, FaultPlan, FaultSpec,
+            InjectedKill)
+        from transmogrifai_tpu.runtime.journal import SweepJournal
+        from transmogrifai_tpu.runtime.retry import RetryPolicy
+        from transmogrifai_tpu.stages.base import FitContext
+
+        X, y, folds = _sweep_inputs()
+        ev = BinaryClassificationEvaluator()
+        ctx = FitContext(n_rows=int(X.shape[0]), seed=7)
+        # two static groups (max_iter 8 vs 4): two journal blocks
+        grids = [{"reg_param": 0.01, "max_iter": 8},
+                 {"reg_param": 0.1, "max_iter": 8},
+                 {"reg_param": 0.02, "max_iter": 4}]
+        jpath = str(tmp_path / "sweep.journal")
+
+        with TRACER.span("run:train", category="run",
+                         new_trace=True) as root:
+            # 1) ingest with a transient read fault -> retried chunk
+            chunks = [np.full((8, 4), i, np.float32) for i in range(4)]
+            plan = FaultPlan([FaultSpec(SITE_READ_CHUNK, at=2,
+                                        kind="error", transient=True)])
+            policy = RetryPolicy(max_attempts=3, base_delay_s=0.02,
+                                 jitter=0.0, seed=1)
+            with plan.active():
+                stats = run_chunk_pipeline(
+                    chunks, prepare=lambda c: c * 2.0,
+                    upload=lambda c: None, workers=2, depth=1,
+                    label="test", retry=policy)
+            assert stats.retries == 1
+
+            # 2) sweep killed at block 2 (block 1 journals), then resumed
+            plan = FaultPlan([FaultSpec(SITE_RUN_BLOCK, at=2,
+                                        kind="kill")])
+            with pytest.raises(InjectedKill):
+                with plan.active():
+                    run_sweep(OpLogisticRegression(), grids, X, y,
+                              folds, ev, ctx,
+                              journal=SweepJournal(jpath))
+            resumed = run_sweep(OpLogisticRegression(), grids, X, y,
+                                folds, ev, ctx,
+                                journal=SweepJournal(jpath))
+            assert all(row is not None for row in resumed)
+
+        spans = TRACER.trace_spans(root.trace_id)
+        by_cat = {}
+        for s in spans:
+            by_cat.setdefault(s.category, []).append(s)
+
+        # every span reaches the root through parent links
+        by_id = {s.span_id: s for s in spans}
+
+        def reaches_root(s):
+            while s.parent_id is not None:
+                s = by_id[s.parent_id]
+            return s.span_id == root.span_id
+
+        assert all(reaches_root(s) for s in spans)
+
+        # ingest worker spans under the ingest span under the root (the
+        # retried chunk keeps ONE span — the retry loop runs inside it)
+        assert by_cat["ingest"], "no ingest span"
+        workers = by_cat["ingest_chunk"]
+        assert len(workers) == len(chunks)
+        ing = by_cat["ingest"][0]
+        assert all(w.parent_id == ing.span_id for w in workers)
+
+        # retry-backoff span nests under the retried worker chunk
+        retries = by_cat["retry"]
+        assert len(retries) == 1
+        assert by_id[retries[0].parent_id].category == "ingest_chunk"
+
+        # sweep blocks (killed run + resumed run) under the root
+        blocks = by_cat["sweep"]
+        assert len(blocks) >= 2
+        killed = [b for b in blocks if b.error
+                  and "InjectedKill" in b.error]
+        assert killed, "the killed block span must record the kill"
+
+        # recompile events fired inside sweep blocks
+        recompiles = [e for s in spans for e in s.events
+                      if e[0] == "recompile"]
+        assert recompiles, "sweep compiles must emit recompile events"
+
+        # resume event credits the journal with the skipped block
+        resumes = [e for s in spans + [root] for e in s.events
+                   if e[0] == "journal_resume"]
+        assert resumes
+        assert resumes[0][2]["blocks"] >= 1
+        assert resumes[0][2]["saved_s"] > 0.0
+
+        # goodput: injected badput lands in the right buckets
+        report = obsg.build_report(root, spans)
+        assert report.buckets["retry_backoff_s"] > 0.0
+        assert report.buckets["fault_redo_s"] > 0.0  # the failed attempt
+        assert report.buckets["recompile_s"] > 0.0
+        assert report.counts["retries"] == 1
+        assert report.counts["resumed_blocks"] >= 1
+        assert report.savings["resume_saved_s"] > 0.0
+        assert report.counts["faults_injected"] >= 1
+        assert sum(report.buckets.values()) == pytest.approx(
+            report.wall_s, rel=1e-6)
+
+        # and the whole thing round-trips through the Perfetto exporter
+        obj = obsx.chrome_trace([root] + spans)
+        assert obsx.validate_chrome_trace(obj) == []
+
+    def test_oom_halving_attributed_to_oom_redo(self):
+        from transmogrifai_tpu.evaluators import (
+            BinaryClassificationEvaluator)
+        from transmogrifai_tpu.models import OpLogisticRegression
+        from transmogrifai_tpu.parallel.sweep import run_sweep
+        from transmogrifai_tpu.runtime.faults import (
+            SITE_RUN_BLOCK, FaultPlan, FaultSpec)
+        from transmogrifai_tpu.stages.base import FitContext
+
+        X, y, folds = _sweep_inputs(seed=5)
+        ev = BinaryClassificationEvaluator()
+        ctx = FitContext(n_rows=int(X.shape[0]), seed=7)
+        grids = [{"reg_param": 0.01, "max_iter": 6},
+                 {"reg_param": 0.1, "max_iter": 6}]
+        plan = FaultPlan([FaultSpec(SITE_RUN_BLOCK, at=1, kind="oom")])
+        with TRACER.span("run:train", category="run",
+                         new_trace=True) as root:
+            with plan.active():
+                out = run_sweep(OpLogisticRegression(), grids, X, y,
+                                folds, ev, ctx)
+        assert all(row is not None for row in out)
+        report = obsg.build_report(root, TRACER.trace_spans(root.trace_id))
+        assert report.buckets["oom_redo_s"] > 0.0
+        assert report.counts["oom_redos"] == 1
+
+    def test_journal_duration_roundtrip(self, tmp_path):
+        from transmogrifai_tpu.runtime.journal import SweepJournal
+        path = str(tmp_path / "d.journal")
+        j = SweepJournal(path, meta={"sig": "x"})
+        j.append({"a": 1}, [0.5, 0.6], duration_s=1.25)
+        j.append({"a": 2}, [0.7, 0.8])  # no duration: older-writer shape
+        j2 = SweepJournal(path, meta={"sig": "x"})
+        assert j2.duration_of({"a": 1}) == pytest.approx(1.25)
+        assert j2.duration_of({"a": 2}) == 0.0
+        assert j2.lookup({"a": 1}) == [0.5, 0.6]

@@ -58,6 +58,15 @@ silent slowness or nondeterminism once XLA is in the loop:
   forever; bound it with ``RetryPolicy`` (attempts + backoff +
   transient classification) instead.
 
+- ``L009 wallclock-duration``: subtraction arithmetic on a
+  ``time.time()`` call — the wall-clock-for-durations bug. An NTP step
+  or suspend/resume silently corrupts any interval measured as
+  ``time.time() - t0`` (negative phase timings, goodput buckets that
+  exceed wall time); use ``time.perf_counter()`` (or
+  ``time.monotonic()`` for deadlines). Bare ``time.time()`` reads
+  stay legal: an epoch TIMESTAMP (``started_at``, log stamps) is what
+  the wall clock is for.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -269,6 +278,7 @@ class _FileLinter(ast.NodeVisitor):
         self.findings: List[LintFinding] = []
         self._class_stack: List[ast.ClassDef] = []
         self._classes = classes or {}  # module classes, for base resolution
+        self._time_aliases = {"time"}  # `import time as _time` et al.
 
     def _emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(LintFinding(
@@ -312,6 +322,48 @@ class _FileLinter(ast.NodeVisitor):
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         self._check_swallowed_exception(node)
         self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        self._check_wallclock_duration(node)
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name == "time":
+                self._time_aliases.add(alias.asname or "time")
+        self.generic_visit(node)
+
+    # -- L009 -------------------------------------------------------------- #
+
+    def _is_walltime_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        dotted = _dotted(node.func)
+        if dotted is None:
+            return False
+        # exact module call through any recorded alias of the `time`
+        # module — NOT arbitrary `.time()` methods (datetime.time etc.
+        # must not false-positive)
+        parts = dotted.rsplit(".", 1)
+        return (len(parts) == 2 and parts[0] in self._time_aliases
+                and parts[1] in ("time", "time_ns")) or \
+            dotted.endswith(".time.time")
+
+    def _check_wallclock_duration(self, node: ast.BinOp) -> None:
+        """Subtraction involving a `time.time()` call measures a
+        DURATION on the wall clock: a clock step corrupts it. Timestamps
+        (bare reads) are fine; interval math belongs on
+        `time.perf_counter()`."""
+        if not isinstance(node.op, ast.Sub):
+            return
+        if self._is_walltime_call(node.left) or \
+                self._is_walltime_call(node.right):
+            self._emit(
+                node, "L009",
+                "`time.time()` subtraction measures a duration on the "
+                "wall clock — an NTP step/suspend corrupts it; use "
+                "time.perf_counter() for intervals (keep time.time() "
+                "for epoch timestamps only)")
 
     def visit_While(self, node: ast.While) -> None:
         self._check_unbounded_retry(node)

@@ -46,6 +46,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Optional
 
+from transmogrifai_tpu.obs.metrics import get_registry
+from transmogrifai_tpu.obs.trace import TRACER
 from transmogrifai_tpu.runtime.faults import SITE_READ_CHUNK, fault_point
 
 __all__ = ["IngestStats", "run_chunk_pipeline"]
@@ -196,62 +198,92 @@ def run_chunk_pipeline(items: Iterable[Any],
             prepare_once, label=f"{label}.read_chunk",
             on_attempt=lambda ev: st.note_retry(ev.delay_s))
 
-    t_start = time.perf_counter()
-    it = iter(items)
-    pending: deque = deque()      # prepare futures, submission order
-    in_flight: deque = deque()    # upload completion tokens
-    lookahead = max(1, workers) + max(1, depth)
+    # worker threads do not inherit the caller's span context: each
+    # chunk prepare opens its own span EXPLICITLY parented under the
+    # pipeline's ingest span, so worker rows nest in the run timeline
+    # (and any retry backoff spans opened inside nest under the chunk)
+    with TRACER.span(f"ingest:{label}", category="ingest",
+                     workers=workers, depth=depth) as ingest_span:
+        def worker_task(item):
+            with TRACER.span("ingest:chunk", category="ingest_chunk",
+                             parent=ingest_span):
+                return prepare_task(item)
 
-    def elapsed() -> float:
-        return time.perf_counter() - t_start
+        t_start = time.perf_counter()
+        it = iter(items)
+        pending: deque = deque()      # prepare futures, submission order
+        in_flight: deque = deque()    # upload completion tokens
+        lookahead = max(1, workers) + max(1, depth)
 
-    pool = ThreadPoolExecutor(max_workers=max(1, workers))
-    try:
-        def fill() -> None:
-            while len(pending) < lookahead:
-                try:
-                    item = next(it)
-                except StopIteration:
-                    return
-                pending.append(pool.submit(prepare_task, item))
+        def elapsed() -> float:
+            return time.perf_counter() - t_start
 
-        fill()
-        i = 0
-        while pending:
-            prepared = pending.popleft().result()  # re-raises worker errors
+        pool = ThreadPoolExecutor(max_workers=max(1, workers))
+        try:
+            def fill() -> None:
+                while len(pending) < lookahead:
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        return
+                    pending.append(pool.submit(worker_task, item))
+
             fill()
-            if deadline_s is not None and elapsed() > deadline_s:
-                raise TimeoutError(
-                    f"{label} past {deadline_s:.0f}s deadline at chunk "
-                    f"{i} ({elapsed():.1f}s elapsed)")
-            t0 = time.perf_counter()
-            token = upload(prepared)
-            st.dispatch_s += time.perf_counter() - t0
-            i += 1
-            if token is not None:
-                in_flight.append(token)
-                while len(in_flight) > max(1, depth):
-                    t0 = time.perf_counter()
-                    _block(in_flight.popleft())
-                    st.upload_wait_s += time.perf_counter() - t0
-                st.max_in_flight = max(st.max_in_flight, len(in_flight))
-        # drain: the last token's readiness implies the final write
-        # landed, so the recorded wall time is true transfer time and
-        # the caller's buffer needs no separate block_until_ready
-        while in_flight:
-            t0 = time.perf_counter()
-            _block(in_flight.popleft())
-            st.upload_wait_s += time.perf_counter() - t0
-    except BaseException:
-        # a deadline/worker error must surface NOW: without
-        # cancel_futures the pool shutdown would sit through up to
-        # `lookahead` queued multi-hundred-MB reads — eating exactly the
-        # budget reserve the deadline protects
-        pool.shutdown(wait=False, cancel_futures=True)
-        raise
-    finally:
-        pool.shutdown(wait=True)
-    st.wall_s = elapsed()
+            i = 0
+            while pending:
+                prepared = pending.popleft().result()  # re-raises worker errors
+                fill()
+                if deadline_s is not None and elapsed() > deadline_s:
+                    raise TimeoutError(
+                        f"{label} past {deadline_s:.0f}s deadline at chunk "
+                        f"{i} ({elapsed():.1f}s elapsed)")
+                t0 = time.perf_counter()
+                token = upload(prepared)
+                st.dispatch_s += time.perf_counter() - t0
+                i += 1
+                if token is not None:
+                    in_flight.append(token)
+                    while len(in_flight) > max(1, depth):
+                        t0 = time.perf_counter()
+                        _block(in_flight.popleft())
+                        st.upload_wait_s += time.perf_counter() - t0
+                    st.max_in_flight = max(st.max_in_flight, len(in_flight))
+            # drain: the last token's readiness implies the final write
+            # landed, so the recorded wall time is true transfer time and
+            # the caller's buffer needs no separate block_until_ready
+            while in_flight:
+                t0 = time.perf_counter()
+                _block(in_flight.popleft())
+                st.upload_wait_s += time.perf_counter() - t0
+        except BaseException:
+            # a deadline/worker error must surface NOW: without
+            # cancel_futures the pool shutdown would sit through up to
+            # `lookahead` queued multi-hundred-MB reads — eating exactly the
+            # budget reserve the deadline protects
+            pool.shutdown(wait=False, cancel_futures=True)
+            raise
+        finally:
+            pool.shutdown(wait=True)
+            st.wall_s = elapsed()
+            # the span carries the stats the goodput rollup reads
+            # (upload_wait_s → ingest-wait badput) and the process-wide
+            # registry gets the cumulative ingest counters the serving
+            # /metrics surface exposes
+            ingest_span.set(**st.to_extra())
+            reg = get_registry()
+            reg.counter("ingest_chunks_total",
+                        "chunks driven through run_chunk_pipeline"
+                        ).inc(st.chunks)
+            reg.counter("ingest_bytes_wire_total",
+                        "bytes shipped host->device by pipelined ingest"
+                        ).inc(st.bytes_wire)
+            reg.counter("ingest_upload_wait_seconds_total",
+                        "main-thread seconds blocked on device tokens"
+                        ).inc(st.upload_wait_s)
+            if st.retries:
+                reg.counter("ingest_retries_total",
+                            "transient chunk-read retries"
+                            ).inc(st.retries)
     return st
 
 

@@ -1,0 +1,44 @@
+"""obs/ — unified observability: span tracing, trace export, goodput
+accounting, and the process-wide metrics registry.
+
+The reference's `OpSparkListener` gave every run one coherent per-phase
+metrics story; this package is the port's version of that, grown to
+cover what a TPU-first stack actually loses time to (ML Goodput line of
+work, PAPERS.md):
+
+- `trace`   — thread-safe hierarchical `Span` tracer with contextvar
+              propagation; `RunProfile` phases, per-stage DAG fits,
+              ingest workers, sweep blocks, retry backoffs, and serving
+              batches all open spans on the global `TRACER`
+- `export`  — Chrome-trace/Perfetto JSON exporter (+ validation) and a
+              JSONL structured event log with run correlation ids
+- `goodput` — `GoodputReport`: spans + events rolled into productive /
+              recompile / retry-backoff / ingest-wait / OOM-redo
+              buckets that sum to wall time
+- `metrics` — Counter/Gauge/Histogram registry (promoted from
+              `serving/metrics.py`, which re-exports) with a
+              process-global `REGISTRY` the serving `/metrics` surface
+              exposes alongside each service's own
+- `smoke`   — `make trace-smoke`: tiny train+score with `--trace-out`,
+              validates the Perfetto JSON and the goodput rollup
+"""
+
+from transmogrifai_tpu.obs.export import (  # noqa: F401
+    EventLog, chrome_trace, emit_event, install_event_log,
+    uninstall_event_log, validate_chrome_trace, write_chrome_trace)
+from transmogrifai_tpu.obs.goodput import (  # noqa: F401
+    GoodputReport, build_report)
+from transmogrifai_tpu.obs.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, REGISTRY, get_registry)
+from transmogrifai_tpu.obs.trace import (  # noqa: F401
+    Span, TRACER, Tracer, add_event, current_span, get_tracer, new_run_id)
+
+__all__ = [
+    "Span", "Tracer", "TRACER", "add_event", "current_span", "get_tracer",
+    "new_run_id",
+    "EventLog", "chrome_trace", "emit_event", "install_event_log",
+    "uninstall_event_log", "validate_chrome_trace", "write_chrome_trace",
+    "GoodputReport", "build_report",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "get_registry",
+]

@@ -1,0 +1,148 @@
+"""Goodput accounting: roll a span tree into useful-work vs badput buckets.
+
+"ML Productivity Goodput" (arxiv 2502.06982, PAPERS.md) argues the
+metric that matters for accelerator fleets is not FLOPs but the fraction
+of wall-clock spent on USEFUL work — everything else (recompiles,
+retries, redone work, input stalls) is badput that per-op profilers
+never attribute. This module computes that rollup from the spans and
+events the rest of the codebase already emits:
+
+- ``retry_backoff_s``  — time slept between retry attempts
+  (`runtime/retry.py` opens a ``retry:<site>`` span around each
+  backoff);
+- ``recompile_s``      — time spent re-tracing jitted programs
+  (`analysis/retrace.py` emits a ``recompile`` event with the measured
+  trace duration on every jit cache miss);
+- ``ingest_wait_s``    — main-thread time blocked on device completion
+  tokens during pipelined ingest (the `IngestStats.upload_wait_s`
+  attribute on each ingest span);
+- ``oom_redo_s``       — wall time wasted on sweep blocks that died of
+  device OOM before the halved retry succeeded (``oom_redo`` events
+  from `parallel/sweep.py`);
+- ``fault_redo_s``     — wall time of failed attempts that a
+  `RetryPolicy` subsequently retried (``fault_redo`` events: the work
+  is redone, distinct from the backoff sleep);
+- ``productive_s``     — the remainder. Buckets sum to the root span's
+  wall time BY CONSTRUCTION, so "what fraction was useful" is always
+  answerable.
+
+Savings are tracked separately (they are not part of the wall-time
+decomposition): ``resume_saved_s`` sums the journaled durations of
+sweep blocks a resumed run skipped (``journal_resume`` events).
+
+The report lands in `RunProfile.to_json()["goodput"]`, bench payloads,
+and beside the CLI's ``--trace-out`` trace.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Optional
+
+from transmogrifai_tpu.obs.trace import Span
+
+__all__ = ["GoodputReport", "build_report", "BADPUT_BUCKETS"]
+
+BADPUT_BUCKETS = ("retry_backoff_s", "recompile_s", "ingest_wait_s",
+                  "oom_redo_s", "fault_redo_s")
+
+
+@dataclass
+class GoodputReport:
+    """Wall-time decomposition of one trace (one run)."""
+
+    wall_s: float = 0.0
+    trace_id: Optional[str] = None
+    buckets: Dict[str, float] = field(default_factory=dict)
+    savings: Dict[str, float] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def badput_s(self) -> float:
+        return sum(v for k, v in self.buckets.items()
+                   if k != "productive_s")
+
+    @property
+    def goodput_frac(self) -> float:
+        if self.wall_s <= 0.0:
+            return 1.0
+        return max(0.0, min(1.0, self.buckets.get("productive_s", 0.0)
+                            / self.wall_s))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "wall_s": round(self.wall_s, 6),
+            "trace_id": self.trace_id,
+            "goodput_frac": round(self.goodput_frac, 4),
+            "buckets": {k: round(v, 6)
+                        for k, v in sorted(self.buckets.items())},
+            "savings": {k: round(v, 6)
+                        for k, v in sorted(self.savings.items())},
+            "counts": dict(sorted(self.counts.items())),
+        }
+
+    def pretty(self) -> str:
+        lines = [f"goodput: {self.goodput_frac:.1%} of "
+                 f"{self.wall_s:.2f}s wall"]
+        for k, v in sorted(self.buckets.items()):
+            lines.append(f"  {k}: {v:.3f}s")
+        for k, v in sorted(self.savings.items()):
+            lines.append(f"  (saved) {k}: {v:.3f}s")
+        return "\n".join(lines)
+
+
+def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
+    """Classify `spans` (one trace, root included or not) into goodput
+    buckets against `root`'s wall clock.
+
+    Badput assignment is exclusive by source: a retry span counts its
+    own duration once even when nested inside an ingest worker span
+    (the ingest bucket reads the stats attribute, not span wall time),
+    so buckets never double-count one second of badput."""
+    report = GoodputReport(wall_s=root.duration_s, trace_id=root.trace_id)
+    b = {k: 0.0 for k in BADPUT_BUCKETS}
+    counts = {"retries": 0, "recompiles": 0, "oom_redos": 0,
+              "resumed_blocks": 0, "faults_injected": 0}
+    saved = 0.0
+    seen: set = set()
+    for sp in [root, *spans]:
+        if sp.span_id in seen or sp.trace_id != root.trace_id:
+            continue
+        seen.add(sp.span_id)
+        if sp is not root:  # the root's wall IS the denominator
+            if sp.category == "retry":
+                b["retry_backoff_s"] += sp.duration_s
+                counts["retries"] += 1
+            elif sp.category == "ingest":
+                b["ingest_wait_s"] += float(
+                    sp.attributes.get("upload_wait_s", 0.0) or 0.0)
+        # events count wherever they landed — INCLUDING the root (a
+        # sweep invoked directly under the root attaches its
+        # journal_resume / oom_redo events there)
+        for name, _, attrs in sp.events:
+            if name == "recompile":
+                b["recompile_s"] += float(attrs.get("trace_s", 0.0) or 0.0)
+                counts["recompiles"] += 1
+            elif name == "oom_redo":
+                b["oom_redo_s"] += float(attrs.get("wasted_s", 0.0) or 0.0)
+                counts["oom_redos"] += 1
+            elif name == "fault_redo":
+                b["fault_redo_s"] += float(attrs.get("wasted_s", 0.0) or 0.0)
+            elif name == "journal_resume":
+                saved += float(attrs.get("saved_s", 0.0) or 0.0)
+                counts["resumed_blocks"] += int(attrs.get("blocks", 0) or 0)
+            elif name == "fault":
+                counts["faults_injected"] += 1
+    # badput cannot exceed wall (overlapped worker backoffs can): clamp
+    # proportionally so the decomposition stays a decomposition
+    total_bad = sum(b.values())
+    if total_bad > report.wall_s > 0.0:
+        scale = report.wall_s / total_bad
+        b = {k: v * scale for k, v in b.items()}
+        total_bad = report.wall_s
+    b["productive_s"] = max(0.0, report.wall_s - total_bad)
+    report.buckets = b
+    if saved > 0.0 or counts["resumed_blocks"]:
+        report.savings["resume_saved_s"] = saved
+    report.counts = {k: v for k, v in counts.items() if v}
+    return report

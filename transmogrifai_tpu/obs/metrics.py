@@ -1,0 +1,299 @@
+"""Process-wide runtime metrics registry (promoted from serving/metrics.py).
+
+The ML Goodput line of work (PAPERS.md) argues that fleet efficiency is
+lost to UNTRACKED stalls — queueing, recompiles, shed load — not FLOPs;
+this registry makes those visible. It is deliberately stdlib-only (no
+prometheus_client dependency): Counter / Gauge / Histogram with labels,
+exported two ways from one source of truth:
+
+- ``registry.to_json()``  — structured dict for programmatic checks and
+  the runner's metrics files;
+- ``registry.to_prometheus()`` — the Prometheus text exposition format
+  (``# HELP`` / ``# TYPE`` headers, cumulative ``_bucket{le=...}``
+  series, label values escaped per the spec), scrapeable from the HTTP
+  frontend's ``/metrics``.
+
+Originally this lived in ``serving/`` and only serving counters reached
+the ``/metrics`` surface; train-time ingest uploads, retry pressure, and
+fit counts were invisible to the same scrape. It now lives in ``obs/``
+with a process-global default instance (`REGISTRY` / `get_registry()`)
+that train/ingest/runtime paths register into, and the serving frontend
+exposes alongside each service's own registry. ``serving.metrics``
+re-exports everything for compatibility.
+
+Histograms use fixed log-spaced buckets so p50/p95/p99 estimates are
+O(buckets) with bounded memory — no reservoir, safe under sustained
+traffic. Quantiles interpolate linearly inside the winning bucket.
+
+All mutation is lock-protected: the batcher thread, HTTP worker threads,
+ingest workers, and scrapers hit the same registry concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# default latency ladder (seconds): 100 us .. 60 s, roughly 2-2.5x steps
+DEFAULT_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "DEFAULT_LATENCY_BUCKETS", "REGISTRY", "get_registry"]
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, double quote and
+    newline must be escaped or the series line is unparseable."""
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _escape_help(v: str) -> str:
+    """# HELP lines escape backslash and newline (quotes are legal)."""
+    return v.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    """Monotonic counter (requests, sheds, errors, swaps)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value (queue depth, in-flight batches, versions)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated quantiles.
+
+    `bounds` are the inclusive upper edges of each bucket; an implicit
+    +inf bucket catches the tail. `observe()` is O(buckets) worst case
+    (linear scan — the ladders here are ~20 wide, not worth bisect).
+    """
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError("histogram bounds must be strictly increasing")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)  # +1: the +inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                break
+        else:
+            i = len(self.bounds)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0 < q < 1) from bucket counts, or None
+        when empty. Interpolates within the winning bucket; the +inf
+        bucket reports the observed max (the honest upper bound)."""
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"quantile q must be in (0,1), got {q}")
+        with self._lock:
+            total = self._count
+            if total == 0:
+                return None
+            rank = q * total
+            cum = 0.0
+            for i, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                lo = 0.0 if i == 0 else self.bounds[i - 1]
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else (self._max if self._max is not None else lo))
+                if cum + c >= rank:
+                    frac = (rank - cum) / c
+                    return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+                cum += c
+            return self._max
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            count, total = self._count, self._sum
+            mn, mx = self._min, self._max
+        out: Dict[str, Any] = {
+            "count": count, "sum": round(total, 6),
+            "mean": round(total / count, 6) if count else None,
+            "min": mn, "max": mx,
+        }
+        for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            v = self.quantile(q)
+            out[name] = round(v, 6) if v is not None else None
+        return out
+
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """Cumulative (upper_bound, count) pairs, Prometheus-style, ending
+        with (+inf, total)."""
+        with self._lock:
+            counts = list(self._counts)
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            out.append((b, cum))
+        out.append((float("inf"), cum + counts[-1]))
+        return out
+
+
+class MetricsRegistry:
+    """Named, labeled metric families with dual JSON/Prometheus export."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # name -> {"type", "help", "series": {label_key: metric}}
+        self._families: Dict[str, Dict[str, Any]] = {}
+
+    def _get(self, name: str, mtype: str, help_: str, labels: Dict[str, str],
+             factory):
+        key = _label_key(labels or {})
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = {"type": mtype, "help": help_, "series": {}}
+                self._families[name] = fam
+            elif fam["type"] != mtype:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam['type']}")
+            metric = fam["series"].get(key)
+            if metric is None:
+                metric = factory()
+                fam["series"][key] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                **labels: Any) -> Counter:
+        return self._get(name, "counter", help, labels, Counter)
+
+    def gauge(self, name: str, help: str = "", **labels: Any) -> Gauge:
+        return self._get(name, "gauge", help, labels, Gauge)
+
+    def histogram(self, name: str, help: str = "",
+                  bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
+                  **labels: Any) -> Histogram:
+        return self._get(name, "histogram", help, labels,
+                         lambda: Histogram(bounds))
+
+    # -- export ----------------------------------------------------------- #
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            families = {n: (f["type"], f["help"], dict(f["series"]))
+                        for n, f in self._families.items()}
+        out: Dict[str, Any] = {}
+        for name, (mtype, help_, series) in sorted(families.items()):
+            entries = []
+            for key, metric in sorted(series.items()):
+                labels = dict(key)
+                if mtype == "histogram":
+                    entry: Dict[str, Any] = {"labels": labels,
+                                             **metric.summary()}
+                else:
+                    entry = {"labels": labels, "value": metric.value}
+                entries.append(entry)
+            out[name] = {"type": mtype, "help": help_, "series": entries}
+        return out
+
+    def to_prometheus(self) -> str:
+        with self._lock:
+            families = {n: (f["type"], f["help"], dict(f["series"]))
+                        for n, f in self._families.items()}
+        lines: List[str] = []
+        for name, (mtype, help_, series) in sorted(families.items()):
+            if help_:
+                lines.append(f"# HELP {name} {_escape_help(help_)}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for key, metric in sorted(series.items()):
+                if mtype == "histogram":
+                    for bound, cum in metric.bucket_counts():
+                        le = "+Inf" if bound == float("inf") else repr(bound)
+                        le_label = 'le="%s"' % le
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_fmt_labels(key, le_label)} {cum}")
+                    lines.append(
+                        f"{name}_sum{_fmt_labels(key)} {metric.sum}")
+                    lines.append(
+                        f"{name}_count{_fmt_labels(key)} {metric.count}")
+                else:
+                    lines.append(
+                        f"{name}{_fmt_labels(key)} {metric.value}")
+        return "\n".join(lines) + "\n"
+
+
+# -- process-global registry -------------------------------------------------- #
+
+# The single process-wide surface train/ingest/runtime counters land on.
+# Serving keeps per-service registries (isolated hot paths, testable in
+# parallel) and the HTTP frontend exposes BOTH on /metrics.
+REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return REGISTRY

@@ -1,0 +1,160 @@
+"""trace-smoke: a tiny train+score through the runner with ``--trace-out``,
+validating the whole observability path end to end (`make trace-smoke`).
+
+Asserted properties — the same contract `tests/test_obs.py` checks piecewise:
+
+1. the Perfetto/Chrome-trace JSON loads, is structurally well-formed
+   (`obs.export.validate_chrome_trace`: required keys, non-negative
+   monotonic-clock timestamps, every parent present, children inside
+   their parent's interval);
+2. the run ROOT span exists and the runner phases + per-stage DAG spans
+   parent (transitively) under it;
+3. the `GoodputReport` buckets sum to the root span's wall time (the
+   decomposition is a decomposition, not a sampling);
+4. the JSONL event log exists and every record carries the run's
+   correlation id.
+
+Run: ``python -m transmogrifai_tpu.obs.smoke`` (CPU-friendly).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import tempfile
+
+import numpy as np
+
+
+def _write_csv(path: str, n: int = 96, seed: int = 7) -> None:
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    y = (a + 0.5 * b + rng.normal(0, 0.4, n) > 0).astype(int)
+    with open(path, "w") as f:
+        f.write("a,b,label\n")
+        for i in range(n):
+            f.write(f"{a[i]:.6f},{b[i]:.6f},{y[i]}\n")
+
+
+def _runner(csv_path: str):
+    from transmogrifai_tpu.automl import transmogrify
+    from transmogrifai_tpu.data.dataset import Dataset
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.workflow import Workflow
+    from transmogrifai_tpu.workflow.runner import WorkflowRunner
+
+    template = Dataset.from_csv(csv_path)
+    preds, label = FeatureBuilder.from_dataset(template, response="label")
+    vec = transmogrify(preds)
+    pred = OpLogisticRegression(max_iter=8).set_input(
+        label, vec).get_output()
+    wf = Workflow().set_result_features(pred, label)
+    return WorkflowRunner(wf, train_reader=DataReaders.csv(csv_path),
+                          score_reader=DataReaders.csv(csv_path))
+
+
+def _validate_trace(trace_path: str, run_type: str, run_id: str) -> dict:
+    from transmogrifai_tpu.obs.export import validate_chrome_trace
+
+    with open(trace_path) as f:
+        obj = json.load(f)
+    problems = validate_chrome_trace(obj)
+    assert not problems, f"trace {trace_path} invalid: {problems}"
+    xs = [e for e in obj["traceEvents"] if e.get("ph") == "X"]
+    roots = [e for e in xs if e["name"] == f"run:{run_type}"]
+    assert len(roots) == 1, f"expected one run root, got {len(roots)}"
+    root = roots[0]
+    assert root["args"]["parent_id"] is None
+    assert root["args"]["run_id"] == run_id
+    # ONE correlation id: the trace id IS the profile/event-log run id
+    assert root["args"]["trace_id"] == run_id
+    # every span in the file reaches the root through parent links
+    by_id = {e["args"]["span_id"]: e for e in xs}
+    rid = root["args"]["span_id"]
+
+    def _reaches_root(e) -> bool:
+        seen = set()
+        while e is not None:
+            sid = e["args"]["span_id"]
+            if sid == rid:
+                return True
+            if sid in seen:
+                return False
+            seen.add(sid)
+            e = by_id.get(e["args"]["parent_id"])
+        return False
+
+    orphans = [e["name"] for e in xs if not _reaches_root(e)]
+    assert not orphans, f"spans not under the run root: {orphans}"
+    phases = [e["name"] for e in xs if e["cat"] == "phase"]
+    assert phases, "no runner phase spans in the trace"
+    return {"spans": len(xs), "phases": sorted(set(phases))}
+
+
+def _validate_goodput(profile: dict) -> dict:
+    gp = profile.get("goodput")
+    assert gp, "profile missing the goodput report"
+    buckets = gp["buckets"]
+    total = sum(buckets.values())
+    wall = gp["wall_s"]
+    assert math.isclose(total, wall, rel_tol=0.02, abs_tol=0.05), \
+        f"goodput buckets sum {total} != wall {wall}"
+    assert 0.0 <= gp["goodput_frac"] <= 1.0
+    return {"goodput_frac": gp["goodput_frac"], "wall_s": wall}
+
+
+def _validate_events(events_path: str, run_id: str) -> int:
+    n = 0
+    with open(events_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            assert rec["run_id"] == run_id, \
+                f"event correlation id {rec['run_id']} != run {run_id}"
+            n += 1
+    assert n >= 2, "event log missing run_start/run_end markers"
+    return n
+
+
+def _smoke() -> int:
+    from transmogrifai_tpu.workflow.params import OpParams
+
+    payload = {}
+    with tempfile.TemporaryDirectory(prefix="trace-smoke-") as tmp:
+        csv_path = f"{tmp}/data.csv"
+        _write_csv(csv_path)
+        runner = _runner(csv_path)
+
+        train_trace = f"{tmp}/train-trace.json"
+        params = OpParams.from_json({
+            "model_location": f"{tmp}/model",
+            "trace_location": train_trace,
+        })
+        result = runner.run("train", params)
+        run_id = result.profile["run_id"]
+        payload["train"] = {
+            **_validate_trace(train_trace, "train", run_id),
+            **_validate_goodput(result.profile),
+            "events": _validate_events(
+                train_trace + ".events.jsonl", run_id),
+        }
+
+        score_trace = f"{tmp}/score-trace.json"
+        params.trace_location = score_trace
+        result = runner.run("score", params)
+        run_id = result.profile["run_id"]
+        payload["score"] = {
+            **_validate_trace(score_trace, "score", run_id),
+            **_validate_goodput(result.profile),
+        }
+    print(json.dumps({"trace_smoke": "ok", **payload}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_smoke())

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -35,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from transmogrifai_tpu import types as T
+from transmogrifai_tpu.obs import export as obs_export
+from transmogrifai_tpu.obs.trace import TRACER
 from transmogrifai_tpu.data.columns import Column
 from transmogrifai_tpu.evaluators.device_metrics import make_device_metric
 from transmogrifai_tpu.models.base import infer_n_classes
@@ -106,6 +109,7 @@ def _journal_prefill(grids: List[Dict],
         return 0
     best = getattr(_SWEEP_TL, "best", None)
     hits = 0
+    saved_s = 0.0
     for i, g in enumerate(grids):
         row = journal.lookup(g)
         if row is not None:
@@ -114,26 +118,36 @@ def _journal_prefill(grids: List[Dict],
                 # seed the best-so-far tracker with pre-kill blocks, or
                 # post-resume journal entries would name a worse leader
                 best.note(g, row)
+            saved_s += journal.duration_of(g)
             hits += 1
     if hits:
         log.info("sweep journal: resuming past %d/%d completed blocks",
                  hits, len(grids))
+        # resume-skip savings into the unified timeline + event log: the
+        # goodput report credits the journal with the blocks it avoided
+        obs_export.record_event("journal_resume", blocks=hits,
+                                total=len(grids),
+                                saved_s=round(saved_s, 6))
     return hits
 
 
 def _journal_commit(grids: List[Dict],
                     metrics: List[Optional[List[float]]],
-                    idxs: List[int]) -> None:
+                    idxs: List[int],
+                    block_s: Optional[float] = None) -> None:
     journal = _active_journal()
     if journal is None:
         return
     best = getattr(_SWEEP_TL, "best", None)
+    # the block ran its configs as one program: attribute wall time evenly
+    per_cfg = (block_s / len(idxs)) if (block_s and idxs) else None
     for i in idxs:
         row = metrics[i]
         if row is None or any(m is None for m in row):
             continue
         journal.append(grids[i], row,
-                       best=best.note(grids[i], row) if best else None)
+                       best=best.note(grids[i], row) if best else None,
+                       duration_s=per_cfg)
 
 
 def _run_groups_resilient(groups: Dict[Tuple, List[int]], run_one,
@@ -144,16 +158,27 @@ def _run_groups_resilient(groups: Dict[Tuple, List[int]], run_one,
       plan can kill/fail the sweep at any block boundary;
     - a device-OOM failure HALVES the block width and retries each half
       before surfacing (narrower blocks fit where wide ones did not —
-      the compiled program per half persists in the compile cache);
-    - `commit(idxs)` journals a block only after it fully completes.
+      the compiled program per half persists in the compile cache); the
+      failed wide attempt's wall time is recorded as an ``oom_redo``
+      badput event on the enclosing span;
+    - `commit(idxs, block_s)` journals a block only after it fully
+      completes, stamped with its wall cost (resume-skip accounting).
     """
     def run(static, idxs):
+        t0 = time.perf_counter()
         try:
-            fault_point(SITE_RUN_BLOCK)
-            run_one(static, idxs)
+            with TRACER.span("sweep:block", category="sweep",
+                             family=family, static=repr(static),
+                             configs=len(idxs)):
+                fault_point(SITE_RUN_BLOCK)
+                run_one(static, idxs)
         except Exception as e:
             if len(idxs) <= 1 or not is_oom_error(e):
                 raise
+            wasted = time.perf_counter() - t0
+            obs_export.record_event("oom_redo", family=family,
+                                    configs=len(idxs),
+                                    wasted_s=round(wasted, 6))
             mid = (len(idxs) + 1) // 2
             log.warning(
                 "sweep %s block %r: device OOM with %d configs (%s) — "
@@ -162,7 +187,7 @@ def _run_groups_resilient(groups: Dict[Tuple, List[int]], run_one,
             run(static, idxs[:mid])
             run(static, idxs[mid:])
             return
-        commit(idxs)
+        commit(idxs, time.perf_counter() - t0)
 
     for static, idxs in groups.items():
         run(static, idxs)
@@ -192,27 +217,41 @@ def _sweep_generic(est, grids: List[Dict], X, y, folds, evaluator,
     journal = _active_journal()
     best = getattr(_SWEEP_TL, "best", None)
     bin_cache: Dict = {}  # shared across the family: bin X once per max_bins
+    hits, saved_s = 0, 0.0
     for grid in grids:
         cached = journal.lookup(grid) if journal is not None else None
         if cached is not None:
             out.append(cached)
+            if best is not None:
+                best.note(grid, cached)
+            hits += 1
+            saved_s += journal.duration_of(grid)
             continue
-        fault_point(SITE_RUN_BLOCK)
-        clone = type(est)(**{**{k: v for k, v in est.params.items()
-                                if k != "uid"}, **grid})
-        if isinstance(clone, _TreeEstimatorBase):
-            clone._bin_cache = bin_cache
-        row = []
-        for tr, va in folds:
-            with _DispatchSpan():  # visible to tree-family calib timing
-                model = clone.fit_arrays(X, y, jnp.asarray(tr), ctx)
-                pred = model.predict_arrays(X)
-            row.append(_metric(evaluator, y_np,
-                               {k: np.asarray(v) for k, v in pred.items()}, va))
+        t0 = time.perf_counter()
+        with TRACER.span("sweep:block", category="sweep",
+                         family=type(est).__name__, configs=1):
+            fault_point(SITE_RUN_BLOCK)
+            clone = type(est)(**{**{k: v for k, v in est.params.items()
+                                    if k != "uid"}, **grid})
+            if isinstance(clone, _TreeEstimatorBase):
+                clone._bin_cache = bin_cache
+            row = []
+            for tr, va in folds:
+                with _DispatchSpan():  # visible to tree-family calib timing
+                    model = clone.fit_arrays(X, y, jnp.asarray(tr), ctx)
+                    pred = model.predict_arrays(X)
+                row.append(_metric(
+                    evaluator, y_np,
+                    {k: np.asarray(v) for k, v in pred.items()}, va))
         out.append(row)
         if journal is not None:
             journal.append(grid, row,
-                           best=best.note(grid, row) if best else None)
+                           best=best.note(grid, row) if best else None,
+                           duration_s=time.perf_counter() - t0)
+    if hits:
+        obs_export.record_event("journal_resume", blocks=hits,
+                                total=len(grids),
+                                saved_s=round(saved_s, 6))
     return out
 
 
@@ -439,7 +478,8 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
     # (c) let later groups reuse calibration learned by earlier ones.
     _run_groups_resilient(
         groups, _run_group,
-        commit=lambda idxs: _journal_commit(grids, metrics, idxs),
+        commit=lambda idxs, block_s=None: _journal_commit(
+            grids, metrics, idxs, block_s),
         family=family)
     return metrics  # type: ignore[return-value]
 
@@ -1162,7 +1202,8 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
 
     _run_groups_resilient(
         groups, _run_gbt_group,
-        commit=lambda idxs: _journal_commit(grids, metrics, idxs),
+        commit=lambda idxs, block_s=None: _journal_commit(
+            grids, metrics, idxs, block_s),
         family="gbt")
     return metrics  # type: ignore[return-value]
 

@@ -150,6 +150,10 @@ class FaultPlan:
                     break
         if hit is None:
             return
+        # the structured event log + current trace span both record the
+        # injection the moment it fires — a kill never gets another chance
+        from transmogrifai_tpu.obs.export import record_event
+        record_event("fault", site=site, n=n, fault_kind=hit.kind)
         if hit.kind == "delay":
             time.sleep(hit.delay_s)
             return

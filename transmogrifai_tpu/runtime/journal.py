@@ -56,6 +56,7 @@ class SweepJournal:
         self.fsync = fsync
         self._lock = threading.Lock()
         self._rows: Dict[str, List[float]] = {}
+        self._durations: Dict[str, float] = {}  # key -> block wall seconds
         self._header_written = False
         self._load()
 
@@ -79,6 +80,7 @@ class SweepJournal:
                         self.path, exc_info=True)
             return
         rows: Dict[str, List[float]] = {}
+        durations: Dict[str, float] = {}
         header_ok = False
         valid_bytes = 0   # length of the intact, newline-terminated prefix
         saw_record_line = False
@@ -124,6 +126,9 @@ class SweepJournal:
             metrics = rec.get("fold_metrics")
             if isinstance(key, str) and isinstance(metrics, list):
                 rows[key] = [float(m) for m in metrics]
+                dur = rec.get("duration_s")
+                if isinstance(dur, (int, float)):
+                    durations[key] = float(dur)
             valid_bytes += len(bline)
         if valid_bytes < len(raw):
             log.warning("sweep journal %s: torn record after %d intact "
@@ -147,6 +152,7 @@ class SweepJournal:
                             exc_info=True)
                 return
         self._rows = rows
+        self._durations = durations
         # only a validated header makes appends skip re-writing it — an
         # empty or header-torn file must get a fresh header first
         self._header_written = header_ok
@@ -155,6 +161,13 @@ class SweepJournal:
         with self._lock:
             row = self._rows.get(self.key_of(grid))
             return list(row) if row is not None else None
+
+    def duration_of(self, grid: Dict[str, Any]) -> float:
+        """Recorded wall seconds of a journaled block (0.0 when the
+        record predates duration stamping) — the resume-skip savings
+        feeding the goodput report."""
+        with self._lock:
+            return self._durations.get(self.key_of(grid), 0.0)
 
     def __len__(self) -> int:
         with self._lock:
@@ -171,14 +184,23 @@ class SweepJournal:
                 os.fsync(fh.fileno())
 
     def append(self, grid: Dict[str, Any], fold_metrics: List[float],
-               best: Optional[Dict[str, Any]] = None) -> None:
+               best: Optional[Dict[str, Any]] = None,
+               duration_s: Optional[float] = None) -> None:
         """Record one completed grid-config block. Idempotent per config;
         never raises (journaling is an optimization — a full disk must
-        degrade resume granularity, not kill the sweep)."""
+        degrade resume granularity, not kill the sweep). `duration_s`
+        stamps the block's wall cost so a resume can report how much
+        work the journal saved (goodput resume-skip accounting)."""
         key = self.key_of(grid)
         with self._lock:
             if key in self._rows:
                 return
+            rec: Dict[str, Any] = {
+                "key": key, "grid": grid,
+                "fold_metrics": [float(m) for m in fold_metrics],
+                "best": best}
+            if duration_s is not None:
+                rec["duration_s"] = round(float(duration_s), 6)
             try:
                 if not self._header_written:
                     dirname = os.path.dirname(self.path)
@@ -187,12 +209,11 @@ class SweepJournal:
                     self._write_line({"journal": _FORMAT_VERSION,
                                       "meta": self.meta})
                     self._header_written = True
-                self._write_line({
-                    "key": key, "grid": grid,
-                    "fold_metrics": [float(m) for m in fold_metrics],
-                    "best": best})
+                self._write_line(rec)
             except OSError:
                 log.warning("sweep journal %s: append failed; block will "
                             "re-run on resume", self.path, exc_info=True)
                 return
             self._rows[key] = [float(m) for m in fold_metrics]
+            if duration_s is not None:
+                self._durations[key] = float(duration_s)

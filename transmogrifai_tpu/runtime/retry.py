@@ -35,6 +35,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Tuple
 
+from transmogrifai_tpu.obs import export as obs_export
+from transmogrifai_tpu.obs.trace import TRACER
+
 __all__ = ["RetryEvent", "RetryPolicy", "metrics_hook", "profile_hook"]
 
 log = logging.getLogger(__name__)
@@ -105,9 +108,11 @@ class RetryPolicy:
         attempt = 0
         while True:
             attempt += 1
+            t_attempt = time.perf_counter()
             try:
                 return fn(*args, **kwargs)
             except Exception as e:
+                wasted = time.perf_counter() - t_attempt
                 if attempt >= self.max_attempts or not self.is_transient(e):
                     raise
                 delay = self.delay_for(attempt, rng)
@@ -120,7 +125,24 @@ class RetryPolicy:
                     "%s: transient failure on attempt %d/%d (%s: %s) — "
                     "retrying in %.3fs", label, attempt, self.max_attempts,
                     type(e).__name__, e, delay)
-                self.sleep(delay)
+                obs_export.record_event(
+                    "retry", site=label, attempt=attempt,
+                    delay_s=round(delay, 6),
+                    error=f"{type(e).__name__}: {e}")
+                # the failed attempt's wall time is REDONE work (the
+                # next attempt repeats it): goodput's fault_redo bucket,
+                # distinct from the backoff sleep measured by the span
+                obs_export.record_event(
+                    "fault_redo", site=label,
+                    wasted_s=round(wasted, 6))
+                # the backoff sleep is pure badput: give it a span so the
+                # goodput rollup and the Perfetto timeline both see it,
+                # nested under whatever opened this attempt (ingest
+                # worker chunk, sweep family, serving handler)
+                with TRACER.span(f"retry:{label}", category="retry",
+                                 attempt=attempt,
+                                 error=type(e).__name__):
+                    self.sleep(delay)
 
     def wrap(self, fn: Callable[..., Any], label: str = "retry",
              on_attempt: Optional[Callable[[RetryEvent], Any]] = None
@@ -135,7 +157,7 @@ class RetryPolicy:
 # -- observability adapters -------------------------------------------------- #
 
 def metrics_hook(registry) -> Callable[[RetryEvent], None]:
-    """Per-attempt hook onto a `serving.metrics.MetricsRegistry`:
+    """Per-attempt hook onto an `obs.metrics.MetricsRegistry`:
     increments `runtime_retry_attempts_total{site=label}` so retry
     pressure shows up beside the serving/ingest series."""
     def hook(event: RetryEvent) -> None:

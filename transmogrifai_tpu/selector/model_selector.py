@@ -146,26 +146,36 @@ class ModelSelector(Estimator):
             data_digest = (self._data_digest(X, y_dev)
                            if self.checkpoint_dir is not None else None)
 
+            # family jobs run on pool threads with no inherited span
+            # context: parent each family span explicitly so sweep-block
+            # spans nest under the caller's run/stage span
+            from transmogrifai_tpu.obs.trace import TRACER as _TRACER
+            _sweep_parent = _TRACER.current()
+
             def run_family(mi_est_grids):
                 mi, (est, grids) = mi_est_grids
-                sig = self._sweep_signature(
-                    mi, est, grids, X, data_digest, folds, ctx)
-                ckpt = self._checkpoint_path(mi, est, sig)
-                cached = self._load_checkpoint(ckpt)
-                if cached is not None:
-                    log.info("sweep checkpoint hit: %s (%d grids)",
-                             type(est).__name__, len(cached))
-                    return cached
-                # block-granular journal: completed grid blocks persist
-                # as the sweep runs, so a kill ANYWHERE inside the family
-                # resumes at the first un-journaled block instead of
-                # re-running the family from scratch
-                journal = self._journal_for(mi, est, sig)
-                grid_fold = self._run_sweep_with_retry(
-                    est, grids, X, y_dev, folds, ctx, sharding,
-                    journal=journal)
-                self._save_checkpoint(ckpt, grid_fold)
-                return grid_fold
+                with _TRACER.span(f"sweep:family:{type(est).__name__}",
+                                  category="sweep_family",
+                                  parent=_sweep_parent, grids=len(grids)):
+                    sig = self._sweep_signature(
+                        mi, est, grids, X, data_digest, folds, ctx)
+                    ckpt = self._checkpoint_path(mi, est, sig)
+                    cached = self._load_checkpoint(ckpt)
+                    if cached is not None:
+                        log.info("sweep checkpoint hit: %s (%d grids)",
+                                 type(est).__name__, len(cached))
+                        return cached
+                    # block-granular journal: completed grid blocks
+                    # persist as the sweep runs, so a kill ANYWHERE
+                    # inside the family resumes at the first
+                    # un-journaled block instead of re-running the
+                    # family from scratch
+                    journal = self._journal_for(mi, est, sig)
+                    grid_fold = self._run_sweep_with_retry(
+                        est, grids, X, y_dev, folds, ctx, sharding,
+                        journal=journal)
+                    self._save_checkpoint(ckpt, grid_fold)
+                    return grid_fold
 
             # Families run on a thread pool (the reference's Parallelism=8
             # Future-per-fit pool, OpValidator.scala:374): device

@@ -43,6 +43,25 @@ _ERROR_STATUS = {
 }
 
 
+def metrics_text(service: ScoringService) -> str:
+    """Prometheus exposition for `/metrics`: the service's own registry
+    PLUS the process-global `obs.metrics` registry, so train/ingest/
+    runtime counters registered anywhere in the process land on the
+    same scrape surface as the serving series. Family names are
+    namespaced by convention (serving_* vs ingest_*/train_*/runtime_*),
+    so the concatenation stays collision-free."""
+    from transmogrifai_tpu.obs.metrics import get_registry
+    return service.registry.to_prometheus() + get_registry().to_prometheus()
+
+
+def metrics_json(service: ScoringService) -> Dict[str, Any]:
+    """JSON form of `/metrics?format=json`: process-global families
+    merged under the service's (the service wins a name collision — its
+    series are the ones this endpoint has always reported)."""
+    from transmogrifai_tpu.obs.metrics import get_registry
+    return {**get_registry().to_json(), **service.registry.to_json()}
+
+
 class ServingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer carrying the ScoringService reference."""
 
@@ -104,10 +123,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(status, health)
         elif path == "/metrics":
             if "format=json" in query:
-                self._send_json(200, self.service.registry.to_json())
+                self._send_json(200, metrics_json(self.service))
             else:
                 self._send(
-                    200, self.service.registry.to_prometheus().encode(),
+                    200, metrics_text(self.service).encode(),
                     content_type="text/plain; version=0.0.4")
         else:
             self._send_json(404, {"error": "not_found",

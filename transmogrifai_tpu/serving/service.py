@@ -36,10 +36,11 @@ import numpy as np
 
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.obs.trace import TRACER
 from transmogrifai_tpu.serving.batcher import (
     MicroBatcher, Request, ScoreError, bucket_for, bucket_ladder,
     pad_requests)
-from transmogrifai_tpu.serving.metrics import MetricsRegistry
 from transmogrifai_tpu.workflow.compiled import slice_result_tree
 
 log = logging.getLogger(__name__)
@@ -209,7 +210,9 @@ class ScoringService:
             batch_wait_s=self.config.batch_wait_ms / 1000.0)
         self._thread: Optional[threading.Thread] = None
         self._running = False
-        self.started_at = time.time()
+        self.started_at = time.time()          # epoch timestamp (display)
+        self._started_mono = time.monotonic()  # uptime arithmetic (L009)
+        self._trace_parent = None  # span the batcher thread nests under
         self._schema: Dict[str, type] = {}
         self._init_metrics()
         if model is not None:
@@ -290,6 +293,10 @@ class ScoringService:
             self._batcher = MicroBatcher(
                 self.config.max_queue, self.ladder[-1],
                 batch_wait_s=self.config.batch_wait_ms / 1000.0)
+        # the scoring thread does not inherit this context: capture the
+        # caller's current span so batch spans nest under the run that
+        # started the service (e.g. the runner's serve phase)
+        self._trace_parent = TRACER.current()
         self._running = True
         self._thread = threading.Thread(
             target=self._serve_loop, name="scoring-batcher", daemon=True)
@@ -428,7 +435,7 @@ class ScoringService:
         return {
             "status": "ok" if (self._running and active) else "down",
             "model_version": active.version_id if active else None,
-            "uptime_s": round(time.time() - self.started_at, 3),
+            "uptime_s": round(time.monotonic() - self._started_mono, 3),
             "queue_depth": self._batcher.depth(),
             "buckets": list(self.ladder),
             "versions": [v.info() for v in self._versions],
@@ -466,8 +473,13 @@ class ScoringService:
             # batch ASSEMBLY is inside the quarantine too: two requests
             # with mismatched column sets fail Dataset.concat, and that
             # must degrade to per-request scoring, not kill the batch
-            ds, n_valid, bucket = pad_requests(batch, self.ladder)
-            out = version.scorer.score_padded(ds, bucket)
+            with TRACER.span("serving:batch", category="serving",
+                             parent=self._trace_parent,
+                             requests=len(batch),
+                             version=version.version_id) as sp:
+                ds, n_valid, bucket = pad_requests(batch, self.ladder)
+                sp.set(bucket=bucket, rows=n_valid)
+                out = version.scorer.score_padded(ds, bucket)
         except Exception as e:
             # error quarantine: one bad record must fail ONE request.
             # Re-score each request alone so its batchmates still get

@@ -4,7 +4,13 @@ Reference parity: `utils/.../spark/OpSparkListener.scala:62-141` (per-phase
 metrics, app duration, custom tags) and `OpStep.scala:35-45` (phase names).
 Here phases are wall-clock scopes; under jax the scope also opens a named
 TraceAnnotation so device traces line up with framework phases when the
-jax profiler is active.
+jax profiler is active, and an `obs.trace` span so the phase lands in the
+run's unified timeline (Perfetto export, goodput rollup).
+
+Clocks: durations come from `time.perf_counter()` — a wall-clock step
+(NTP, suspend) must not corrupt a measured interval — while `started_at`
+stays epoch-based because it is a TIMESTAMP, not a duration (lint L009
+enforces the same split across the library).
 """
 
 from __future__ import annotations
@@ -14,6 +20,8 @@ import json
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+from transmogrifai_tpu.obs.trace import TRACER
 
 # OpStep.scala phase names
 DATA_READING = "DataReadingAndFiltering"
@@ -44,12 +52,16 @@ class RunProfile:
     custom_tag_name: Optional[str] = None
     custom_tag_value: Optional[str] = None
     phases: List[PhaseMetric] = field(default_factory=list)
-    started_at: float = field(default_factory=time.time)
+    started_at: float = field(default_factory=time.time)  # epoch timestamp
     histograms: Dict[str, Any] = field(default_factory=dict)
+    run_id: Optional[str] = None       # obs trace correlation id
+    goodput: Optional[Dict[str, Any]] = None  # obs.goodput rollup
+    # duration origin: monotonic, immune to wall-clock steps
+    _t0: float = field(default_factory=time.perf_counter, repr=False)
 
     def record_histogram(self, name: str, hist) -> None:
         """Attach a distribution summary (p50/p95/p99/count/...) to the
-        profile — `hist` is a `serving.metrics.Histogram` (or any object
+        profile — `hist` is an `obs.metrics.Histogram` (or any object
         with a `summary()` dict). Used by the streaming scorer for
         per-batch latency, and by the serve run type for its registry."""
         self.histograms[name] = hist.summary() if hasattr(hist, "summary") \
@@ -66,29 +78,45 @@ class RunProfile:
 
     @contextlib.contextmanager
     def phase(self, name: str, **extra):
-        """Time a named phase; nests with the jax profiler when tracing."""
+        """Time a named phase; nests with the jax profiler when tracing
+        and opens an `obs.trace` span in the run's timeline.
+
+        A body that raises still records its phase — with an ``error``
+        extra naming the exception — and re-raises: a failed run's
+        profile must show WHERE the time went before the failure, not
+        silently drop the phase that died."""
         try:
             import jax.profiler
             annotation = jax.profiler.TraceAnnotation(name)
         except Exception:  # profiler unavailable: plain timing
             annotation = contextlib.nullcontext()
-        t0 = time.time()
-        with annotation:
-            yield
-        self.phases.append(PhaseMetric(name, time.time() - t0, dict(extra)))
+        extra = dict(extra)
+        t0 = time.perf_counter()
+        try:
+            with TRACER.span(f"phase:{name}", category="phase", **extra), \
+                    annotation:
+                yield
+        except BaseException as e:  # incl. injected kills/preemptions
+            extra["error"] = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self.phases.append(
+                PhaseMetric(name, time.perf_counter() - t0, extra))
 
     @property
     def app_duration_s(self) -> float:
-        return time.time() - self.started_at
+        return time.perf_counter() - self._t0
 
     def to_json(self) -> Dict[str, Any]:
         return {
             "run_type": self.run_type,
+            "run_id": self.run_id,
             "custom_tag": ({self.custom_tag_name: self.custom_tag_value}
                            if self.custom_tag_name else None),
             "app_duration_s": round(self.app_duration_s, 4),
             "phases": [p.to_json() for p in self.phases],
             "histograms": self.histograms or None,
+            "goodput": self.goodput,
         }
 
     def write(self, path: str) -> None:
@@ -101,4 +129,7 @@ class RunProfile:
         for p in self.phases:
             lines.append(f"  {p.name}: {p.duration_s:.2f}s "
                          + (str(p.extra) if p.extra else ""))
+        if self.goodput:
+            lines.append(f"  goodput: {self.goodput.get('goodput_frac')}"
+                         f" of {self.goodput.get('wall_s')}s wall")
         return "\n".join(lines)

@@ -114,6 +114,10 @@ class OpParams:
     model_location: Optional[str] = None
     write_location: Optional[str] = None
     metrics_location: Optional[str] = None
+    # Perfetto/Chrome-trace output path for the run's span timeline
+    # (the CLI's --trace-out); a sibling .events.jsonl gets the
+    # structured event log with the run correlation id
+    trace_location: Optional[str] = None
     batch_duration_secs: Optional[int] = None
     custom_tag_name: Optional[str] = None
     custom_tag_value: Optional[str] = None
@@ -137,6 +141,7 @@ class OpParams:
             model_location=d.get("model_location"),
             write_location=d.get("write_location"),
             metrics_location=d.get("metrics_location"),
+            trace_location=d.get("trace_location"),
             batch_duration_secs=d.get("batch_duration_secs"),
             custom_tag_name=d.get("custom_tag_name"),
             custom_tag_value=d.get("custom_tag_value"),
@@ -159,6 +164,7 @@ class OpParams:
             "model_location": self.model_location,
             "write_location": self.write_location,
             "metrics_location": self.metrics_location,
+            "trace_location": self.trace_location,
             "batch_duration_secs": self.batch_duration_secs,
             "custom_tag_name": self.custom_tag_name,
             "custom_tag_value": self.custom_tag_value,

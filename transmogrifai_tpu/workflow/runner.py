@@ -23,6 +23,9 @@ from typing import Any, Dict, Iterator, List, Optional
 import numpy as np
 
 from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.obs import export as obs_export
+from transmogrifai_tpu.obs import goodput as obs_goodput
+from transmogrifai_tpu.obs.trace import TRACER, new_run_id
 from transmogrifai_tpu.utils import profiling
 from transmogrifai_tpu.utils.profiling import RunProfile
 from transmogrifai_tpu.workflow.params import OpParams, ReaderParams
@@ -96,9 +99,11 @@ class WorkflowRunner:
             raise ValueError(
                 f"run_type must be one of {RUN_TYPES}, got {run_type!r}")
         log.info("Assuming OP params: %s", json.dumps(params.to_json()))
+        run_id = new_run_id()
         profile = RunProfile(run_type=run_type,
                              custom_tag_name=params.custom_tag_name,
-                             custom_tag_value=params.custom_tag_value)
+                             custom_tag_value=params.custom_tag_value,
+                             run_id=run_id)
         self.workflow.set_parameters(params)
         dispatch = {
             "train": self._train, "score": self._score,
@@ -106,8 +111,35 @@ class WorkflowRunner:
             "features": self._features, "evaluate": self._evaluate,
             "serve": self._serve,
         }
-        result = dispatch[run_type](params, profile)
+        # the run ROOT span: every phase, stage fit, ingest worker, sweep
+        # block, retry backoff, and serving batch below nests under one
+        # correlation id — exported as a single Perfetto timeline and
+        # rolled into the goodput report
+        event_log = None
+        if params.trace_location:
+            event_log = obs_export.EventLog(
+                params.trace_location + ".events.jsonl", run_id=run_id)
+            obs_export.install_event_log(event_log)
+            obs_export.emit_event("run_start", run_type=run_type)
+        try:
+            # trace_id=run_id: the Perfetto trace, the RunProfile, and
+            # the JSONL event log share ONE correlation id
+            with TRACER.span(f"run:{run_type}", category="run",
+                             new_trace=True, trace_id=run_id,
+                             run_id=run_id, run_type=run_type) as root:
+                result = dispatch[run_type](params, profile)
+        finally:
+            if event_log is not None:
+                obs_export.emit_event("run_end")
+                obs_export.uninstall_event_log(event_log)
+                event_log.close()
+        spans = TRACER.trace_spans(root.trace_id)
+        profile.goodput = obs_goodput.build_report(root, spans).to_json()
         result.profile = profile.to_json()
+        if params.trace_location:
+            obs_export.write_chrome_trace(params.trace_location, spans)
+            log.info("trace written to %s (%d spans, run %s)",
+                     params.trace_location, len(spans), run_id)
         if params.metrics_location:
             os.makedirs(params.metrics_location, exist_ok=True)
             with open(os.path.join(params.metrics_location,
@@ -208,7 +240,7 @@ class WorkflowRunner:
         # scorer, into the serving metrics histogram type — p50 tracks
         # steady-state, p99 exposes stalls/recompiles (ML Goodput:
         # untracked stalls, not FLOPs, dominate fleet efficiency)
-        from transmogrifai_tpu.serving.metrics import Histogram
+        from transmogrifai_tpu.obs.metrics import Histogram
         batch_latency = Histogram()
         with profile.phase(profiling.SCORING):
             t_prev = time.perf_counter()

@@ -25,6 +25,8 @@ _log = logging.getLogger(__name__)
 from transmogrifai_tpu.data.columns import Column
 from transmogrifai_tpu.data.dataset import Dataset
 from transmogrifai_tpu.features.dag import clone_graph, topological_layers
+from transmogrifai_tpu.obs.metrics import get_registry
+from transmogrifai_tpu.obs.trace import TRACER
 from transmogrifai_tpu.stages.base import (
     Estimator, FeatureGeneratorStage, FitContext, Stage, Transformer)
 
@@ -187,6 +189,7 @@ class Workflow:
                 raise TypeError(f"Layer-0 stage {gen!r} is not a feature generator")
             columns[gen.get_output().uid] = gen.materialize(ds)
 
+        n_fits = 0
         for li, layer in enumerate(layers[1:], start=1):
             for stage in layer:
                 inputs = [columns[f.uid] for f in stage.input_features]
@@ -205,16 +208,35 @@ class Workflow:
                     if self._workflow_cv and self._is_selector(est):
                         stage_ctx.cv_refit = self._make_cv_refit(
                             stage, layers, columns, ctx)
-                    model = est.fit(inputs, stage_ctx)
+                    # per-stage spans: every fit and transform lands in
+                    # the run's unified timeline keyed by stage uid, so
+                    # a slow estimator is attributable from the trace
+                    # alone (the OpSparkListener per-stage analogue)
+                    with TRACER.span(
+                            f"stage:fit:{stage.operation_name}",
+                            category="stage", uid=est.uid, layer=li):
+                        model = est.fit(inputs, stage_ctx)
+                    n_fits += 1
                     fitted[est.uid] = model
-                    out = model.transform(inputs, ctx)
+                    with TRACER.span(
+                            f"stage:transform:{stage.operation_name}",
+                            category="stage", uid=est.uid, layer=li):
+                        out = model.transform(inputs, ctx)
                 elif isinstance(stage, Transformer):
                     fitted[stage.uid] = stage
-                    out = stage.transform(inputs, ctx)
+                    with TRACER.span(
+                            f"stage:transform:{stage.operation_name}",
+                            category="stage", uid=stage.uid, layer=li):
+                        out = stage.transform(inputs, ctx)
                 else:
                     raise TypeError(f"Cannot execute stage {stage!r}")
                 columns[stage.get_output().uid] = out
 
+        reg = get_registry()
+        reg.counter("train_runs_total",
+                    "Workflow.train invocations").inc()
+        reg.counter("train_stages_fitted_total",
+                    "estimators fitted during train").inc(n_fits)
         model = WorkflowModel(
             result_features=result_features, fitted=fitted,
             train_columns=columns)
