@@ -15,7 +15,8 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-.PHONY: lint serve-smoke ingest-smoke faults-smoke trace-smoke test check
+.PHONY: lint serve-smoke ingest-smoke faults-smoke trace-smoke \
+	cache-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -27,6 +28,14 @@ lint:
 # survives intact. See transmogrifai_tpu/runtime/smoke.py.
 faults-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.runtime.smoke
+
+# feature-cache smoke: cold dual build writes the content-addressed
+# wire artifact, a rebuild HITS it (zero store reads, bit-identical
+# buffers), a corrupted artifact is rejected and falls back to a
+# rebuild, and the int8 quantized wire stays within tolerance at 2x
+# compression. See transmogrifai_tpu/data/feature_cache.py.
+cache-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.data.feature_cache
 
 # out-of-core ingest smoke: small synthetic ColumnarStore through the
 # pipelined one-pass dual-representation build (data/pipeline.py) —
@@ -52,4 +61,4 @@ trace-smoke:
 test:
 	@$(TIER1)
 
-check: lint serve-smoke ingest-smoke faults-smoke trace-smoke test
+check: lint serve-smoke ingest-smoke cache-smoke faults-smoke trace-smoke test

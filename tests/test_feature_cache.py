@@ -1,0 +1,653 @@
+"""Persistent content-addressed feature cache (`data/feature_cache.py`
++ the `cache=` policy on the `parallel/bigdata.py` builders): warm-path
+proof (zero store reads, bit-identical buffers), cache-key invalidation
+(store mutation / dtype-bin plan / sharding / chunk layout), corrupt and
+torn artifact rejection with rebuild fallback, quantized-wire numerics,
+the resident registry, and goodput cache savings."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.data import feature_cache as fc
+from transmogrifai_tpu.data.columnar_store import (
+    ColumnarStore, synth_binary_store)
+from transmogrifai_tpu.parallel import bigdata as bd
+
+N_ROWS, N_FEATS, CHUNK = 5000, 12, 1024
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return synth_binary_store(str(tmp_path / "store"), N_ROWS, N_FEATS,
+                              seed=3, chunk_rows=CHUNK)
+
+
+@pytest.fixture()
+def params(tmp_path):
+    return fc.FeatureCacheParams(dir=str(tmp_path / "cache"),
+                                 policy="readwrite")
+
+
+def _edges(store):
+    return store.quantile_edges(16, sample=N_ROWS)
+
+
+# -- warm-path proof (acceptance) ------------------------------------------- #
+
+class TestWarmPath:
+    def test_dual_second_build_zero_store_reads_and_identical(
+            self, store, params):
+        edges = _edges(store)
+        x1, b1, st1 = bd.dual_device_matrices(
+            store, edges, chunk_rows=CHUNK, cache=params,
+            return_stats=True)
+        assert st1.cache == "miss"
+        assert not st1.cache_hit
+        assert st1.read_s > 0 and st1.bytes_read > 0
+        x2, b2, st2 = bd.dual_device_matrices(
+            store, edges, chunk_rows=CHUNK, cache=params,
+            return_stats=True)
+        # the proof: hit flag set, ZERO store memmap chunk reads
+        assert st2.cache == "hit" and st2.cache_hit
+        assert st2.read_s == 0.0
+        assert st2.bytes_read == 0
+        assert st2.cache_bytes > 0 and st2.cache_read_s >= 0.0
+        assert st2.chunks == st1.chunks
+        assert st2.bytes_wire == st1.bytes_wire
+        # bit-identical, both representations
+        assert np.asarray(x2).tobytes() == np.asarray(x1).tobytes()
+        np.testing.assert_array_equal(np.asarray(b2), np.asarray(b1))
+
+    def test_matrix_and_binned_warm_parity(self, store, params):
+        edges = _edges(store)
+        x1, stm1 = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                    return_stats=True)
+        x2, stm2 = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                    return_stats=True)
+        assert (stm1.cache, stm2.cache) == ("miss", "hit")
+        assert stm2.read_s == 0.0 and stm2.bytes_read == 0
+        assert np.asarray(x2).tobytes() == np.asarray(x1).tobytes()
+        b1, stb1 = bd.device_binned(store, edges, chunk_rows=CHUNK,
+                                    cache=params, return_stats=True)
+        b2, stb2 = bd.device_binned(store, edges, chunk_rows=CHUNK,
+                                    cache=params, return_stats=True)
+        assert (stb1.cache, stb2.cache) == ("miss", "hit")
+        np.testing.assert_array_equal(np.asarray(b2), np.asarray(b1))
+
+    def test_warm_binned_bit_identical_to_uncached_direct_build(
+            self, store, params):
+        """A cache hit replays the exact f16 wire the direct build
+        ships, so the int8 binned matrix is bit-identical to a build
+        that never saw the cache."""
+        edges = _edges(store)
+        direct = bd.device_binned(store, edges, chunk_rows=CHUNK)
+        bd.device_binned(store, edges, chunk_rows=CHUNK, cache=params)
+        warm, st = bd.device_binned(store, edges, chunk_rows=CHUNK,
+                                    cache=params, return_stats=True)
+        assert st.cache == "hit"
+        np.testing.assert_array_equal(np.asarray(warm), np.asarray(direct))
+
+    def test_read_policy_does_not_write(self, store, params):
+        import dataclasses
+        ro = dataclasses.replace(params, policy="read")
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=ro,
+                                 return_stats=True)
+        assert st.cache == "miss"
+        assert not fc.FeatureCache(ro).probe(st.cache_key)
+        # readwrite then populates; read hits it
+        bd.device_matrix(store, chunk_rows=CHUNK, cache=params)
+        _, st2 = bd.device_matrix(store, chunk_rows=CHUNK, cache=ro,
+                                  return_stats=True)
+        assert st2.cache == "hit"
+
+    def test_cache_off_is_legacy(self, store):
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK, cache="off",
+                                 return_stats=True)
+        assert st.cache == ""
+        assert st.cache_key == ""
+
+    def test_stats_to_extra_carries_cache_fields(self, store, params):
+        bd.device_matrix(store, chunk_rows=CHUNK, cache=params)
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                 return_stats=True)
+        extra = st.to_extra()
+        assert extra["cache"] == "hit"
+        assert extra["cache_key"] == st.cache_key
+        assert extra["cache_bytes"] == st.cache_bytes
+
+
+# -- cache-key invalidation -------------------------------------------------- #
+
+class TestKeyInvalidation:
+    def test_mutating_store_column_misses(self, tmp_path, params):
+        path = str(tmp_path / "store")
+        store = synth_binary_store(path, N_ROWS, N_FEATS, seed=3,
+                                   chunk_rows=CHUNK)
+        _, st1 = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                  return_stats=True)
+        assert st1.cache == "miss"
+        # rewrite the store in place: same shape, one column changed →
+        # the manifest checksums (the content identity) move
+        old = np.array(store.chunk(0, N_ROWS), copy=True)
+        mutated = old.copy()
+        mutated[:, 0] = mutated[:, 0] + np.float16(1.0)
+        w = ColumnarStore.create(path, N_ROWS, N_FEATS)
+        w.write_chunk(0, mutated, np.asarray(store.y, np.float32))
+        store2 = w.close()
+        assert fc.store_fingerprint(store2) != fc.store_fingerprint(store)
+        _, st2 = bd.device_matrix(store2, chunk_rows=CHUNK, cache=params,
+                                  return_stats=True)
+        assert st2.cache == "miss", "stale artifact served for mutated data"
+
+    def test_bin_plan_change_misses(self, store, params):
+        e16 = store.quantile_edges(16, sample=N_ROWS)
+        e8 = store.quantile_edges(8, sample=N_ROWS)
+        _, st1 = bd.device_binned(store, e16, chunk_rows=CHUNK,
+                                  cache=params, return_stats=True)
+        _, st2 = bd.device_binned(store, e8, chunk_rows=CHUNK,
+                                  cache=params, return_stats=True)
+        assert st1.cache == st2.cache == "miss"
+        assert st1.cache_key != st2.cache_key
+        # unchanged plan still hits
+        _, st3 = bd.device_binned(store, e16, chunk_rows=CHUNK,
+                                  cache=params, return_stats=True)
+        assert st3.cache == "hit"
+
+    def test_dtype_change_misses(self, store, params):
+        import jax.numpy as jnp
+        bd.device_matrix(store, dtype=jnp.bfloat16, chunk_rows=CHUNK,
+                         cache=params)
+        _, st = bd.device_matrix(store, dtype=jnp.float32,
+                                 chunk_rows=CHUNK, cache=params,
+                                 return_stats=True)
+        assert st.cache == "miss"
+
+    def test_wire_mode_change_misses(self, store, params):
+        import dataclasses
+        bd.device_matrix(store, chunk_rows=CHUNK, cache=params)
+        qp = dataclasses.replace(params, wire="int8")
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=qp,
+                                 return_stats=True)
+        assert st.cache == "miss"
+
+    def test_chunk_layout_change_misses(self, store, params):
+        _, st1 = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                  return_stats=True)
+        _, st2 = bd.device_matrix(store, chunk_rows=CHUNK // 2,
+                                  cache=params, return_stats=True)
+        assert st2.cache == "miss"
+        assert st1.cache_key != st2.cache_key
+
+    def test_sharding_change_misses(self, store, params):
+        from jax.sharding import SingleDeviceSharding
+        sh = SingleDeviceSharding(jax.devices()[0])
+        _, st1 = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                  return_stats=True)
+        _, st2 = bd.device_matrix(store, chunk_rows=CHUNK, sharding=sh,
+                                  cache=params, return_stats=True)
+        assert st2.cache == "miss"
+        assert st1.cache_key != st2.cache_key
+        # and the sharded key is itself stable
+        _, st3 = bd.device_matrix(store, chunk_rows=CHUNK, sharding=sh,
+                                  cache=params, return_stats=True)
+        assert st3.cache == "hit"
+
+
+# -- corrupt / torn artifacts ------------------------------------------------ #
+
+def _artifact_dir(params, key):
+    return os.path.join(params.resolved_dir(), key)
+
+
+class TestCorruptArtifacts:
+    def _populate(self, store, params):
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                 return_stats=True)
+        return st.cache_key
+
+    def test_bit_flip_rejected_structured_then_rebuilt(self, store,
+                                                       params):
+        key = self._populate(store, params)
+        wire = os.path.join(_artifact_dir(params, key), fc.WIRE)
+        with open(wire, "r+b") as fh:
+            fh.seek(37)
+            b = fh.read(1)
+            fh.seek(37)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(fc.FeatureCacheError) as ei:
+            fc.FeatureCache(params).load(key)
+        assert ei.value.key == key
+        assert "checksum mismatch" in ei.value.reason
+        # builder: counted fallback rebuild, correct values, repaired
+        ref = bd.device_matrix(store, chunk_rows=CHUNK)
+        got, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                   return_stats=True)
+        assert st.cache == "miss"
+        assert np.asarray(got).tobytes() == np.asarray(ref).tobytes()
+        _, st2 = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                  return_stats=True)
+        assert st2.cache == "hit"
+
+    def test_truncated_wire_rejected(self, store, params):
+        key = self._populate(store, params)
+        wire = os.path.join(_artifact_dir(params, key), fc.WIRE)
+        with open(wire, "r+b") as fh:
+            fh.truncate(os.path.getsize(wire) // 2)
+        with pytest.raises(fc.FeatureCacheError) as ei:
+            fc.FeatureCache(params).load(key)
+        assert "truncated" in ei.value.reason
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                 return_stats=True)
+        assert st.cache == "miss"
+
+    def test_mid_write_kill_dir_without_manifest_rejected(self, store,
+                                                          params):
+        key = self._populate(store, params)
+        adir = _artifact_dir(params, key)
+        os.unlink(os.path.join(adir, fc.ARTIFACT))
+        with pytest.raises(fc.FeatureCacheError) as ei:
+            fc.FeatureCache(params).load(key)
+        assert "torn artifact" in ei.value.reason
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                 return_stats=True)
+        assert st.cache == "miss"
+
+    def test_garbage_manifest_rejected(self, store, params):
+        key = self._populate(store, params)
+        apath = os.path.join(_artifact_dir(params, key), fc.ARTIFACT)
+        with open(apath, "w") as fh:
+            fh.write("{not json")
+        with pytest.raises(fc.FeatureCacheError):
+            fc.FeatureCache(params).load(key)
+
+    def test_staged_tmp_dir_is_not_an_artifact(self, store, params):
+        """A build killed before finalize leaves only the .tmp-<pid>
+        staging dir: probe/load must treat the key as a clean miss."""
+        key = self._populate(store, params)
+        import shutil
+        adir = _artifact_dir(params, key)
+        shutil.move(adir, adir + ".tmp-99999")
+        cache = fc.FeatureCache(params)
+        assert not cache.probe(key)
+        assert cache.load(key) is None
+
+    def test_concurrent_writers_same_key_do_not_collide(self, tmp_path):
+        """Two writers staging the SAME key (two threads in one
+        process) must not rmtree each other's in-progress staging dir;
+        the later finalize simply displaces the earlier artifact."""
+        final = str(tmp_path / "k1")
+        meta = {"n_rows": 4, "n_pad": 4, "n_features": 2,
+                "wire_dtype": "float16", "wire_cols": 2, "kind": "matrix",
+                "wire": "float16", "chunk_rows": 4}
+        w1 = fc.ArtifactWriter(final, "k1", meta)
+        w2 = fc.ArtifactWriter(final, "k1", meta)
+        assert w1.tmp != w2.tmp
+        chunk = np.arange(8, dtype=np.float16).reshape(4, 2)
+        w1.append(chunk)
+        assert os.path.isdir(w1.tmp), "second writer clobbered the first"
+        w2.append(chunk * 2)
+        w1.finalize()
+        w2.finalize()
+        cache = fc.FeatureCache(fc.FeatureCacheParams(
+            dir=str(tmp_path), policy="read"))
+        art = cache.load("k1")
+        np.testing.assert_array_equal(np.asarray(art.wire),
+                                      np.asarray(chunk * 2))
+
+    def test_corrupt_counter_increments(self, store, params):
+        from transmogrifai_tpu.obs.metrics import get_registry
+        key = self._populate(store, params)
+        wire = os.path.join(_artifact_dir(params, key), fc.WIRE)
+        with open(wire, "r+b") as fh:
+            fh.seek(5)
+            fh.write(b"\x7f")
+
+        def corrupt_count():
+            fam = get_registry().to_json().get(
+                "feature_cache_corrupt_total")
+            return fam["series"][0]["value"] if fam else 0
+
+        before = corrupt_count()
+        bd.device_matrix(store, chunk_rows=CHUNK, cache=params)
+        assert corrupt_count() == before + 1
+
+
+# -- quantized wire numerics ------------------------------------------------- #
+
+class TestQuantizedWire:
+    @pytest.mark.parametrize("wire,ratio_floor", [("int8", 1.9),
+                                                  ("int4", 3.5)])
+    def test_quant_wire_within_stated_tolerance(self, store, params,
+                                                wire, ratio_floor):
+        import dataclasses
+        qp = dataclasses.replace(params, wire=wire,
+                                 quant_sample=N_ROWS)
+        x_q, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=qp,
+                                   return_stats=True)
+        x_f16 = bd.device_matrix(store, chunk_rows=CHUNK)
+        # compression: wire bytes vs the f16-equivalent tape
+        ratio = (st.bytes_wire + st.bytes_saved_wire) / st.bytes_wire
+        assert ratio >= ratio_floor
+        assert st.wire == wire
+        # stated tolerance: scale/2 per feature + target rounding slack
+        bits = 8 if wire == "int8" else 4
+        plan = fc.compute_quant_plan(store, bits, sample=N_ROWS)
+        a = np.asarray(x_q[:N_ROWS], np.float32)
+        b = np.asarray(x_f16[:N_ROWS], np.float32)
+        tol = plan.scale[None, :] * 0.5 + 0.02 * np.abs(b) + 1e-2
+        assert (np.abs(a - b) <= tol).all()
+
+    def test_quant_warm_replay_bit_identical_to_quant_cold(self, store,
+                                                           params):
+        import dataclasses
+        qp = dataclasses.replace(params, wire="int4")
+        x1, st1 = bd.device_matrix(store, chunk_rows=CHUNK, cache=qp,
+                                   return_stats=True)
+        x2, st2 = bd.device_matrix(store, chunk_rows=CHUNK, cache=qp,
+                                   return_stats=True)
+        assert (st1.cache, st2.cache) == ("miss", "hit")
+        assert st2.read_s == 0.0
+        assert np.asarray(x2).tobytes() == np.asarray(x1).tobytes()
+
+    def test_quant_dual_binned_matches_quant_direct_binned(self, store,
+                                                           params):
+        """The dual build's binned half under a quantized wire equals
+        the standalone quantized binned build: both bin the SAME
+        dequantized values on device."""
+        import dataclasses
+        qp = dataclasses.replace(params, wire="int8")
+        edges = _edges(store)
+        _, b_dual, _ = bd.dual_device_matrices(
+            store, edges, chunk_rows=CHUNK, cache=qp, return_stats=True)
+        b_direct = bd.device_binned(store, edges, chunk_rows=CHUNK,
+                                    cache=dataclasses.replace(
+                                        qp, dir=qp.dir + "-2"))
+        np.testing.assert_array_equal(np.asarray(b_dual),
+                                      np.asarray(b_direct))
+
+    def test_pack_unpack_roundtrip(self):
+        rng = np.random.default_rng(0)
+        q = rng.integers(0, 16, size=(7, 9), dtype=np.uint8)
+        packed = fc._pack4(q)
+        assert packed.shape == (7, 5)
+        np.testing.assert_array_equal(fc._unpack4_host(packed, 9), q)
+
+    def test_nan_feature_does_not_poison_quant_plan(self, tmp_path,
+                                                    params):
+        import dataclasses
+        path = str(tmp_path / "nans")
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(2048, 4)).astype(np.float16)
+        X[5, 2] = np.nan           # one NaN in an otherwise sane column
+        X[:, 3] = np.nan           # an all-NaN column
+        w = ColumnarStore.create(path, 2048, 4)
+        w.write_chunk(0, X, np.zeros(2048, np.float32))
+        store = w.close()
+        plan = fc.compute_quant_plan(store, 8, sample=2048)
+        assert np.isfinite(plan.scale).all() and np.isfinite(plan.lo).all()
+        qp = dataclasses.replace(params, wire="int8", quant_sample=2048)
+        xq, st = bd.device_matrix(store, chunk_rows=1024, cache=qp,
+                                  return_stats=True)
+        got = np.asarray(xq[:2048], np.float32)
+        assert np.isfinite(got).all()
+        # the clean columns still honor the tolerance contract
+        ref = np.asarray(X[:, :2], np.float32)
+        tol = plan.scale[None, :2] * 0.5 + 0.02 * np.abs(ref) + 1e-2
+        assert (np.abs(got[:, :2] - ref) <= tol).all()
+
+    def test_explicit_f16_wire_narrows_a_wider_store(self, tmp_path,
+                                                     params):
+        """wire='f16' must actually ship 2-byte chunks for an f32 store
+        (the narrowest-dtype rule alone would keep the 4-byte wire)."""
+        import dataclasses
+        import jax.numpy as jnp
+        path = str(tmp_path / "f32store")
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(2048, 4)).astype(np.float32)
+        w = ColumnarStore.create(path, 2048, 4, dtype="float32")
+        w.write_chunk(0, X, np.zeros(2048, np.float32))
+        store = w.close()
+        fp = dataclasses.replace(params, wire="f16")
+        _, st16 = bd.device_matrix(store, dtype=jnp.float32,
+                                   chunk_rows=1024, cache=fp,
+                                   return_stats=True)
+        _, st32 = bd.device_matrix(store, dtype=jnp.float32,
+                                   chunk_rows=1024, return_stats=True)
+        assert st16.wire == "float16"
+        assert st16.bytes_wire * 2 == st32.bytes_wire
+        art = fc.FeatureCache(fp).load(st16.cache_key)
+        assert art.meta["wire_dtype"] == "float16"
+
+    def test_quant_plan_constant_feature_exact(self, tmp_path):
+        path = str(tmp_path / "const")
+        w = ColumnarStore.create(path, 64, 3)
+        X = np.zeros((64, 3), np.float16)
+        X[:, 1] = 2.5            # constant feature
+        X[:, 2] = np.arange(64)
+        w.write_chunk(0, X, np.zeros(64, np.float32))
+        store = w.close()
+        plan = fc.compute_quant_plan(store, 8, sample=64)
+        deq = plan.dequantize_host(plan.quantize(X.astype(np.float32)), 3)
+        np.testing.assert_allclose(deq[:, 1], 2.5, atol=0)
+        np.testing.assert_allclose(deq[:, 0], 0.0, atol=0)
+
+
+# -- resident registry ------------------------------------------------------- #
+
+class TestResident:
+    def test_resident_reuse_returns_same_arrays(self, store, params):
+        import dataclasses
+        rp = dataclasses.replace(params, resident=True)
+        x1, st1 = bd.device_matrix(store, chunk_rows=CHUNK, cache=rp,
+                                   return_stats=True)
+        try:
+            x2, st2 = bd.device_matrix(store, chunk_rows=CHUNK, cache=rp,
+                                       return_stats=True)
+            assert st2.cache == "resident"
+            assert x2 is x1, "resident hit must reuse the live buffer"
+            # release → next call falls back to the disk artifact
+            assert fc.resident_release(st1.cache_key) == 1
+            _, st3 = bd.device_matrix(store, chunk_rows=CHUNK, cache=rp,
+                                      return_stats=True)
+            assert st3.cache == "hit"
+        finally:
+            fc.resident_release(st1.cache_key)
+
+    def test_resident_off_by_default(self, store, params):
+        _, st1 = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                  return_stats=True)
+        assert fc.resident_get(st1.cache_key) is None
+
+
+# -- policy resolution / params threading ------------------------------------ #
+
+class TestPolicyThreading:
+    def test_process_default_scope(self, store, params):
+        with fc.cache_scope(params.to_json()):
+            assert fc.get_default_cache_params().policy == "readwrite"
+            _, st = bd.device_matrix(store, chunk_rows=CHUNK,
+                                     return_stats=True)  # cache=None
+            assert st.cache == "miss"
+            _, st2 = bd.device_matrix(store, chunk_rows=CHUNK,
+                                      return_stats=True)
+            assert st2.cache == "hit"
+        assert fc.get_default_cache_params() is None
+        _, st3 = bd.device_matrix(store, chunk_rows=CHUNK,
+                                  return_stats=True)
+        assert st3.cache == ""  # scope restored: cache off again
+
+    def test_policy_string_uses_default_dir(self, store, params,
+                                            monkeypatch):
+        monkeypatch.setenv(fc.ENV_DIR, params.resolved_dir())
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK,
+                                 cache="readwrite", return_stats=True)
+        assert st.cache == "miss"
+        _, st2 = bd.device_matrix(store, chunk_rows=CHUNK, cache="read",
+                                  return_stats=True)
+        assert st2.cache == "hit"
+
+    def test_env_policy(self, store, params, monkeypatch):
+        monkeypatch.setenv(fc.ENV_POLICY, "readwrite")
+        monkeypatch.setenv(fc.ENV_DIR, params.resolved_dir())
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK,
+                                 return_stats=True)
+        assert st.cache == "miss"
+
+    def test_env_wire_typo_degrades_not_crashes(self, store, params,
+                                                monkeypatch):
+        monkeypatch.setenv(fc.ENV_POLICY, "readwrite")
+        monkeypatch.setenv(fc.ENV_DIR, params.resolved_dir())
+        monkeypatch.setenv(fc.ENV_WIRE, "int16")  # typo
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK,
+                                 return_stats=True)
+        assert st.cache in ("miss", "hit")  # built, uncompressed wire
+        assert st.wire != "int16"
+
+    def test_dir_only_json_enables_readwrite(self, store, tmp_path):
+        """A feature_cache block with only `dir` enables the cache on
+        EVERY JSON path (from_json is the single normalization point —
+        cache_scope, OpParams, and ServingConfig all route through it),
+        matching the CLI's --feature-cache-dir-alone behavior."""
+        p = fc.FeatureCacheParams.from_json({"dir": str(tmp_path / "d"),
+                                             "resident": True})
+        assert p.policy == "readwrite" and p.enabled
+        with fc.cache_scope({"dir": str(tmp_path / "fc-d")}):
+            installed = fc.get_default_cache_params()
+            assert installed is not None
+            assert installed.policy == "readwrite"
+        # an explicit off stays off
+        assert fc.FeatureCacheParams.from_json(
+            {"dir": str(tmp_path / "d"), "policy": "off"}).enabled is False
+        with fc.cache_scope({"dir": str(tmp_path / "fc-d"),
+                             "policy": "off"}):
+            assert fc.resolve_cache_params(None) is None
+
+    def test_overlapping_scopes_do_not_wipe_live_policy(self, tmp_path):
+        """An earlier scope unwinding must not clobber a LATER scope's
+        still-active policy (unordered exits across threads)."""
+        a = fc.FeatureCacheParams(dir=str(tmp_path / "a"),
+                                  policy="readwrite")
+        b = fc.FeatureCacheParams(dir=str(tmp_path / "b"), policy="read")
+        prev = fc.set_default_cache_params(None)
+        try:
+            scope_a = fc.cache_scope(a)
+            scope_a.__enter__()
+            scope_b = fc.cache_scope(b)
+            scope_b.__enter__()
+            scope_a.__exit__(None, None, None)  # A exits while B active
+            assert fc.get_default_cache_params() is b, \
+                "A's exit wiped B's live policy"
+            scope_b.__exit__(None, None, None)
+        finally:
+            fc.set_default_cache_params(prev)
+
+    def test_commit_race_loser_does_not_strand_old_dir(self, tmp_path,
+                                                       monkeypatch):
+        """Losing the rename race against a concurrent committer of the
+        same key must keep the winner's artifact, raise the ORIGINAL
+        error, and not strand the displaced `.old-<pid>` copy."""
+        import os as _os
+        from transmogrifai_tpu.runtime import integrity as integ
+        final = str(tmp_path / "k")
+        tmp = str(tmp_path / "k.tmp-1")
+        os.makedirs(final)
+        open(os.path.join(final, "v1"), "w").write("old")
+        os.makedirs(tmp)
+        open(os.path.join(tmp, "v2"), "w").write("mine")
+        real_rename = _os.rename
+
+        def racing_rename(src, dst):
+            if src == tmp:
+                # concurrent winner repopulates `final` first, then our
+                # rename of tmp into the non-empty dir fails
+                os.makedirs(final, exist_ok=True)
+                open(os.path.join(final, "winner"), "w").write("w")
+                raise OSError(39, "Directory not empty")
+            return real_rename(src, dst)
+
+        monkeypatch.setattr(integ.os, "rename", racing_rename)
+        with pytest.raises(OSError, match="not empty"):
+            integ.commit_staged_dir(tmp, final)
+        monkeypatch.undo()
+        assert os.path.exists(os.path.join(final, "winner"))
+        leftovers = [p for p in os.listdir(str(tmp_path))
+                     if ".old-" in p]
+        assert not leftovers, f"stranded displaced dirs: {leftovers}"
+
+    def test_finalize_commit_failure_cleans_staged_dir(self, tmp_path,
+                                                       monkeypatch):
+        """A failed artifact commit (e.g. losing a concurrent rename
+        race) must not orphan the fully staged multi-GB tmp dir."""
+        final = str(tmp_path / "kx")
+        w = fc.ArtifactWriter(final, "kx", {"n_pad": 2, "wire_cols": 2,
+                                            "wire_dtype": "float16"})
+        w.append(np.zeros((2, 2), np.float16))
+        tmp_dir = w.tmp
+
+        def boom(tmp, final):
+            raise OSError("rename race lost")
+        monkeypatch.setattr(fc, "_commit_staged_dir", boom)
+        with pytest.raises(OSError):
+            w.finalize()
+        assert not os.path.exists(tmp_dir), "staged dir leaked"
+        assert not os.path.exists(final)
+
+    def test_opparams_roundtrip(self):
+        from transmogrifai_tpu.workflow.params import OpParams
+        p = OpParams.from_json({"feature_cache": {
+            "policy": "readwrite", "dir": "/tmp/fcx", "wire": "int8",
+            "resident": True}})
+        j = p.to_json()
+        p2 = OpParams.from_json(j)
+        assert p2.feature_cache.wire == "int8"
+        assert p2.feature_cache.resident is True
+
+    def test_bad_policy_and_wire_raise(self):
+        with pytest.raises(ValueError):
+            fc.FeatureCacheParams(policy="always")
+        with pytest.raises(ValueError):
+            fc.FeatureCacheParams(wire="fp8")
+        with pytest.raises(ValueError):
+            fc.resolve_cache_params("sometimes")
+
+    def test_serving_config_installs_default(self, params):
+        from transmogrifai_tpu.serving.service import (
+            ScoringService, ServingConfig)
+        prev = fc.set_default_cache_params(None)
+        try:
+            ScoringService(config=ServingConfig(
+                feature_cache=params.to_json()))
+            installed = fc.get_default_cache_params()
+            assert installed is not None
+            assert installed.policy == "readwrite"
+        finally:
+            fc.set_default_cache_params(prev)
+
+
+# -- observability ----------------------------------------------------------- #
+
+class TestGoodput:
+    def test_cache_hit_savings_in_report(self, store, params):
+        from transmogrifai_tpu.obs import goodput as obsg
+        from transmogrifai_tpu.obs.trace import TRACER
+        with TRACER.span("run:cache-test", category="run",
+                         new_trace=True) as root:
+            bd.device_matrix(store, chunk_rows=CHUNK, cache=params)
+            _, st = bd.device_matrix(store, chunk_rows=CHUNK,
+                                     cache=params, return_stats=True)
+            assert st.cache == "hit"
+        report = obsg.build_report(root, TRACER.trace_spans(root.trace_id))
+        assert report.counts.get("cache_hits") == 1
+        assert report.counts.get("cache_misses") == 1
+        assert "cache_saved_s" in report.savings
+        assert report.savings["cache_saved_s"] >= 0.0
+
+    def test_artifact_records_cold_wall(self, store, params):
+        _, st = bd.device_matrix(store, chunk_rows=CHUNK, cache=params,
+                                 return_stats=True)
+        art = fc.FeatureCache(params).load(st.cache_key)
+        assert art.cold_wall_s > 0.0
+        assert art.meta["cold"]["bytes_wire"] == st.bytes_wire

@@ -682,3 +682,55 @@ def test_score_stream_and_score_function_validate(monkeypatch):
         list(model.score_stream([ds]))
     with pytest.raises(GraphValidationError):
         model.score_function()
+
+
+def test_lint_uncached_rebuild_same_store():
+    """L010: repeated device-matrix builds from the same store in one
+    scope with no cache= policy — each repeat re-streams the store."""
+    src = '''
+def big_fit(store, edges):
+    Xb = device_binned(store, edges)
+    use(Xb)
+    X16 = bd.device_matrix(store)
+    return X16
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L010"]
+    assert len(findings) == 1
+    assert "store" in findings[0].message
+
+
+def test_lint_uncached_rebuild_not_flagged():
+    """No L010 when a cache= policy is present, when the stores differ,
+    for a single build, or across separate function scopes."""
+    src = '''
+def cached(store, edges, cache):
+    Xb = device_binned(store, edges, cache=cache)
+    X16 = device_matrix(store)
+    return X16, Xb
+
+def two_stores(s1, s2):
+    return device_matrix(s1), device_matrix(s2)
+
+def once(store):
+    return dual_device_matrices(store, None)
+
+def scope_a(store):
+    return device_matrix(store)
+
+def scope_b(store):
+    return device_matrix(store)
+'''
+    assert "L010" not in _lint_codes(src)
+
+
+def test_lint_uncached_rebuild_nested_scope_judged_apart():
+    """A builder call inside a nested def belongs to the nested scope,
+    not the enclosing one."""
+    src = '''
+def outer(store):
+    X = device_matrix(store)
+    def inner():
+        return device_matrix(store)
+    return X, inner
+'''
+    assert "L010" not in _lint_codes(src)

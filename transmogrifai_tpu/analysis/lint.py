@@ -67,6 +67,16 @@ silent slowness or nondeterminism once XLA is in the loop:
   stay legal: an epoch TIMESTAMP (``started_at``, log stamps) is what
   the wall clock is for.
 
+- ``L010 uncached-rebuild``: two or more device-matrix builder calls
+  (``device_matrix`` / ``device_binned`` / ``dual_device_matrices``)
+  on the SAME store variable inside one function scope with none of
+  them carrying a ``cache=`` policy. Each uncached call re-streams the
+  whole store host→device — at 10M×500 that is ~635 s per repeat
+  (BENCH_r05) — while the content-addressed feature cache
+  (`data/feature_cache.py`) replays the wire artifact with zero store
+  reads. Pass ``cache=`` (a policy string or `FeatureCacheParams`) so
+  the rebuild is a deliberate choice, not an accident.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -118,6 +128,10 @@ _INGEST_ITER_CALLS = {"iter_chunks", "stream"}
 _INGEST_ITER_NAMES = {"chunks", "batches"}
 _SERIAL_UPLOAD_CALLS = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
                         "jax.numpy.array", "jax.device_put", "device_put"}
+
+# L010: the out-of-core device-matrix builders the feature cache fronts
+_MATRIX_BUILDER_CALLS = {"device_matrix", "device_binned",
+                         "dual_device_matrices"}
 
 
 @dataclass
@@ -311,6 +325,7 @@ class _FileLinter(ast.NodeVisitor):
             self._check_traced_branches(
                 node, traced_params={a.arg for a in node.args.args}
                 - statics - {"self"})
+        self._check_uncached_rebuild(node)
         self.generic_visit(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
@@ -444,6 +459,51 @@ class _FileLinter(ast.NodeVisitor):
                         "transient classification)")
                 continue  # handler internals already judged
             stack.extend(ast.iter_child_nodes(sub))
+
+    # -- L010 -------------------------------------------------------------- #
+
+    def _check_uncached_rebuild(self, fn: ast.FunctionDef) -> None:
+        """Repeated device-matrix builds from the same store variable in
+        one scope with no `cache=` policy on any of them: each repeat
+        re-streams the whole store host→device when the feature cache
+        would replay the built wire tape instead."""
+        groups: Dict[str, List[Tuple[ast.Call, bool]]] = {}
+        # own scope only: nested defs get their own visit (and their own
+        # store bindings), so walking into them would double-report
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func)
+            if dotted is None or \
+                    dotted.rsplit(".", 1)[-1] not in _MATRIX_BUILDER_CALLS:
+                continue
+            if not sub.args:
+                continue
+            store = _dotted(sub.args[0])
+            if store is None:
+                continue
+            cached = any(kw.arg == "cache" for kw in sub.keywords)
+            groups.setdefault(store, []).append((sub, cached))
+        for store, calls in groups.items():
+            uncached = [c for c, cached in sorted(
+                calls, key=lambda p: p[0].lineno) if not cached]
+            if len(uncached) < 2:
+                continue
+            for call in uncached[1:]:
+                self._emit(
+                    call, "L010",
+                    f"repeated device-matrix build from `{store}` in "
+                    f"`{fn.name}` with no cache= policy — every call "
+                    "re-streams the whole store host→device; pass "
+                    "cache= (policy string or FeatureCacheParams) so "
+                    "repeats replay the data/feature_cache.py wire "
+                    "artifact instead of re-uploading")
 
     # -- L007 -------------------------------------------------------------- #
 

@@ -2,6 +2,9 @@ from transmogrifai_tpu.data.columns import Column, kind_of
 from transmogrifai_tpu.data.metadata import VectorColumnMetadata, VectorMetadata
 from transmogrifai_tpu.data.dataset import Dataset
 from transmogrifai_tpu.data.pipeline import IngestStats, run_chunk_pipeline
+from transmogrifai_tpu.data.feature_cache import (
+    FeatureCache, FeatureCacheError, FeatureCacheParams)
 
 __all__ = ["Column", "kind_of", "VectorColumnMetadata", "VectorMetadata",
-           "Dataset", "IngestStats", "run_chunk_pipeline"]
+           "Dataset", "IngestStats", "run_chunk_pipeline",
+           "FeatureCache", "FeatureCacheError", "FeatureCacheParams"]

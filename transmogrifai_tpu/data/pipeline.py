@@ -77,6 +77,18 @@ class IngestStats:
     max_in_flight: int = 0
     retries: int = 0          # transient prepare failures retried
     retry_wait_s: float = 0.0  # backoff slept across all retries
+    # feature-cache accounting (data/feature_cache.py): `read_s` /
+    # `bytes_read` always mean STORE memmap reads, so a warm cache hit
+    # shows 0 there and its artifact IO lands in `cache_read_s` /
+    # `cache_bytes` instead — the warm-path proof tests assert exactly
+    # that split
+    wire: str = ""             # wire mode label (f16/int8/int4/...)
+    cache: str = ""            # "", "off", "miss", "hit", "resident"
+    cache_key: str = ""        # content address of this build
+    cache_read_s: float = 0.0  # artifact (warm) read seconds
+    cache_bytes: int = 0       # artifact bytes read on a hit
+    cache_write_s: float = 0.0  # artifact tee seconds on a readwrite miss
+    bytes_saved_wire: int = 0  # f16-equivalent bytes NOT shipped (quant)
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -98,11 +110,24 @@ class IngestStats:
             self.retries += 1
             self.retry_wait_s += delay_s
 
+    def note_cache_read(self, seconds: float, nbytes: int) -> None:
+        with self._lock:
+            self.cache_read_s += seconds
+            self.cache_bytes += nbytes
+
     # derived ----------------------------------------------------------- #
 
     @property
+    def cache_hit(self) -> bool:
+        """This build replayed a cached artifact (disk or resident)
+        instead of sweeping the store."""
+        return self.cache in ("hit", "resident")
+
+    @property
     def host_s(self) -> float:
-        return self.read_s + self.cast_s
+        # cache_read_s counts: warm replays do their (artifact) IO on
+        # the same worker threads, so overlap_frac stays meaningful
+        return self.read_s + self.cast_s + self.cache_read_s
 
     @property
     def overlap_frac(self) -> float:
@@ -144,6 +169,14 @@ class IngestStats:
             "max_in_flight": self.max_in_flight,
             "retries": self.retries,
             "retry_wait_s": round(self.retry_wait_s, 4),
+            **({"wire": self.wire} if self.wire else {}),
+            **({"cache": self.cache,
+                "cache_key": self.cache_key,
+                "cache_read_s": round(self.cache_read_s, 4),
+                "cache_bytes": self.cache_bytes,
+                "cache_write_s": round(self.cache_write_s, 4),
+                "bytes_saved_wire": self.bytes_saved_wire,
+                } if self.cache else {}),
         }
 
 

@@ -28,7 +28,11 @@ events the rest of the codebase already emits:
 
 Savings are tracked separately (they are not part of the wall-time
 decomposition): ``resume_saved_s`` sums the journaled durations of
-sweep blocks a resumed run skipped (``journal_resume`` events).
+sweep blocks a resumed run skipped (``journal_resume`` events), and
+``cache_saved_s`` sums the upload seconds feature-cache hits avoided —
+each artifact records its cold build's wall time, so a warm replay
+reports cold-minus-warm as recovered ingest badput (``cache_hit``
+events from `parallel/bigdata.py`).
 
 The report lands in `RunProfile.to_json()["goodput"]`, bench payloads,
 and beside the CLI's ``--trace-out`` trace.
@@ -102,8 +106,10 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     report = GoodputReport(wall_s=root.duration_s, trace_id=root.trace_id)
     b = {k: 0.0 for k in BADPUT_BUCKETS}
     counts = {"retries": 0, "recompiles": 0, "oom_redos": 0,
-              "resumed_blocks": 0, "faults_injected": 0}
+              "resumed_blocks": 0, "faults_injected": 0,
+              "cache_hits": 0, "cache_misses": 0}
     saved = 0.0
+    cache_saved = 0.0
     seen: set = set()
     for sp in [root, *spans]:
         if sp.span_id in seen or sp.trace_id != root.trace_id:
@@ -131,6 +137,11 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
             elif name == "journal_resume":
                 saved += float(attrs.get("saved_s", 0.0) or 0.0)
                 counts["resumed_blocks"] += int(attrs.get("blocks", 0) or 0)
+            elif name == "cache_hit":
+                cache_saved += float(attrs.get("saved_s", 0.0) or 0.0)
+                counts["cache_hits"] += 1
+            elif name == "cache_miss":
+                counts["cache_misses"] += 1
             elif name == "fault":
                 counts["faults_injected"] += 1
     # badput cannot exceed wall (overlapped worker backoffs can): clamp
@@ -144,5 +155,7 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     report.buckets = b
     if saved > 0.0 or counts["resumed_blocks"]:
         report.savings["resume_saved_s"] = saved
+    if cache_saved > 0.0 or counts["cache_hits"]:
+        report.savings["cache_saved_s"] = cache_saved
     report.counts = {k: v for k, v in counts.items() if v}
     return report

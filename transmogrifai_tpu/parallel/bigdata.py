@@ -19,7 +19,13 @@ dispatching fits over executors). One TPU chip can't hold 10M×500 f32
   is read once; gain/split selection reuses the in-core logic
   (`models/trees.py:split_from_histograms`).
 - leaf sums use the same chunked matmul (TPU scatter-add serializes at
-  10M rows).
+  10M rows);
+- feeds every upload through the persistent content-addressed feature
+  cache (`data/feature_cache.py`, ``cache=`` on the builders): repeat
+  sweeps / resumed runs / serving warmups replay the wire tape from a
+  verified artifact with ZERO store reads (bit-identical buffers), and
+  cold misses can ship an int8/int4 quantized wire with dequant fused
+  into the donated write (2–4× fewer bytes than f16).
 
 Memory plan at 10M×500×32 bins (v5e 16 GB HBM):
     linear family : X bf16 10 GB + y/masks/logits ≈ 0.2 GB     → 10.2 GB
@@ -40,9 +46,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from transmogrifai_tpu.data import feature_cache as fc
 from transmogrifai_tpu.data.columnar_store import ColumnarStore
 from transmogrifai_tpu.data.pipeline import IngestStats, run_chunk_pipeline
 from transmogrifai_tpu.models.trees import split_from_histograms
+from transmogrifai_tpu.obs.export import record_event
 
 log = logging.getLogger(__name__)
 
@@ -96,6 +104,47 @@ def _probe(buf):
     return buf[(0,) * buf.ndim]
 
 
+# -- quantized (compressed) wire: dequant fused into the donated write ------ #
+
+def _unpack_dequant(chunk, scale, lo, bits: int, d: int):
+    """Wire uint8 → f32 features ON DEVICE: unpack int4 nibbles when
+    packed (feature 2j low, 2j+1 high — mirrors
+    `feature_cache._pack4`), then the per-feature affine dequant
+    x = q·scale + lo. Runs inside the donated write, so the host ships
+    1 (int8) or 0.5 (int4) bytes/elem instead of the 2-byte f16 wire."""
+    if bits == 4:
+        lo_nib = chunk & jnp.uint8(0x0F)
+        hi_nib = (chunk >> 4).astype(jnp.uint8)
+        chunk = jnp.stack([lo_nib, hi_nib], axis=-1) \
+            .reshape(chunk.shape[0], -1)[:, :d]
+    return chunk.astype(jnp.float32) * scale + lo
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("bits",))
+def _dequant_write_rows(buf, chunk_q, scale, lo, r0, *, bits):
+    x = _unpack_dequant(chunk_q, scale, lo, bits, buf.shape[1])
+    return jax.lax.dynamic_update_slice(buf, x.astype(buf.dtype), (r0, 0))
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("bits",))
+def _dequant_bin_write_rows(buf, chunk_q, scale, lo, edges, r0, *, bits):
+    from transmogrifai_tpu.models.trees import bin_features
+    x = _unpack_dequant(chunk_q, scale, lo, bits, buf.shape[1])
+    binned = bin_features(x, edges).astype(jnp.int8)
+    return jax.lax.dynamic_update_slice(buf, binned, (r0, 0))
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("bits",))
+def _dequant_dual_write_rows(buf16, bufb, chunk_q, scale, lo, edges, r0, *,
+                             bits):
+    from transmogrifai_tpu.models.trees import bin_features
+    x = _unpack_dequant(chunk_q, scale, lo, bits, buf16.shape[1])
+    binned = bin_features(x, edges).astype(jnp.int8)
+    return (jax.lax.dynamic_update_slice(
+                buf16, x.astype(buf16.dtype), (r0, 0)),
+            jax.lax.dynamic_update_slice(bufb, binned, (r0, 0)))
+
+
 def _zeros(shape, dtype, sharding):
     if sharding is None:
         return jnp.zeros(shape, dtype)
@@ -121,39 +170,45 @@ def _default_ingest_retry():
                        base_delay_s=0.1, max_delay_s=5.0)
 
 
-def _pipelined_upload(store: ColumnarStore, chunk_rows: int,
-                      wire: np.dtype, label: str, bufs: dict, write, *,
+def _pipelined_upload(items, chunk_rows: int, prepare, label: str,
+                      bufs: dict, write, *, n_rows: int,
                       workers: int, depth: int,
                       deadline_s: Optional[float], sharding,
-                      profile, retry=None) -> IngestStats:
+                      profile, retry=None, stats: IngestStats = None,
+                      tee=None) -> IngestStats:
     """Shared scaffold for the upload builders: timed prepare, bounded
     pipeline, progress/summary logging, profile record. `write(bufs,
     chunk_dev, r0)` dispatches the donated write(s), rebinding `bufs`
-    entries, and returns the completion token. Chunk reads retry
-    transient IO under `retry` (default `_default_ingest_retry`);
-    attempts land in the returned stats."""
-    stats = IngestStats(label=label, workers=workers, depth=depth)
+    entries, and returns the completion token. `prepare` is the
+    worker-side chunk producer (store sweep, quantizing sweep, or
+    cache-artifact replay). `tee(chunk)` — the feature-cache artifact
+    append — runs on the main thread in item order BEFORE the device
+    dispatch, so a readwrite miss persists exactly the bytes it ships.
+    Chunk reads retry transient IO under `retry` (default
+    `_default_ingest_retry`); attempts land in the returned stats."""
+    st = stats if stats is not None else IngestStats(label=label)
+    st.label = label
 
     def upload(prep):
         r0, c = prep
+        if tee is not None:
+            tee(c)
         token = write(bufs, _put(c, sharding), r0)
         if r0 and (r0 // chunk_rows) % 8 == 0:
-            log.info("%s: %d/%d rows", label, r0, store.n_rows)
+            log.info("%s: %d/%d rows", label, r0, n_rows)
         return token
 
-    run_chunk_pipeline(range(0, store.n_rows, chunk_rows),
-                       _chunk_prepare(store, chunk_rows, wire, stats),
-                       upload, workers=workers, depth=depth,
-                       deadline_s=deadline_s, label=f"{label} upload",
-                       stats=stats,
+    run_chunk_pipeline(items, prepare, upload, workers=workers,
+                       depth=depth, deadline_s=deadline_s,
+                       label=f"{label} upload", stats=st,
                        retry=retry if retry is not None
                        else _default_ingest_retry())
-    log.info("%s: %d rows in %.1fs (%.2f GB/s, overlap %.2f, retries %d)",
-             label, store.n_rows, stats.wall_s, stats.gbps,
-             stats.overlap_frac, stats.retries)
+    log.info("%s: %d rows in %.1fs (%.2f GB/s, overlap %.2f, retries %d"
+             "%s)", label, n_rows, st.wall_s, st.gbps, st.overlap_frac,
+             st.retries, f", cache {st.cache}" if st.cache else "")
     if profile is not None:
-        profile.record_ingest(f"{label}_upload", stats)
-    return stats
+        profile.record_ingest(f"{label}_upload", st)
+    return st
 
 
 def _chunk_prepare(store: ColumnarStore, chunk_rows: int, wire: np.dtype,
@@ -183,12 +238,277 @@ def _chunk_prepare(store: ColumnarStore, chunk_rows: int, wire: np.dtype,
     return prepare
 
 
+def _quant_prepare(store: ColumnarStore, chunk_rows: int,
+                   plan: "fc.QuantPlan", stats: IngestStats):
+    """prepare(r0) for the compressed wire path: memmap read →
+    per-feature affine quantize (+ int4 nibble pack) → tail pad with the
+    quantized-zero row. Ships 2–4× fewer bytes than the f16 wire; the
+    device side dequantizes inside the donated write."""
+    def prepare(r0: int):
+        t0 = time.perf_counter()
+        c = np.array(store.chunk(r0, r0 + chunk_rows), copy=True)
+        stats.note_read(time.perf_counter() - t0, c.nbytes)
+        t0 = time.perf_counter()
+        q = plan.quantize(c)
+        if len(q) < chunk_rows:
+            q = np.concatenate(
+                [q, np.tile(plan.pad_row, (chunk_rows - len(q), 1))])
+        stats.note_cast(time.perf_counter() - t0, q.nbytes)
+        return r0, q
+
+    return prepare
+
+
+def _artifact_prepare(art: "fc.CacheArtifact", chunk_rows: int,
+                      stats: IngestStats):
+    """prepare(r0) for a cache HIT: replay the artifact's wire tape.
+    The bytes are already wire-ready (cast/quantized/padded at cold
+    build time), so there is no store read and no cast — artifact IO
+    lands in `stats.cache_read_s`, and `stats.read_s`/`bytes_read` stay
+    0 (the warm-path proof the tests assert)."""
+    mm = art.wire
+
+    def prepare(r0: int):
+        t0 = time.perf_counter()
+        c = np.array(mm[r0:r0 + chunk_rows], copy=True)
+        stats.note_cache_read(time.perf_counter() - t0, c.nbytes)
+        stats.note_cast(0.0, c.nbytes)  # wire-ready: nothing to cast
+        return r0, c
+
+    return prepare
+
+
+class _CacheSession:
+    """Per-build feature-cache orchestration shared by the three
+    builders: resolves the `cache=` policy, computes the content
+    address, consults the resident registry and the on-disk cache,
+    picks warm-replay vs cold-sweep prepare, tees the wire stream into
+    a staged artifact on a readwrite miss, and emits the hit/miss/
+    corrupt events + counters the goodput report and serving /metrics
+    read. Corrupt or torn artifacts are REJECTED (structured
+    `FeatureCacheError`, counted) and fall back to a cold rebuild —
+    never a crash, never stale data."""
+
+    def __init__(self, kind: str, store: ColumnarStore, chunk_rows: int, *,
+                 legacy_wire, target_name: str, edges=None, sharding=None,
+                 cache=None):
+        self.kind = kind
+        self.store = store
+        self.chunk_rows = int(chunk_rows)
+        self.edges = edges
+        self.sharding = sharding
+        self.d = store.n_features
+        self.n_pad = _pad_rows(store.n_rows, chunk_rows)
+        self.params = fc.resolve_cache_params(cache)
+        self.legacy_wire = np.dtype(legacy_wire)
+        mode = self.params.wire if self.params is not None else "auto"
+        if mode in ("int8", "int4"):
+            self.wire_mode = mode
+            self.bits: Optional[int] = 8 if mode == "int8" else 4
+        else:
+            if mode == "f16":
+                # explicit f16 wire: force 2-byte chunks even when the
+                # narrowest-dtype rule would keep a wider store dtype
+                # (an f32 store rounds through f16 on the wire — the
+                # same contract the binned/dual builders document)
+                self.legacy_wire = np.dtype(np.float16)
+            self.wire_mode = self.legacy_wire.name
+            self.bits = None
+        self.quant: Optional[fc.QuantPlan] = None
+        self.cache_obj = None
+        self.key = ""
+        if self.params is not None:
+            self.cache_obj = fc.FeatureCache(self.params)
+            self.key = fc.cache_key(
+                kind, store, target_dtype=target_name, wire=self.wire_mode,
+                chunk_rows=self.chunk_rows, edges=edges, sharding=sharding,
+                quant_sample=self.params.quant_sample,
+                quant_seed=self.params.quant_seed)
+        self.artifact: Optional[fc.CacheArtifact] = None
+        self.writer: Optional[fc.ArtifactWriter] = None
+        self._stats: Optional[IngestStats] = None
+
+    # -- resident layer -------------------------------------------------- #
+
+    def resident(self) -> Optional[Tuple[Tuple, IngestStats]]:
+        """HBM-resident arrays for this exact key, when the policy opts
+        in — a sweep resume or serving warm re-requesting the same build
+        gets the live device buffers with zero IO."""
+        if self.params is None or not self.params.resident or not self.key:
+            return None
+        entry = fc.resident_get(self.key)
+        if entry is None:
+            return None
+        stats = IngestStats(label=f"{self.kind}_resident")
+        stats.cache = "resident"
+        stats.cache_key = self.key
+        stats.wire = self.wire_mode
+        saved = float(entry["extra"].get("cold_wall_s", 0.0))
+        fc.count_hit(self.store.nbytes(), saved)
+        record_event("cache_hit", key=self.key, build=self.kind,
+                     resident=True, saved_s=round(saved, 6))
+        return entry["arrays"], stats
+
+    # -- build-time hooks ------------------------------------------------ #
+
+    def _expected_wire_cols(self) -> int:
+        return (self.d + 1) // 2 if self.bits == 4 else self.d
+
+    def _check_meta(self, art: "fc.CacheArtifact") -> None:
+        meta = art.meta
+        expect = {"kind": self.kind, "n_pad": self.n_pad,
+                  "n_features": self.d, "wire": self.wire_mode,
+                  "wire_cols": self._expected_wire_cols(),
+                  "chunk_rows": self.chunk_rows}
+        for field_, want in expect.items():
+            if meta.get(field_) != want:
+                raise fc.FeatureCacheError(
+                    art.path, f"meta {field_}={meta.get(field_)!r} does "
+                              f"not match the requested build ({want!r})",
+                    self.key)
+        if self.bits is not None and art.quant is None:
+            raise fc.FeatureCacheError(
+                art.path, "quantized wire artifact lacks quant.npz",
+                self.key)
+
+    def _meta(self) -> dict:
+        return {
+            "kind": self.kind,
+            "store_fingerprint": fc.store_fingerprint(self.store),
+            "n_rows": int(self.store.n_rows),
+            "n_pad": int(self.n_pad),
+            "n_features": int(self.d),
+            "store_dtype": self.store.dtype.name,
+            "wire": self.wire_mode,
+            "wire_dtype": ("uint8" if self.bits is not None
+                           else self.legacy_wire.name),
+            "wire_cols": self._expected_wire_cols(),
+            "chunk_rows": self.chunk_rows,
+            "edges_sha": fc._edges_digest(self.edges),
+            "sharding": (None if self.sharding is None
+                         else str(self.sharding)),
+        }
+
+    def begin(self, stats: IngestStats):
+        """Resolve warm vs cold. Returns (prepare, items) for
+        `_pipelined_upload`."""
+        self._stats = stats
+        stats.wire = self.wire_mode
+        stats.cache_key = self.key
+        if self.cache_obj is not None:
+            try:
+                art = self.cache_obj.load(self.key)
+                if art is not None:
+                    self._check_meta(art)
+                self.artifact = art
+            except fc.FeatureCacheError as e:
+                fc.count_corrupt()
+                record_event("cache_corrupt", key=self.key,
+                             build=self.kind, reason=e.reason)
+                log.warning("feature cache: %s — rebuilding", e)
+                self.artifact = None
+        if self.artifact is not None:
+            self.quant = self.artifact.quant
+            stats.cache = "hit"
+            return (_artifact_prepare(self.artifact, self.chunk_rows,
+                                      stats),
+                    range(0, self.n_pad, self.chunk_rows))
+        if self.bits is not None:
+            self.quant = fc.compute_quant_plan(
+                self.store, self.bits, sample=self.params.quant_sample,
+                seed=self.params.quant_seed)
+        if self.cache_obj is not None:
+            stats.cache = "miss"
+            if self.params.writable:
+                try:
+                    self.writer = self.cache_obj.writer(self.key,
+                                                        self._meta())
+                except OSError:
+                    log.warning("feature cache: cannot stage artifact "
+                                "under %s; building uncached",
+                                self.params.resolved_dir(), exc_info=True)
+                    self.writer = None
+        if self.quant is not None:
+            prepare = _quant_prepare(self.store, self.chunk_rows,
+                                     self.quant, stats)
+        else:
+            prepare = _chunk_prepare(self.store, self.chunk_rows,
+                                     self.legacy_wire, stats)
+        return prepare, range(0, self.store.n_rows, self.chunk_rows)
+
+    def quant_device(self):
+        """(scale, lo) as device arrays for the fused-dequant writes."""
+        return jnp.asarray(self.quant.scale), jnp.asarray(self.quant.lo)
+
+    def tee(self, chunk: np.ndarray) -> None:
+        """Artifact append off the upload stream (main thread, item
+        order). A failing disk degrades to an uncached build — it must
+        not kill a multi-hundred-second upload."""
+        if self.writer is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            self.writer.append(chunk)
+        except OSError:
+            log.warning("feature cache: artifact append failed; "
+                        "continuing uncached", exc_info=True)
+            self.writer.abort()
+            self.writer = None
+            return
+        if self._stats is not None:
+            self._stats.cache_write_s += time.perf_counter() - t0
+
+    def finish(self, stats: IngestStats, arrays: Tuple) -> None:
+        """Post-pipeline bookkeeping: finalize the staged artifact
+        (integrity manifest LAST → crash-consistent rename), emit
+        hit/miss events + counters, stamp wire savings, and publish
+        resident arrays when the policy keeps them."""
+        if self.bits is not None:
+            f16_equiv = self.n_pad * self.d * 2
+            stats.bytes_saved_wire = max(0, f16_equiv - stats.bytes_wire)
+        if self.params is None:
+            return
+        if stats.cache == "hit":
+            saved = max(0.0, self.artifact.cold_wall_s - stats.wall_s)
+            fc.count_hit(self.store.nbytes(), saved)
+            record_event("cache_hit", key=self.key, build=self.kind,
+                         saved_s=round(saved, 6), bytes=stats.cache_bytes)
+        else:
+            fc.count_miss()
+            record_event("cache_miss", key=self.key, build=self.kind)
+            if self.writer is not None:
+                try:
+                    self.writer.finalize(
+                        quant=self.quant,
+                        cold={"wall_s": round(stats.wall_s, 6),
+                              "gbps": round(stats.gbps, 6),
+                              "bytes_wire": stats.bytes_wire})
+                except OSError:
+                    log.warning("feature cache: artifact finalize failed; "
+                                "next run rebuilds", exc_info=True)
+                finally:
+                    self.writer = None
+        if self.params.resident and self.key:
+            cold_wall = (self.artifact.cold_wall_s
+                         if self.artifact is not None else stats.wall_s)
+            fc.resident_put(self.key, arrays,
+                            cold_wall_s=cold_wall or stats.wall_s)
+
+    def abort(self) -> None:
+        """Build died (deadline, worker error): remove the staged
+        artifact so a torn tape can never be mistaken for a cache
+        entry."""
+        if self.writer is not None:
+            self.writer.abort()
+            self.writer = None
+
+
 def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
                   chunk_rows: int = UPLOAD_CHUNK_ROWS,
                   deadline_s: Optional[float] = None, *,
                   workers: int = UPLOAD_WORKERS, depth: int = UPLOAD_DEPTH,
                   sharding=None, profile=None, return_stats: bool = False,
-                  retry=None):
+                  retry=None, cache=None):
     """Stream the store into one (n_pad, d) device buffer through the
     bounded-depth chunk pipeline (`data/pipeline.py`): worker threads
     read+cast upcoming chunks while up to `depth` donated writes are in
@@ -211,20 +531,58 @@ def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
     varies 100× between sessions (r4: 18-44 MB/s; r5 observed ~5 MB/s).
     Depth backpressure makes the per-chunk check track real transfer
     progress, so TimeoutError fires mid-upload for the caller to turn
-    into an explicit skip marker."""
-    n_pad = _pad_rows(store.n_rows, chunk_rows)
+    into an explicit skip marker.
+
+    `cache`: feature-cache policy (None → process default/env;
+    "off"/"read"/"readwrite"; or a `FeatureCacheParams`). On a hit the
+    build replays the content-addressed wire artifact — zero store
+    reads — and is bit-identical to the cold build that wrote it; on a
+    readwrite miss the wire stream tees into a crash-consistent
+    artifact for free. `FeatureCacheParams(wire="int8"/"int4")` ships a
+    quantized wire with dequant fused into the donated write (2–4×
+    fewer bytes; max abs error scale/2 per feature — see
+    data/feature_cache.py)."""
     target = np.dtype(dtype)
-    wire = target if target.itemsize < store.dtype.itemsize else store.dtype
+    legacy_wire = (target if target.itemsize < store.dtype.itemsize
+                   else store.dtype)
+    sess = _CacheSession("matrix", store, chunk_rows,
+                         legacy_wire=legacy_wire, target_name=target.name,
+                         sharding=sharding, cache=cache)
+    res = sess.resident()
+    if res is not None:
+        (x,), stats = res
+        if profile is not None:
+            profile.record_ingest("device_matrix_upload", stats)
+        return (x, stats) if return_stats else x
+    stats = IngestStats(label="device_matrix")
+    prepare, items = sess.begin(stats)
+    n_pad = sess.n_pad
     bufs = {"x": _zeros((n_pad, store.n_features), dtype, sharding)}
 
-    def write(bufs, cdev, r0):
-        bufs["x"] = _write_cast_rows(bufs["x"], cdev, r0)
-        return _probe(bufs["x"])
+    if sess.quant is None:
+        def write(bufs, cdev, r0):
+            bufs["x"] = _write_cast_rows(bufs["x"], cdev, r0)
+            return _probe(bufs["x"])
+    else:
+        scale_dev, lo_dev = sess.quant_device()
+        bits = sess.quant.bits
 
-    stats = _pipelined_upload(store, chunk_rows, wire, "device_matrix",
-                              bufs, write, workers=workers, depth=depth,
-                              deadline_s=deadline_s, sharding=sharding,
-                              profile=profile, retry=retry)
+        def write(bufs, cdev, r0):
+            bufs["x"] = _dequant_write_rows(bufs["x"], cdev, scale_dev,
+                                            lo_dev, r0, bits=bits)
+            return _probe(bufs["x"])
+
+    try:
+        _pipelined_upload(items, chunk_rows, prepare, "device_matrix",
+                          bufs, write, n_rows=store.n_rows,
+                          workers=workers, depth=depth,
+                          deadline_s=deadline_s, sharding=sharding,
+                          profile=profile, retry=retry, stats=stats,
+                          tee=sess.tee)
+    except BaseException:
+        sess.abort()
+        raise
+    sess.finish(stats, (bufs["x"],))
     return (bufs["x"], stats) if return_stats else bufs["x"]
 
 
@@ -233,26 +591,56 @@ def device_binned(store: ColumnarStore, edges: np.ndarray,
                   deadline_s: Optional[float] = None, *,
                   workers: int = UPLOAD_WORKERS, depth: int = UPLOAD_DEPTH,
                   sharding=None, profile=None, return_stats: bool = False,
-                  retry=None):
+                  retry=None, cache=None):
     """(n_pad, d) int8 quantile-binned device buffer through the same
     chunk pipeline as `device_matrix`. Chunks ship as f16 and bin ON
     DEVICE (broadcast-compare, VPU): the r3 host `searchsorted` loop
     cost ~420 s at 10M×500 while f16 wire + device-side binning costs
-    one pipelined upload pass. `deadline_s`/`sharding`/`profile` as in
-    `device_matrix`."""
-    n_pad = _pad_rows(store.n_rows, chunk_rows)
+    one pipelined upload pass. `deadline_s`/`sharding`/`profile`/
+    `cache` as in `device_matrix`; a cache hit replays the f16 wire
+    tape, so the binned matrix is BIT-IDENTICAL to the direct build
+    (same wire bytes through the same device binning)."""
+    sess = _CacheSession("binned", store, chunk_rows,
+                         legacy_wire=np.dtype(np.float16),
+                         target_name="int8", edges=edges,
+                         sharding=sharding, cache=cache)
+    res = sess.resident()
+    if res is not None:
+        (b,), stats = res
+        if profile is not None:
+            profile.record_ingest("device_binned_upload", stats)
+        return (b, stats) if return_stats else b
+    stats = IngestStats(label="device_binned")
+    prepare, items = sess.begin(stats)
+    n_pad = sess.n_pad
     edges_dev = jnp.asarray(edges)
     bufs = {"b": _zeros((n_pad, store.n_features), jnp.int8, sharding)}
 
-    def write(bufs, cdev, r0):
-        bufs["b"] = _bin_write_rows(bufs["b"], cdev, edges_dev, r0)
-        return _probe(bufs["b"])
+    if sess.quant is None:
+        def write(bufs, cdev, r0):
+            bufs["b"] = _bin_write_rows(bufs["b"], cdev, edges_dev, r0)
+            return _probe(bufs["b"])
+    else:
+        scale_dev, lo_dev = sess.quant_device()
+        bits = sess.quant.bits
 
-    stats = _pipelined_upload(store, chunk_rows, np.dtype(np.float16),
-                              "device_binned", bufs, write,
-                              workers=workers, depth=depth,
-                              deadline_s=deadline_s, sharding=sharding,
-                              profile=profile, retry=retry)
+        def write(bufs, cdev, r0):
+            bufs["b"] = _dequant_bin_write_rows(
+                bufs["b"], cdev, scale_dev, lo_dev, edges_dev, r0,
+                bits=bits)
+            return _probe(bufs["b"])
+
+    try:
+        _pipelined_upload(items, chunk_rows, prepare, "device_binned",
+                          bufs, write, n_rows=store.n_rows,
+                          workers=workers, depth=depth,
+                          deadline_s=deadline_s, sharding=sharding,
+                          profile=profile, retry=retry, stats=stats,
+                          tee=sess.tee)
+    except BaseException:
+        sess.abort()
+        raise
+    sess.finish(stats, (bufs["b"],))
     return (bufs["b"], stats) if return_stats else bufs["b"]
 
 
@@ -263,7 +651,7 @@ def dual_device_matrices(store: ColumnarStore, edges: np.ndarray,
                          workers: int = UPLOAD_WORKERS,
                          depth: int = UPLOAD_DEPTH, sharding=None,
                          profile=None, return_stats: bool = False,
-                         retry=None):
+                         retry=None, cache=None):
     """ONE pass over the store → BOTH device representations: the
     (n_pad, d) `dtype` (bf16) linear-family matrix AND the (n_pad, d)
     int8 quantile-binned matrix. Halves host IO versus running
@@ -279,25 +667,59 @@ def dual_device_matrices(store: ColumnarStore, edges: np.ndarray,
 
     Both buffers must be HBM-resident simultaneously (3 bytes/elem
     total) — at 10M×500 that is ~15 GB before tree working set, so the
-    bench gates this path on the memory plan fitting."""
+    bench gates this path on the memory plan fitting.
+
+    `cache` as in `device_matrix`: the artifact is the SINGLE wire tape
+    (the one f16 — or quantized — stream that fans out device-side into
+    both representations), so caching the dual build costs one compact
+    file, and a hit reproduces BOTH matrices bit-identically with zero
+    store reads."""
     d = store.n_features
-    n_pad = _pad_rows(store.n_rows, chunk_rows)
+    target = np.dtype(dtype)
+    sess = _CacheSession("dual", store, chunk_rows,
+                         legacy_wire=np.dtype(np.float16),
+                         target_name=target.name, edges=edges,
+                         sharding=sharding, cache=cache)
+    res = sess.resident()
+    if res is not None:
+        (x, b), stats = res
+        if profile is not None:
+            profile.record_ingest("dual_upload", stats)
+        return (x, b, stats) if return_stats else (x, b)
+    stats = IngestStats(label="dual")
+    prepare, items = sess.begin(stats)
+    n_pad = sess.n_pad
     edges_dev = jnp.asarray(edges)
     bufs = {"x": _zeros((n_pad, d), dtype, sharding),
             "b": _zeros((n_pad, d), jnp.int8, sharding)}
 
-    def write(bufs, cdev, r0):
-        bufs["x"], bufs["b"] = _dual_write_rows(bufs["x"], bufs["b"],
-                                                cdev, edges_dev, r0)
-        # one executable produces both buffers: either probe tokens the
-        # completion of the pair
-        return _probe(bufs["b"])
+    if sess.quant is None:
+        def write(bufs, cdev, r0):
+            bufs["x"], bufs["b"] = _dual_write_rows(bufs["x"], bufs["b"],
+                                                    cdev, edges_dev, r0)
+            # one executable produces both buffers: either probe tokens
+            # the completion of the pair
+            return _probe(bufs["b"])
+    else:
+        scale_dev, lo_dev = sess.quant_device()
+        bits = sess.quant.bits
 
-    stats = _pipelined_upload(store, chunk_rows, np.dtype(np.float16),
-                              "dual", bufs, write, workers=workers,
-                              depth=depth, deadline_s=deadline_s,
-                              sharding=sharding, profile=profile,
-                              retry=retry)
+        def write(bufs, cdev, r0):
+            bufs["x"], bufs["b"] = _dequant_dual_write_rows(
+                bufs["x"], bufs["b"], cdev, scale_dev, lo_dev, edges_dev,
+                r0, bits=bits)
+            return _probe(bufs["b"])
+
+    try:
+        _pipelined_upload(items, chunk_rows, prepare, "dual", bufs, write,
+                          n_rows=store.n_rows, workers=workers,
+                          depth=depth, deadline_s=deadline_s,
+                          sharding=sharding, profile=profile, retry=retry,
+                          stats=stats, tee=sess.tee)
+    except BaseException:
+        sess.abort()
+        raise
+    sess.finish(stats, (bufs["x"], bufs["b"]))
     if return_stats:
         return bufs["x"], bufs["b"], stats
     return bufs["x"], bufs["b"]

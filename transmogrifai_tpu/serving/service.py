@@ -59,6 +59,14 @@ class ServingConfig:
     default_deadline_ms: float = 2000.0  # per-request deadline
     warm_on_load: bool = True      # AOT-compile every bucket at load
     keep_versions: int = 2         # live + rollback
+    # optional data/feature_cache.py policy (a FeatureCacheParams JSON
+    # dict) installed as the process default at service construction:
+    # any store-backed scoring this process runs through the
+    # parallel/bigdata.py builders then reuses cached — and, with
+    # resident=True, HBM-resident — device matrices across model
+    # hot-swaps instead of re-uploading after every /reload (the row
+    # /score path itself builds no device matrices)
+    feature_cache: Optional[Dict[str, Any]] = None
 
     def ladder(self) -> Tuple[int, ...]:
         if self.buckets:
@@ -215,6 +223,15 @@ class ScoringService:
         self._trace_parent = None  # span the batcher thread nests under
         self._schema: Dict[str, type] = {}
         self._init_metrics()
+        if self.config.feature_cache:
+            # device-matrix cache policy for this serving process: warm
+            # scoring over a ColumnarStore replays the wire artifact,
+            # and resident=True keeps the built matrices in HBM across
+            # hot-swaps (a /reload swaps the MODEL, not the data)
+            from transmogrifai_tpu.data.feature_cache import (
+                FeatureCacheParams, set_default_cache_params)
+            set_default_cache_params(
+                FeatureCacheParams.from_json(dict(self.config.feature_cache)))
         if model is not None:
             self._install(model, version_id or "v0")
 

@@ -14,6 +14,8 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from transmogrifai_tpu.data.feature_cache import FeatureCacheParams
+
 
 @dataclass
 class ReaderParams:
@@ -56,10 +58,13 @@ class ServingParams:
     default_deadline_ms: float = 2000.0
     warm_on_load: bool = True
     keep_versions: int = 2
+    # FeatureCacheParams JSON dict: installed as the serving process's
+    # device-matrix cache policy (resident matrices survive hot-swaps)
+    feature_cache: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("host", "port", "max_batch", "min_bucket", "buckets",
                "max_queue", "batch_wait_ms", "default_deadline_ms",
-               "warm_on_load", "keep_versions")
+               "warm_on_load", "keep_versions", "feature_cache")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "ServingParams":
@@ -79,7 +84,8 @@ class ServingParams:
             batch_wait_ms=self.batch_wait_ms,
             default_deadline_ms=self.default_deadline_ms,
             warm_on_load=self.warm_on_load,
-            keep_versions=self.keep_versions)
+            keep_versions=self.keep_versions,
+            feature_cache=self.feature_cache)
 
 
 @dataclass
@@ -126,6 +132,11 @@ class OpParams:
     custom_params: Dict[str, Any] = field(default_factory=dict)
     serving: Optional[ServingParams] = None
     sweep_checkpoint: Optional[SweepCheckpointParams] = None
+    # persistent device-matrix cache (data/feature_cache.py):
+    # `Workflow.train()` installs this as the process default for the
+    # run's extent, so every big-data matrix build under the train
+    # resolves the run's cache policy
+    feature_cache: Optional[FeatureCacheParams] = None
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -135,6 +146,8 @@ class OpParams:
                    if d.get("serving") else None)
         sweep_ckpt = (SweepCheckpointParams.from_json(d["sweep_checkpoint"])
                       if d.get("sweep_checkpoint") else None)
+        feature_cache = (FeatureCacheParams.from_json(d["feature_cache"])
+                         if d.get("feature_cache") else None)
         return OpParams(
             stage_params=dict(d.get("stage_params") or {}),
             reader_params=readers,
@@ -149,7 +162,8 @@ class OpParams:
             collect_stage_metrics=bool(d.get("collect_stage_metrics", True)),
             custom_params=dict(d.get("custom_params") or {}),
             serving=serving,
-            sweep_checkpoint=sweep_ckpt)
+            sweep_checkpoint=sweep_ckpt,
+            feature_cache=feature_cache)
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -174,6 +188,8 @@ class OpParams:
             "serving": self.serving.to_json() if self.serving else None,
             "sweep_checkpoint": (self.sweep_checkpoint.to_json()
                                  if self.sweep_checkpoint else None),
+            "feature_cache": (self.feature_cache.to_json()
+                              if self.feature_cache else None),
         }
 
 

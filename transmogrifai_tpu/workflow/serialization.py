@@ -39,7 +39,9 @@ import numpy as np
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.features.feature import Feature
 from transmogrifai_tpu.runtime.faults import SITE_WRITE_FILE, fault_point
-from transmogrifai_tpu.runtime.integrity import sha256_file as _sha256_file
+from transmogrifai_tpu.runtime.integrity import (
+    commit_staged_dir as _commit_staged_dir, fsync_dir as _fsync_dir,
+    fsync_file as _fsync_file, sha256_file as _sha256_file)
 from transmogrifai_tpu.stages.base import (
     FeatureGeneratorStage, StageRegistry, Transformer)
 
@@ -63,29 +65,6 @@ class ModelIntegrityError(RuntimeError):
         self.reason = reason
         super().__init__(
             f"model artifact {path!r} failed integrity check: {reason}")
-
-
-def _fsync_file(path: str) -> None:
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-
-
-def _fsync_dir(path: str) -> None:
-    """Durable directory entry (rename/create visibility). Best-effort:
-    not every platform lets you fsync a directory fd."""
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        log.debug("directory fsync unsupported for %s", path)
-    finally:
-        os.close(fd)
 
 
 def _offload_arrays(value: Any, store: Dict[str, np.ndarray],
@@ -238,25 +217,8 @@ def save_model(model, path: str, overwrite: bool = True,
         raise
 
     # -- swap into place: the old model is renamed aside, not deleted,
-    #    until the new one is live --------------------------------------- #
-    if os.path.exists(path):
-        old = f"{path}.old-{os.getpid()}"
-        if os.path.exists(old):
-            shutil.rmtree(old)
-        os.rename(path, old)
-        try:
-            os.rename(tmp, path)
-        except BaseException:
-            os.rename(old, path)  # restore the displaced model
-            raise
-        shutil.rmtree(old, ignore_errors=True)
-    else:
-        parent = os.path.dirname(path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        os.rename(tmp, path)
-    parent = os.path.dirname(os.path.abspath(path))
-    _fsync_dir(parent)
+    #    until the new one is live (shared staged-dir protocol) ---------- #
+    _commit_staged_dir(tmp, path)
 
 
 def model_fingerprint(path: str) -> str:

@@ -147,7 +147,19 @@ class Workflow:
         FIRST — before any data materialization, fit, or XLA compile — and
         raises `GraphValidationError` on a miswired DAG (type mismatches,
         response leakage, cycles, host/device contract violations).
-        `strict=False` downgrades validation errors to logged warnings."""
+        `strict=False` downgrades validation errors to logged warnings.
+
+        An OpParams ``feature_cache`` config is installed as the
+        process-default device-matrix cache policy for the train's
+        extent (`data/feature_cache.py`), so any big-data matrix built
+        under this train — selector sweeps, out-of-core fits — resolves
+        the run's cache policy without per-call plumbing."""
+        from transmogrifai_tpu.data.feature_cache import cache_scope
+        with cache_scope(self.parameters.get("feature_cache")):
+            return self._train_impl(dataset, seed, mesh, strict)
+
+    def _train_impl(self, dataset: Optional[Dataset], seed: int,
+                    mesh, strict: bool) -> "WorkflowModel":
         if not self.result_features:
             raise RuntimeError("set_result_features before train()")
         _validate_or_raise(self.result_features, strict, where="train")
