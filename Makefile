@@ -16,7 +16,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	exit $$rc
 
 .PHONY: lint serve-smoke ingest-smoke faults-smoke trace-smoke \
-	cache-smoke test check
+	cache-smoke multichip-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -50,6 +50,16 @@ ingest-smoke:
 serve-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.smoke
 
+# distributed-sweep smoke: on 8 forced host devices, a 2-family grid
+# sweep scheduled across the mesh must return the bit-identical winner
+# to the single-device sweep; an injected kill of one worker preempts
+# the schedule and the resume re-runs ONLY that worker's in-flight
+# block (journal-shard-asserted; with blocks <= lanes every other block
+# was dispatched and drains to its journal); a worker-level error is
+# survived by work stealing. See transmogrifai_tpu/parallel/smoke.py.
+multichip-smoke:
+	$(PY) -m transmogrifai_tpu.parallel.smoke
+
 # observability smoke: tiny train+score through the runner with
 # --trace-out; validates the Perfetto JSON (well-formed events,
 # monotonic ts, parented spans), the GoodputReport buckets summing to
@@ -61,4 +71,5 @@ trace-smoke:
 test:
 	@$(TIER1)
 
-check: lint serve-smoke ingest-smoke cache-smoke faults-smoke trace-smoke test
+check: lint serve-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
+	multichip-smoke test

@@ -734,3 +734,66 @@ def outer(store):
     return X, inner
 '''
     assert "L010" not in _lint_codes(src)
+
+
+def test_lint_per_device_upload_loop():
+    """L011(a): per-device Python loops doing device_put/jnp.asarray —
+    one synchronous transfer per chip where a single sharded
+    device_put ships one placement."""
+    src = '''
+def replicate(x):
+    out = []
+    for d in jax.devices():
+        out.append(jax.device_put(x, d))
+    return out
+
+def stage(xs, mesh):
+    for i, d in enumerate(mesh.devices):
+        xs[i] = jnp.asarray(xs[i])
+    return xs
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L011"]
+    assert len(findings) == 2
+
+
+def test_lint_spmd_host_callback():
+    """L011(b): host callbacks inside shard_map/pjit-wrapped bodies —
+    named def, lambda, and @partial decorator forms all resolve."""
+    src = '''
+def body(x):
+    jax.debug.callback(note, x)
+    return x * 2
+
+def run(mesh, x):
+    return shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())(x)
+
+def run_lambda(x):
+    return pjit(lambda v: jax.pure_callback(host_fn, v, v))(x)
+
+@partial(shard_map, mesh=None, in_specs=None, out_specs=None)
+def decorated(x):
+    return io_callback(host_fn, x, x)
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L011"]
+    assert len(findings) == 3
+
+
+def test_lint_l011_not_flagged_elsewhere():
+    """No L011 for a single sharded placement, a callback OUTSIDE any
+    SPMD wrapper, `.callback(...)` methods that are not jax.debug's,
+    or non-device loops."""
+    src = '''
+def place(x, mesh, spec):
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+def host_side(f, x):
+    return jax.pure_callback(f, x, x)   # not inside shard_map/pjit
+
+def unrelated(handlers, evt):
+    for h in handlers:
+        h.callback(evt)                 # method named callback: fine
+
+def grids_loop(grids):
+    return [jnp.asarray(g) for g in grids]
+'''
+    assert "L011" not in _lint_codes(src)

@@ -77,6 +77,21 @@ silent slowness or nondeterminism once XLA is in the loop:
   reads. Pass ``cache=`` (a policy string or `FeatureCacheParams`) so
   the rebuild is a deliberate choice, not an accident.
 
+- ``L011 per-device-dispatch``: the two host-in-the-loop multichip
+  anti-patterns. (a) a Python ``for`` loop over the device list
+  (``jax.devices()`` / ``jax.local_devices()`` / a ``devices``
+  iterable) doing per-device ``device_put``/``jnp.asarray`` — one
+  synchronous transfer per chip serializes what a single
+  ``device_put(x, NamedSharding(mesh, spec))`` ships as one sharded
+  placement (and the scheduler in `parallel/scheduler.py` exists so
+  per-worker placement happens once per lane, not per dispatch).
+  (b) a host callback (``jax.pure_callback`` / ``io_callback`` /
+  ``jax.debug.callback`` / ``host_callback.call``) inside a function
+  wrapped by ``shard_map``/``pjit`` — every shard's execution stalls
+  on a host round-trip per step, turning an SPMD program into a
+  host-bound serial one; move the host work outside the mapped
+  computation (or into the scheduler's host-side worker loop).
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -132,6 +147,15 @@ _SERIAL_UPLOAD_CALLS = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
 # L010: the out-of-core device-matrix builders the feature cache fronts
 _MATRIX_BUILDER_CALLS = {"device_matrix", "device_binned",
                          "dual_device_matrices"}
+
+# L011: device-list iterables (calls or bare names) and SPMD wrappers
+_DEVICE_ITER_CALLS = {"devices", "local_devices"}
+_DEVICE_ITER_NAMES = {"devices", "local_devices", "mesh_devices"}
+_SPMD_WRAPPERS = {"shard_map", "pjit"}
+# exact-suffix host-callback forms (a bare `.callback` method must not
+# false-positive, so `callback` only matches under the jax.debug module)
+_HOST_CALLBACK_LAST = {"pure_callback", "io_callback"}
+_HOST_CALLBACK_DOTTED_SUFFIX = ("debug.callback", "host_callback.call")
 
 
 @dataclass
@@ -332,6 +356,7 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_For(self, node: ast.For) -> None:
         self._check_serial_ingest(node)
+        self._check_per_device_loop(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -504,6 +529,46 @@ class _FileLinter(ast.NodeVisitor):
                     "cache= (policy string or FeatureCacheParams) so "
                     "repeats replay the data/feature_cache.py wire "
                     "artifact instead of re-uploading")
+
+    # -- L011 (a): per-device upload loops ---------------------------------- #
+
+    @staticmethod
+    def _is_device_iter(it: ast.AST) -> bool:
+        # unwrap enumerate(...) — `for i, d in enumerate(devices)`
+        if isinstance(it, ast.Call) and _dotted(it.func) == "enumerate" \
+                and it.args:
+            it = it.args[0]
+        if isinstance(it, ast.Call):
+            dotted = _dotted(it.func)
+            return dotted is not None and \
+                dotted.rsplit(".", 1)[-1] in _DEVICE_ITER_CALLS
+        dotted = _dotted(it)
+        return dotted is not None and \
+            dotted.rsplit(".", 1)[-1] in _DEVICE_ITER_NAMES
+
+    def _check_per_device_loop(self, node: ast.For) -> None:
+        """Per-device Python loops doing host→device transfers: N
+        synchronous RPCs where one sharded `device_put` ships a single
+        placement over the whole mesh."""
+        if not self._is_device_iter(node.iter):
+            return
+        stack: List[ast.AST] = list(node.body)
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, ast.For) and self._is_device_iter(sub.iter):
+                continue  # nested device loops report on their own visit
+            stack.extend(ast.iter_child_nodes(sub))
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func)
+            if dotted in _SERIAL_UPLOAD_CALLS:
+                self._emit(
+                    sub, "L011",
+                    f"per-device `{dotted}` inside a loop over the "
+                    "device list — one synchronous transfer per chip "
+                    "serializes placement; ship it as ONE "
+                    "`device_put(x, NamedSharding(mesh, spec))` (or let "
+                    "parallel/scheduler.py place per worker lane, once)")
 
     # -- L007 -------------------------------------------------------------- #
 
@@ -753,6 +818,82 @@ class _FileLinter(ast.NodeVisitor):
                     "device_apply via `dev` instead")
 
 
+# -- L011 (b): host callbacks inside shard_map/pjit bodies ------------------ #
+
+def _is_host_callback(call: ast.Call) -> Optional[str]:
+    dotted = _dotted(call.func)
+    if dotted is None:
+        return None
+    if dotted.rsplit(".", 1)[-1] in _HOST_CALLBACK_LAST:
+        return dotted
+    if any(dotted == s or dotted.endswith("." + s)
+           for s in _HOST_CALLBACK_DOTTED_SUFFIX):
+        return dotted
+    return None
+
+
+def _spmd_wrapped_bodies(tree: ast.AST):
+    """(wrapper_name, body_node) for every function an `shard_map(...)`/
+    `pjit(...)` call or decorator wraps: inline lambdas, module/nested
+    defs referenced by name, and decorated defs (incl. the
+    `@partial(shard_map, ...)` form)."""
+    fns = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(n.name, n)
+    seen: Set[int] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call):
+            dotted = _dotted(n.func)
+            # @partial(shard_map, mesh=...) nests the wrapper reference
+            if dotted in ("partial", "functools.partial") and n.args:
+                dotted = _dotted(n.args[0])
+                args = n.args[1:]
+            else:
+                args = n.args
+            if dotted is None or \
+                    dotted.rsplit(".", 1)[-1] not in _SPMD_WRAPPERS:
+                continue
+            wrapper = dotted.rsplit(".", 1)[-1]
+            for a in args[:1]:
+                body = a if isinstance(a, ast.Lambda) else \
+                    fns.get(a.id) if isinstance(a, ast.Name) else None
+                if body is not None and id(body) not in seen:
+                    seen.add(id(body))
+                    yield wrapper, body
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                dotted = _dotted(d)
+                if dotted in ("partial", "functools.partial") and \
+                        isinstance(dec, ast.Call) and dec.args:
+                    dotted = _dotted(dec.args[0])
+                if dotted is not None and \
+                        dotted.rsplit(".", 1)[-1] in _SPMD_WRAPPERS and \
+                        id(n) not in seen:
+                    seen.add(id(n))
+                    yield dotted.rsplit(".", 1)[-1], n
+
+
+def _check_spmd_callbacks(tree: ast.AST, path: str) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    for wrapper, body in _spmd_wrapped_bodies(tree):
+        name = getattr(body, "name", "<lambda>")
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            cb = _is_host_callback(sub)
+            if cb is not None:
+                findings.append(LintFinding(
+                    path, getattr(sub, "lineno", 0), "L011",
+                    f"host callback `{cb}` inside `{name}`, which "
+                    f"`{wrapper}` maps over the mesh — every shard "
+                    "stalls on a host round-trip per step, serializing "
+                    "the SPMD program; move the host work outside the "
+                    "mapped computation"))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -766,6 +907,7 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
                if isinstance(n, ast.ClassDef)}
     linter = _FileLinter(path, classes)
     linter.visit(tree)
+    linter.findings.extend(_check_spmd_callbacks(tree, path))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 

@@ -60,6 +60,21 @@ def cmd_run(args) -> int:
         from transmogrifai_tpu.workflow.params import SweepCheckpointParams
         params.sweep_checkpoint = SweepCheckpointParams(
             checkpoint_dir=args.sweep_checkpoint_dir)
+    if getattr(args, "mesh_devices", None) or \
+            getattr(args, "mesh_sweep", None) or \
+            getattr(args, "mesh_slices", None):
+        # distributed sweeps: train over a (sweep, data) device mesh —
+        # the selector's grid blocks schedule across the sweep axis via
+        # the work-stealing scheduler (parallel/scheduler.py)
+        from transmogrifai_tpu.workflow.params import MeshParams
+        base_mesh = params.mesh or MeshParams()
+        if getattr(args, "mesh_devices", None):
+            base_mesh.n_devices = args.mesh_devices
+        if getattr(args, "mesh_sweep", None):
+            base_mesh.sweep = args.mesh_sweep
+        if getattr(args, "mesh_slices", None):
+            base_mesh.n_slices = args.mesh_slices
+        params.mesh = base_mesh
     if getattr(args, "feature_cache", None) or \
             getattr(args, "feature_cache_dir", None) or \
             getattr(args, "feature_cache_wire", None):
@@ -663,6 +678,19 @@ def main(argv: Optional[list] = None) -> int:
         help="cold-miss wire compression: int8/int4 ship a quantized "
              "wire with dequant fused into the donated device write "
              "(2-4x fewer bytes)")
+    run_p.add_argument(
+        "--mesh-devices", type=int,
+        help="train over a device mesh of this many devices: selector "
+             "sweeps distribute their grid blocks across the mesh's "
+             "sweep axis via the work-stealing scheduler")
+    run_p.add_argument(
+        "--mesh-sweep", type=int,
+        help="sweep-axis width of the mesh (default: all devices on "
+             "sweep); remaining devices shard each worker's row data")
+    run_p.add_argument(
+        "--mesh-slices", type=int,
+        help="lay the mesh out for a multi-slice pod (slice boundaries "
+             "on the sweep axis; see make_multislice_mesh)")
     run_p.set_defaults(fn=cmd_run)
 
     gen_p = sub.add_parser("gen", help="generate a starter app from data")

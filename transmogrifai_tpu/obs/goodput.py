@@ -34,6 +34,12 @@ each artifact records its cold build's wall time, so a warm replay
 reports cold-minus-warm as recovered ingest badput (``cache_hit``
 events from `parallel/bigdata.py`).
 
+A ``mesh`` section (present when a distributed sweep ran) rolls up the
+scheduler's ``mesh_utilization`` events: the fraction of workers × wall
+the mesh lanes spent executing grid blocks, plus steal/requeue/idle
+counters — the measured packing efficiency behind any pod-scale
+extrapolation (`parallel/scheduler.py`).
+
 The report lands in `RunProfile.to_json()["goodput"]`, bench payloads,
 and beside the CLI's ``--trace-out`` trace.
 """
@@ -60,6 +66,11 @@ class GoodputReport:
     buckets: Dict[str, float] = field(default_factory=dict)
     savings: Dict[str, float] = field(default_factory=dict)
     counts: Dict[str, int] = field(default_factory=dict)
+    # distributed-sweep packing: rolled up from the scheduler's
+    # ``mesh_utilization`` events (parallel/scheduler.py) — how much of
+    # workers × wall the mesh lanes spent executing blocks, plus
+    # steal/requeue/straggler counters. Empty when no schedule ran.
+    mesh: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def badput_s(self) -> float:
@@ -74,7 +85,7 @@ class GoodputReport:
                             / self.wall_s))
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "wall_s": round(self.wall_s, 6),
             "trace_id": self.trace_id,
             "goodput_frac": round(self.goodput_frac, 4),
@@ -84,6 +95,9 @@ class GoodputReport:
                         for k, v in sorted(self.savings.items())},
             "counts": dict(sorted(self.counts.items())),
         }
+        if self.mesh:
+            out["mesh"] = dict(sorted(self.mesh.items()))
+        return out
 
     def pretty(self) -> str:
         lines = [f"goodput: {self.goodput_frac:.1%} of "
@@ -107,9 +121,16 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     b = {k: 0.0 for k in BADPUT_BUCKETS}
     counts = {"retries": 0, "recompiles": 0, "oom_redos": 0,
               "resumed_blocks": 0, "faults_injected": 0,
-              "cache_hits": 0, "cache_misses": 0}
+              "cache_hits": 0, "cache_misses": 0,
+              "steals": 0, "workers_retired": 0}
     saved = 0.0
     cache_saved = 0.0
+    # mesh rollup accumulators: several schedules (one per selector fit)
+    # can land in one trace — utilization averages weighted by each
+    # schedule's wall, counters sum
+    mesh_wall = 0.0
+    mesh_busy = 0.0
+    mesh: Dict[str, Any] = {}
     seen: set = set()
     for sp in [root, *spans]:
         if sp.span_id in seen or sp.trace_id != root.trace_id:
@@ -144,6 +165,23 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
                 counts["cache_misses"] += 1
             elif name == "fault":
                 counts["faults_injected"] += 1
+            elif name == "steal":
+                counts["steals"] += 1
+            elif name == "worker_retired":
+                counts["workers_retired"] += 1
+            elif name == "mesh_utilization":
+                wall = float(attrs.get("wall_s", 0.0) or 0.0)
+                workers = int(attrs.get("workers", 0) or 0)
+                mesh_wall += wall * max(workers, 1)
+                mesh_busy += (float(attrs.get("utilization_frac", 0.0)
+                                    or 0.0) * wall * max(workers, 1))
+                mesh["workers"] = max(mesh.get("workers", 0), workers)
+                mesh["schedules"] = mesh.get("schedules", 0) + 1
+                for key in ("steals", "requeues", "blocks"):
+                    mesh[key] = mesh.get(key, 0) + int(
+                        attrs.get(key, 0) or 0)
+                mesh["idle_s"] = round(mesh.get("idle_s", 0.0) + float(
+                    attrs.get("idle_s", 0.0) or 0.0), 6)
     # badput cannot exceed wall (overlapped worker backoffs can): clamp
     # proportionally so the decomposition stays a decomposition
     total_bad = sum(b.values())
@@ -157,5 +195,9 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
         report.savings["resume_saved_s"] = saved
     if cache_saved > 0.0 or counts["cache_hits"]:
         report.savings["cache_saved_s"] = cache_saved
+    if mesh:
+        mesh["utilization_frac"] = round(
+            mesh_busy / mesh_wall, 4) if mesh_wall > 0 else 0.0
+        report.mesh = mesh
     report.counts = {k: v for k, v in counts.items() if v}
     return report

@@ -1,6 +1,8 @@
 from transmogrifai_tpu.parallel.mesh import (
     data_sharding, make_mesh, make_multislice_mesh, sweep_sharding)
-from transmogrifai_tpu.parallel.sweep import run_sweep
+from transmogrifai_tpu.parallel.scheduler import GridScheduler, SweepJob
+from transmogrifai_tpu.parallel.sweep import run_sweep, static_signature
 
 __all__ = ["data_sharding", "make_mesh", "make_multislice_mesh",
-           "sweep_sharding", "run_sweep"]
+           "sweep_sharding", "run_sweep", "static_signature",
+           "GridScheduler", "SweepJob"]

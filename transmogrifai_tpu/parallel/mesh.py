@@ -75,7 +75,22 @@ def make_multislice_mesh(n_slices: int,
             per = devices_per_slice
         groups = [g[:per] for g in groups]
     else:  # single real slice (or CPU test mesh): contiguous grouping
-        per = devices_per_slice or len(devices) // n_slices
+        if n_slices < 1:
+            raise ValueError(f"n_slices={n_slices} must be >= 1")
+        if devices_per_slice is None:
+            # an implicit floor-divide would silently drop the remainder
+            # devices (8 devices / 3 slices "worked" on 6) — demand an
+            # explicit devices_per_slice instead of guessing
+            if len(devices) % n_slices != 0:
+                raise ValueError(
+                    f"{len(devices)} devices do not divide into "
+                    f"{n_slices} equal contiguous slices "
+                    f"({len(devices)} % {n_slices} = "
+                    f"{len(devices) % n_slices}); pass devices_per_slice "
+                    "explicitly to use a subset")
+            per = len(devices) // n_slices
+        else:
+            per = devices_per_slice
         if per < 1 or per * n_slices > len(devices):
             raise ValueError(
                 f"need {max(per, 1) * n_slices} devices for {n_slices} "

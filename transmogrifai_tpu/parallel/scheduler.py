@@ -1,0 +1,485 @@
+"""Distributed sweep execution: a work-stealing grid scheduler over a mesh.
+
+The reference distributes exactly this workload — model×grid×fold fits
+fanned out as Futures over a Spark executor pool
+(`OpValidator.scala:299-358`) — and the TensorFlow paper (arxiv
+1605.08695, PAPERS.md) maps the same shape onto dataflow workers. This
+module is that story on a `jax.sharding.Mesh`: the grid-config blocks
+the family handlers in `parallel/sweep.py` already compile as single
+XLA programs become the scheduler's work units, partitioned across the
+mesh's SWEEP axis, one worker lane per sweep row.
+
+Design:
+
+- **block = compiled group.** `sweep.static_signature(est, grid)` cuts
+  each family's grids along the exact boundaries the handlers group
+  them for compilation, so a scheduled block regroups into ONE batched
+  program on its worker — distribution never splits a compile.
+- **work stealing.** Blocks are dealt round-robin into per-worker
+  deques (longest-first, LPT-style packing); a worker that drains its
+  own deque steals from the back of the longest other deque (recorded
+  as a ``steal`` event on its lane). A worker that dies of a
+  worker-level fault retires and its in-flight block is requeued for
+  the survivors — a preempted worker costs only its in-flight block.
+- **the journal is the shared completion log.** Each worker appends
+  completed blocks to its own `ShardedSweepJournal` shard
+  (``journal-w<k>.jsonl`` — no shared fd, so concurrent appends cannot
+  interleave), and lookups merge every shard: resume skips the union
+  of all workers' completed blocks and reproduces the bit-identical
+  winner, the PR-4 single-device invariant now under concurrency.
+- **preemption (InjectedKill / BaseException) drains.** A kill observed
+  by one worker cancels undispatched work, lets the other lanes finish
+  (and journal) their in-flight blocks, then re-raises — a resumed
+  schedule re-runs only the killed worker's in-flight block plus any
+  blocks never dispatched before the kill (with blocks ≤ lanes, exactly
+  the one in-flight block); completed blocks never re-run.
+- **per-worker lanes in the trace.** Every worker opens a
+  ``sweep:worker:<k>`` span under the scheduling root; steal/idle
+  events land on the lane, and the end-of-run ``mesh_utilization``
+  event (Σbusy / workers·wall, straggler flag) feeds the
+  `GoodputReport` mesh rollup (obs/goodput.py).
+
+Device placement: worker k owns sweep-row k of the (sweep, data) mesh.
+With a 1-wide data axis the block's inputs are `device_put` onto the
+worker's device and the block runs exactly the single-device program
+(bit-identical metrics). With data > 1 the worker gets a (1, data)
+sub-mesh as its `FitContext.mesh`, so `run_sweep`'s existing data-axis
+path shards the rows across the worker's devices — data-parallel fits
+and sweep-parallel grid execution compose on one 2-D mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu.obs import export as obs_export
+from transmogrifai_tpu.obs.trace import TRACER
+from transmogrifai_tpu.parallel.mesh import DATA_AXIS, SWEEP_AXIS
+from transmogrifai_tpu.parallel.sweep import (
+    journal_prefill, run_sweep, static_signature)
+from transmogrifai_tpu.runtime.faults import SITE_WORKER_BLOCK, fault_point
+
+__all__ = ["SweepJob", "GridScheduler", "SchedulerReport", "WorkerStats"]
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SweepJob:
+    """One model family's sweep, as submitted to the scheduler."""
+
+    index: int                 # caller's job id (the selector's model index)
+    est: Any                   # the family estimator prototype
+    grids: List[Dict]
+    journal: Any = None        # ShardedSweepJournal (or None)
+    name: str = ""
+    # optional run_sweep-signature callable wrapping the block execution
+    # (the selector passes run_sweep behind its transient-RPC
+    # RetryPolicy, so distribution keeps the single-device path's
+    # fault tolerance); None = plain run_sweep
+    run: Any = None
+
+
+@dataclass
+class _Block:
+    job: int                   # index into the jobs sequence
+    key: Tuple                 # static_signature group key
+    idxs: List[int]            # grid indices within the job
+    home: int = 0              # worker the block was dealt to
+
+
+@dataclass
+class WorkerStats:
+    worker: int
+    blocks: int = 0
+    steals: int = 0
+    busy_s: float = 0.0
+    idle_s: float = 0.0
+    retired: Optional[str] = None   # worker-level failure, if any
+
+
+@dataclass
+class SchedulerReport:
+    """What the schedule did with the mesh: the measured counterpart of
+    the pod-extrapolation's perfect-packing assumption."""
+
+    n_workers: int = 0
+    wall_s: float = 0.0
+    blocks: int = 0
+    steals: int = 0
+    requeues: int = 0
+    utilization_frac: float = 0.0
+    straggler: Optional[int] = None
+    workers: List[WorkerStats] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "n_workers": self.n_workers,
+            "wall_s": round(self.wall_s, 6),
+            "blocks": self.blocks,
+            "steals": self.steals,
+            "requeues": self.requeues,
+            "utilization_frac": round(self.utilization_frac, 4),
+            "straggler": self.straggler,
+            "workers": [{
+                "worker": w.worker, "blocks": w.blocks, "steals": w.steals,
+                "busy_s": round(w.busy_s, 6), "idle_s": round(w.idle_s, 6),
+                "retired": w.retired} for w in self.workers],
+        }
+
+
+class GridScheduler:
+    """Schedule grid blocks across the sweep axis of a device mesh.
+
+    `on_worker_death` governs a worker-level **Exception** at the claim
+    site (`scheduler.worker_block`): ``"requeue"`` (default) retires the
+    worker and requeues its block for the survivors to steal. A
+    **BaseException** (InjectedKill, KeyboardInterrupt — preemption
+    semantics) always takes the whole schedule down via the drain path
+    regardless of this setting.
+    """
+
+    def __init__(self, mesh=None, n_workers: Optional[int] = None,
+                 on_worker_death: str = "requeue"):
+        import jax
+        if on_worker_death not in ("requeue", "abort"):
+            raise ValueError(f"on_worker_death={on_worker_death!r}")
+        self.mesh = mesh
+        self.on_worker_death = on_worker_death
+        if mesh is not None:
+            rows = np.asarray(mesh.devices)
+            names = list(getattr(mesh, "axis_names", ()) or ())
+            if SWEEP_AXIS in names and names.index(SWEEP_AXIS) != 0:
+                # Workflow.train(mesh=) accepts any user mesh, e.g. axes
+                # ("data", "sweep"): lanes are rows of the sweep axis by
+                # NAME — axis order must not silently invert the layout
+                rows = np.moveaxis(rows, names.index(SWEEP_AXIS), 0)
+            if rows.ndim == 1:
+                rows = rows[:, None]
+            elif rows.ndim > 2:  # >2-D user mesh: flatten non-sweep axes
+                rows = rows.reshape(rows.shape[0], -1)
+            self._rows = [rows[k] for k in range(rows.shape[0])]
+        else:
+            self._rows = [np.asarray([d]) for d in jax.devices()[:1]]
+        if n_workers is not None:
+            if n_workers < 1:
+                raise ValueError("n_workers must be >= 1")
+            # fewer lanes than sweep rows: use the first n rows (the
+            # remaining devices serve data-parallel duty only)
+            self._rows = self._rows[:n_workers]
+        self.n_workers = len(self._rows)
+        self.report = SchedulerReport(n_workers=self.n_workers)
+        # shared queue state
+        self._cond = threading.Condition()
+        self._queues: List[deque] = []
+        self._inflight = 0
+        self._abort_exc: Optional[BaseException] = None
+        self._job_errors: Dict[int, Exception] = {}
+        self._placed: Dict[int, Tuple[Any, Any, Any, Any]] = {}
+        self._place_lock = threading.Lock()
+        # per-worker (1, data) sub-meshes, built once: _place tests this
+        # on every block, and a lane's topology is fixed for the
+        # scheduler's lifetime
+        self._submeshes = [self._build_submesh(k)
+                           for k in range(self.n_workers)]
+
+    # -- device topology --------------------------------------------------- #
+
+    def _device(self, k: int):
+        return self._rows[k][0]
+
+    def _build_submesh(self, k: int):
+        """Worker k's (1, data) sub-mesh when its sweep row holds more
+        than one device (data-parallel fits inside the lane)."""
+        if self.mesh is None or len(self._rows[k]) <= 1:
+            return None
+        from jax.sharding import Mesh
+        return Mesh(np.asarray(self._rows[k])[None, :],
+                    (SWEEP_AXIS, DATA_AXIS))
+
+    def _submesh(self, k: int):
+        return self._submeshes[k]
+
+    def _place(self, k: int, X, y):
+        """Pin the training arrays to worker k's device ONCE (committed
+        inputs drag the whole block's execution onto the lane's device —
+        uncommitted inputs would silently serialize every lane onto the
+        default device). Data-parallel lanes skip this: `run_sweep`'s
+        mesh path shards the rows itself. The cache RETAINS the keying
+        objects and compares identity on BOTH inputs — an id()-only key
+        could false-hit after GC address reuse, or return a stale y for
+        a reused scheduler instance."""
+        import jax
+        if self._submesh(k) is not None:
+            return X, y
+        with self._place_lock:
+            hit = self._placed.get(k)
+            if hit is not None and hit[0] is X and hit[1] is y:
+                return hit[2], hit[3]
+        dev = self._device(k)
+        Xk = jax.device_put(X, dev)
+        yk = jax.device_put(y, dev)
+        with self._place_lock:
+            self._placed[k] = (X, y, Xk, yk)
+        return Xk, yk
+
+    # -- scheduling -------------------------------------------------------- #
+
+    def run(self, jobs: Sequence[SweepJob], X, y, folds, evaluator,
+            ctx) -> List[Any]:
+        """Execute every job's sweep across the mesh. Returns one outcome
+        per job: the [grid][fold] metric matrix, or the Exception that
+        failed the family (the caller applies its family-drop policy).
+        A BaseException (preemption) drains in-flight blocks on the
+        surviving lanes, then re-raises."""
+        import jax  # noqa: F401  (workers need an initialized backend)
+
+        results: List[List[Optional[List[float]]]] = [
+            [None] * len(j.grids) for j in jobs]
+        self._job_errors = {}
+
+        # resume: the merged journal shards are the shared completion
+        # log — blocks any worker completed in a previous (or killed)
+        # schedule never re-run (shared resume-skip implementation with
+        # the in-family path)
+        for ji, job in enumerate(jobs):
+            journal_prefill(job.journal, job.grids, results[ji])
+
+        blocks: List[_Block] = []
+        for ji, job in enumerate(jobs):
+            groups: Dict[Tuple, List[int]] = {}
+            for i, g in enumerate(job.grids):
+                if results[ji][i] is None:
+                    groups.setdefault(
+                        static_signature(job.est, g), []).append(i)
+            blocks += [_Block(ji, key, idxs) for key, idxs in groups.items()]
+        # longest-first (LPT) for packing; deterministic tie-break
+        blocks.sort(key=lambda b: (-len(b.idxs), b.job, repr(b.key)))
+
+        self._queues = [deque() for _ in range(self.n_workers)]
+        for bi, blk in enumerate(blocks):
+            blk.home = bi % self.n_workers
+            self._queues[blk.home].append(blk)
+        self._inflight = 0
+        self._abort_exc = None
+        self._placed = {}  # drop a previous run's pinned device buffers
+        self.report = SchedulerReport(
+            n_workers=self.n_workers, blocks=len(blocks),
+            workers=[WorkerStats(worker=k) for k in range(self.n_workers)])
+
+        t0 = time.perf_counter()
+        with TRACER.span("sweep:scheduler", category="scheduler",
+                         workers=self.n_workers, blocks=len(blocks),
+                         jobs=len(jobs)) as root:
+            worker_ctxs = [self._worker_ctx(k, ctx)
+                           for k in range(self.n_workers)]
+            threads = [
+                threading.Thread(
+                    target=self._worker_loop,
+                    args=(k, root, jobs, results, worker_ctxs[k],
+                          X, y, folds, evaluator),
+                    name=f"sweep-worker-{k}", daemon=True)
+                for k in range(self.n_workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            self.report.wall_s = time.perf_counter() - t0
+            self._rollup(root)
+        if self._abort_exc is not None:
+            raise self._abort_exc
+        leftover = sum(len(q) for q in self._queues)
+        if leftover:
+            raise RuntimeError(
+                f"all {self.n_workers} sweep workers retired with "
+                f"{leftover} grid blocks unfinished")
+        return [self._job_errors.get(ji, results[ji])
+                for ji in range(len(jobs))]
+
+    def _worker_ctx(self, k: int, ctx):
+        """Same n_rows and — critically — the SAME seed as the caller's
+        context: bootstrap/fold streams must match the single-device
+        sweep bit for bit."""
+        from transmogrifai_tpu.stages.base import FitContext
+        return FitContext(n_rows=getattr(ctx, "n_rows", 0),
+                          seed=getattr(ctx, "seed", 42),
+                          mesh=self._submesh(k))
+
+    # -- queue protocol ----------------------------------------------------- #
+
+    def _claim(self, k: int) -> Optional[Tuple[_Block, bool]]:
+        """Own deque first; otherwise steal from the BACK of the longest
+        other deque. Returns None when every deque is empty and nothing
+        is in flight (or the schedule is aborting); blocks while other
+        lanes still run — a dying lane may requeue its block for us."""
+        with self._cond:
+            while True:
+                if self._abort_exc is not None:
+                    return None
+                if self._queues[k]:
+                    self._inflight += 1
+                    return self._queues[k].popleft(), False
+                donors = [(len(q), j) for j, q in enumerate(self._queues)
+                          if j != k and q]
+                if donors:
+                    donors.sort(key=lambda p: (-p[0], p[1]))
+                    self._inflight += 1
+                    return self._queues[donors[0][1]].pop(), True
+                if self._inflight == 0:
+                    return None
+                self._cond.wait(timeout=0.1)
+
+    def _complete(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+
+    def _requeue(self, blk: _Block) -> None:
+        with self._cond:
+            self._queues[blk.home].append(blk)
+            self._inflight -= 1
+            self.report.requeues += 1
+            self._cond.notify_all()
+
+    def _abort(self, exc: BaseException) -> None:
+        """Preemption: cancel undispatched work so the surviving lanes
+        drain only their IN-FLIGHT blocks (journaling them), then the
+        schedule re-raises. What was cancelled or in flight on the dead
+        lane re-runs on resume via the journal."""
+        with self._cond:
+            if self._abort_exc is None:
+                self._abort_exc = exc
+            for q in self._queues:
+                q.clear()
+            self._inflight -= 1
+            self._cond.notify_all()
+
+    def _fail_job(self, ji: int, exc: Exception) -> None:
+        with self._cond:
+            self._job_errors.setdefault(ji, exc)
+            for q in self._queues:  # cancel the family's remaining blocks
+                for blk in [b for b in q if b.job == ji]:
+                    q.remove(blk)
+            self._cond.notify_all()
+
+    # -- worker ------------------------------------------------------------- #
+
+    def _claims(self, k: int, stats: WorkerStats, lane):
+        """Yield (block, stolen) claims for lane k until the schedule
+        drains, charging wait time to the lane's idle account."""
+        while True:
+            t_wait = time.perf_counter()
+            claim = self._claim(k)
+            waited = time.perf_counter() - t_wait
+            if waited > 0.002:
+                stats.idle_s += waited
+                lane.event("idle", waited_s=round(waited, 6))
+            if claim is None:
+                return
+            yield claim
+
+    def _worker_loop(self, k: int, root, jobs, results, wctx,
+                     X, y, folds, evaluator) -> None:
+        stats = self.report.workers[k]
+        with TRACER.span(f"sweep:worker:{k}", category="sweep_worker",
+                         parent=root, worker=k,
+                         devices=int(len(self._rows[k]))) as lane:
+            for blk, stolen in self._claims(k, stats, lane):
+                job = jobs[blk.job]
+                if stolen:
+                    stats.steals += 1
+                    with self._cond:  # += from N lanes loses increments
+                        self.report.steals += 1
+                    obs_export.record_event(
+                        "steal", worker=k, from_worker=blk.home,
+                        job=job.name or type(job.est).__name__,
+                        configs=len(blk.idxs))
+                try:
+                    fault_point(SITE_WORKER_BLOCK)
+                except Exception as e:
+                    # worker-level failure (the executor died, not the
+                    # family): retire this lane, hand the block to the
+                    # survivors — the preemption costs one in-flight block
+                    stats.retired = f"{type(e).__name__}: {e}"
+                    obs_export.record_event(
+                        "worker_retired", worker=k, configs=len(blk.idxs))
+                    if self.on_worker_death == "abort":
+                        self._abort(e)
+                        return
+                    log.warning("sweep worker %d retired (%s); block "
+                                "requeued for stealing", k, e)
+                    self._requeue(blk)
+                    return
+                except BaseException as e:
+                    stats.retired = f"{type(e).__name__}: {e}"
+                    obs_export.record_event("worker_killed", worker=k,
+                                            configs=len(blk.idxs))
+                    self._abort(e)
+                    return
+                t0 = time.perf_counter()
+                try:
+                    rows = self._run_block(k, job, blk, wctx, X, y, folds,
+                                           evaluator)
+                except Exception as e:
+                    log.error("sweep worker %d: family %s block failed",
+                              k, job.name or type(job.est).__name__,
+                              exc_info=True)
+                    self._fail_job(blk.job, e)
+                    self._complete()
+                    continue
+                except BaseException as e:
+                    stats.retired = f"{type(e).__name__}: {e}"
+                    obs_export.record_event("worker_killed", worker=k,
+                                            configs=len(blk.idxs))
+                    self._abort(e)
+                    return
+                with self._cond:
+                    for i, row in zip(blk.idxs, rows):
+                        results[blk.job][i] = row
+                stats.busy_s += time.perf_counter() - t0
+                stats.blocks += 1
+                self._complete()
+
+    def _run_block(self, k: int, job: SweepJob, blk: _Block, wctx,
+                   X, y, folds, evaluator):
+        import jax
+        grids = [job.grids[i] for i in blk.idxs]
+        journal = job.journal.shard(k) if job.journal is not None else None
+        Xk, yk = self._place(k, X, y)
+        fn = job.run or run_sweep
+        with jax.default_device(self._device(k)):
+            return fn(job.est, grids, Xk, yk, folds, evaluator,
+                      wctx, sharding=None, journal=journal)
+
+    # -- rollup ------------------------------------------------------------- #
+
+    def _rollup(self, root) -> None:
+        rep = self.report
+        busy = [w.busy_s for w in rep.workers]
+        denom = rep.n_workers * max(rep.wall_s, 1e-9)
+        rep.utilization_frac = min(1.0, sum(busy) / denom)
+        alive = [(b, w.worker) for b, w in zip(busy, rep.workers)
+                 if w.retired is None]
+        if len(alive) > 1:
+            med = float(np.median([b for b, _ in alive]))
+            worst_busy, worst = max(alive)  # retired lanes can't straggle
+            if med > 0 and worst_busy > 1.5 * med:
+                rep.straggler = worst
+                obs_export.record_event(
+                    "straggler", worker=worst,
+                    busy_s=round(worst_busy, 6), median_s=round(med, 6))
+        obs_export.record_event(
+            "mesh_utilization", workers=rep.n_workers,
+            utilization_frac=round(rep.utilization_frac, 4),
+            steals=rep.steals, requeues=rep.requeues,
+            idle_s=round(sum(w.idle_s for w in rep.workers), 6),
+            blocks=rep.blocks, wall_s=round(rep.wall_s, 6))
+        root.set(utilization_frac=round(rep.utilization_frac, 4),
+                 steals=rep.steals)

@@ -1,0 +1,245 @@
+"""multichip-smoke: the distributed sweep scheduler on a forced host mesh.
+
+The CI gate for `parallel/scheduler.py` (`make multichip-smoke`) — and
+the measured half of bench's multichip story (`python bench.py
+multichip` calls `run_measured` with bigger shapes). On 8 XLA
+host-platform virtual devices (the reference's `local[2]` trick):
+
+1. **exact-winner parity**: a 2-family grid sweep scheduled across an
+   8-wide sweep mesh must reproduce the single-device sweep's metric
+   matrix bit for bit (JSON-roundtrip exact) — per-worker blocks run
+   the exact single-device programs, so distribution must not move a
+   single ulp;
+2. **kill-one-worker resume parity**: an `InjectedKill` at the LAST
+   block claim (``scheduler.worker_block``) preempts the schedule; the
+   surviving lanes drain + journal their in-flight blocks, so resuming
+   re-runs ONLY the killed worker's in-flight block — asserted from
+   the per-worker journal shard record counts;
+3. **work stealing**: an injected worker-level *error* retires one
+   lane mid-schedule; the survivors steal its block and the sweep
+   completes with the same exact metrics (no resume needed);
+4. **measurement**: single-device vs mesh wall clock + the goodput
+   mesh-utilization rollup — the measured counterpart of the bench's
+   divide-by-N pod extrapolation.
+
+Run: ``python -m transmogrifai_tpu.parallel.smoke`` (fresh process: the
+module forces the 8-device host platform before JAX initializes).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict
+
+
+def ensure_host_devices(n: int = 8) -> None:
+    """Force `n` virtual CPU devices — must run before backend init."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _cols(n: int, seed: int = 3):
+    import numpy as np
+
+    import transmogrifai_tpu.types as T
+    from transmogrifai_tpu.data.columns import Column
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.6 * X[:, 1] + rng.normal(0, 0.5, n) > 0) \
+        .astype(np.float64)
+    return (Column(T.RealNN, {"value": y, "mask": np.ones(n, bool)}),
+            Column(T.OPVector, X))
+
+
+def _selector(ckpt=None, max_iters=(8, 4)):
+    """Two families, every static group exactly 2 configs: LR grids over
+    two max_iter groups + one SVC group = 3 scheduler blocks of 2, so
+    the kill-one-block arithmetic below is exact."""
+    from transmogrifai_tpu.evaluators import BinaryClassificationEvaluator
+    from transmogrifai_tpu.models import OpLinearSVC, OpLogisticRegression
+    from transmogrifai_tpu.selector import ModelSelector
+    from transmogrifai_tpu.selector.validators import OpCrossValidation
+    lr = [{"reg_param": r, "max_iter": it}
+          for it in max_iters for r in (0.01, 0.1)]
+    svc = [{"reg_param": r} for r in (0.01, 0.1)]
+    return ModelSelector(
+        models=[(OpLogisticRegression(), lr), (OpLinearSVC(max_iter=8), svc)],
+        validator=OpCrossValidation(n_folds=2, seed=11),
+        evaluator=BinaryClassificationEvaluator(),
+        checkpoint_dir=ckpt)
+
+
+def _fit(selector, cols, n, mesh=None):
+    from transmogrifai_tpu.stages.base import FitContext
+    return selector.fit_model(cols, FitContext(n_rows=n, seed=7, mesh=mesh))
+
+
+def _rows(model) -> Dict[str, Any]:
+    s = model.summary
+    return {"best_grid": s.best_grid, "best_model": s.best_model,
+            "rows": {f"{r.model}:{json.dumps(r.grid, sort_keys=True)}":
+                     r.fold_metrics for r in s.validation_results}}
+
+
+def _shard_records(ckpt_dir: str) -> int:
+    n = 0
+    for p in glob.glob(os.path.join(ckpt_dir, "*.journal-w*.jsonl")):
+        with open(p) as fh:
+            n += max(0, sum(1 for _ in fh) - 1)  # minus header
+    return n
+
+
+def run_measured(n_devices: int = 8, n_rows: int = 240,
+                 max_iters=(8, 4)) -> Dict[str, Any]:
+    """Single-device vs mesh-scheduled sweep: exact parity + measured
+    speedup + the goodput mesh rollup. Shared by the smoke gate and
+    `bench.py multichip` (which passes more/larger grid blocks so the
+    packing measurement is not dominated by 3 tiny blocks)."""
+    ensure_host_devices(n_devices)
+    import jax
+
+    from transmogrifai_tpu.obs import goodput as obs_goodput
+    from transmogrifai_tpu.obs.trace import TRACER
+    from transmogrifai_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= n_devices, (
+        f"need {n_devices} devices, have {len(jax.devices())}")
+    mesh = make_mesh(n_devices, sweep=n_devices)
+    cols = _cols(n_rows)
+
+    def sel():
+        return _selector(max_iters=max_iters)
+
+    # warm both paths once (compiles must not contaminate the timing,
+    # and the persistent compile cache makes warm the steady state) —
+    # under a THROWAWAY trace, or the warm-up schedule's
+    # mesh_utilization event lands in the caller's trace (bench.py's
+    # root) and its goodput.mesh rollup reports warm-up packing instead
+    # of the measured run's
+    with TRACER.span("run:multichip-warmup", category="run",
+                     new_trace=True):
+        _fit(sel(), cols, n_rows)
+        _fit(sel(), cols, n_rows, mesh=mesh)
+
+    t0 = time.perf_counter()
+    base = _rows(_fit(sel(), cols, n_rows))
+    t_single = time.perf_counter() - t0
+
+    with TRACER.span("run:multichip-bench", category="run",
+                     new_trace=True) as root:
+        t0 = time.perf_counter()
+        sched = _rows(_fit(sel(), cols, n_rows, mesh=mesh))
+        t_mesh = time.perf_counter() - t0
+    report = obs_goodput.build_report(
+        root, TRACER.trace_spans(root.trace_id))
+
+    exact = (base["best_grid"] == sched["best_grid"]
+             and set(base["rows"]) == set(sched["rows"])
+             and all(json.dumps(base["rows"][k]) ==
+                     json.dumps(sched["rows"][k]) for k in base["rows"]))
+    assert exact, "mesh-scheduled sweep is not bit-identical to single-device"
+    util = float(report.mesh.get("utilization_frac", 0.0))
+    assert 0.0 < util <= 1.0, f"mesh utilization out of range: {report.mesh}"
+    return {
+        "n_devices": n_devices,
+        "n_rows": n_rows,
+        "winner_exact": exact,
+        "sweep_single_measured_s": round(t_single, 3),
+        f"sweep_mesh{n_devices}_measured_s": round(t_mesh, 3),
+        "mesh_speedup": round(t_single / max(t_mesh, 1e-9), 3),
+        "mesh_scaling_efficiency": round(
+            t_single / max(t_mesh, 1e-9) / n_devices, 4),
+        "mesh_utilization_frac": round(util, 4),
+        "mesh": report.mesh,
+    }
+
+
+def _smoke_kill_resume(payload: Dict[str, Any], n_rows: int = 240) -> None:
+    """Kill at the LAST block claim: the other blocks are already in
+    flight and drain to their journals, so resume re-runs exactly one
+    2-config block."""
+    from transmogrifai_tpu.parallel.mesh import make_mesh
+    from transmogrifai_tpu.runtime.faults import (
+        SITE_WORKER_BLOCK, FaultPlan, FaultSpec, InjectedKill)
+
+    mesh = make_mesh(8, sweep=8)
+    cols = _cols(n_rows)
+    clean = _rows(_fit(_selector(), cols, n_rows, mesh=mesh))
+    n_blocks, cfg_per_block, total_cfgs = 3, 2, 6
+
+    with tempfile.TemporaryDirectory(prefix="multichip-smoke-") as tmp:
+        plan = FaultPlan(
+            [FaultSpec(SITE_WORKER_BLOCK, at=n_blocks, kind="kill")])
+        killed = False
+        try:
+            with plan.active():
+                _fit(_selector(tmp), cols, n_rows, mesh=mesh)
+        except InjectedKill:
+            killed = True
+        assert killed, "fault plan failed to preempt the schedule"
+        journaled = _shard_records(tmp)
+        assert journaled == total_cfgs - cfg_per_block, (
+            f"drain should journal every block but the killed worker's "
+            f"in-flight one: {journaled}/{total_cfgs} configs journaled")
+
+        resumed = _rows(_fit(_selector(tmp), cols, n_rows, mesh=mesh))
+        rerun = _shard_records(tmp) - journaled
+        assert rerun == cfg_per_block, (
+            f"resume re-ran {rerun} configs, expected exactly the "
+            f"{cfg_per_block}-config in-flight block")
+        assert resumed["best_grid"] == clean["best_grid"]
+        assert all(json.dumps(resumed["rows"][k]) ==
+                   json.dumps(clean["rows"][k]) for k in clean["rows"]), \
+            "resumed metrics are not bit-identical"
+        payload.update(kill_resume="ok",
+                       blocks_journaled_at_kill=journaled // cfg_per_block,
+                       blocks_rerun_on_resume=rerun // cfg_per_block)
+
+
+def _smoke_steal(payload: Dict[str, Any], n_rows: int = 240) -> None:
+    """A worker-level ERROR retires one lane; the survivors steal its
+    in-flight block and the schedule completes exactly."""
+    from transmogrifai_tpu.obs import goodput as obs_goodput
+    from transmogrifai_tpu.obs.trace import TRACER
+    from transmogrifai_tpu.parallel.mesh import make_mesh
+    from transmogrifai_tpu.runtime.faults import (
+        SITE_WORKER_BLOCK, FaultPlan, FaultSpec)
+
+    mesh = make_mesh(8, sweep=8)
+    cols = _cols(n_rows)
+    clean = _rows(_fit(_selector(), cols, n_rows, mesh=mesh))
+    plan = FaultPlan([FaultSpec(SITE_WORKER_BLOCK, at=1, kind="error")])
+    with TRACER.span("run:multichip-steal", category="run",
+                     new_trace=True) as root:
+        with plan.active():
+            stolen = _rows(_fit(_selector(), cols, n_rows, mesh=mesh))
+    report = obs_goodput.build_report(root, TRACER.trace_spans(root.trace_id))
+    assert all(json.dumps(stolen["rows"][k]) ==
+               json.dumps(clean["rows"][k]) for k in clean["rows"]), \
+        "post-steal metrics are not bit-identical"
+    assert report.counts.get("workers_retired", 0) == 1, report.counts
+    assert report.mesh.get("requeues", 0) >= 1, report.mesh
+    payload.update(steal_resilience="ok",
+                   requeues=report.mesh.get("requeues"))
+
+
+def _smoke() -> int:
+    payload: Dict[str, Any] = {}
+    payload.update(run_measured())
+    _smoke_kill_resume(payload)
+    _smoke_steal(payload)
+    print(json.dumps({"multichip_smoke": "ok", **payload}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_smoke())

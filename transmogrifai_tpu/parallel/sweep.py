@@ -99,25 +99,23 @@ class _BestTracker:
         return self.best
 
 
-def _journal_prefill(grids: List[Dict],
-                     metrics: List[Optional[List[float]]]) -> int:
+def journal_prefill(journal, grids: List[Dict],
+                    metrics: List[Optional[List[float]]]) -> int:
     """Fill journaled rows into `metrics`; returns how many were skipped.
     Journal floats round-trip JSON exactly, so a resumed sweep's metric
-    matrix is bit-identical to an uninterrupted run's."""
-    journal = _active_journal()
+    matrix is bit-identical to an uninterrupted run's. The ONE resume-
+    skip implementation: the in-family path below and the distributed
+    scheduler's per-job resume both route through it."""
     if journal is None:
         return 0
-    best = getattr(_SWEEP_TL, "best", None)
     hits = 0
     saved_s = 0.0
     for i, g in enumerate(grids):
+        if metrics[i] is not None:
+            continue
         row = journal.lookup(g)
         if row is not None:
             metrics[i] = row
-            if best is not None:
-                # seed the best-so-far tracker with pre-kill blocks, or
-                # post-resume journal entries would name a worse leader
-                best.note(g, row)
             saved_s += journal.duration_of(g)
             hits += 1
     if hits:
@@ -129,6 +127,11 @@ def _journal_prefill(grids: List[Dict],
                                 total=len(grids),
                                 saved_s=round(saved_s, 6))
     return hits
+
+
+def _journal_prefill(grids: List[Dict],
+                     metrics: List[Optional[List[float]]]) -> int:
+    return journal_prefill(_active_journal(), grids, metrics)
 
 
 def _journal_commit(grids: List[Dict],
@@ -500,6 +503,88 @@ def _l1_l2_of(est, g) -> Dict[str, float]:
     return {"l1": reg * alpha, "l2": reg * (1.0 - alpha)}
 
 
+# -- per-family static grouping keys ---------------------------------------- #
+# Module-level (not closures inside the handlers) on purpose: the
+# distributed scheduler (parallel/scheduler.py) partitions a family's
+# grids into work blocks along EXACTLY these boundaries, so a scheduled
+# block regroups into one compiled program on its worker — the same
+# static-shape strategy as the single-device sweep, just spread over the
+# mesh. The handlers below pass these same functions to `_sweep_blocks`.
+
+def _static_logistic(est, g) -> Tuple:
+    return (int(_grid_param(est, g, "max_iter")), _enet_of(est, g) > 0.0)
+
+
+def _static_linreg(est, g) -> Tuple:
+    return (_enet_of(est, g) > 0.0,)
+
+
+def _static_svc(est, g) -> Tuple:
+    return (int(_grid_param(est, g, "max_iter")),)
+
+
+def _static_glm(est, g) -> Tuple:
+    ln = _grid_param(est, g, "link")
+    return (str(_grid_param(est, g, "family")),
+            int(_grid_param(est, g, "max_iter")),
+            float(_grid_param(est, g, "var_power")),
+            str(ln) if ln is not None else None)
+
+
+def _static_nb(est, g) -> Tuple:
+    return ()
+
+
+def _static_mlp(est, g) -> Tuple:
+    return (tuple(_grid_param(est, g, "hidden_layers")),
+            int(_grid_param(est, g, "max_iter")))
+
+
+def _static_forest(est, g) -> Tuple:
+    return (int(_grid_param(est, g, "n_trees")),
+            int(_grid_param(est, g, "max_bins")),
+            bool(_grid_param(est, g, "subsample_features")),
+            _depth_bucket(int(_grid_param(est, g, "max_depth"))))
+
+
+def _static_gbt(est, g) -> Tuple:
+    return (int(_grid_param(est, g, "n_estimators")),
+            int(_grid_param(est, g, "max_bins")),
+            int(_grid_param(est, g, "early_stopping_rounds") or 0),
+            _depth_bucket(int(_grid_param(est, g, "max_depth"))))
+
+
+def static_signature(est, grid: Dict) -> Tuple:
+    """The (family, static-group) key a grid config compiles under.
+
+    Two grids with equal signatures share one batched XLA program in the
+    family handlers; the distributed scheduler uses this to cut a
+    family's grid list into blocks that never split a compiled group
+    (a split group would compile twice at different dyn-vector shapes).
+    Unknown estimator classes fall back to per-config blocks (they run
+    the eager `_sweep_generic` path, where a config IS the unit)."""
+    if isinstance(est, (OpXGBoostClassifier, OpXGBoostRegressor,
+                        OpGBTClassifier, OpGBTRegressor)):
+        return ("gbt", _static_gbt(est, grid))
+    if isinstance(est, (OpRandomForestRegressor, OpDecisionTreeRegressor,
+                        OpRandomForestClassifier, OpDecisionTreeClassifier)):
+        return ("forest", _static_forest(est, grid))
+    if isinstance(est, OpLogisticRegression):
+        return ("logistic", _static_logistic(est, grid))
+    if isinstance(est, OpLinearRegression):
+        return ("linreg", _static_linreg(est, grid))
+    if isinstance(est, OpLinearSVC):
+        return ("svc", _static_svc(est, grid))
+    if isinstance(est, OpGeneralizedLinearRegression):
+        return ("glm", _static_glm(est, grid))
+    if isinstance(est, OpNaiveBayes):
+        return ("naive_bayes", _static_nb(est, grid))
+    if isinstance(est, OpMultilayerPerceptronClassifier):
+        return ("mlp", _static_mlp(est, grid))
+    from transmogrifai_tpu.runtime.journal import SweepJournal
+    return ("generic", SweepJournal.key_of(grid))
+
+
 def _sweep_logistic(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     n_classes = est.n_classes or infer_n_classes(np.asarray(y))
 
@@ -515,8 +600,7 @@ def _sweep_logistic(est, grids, X, y, W, V, metric_fn, ctx, sharding):
 
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (int(_grid_param(est, g, "max_iter")),
-                             _enet_of(est, g) > 0.0),
+        static_of=lambda g: _static_logistic(est, g),
         dyn_of=lambda g: _l1_l2_of(est, g),
         build=build, family="logistic")
 
@@ -530,7 +614,7 @@ def _sweep_linreg(est, grids, X, y, W, V, metric_fn, ctx, sharding):
 
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (_enet_of(est, g) > 0.0,),
+        static_of=lambda g: _static_linreg(est, g),
         dyn_of=lambda g: _l1_l2_of(est, g),
         build=build, family="linreg")
 
@@ -538,7 +622,7 @@ def _sweep_linreg(est, grids, X, y, W, V, metric_fn, ctx, sharding):
 def _sweep_svc(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (int(_grid_param(est, g, "max_iter")),),
+        static_of=lambda g: _static_svc(est, g),
         dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
         build=lambda st, idxs: lambda d, w: predict_linear_svc(
             fit_linear_svc(X, y, w, d["reg"], st[0]), X),
@@ -552,16 +636,9 @@ def _sweep_glm(est, grids, X, y, W, V, metric_fn, ctx, sharding):
             fit_glm(X, y, w, d["reg"], family, max_iter, var_power, link),
             X, family, link, var_power)
 
-    def link_of(g):
-        ln = _grid_param(est, g, "link")
-        return str(ln) if ln is not None else None
-
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (str(_grid_param(est, g, "family")),
-                             int(_grid_param(est, g, "max_iter")),
-                             float(_grid_param(est, g, "var_power")),
-                             link_of(g)),
+        static_of=lambda g: _static_glm(est, g),
         dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
         build=build, family="glm")
 
@@ -582,7 +659,7 @@ def _sweep_nb(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     n_classes = est.n_classes or infer_n_classes(np.asarray(y))
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (),
+        static_of=lambda g: _static_nb(est, g),
         dyn_of=lambda g: {"smoothing": float(_grid_param(est, g, "smoothing"))},
         build=lambda st, idxs: lambda d, w: predict_naive_bayes(
             fit_naive_bayes(X, y, w, d["smoothing"], n_classes), X),
@@ -600,8 +677,7 @@ def _sweep_mlp(est, grids, X, y, W, V, metric_fn, ctx, sharding):
             fit_mlp(X, y, w, layers, max_iter, d["lr"], seed), X)
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (tuple(_grid_param(est, g, "hidden_layers")),
-                             int(_grid_param(est, g, "max_iter"))),
+        static_of=lambda g: _static_mlp(est, g),
         dyn_of=lambda g: {"lr": float(_grid_param(est, g, "learning_rate"))},
         build=build, family="mlp")
 
@@ -961,11 +1037,7 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
     # (the persistent compile cache absorbs the extra program per bucket)
     return _sweep_blocks(
         grids, y, W, V, metric_fn, sharding,
-        static_of=lambda g: (int(_grid_param(est, g, "n_trees")),
-                             int(_grid_param(est, g, "max_bins")),
-                             bool(_grid_param(est, g, "subsample_features")),
-                             _depth_bucket(
-                                 int(_grid_param(est, g, "max_depth")))),
+        static_of=lambda g: _static_forest(est, g),
         dyn_of=dyn_of,
         build=build,
         grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6,
@@ -999,10 +1071,7 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
     eval_metric = str(getattr(est, "eval_metric", "logloss") or "logloss")
 
     def static_of(g):
-        return (int(_grid_param(est, g, "n_estimators")),
-                int(_grid_param(est, g, "max_bins")),
-                int(_grid_param(est, g, "early_stopping_rounds") or 0),
-                _depth_bucket(int(_grid_param(est, g, "max_depth"))))
+        return _static_gbt(est, g)
 
     def dyn_of(g):
         mcw = max(float(_grid_param(est, g, "min_child_weight") or 1.0),
@@ -1246,9 +1315,17 @@ def run_sweep(est, grids: List[Dict], X, y, folds, evaluator, ctx,
     same journal re-runs only un-journaled blocks and reproduces the
     bit-identical metric matrix (journal floats round-trip exactly)."""
     _SWEEP_TL.journal = journal
-    _SWEEP_TL.best = _BestTracker(
-        getattr(evaluator, "is_larger_better", True)) \
-        if journal is not None else None
+    best = None
+    if journal is not None:
+        best = _BestTracker(getattr(evaluator, "is_larger_better", True))
+        # seed from EVERY journaled row (not just this call's grids): a
+        # post-resume record's `best` annotation must account for pre-
+        # kill blocks, including — on the distributed scheduler path,
+        # where each worker's run_sweep sees only its own block — the
+        # grids other workers completed
+        for g, row in journal.rows():
+            best.note(g, row)
+    _SWEEP_TL.best = best
     try:
         return _run_sweep(est, grids, X, y, folds, evaluator, ctx, sharding)
     finally:

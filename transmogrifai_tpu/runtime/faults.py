@@ -20,6 +20,8 @@ Sites threaded through the codebase:
 - ``sweep.run_block``      — parallel/sweep.py, before each grid block
 - ``serialize.write_file`` — workflow/serialization.py, before each
   artifact file write
+- ``scheduler.worker_block`` — parallel/scheduler.py, as a mesh worker
+  claims a grid block (worker-level preemption/failure injection)
 
 Fault kinds:
 
@@ -53,11 +55,17 @@ __all__ = [
     "fault_point", "install_plan", "clear_plan", "active_plan",
     "is_oom_error",
     "SITE_READ_CHUNK", "SITE_RUN_BLOCK", "SITE_WRITE_FILE",
+    "SITE_WORKER_BLOCK",
 ]
 
 SITE_READ_CHUNK = "ingest.read_chunk"
 SITE_RUN_BLOCK = "sweep.run_block"
 SITE_WRITE_FILE = "serialize.write_file"
+# parallel/scheduler.py: fires as a worker CLAIMS a block, before any
+# execution — `error` retires the worker (its block is stolen), `kill`
+# preempts the whole schedule (drain + re-raise; resume re-runs only the
+# claiming worker's in-flight block)
+SITE_WORKER_BLOCK = "scheduler.worker_block"
 
 
 class InjectedFault(RuntimeError):

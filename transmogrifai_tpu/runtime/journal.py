@@ -30,14 +30,16 @@ Properties the resume guarantees lean on:
 
 from __future__ import annotations
 
+import glob as _glob
 import hashlib
 import json
 import logging
 import os
+import re
 import threading
 from typing import Any, Dict, List, Optional
 
-__all__ = ["SweepJournal"]
+__all__ = ["SweepJournal", "ShardedSweepJournal"]
 
 log = logging.getLogger(__name__)
 
@@ -57,6 +59,7 @@ class SweepJournal:
         self._lock = threading.Lock()
         self._rows: Dict[str, List[float]] = {}
         self._durations: Dict[str, float] = {}  # key -> block wall seconds
+        self._grids: Dict[str, Dict[str, Any]] = {}  # key -> grid config
         self._header_written = False
         self._load()
 
@@ -81,6 +84,7 @@ class SweepJournal:
             return
         rows: Dict[str, List[float]] = {}
         durations: Dict[str, float] = {}
+        grids: Dict[str, Dict[str, Any]] = {}
         header_ok = False
         valid_bytes = 0   # length of the intact, newline-terminated prefix
         saw_record_line = False
@@ -129,6 +133,8 @@ class SweepJournal:
                 dur = rec.get("duration_s")
                 if isinstance(dur, (int, float)):
                     durations[key] = float(dur)
+                if isinstance(rec.get("grid"), dict):
+                    grids[key] = rec["grid"]
             valid_bytes += len(bline)
         if valid_bytes < len(raw):
             log.warning("sweep journal %s: torn record after %d intact "
@@ -153,6 +159,7 @@ class SweepJournal:
                 return
         self._rows = rows
         self._durations = durations
+        self._grids = grids
         # only a validated header makes appends skip re-writing it — an
         # empty or header-torn file must get a fresh header first
         self._header_written = header_ok
@@ -168,6 +175,17 @@ class SweepJournal:
         feeding the goodput report."""
         with self._lock:
             return self._durations.get(self.key_of(grid), 0.0)
+
+    def rows(self) -> List[tuple]:
+        """Every journaled ``(grid, fold_metrics)`` pair (records whose
+        grid predates grid retention are omitted) — `run_sweep` seeds its
+        best-so-far tracker from this, so post-resume journal ``best``
+        annotations account for blocks completed before the kill even
+        when the resumed call only sees a SUBSET of the grids (the
+        distributed scheduler hands each worker one block)."""
+        with self._lock:
+            return [(self._grids[k], list(self._rows[k]))
+                    for k in self._rows if k in self._grids]
 
     def __len__(self) -> int:
         with self._lock:
@@ -215,5 +233,152 @@ class SweepJournal:
                             "re-run on resume", self.path, exc_info=True)
                 return
             self._rows[key] = [float(m) for m in fold_metrics]
+            self._grids[key] = grid
             if duration_s is not None:
                 self._durations[key] = float(duration_s)
+
+
+# --------------------------------------------------------------------------- #
+# multi-writer sharding                                                       #
+# --------------------------------------------------------------------------- #
+
+_SHARD_RE = re.compile(r"-w(\d+)\.jsonl$")
+
+
+class _ShardWriter:
+    """One worker's view of a `ShardedSweepJournal`: lookups see the
+    MERGED rows of every shard (so a worker never re-runs a block another
+    worker completed), while appends land only in the worker's own shard
+    file — two workers never share an fd, so concurrent appends cannot
+    interleave bytes inside one file."""
+
+    def __init__(self, parent: "ShardedSweepJournal", shard: SweepJournal):
+        self._parent = parent
+        self._shard = shard
+
+    def lookup(self, grid: Dict[str, Any]) -> Optional[List[float]]:
+        return self._parent.lookup(grid)
+
+    def duration_of(self, grid: Dict[str, Any]) -> float:
+        return self._parent.duration_of(grid)
+
+    def rows(self) -> List[tuple]:
+        return self._parent.rows()
+
+    def append(self, grid: Dict[str, Any], fold_metrics: List[float],
+               best: Optional[Dict[str, Any]] = None,
+               duration_s: Optional[float] = None) -> None:
+        self._shard.append(grid, fold_metrics, best=best,
+                           duration_s=duration_s)
+
+    def __len__(self) -> int:
+        return len(self._parent)
+
+
+class ShardedSweepJournal:
+    """Concurrent-worker journal: per-worker shard files merged on read.
+
+    A single `SweepJournal` is append-only through one fd; with N
+    scheduler workers completing blocks concurrently, sharing that fd
+    would interleave partial lines (even line-buffered writes interleave
+    across processes/threads on some filesystems). Instead each worker k
+    appends to its own ``<base>-w<k>.jsonl`` shard — the same
+    header/flush/fsync/torn-tail-repair contract per shard — and reads
+    merge every shard, so resume and steal decisions see the union of
+    all workers' completed blocks. Shard discovery is by filename
+    pattern, so a resumed run with a different worker count still reads
+    every prior shard (and only ever appends to its own).
+    """
+
+    def __init__(self, base_path: str, meta: Optional[Dict[str, Any]] = None,
+                 fsync: bool = True):
+        self.base_path = base_path
+        self.meta = dict(meta or {})
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._shards: Dict[int, SweepJournal] = {}
+        # glob.escape: a checkpoint dir containing [, ?, or * must not
+        # turn shard discovery into a character-class match that finds
+        # nothing (which would silently re-run every journaled block)
+        for path in sorted(_glob.glob(
+                _glob.escape(self.base_path) + "-w*.jsonl")):
+            m = _SHARD_RE.search(path)
+            if m is None:
+                continue
+            k = int(m.group(1))
+            # load (and torn-tail-repair) every existing shard up front:
+            # resume must see the union before any block is scheduled
+            self._shards[k] = SweepJournal(path, meta=self.meta,
+                                           fsync=self.fsync)
+        if os.path.exists(base_path):
+            # a pre-sharding single-file journal at the base path merges
+            # read-only (shard -1): a single-device run killed and then
+            # resumed on a mesh still skips its completed blocks
+            self._shards[-1] = SweepJournal(base_path, meta=self.meta,
+                                            fsync=self.fsync)
+
+    def _shard_path(self, k) -> str:
+        return f"{self.base_path}-w{k}.jsonl"
+
+    def shard(self, k: int) -> _ShardWriter:
+        """Worker k's writer view (merged reads, own-file appends)."""
+        with self._lock:
+            sj = self._shards.get(k)
+            if sj is None:
+                sj = SweepJournal(self._shard_path(k), meta=self.meta,
+                                  fsync=self.fsync)
+                self._shards[k] = sj
+        return _ShardWriter(self, sj)
+
+    def shard_paths(self) -> List[str]:
+        with self._lock:
+            return [s.path for s in self._shards.values()]
+
+    @staticmethod
+    def has_shards(base_path: str) -> bool:
+        """Shard files exist beside `base_path` — a single-device resume
+        of a mesh-journaled sweep must open the sharded reader or every
+        mesh-completed block silently re-runs."""
+        return bool(_glob.glob(_glob.escape(base_path) + "-w*.jsonl"))
+
+    # -- merged reads ------------------------------------------------------ #
+
+    def _all(self) -> List[SweepJournal]:
+        with self._lock:
+            return list(self._shards.values())
+
+    def lookup(self, grid: Dict[str, Any]) -> Optional[List[float]]:
+        for sj in self._all():
+            row = sj.lookup(grid)
+            if row is not None:
+                return row
+        return None
+
+    def duration_of(self, grid: Dict[str, Any]) -> float:
+        for sj in self._all():
+            d = sj.duration_of(grid)
+            if d:
+                return d
+        return 0.0
+
+    def rows(self) -> List[tuple]:
+        seen: Dict[str, tuple] = {}
+        for sj in self._all():
+            for g, row in sj.rows():
+                seen.setdefault(SweepJournal.key_of(g), (g, row))
+        return list(seen.values())
+
+    def append(self, grid: Dict[str, Any], fold_metrics: List[float],
+               best: Optional[Dict[str, Any]] = None,
+               duration_s: Optional[float] = None) -> None:
+        """Single-writer convenience (callers outside a scheduler worker
+        context append to shard 0)."""
+        self.shard(0).append(grid, fold_metrics, best=best,
+                             duration_s=duration_s)
+
+    def __len__(self) -> int:
+        seen: set = set()
+        for sj in self._all():
+            with sj._lock:
+                seen.update(sj._rows.keys())
+        return len(seen)

@@ -137,9 +137,25 @@ class ModelSelector(Estimator):
 
         # -- the sweep --------------------------------------------------- #
         sharding = None
-        if ctx.mesh is not None:  # spread the grid axis across the mesh
-            from transmogrifai_tpu.parallel.mesh import sweep_sharding
-            sharding = sweep_sharding(ctx.mesh)
+        use_scheduler = False
+        if ctx.mesh is not None:
+            import os as _os
+            from transmogrifai_tpu.parallel.mesh import (
+                SWEEP_AXIS, sweep_sharding)
+            # a >1-wide sweep axis runs the distributed work-stealing
+            # scheduler (parallel/scheduler.py): grid blocks partition
+            # across the mesh's sweep rows, per-worker journal shards
+            # form the shared completion log, and each worker's blocks
+            # execute the exact single-device programs (bit-identical
+            # winner). TRANSMOGRIFAI_DISTRIBUTED_SWEEP=0 falls back to
+            # the grid-axis vmap sharding path.
+            use_scheduler = (
+                ctx.cv_refit is None
+                and dict(ctx.mesh.shape).get(SWEEP_AXIS, 1) > 1
+                and _os.environ.get(
+                    "TRANSMOGRIFAI_DISTRIBUTED_SWEEP", "1") != "0")
+            if not use_scheduler:  # spread the grid axis across the mesh
+                sharding = sweep_sharding(ctx.mesh)
         results: List[ValidationResult] = []
         failures = 0
         if ctx.cv_refit is None:
@@ -157,13 +173,9 @@ class ModelSelector(Estimator):
                 with _TRACER.span(f"sweep:family:{type(est).__name__}",
                                   category="sweep_family",
                                   parent=_sweep_parent, grids=len(grids)):
-                    sig = self._sweep_signature(
+                    sig, ckpt, cached = self._checkpoint_lookup(
                         mi, est, grids, X, data_digest, folds, ctx)
-                    ckpt = self._checkpoint_path(mi, est, sig)
-                    cached = self._load_checkpoint(ckpt)
                     if cached is not None:
-                        log.info("sweep checkpoint hit: %s (%d grids)",
-                                 type(est).__name__, len(cached))
                         return cached
                     # block-granular journal: completed grid blocks
                     # persist as the sweep runs, so a kill ANYWHERE
@@ -188,7 +200,10 @@ class ModelSelector(Estimator):
             from concurrent.futures import ThreadPoolExecutor
             par = min(len(self.models), int(_os.environ.get(
                 "TRANSMOGRIFAI_SWEEP_PARALLELISM", "8")))
-            if par > 1 and sharding is None and len(self.models) > 1:
+            if use_scheduler:
+                outcomes = self._sweep_scheduled(
+                    ctx, X, y_dev, folds, data_digest)
+            elif par > 1 and sharding is None and len(self.models) > 1:
                 with ThreadPoolExecutor(max_workers=par) as pool:
                     futs = [pool.submit(run_family, (mi, mg))
                             for mi, mg in enumerate(self.models)]
@@ -229,15 +244,64 @@ class ModelSelector(Estimator):
         return self._finish(ctx, results, finite, sign, X, X_full, y_np,
                             y_dev, train_idx, test_idx, split_summary)
 
-    def _run_sweep_with_retry(self, est, grids, X, y_dev, folds, ctx,
-                              sharding, retries: int = 2, journal=None):
+    def _sweep_scheduled(self, ctx, X, y_dev, folds, data_digest):
+        """Distributed sweep: ALL families' grid blocks go into ONE
+        work-stealing schedule over the mesh (parallel/scheduler.py) —
+        one queue packs the mesh better than per-family fan-out, and a
+        straggling tree family's blocks spread over lanes that finished
+        their linear families. Per-family checkpoints still short-
+        circuit whole families; per-worker journal shards
+        (``<family>.journal-w<k>.jsonl``) are the shared completion log
+        for steal/resume decisions. Returns one outcome per family
+        (metric matrix, or the Exception that failed it)."""
+        from transmogrifai_tpu.parallel.scheduler import (
+            GridScheduler, SweepJob)
+
+        outcomes: List[Any] = [None] * len(self.models)
+        jobs, meta = [], []
+        for mi, (est, grids) in enumerate(self.models):
+            sig, ckpt, cached = self._checkpoint_lookup(
+                mi, est, grids, X, data_digest, folds, ctx)
+            if cached is not None:
+                outcomes[mi] = cached
+                continue
+            jobs.append(SweepJob(
+                index=mi, est=est, grids=grids,
+                journal=self._journal_for(mi, est, sig, sharded=True),
+                name=type(est).__name__,
+                # per-block transient-RPC retry: distribution must not be
+                # LESS fault-tolerant than the single-device family path
+                run=self._block_runner(type(est).__name__)))
+            meta.append((mi, ckpt))
+        if jobs:
+            sched = GridScheduler(mesh=ctx.mesh)
+            for (mi, ckpt), out in zip(meta, sched.run(
+                    jobs, X, y_dev, folds, self.evaluator, ctx)):
+                outcomes[mi] = out
+                if not isinstance(out, Exception):
+                    self._save_checkpoint(ckpt, out)
+        return outcomes
+
+    def _block_runner(self, family: str):
+        """run_sweep wrapped in the transient-RPC RetryPolicy, one policy
+        per family job (attempt budgets must not pool across blocks of
+        different families). Used as `SweepJob.run` by the scheduler;
+        completed grids inside a retried block skip via the journal."""
+        policy = self._sweep_retry_policy()
+
+        def run_block(*args, **kwargs):
+            return policy.call(run_sweep, *args,
+                               label=f"sweep.{family}", **kwargs)
+        return run_block
+
+    @staticmethod
+    def _sweep_retry_policy(retries: int = 2):
         """The serving tunnel's remote-compile RPC occasionally drops a
-        response mid-read (transient INTERNAL error, r3 bench); dropping a
-        whole model family for that throws away real work. Retry through
-        the shared `runtime.retry.RetryPolicy` — the persistent compile
-        cache plus the block journal make a retry cheap (journaled blocks
-        are skipped) — and only then let the family-drop fault tolerance
-        (OpValidator.scala:344-347 parity) take over."""
+        response mid-read (transient INTERNAL error, r3 bench); dropping
+        a whole model family for that throws away real work. Shared by
+        the single-device family path AND the distributed scheduler's
+        per-block runner — the persistent compile cache plus the block
+        journal make a retry cheap (journaled blocks are skipped)."""
         from transmogrifai_tpu.runtime.retry import RetryPolicy
 
         def classify(e):
@@ -246,15 +310,34 @@ class ModelSelector(Estimator):
                 return True
             return None  # fall through to the error's own `transient` attr
 
-        policy = RetryPolicy(max_attempts=retries + 1, base_delay_s=3.0,
-                             max_delay_s=10.0, backoff=1.5,
-                             transient_types=(), classify=classify)
-        return policy.call(
+        return RetryPolicy(max_attempts=retries + 1, base_delay_s=3.0,
+                           max_delay_s=10.0, backoff=1.5,
+                           transient_types=(), classify=classify)
+
+    def _run_sweep_with_retry(self, est, grids, X, y_dev, folds, ctx,
+                              sharding, retries: int = 2, journal=None):
+        """Family sweep behind the transient-RPC RetryPolicy; only after
+        exhaustion does the family-drop fault tolerance
+        (OpValidator.scala:344-347 parity) take over."""
+        return self._sweep_retry_policy(retries).call(
             run_sweep, est, grids, X, y_dev, folds, self.evaluator, ctx,
             sharding=sharding, journal=journal,
             label=f"sweep.{type(est).__name__}")
 
     # -- sweep checkpointing ------------------------------------------- #
+
+    def _checkpoint_lookup(self, mi, est, grids, X, data_digest, folds, ctx):
+        """(sig, ckpt_path, cached matrix-or-None) for one family — the
+        ONE source of checkpoint-hit semantics for both the
+        single-device family path and the distributed scheduler."""
+        sig = self._sweep_signature(
+            mi, est, grids, X, data_digest, folds, ctx)
+        ckpt = self._checkpoint_path(mi, est, sig)
+        cached = self._load_checkpoint(ckpt)
+        if cached is not None:
+            log.info("sweep checkpoint hit: %s (%d grids)",
+                     type(est).__name__, len(cached))
+        return sig, ckpt, cached
 
     @staticmethod
     def _data_digest(X, y) -> Optional[str]:
@@ -318,23 +401,33 @@ class ModelSelector(Estimator):
         return os.path.join(self.checkpoint_dir,
                             f"sweep_{mi}_{type(est).__name__}_{sig}.json")
 
-    def _journal_for(self, mi, est, sig):
+    def _journal_for(self, mi, est, sig, sharded: bool = False):
         """Open (or resume) the family's block journal beside the family
-        checkpoint. Never raises — an unusable journal degrades to
-        family-level resume granularity."""
+        checkpoint. `sharded=True` (the distributed scheduler) returns a
+        `ShardedSweepJournal`: per-worker ``-w<k>.jsonl`` shard files
+        merged on read, so concurrent workers never share an append fd
+        (and a pre-existing single-file journal at the base path still
+        merges in read-only). Never raises — an unusable journal
+        degrades to family-level resume granularity."""
         if self.checkpoint_dir is None or sig is None:
             return None
         import os
 
-        from transmogrifai_tpu.runtime.journal import SweepJournal
+        from transmogrifai_tpu.runtime.journal import (
+            ShardedSweepJournal, SweepJournal)
         try:
             os.makedirs(self.checkpoint_dir, exist_ok=True)
-            return SweepJournal(
-                os.path.join(
-                    self.checkpoint_dir,
-                    f"sweep_{mi}_{type(est).__name__}_{sig}.journal"),
-                meta={"sig": sig},
-                fsync=getattr(self, "checkpoint_fsync", True))
+            path = os.path.join(
+                self.checkpoint_dir,
+                f"sweep_{mi}_{type(est).__name__}_{sig}.journal")
+            # resume symmetry: a single-device resume of a MESH-journaled
+            # sweep must read the shard files too, or every block the
+            # mesh completed re-runs (appends then go to shard 0)
+            cls = (ShardedSweepJournal
+                   if sharded or ShardedSweepJournal.has_shards(path)
+                   else SweepJournal)
+            return cls(path, meta={"sig": sig},
+                       fsync=getattr(self, "checkpoint_fsync", True))
         except Exception:
             log.warning("sweep journal unusable; family-level resume only",
                         exc_info=True)

@@ -89,6 +89,67 @@ class ServingParams:
 
 
 @dataclass
+class MeshParams:
+    """Device-mesh configuration for distributed runs.
+
+    `Workflow.train()` accepts a `jax.sharding.Mesh` directly; this is
+    the JSON-loadable form the runner/CLI build one from. A >1-wide
+    sweep axis makes every `ModelSelector` in the run schedule its grid
+    blocks across the mesh through the work-stealing scheduler
+    (`parallel/scheduler.py`); devices left on the data axis shard each
+    worker's row data (`parallel/mesh.py`). `n_slices` lays the mesh
+    out for a multi-slice pod via `make_multislice_mesh` (slice
+    boundaries on the sweep axis, DCN-friendly)."""
+
+    n_devices: Optional[int] = None   # default: every visible device
+    sweep: Optional[int] = None       # sweep-axis width (default: all)
+    n_slices: Optional[int] = None    # multislice layout when set
+    data_per_slice: Optional[int] = None
+
+    _FIELDS = ("n_devices", "sweep", "n_slices", "data_per_slice")
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "MeshParams":
+        return MeshParams(**{k: d[k] for k in MeshParams._FIELDS if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+    def build(self):
+        """The configured jax.sharding.Mesh (validates divisibility —
+        a config asking for devices it cannot use must fail loudly, not
+        silently train on a subset)."""
+        from transmogrifai_tpu.parallel.mesh import (
+            make_mesh, make_multislice_mesh)
+        if self.n_slices:
+            if self.sweep is not None:
+                # the multislice sweep width is n_slices × per/data_per_slice
+                # — a `sweep` request would be silently ignored
+                raise ValueError(
+                    "mesh params: `sweep` cannot be combined with "
+                    "`n_slices`; control the lane count via "
+                    "`data_per_slice` (sweep = n_slices × "
+                    "devices_per_slice / data_per_slice)")
+            per = None
+            if self.n_devices is not None:
+                if self.n_devices % self.n_slices != 0:
+                    raise ValueError(
+                        f"mesh params: n_devices={self.n_devices} does "
+                        f"not divide into n_slices={self.n_slices}")
+                per = self.n_devices // self.n_slices
+            return make_multislice_mesh(
+                self.n_slices, devices_per_slice=per,
+                data_per_slice=self.data_per_slice)
+        if self.data_per_slice is not None:
+            # only the multislice layout reads it — on the flat mesh the
+            # requested per-worker data sharding would be silently dropped
+            raise ValueError(
+                "mesh params: `data_per_slice` requires `n_slices`; on a "
+                "flat mesh set `sweep` (data width = n_devices / sweep)")
+        return make_mesh(self.n_devices, sweep=self.sweep)
+
+
+@dataclass
 class SweepCheckpointParams:
     """Resumable-sweep configuration: where `ModelSelector` persists its
     per-family checkpoints and per-block `SweepJournal` files
@@ -132,6 +193,10 @@ class OpParams:
     custom_params: Dict[str, Any] = field(default_factory=dict)
     serving: Optional[ServingParams] = None
     sweep_checkpoint: Optional[SweepCheckpointParams] = None
+    # device-mesh config: train runs build the mesh and pass it to
+    # Workflow.train(mesh=...), turning the selector sweep into a
+    # distributed schedule (parallel/scheduler.py)
+    mesh: Optional[MeshParams] = None
     # persistent device-matrix cache (data/feature_cache.py):
     # `Workflow.train()` installs this as the process default for the
     # run's extent, so every big-data matrix build under the train
@@ -148,6 +213,7 @@ class OpParams:
                       if d.get("sweep_checkpoint") else None)
         feature_cache = (FeatureCacheParams.from_json(d["feature_cache"])
                          if d.get("feature_cache") else None)
+        mesh = MeshParams.from_json(d["mesh"]) if d.get("mesh") else None
         return OpParams(
             stage_params=dict(d.get("stage_params") or {}),
             reader_params=readers,
@@ -163,6 +229,7 @@ class OpParams:
             custom_params=dict(d.get("custom_params") or {}),
             serving=serving,
             sweep_checkpoint=sweep_ckpt,
+            mesh=mesh,
             feature_cache=feature_cache)
 
     @staticmethod
@@ -188,6 +255,7 @@ class OpParams:
             "serving": self.serving.to_json() if self.serving else None,
             "sweep_checkpoint": (self.sweep_checkpoint.to_json()
                                  if self.sweep_checkpoint else None),
+            "mesh": self.mesh.to_json() if self.mesh else None,
             "feature_cache": (self.feature_cache.to_json()
                               if self.feature_cache else None),
         }

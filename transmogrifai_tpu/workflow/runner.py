@@ -172,8 +172,11 @@ class WorkflowRunner:
         reader = self._resolve_reader(self.train_reader, params, "train")
         with profile.phase(profiling.DATA_READING):
             ds = reader.read(self.workflow._raw_features())
+        # mesh params: build the (sweep, data) device mesh so selector
+        # sweeps run the distributed work-stealing scheduler
+        mesh = params.mesh.build() if params.mesh is not None else None
         with profile.phase(profiling.TRAINING, n_rows=len(ds)):
-            model = self.workflow.set_input_dataset(ds).train()
+            model = self.workflow.set_input_dataset(ds).train(mesh=mesh)
         metrics: Dict[str, Any] = {}
         if self.prediction_feature is not None:
             fitted = model.fitted.get(self.prediction_feature.origin_stage.uid)
