@@ -16,7 +16,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	exit $$rc
 
 .PHONY: lint serve-smoke ingest-smoke faults-smoke trace-smoke \
-	cache-smoke multichip-smoke test check
+	cache-smoke multichip-smoke continual-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -60,6 +60,15 @@ serve-smoke:
 multichip-smoke:
 	$(PY) -m transmogrifai_tpu.parallel.smoke
 
+# continuous-training smoke: drifted records appended to a live store
+# fire the drift monitor, a warm-start refit runs while serving stays
+# live (zero dropped requests, p99 measured during refit), the promoted
+# model answers /score with a new version, and an injected holdout
+# regression (runtime/faults site continual.holdout_eval) auto-rolls
+# the swap back. See transmogrifai_tpu/continual/smoke.py.
+continual-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.continual.smoke
+
 # observability smoke: tiny train+score through the runner with
 # --trace-out; validates the Perfetto JSON (well-formed events,
 # monotonic ts, parented spans), the GoodputReport buckets summing to
@@ -72,4 +81,4 @@ test:
 	@$(TIER1)
 
 check: lint serve-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
-	multichip-smoke test
+	multichip-smoke continual-smoke test

@@ -1028,6 +1028,114 @@ def run_serving() -> None:
             })
 
 
+def run_continual() -> None:
+    """Continual-mode bench (`python bench.py continual`): the always-on
+    freshness SLO numbers. Trains a store-backed model, serves it, then
+    appends drifted records and runs one full drift→warm-refit→gated-
+    swap cycle while client threads keep scoring. Emits:
+
+    - ``continual_staleness_s``: append → fresh-model-serving seconds
+      (the headline freshness metric of the closed loop);
+    - ``continual_refit_p99_ms`` / ``p50``: serving latency percentiles
+      measured DURING the refit window (the refit runs off the serving
+      path — the batcher should barely notice), plus dropped-request
+      and shed counts (must be 0 for the loop to claim 'under
+      traffic')."""
+    import tempfile
+    import threading
+
+    from transmogrifai_tpu.continual import ContinualLoop, ContinualParams
+    from transmogrifai_tpu.data.columnar_store import ColumnarStore
+    from transmogrifai_tpu.serving.service import (
+        ScoringService, ServingConfig)
+
+    platform = probe_backend()
+    n_rows = int(os.environ.get("BENCH_CONTINUAL_ROWS", 20_000))
+    n_feats = int(os.environ.get("BENCH_CONTINUAL_FEATS", 16))
+    n_append = int(os.environ.get("BENCH_CONTINUAL_APPEND", 4096))
+    n_clients = int(os.environ.get("BENCH_CONTINUAL_CLIENTS", 4))
+    rng = np.random.default_rng(13)
+    beta = rng.normal(size=n_feats)
+    with tempfile.TemporaryDirectory(prefix="bench-continual-") as tmp:
+        X = rng.standard_normal((n_rows, n_feats)).astype(np.float32)
+        y = (X @ beta > 0).astype(np.float32)
+        w = ColumnarStore.create(f"{tmp}/store", n_rows, n_feats,
+                                 dtype="float32")
+        w.write_chunk(0, X, y)
+        store = w.close()
+        t0 = time.perf_counter()
+        loop = ContinualLoop(
+            store, f"{tmp}/model",
+            params=ContinualParams(window_rows=n_append,
+                                   min_window_rows=256,
+                                   journal_dir=f"{tmp}/journal"),
+            seed=13)
+        loop.train_initial()
+        svc = ScoringService.from_path(
+            f"{tmp}/model", config=ServingConfig(max_batch=32,
+                                                 max_queue=1024))
+        svc.start()
+        loop.attach(svc)
+        setup_s = time.perf_counter() - t0
+        _emit({"metric": "continual_setup_s", "platform": platform,
+               "value": round(setup_s, 2), "unit": "s",
+               "vs_baseline": 0.0, "rows": n_rows, "features": n_feats})
+
+        row = {f"f{j}": 0.1 for j in range(n_feats)}
+        latencies: list = []
+        errors = [0]
+        halt = threading.Event()
+
+        def client(i: int) -> None:
+            while not halt.is_set():
+                t = time.perf_counter()
+                try:
+                    svc.score([row], deadline_ms=10_000)
+                    latencies.append(time.perf_counter() - t)
+                except Exception:
+                    errors[0] += 1
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_clients)]
+        for th in threads:
+            th.start()
+        try:
+            Xn = (rng.standard_normal((n_append, n_feats))
+                  + 2.0).astype(np.float32)
+            yn = (Xn @ beta > 0).astype(np.float32)
+            loop.append(Xn, yn)
+            t1 = time.perf_counter()
+            result = loop.run_cycle()
+            cycle_wall = time.perf_counter() - t1
+        finally:
+            halt.set()
+            for th in threads:
+                th.join(timeout=5)
+            svc.stop()
+        lat = np.array(latencies) if latencies else np.zeros(1)
+        _emit({
+            "metric": "continual_staleness_s", "platform": platform,
+            "value": round(float(result.get("staleness_s") or cycle_wall),
+                           3),
+            "unit": "s", "vs_baseline": 0.0,
+            "status": result.get("status"),
+            "cycle_wall_s": round(cycle_wall, 3),
+            "holdout_metric": (round(result["metric"], 4)
+                               if result.get("metric") is not None
+                               else None),
+            "append_rows": n_append,
+        })
+        _emit({
+            "metric": "continual_refit_p99_ms", "platform": platform,
+            "value": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "unit": "ms", "vs_baseline": 0.0,
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "requests": len(latencies), "errors": errors[0],
+            "clients": n_clients,
+        })
+
+
 def main() -> None:
     global _BENCH_ROOT, _BENCH_ROOT_CM
     # root span for the whole bench: main-thread phase spans (train,
@@ -1058,6 +1166,17 @@ def main() -> None:
             _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
                    "vs_baseline": 0.0,
                    "error": f"serving bench failed: {type(e).__name__}: {e}",
+                   "trace_tail":
+                       traceback.format_exc().strip().splitlines()[-3:]})
+        return
+    if "continual" in sys.argv[1:]:
+        try:
+            run_continual()
+        except Exception as e:
+            _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"continual bench failed: "
+                            f"{type(e).__name__}: {e}",
                    "trace_tail":
                        traceback.format_exc().strip().splitlines()[-3:]})
         return

@@ -1,0 +1,849 @@
+"""continual/ subsystem: crash-consistent store append (+ feature-cache
+invalidation), training fingerprint + PSI drift detection, warm-start
+refits (no-op parity, fewer-steps convergence, compiled-program reuse),
+and the closed loop's gated promotion / journal resume / auto-rollback."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.continual import (
+    ContinualLoop, ContinualParams, DriftMonitor, TrainingFingerprint,
+    extract_warm_params, load_fingerprint, prepare_warm_estimator, psi)
+from transmogrifai_tpu.data.columnar_store import (
+    ColumnarStore, StoreIntegrityError, synth_binary_store)
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.logistic import (
+    OpLogisticRegression, fit_logreg)
+from transmogrifai_tpu.ops.numeric import RealVectorizer
+from transmogrifai_tpu.stages.base import FitContext
+from transmogrifai_tpu.workflow import Workflow
+
+import jax.numpy as jnp
+
+D = 6
+
+
+def _linear_data(n, seed=0, shift=0.0, d=D):
+    rng = np.random.default_rng(seed)
+    beta = np.random.default_rng(99).normal(size=d)  # fixed relationship
+    X = (rng.standard_normal((n, d)) + shift).astype(np.float32)
+    y = (X @ beta > 0).astype(np.float32)
+    return X, y
+
+
+def _make_store(path, n=1200, seed=0):
+    X, y = _linear_data(n, seed=seed)
+    w = ColumnarStore.create(str(path), n, D, dtype="float32")
+    w.write_chunk(0, X, y)
+    return w.close(), X, y
+
+
+# --------------------------------------------------------------------- #
+# streaming append                                                       #
+# --------------------------------------------------------------------- #
+
+class TestAppend:
+    def test_append_extends_rows_and_reads_span_segments(self, tmp_path):
+        st, X, y = _make_store(tmp_path / "s", n=1000)
+        Xn, yn = _linear_data(300, seed=1)
+        w = ColumnarStore.append(st.path, 300)
+        w.write_chunk(0, Xn, yn)
+        st2 = w.close()
+        assert st2.n_rows == 1300 and st2.base_rows == 1000
+        np.testing.assert_array_equal(st2.chunk(0, 1000), X)
+        np.testing.assert_array_equal(st2.chunk(1000, 1300), Xn)
+        span = st2.chunk(900, 1100)  # crosses the segment boundary
+        np.testing.assert_array_equal(span[:100], X[900:])
+        np.testing.assert_array_equal(span[100:], Xn[:100])
+        yfull = np.asarray(st2.y)
+        np.testing.assert_array_equal(yfull[:1000], y)
+        np.testing.assert_array_equal(yfull[1000:], yn)
+        assert sum(len(c) for _, c in st2.iter_chunks(256)) == 1300
+
+    def test_append_verifies_and_checksums_cover_segments(self, tmp_path):
+        st, _, _ = _make_store(tmp_path / "s", n=600)
+        Xn, yn = _linear_data(200, seed=2)
+        w = ColumnarStore.append(st.path, 200)
+        w.write_chunk(0, Xn, yn)
+        st2 = w.close()
+        # full checksum verify passes, and the manifest records per-file
+        # checksums for the segment columns
+        st3 = ColumnarStore(st.path)  # verify=True re-hashes everything
+        seg_keys = [k for k in st3.meta["checksums"] if k.startswith("seg-")]
+        assert len(seg_keys) == 2  # X.bin + y.bin of the segment
+        # bit-flip the segment matrix: open() must refuse
+        seg_x = os.path.join(st.path, st2.meta["segments"][0]["dir"],
+                             "X.bin")
+        with open(seg_x, "r+b") as fh:
+            fh.seek(8)
+            b = fh.read(1)
+            fh.seek(8)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(StoreIntegrityError):
+            ColumnarStore(st.path)
+
+    def test_interrupted_append_leaves_previous_store_intact(self, tmp_path):
+        st, X, _ = _make_store(tmp_path / "s", n=500)
+        Xn, yn = _linear_data(100, seed=3)
+        w = ColumnarStore.append(st.path, 100)
+        w.write_chunk(0, Xn, yn)
+        # kill BEFORE close(): no manifest update, no committed segment
+        del w
+        st2 = ColumnarStore(st.path)
+        assert st2.n_rows == 500
+        np.testing.assert_array_equal(st2.chunk(0, 500), X)
+        # the next append still works and lands cleanly
+        w2 = ColumnarStore.append(st.path, 100)
+        w2.write_chunk(0, Xn, yn)
+        assert w2.close().n_rows == 600
+
+    def test_sample_rows_gathers_across_segments(self, tmp_path):
+        st, X, _ = _make_store(tmp_path / "s", n=400)
+        Xn, yn = _linear_data(200, seed=4)
+        w = ColumnarStore.append(st.path, 200)
+        w.write_chunk(0, Xn, yn)
+        st2 = w.close()
+        sample = st2.sample_rows(600, seed=0)  # all rows, sorted order
+        full = np.concatenate([X, Xn]).astype(np.float32)
+        np.testing.assert_allclose(sample, full, rtol=1e-6)
+
+    def test_concurrent_appends_lose_no_rows(self, tmp_path):
+        """Appenders that all opened against the SAME manifest snapshot
+        commit serially against a re-read manifest: every segment lands,
+        no rows lost, full checksum verification passes."""
+        st, X, _ = _make_store(tmp_path / "s", n=400)
+        batches = [_linear_data(50, seed=60 + i) for i in range(6)]
+        writers = []
+        for Xb, yb in batches:  # all open BEFORE any commit
+            w = ColumnarStore.append(st.path, 50)
+            w.write_chunk(0, Xb, yb)
+            writers.append(w)
+        threads = [threading.Thread(target=w.close) for w in writers]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        st2 = ColumnarStore(st.path)  # full verify
+        assert st2.n_rows == 400 + 300
+        assert len(st2.meta["segments"]) == 6
+        appended = np.asarray(st2.chunk(400, 700))
+        want = np.concatenate([b[0] for b in batches])
+        # commit order is scheduler-dependent: compare as row multisets
+        np.testing.assert_array_equal(
+            np.sort(appended.view([("", appended.dtype)] * D), axis=0),
+            np.sort(want.view([("", want.dtype)] * D), axis=0))
+
+    def test_take_rows_bounds_and_negative_indices(self, tmp_path):
+        """Numpy fancy-index parity: negatives count from the end,
+        out-of-range raises — never an uninitialized gather buffer."""
+        st, X, _ = _make_store(tmp_path / "s", n=300)
+        Xn, yn = _linear_data(100, seed=5)
+        w = ColumnarStore.append(st.path, 100)
+        w.write_chunk(0, Xn, yn)
+        st2 = w.close()
+        np.testing.assert_array_equal(st2.take_rows(np.array([-1])),
+                                      Xn[-1:])
+        np.testing.assert_array_equal(st2.take_rows(np.array([-400])),
+                                      X[:1])
+        with pytest.raises(IndexError):
+            st2.take_rows(np.array([400]))
+        with pytest.raises(IndexError):
+            st2.take_rows(np.array([0, -401]))
+
+    def test_append_invalidates_feature_cache_never_stale_hit(
+            self, tmp_path):
+        """Satellite regression: a post-append device_matrix with
+        cache="readwrite" must be a MISS (the fingerprint moved), and
+        the rebuilt buffer must contain the appended rows."""
+        from transmogrifai_tpu.data import feature_cache as fc
+        from transmogrifai_tpu.parallel import bigdata as bd
+        st = synth_binary_store(str(tmp_path / "s"), 2000, 8, seed=5,
+                                chunk_rows=512)
+        params = fc.FeatureCacheParams(dir=str(tmp_path / "cache"),
+                                       policy="readwrite")
+        x1, st1 = bd.device_matrix(st, chunk_rows=512, cache=params,
+                                   return_stats=True)
+        x2, st2 = bd.device_matrix(st, chunk_rows=512, cache=params,
+                                   return_stats=True)
+        assert (st1.cache, st2.cache) == ("miss", "hit")
+        Xn = np.random.default_rng(0).standard_normal((512, 8)) \
+            .astype(np.float16)
+        w = ColumnarStore.append(st.path, 512)
+        w.write_chunk(0, Xn, np.zeros(512, np.float32))
+        st_post = w.close()
+        x3, st3 = bd.device_matrix(st_post, chunk_rows=512, cache=params,
+                                   return_stats=True)
+        assert st3.cache == "miss", "post-append build served stale bytes"
+        assert x3.shape[0] >= 2512
+        np.testing.assert_allclose(
+            np.asarray(x3[2000:2512], np.float32),
+            Xn.astype(np.float32), rtol=1e-2, atol=1e-2)
+        # and the post-append key is itself cacheable: rebuild hits
+        _, st4 = bd.device_matrix(st_post, chunk_rows=512, cache=params,
+                                  return_stats=True)
+        assert st4.cache == "hit"
+
+
+# --------------------------------------------------------------------- #
+# fingerprint + drift monitor                                            #
+# --------------------------------------------------------------------- #
+
+class TestDrift:
+    def test_psi_zero_for_identical_and_positive_for_shifted(self):
+        p = np.array([0.2, 0.3, 0.5])
+        assert psi(p, p) == pytest.approx(0.0, abs=1e-9)
+        assert psi(p, np.array([0.5, 0.3, 0.2])) > 0.1
+
+    def test_fingerprint_roundtrip(self):
+        X, y = _linear_data(800, seed=6)
+        fp = TrainingFingerprint.from_arrays(X, y, n_bins=8,
+                                             feature_names=[f"f{i}"
+                                                            for i in
+                                                            range(D)])
+        fp2 = TrainingFingerprint.from_json(
+            json.loads(json.dumps(fp.to_json())))
+        assert fp2.n_rows == 800 and fp2.n_bins == 8
+        np.testing.assert_allclose(fp2.fractions, fp.fractions)
+        np.testing.assert_allclose(fp2.edges, fp.edges)
+        assert fp2.label_rate == pytest.approx(fp.label_rate)
+
+    def test_monitor_quiet_on_same_distribution(self):
+        X, y = _linear_data(2000, seed=7)
+        fp = TrainingFingerprint.from_arrays(X, y)
+        mon = DriftMonitor(fp, ContinualParams(min_window_rows=256))
+        Xn, yn = _linear_data(600, seed=8)  # same distribution
+        mon.observe(Xn, yn)
+        rep = mon.check()
+        assert not rep.drifted and rep.max_psi < 0.1, rep.to_json()
+
+    def test_monitor_fires_on_feature_shift(self):
+        X, y = _linear_data(2000, seed=9)
+        fp = TrainingFingerprint.from_arrays(X, y)
+        mon = DriftMonitor(fp, ContinualParams(min_window_rows=256))
+        Xn, yn = _linear_data(600, seed=10, shift=2.0)
+        mon.observe(Xn, yn)
+        rep = mon.check()
+        assert rep.drifted and rep.max_psi > 0.2
+        assert rep.triggers, rep.to_json()
+
+    def test_monitor_fires_on_label_shift_alone(self):
+        X, y = _linear_data(2000, seed=11)
+        fp = TrainingFingerprint.from_arrays(X, y)
+        mon = DriftMonitor(fp, ContinualParams(min_window_rows=100))
+        Xn, _ = _linear_data(400, seed=12)
+        mon.observe(Xn, np.ones(400))  # all-positive labels
+        rep = mon.check()
+        assert rep.drifted and "__label__" in rep.triggers
+
+    def test_monitor_respects_min_window_and_trims(self):
+        X, y = _linear_data(1000, seed=13)
+        fp = TrainingFingerprint.from_arrays(X, y)
+        mon = DriftMonitor(fp, ContinualParams(window_rows=512,
+                                               min_window_rows=256))
+        Xs, ys = _linear_data(100, seed=14, shift=5.0)
+        mon.observe(Xs, ys)
+        assert not mon.check().drifted  # below min_window_rows
+        for s in range(10):
+            Xn, yn = _linear_data(128, seed=20 + s)
+            mon.observe(Xn, yn)
+        assert mon.window_rows <= 512 + 128  # trimmed to the window
+
+    def test_monitor_concurrent_observe_and_check(self):
+        """The documented deployment: the application thread appends
+        (observe) while the supervisor thread checks — the window must
+        stay a consistent (X, y) pairing, never racing the deque."""
+        X, y = _linear_data(1500, seed=40)
+        fp = TrainingFingerprint.from_arrays(X, y)
+        mon = DriftMonitor(fp, ContinualParams(window_rows=512,
+                                               min_window_rows=64))
+        Xb, yb = _linear_data(64, seed=41)
+        stop = threading.Event()
+        errors = []
+
+        def feeder():
+            while not stop.is_set():
+                try:
+                    mon.observe(Xb, yb)
+                except Exception as e:  # pragma: no cover - the bug
+                    errors.append(e)
+                    return
+
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        try:
+            for _ in range(200):
+                Xw, yw = mon.window()
+                assert len(Xw) == len(yw)
+                mon.check()
+        finally:
+            stop.set()
+            th.join(timeout=10)
+        assert not errors, errors
+
+    def test_fingerprint_persisted_via_train_and_loaded(self, tmp_path):
+        X, y = _linear_data(600, seed=15)
+        cols = {f"f{j}": X[:, j].astype(np.float64) for j in range(D)}
+        cols["label"] = y.astype(np.float64)
+        schema = {f"f{j}": t.Real for j in range(D)}
+        schema["label"] = t.Integral
+        ds = Dataset(cols, schema)
+        preds, label = FeatureBuilder.from_dataset(ds, response="label")
+        vec = RealVectorizer(track_nulls=False).set_input(*preds) \
+            .get_output()
+        pred = OpLogisticRegression(max_iter=30).set_input(
+            label, vec).get_output()
+        model = Workflow().set_result_features(pred, label) \
+            .set_input_dataset(ds) \
+            .set_parameters({"continual": {}}).train()
+        assert model.training_fingerprint is not None
+        assert model.training_fingerprint.n_features == D
+        # capture is opt-in: a batch train without the continual block
+        # must not pay the fingerprint pass
+        plain = Workflow().set_result_features(pred, label) \
+            .set_input_dataset(ds).train()
+        assert plain.training_fingerprint is None
+        ins = model.model_insights().to_json()
+        assert ins["trainingFingerprint"]["n_rows"] == 600
+        model.save(str(tmp_path / "m"),
+                   extra_json={"insights.json": ins})
+        fp = load_fingerprint(str(tmp_path / "m"))
+        assert fp is not None and fp.n_features == D
+
+    def test_extra_json_is_integrity_covered(self, tmp_path):
+        from transmogrifai_tpu.workflow.serialization import (
+            ModelIntegrityError, verify_model_dir)
+        X, y = _linear_data(200, seed=16)
+        cols = {f"f{j}": X[:, j].astype(np.float64) for j in range(D)}
+        cols["label"] = y.astype(np.float64)
+        schema = {f"f{j}": t.Real for j in range(D)}
+        schema["label"] = t.Integral
+        ds = Dataset(cols, schema)
+        preds, label = FeatureBuilder.from_dataset(ds, response="label")
+        vec = RealVectorizer(track_nulls=False).set_input(*preds) \
+            .get_output()
+        pred = OpLogisticRegression(max_iter=10).set_input(
+            label, vec).get_output()
+        model = Workflow().set_result_features(pred, label) \
+            .set_input_dataset(ds).train()
+        path = str(tmp_path / "m")
+        model.save(path, extra_json={"insights.json": {"k": 1}})
+        verify_model_dir(path)
+        with open(os.path.join(path, "insights.json"), "a") as fh:
+            fh.write(" ")  # tamper
+        with pytest.raises(ModelIntegrityError):
+            verify_model_dir(path)
+
+
+# --------------------------------------------------------------------- #
+# warm-start refits                                                      #
+# --------------------------------------------------------------------- #
+
+def _holdout_logloss(params, X, y):
+    import jax
+    logits = X @ np.asarray(params["W"]) + np.asarray(params["b"])
+    p = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    idx = y.astype(int)
+    return -float(np.mean(np.log(np.clip(p[np.arange(len(y)), idx],
+                                         1e-12, 1.0))))
+
+
+class TestWarmStart:
+    def test_warm_fit_on_unchanged_data_is_noop_within_tolerance(self):
+        X, y = _linear_data(800, seed=17)
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        w = jnp.ones(len(y))
+        cold = fit_logreg(Xj, yj, w, jnp.float32(0.01), 2, 80)
+        warm = fit_logreg(Xj, yj, w, jnp.float32(0.01), 2, 5,
+                          init_params=cold)
+        assert float(jnp.abs(warm["W"] - cold["W"]).max()) < 1e-2
+        assert float(jnp.abs(warm["b"] - cold["b"]).max()) < 1e-2
+
+    def test_warm_fit_reaches_cold_metric_in_strictly_fewer_steps(self):
+        """Satellite: on appended data the warm start must hit the
+        cold fit's holdout metric with a strictly smaller optimizer
+        step count (counts asserted)."""
+        X, y = _linear_data(900, seed=18)
+        Xa, ya = _linear_data(500, seed=19, shift=1.0)  # appended delta
+        Xh, yh = _linear_data(400, seed=20, shift=1.0)  # holdout
+        X2 = np.concatenate([X, Xa])
+        y2 = np.concatenate([y, ya])
+        Xj, yj = jnp.asarray(X2), jnp.asarray(y2)
+        w = jnp.ones(len(y2))
+        # resident weights: converged on the PRE-append data
+        resident = fit_logreg(jnp.asarray(X), jnp.asarray(y),
+                              jnp.ones(len(y)), jnp.float32(0.01), 2, 80)
+        # target: a converged cold fit's holdout loss (+2% slack)
+        ref = fit_logreg(Xj, yj, w, jnp.float32(0.01), 2, 120)
+        target = _holdout_logloss(ref, Xh, yh) * 1.02
+
+        def steps_to_target(init):
+            for steps in (2, 4, 8, 16, 32, 64, 128):
+                p = fit_logreg(Xj, yj, w, jnp.float32(0.01), 2, steps,
+                               init_params=init)
+                if _holdout_logloss(p, Xh, yh) <= target:
+                    return steps
+            return 256
+
+        cold_steps = steps_to_target(None)
+        warm_steps = steps_to_target(resident)
+        assert warm_steps < cold_steps, (warm_steps, cold_steps)
+
+    def test_warm_fit_reuses_compiled_program(self):
+        """Two warm refits at the same shapes = ONE compiled program:
+        the jit cache must not grow between them."""
+        X, y = _linear_data(300, seed=21)
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        w = jnp.ones(len(y))
+        p0 = fit_logreg(Xj, yj, w, jnp.float32(0.01), 2, 10)
+        fit_logreg(Xj, yj, w, jnp.float32(0.01), 2, 10, init_params=p0)
+        size_after_first_warm = fit_logreg._cache_size()
+        p1 = fit_logreg(Xj, yj, w, jnp.float32(0.02), 2, 10,
+                        init_params=p0)
+        fit_logreg(Xj, yj, w, jnp.float32(0.01), 2, 10, init_params=p1)
+        assert fit_logreg._cache_size() == size_after_first_warm
+
+    def test_warm_shape_mismatch_fails_loudly(self):
+        X, y = _linear_data(200, seed=22)
+        est = OpLogisticRegression(max_iter=5)
+        with pytest.raises(ValueError, match="shape"):
+            est.fit_arrays(jnp.asarray(X), jnp.asarray(y),
+                           jnp.ones(len(y)), FitContext(len(y), 0),
+                           init_params={"W": np.zeros((D + 1, 2)),
+                                        "b": np.zeros(2)})
+
+    def test_gbt_warm_appends_rounds_and_forest_replaces_oldest(self):
+        from transmogrifai_tpu.models.trees import (
+            OpGBTClassifier, OpRandomForestClassifier)
+        X, y = _linear_data(500, seed=23)
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        w = jnp.ones(len(y))
+        ctx = FitContext(len(y), 1)
+        gbt = OpGBTClassifier(n_estimators=8, max_depth=3)
+        g1 = gbt.fit_arrays(Xj, yj, w, ctx)
+        gbt2 = OpGBTClassifier(n_estimators=8, max_depth=3)
+        assert prepare_warm_estimator(gbt2, g1)
+        g2 = gbt2.fit_arrays(Xj, yj, w, ctx)
+        assert g2.trees["feat"].shape[0] == 8 + 2  # n_estimators // 4
+        np.testing.assert_array_equal(g2.trees["feat"][:8],
+                                      g1.trees["feat"])
+        rf = OpRandomForestClassifier(n_trees=10, max_depth=3)
+        r1 = rf.fit_arrays(Xj, yj, w, ctx)
+        rf2 = OpRandomForestClassifier(n_trees=10, max_depth=3)
+        assert prepare_warm_estimator(rf2, r1, delta_rows=100)
+        r2 = rf2.fit_arrays(Xj, yj, w, ctx)
+        n_new = max(1, round(10 * 100 / 500))
+        assert r2.trees["feat"].shape[0] == 10  # size preserved
+        np.testing.assert_array_equal(r2.trees["feat"][:10 - n_new],
+                                      r1.trees["feat"][n_new:])
+
+    def test_gbt_warm_growth_is_capped_at_twice_the_budget(self):
+        """An always-on loop must not boost the ensemble without bound:
+        growth clamps to the 2x ceiling, and a resident ensemble AT the
+        ceiling falls back to a cold fit (size resets)."""
+        from transmogrifai_tpu.models.trees import OpGBTClassifier
+        X, y = _linear_data(400, seed=27)
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        w = jnp.ones(len(y))
+        ctx = FitContext(len(y), 1)
+        gbt = OpGBTClassifier(n_estimators=4, max_depth=3)
+        m = gbt.fit_arrays(Xj, yj, w, ctx)
+        for _ in range(12):  # far more cycles than the cap allows rounds
+            nxt = OpGBTClassifier(n_estimators=4, max_depth=3)
+            assert prepare_warm_estimator(nxt, m)
+            m = nxt.fit_arrays(Xj, yj, w, ctx)
+            assert m.trees["feat"].shape[0] <= 2 * 4
+        assert m.trees["feat"].shape[0] <= 2 * 4
+
+    def test_tree_warm_falls_back_cold_on_class_or_width_change(self):
+        """Host-side warm validation for trees (the resolve_init_params
+        analogue): a new class or feature width under the resident
+        ensemble must fit cold, not silently mistrain (one_hot of an
+        unseen class is all-zeros)."""
+        from transmogrifai_tpu.models.trees import (
+            OpRandomForestClassifier, warm_tree_compatible)
+        X, y = _linear_data(300, seed=28)
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        w = jnp.ones(len(y))
+        ctx = FitContext(len(y), 1)
+        rf = OpRandomForestClassifier(n_trees=6, max_depth=3)
+        m = rf.fit_arrays(Xj, yj, w, ctx)
+        warm = extract_warm_params(m)
+        assert warm_tree_compatible(warm, X, n_classes=2)
+        assert not warm_tree_compatible(warm, X, n_classes=3)
+        assert not warm_tree_compatible(warm, np.zeros((4, D + 1)))
+        # end-to-end: appended data introduces a third class -> the
+        # armed warm refit fits cold with 3-class leaves
+        y3 = np.asarray(y).copy()
+        y3[:10] = 2
+        rf2 = OpRandomForestClassifier(n_trees=6, max_depth=3)
+        assert prepare_warm_estimator(rf2, m)
+        m3 = rf2.fit_arrays(Xj, jnp.asarray(y3), w, ctx)
+        assert m3.trees["leaf"].shape[-1] == 3
+        # a NARROWER estimator histogram than the resident edges would
+        # silently drop rows binned past max_bins: must fit cold
+        assert warm_tree_compatible(warm, X, max_bins=rf.max_bins)
+        assert not warm_tree_compatible(warm, X,
+                                        max_bins=rf.max_bins // 2)
+        rf4 = OpRandomForestClassifier(n_trees=6, max_depth=3,
+                                       max_bins=rf.max_bins // 2)
+        assert prepare_warm_estimator(rf4, m)
+        m4 = rf4.fit_arrays(Xj, yj, w, ctx)      # cold: own (narrow) edges
+        assert m4.edges.shape[1] + 1 == rf4.max_bins
+
+    def test_extract_warm_params_families(self):
+        from transmogrifai_tpu.models.glm import GLMModel
+        from transmogrifai_tpu.models.linear import LinearRegressionModel
+        from transmogrifai_tpu.models.logistic import (
+            LogisticRegressionModel)
+        lm = LogisticRegressionModel(W=np.ones((3, 2)), b=np.zeros(2))
+        assert set(extract_warm_params(lm)) == {"W", "b"}
+        lin = LinearRegressionModel(beta=np.ones(3), intercept=0.5)
+        assert set(extract_warm_params(lin)) == {"beta"}
+        glm = GLMModel(beta=np.ones(3), b=0.1, family="poisson")
+        assert set(extract_warm_params(glm)) == {"beta", "b"}
+        assert extract_warm_params(object()) is None
+
+
+# --------------------------------------------------------------------- #
+# the closed loop                                                        #
+# --------------------------------------------------------------------- #
+
+def _loop_fixture(tmp_path, **param_kw):
+    st, X, y = _make_store(tmp_path / "store", n=1200)
+    params = ContinualParams(window_rows=800, min_window_rows=200,
+                             journal_dir=str(tmp_path / "journal"),
+                             **param_kw)
+    loop = ContinualLoop(st, str(tmp_path / "model"), params=params,
+                         seed=3)
+    loop.train_initial()
+    return loop
+
+
+class TestLoop:
+    def test_no_drift_cycle_is_a_noop(self, tmp_path):
+        loop = _loop_fixture(tmp_path)
+        assert loop.run_cycle()["status"] == "no_drift"
+
+    def test_drift_refit_promotes_and_updates_monitor(self, tmp_path):
+        loop = _loop_fixture(tmp_path)
+        Xn, yn = _linear_data(500, seed=30, shift=2.0)
+        loop.append(Xn, yn)
+        r = loop.run_cycle()
+        assert r["status"] == "promoted", r
+        assert r["metric"] >= r["baseline"] - 0.02
+        assert os.path.isdir(r["candidate"])
+        fp = load_fingerprint(r["candidate"])
+        assert fp is not None
+        # the promoted model becomes the resident baseline
+        assert loop.model is not None
+        assert loop._cycle == 1
+
+    def test_journal_resume_skips_completed_refit(self, tmp_path):
+        """A crash between candidate save and swap resumes at the gate:
+        the journaled candidate is reused, not refit."""
+        loop = _loop_fixture(tmp_path)
+        Xn, yn = _linear_data(500, seed=31, shift=2.0)
+        loop.append(Xn, yn)
+
+        class _Boom(Exception):
+            pass
+
+        class _CrashService:
+            ladder = (8,)
+
+            def reload(self, path):
+                raise _Boom("killed mid-swap")
+
+        loop.attach(_CrashService())
+        with pytest.raises(_Boom):
+            loop.run_cycle()
+        # a fresh process over the same journal resumes the SAME cycle;
+        # the drift window rehydrates from the store's appended segments
+        # (no manual re-observe — the rows are on disk)
+        loop2 = ContinualLoop(str(loop.store.path), loop.model_dir,
+                              params=loop.params, seed=3)
+        loop2.load_resident()
+        assert loop2.monitor.window_rows == len(Xn)
+        assert loop2._cycle == 0  # resumed IN the crashed cycle
+        cand = loop2._pending_candidate()
+        assert cand is not None and os.path.isdir(cand["model_dir"])
+        r = loop2.run_cycle()  # no service attached: promote = adopt
+        assert r["status"] == "promoted"
+        assert r["candidate"] == cand["model_dir"]
+
+    def test_rejected_candidate_never_swaps(self, tmp_path):
+        """A refit that scores worse than the resident on the holdout
+        is rejected before any serving interaction."""
+        loop = _loop_fixture(tmp_path)
+        # an impossible tolerance (candidate must BEAT the baseline by a
+        # full accuracy point) forces the rejection branch determinately
+        loop.params.metric_tolerance = -1.0
+        Xn, yn = _linear_data(500, seed=32, shift=2.0)
+        loop.append(Xn, yn)
+        r = loop.run_cycle()
+        assert r["status"] == "rejected"
+        assert loop._cycle == 1  # cycle consumed, no promotion recorded
+
+    def test_rejected_cycle_cools_down_until_new_rows(self, tmp_path):
+        """After a rejection the supervisor must NOT re-run a full
+        refit on identical data every poll — drift alone is not new
+        evidence. New appends lift the cooldown."""
+        loop = _loop_fixture(tmp_path)
+        loop.params.metric_tolerance = -1.0   # force rejection
+        Xn, yn = _linear_data(500, seed=33, shift=2.0)
+        loop.append(Xn, yn)
+        assert loop.run_cycle()["status"] == "rejected"
+        # same data: no refit, just a cheap cooldown outcome
+        assert loop.run_cycle()["status"] == "cooldown"
+        assert loop.run_cycle()["status"] == "cooldown"
+        # new rows arrive -> the gate is retried
+        loop.params.metric_tolerance = 0.5
+        loop.append(*_linear_data(200, seed=34, shift=2.0))
+        assert loop.run_cycle()["status"] == "promoted"
+
+    def test_promotion_survives_missing_fingerprint(self, tmp_path):
+        """Fingerprint capture is best-effort: a promoted model without
+        one must keep the previous drift baseline (fresh window), not
+        raise after the swap landed and wedge the supervisor."""
+        loop = _loop_fixture(tmp_path)
+        old_fp = loop.monitor.fingerprint
+        import transmogrifai_tpu.workflow.workflow as W
+        orig = W.Workflow.__dict__["_capture_fingerprint"]  # staticmethod
+        W.Workflow._capture_fingerprint = staticmethod(
+            lambda *a, **k: None)
+        try:
+            loop.append(*_linear_data(500, seed=35, shift=2.0))
+            r = loop.run_cycle()
+        finally:
+            W.Workflow._capture_fingerprint = orig
+        assert r["status"] == "promoted"
+        assert loop.monitor is not None
+        assert loop.monitor.fingerprint is old_fp  # baseline kept
+        assert loop.monitor.window_rows == 0       # window reset
+        assert loop._cycle == 1                    # cycle completed
+
+    def test_refit_max_rows_caps_the_training_range(self, tmp_path):
+        """refit_max_rows bounds host materialization: the refit's
+        dataset covers at most that many trailing rows."""
+        loop = _loop_fixture(tmp_path)
+        loop.params.refit_max_rows = 300
+        seen = {}
+        orig = ContinualLoop._dataset
+
+        def spy(self, r0, r1):
+            seen["range"] = (r0, r1)
+            return orig(self, r0, r1)
+
+        ContinualLoop._dataset = spy
+        try:
+            loop.append(*_linear_data(500, seed=36, shift=2.0))
+            r = loop.run_cycle()
+        finally:
+            ContinualLoop._dataset = orig
+        assert r["status"] in ("promoted", "rejected")
+        r0, r1 = seen["range"]
+        assert r1 - r0 == 300
+
+    def test_restart_refit_reuses_fitted_feature_stages(self, tmp_path):
+        """load_resident adopts the ARTIFACT's graph (original uids,
+        fitted transformers): a restart refit reuses the vectorizer the
+        serving model scores with — only the predictor is swapped for a
+        fresh estimator."""
+        loop = _loop_fixture(tmp_path)
+        loop2 = ContinualLoop(str(loop.store.path), loop.model_dir,
+                              params=loop.params, seed=4)
+        loop2.load_resident()
+        pred_f, label_f = loop2._result_features
+        vec_f = next(p for p in pred_f.parents if not p.is_response)
+        artifact_uids = {f.uid for rf in loop2.model.result_features
+                         for f in rf.traverse()}
+        assert vec_f.uid in artifact_uids  # reused, not rebuilt
+        assert label_f.uid in artifact_uids
+        assert loop2._estimator.uid not in loop2.model.fitted  # fresh
+        Xn, yn = _linear_data(500, seed=37, shift=2.0)
+        loop2.append(Xn, yn)
+        r = loop2.run_cycle()
+        assert r["status"] == "promoted", r
+
+    def test_n_bins_param_threads_into_the_fingerprint(self, tmp_path):
+        """ContinualParams.n_bins is not dead config: the loop's trains
+        carry it into the captured fingerprint's histogram geometry."""
+        loop = _loop_fixture(tmp_path, n_bins=7)
+        assert loop.model.training_fingerprint.n_bins == 7
+        assert loop.model.training_fingerprint.n_rows == 1200
+
+    def test_gated_swap_auto_rollback_off_keeps_candidate_live(self):
+        """auto_rollback=False is a policy choice, not a blind spot: the
+        regressed candidate STAYS live (reported, not reverted), so the
+        loop's resident state and the serving state agree."""
+        from transmogrifai_tpu.continual import gated_swap
+
+        class _Svc:
+            ladder = (8,)
+
+            def __init__(self):
+                self.rolled = False
+
+            def reload(self, path):
+                return {"version": "v2"}
+
+            def rollback(self):
+                self.rolled = True
+                return {"version": "v1"}
+
+            def score(self, rows):
+                raise RuntimeError("eval down")
+
+        rows, y = [{"f0": 0.0}] * 4, np.zeros(4)
+        svc = _Svc()
+        r = gated_swap(svc, "unused", rows, y, baseline=0.9,
+                       tolerance=0.02)
+        assert r["status"] == "rolled_back" and svc.rolled
+        svc2 = _Svc()
+        r2 = gated_swap(svc2, "unused", rows, y, baseline=0.9,
+                        tolerance=0.02, auto_rollback=False)
+        assert r2["status"] == "promoted" and not svc2.rolled
+        assert "regressed" in r2
+
+    def test_gated_swap_unchanged_candidate_never_rolls_back(self):
+        """A candidate content-identical to the live version (a warm
+        refit that converged in zero steps) makes reload a no-op — the
+        gate must NOT run the live eval and must NOT rollback() on its
+        failure, or a version that was never displaced gets popped and
+        serving silently reverts to the stale previous artifact."""
+        from transmogrifai_tpu.continual import gated_swap
+
+        class _Svc:
+            ladder = (8,)
+
+            def __init__(self):
+                self.rolled = False
+                self.scored = False
+
+            def reload(self, path):
+                return {"status": "unchanged", "version": "v2"}
+
+            def rollback(self):
+                self.rolled = True
+                return {"version": "v1"}
+
+            def score(self, rows):
+                self.scored = True
+                raise RuntimeError("eval down")
+
+        svc = _Svc()
+        r = gated_swap(svc, "unused", [{"f0": 0.0}] * 4, np.zeros(4),
+                       baseline=0.9, tolerance=0.02)
+        assert r["status"] == "promoted" and r.get("unchanged")
+        assert not svc.rolled and not svc.scored
+
+    def test_loop_auto_rollback_off_installs_the_live_candidate(
+            self, tmp_path):
+        loop = _loop_fixture(tmp_path, auto_rollback=False)
+
+        class _Svc:
+            ladder = (8,)
+            rolled = False
+
+            def reload(self, path):
+                return {"version": "v2"}
+
+            def rollback(self):
+                self.rolled = True
+                return {"version": "v1"}
+
+            def score(self, rows):
+                raise RuntimeError("eval down")
+
+        svc = _Svc()
+        loop.attach(svc)
+        Xn, yn = _linear_data(500, seed=35, shift=2.0)
+        loop.append(Xn, yn)
+        r = loop.run_cycle()
+        assert r["status"] == "promoted", r
+        assert not svc.rolled  # policy honored: no hidden rollback
+        assert loop._cycle == 1
+
+    def test_refit_max_iter_scoped_to_the_warm_fit(self, tmp_path):
+        """refit_max_iter caps the WARM optimizer budget only — a later
+        cold fit of the same estimator sees its own budget again."""
+        loop = _loop_fixture(tmp_path, refit_max_iter=5)
+        cold_budget = loop._estimator.max_iter
+        Xn, yn = _linear_data(500, seed=36, shift=2.0)
+        loop.append(Xn, yn)
+        assert loop.run_cycle()["status"] == "promoted"
+        assert loop._estimator.max_iter == cold_budget
+        assert loop._estimator.init_params is None
+
+    def test_holdout_eval_regressor_with_integral_labels(self):
+        """classification is judged from the model's OUTPUT (probability
+        head), not label integrality — an integer-valued regression
+        target must keep BOTH gates on (negative-)MSE, or the live gate
+        compares accuracy against -MSE and never fires."""
+        from transmogrifai_tpu.continual import holdout_eval
+        from transmogrifai_tpu.models.linear import OpLinearRegression
+        rng = np.random.default_rng(44)
+        X = rng.standard_normal((300, D))
+        beta = np.random.default_rng(99).normal(size=D)
+        y = np.round(X @ beta * 3.0)  # integral regression target
+        cols = {f"f{j}": X[:, j] for j in range(D)}
+        cols["label"] = y
+        schema = {f"f{j}": t.Real for j in range(D)}
+        schema["label"] = t.Real
+        ds = Dataset(cols, schema)
+        preds, label = FeatureBuilder.from_dataset(
+            ds, response="label", response_type=t.RealNN)
+        vec = RealVectorizer(track_nulls=False).set_input(*preds) \
+            .get_output()
+        pred = OpLinearRegression().set_input(label, vec).get_output()
+        model = Workflow().set_result_features(pred, label) \
+            .set_input_dataset(ds).train()
+        metric, classification = holdout_eval(model, ds, y)
+        assert classification is False
+        assert metric <= 0.0  # negative MSE, not an accuracy in [0, 1]
+
+    def test_loop_counters_and_staleness_recorded(self, tmp_path):
+        from transmogrifai_tpu.obs.metrics import get_registry
+        loop = _loop_fixture(tmp_path)
+        Xn, yn = _linear_data(500, seed=33, shift=2.0)
+        before = {
+            k: _counter_value(get_registry(), k)
+            for k in ("continual_promotions_total",
+                      "continual_drift_detected_total")}
+        loop.append(Xn, yn)
+        r = loop.run_cycle()
+        assert r["status"] == "promoted"
+        assert r["staleness_s"] > 0.0
+        reg = get_registry()
+        assert _counter_value(reg, "continual_promotions_total") == \
+            before["continual_promotions_total"] + 1
+        assert _counter_value(reg, "continual_drift_detected_total") == \
+            before["continual_drift_detected_total"] + 1
+
+    def test_goodput_report_accounts_cycles(self, tmp_path):
+        from transmogrifai_tpu.obs.goodput import build_report
+        from transmogrifai_tpu.obs.trace import TRACER
+        with TRACER.span("run:test-continual", category="run",
+                         new_trace=True) as root:
+            loop = _loop_fixture(tmp_path)
+            Xn, yn = _linear_data(500, seed=34, shift=2.0)
+            loop.append(Xn, yn)
+            assert loop.run_cycle()["status"] == "promoted"
+            rep = build_report(root, TRACER.trace_spans(root.trace_id))
+        cont = rep.to_json()["continual"]
+        assert cont["cycles"] == 1 and cont["promoted"] == 1
+        assert cont["drift_detected"] == 1
+        assert cont["last_staleness_s"] > 0.0
+
+
+def _counter_value(reg, name) -> float:
+    fam = reg.to_json().get(name)
+    if not fam or not fam.get("series"):
+        return 0.0
+    return float(sum(s.get("value", 0.0) for s in fam["series"]))

@@ -797,3 +797,78 @@ def grids_loop(grids):
     return [jnp.asarray(g) for g in grids]
 '''
     assert "L011" not in _lint_codes(src)
+
+
+def test_lint_l012_legacy_np_random_flagged_anywhere():
+    """L012: module-level legacy-RNG calls and seedless default_rng()
+    anywhere in the file — module scope, helpers, AND fit bodies (where
+    L004 also fires; L012 is the file-wide superset)."""
+    src = '''
+noise = np.random.randn(8)              # module scope
+
+def shuffle_refit_rows(rows):
+    np.random.shuffle(rows)             # helper fn
+    np.random.seed(0)                   # state management counts too
+    return rows
+
+def sample_drift_window(n):
+    rng = np.random.default_rng()       # seedless generator
+    return rng.uniform(size=n)
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L012"]
+    assert len(findings) == 4
+    assert any("default_rng" in f.message for f in findings)
+
+
+def test_lint_l012_seeded_generator_and_jax_random_clean():
+    src = '''
+def sample(seed, n):
+    rng = np.random.default_rng(seed)
+    k = jax.random.PRNGKey(seed)
+    other.random.shuffle(n)             # not numpy's module RNG
+    return rng.standard_normal(n), jax.random.uniform(k, (n,))
+'''
+    assert "L012" not in _lint_codes(src)
+
+
+def test_lint_l012_seed_kwarg_not_flagged():
+    """`default_rng(seed=...)` (keyword form) is fully deterministic —
+    flagging it would fail `make lint` on correct code."""
+    src = '''
+def sample(cfg, n):
+    rng = np.random.default_rng(seed=cfg.seed)
+    splat = np.random.default_rng(**cfg.rng_kwargs)  # unknowable: trusted
+    return rng.standard_normal(n), splat
+'''
+    assert "L012" not in _lint_codes(src)
+
+
+def test_lint_l012_literal_none_seed_flagged():
+    """default_rng(None) / default_rng(seed=None) are OS-entropy seeded
+    — exactly the spelled-out nondeterminism L012 exists to catch."""
+    src = '''
+def sample(n):
+    a = np.random.default_rng(None)
+    b = np.random.default_rng(seed=None)
+    return a, b
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L012"]
+    assert len(findings) == 2
+
+
+def test_lint_l004_seed_kwarg_not_flagged():
+    src = '''
+class E(Estimator):
+    def fit_model(self, cols, ctx):
+        return np.random.default_rng(seed=ctx.seed).normal(size=3)
+'''
+    assert "L004" not in _lint_codes(src)
+
+
+def test_lint_l012_testkit_exempt():
+    src = "x = np.random.rand(4)\n"
+    flagged = L.lint_source(src, path="transmogrifai_tpu/models/m.py")
+    assert any(f.code == "L012" for f in flagged)
+    exempt = L.lint_source(
+        src, path="transmogrifai_tpu/testkit/random_data.py")
+    assert not any(f.code == "L012" for f in exempt)

@@ -860,6 +860,66 @@ def test_rollback_updates_version_gauge(model_dirs):
         service.stop()
 
 
+def test_reload_of_garbage_model_auto_rolls_back_live(model_dirs, tmp_path):
+    """Satellite: the ROLLBACK half of the gated reload. A reload whose
+    model scores garbage on the live holdout triggers automatic
+    rollback — version gauge restored, `serving_rollbacks_total`
+    ticked, and in-flight requests on the resident version unaffected
+    throughout."""
+    from transmogrifai_tpu.continual import gated_swap, live_holdout_metric
+    ds, v1, _ = model_dirs
+    # a garbage candidate: same schema, labels PERMUTED, so it passes
+    # every integrity check and still predicts noise
+    rng = np.random.default_rng(5)
+    bad_ds = Dataset(
+        {**{k: ds.columns[k] for k in ("age", "fare", "sex")},
+         "survived": rng.permutation(np.asarray(ds.columns["survived"]))},
+        dict(ds.schema))
+    bad_dir = str(tmp_path / "garbage")
+    _train(bad_ds, reg_param=5.0, max_iter=5).save(bad_dir)
+
+    service = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=8, batch_wait_ms=1.0))
+    service.start()
+    stop_traffic = threading.Event()
+    traffic_errors: list = []
+
+    def traffic():
+        while not stop_traffic.is_set():
+            try:
+                service.score([ROWS[0]])
+            except Exception as e:  # noqa: BLE001 - any drop fails the test
+                traffic_errors.append(f"{type(e).__name__}: {e}")
+            time.sleep(0.005)
+
+    th = threading.Thread(target=traffic, daemon=True)
+    th.start()
+    try:
+        v1_id = service.health()["model_version"]
+        hold = ds.take(np.arange(64))
+        y = np.asarray(hold.columns["survived"], np.float64)
+        rows = [{k: r[k] for k in ("age", "fare", "sex")}
+                for r in hold.to_rows()]
+        baseline = live_holdout_metric(service, rows, y,
+                                       classification=True)
+        gauge = service.registry.gauge("serving_model_versions")
+        result = gated_swap(service, bad_dir, rows, y,
+                            baseline=baseline, tolerance=0.02,
+                            registry=service.registry)
+        assert result["status"] == "rolled_back", result
+        assert service.health()["model_version"] == v1_id
+        assert gauge.value == 1.0
+        rb = service.registry.counter("serving_rollbacks_total")
+        assert rb.value == 1.0
+        # the restored version still answers
+        assert service.score([ROWS[0]]).model_version == v1_id
+    finally:
+        stop_traffic.set()
+        th.join(timeout=5)
+        service.stop()
+    assert not traffic_errors, traffic_errors[:3]
+
+
 def test_score_stream_midstream_small_batch_not_padded(model_dirs):
     """Only the FINAL ragged batch pads; a mid-stream smaller batch is a
     real workload shape and passes through untouched (no silent compute

@@ -92,6 +92,18 @@ silent slowness or nondeterminism once XLA is in the loop:
   host-bound serial one; move the host work outside the mapped
   computation (or into the scheduler's host-side worker loop).
 
+- ``L012 legacy-global-rng``: any call through numpy's module-level
+  legacy RNG surface (``np.random.rand`` / ``randn`` / ``normal`` /
+  ``seed`` / ``shuffle`` / ...), or a seedless
+  ``np.random.default_rng()``, ANYWHERE outside ``testkit/`` — not just
+  inside fit bodies (that narrower case is L004). The module-level
+  functions share ONE hidden global ``RandomState``: any import-order
+  or thread-interleaving change silently reorders every draw, so drift
+  sampling, refit shuffling, and journal-resumed continual cycles stop
+  replaying deterministically. Use a seeded
+  ``np.random.default_rng(seed)`` ``Generator`` instead (`testkit/` is
+  exempt: test fixtures own their processes).
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -134,6 +146,33 @@ _NONDET_NP_RANDOM = {
     "rand", "randn", "randint", "random", "choice", "shuffle",
     "permutation", "uniform", "normal", "random_sample",
 }
+
+# L012: the full module-level legacy-RNG surface (shared hidden global
+# RandomState) — everything L004 flags plus state management and the
+# distribution samplers continual refit/drift code reaches for
+_LEGACY_NP_RANDOM = _NONDET_NP_RANDOM | {
+    "seed", "get_state", "set_state", "standard_normal", "sample",
+    "exponential", "poisson", "beta", "gamma", "binomial", "multinomial",
+    "bytes", "lognormal", "geometric",
+}
+
+
+def _rng_seedless(call: ast.Call) -> bool:
+    """True when a `default_rng(...)` call visibly seeds from OS
+    entropy: no args at all, or a LITERAL None seed (positional or
+    `seed=None` — both are spelled-out nondeterminism). A `**kwargs`
+    splat is statically unknowable and given the benefit of the
+    doubt."""
+    if call.args:
+        a = call.args[0]
+        return isinstance(a, ast.Constant) and a.value is None
+    for kw in call.keywords:
+        if kw.arg is None:      # **splat: unknowable, trusted
+            return False
+        if kw.arg == "seed":
+            return isinstance(kw.value, ast.Constant) and \
+                kw.value.value is None
+    return True
 
 _DEVICE_KINDS = ("scalar", "vector", "prediction")
 
@@ -773,7 +812,7 @@ class _FileLinter(ast.NodeVisitor):
                         sub, "L004",
                         f"global-state RNG `{dotted}` inside `{fn.name}` "
                         "— use np.random.default_rng(ctx.seed)")
-                elif parts[-1] == "default_rng" and not sub.args:
+                elif parts[-1] == "default_rng" and _rng_seedless(sub):
                     self._emit(
                         sub, "L004",
                         f"seedless `{dotted}()` inside `{fn.name}` — pass "
@@ -894,6 +933,42 @@ def _check_spmd_callbacks(tree: ast.AST, path: str) -> List[LintFinding]:
     return findings
 
 
+# -- L012: legacy global-RNG calls (file-wide, testkit-exempt) -------------- #
+
+def _check_legacy_np_random(tree: ast.AST, path: str) -> List[LintFinding]:
+    """Flag every call through numpy's module-level legacy RNG (and
+    seedless `default_rng()`) anywhere in the file. `testkit/` files are
+    exempt — fixtures own their process and seed at the call site."""
+    if "testkit" in os.path.normpath(path).split(os.sep):
+        return []
+    findings: List[LintFinding] = []
+    for sub in ast.walk(tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        dotted = _dotted(sub.func)
+        if dotted is None:
+            continue
+        parts = dotted.split(".")
+        if len(parts) < 3 or parts[-2] != "random" or \
+                parts[0] not in ("np", "numpy"):
+            continue
+        if parts[-1] in _LEGACY_NP_RANDOM:
+            findings.append(LintFinding(
+                path, getattr(sub, "lineno", 0), "L012",
+                f"legacy global-RNG call `{dotted}` — the module-level "
+                "np.random functions share one hidden RandomState, so "
+                "any import/thread reordering silently reshuffles every "
+                "draw; use a seeded np.random.default_rng(seed) "
+                "Generator"))
+        elif parts[-1] == "default_rng" and _rng_seedless(sub):
+            findings.append(LintFinding(
+                path, getattr(sub, "lineno", 0), "L012",
+                f"seedless `{dotted}()` — drift sampling and refit "
+                "shuffling must replay deterministically across "
+                "journal-resumed runs; pass an explicit seed"))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -908,6 +983,7 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter = _FileLinter(path, classes)
     linter.visit(tree)
     linter.findings.extend(_check_spmd_callbacks(tree, path))
+    linter.findings.extend(_check_legacy_np_random(tree, path))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 

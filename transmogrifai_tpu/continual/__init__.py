@@ -1,0 +1,19 @@
+"""Continuous training: streaming append → drift detection → warm-start
+refit → gated hot-swap under traffic (see continual/loop.py)."""
+
+from transmogrifai_tpu.continual.drift import (
+    DriftMonitor, DriftReport, TrainingFingerprint, load_fingerprint, psi)
+from transmogrifai_tpu.continual.loop import (
+    ContinualLoop, gated_swap, holdout_eval, holdout_metric,
+    live_holdout_metric)
+from transmogrifai_tpu.continual.params import ContinualParams
+from transmogrifai_tpu.continual.refit import (
+    extract_warm_params, prepare_warm_estimator)
+
+__all__ = [
+    "ContinualLoop", "ContinualParams", "DriftMonitor", "DriftReport",
+    "TrainingFingerprint", "load_fingerprint", "psi", "gated_swap",
+    "holdout_eval", "holdout_metric", "live_holdout_metric",
+    "extract_warm_params",
+    "prepare_warm_estimator",
+]

@@ -1,0 +1,272 @@
+"""Drift detection: training fingerprint vs a sliding window of
+appended records.
+
+The fingerprint is captured at fit time from the SAME matrix the
+predictor trained on — per-feature quantile-bin histograms plus the
+streaming moments the SanityChecker already computes in one fused
+device pass (`automl/sanity_checker._column_reductions`) — and is
+persisted into ModelInsights beside the model artifact, so the monitor
+of a freshly restarted process compares against what the serving model
+actually saw, not against whatever rows happen to be on disk.
+
+Shift is scored per feature as PSI (population stability index) over
+the fingerprint's own bin edges:
+
+    PSI = Σ_b (q_b − p_b) · ln(q_b / p_b)
+
+with p the training fraction and q the window fraction per bin
+(ε-clamped — an empty bin must read as strong evidence, not a NaN).
+PSI ≥ 0.2 is the standard "significant shift" trigger. The label side
+is a plain rate shift: |mean(y_window) − mean(y_train)| — cheap, and a
+flipped label relationship shows up there long before feature
+marginals move.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu.continual.params import ContinualParams
+
+log = logging.getLogger(__name__)
+
+_EPS = 1e-4          # PSI bin-fraction clamp
+_FP_SAMPLE = 100_000  # fingerprint row-sample cap (quantiles stabilize long before)
+
+
+def psi(expected: np.ndarray, actual: np.ndarray,
+        eps: float = _EPS) -> float:
+    """Population stability index between two bin-fraction vectors."""
+    p = np.clip(np.asarray(expected, np.float64), eps, None)
+    q = np.clip(np.asarray(actual, np.float64), eps, None)
+    p = p / p.sum()
+    q = q / q.sum()
+    return float(((q - p) * np.log(q / p)).sum())
+
+
+def _histogram_fractions(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """(d, n_bins) per-feature bin fractions of X over `edges`
+    ((d, n_bins-1) interior edges): one vectorized searchsorted per
+    feature, NaNs dropped from the count."""
+    n, d = X.shape
+    n_bins = edges.shape[1] + 1
+    out = np.zeros((d, n_bins), np.float64)
+    for j in range(d):
+        col = X[:, j]
+        col = col[np.isfinite(col)]
+        if col.size == 0:
+            out[j] = 1.0 / n_bins
+            continue
+        b = np.searchsorted(edges[j], col, side="right")
+        out[j] = np.bincount(b, minlength=n_bins)[:n_bins] / col.size
+    return out
+
+
+@dataclass
+class TrainingFingerprint:
+    """What the training data looked like, compressed to what drift
+    scoring needs: per-feature quantile edges + bin fractions + moments,
+    and the label rate. JSON round-trips into ModelInsights."""
+
+    n_rows: int
+    edges: np.ndarray        # (d, n_bins-1) interior quantile edges
+    fractions: np.ndarray    # (d, n_bins) training bin fractions
+    means: np.ndarray        # (d,)
+    variances: np.ndarray    # (d,)
+    label_rate: float
+    feature_names: List[str] = field(default_factory=list)
+
+    @property
+    def n_features(self) -> int:
+        return int(self.edges.shape[0])
+
+    @property
+    def n_bins(self) -> int:
+        return int(self.edges.shape[1] + 1)
+
+    @staticmethod
+    def from_arrays(X, y, n_bins: int = 10, sample: int = _FP_SAMPLE,
+                    seed: int = 0,
+                    feature_names: Optional[List[str]] = None,
+                    total_rows: Optional[int] = None
+                    ) -> "TrainingFingerprint":
+        """Fingerprint the training matrix. Rows beyond `sample` are
+        seeded-subsampled (quantile error is O(1/sample) of a bin);
+        moments come from the SanityChecker's fused device reduction so
+        the fingerprint pass adds no second stats implementation.
+        `total_rows` records the true training size when the caller
+        already subsampled X (e.g. device-side, to avoid a full host
+        transfer)."""
+        from transmogrifai_tpu.automl.sanity_checker import _column_reductions
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float64).reshape(-1)
+        n = X.shape[0]
+        if n > sample:
+            rng = np.random.default_rng(seed)
+            idx = np.sort(rng.choice(n, size=sample, replace=False))
+            Xs = X[idx]
+        else:
+            Xs = X
+        qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+        edges = np.nanquantile(Xs.astype(np.float64), qs, axis=0).T
+        edges = np.ascontiguousarray(edges)
+        red = {k: np.asarray(v) for k, v in _column_reductions(Xs).items()}
+        ns = max(Xs.shape[0], 1)
+        means = red["sx"] / ns
+        variances = np.maximum(
+            (red["sxx"] - ns * means ** 2) / max(ns - 1, 1), 0.0)
+        return TrainingFingerprint(
+            n_rows=int(total_rows if total_rows is not None else n),
+            edges=edges,
+            fractions=_histogram_fractions(Xs, edges),
+            means=np.asarray(means, np.float64),
+            variances=np.asarray(variances, np.float64),
+            label_rate=float(np.nanmean(y)) if y.size else 0.0,
+            feature_names=list(feature_names or []))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "n_rows": self.n_rows,
+            "edges": np.asarray(self.edges, np.float64).tolist(),
+            "fractions": np.asarray(self.fractions, np.float64).tolist(),
+            "means": np.asarray(self.means, np.float64).tolist(),
+            "variances": np.asarray(self.variances, np.float64).tolist(),
+            "label_rate": self.label_rate,
+            "feature_names": list(self.feature_names),
+        }
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "TrainingFingerprint":
+        return TrainingFingerprint(
+            n_rows=int(d["n_rows"]),
+            edges=np.asarray(d["edges"], np.float64),
+            fractions=np.asarray(d["fractions"], np.float64),
+            means=np.asarray(d["means"], np.float64),
+            variances=np.asarray(d["variances"], np.float64),
+            label_rate=float(d["label_rate"]),
+            feature_names=list(d.get("feature_names") or []))
+
+
+def load_fingerprint(model_dir: str) -> Optional[TrainingFingerprint]:
+    """The fingerprint persisted beside a saved model (the
+    `insights.json` the continual loop writes via `save_model`'s
+    extra-files hook). None when the artifact predates fingerprinting."""
+    path = os.path.join(model_dir, "insights.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+        fp = doc.get("trainingFingerprint")
+        return TrainingFingerprint.from_json(fp) if fp else None
+    except (ValueError, KeyError, OSError):
+        log.warning("unreadable training fingerprint in %s", model_dir,
+                    exc_info=True)
+        return None
+
+
+@dataclass
+class DriftReport:
+    """One drift check: per-feature PSI against the training histogram
+    plus the label-rate shift, with the thresholds that judged them."""
+
+    drifted: bool
+    window_rows: int
+    max_psi: float
+    label_shift: float
+    psi_by_feature: Dict[str, float] = field(default_factory=dict)
+    triggers: List[str] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "drifted": self.drifted, "window_rows": self.window_rows,
+            "max_psi": round(self.max_psi, 6),
+            "label_shift": round(self.label_shift, 6),
+            "psi_by_feature": {k: round(v, 6)
+                               for k, v in self.psi_by_feature.items()},
+            "triggers": list(self.triggers),
+        }
+
+
+class DriftMonitor:
+    """Sliding-window drift scoring against a TrainingFingerprint.
+
+    `observe(X, y)` feeds appended records; the window keeps the most
+    recent `params.window_rows`. `check()` is cheap (histogram counts +
+    one PSI per feature) and never judges fewer than
+    `params.min_window_rows` rows. Thread-safe: `observe` runs on the
+    appending application thread while the loop's supervisor thread
+    calls `check`/`window`, so the deque is snapshotted under a lock —
+    a check concurrent with an append sees a consistent (X, y) pairing,
+    never a half-updated window."""
+
+    def __init__(self, fingerprint: TrainingFingerprint,
+                 params: Optional[ContinualParams] = None):
+        self.fingerprint = fingerprint
+        self.params = params or ContinualParams()
+        self._chunks: Deque[Tuple[np.ndarray, np.ndarray]] = deque()
+        self._rows = 0
+        self._lock = threading.Lock()
+
+    @property
+    def window_rows(self) -> int:
+        return self._rows
+
+    def observe(self, X, y) -> None:
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float64).reshape(-1)
+        if X.ndim != 2 or X.shape[1] != self.fingerprint.n_features:
+            raise ValueError(
+                f"drift monitor: observed width {X.shape} does not match "
+                f"the fingerprint's {self.fingerprint.n_features} features")
+        with self._lock:
+            self._chunks.append((X, y))
+            self._rows += len(X)
+            while self._chunks and self._rows - len(self._chunks[0][0]) \
+                    >= self.params.window_rows:
+                old = self._chunks.popleft()
+                self._rows -= len(old[0])
+
+    def _snapshot(self) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], int]:
+        with self._lock:
+            return list(self._chunks), self._rows
+
+    def window(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The materialized sliding window (most recent rows last)."""
+        chunks, _ = self._snapshot()
+        if not chunks:
+            d = self.fingerprint.n_features
+            return np.zeros((0, d), np.float32), np.zeros((0,), np.float64)
+        return (np.concatenate([c for c, _ in chunks]),
+                np.concatenate([yc for _, yc in chunks]))
+
+    def check(self) -> DriftReport:
+        fp, p = self.fingerprint, self.params
+        chunks, rows = self._snapshot()
+        if rows < p.min_window_rows:
+            return DriftReport(drifted=False, window_rows=rows,
+                               max_psi=0.0, label_shift=0.0)
+        Xw = np.concatenate([c for c, _ in chunks])
+        yw = np.concatenate([yc for _, yc in chunks])
+        frac = _histogram_fractions(Xw, np.asarray(fp.edges))
+        names = fp.feature_names or [f"f{i}" for i in range(fp.n_features)]
+        scores = {names[j]: psi(fp.fractions[j], frac[j])
+                  for j in range(fp.n_features)}
+        label_shift = abs((float(np.nanmean(yw)) if yw.size else 0.0)
+                          - fp.label_rate)
+        triggers = [nm for nm, s in scores.items() if s > p.psi_threshold]
+        if label_shift > p.label_shift_threshold:
+            triggers.append("__label__")
+        return DriftReport(
+            drifted=bool(triggers), window_rows=rows,
+            max_psi=max(scores.values()) if scores else 0.0,
+            label_shift=label_shift, psi_by_feature=scores,
+            triggers=triggers)

@@ -1,0 +1,727 @@
+"""ContinualLoop: streaming append → drift → warm refit → gated hot-swap.
+
+The closed loop the rest of the codebase built in halves: records
+append to a live `ColumnarStore` (crash-consistent segments), a
+`DriftMonitor` watches them against the training fingerprint persisted
+beside the serving model, and when drift fires a WARM-START refit runs
+OFF the serving path — the feature-engineering stages are reused
+as-fitted, the predictor continues from the resident weights — under a
+`RetryPolicy`, with every completed step journaled so a killed process
+resumes at the saved candidate instead of refitting again. Promotion is
+gated twice: the candidate must hold the holdout metric BEFORE the
+swap, and after the integrity-verified `/reload` a live holdout scored
+THROUGH the serving path must not regress, or the swap auto-rolls back
+to the resident version.
+
+Observability: each pass is one `continual:cycle` span (drift / refit /
+eval / promote children), with `drift_detected` / `refit` / `promoted`
+/ `rolled_back` events in the shared event log and
+`continual_*` counters in the process metrics registry — the same
+surface serving `/metrics` scrapes. A `continual_cycle` summary event
+carries staleness (append → fresh-model-serving seconds) into the
+GoodputReport's `continual` section.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.continual.drift import (
+    DriftMonitor, DriftReport, TrainingFingerprint, load_fingerprint)
+from transmogrifai_tpu.continual.params import ContinualParams
+from transmogrifai_tpu.continual.refit import prepare_warm_estimator
+from transmogrifai_tpu.data.columnar_store import ColumnarStore
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.obs.export import record_event
+from transmogrifai_tpu.obs.metrics import get_registry
+from transmogrifai_tpu.obs.trace import TRACER
+from transmogrifai_tpu.runtime.faults import SITE_HOLDOUT_EVAL, fault_point
+from transmogrifai_tpu.runtime.journal import SweepJournal
+from transmogrifai_tpu.runtime.retry import RetryPolicy
+
+log = logging.getLogger(__name__)
+
+LABEL_COLUMN = "label"
+
+
+def _gate_metric(pred: np.ndarray, y: np.ndarray,
+                 classification: bool) -> float:
+    """THE gate's larger-is-better score — accuracy for classifiers,
+    negative MSE for regressors. One implementation on purpose: the
+    pre-swap baseline (holdout_eval) and the post-swap live gate
+    (live_holdout_metric) must judge the identical quantity, or a
+    candidate that holds the holdout could be rolled back (or a
+    regressed one promoted) by metric skew alone."""
+    pred = np.asarray(pred, np.float64).reshape(-1)
+    y = np.asarray(y, np.float64).reshape(-1)
+    if classification:
+        return float((pred == np.round(y)).mean())
+    return -float(((pred - y) ** 2).mean())
+
+
+def holdout_eval(model, ds: Dataset, y: np.ndarray) -> Tuple[float, bool]:
+    """(metric, classification) holdout score of a WorkflowModel:
+    accuracy for classifiers, negative MSE for regressors — one number
+    on purpose, the gate needs an ordering, not a report. Whether the
+    model IS a classifier is judged from its own output (a non-empty
+    probability head), so the pre-swap baseline and the post-swap live
+    gate share one detector — integer-valued regression labels must not
+    flip the live gate onto accuracy."""
+    out = model.score(ds)
+    tree = next((c.data for c in out.values()
+                 if isinstance(c.data, dict) and "prediction" in c.data),
+                None)
+    if tree is None:
+        raise ValueError("model produced no prediction feature")
+    pred = np.asarray(tree["prediction"], np.float64).reshape(-1)
+    prob = np.asarray(tree.get("probability"))
+    classification = bool(prob.ndim == 2 and prob.shape[1] > 0)
+    return _gate_metric(pred, y, classification), classification
+
+
+def holdout_metric(model, ds: Dataset, y: np.ndarray) -> float:
+    """Larger-is-better holdout score (see `holdout_eval`)."""
+    return holdout_eval(model, ds, y)[0]
+
+
+def live_holdout_metric(service, rows: List[Dict[str, Any]],
+                        y: np.ndarray, classification: bool) -> float:
+    """The same metric scored THROUGH the serving path (the live model,
+    the live batcher, real requests) — what the post-swap gate judges.
+    Requests are cut to the service's own bucket ladder, so the eval
+    coexists with live traffic instead of monopolizing the top bucket.
+    The `continual.holdout_eval` fault site fires first, so chaos tests
+    can force this eval to fail deterministically."""
+    fault_point(SITE_HOLDOUT_EVAL)
+    step = int(service.ladder[-1])
+    preds: List[np.ndarray] = []
+    for i in range(0, len(rows), step):
+        result = service.score(rows[i:i + step])
+        tree = next((v for v in result.outputs.values()
+                     if isinstance(v, dict) and "prediction" in v), None)
+        if tree is None:
+            raise ValueError("serving returned no prediction feature")
+        preds.append(np.asarray(tree["prediction"], np.float64).reshape(-1))
+    pred = np.concatenate(preds) if preds else np.zeros(0)
+    return _gate_metric(pred, y, classification)
+
+
+def gated_swap(service, candidate_dir: str, rows: List[Dict[str, Any]],
+               y: np.ndarray, baseline: float, tolerance: float,
+               classification: bool = True,
+               registry=None, auto_rollback: bool = True) -> Dict[str, Any]:
+    """Reload `candidate_dir` into `service`, then judge it on a LIVE
+    holdout: if the served metric regresses more than `tolerance` below
+    `baseline` — or the eval itself fails (an unknowable metric must be
+    assumed regressed) — the swap rolls back to the resident version.
+    With `auto_rollback=False` a regressed candidate STAYS live (the
+    regression is reported, not reverted — an operator policy choice).
+    In-flight traffic is never touched: reload warms off the serving
+    path and rollback re-activates an already-warm version.
+
+    Returns {"status": "promoted" | "rolled_back", "metric": ...}."""
+    reg = registry or get_registry()
+    info = service.reload(candidate_dir)
+    if info.get("status") == "unchanged":
+        # content-identical candidate (a warm refit at an optimum that
+        # still fits the new data converges in zero steps): nothing was
+        # swapped, so there is nothing to gate — and nothing to roll
+        # back. Running the live eval here would judge the RESIDENT
+        # model, and a transient eval failure would then rollback() a
+        # version that was never displaced, silently downgrading
+        # serving to the previous (stale) artifact.
+        record_event("promotion_unchanged", version=info.get("version"))
+        log.info("continual: candidate %s is content-identical to the "
+                 "live version; promotion is a no-op", info.get("version"))
+        return {"status": "promoted", "metric": None, "unchanged": True,
+                "version": info.get("version")}
+    try:
+        live = live_holdout_metric(service, rows, y, classification)
+        ok = live >= baseline - tolerance
+        reason = (None if ok else
+                  f"live metric {live:.4f} < baseline {baseline:.4f} "
+                  f"- tol {tolerance}")
+    except Exception as e:
+        live, ok = None, False
+        reason = f"live holdout eval failed: {type(e).__name__}: {e}"
+    if ok:
+        return {"status": "promoted", "metric": live,
+                "version": info.get("version")}
+    if not auto_rollback:
+        record_event("live_regression", reason=reason, metric=live,
+                     baseline=round(baseline, 6))
+        log.warning("continual: live regression but auto_rollback is "
+                    "off; candidate %s stays live (%s)",
+                    info.get("version"), reason)
+        return {"status": "promoted", "metric": live, "regressed": reason,
+                "version": info.get("version")}
+    rb = service.rollback()
+    reg.counter("continual_rollbacks_total",
+                "post-swap live regressions auto-rolled back").inc()
+    record_event("rolled_back", reason=reason,
+                 metric=live, baseline=round(baseline, 6),
+                 restored=rb.get("version"))
+    log.warning("continual: rolled back %s -> %s (%s)",
+                info.get("version"), rb.get("version"), reason)
+    return {"status": "rolled_back", "metric": live, "reason": reason,
+            "restored": rb.get("version")}
+
+
+class ContinualLoop:
+    """Supervises one store + one serving model as an always-on system.
+
+    Usage::
+
+        loop = ContinualLoop(store_path, model_dir, params)
+        loop.train_initial()                      # cold fit + save
+        svc = ScoringService.from_path(model_dir).start()
+        loop.attach(svc)
+        loop.start()                              # background supervisor
+        ...
+        loop.append(X_new, y_new)                 # streaming records
+        # drift -> warm refit -> gated swap happen off the serving path
+
+    Single supervisor thread: `run_cycle` (drift check, refit, gate) is
+    only ever called from it (or synchronously in tests/smoke) — the
+    serving scoring thread is never blocked by a refit.
+    """
+
+    def __init__(self, store, model_dir: str,
+                 params: Optional[ContinualParams] = None,
+                 estimator=None, seed: int = 42,
+                 registry=None):
+        self.store = (ColumnarStore(store) if isinstance(store, str)
+                      else store)
+        self.model_dir = os.path.normpath(model_dir)
+        self.params = params or ContinualParams()
+        self.seed = seed
+        self.registry = registry or get_registry()
+        if estimator is None:
+            from transmogrifai_tpu.models.logistic import OpLogisticRegression
+            estimator = OpLogisticRegression(max_iter=100)
+        self._estimator = estimator
+        self._result_features = None
+        self._label_feature = None
+        self.model = None                 # resident WorkflowModel
+        self.monitor: Optional[DriftMonitor] = None
+        self.service = None
+        self._trace_parent = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._wake = threading.Event()
+        self._cycle = 0
+        self._pending_since: Optional[float] = None  # oldest unserved append
+        # store size at the last rejected/rolled-back cycle: until new
+        # rows arrive, re-running the refit would reproduce the same
+        # gated-out candidate once per poll interval (a full train per
+        # second) — drift alone is not new evidence
+        self._gate_cooldown_rows: Optional[int] = None
+        self._journal = None
+        jd = self.params.journal_dir
+        if jd:
+            os.makedirs(jd, exist_ok=True)
+            self._journal = SweepJournal(
+                os.path.join(jd, "continual.jsonl"),
+                meta={"kind": "continual", "model_dir": self.model_dir})
+            self._cycle = self._restore_cycle()
+        self._retry = RetryPolicy(max_attempts=3, seed=seed)
+
+    # -- construction ---------------------------------------------------- #
+
+    def _versions_dir(self) -> str:
+        return self.params.versions_dir or f"{self.model_dir}-versions"
+
+    def _build_graph(self, ds: Dataset) -> None:
+        from transmogrifai_tpu.features.feature import FeatureBuilder
+        from transmogrifai_tpu.ops.numeric import RealVectorizer
+        preds, label = FeatureBuilder.from_dataset(ds, response=LABEL_COLUMN)
+        vec = RealVectorizer(track_nulls=False).set_input(*preds).get_output()
+        pred = self._estimator.set_input(label, vec).get_output()
+        self._result_features = (pred, label)
+        self._label_feature = label
+
+    def _dataset(self, r0: int, r1: int) -> Dataset:
+        X = np.asarray(self.store.chunk(r0, r1), np.float32)
+        y = np.asarray(self.store.y[r0:r1], np.float64)
+        cols: Dict[str, Any] = {
+            name: X[:, j].astype(np.float64)
+            for j, name in enumerate(self.store.feature_names)}
+        cols[LABEL_COLUMN] = y
+        schema = {name: T.Real for name in self.store.feature_names}
+        schema[LABEL_COLUMN] = T.Integral if self._classification \
+            else T.Real
+        return Dataset(cols, schema)
+
+    @property
+    def _classification(self) -> bool:
+        y = self.store.y
+        if y is None:
+            return True
+        head = np.asarray(y[:1024], np.float64)
+        return bool(np.allclose(head, np.round(head)))
+
+    def _insights_json(self, model) -> Dict[str, Any]:
+        from transmogrifai_tpu.insights import ModelInsights
+        return ModelInsights.extract(model).to_json()
+
+    def _save(self, model, path: str) -> None:
+        model.save(path, extra_json={
+            "insights.json": self._insights_json(model)})
+
+    def train_initial(self):
+        """Cold fit on every current store row; persists the model (and
+        its training fingerprint, inside insights.json) to model_dir."""
+        from transmogrifai_tpu.workflow.workflow import Workflow
+        ds = self._dataset(0, self.store.n_rows)
+        if self._result_features is None:
+            self._build_graph(ds)
+        wf = Workflow().set_result_features(*self._result_features) \
+            .set_input_dataset(ds) \
+            .set_parameters({"continual": self.params.to_json()})
+        model = wf.train(seed=self.seed)
+        self._save(model, self.model_dir)
+        self.model = model
+        self._install_monitor(model.training_fingerprint)
+        return model
+
+    def load_resident(self):
+        """Adopt an existing serialized model + fingerprint (process
+        restart path): the monitor compares against what the ARTIFACT
+        trained on, not whatever is currently on disk."""
+        from transmogrifai_tpu.workflow.serialization import load_model
+        self.model = load_model(self.model_dir)
+        fp = load_fingerprint(self.model_dir)
+        if fp is None:
+            raise ValueError(
+                f"{self.model_dir} has no training fingerprint — retrain "
+                "with train_initial() (or any Workflow.train) to capture "
+                "one")
+        self._adopt_graph(self.model)
+        self._install_monitor(fp)
+        # rehydrate the drift window from the store's appended segments:
+        # a process restart must not forget rows that already landed on
+        # disk — without this, a refit candidate journaled before a
+        # crash is unreachable (run_cycle bails at 'no_drift' on the
+        # empty window) until ANOTHER min_window_rows of drifted
+        # appends arrive, and the stale resident serves indefinitely
+        appended = self.store.n_rows - self.store.base_rows
+        if appended > 0:
+            take = min(appended, self.params.window_rows)
+            r0 = self.store.n_rows - take
+            self.monitor.observe(
+                np.asarray(self.store.chunk(r0, self.store.n_rows),
+                           np.float32),
+                np.asarray(self.store.y[r0:self.store.n_rows],
+                           np.float64))
+            if self._pending_since is None:
+                self._pending_since = time.perf_counter()
+        return self.model
+
+    def _adopt_graph(self, model) -> None:
+        """Refit graph for a restarted process, built ON the loaded
+        artifact's own feature stages (original uids, already fitted):
+        feature engineering is reused verbatim — a fresh graph's
+        process-local uids would never match `model.fitted`, so
+        `with_model_stages` would silently REFIT every vectorizer the
+        serving model scores with — and only the predictor is swapped
+        for a fresh estimator wired into the same inputs."""
+        pred_f = next((f for f in model.result_features
+                       if issubclass(f.ftype, T.Prediction)
+                       and f.origin_stage is not None), None)
+        label_f = next((p for p in (pred_f.parents if pred_f else ())
+                        if p.is_response), None)
+        vec_f = next((p for p in (pred_f.parents if pred_f else ())
+                      if issubclass(p.ftype, T.OPVector)), None)
+        if label_f is None or vec_f is None:
+            # artifact without a (label, vector) predictor: fall back to
+            # a fresh graph (feature stages will refit cold)
+            ds = self._dataset(0, min(self.store.n_rows, 16))
+            if self._result_features is None:
+                self._build_graph(ds)
+            return
+        new_pred = self._estimator.set_input(label_f, vec_f).get_output()
+        self._result_features = (new_pred, label_f)
+        self._label_feature = label_f
+
+    def _install_monitor(self, fingerprint) -> None:
+        if fingerprint is None:
+            raise ValueError("training produced no fingerprint (no "
+                             "(label, vector) predictor in the graph?)")
+        if isinstance(fingerprint, dict):
+            fingerprint = TrainingFingerprint.from_json(fingerprint)
+        self.monitor = DriftMonitor(fingerprint, self.params)
+
+    def attach(self, service) -> "ContinualLoop":
+        """Bind the serving service promotions hot-swap into."""
+        self.service = service
+        return self
+
+    # -- streaming append ------------------------------------------------- #
+
+    def append(self, X, y) -> ColumnarStore:
+        """Extend the live store with new records (crash-consistent
+        segment append) and feed the drift window. The store object is
+        swapped for the post-append view; readers holding the old one
+        keep a consistent pre-append snapshot."""
+        X = np.asarray(X)
+        y = np.asarray(y, np.float32)
+        w = ColumnarStore.append(self.store.path, len(X))
+        w.write_chunk(0, X.astype(self.store.dtype), y)
+        self.store = w.close()
+        if self.monitor is not None:
+            self.monitor.observe(X, y)
+        if self._pending_since is None:
+            self._pending_since = time.perf_counter()
+        self.registry.counter(
+            "continual_rows_appended_total",
+            "records appended to the live store").inc(len(X))
+        record_event("continual_append", rows=len(X),
+                     store_rows=self.store.n_rows)
+        self._wake.set()
+        return self.store
+
+    # -- the cycle --------------------------------------------------------- #
+
+    def _restore_cycle(self) -> int:
+        """Journal-derived resume point: normally one past the last
+        cycle, but a cycle whose refit landed with NO terminal step
+        (promoted / rejected / rolled_back — the process died between
+        candidate save and swap) is resumed IN PLACE so the saved
+        candidate gets its gate instead of a duplicate refit."""
+        by_cycle: Dict[int, set] = {}
+        for g, _ in self._journal.rows():
+            by_cycle.setdefault(int(g.get("cycle", 0)), set()).add(
+                g.get("step"))
+        if not by_cycle:
+            return 0
+        last = max(by_cycle)
+        terminal = {"promoted", "rejected", "rolled_back"}
+        if "refit" in by_cycle[last] and not (by_cycle[last] & terminal):
+            return last
+        return last + 1
+
+    def _journal_step(self, step: str, metric: float = 0.0,
+                      **extra: Any) -> None:
+        if self._journal is not None:
+            self._journal.append({"cycle": self._cycle, "step": step,
+                                  **extra}, [float(metric)])
+
+    def _pending_candidate(self) -> Optional[Dict[str, Any]]:
+        """A refit journaled for this cycle whose promotion never
+        landed (crash between save and swap): resume at the gate
+        instead of refitting again."""
+        if self._journal is None:
+            return None
+        steps: Dict[str, Dict[str, Any]] = {}
+        for grid, metrics in self._journal.rows():
+            if int(grid.get("cycle", -1)) == self._cycle:
+                steps[grid.get("step")] = {**grid, "metric": metrics[0]
+                                           if metrics else 0.0}
+        if "refit" in steps and "promoted" not in steps \
+                and "rolled_back" not in steps:
+            cand = steps["refit"]
+            path = cand.get("model_dir")
+            if path and os.path.isdir(path):
+                from transmogrifai_tpu.workflow.serialization import (
+                    ModelIntegrityError, verify_model_dir)
+                try:
+                    verify_model_dir(path)
+                    return cand
+                except (ModelIntegrityError, OSError):
+                    log.warning("continual: journaled candidate %s is "
+                                "torn; refitting", path)
+        return None
+
+    def _split_holdout(self):
+        """The trailing `holdout_fraction` of the drift window: the
+        newest records, held out of the refit, score the candidate."""
+        Xw, yw = self.monitor.window()
+        n_hold = max(1, int(len(Xw) * self.params.holdout_fraction))
+        return Xw[-n_hold:], yw[-n_hold:]
+
+    def _resident_predictor(self):
+        """The resident model's fitted prediction stage — matched by
+        TYPE, not uid, so a process restart (fresh graph uids over a
+        loaded artifact) still finds its warm-start source."""
+        from transmogrifai_tpu.models.base import PredictionModel
+        fitted = self.model.fitted.get(self._estimator.uid)
+        if isinstance(fitted, PredictionModel):
+            return fitted
+        for m in self.model.fitted.values():
+            if isinstance(m, PredictionModel):
+                return m
+        raise ValueError("resident model has no fitted prediction stage")
+
+    def _rows_of(self, X: np.ndarray) -> List[Dict[str, Any]]:
+        names = self.store.feature_names
+        return [{nm: float(x[j]) for j, nm in enumerate(names)}
+                for x in np.asarray(X, np.float64)]
+
+    def _warm_refit(self, holdout_rows: int, store_rows: int):
+        """The refit itself: every feature-engineering stage reused
+        as-fitted, the predictor re-trained warm on all store rows
+        except the trailing holdout. `store_rows` is the row count
+        captured WHEN the holdout was split — an append landing
+        mid-cycle must not shift the holdout boundary, or the refit
+        would train on the very rows the gate scores it on. A warm
+        start whose shapes no longer match the data (e.g. appended
+        records introduced a new class) falls back to a cold fit
+        instead of wedging the loop."""
+        from transmogrifai_tpu.workflow.workflow import Workflow
+        fit_hi = max(1, store_rows - holdout_rows)
+        delta = min(self.monitor.window_rows, fit_hi) \
+            if self.monitor is not None else None
+        cold_max_iter = getattr(self._estimator, "max_iter", None)
+        prepare_warm_estimator(
+            self._estimator, self._resident_predictor(),
+            delta_rows=delta,
+            refit_max_iter=self.params.refit_max_iter)
+        try:
+            # refit_max_rows bounds the host materialization: with a
+            # warm start, the trailing rows carry the new signal — a
+            # multi-GB store need not round-trip through host RAM
+            fit_lo = 0
+            if self.params.refit_max_rows is not None:
+                fit_lo = max(0, fit_hi - int(self.params.refit_max_rows))
+            ds = self._dataset(fit_lo, fit_hi)
+
+            def _train():
+                wf = Workflow() \
+                    .set_result_features(*self._result_features) \
+                    .set_input_dataset(ds) \
+                    .set_parameters({"continual": self.params.to_json()}) \
+                    .with_model_stages(self.model,
+                                       exclude=(self._estimator.uid,))
+                return wf.train(seed=self.seed + self._cycle + 1)
+
+            try:
+                model = _train()
+            except ValueError as e:
+                if "init_params" not in str(e):
+                    raise
+                log.warning("continual: warm start invalid (%s); "
+                            "refitting cold", e)
+                record_event("warm_start_fallback", reason=str(e)[:200])
+                self._estimator.init_params = None
+                if cold_max_iter is not None:
+                    self._estimator.max_iter = cold_max_iter
+                model = _train()
+        finally:
+            # the warm arming is scoped to THIS fit: a later cold fit of
+            # the same estimator must see its own iteration budget again
+            self._estimator.init_params = None
+            if cold_max_iter is not None:
+                self._estimator.max_iter = cold_max_iter
+        self.registry.counter(
+            "continual_refits_total", "warm-start refits executed").inc()
+        return model
+
+    def run_cycle(self) -> Dict[str, Any]:
+        """One supervised pass: drift check; on drift a warm refit,
+        pre-swap holdout gate, integrity-verified promotion, post-swap
+        live gate with auto-rollback. Returns a status dict; never
+        raises for gate failures (those are outcomes, not errors)."""
+        p = self.params
+        t0 = time.perf_counter()
+        with TRACER.span("continual:cycle", category="continual",
+                         parent=self._trace_parent,
+                         cycle=self._cycle) as cycle_span:
+            self.registry.counter(
+                "continual_cycles_total", "continual cycles run").inc()
+            with TRACER.span("continual:drift", category="continual"):
+                report = self.monitor.check() if self.monitor else \
+                    DriftReport(False, 0, 0.0, 0.0)
+            if not report.drifted:
+                cycle_span.set(status="no_drift")
+                return {"status": "no_drift", "report": report.to_json()}
+            if self._gate_cooldown_rows == self.store.n_rows:
+                # the last candidate from exactly this data was gated
+                # out (rejected or rolled back); wait for new appends
+                # instead of re-training the same rejection every poll
+                cycle_span.set(status="cooldown")
+                return {"status": "cooldown",
+                        "report": report.to_json()}
+            record_event("drift_detected",
+                         max_psi=round(report.max_psi, 4),
+                         label_shift=round(report.label_shift, 4),
+                         triggers=report.triggers[:8],
+                         window_rows=report.window_rows)
+            self.registry.counter(
+                "continual_drift_detected_total",
+                "drift checks that fired").inc()
+
+            # snapshot BEFORE splitting: an append() landing after this
+            # line can only shrink the training range relative to the
+            # holdout (never put holdout rows inside it) — the reverse
+            # order would let a mid-cycle append push fit_hi past the
+            # holdout rows and train on them
+            store_rows = self.store.n_rows
+            Xh, yh = self._split_holdout()
+            hold_ds = self._window_dataset(Xh, yh)
+            baseline, classification = holdout_eval(self.model, hold_ds,
+                                                    yh)
+
+            resumed = self._pending_candidate()
+            if resumed is not None:
+                candidate_dir = resumed["model_dir"]
+                metric_new = float(resumed["metric"])
+                from transmogrifai_tpu.workflow.serialization import (
+                    load_model)
+                model2 = load_model(candidate_dir)
+                record_event("refit", resumed=True,
+                             candidate=candidate_dir)
+            else:
+                with TRACER.span("continual:refit", category="continual",
+                                 rows=store_rows - len(Xh)):
+                    model2 = self._retry.call(
+                        self._warm_refit, len(Xh), store_rows,
+                        label="continual.refit")
+                with TRACER.span("continual:eval", category="continual"):
+                    metric_new = holdout_metric(model2, hold_ds, yh)
+                record_event("refit", metric=round(metric_new, 6),
+                             baseline=round(baseline, 6))
+                if metric_new < baseline - p.metric_tolerance:
+                    record_event("refit_rejected",
+                                 metric=round(metric_new, 6),
+                                 baseline=round(baseline, 6))
+                    self._journal_step("rejected", metric_new)
+                    self._gate_cooldown_rows = store_rows
+                    self._finish_cycle(cycle_span, "rejected", t0, report)
+                    return {"status": "rejected", "metric": metric_new,
+                            "baseline": baseline}
+                candidate_dir = os.path.join(
+                    self._versions_dir(), f"v{self._cycle:05d}")
+                self._save(model2, candidate_dir)
+                self._journal_step("refit", metric_new,
+                                   model_dir=candidate_dir)
+
+            swap: Dict[str, Any] = {"status": "promoted", "metric": None}
+            if self.service is not None:
+                with TRACER.span("continual:promote", category="continual",
+                                 candidate=candidate_dir):
+                    live_n = min(len(Xh), p.live_eval_rows)
+                    # the live gate judges candidate-vs-resident on the
+                    # SAME rows: a full-holdout baseline against a
+                    # live_n-row candidate metric would let sampling
+                    # noise alone cross the tolerance
+                    live_baseline = baseline if live_n == len(Xh) else \
+                        holdout_metric(
+                            self.model,
+                            self._window_dataset(Xh[-live_n:],
+                                                 yh[-live_n:]),
+                            yh[-live_n:])
+                    swap = gated_swap(
+                        self.service, candidate_dir,
+                        self._rows_of(Xh[-live_n:]), yh[-live_n:],
+                        baseline=live_baseline,
+                        tolerance=p.metric_tolerance,
+                        classification=classification,
+                        registry=self.registry,
+                        auto_rollback=p.auto_rollback)
+                if swap["status"] == "rolled_back":
+                    self._journal_step("rolled_back")
+                    self._gate_cooldown_rows = store_rows
+                    self._finish_cycle(cycle_span, "rolled_back", t0,
+                                       report)
+                    return {**swap, "candidate": candidate_dir}
+            # promotion landed: the candidate is the resident model now
+            self._gate_cooldown_rows = None
+            self.model = model2
+            new_fp = (model2.training_fingerprint
+                      or load_fingerprint(candidate_dir))
+            if new_fp is not None:
+                self._install_monitor(new_fp)
+            else:
+                # fingerprint capture is best-effort in Workflow.train;
+                # raising HERE (after the swap landed) would skip the
+                # 'promoted' journal step and wedge the supervisor in a
+                # resume loop on this candidate. Keep drifting against
+                # the previous baseline instead — stale but functional —
+                # with a fresh window (the promoted model absorbed it).
+                log.warning("continual: promoted model has no training "
+                            "fingerprint; keeping the previous drift "
+                            "baseline")
+                self._install_monitor(self.monitor.fingerprint)
+            staleness = (time.perf_counter() - self._pending_since
+                         if self._pending_since is not None else 0.0)
+            self._pending_since = None
+            self.registry.histogram(
+                "continual_staleness_seconds",
+                "append-to-fresh-model-serving latency").observe(staleness)
+            self.registry.counter(
+                "continual_promotions_total",
+                "refit models promoted to serving").inc()
+            record_event("promoted", candidate=candidate_dir,
+                         metric=swap.get("metric"),
+                         staleness_s=round(staleness, 3))
+            self._journal_step("promoted", metric_new,
+                               model_dir=candidate_dir)
+            self._finish_cycle(cycle_span, "promoted", t0, report,
+                               staleness)
+            return {"status": "promoted", "candidate": candidate_dir,
+                    "metric": metric_new, "baseline": baseline,
+                    "staleness_s": staleness}
+
+    def _window_dataset(self, Xh: np.ndarray, yh: np.ndarray) -> Dataset:
+        cols: Dict[str, Any] = {
+            nm: np.asarray(Xh[:, j], np.float64)
+            for j, nm in enumerate(self.store.feature_names)}
+        cols[LABEL_COLUMN] = np.asarray(yh, np.float64)
+        schema = {nm: T.Real for nm in self.store.feature_names}
+        schema[LABEL_COLUMN] = T.Integral if self._classification else T.Real
+        return Dataset(cols, schema)
+
+    def _finish_cycle(self, span, status: str, t0: float,
+                      report: DriftReport,
+                      staleness: Optional[float] = None) -> None:
+        wall = time.perf_counter() - t0
+        span.set(status=status, wall_s=round(wall, 4))
+        record_event("continual_cycle", status=status,
+                     cycle=self._cycle, wall_s=round(wall, 6),
+                     max_psi=round(report.max_psi, 4),
+                     staleness_s=(round(staleness, 6)
+                                  if staleness is not None else None))
+        self._cycle += 1
+
+    # -- supervisor thread -------------------------------------------------- #
+
+    def start(self) -> "ContinualLoop":
+        """Run cycles on a background thread, polling every
+        `check_interval_s` (or immediately on append) — the serving
+        scoring thread never blocks on a refit."""
+        if self._running:
+            return self
+        self._trace_parent = TRACER.current()
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._supervise, name="continual-loop", daemon=True)
+        self._thread.start()
+        return self
+
+    def _supervise(self) -> None:
+        while self._running:
+            self._wake.wait(timeout=self.params.check_interval_s)
+            self._wake.clear()
+            if not self._running:
+                return
+            try:
+                self.run_cycle()
+            except Exception:
+                # the supervisor must survive a failed cycle: the next
+                # append/poll retries from journaled state
+                log.exception("continual: cycle failed; supervisor "
+                              "continues")
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None

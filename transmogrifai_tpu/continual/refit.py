@@ -1,0 +1,73 @@
+"""Warm-start refit: continue a fitted predictor from its own weights.
+
+One dispatch point per model family, so the continual loop treats every
+predictor uniformly:
+
+- linear families (logistic / linear / GLM): the estimator's
+  ``init_params`` warm-start (models/*.py) — the optimizer continues
+  from the resident weights, reusing the SAME compiled fit program at
+  fixed shapes (the warm pytree form compiles once; subsequent refits
+  are pure cache hits, retrace-asserted in tests);
+- forests: replacement trees grown on the appended delta swap in for
+  the oldest resident trees (`models/trees.warm_refit_forest`);
+- GBT: boosting continues from the resident ensemble's margin and the
+  new rounds append (`models/trees.warm_refit_gbt`).
+
+The refit itself runs through ``Workflow.train`` with every
+feature-engineering stage reused warm (``with_model_stages(exclude=
+predictor)``), so vectorizer vocabularies / scaler statistics stay
+EXACTLY what the serving model scores with — only the predictor moves.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def extract_warm_params(fitted_model) -> Optional[Dict[str, Any]]:
+    """The warm-start payload for a fitted prediction model, in the
+    shape its estimator's warm path expects; None for families with no
+    warm form (naive bayes, isotonic, MLP — those refit cold)."""
+    from transmogrifai_tpu.models.glm import GLMModel
+    from transmogrifai_tpu.models.linear import LinearRegressionModel
+    from transmogrifai_tpu.models.logistic import LogisticRegressionModel
+    from transmogrifai_tpu.models.trees import _TreeModelBase
+
+    if isinstance(fitted_model, LogisticRegressionModel):
+        return {"W": np.asarray(fitted_model.W),
+                "b": np.asarray(fitted_model.b)}
+    if isinstance(fitted_model, GLMModel):
+        return {"beta": np.asarray(fitted_model.beta),
+                "b": float(fitted_model.b)}
+    if isinstance(fitted_model, LinearRegressionModel):
+        return {"beta": np.asarray(fitted_model.beta)}
+    if isinstance(fitted_model, _TreeModelBase):
+        # edges + trees (+ learning_rate for GBT): the tree estimators'
+        # warm path consumes a fitted model's params dict directly
+        return {k: v for k, v in fitted_model.get_params().items()}
+    return None
+
+
+def prepare_warm_estimator(estimator, fitted_model,
+                           delta_rows: Optional[int] = None,
+                           refit_max_iter: Optional[int] = None) -> bool:
+    """Arm `estimator` to warm-start its next fit from `fitted_model`.
+    Returns False (estimator untouched — the fit will be cold) when the
+    family has no warm form. `delta_rows` tells the tree families how
+    many trailing rows are new; `refit_max_iter` caps the warm
+    optimizer budget for iterative families."""
+    warm = extract_warm_params(fitted_model)
+    if warm is None:
+        estimator.init_params = None
+        return False
+    if delta_rows is not None and "trees" in warm:
+        warm["delta_rows"] = int(delta_rows)
+    estimator.init_params = warm
+    if refit_max_iter is not None and hasattr(estimator, "max_iter"):
+        estimator.max_iter = int(refit_max_iter)
+    return True

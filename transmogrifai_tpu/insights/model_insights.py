@@ -132,6 +132,11 @@ class ModelInsights:
     stage_info: List[Dict[str, Any]] = field(default_factory=list)
     sanity_checker: Optional[Dict[str, Any]] = None
     rff: Optional[Dict[str, Any]] = None
+    # drift-detection basis captured at fit time (continual/drift.py):
+    # per-feature training histograms + moments + label rate, persisted
+    # so a continual DriftMonitor in ANY later process can compare
+    # appended records against what this model actually trained on
+    training_fingerprint: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -141,6 +146,7 @@ class ModelInsights:
             "stageInfo": self.stage_info,
             "sanityChecker": self.sanity_checker,
             "rawFeatureFilterResults": self.rff,
+            "trainingFingerprint": self.training_fingerprint,
         }
 
     def write(self, path: str) -> None:
@@ -261,6 +267,7 @@ class ModelInsights:
                     rff_reasons=reasons,
                     distributions=dist_by_name.get(name, []))
 
+        fp = getattr(model, "training_fingerprint", None)
         return ModelInsights(
             label_name=None if label_feature is None else label_feature.name,
             features=list(features.values()),
@@ -268,4 +275,6 @@ class ModelInsights:
                             else selector_summary.to_json()),
             stage_info=stage_info,
             sanity_checker=sc_summary,
-            rff=None if rff_results is None else rff_results.to_json())
+            rff=None if rff_results is None else rff_results.to_json(),
+            training_fingerprint=(fp.to_json() if fp is not None
+                                  and hasattr(fp, "to_json") else fp))

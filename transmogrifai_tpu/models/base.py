@@ -40,11 +40,20 @@ class PredictionModel(Transformer):
 
 
 class PredictorEstimator(Estimator):
-    """Base for model estimators. Subclasses implement `fit_arrays`."""
+    """Base for model estimators. Subclasses implement `fit_arrays`.
+
+    `init_params` (attribute, or the `init_params=` kwarg the iterative
+    families' `fit_arrays` accept) warm-starts the optimizer from an
+    existing model's weights — the continual-refit path: a refit on
+    appended data continues from the serving model instead of from
+    zeros. Families where it is meaningless (closed-form solves) ignore
+    it; the sweep engine never sets it (grid fits stay cold and
+    comparable)."""
 
     in_types = (T.RealNN, T.OPVector)
     out_type = T.Prediction
     response_aware = True  # slot 0 is the label
+    init_params: Optional[Dict[str, Any]] = None
 
     def fit_arrays(self, X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
                    ctx: FitContext) -> PredictionModel:
@@ -62,3 +71,32 @@ def infer_n_classes(y: np.ndarray) -> int:
     """Label cardinality for classification (labels must be 0..k-1)."""
     k = int(np.asarray(y).max(initial=0)) + 1
     return max(k, 2)
+
+
+def resolve_init_params(est: PredictorEstimator,
+                        explicit: Optional[Dict[str, Any]],
+                        expect_shapes: Dict[str, tuple]
+                        ) -> Optional[Dict[str, jnp.ndarray]]:
+    """Warm-start weights for a fit: the explicit `init_params=` kwarg
+    wins over the estimator's `init_params` attribute. Shapes are
+    validated HERE, on host, against the incoming data — a refit whose
+    feature width changed (an upstream vectorizer re-fit differently)
+    must fail with a clear message, not a mid-trace XLA shape error."""
+    warm = explicit if explicit is not None else est.init_params
+    if warm is None:
+        return None
+    out: Dict[str, jnp.ndarray] = {}
+    for name, shape in expect_shapes.items():
+        if name not in warm:
+            raise ValueError(
+                f"{type(est).__name__}: init_params missing {name!r} "
+                f"(have {sorted(warm)})")
+        arr = jnp.asarray(warm[name], jnp.float32)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(
+                f"{type(est).__name__}: init_params[{name!r}] shape "
+                f"{tuple(arr.shape)} does not match the data "
+                f"({tuple(shape)}) — warm start requires an unchanged "
+                f"feature/class layout; refit cold instead")
+        out[name] = arr
+    return out

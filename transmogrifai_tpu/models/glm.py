@@ -18,7 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from transmogrifai_tpu.models.base import PredictionModel, PredictorEstimator
+from transmogrifai_tpu.models.base import (
+    PredictionModel, PredictorEstimator, resolve_init_params)
 from transmogrifai_tpu.stages.base import FitContext
 
 FAMILIES = ("gaussian", "binomial", "poisson", "gamma", "tweedie")
@@ -132,11 +133,20 @@ def _link_fwd(family: str, mu, link: Optional[str] = None,
 @partial(jax.jit, static_argnames=("family", "max_iter", "link", "var_power"))
 def fit_glm(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, l2,
             family: str = "gaussian", max_iter: int = 100,
-            var_power: float = 1.5, link: Optional[str] = None) -> Dict:
+            var_power: float = 1.5, link: Optional[str] = None,
+            init_params: Optional[Dict] = None) -> Dict:
     d = X.shape[1]
-    mean_y = (y * w).sum() / jnp.maximum(w.sum(), 1.0)
-    b0 = _link_fwd(family, mean_y, link, var_power).astype(jnp.float32)
-    params = {"beta": jnp.zeros((d,), jnp.float32), "b": b0}
+    if init_params is None:
+        mean_y = (y * w).sum() / jnp.maximum(w.sum(), 1.0)
+        b0 = _link_fwd(family, mean_y, link, var_power).astype(jnp.float32)
+        params = {"beta": jnp.zeros((d,), jnp.float32), "b": b0}
+    else:
+        # warm start (continual refit): the given weights already sit in
+        # the link's domain, which is exactly what the mean-init exists
+        # to guarantee for cold fits
+        params = {"beta": jnp.asarray(init_params["beta"], jnp.float32),
+                  "b": jnp.asarray(init_params["b"],
+                                   jnp.float32).reshape(())}
 
     def loss_fn(p):
         eta = X @ p["beta"] + p["b"]
@@ -214,9 +224,12 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
         self.var_power = var_power
         self.link = link
 
-    def fit_arrays(self, X, y, w, ctx: FitContext) -> GLMModel:
+    def fit_arrays(self, X, y, w, ctx: FitContext,
+                   init_params: Optional[Dict] = None) -> GLMModel:
         link = self.link or canonical_link(self.family)
+        warm = resolve_init_params(self, init_params,
+                                   {"beta": (X.shape[1],), "b": ()})
         p = fit_glm(X, y, w, jnp.float32(self.reg_param), self.family,
-                    self.max_iter, self.var_power, link)
+                    self.max_iter, self.var_power, link, init_params=warm)
         return GLMModel(np.asarray(p["beta"]), float(p["b"]), self.family,
                         link, self.var_power)

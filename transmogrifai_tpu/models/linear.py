@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from transmogrifai_tpu.models.base import PredictionModel, PredictorEstimator
+from transmogrifai_tpu.models.base import (
+    PredictionModel, PredictorEstimator, resolve_init_params)
 from transmogrifai_tpu.stages.base import FitContext
 
 
@@ -43,7 +44,8 @@ def fit_linreg(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, l2) -> Dict:
 
 @partial(jax.jit, static_argnames=("max_iter",))
 def fit_linreg_enet(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
-                    l1, l2, max_iter: int = 300) -> Dict:
+                    l1, l2, max_iter: int = 300,
+                    init_params: Optional[Dict] = None) -> Dict:
     """Elastic-net weighted least squares via FISTA on centered data.
 
     Spark parity: MLlib LinearRegression with elasticNetParam > 0 (OWL-QN);
@@ -71,7 +73,10 @@ def fit_linreg_enet(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
         t1 = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
         return (b1, b1 + (t - 1.0) / t1 * (b1 - b), t1), None
 
-    b0 = jnp.zeros((X.shape[1],), jnp.float32)
+    if init_params is None:
+        b0 = jnp.zeros((X.shape[1],), jnp.float32)
+    else:  # warm start from existing coefficients (continual refit)
+        b0 = jnp.asarray(init_params["beta"], jnp.float32)
     (beta, _, _), _ = jax.lax.scan(
         fista_step, (b0, b0, jnp.float32(1.0)), None, length=max_iter)
     return {"beta": beta, "intercept": y_mean - x_mean @ beta}
@@ -117,13 +122,22 @@ class OpLinearRegression(PredictorEstimator):
     fit_fn = staticmethod(fit_linreg)
     predict_fn = staticmethod(predict_linreg)
 
-    def fit_arrays(self, X, y, w, ctx: FitContext) -> LinearRegressionModel:
+    def fit_arrays(self, X, y, w, ctx: FitContext,
+                   init_params: Optional[Dict] = None
+                   ) -> LinearRegressionModel:
         alpha = float(self.elastic_net_param)
         if alpha > 0.0:
+            warm = resolve_init_params(self, init_params,
+                                       {"beta": (X.shape[1],)})
             p = fit_linreg_enet(X, y, w,
                                 jnp.float32(self.reg_param * alpha),
-                                jnp.float32(self.reg_param * (1.0 - alpha)))
+                                jnp.float32(self.reg_param * (1.0 - alpha)),
+                                init_params=warm)
         else:
+            # closed-form ridge: the solve is exact, so a warm start has
+            # nothing to continue from — init_params is accepted (the
+            # continual refitter treats every family uniformly) and
+            # harmlessly ignored
             p = fit_linreg(X, y, w, jnp.float32(self.reg_param))
         return LinearRegressionModel(np.asarray(p["beta"]),
                                      float(p["intercept"]))

@@ -22,7 +22,8 @@ import numpy as np
 import optax
 
 from transmogrifai_tpu.models.base import (
-    PredictionModel, PredictorEstimator, infer_n_classes)
+    PredictionModel, PredictorEstimator, infer_n_classes,
+    resolve_init_params)
 from transmogrifai_tpu.stages.base import FitContext
 
 
@@ -36,15 +37,27 @@ def logreg_loss(params: Dict, X: jnp.ndarray, y_onehot: jnp.ndarray,
 
 @partial(jax.jit, static_argnames=("n_classes", "max_iter"))
 def fit_logreg(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
-               l2, n_classes: int, max_iter: int = 100) -> Dict:
+               l2, n_classes: int, max_iter: int = 100,
+               init_params: Optional[Dict] = None) -> Dict:
     """Pure fit: (n,d), (n,), (n,), scalar l2 → {"W": (d,k), "b": (k,)}.
 
     vmap over `l2` and/or `w` to sweep grids × folds in one program.
+
+    `init_params` ({"W", "b"}) warm-starts the optimizer from existing
+    weights (the continual-refit path): on barely-shifted data L-BFGS
+    starts inside the basin and converges in a fraction of the cold
+    iteration budget. Passed as traced arrays, so repeated warm refits
+    at fixed shapes reuse ONE compiled program (retrace-asserted in
+    tests); the cold (None) form keeps its own cache entry.
     """
     d = X.shape[1]
     y_onehot = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=jnp.float32)
-    params = {"W": jnp.zeros((d, n_classes), jnp.float32),
-              "b": jnp.zeros((n_classes,), jnp.float32)}
+    if init_params is None:
+        params = {"W": jnp.zeros((d, n_classes), jnp.float32),
+                  "b": jnp.zeros((n_classes,), jnp.float32)}
+    else:
+        params = {"W": jnp.asarray(init_params["W"], jnp.float32),
+                  "b": jnp.asarray(init_params["b"], jnp.float32)}
     loss_fn = lambda p: logreg_loss(p, X, y_onehot, w, l2)  # noqa: E731
     opt = optax.lbfgs()
     state = opt.init(params)
@@ -80,7 +93,8 @@ def _power_lipschitz(X: jnp.ndarray, w: jnp.ndarray, wsum: jnp.ndarray,
 
 @partial(jax.jit, static_argnames=("n_classes", "max_iter"))
 def fit_logreg_enet(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
-                    l1, l2, n_classes: int, max_iter: int = 200) -> Dict:
+                    l1, l2, n_classes: int, max_iter: int = 200,
+                    init_params: Optional[Dict] = None) -> Dict:
     """Elastic-net multinomial logistic regression via FISTA.
 
     Spark parity: MLlib LR's penalty is
@@ -120,8 +134,12 @@ def fit_logreg_enet(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
         beta = (t - 1.0) / t1
         return (W1, b1, W1 + beta * (W1 - W), b1 + beta * (b1 - b), t1), None
 
-    W0 = jnp.zeros((d, n_classes), jnp.float32)
-    b0 = jnp.zeros((n_classes,), jnp.float32)
+    if init_params is None:
+        W0 = jnp.zeros((d, n_classes), jnp.float32)
+        b0 = jnp.zeros((n_classes,), jnp.float32)
+    else:  # warm start: FISTA momentum restarts from the given weights
+        W0 = jnp.asarray(init_params["W"], jnp.float32)
+        b0 = jnp.asarray(init_params["b"], jnp.float32)
     (W, b, _, _, _), _ = jax.lax.scan(
         fista_step, (W0, b0, W0, b0, jnp.float32(1.0)), None, length=max_iter)
     return {"W": W, "b": b}
@@ -180,16 +198,20 @@ class OpLogisticRegression(PredictorEstimator):
     fit_fn = staticmethod(fit_logreg)
     predict_fn = staticmethod(predict_logreg)
 
-    def fit_arrays(self, X, y, w, ctx: FitContext) -> LogisticRegressionModel:
+    def fit_arrays(self, X, y, w, ctx: FitContext,
+                   init_params: Optional[Dict] = None
+                   ) -> LogisticRegressionModel:
         k = self.n_classes or infer_n_classes(np.asarray(y))
+        warm = resolve_init_params(self, init_params,
+                                   {"W": (X.shape[1], k), "b": (k,)})
         alpha = float(self.elastic_net_param)
         if alpha > 0.0:
             params = fit_logreg_enet(
                 X, y, w, jnp.float32(self.reg_param * alpha),
                 jnp.float32(self.reg_param * (1.0 - alpha)), k,
-                enet_iters(self.max_iter))
+                enet_iters(self.max_iter), init_params=warm)
         else:
             params = fit_logreg(X, y, w, jnp.float32(self.reg_param), k,
-                                self.max_iter)
+                                self.max_iter, init_params=warm)
         return LogisticRegressionModel(np.asarray(params["W"]),
                                        np.asarray(params["b"]))

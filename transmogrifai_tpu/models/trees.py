@@ -33,6 +33,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import logging
 import os
 
 import jax
@@ -42,6 +43,8 @@ import numpy as np
 from transmogrifai_tpu.models.base import (
     PredictionModel, PredictorEstimator, infer_n_classes)
 from transmogrifai_tpu.stages.base import FitContext
+
+log = logging.getLogger(__name__)
 
 DEFAULT_MAX_BINS = 32
 
@@ -843,6 +846,128 @@ def gbt_pred_from_margin(margin: jnp.ndarray, objective: str) -> Dict:
 
 
 # --------------------------------------------------------------------------- #
+# Warm-start refits (continual training)                                      #
+# --------------------------------------------------------------------------- #
+
+def warm_tree_compatible(warm: Dict, X,
+                         n_classes: Optional[int] = None,
+                         max_bins: Optional[int] = None) -> bool:
+    """Host-side validation of a tree warm-start payload against the
+    incoming data — the `resolve_init_params` analogue for forests/GBT.
+    The resident bin edges must match the feature width, the
+    estimator's `max_bins` histogram must cover every resident bin id
+    (rows binned past it would one-hot to all zeros and silently vanish
+    from split decisions), and for classification the resident leaf
+    width must cover every observed class: `one_hot` of an unseen class
+    under the old width is all zeros, so a mismatched warm refit would
+    silently mistrain instead of erroring. Returns False → the caller
+    fits cold."""
+    edges = np.asarray(warm["edges"])
+    d = int(np.shape(X)[1])
+    if int(edges.shape[0]) != d:
+        log.info("tree warm refit: feature width changed (%d -> %d); "
+                 "fitting cold", int(edges.shape[0]), d)
+        return False
+    if max_bins is not None and int(edges.shape[1]) + 1 > int(max_bins):
+        log.info("tree warm refit: resident edges bin to %d buckets but "
+                 "the estimator's max_bins is %d; fitting cold",
+                 int(edges.shape[1]) + 1, int(max_bins))
+        return False
+    if n_classes is not None:
+        leaf = np.asarray(warm["trees"]["leaf"])
+        if int(leaf.shape[-1]) < int(n_classes):
+            log.info("tree warm refit: resident leaves are %d-class but "
+                     "the data has %d classes; fitting cold",
+                     int(leaf.shape[-1]), int(n_classes))
+            return False
+    return True
+
+
+def warm_refit_forest(est, warm: Dict, X, y, w, ctx,
+                      classification: bool) -> Dict:
+    """Forest warm refit: grow replacement trees on the DELTA rows and
+    swap them in for the OLDEST trees of the resident ensemble, keeping
+    the ensemble size (and therefore every compiled predict shape)
+    fixed. `warm` is a fitted tree model's params ({"edges", "trees"})
+    plus an optional "delta_rows" count of trailing new rows; without
+    it the replacements grow on the full matrix.
+
+    The resident bin edges are reused — re-binning under new quantiles
+    would silently shift every surviving tree's split semantics.
+    Returns the combined {"feat", "bin", "leaf"} pytree (host arrays)."""
+    edges = jnp.asarray(np.asarray(warm["edges"], np.float32))
+    old = {k: jnp.asarray(v) for k, v in warm["trees"].items()}
+    n_trees = int(old["feat"].shape[0])
+    delta = int(warm.get("delta_rows") or 0)
+    if not (0 < delta <= X.shape[0]):
+        delta = X.shape[0]
+    n_new = int(warm.get("n_new") or 0)
+    if n_new <= 0:
+        # replacement count scales with how much of the data is new,
+        # floored at one tree so a refit always learns something
+        n_new = max(1, round(n_trees * delta / max(X.shape[0], 1)))
+    n_new = min(n_new, n_trees)
+    Xd = jnp.asarray(X)[-delta:]
+    yd = jnp.asarray(y)[-delta:]
+    wd = jnp.asarray(w)[-delta:]
+    Xb = bin_features(Xd, edges)
+    if classification:
+        k = int(old["leaf"].shape[-1])
+        Y = jax.nn.one_hot(yd.astype(jnp.int32), k)
+    else:
+        Y = yd[:, None]
+    seed = (ctx.seed if ctx is not None else 0) + n_trees  # fresh draws
+    new = fit_forest(Xb, Y, wd, n_new, est.max_depth, est.max_bins,
+                     Y.shape[1], seed, est.subsample_features,
+                     est._effective_mcw(),
+                     min_gain=jnp.float32(est.min_info_gain))
+    combined = jax.tree.map(
+        lambda o, nw: jnp.concatenate([o[n_new:], nw], axis=0), old, new)
+    return {k2: np.asarray(v) for k2, v in combined.items()}
+
+
+def warm_refit_gbt(est, warm: Dict, X, y, w, ctx,
+                   objective: str) -> Dict:
+    """GBT warm refit: CONTINUE boosting from the resident ensemble's
+    margin instead of restarting from zero — the new rounds fit the
+    residual the old trees leave on the refreshed data (appended rows
+    included), and the grown trees append to the ensemble. Binary /
+    regression objectives only (the multiclass stacked-round layout
+    falls back to a cold fit at the call site)."""
+    edges = jnp.asarray(np.asarray(warm["edges"], np.float32))
+    old = {k: jnp.asarray(v) for k, v in warm["trees"].items()}
+    n_old = int(old["feat"].shape[0])
+    lr = jnp.float32(warm.get("learning_rate", est.learning_rate))
+    Xb = bin_features(jnp.asarray(X), edges)
+    n = Xb.shape[0]
+    margin0 = predict_gbt_margin(old, Xb, lr)
+    n_extra = int(warm.get("n_new") or 0)
+    if n_extra <= 0:
+        n_extra = max(1, est.n_estimators // 4)
+    # growth cap: an always-on loop must not boost the ensemble (and
+    # every compiled predict shape, and HBM) without bound — the call
+    # site falls back to a cold fit once the 2x ceiling is reached
+    n_extra = min(n_extra,
+                  max(1, 2 * int(est.n_estimators) - n_old))
+    # key stream folded past the resident rounds: warm rounds draw fresh
+    # subsample/colsample randomness, deterministically per (seed, round)
+    seed = ctx.seed if ctx is not None else 0
+    keys = jax.random.split(
+        jax.random.fold_in(jax.random.PRNGKey(seed), n_old), n_extra)
+    (_, _, _), new = fit_gbt_chunk(
+        Xb, jnp.asarray(y), jnp.asarray(w), jnp.zeros(n, jnp.float32),
+        margin0, jnp.float32(jnp.inf), jnp.int32(0), keys, n_extra,
+        est.max_depth, est.max_bins, lr, jnp.float32(est.reg_lambda),
+        objective, est._effective_mcw(), None, jnp.float32(est.gamma),
+        jnp.float32(est.alpha), jnp.float32(est.subsample),
+        jnp.float32(est.colsample_bytree), 0,
+        jnp.float32(est.min_info_gain), est.eval_metric)
+    combined = jax.tree.map(
+        lambda o, nw: jnp.concatenate([o, nw], axis=0), old, new)
+    return {k2: np.asarray(v) for k2, v in combined.items()}
+
+
+# --------------------------------------------------------------------------- #
 # Stage classes                                                               #
 # --------------------------------------------------------------------------- #
 
@@ -977,6 +1102,14 @@ class OpRandomForestClassifier(_TreeEstimatorBase):
 
     def fit_arrays(self, X, y, w, ctx: FitContext):
         k = self.n_classes or infer_n_classes(np.asarray(y))
+        warm = self.init_params
+        if warm is not None and "trees" in warm and \
+                warm_tree_compatible(warm, X, n_classes=k,
+                                     max_bins=self.max_bins):
+            trees = warm_refit_forest(self, warm, X, y, w, ctx,
+                                      classification=True)
+            return ForestClassificationModel(
+                np.asarray(warm["edges"], np.float32), trees)
         edges, Xb = self._edges_binned(X, ctx)
         Y = jax.nn.one_hot(y.astype(jnp.int32), k)
         trees = fit_forest(Xb, Y, w, self.n_trees, self.max_depth,
@@ -989,6 +1122,13 @@ class OpRandomForestClassifier(_TreeEstimatorBase):
 
 class OpRandomForestRegressor(OpRandomForestClassifier):
     def fit_arrays(self, X, y, w, ctx: FitContext):
+        warm = self.init_params
+        if warm is not None and "trees" in warm and \
+                warm_tree_compatible(warm, X, max_bins=self.max_bins):
+            trees = warm_refit_forest(self, warm, X, y, w, ctx,
+                                      classification=False)
+            return ForestRegressionModel(
+                np.asarray(warm["edges"], np.float32), trees)
         edges, Xb = self._edges_binned(X, ctx)
         trees = fit_forest(Xb, y[:, None], w, self.n_trees, self.max_depth,
                            self.max_bins, 1, ctx.seed,
@@ -1117,12 +1257,32 @@ class OpGBTClassifier(_TreeEstimatorBase):
     _ES_EVAL_FRACTION = 0.2
 
     def fit_arrays(self, X, y, w, ctx: FitContext):
-        edges, Xb = self._edges_binned(X, ctx)
-        seed = ctx.seed if ctx is not None else 0
         if self._objective == "logistic":
             k = self.n_classes or infer_n_classes(np.asarray(y))
         else:
             k = 2
+        warm = self.init_params
+        if warm is not None and "trees" in warm:
+            n_resident = int(np.asarray(warm["trees"]["feat"]).shape[0])
+            if self._objective == "logistic" and k > 2:
+                log.info("GBT warm refit: multiclass stacked-round layout "
+                         "has no margin-continuation path; fitting cold")
+            elif n_resident >= 2 * self.n_estimators:
+                log.info("GBT warm refit: resident ensemble at the 2x "
+                         "growth cap (%d rounds vs n_estimators=%d); "
+                         "fitting cold to reset the ensemble size",
+                         n_resident, self.n_estimators)
+            elif not warm_tree_compatible(warm, X,
+                                          max_bins=self.max_bins):
+                pass  # logged: shape drift falls back to a cold fit
+            else:
+                trees = warm_refit_gbt(self, warm, X, y, w, ctx,
+                                       self._objective)
+                return self._model_cls(
+                    np.asarray(warm["edges"], np.float32), trees,
+                    float(warm.get("learning_rate", self.learning_rate)))
+        edges, Xb = self._edges_binned(X, ctx)
+        seed = ctx.seed if ctx is not None else 0
         if self._objective == "logistic" and k > 2:
             trees, _ = fit_gbt_multiclass(
                 Xb, y, w, self.n_estimators, self.max_depth, self.max_bins,

@@ -71,6 +71,11 @@ class GoodputReport:
     # workers × wall the mesh lanes spent executing blocks, plus
     # steal/requeue/straggler counters. Empty when no schedule ran.
     mesh: Dict[str, Any] = field(default_factory=dict)
+    # continual-training accounting: rolled up from the loop's
+    # ``continual_cycle`` summary events (continual/loop.py) — cycles by
+    # outcome, refit wall time, and append-to-fresh-model staleness.
+    # Empty when no continual loop ran in this trace.
+    continual: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def badput_s(self) -> float:
@@ -97,6 +102,8 @@ class GoodputReport:
         }
         if self.mesh:
             out["mesh"] = dict(sorted(self.mesh.items()))
+        if self.continual:
+            out["continual"] = dict(sorted(self.continual.items()))
         return out
 
     def pretty(self) -> str:
@@ -131,6 +138,7 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     mesh_wall = 0.0
     mesh_busy = 0.0
     mesh: Dict[str, Any] = {}
+    continual: Dict[str, Any] = {}
     seen: set = set()
     for sp in [root, *spans]:
         if sp.span_id in seen or sp.trace_id != root.trace_id:
@@ -169,6 +177,19 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
                 counts["steals"] += 1
             elif name == "worker_retired":
                 counts["workers_retired"] += 1
+            elif name == "continual_cycle":
+                continual["cycles"] = continual.get("cycles", 0) + 1
+                st = attrs.get("status") or "unknown"
+                continual[st] = continual.get(st, 0) + 1
+                continual["cycle_wall_s"] = round(
+                    continual.get("cycle_wall_s", 0.0)
+                    + float(attrs.get("wall_s", 0.0) or 0.0), 6)
+                stale = attrs.get("staleness_s")
+                if stale is not None:
+                    continual["last_staleness_s"] = round(float(stale), 6)
+            elif name == "drift_detected":
+                continual["drift_detected"] = \
+                    continual.get("drift_detected", 0) + 1
             elif name == "mesh_utilization":
                 wall = float(attrs.get("wall_s", 0.0) or 0.0)
                 workers = int(attrs.get("workers", 0) or 0)
@@ -199,5 +220,7 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
         mesh["utilization_frac"] = round(
             mesh_busy / mesh_wall, 4) if mesh_wall > 0 else 0.0
         report.mesh = mesh
+    if continual:
+        report.continual = continual
     report.counts = {k: v for k, v in counts.items() if v}
     return report

@@ -22,6 +22,11 @@ Sites threaded through the codebase:
   artifact file write
 - ``scheduler.worker_block`` — parallel/scheduler.py, as a mesh worker
   claims a grid block (worker-level preemption/failure injection)
+- ``continual.holdout_eval`` — continual/loop.py, before the post-swap
+  live holdout evaluation: an injected fault here is treated as a
+  holdout regression (metric unknowable → the gate must assume the
+  worst), so chaos tests can force the automatic serving rollback path
+  deterministically
 
 Fault kinds:
 
@@ -55,7 +60,7 @@ __all__ = [
     "fault_point", "install_plan", "clear_plan", "active_plan",
     "is_oom_error",
     "SITE_READ_CHUNK", "SITE_RUN_BLOCK", "SITE_WRITE_FILE",
-    "SITE_WORKER_BLOCK",
+    "SITE_WORKER_BLOCK", "SITE_HOLDOUT_EVAL",
 ]
 
 SITE_READ_CHUNK = "ingest.read_chunk"
@@ -66,6 +71,10 @@ SITE_WRITE_FILE = "serialize.write_file"
 # preempts the whole schedule (drain + re-raise; resume re-runs only the
 # claiming worker's in-flight block)
 SITE_WORKER_BLOCK = "scheduler.worker_block"
+# continual/loop.py: fires before the post-swap live holdout eval — an
+# injected `error` makes the gate treat the eval as a regression and
+# auto-roll the serving swap back (deterministic rollback chaos testing)
+SITE_HOLDOUT_EVAL = "continual.holdout_eval"
 
 
 class InjectedFault(RuntimeError):

@@ -440,6 +440,9 @@ class ScoringService:
             "serving_model_versions", "versions held (active + rollback)"
         ).set(n_versions)
         self._m_swaps.inc()
+        self.registry.counter(
+            "serving_rollbacks_total",
+            "model versions rolled back (manual + automatic)").inc()
         log.info("serving: rolled back %s -> %s", demoted.version_id,
                  restored.version_id)
         return {"status": "rolled_back", "version": restored.version_id,

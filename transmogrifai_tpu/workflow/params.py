@@ -14,6 +14,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from transmogrifai_tpu.continual.params import ContinualParams
 from transmogrifai_tpu.data.feature_cache import FeatureCacheParams
 
 
@@ -202,6 +203,9 @@ class OpParams:
     # run's extent, so every big-data matrix build under the train
     # resolves the run's cache policy
     feature_cache: Optional[FeatureCacheParams] = None
+    # continuous-training loop thresholds (continual/params.py): drift
+    # triggers, warm-refit budget, promotion gate, rollback policy
+    continual: Optional[ContinualParams] = None
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -214,6 +218,8 @@ class OpParams:
         feature_cache = (FeatureCacheParams.from_json(d["feature_cache"])
                          if d.get("feature_cache") else None)
         mesh = MeshParams.from_json(d["mesh"]) if d.get("mesh") else None
+        continual = (ContinualParams.from_json(d["continual"])
+                     if d.get("continual") else None)
         return OpParams(
             stage_params=dict(d.get("stage_params") or {}),
             reader_params=readers,
@@ -230,7 +236,8 @@ class OpParams:
             serving=serving,
             sweep_checkpoint=sweep_ckpt,
             mesh=mesh,
-            feature_cache=feature_cache)
+            feature_cache=feature_cache,
+            continual=continual)
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -258,6 +265,8 @@ class OpParams:
             "mesh": self.mesh.to_json() if self.mesh else None,
             "feature_cache": (self.feature_cache.to_json()
                               if self.feature_cache else None),
+            "continual": (self.continual.to_json()
+                          if self.continual else None),
         }
 
 

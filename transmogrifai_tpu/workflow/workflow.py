@@ -85,12 +85,17 @@ class Workflow:
             self.parameters = dict(params)
         return self
 
-    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+    def with_model_stages(self, model: "WorkflowModel",
+                          exclude: Sequence[str] = ()) -> "Workflow":
         """Warm start (OpWorkflow.withModelStages, OpWorkflow.scala:468-472):
         estimators whose uid matches a fitted stage in `model` reuse that
         fitted transformer instead of refitting — only new estimators
-        train."""
-        self._warm_models.update(model.fitted)
+        train. `exclude` uids REFIT even when a fitted stage exists —
+        the continual-refit path reuses every feature-engineering fit
+        but re-trains the predictor (warm-started from its weights)."""
+        skip = set(exclude)
+        self._warm_models.update({uid: m for uid, m in model.fitted.items()
+                                  if uid not in skip})
         return self
 
     def with_workflow_cv(self) -> "Workflow":
@@ -254,7 +259,68 @@ class Workflow:
             train_columns=columns)
         model.rff_results = rff_results
         model.blocklist = list(self.blocklist)
+        # fingerprint capture is opt-in via a "continual" parameters
+        # block (even an empty one): the sampled device gather + per-
+        # column quantile pass is real work on wide matrices, and batch
+        # workflows that never attach a DriftMonitor shouldn't pay it
+        if "continual" in self.parameters:
+            cont_params = self.parameters.get("continual") or {}
+            model.training_fingerprint = self._capture_fingerprint(
+                result_features, columns, seed,
+                n_bins=int(cont_params.get("n_bins", 10)))
         return model
+
+    @staticmethod
+    def _capture_fingerprint(result_features, columns, seed: int,
+                             n_bins: int = 10):
+        """Training-data fingerprint for drift detection (continual/):
+        per-feature histograms + moments of the PREDICTOR'S input matrix
+        plus the label rate, taken from the already-materialized train
+        columns (no second data pass). The row sample is gathered ON
+        DEVICE, so only sample-many rows ever transfer to host — a
+        multi-GB big-data matrix must not round-trip through host RAM
+        for a 100k-row histogram. Persisted into ModelInsights, so a
+        later DriftMonitor compares appended records against what this
+        model actually trained on. Best-effort: workflows without a
+        (label, vector) predictor simply have no fingerprint."""
+        from transmogrifai_tpu import types as T
+        from transmogrifai_tpu.continual.drift import (
+            _FP_SAMPLE, TrainingFingerprint)
+        try:
+            pred = next((f for f in result_features
+                         if issubclass(f.ftype, T.Prediction)), None)
+            if pred is None or pred.origin_stage is None:
+                return None
+            label_f = next((p for p in pred.parents if p.is_response), None)
+            vec_f = next((p for p in pred.parents
+                          if issubclass(p.ftype, T.OPVector)), None)
+            if label_f is None or vec_f is None:
+                return None
+            vec_col = columns.get(vec_f.uid)
+            label_col = columns.get(label_f.uid)
+            if vec_col is None or label_col is None:
+                return None
+            dv = vec_col.device_value()
+            total = int(dv.shape[0])
+            if total > _FP_SAMPLE:
+                rng = np.random.default_rng(seed)
+                idx = np.sort(rng.choice(total, size=_FP_SAMPLE,
+                                         replace=False))
+                X = np.asarray(dv[idx])  # device gather, sample-sized copy
+            else:
+                X = np.asarray(dv)
+            y = np.asarray(label_col.data["value"], dtype=np.float64)
+            meta = vec_col.meta
+            names = meta.column_names() if meta is not None else None
+            return TrainingFingerprint.from_arrays(
+                X, y, n_bins=n_bins, seed=seed, feature_names=names,
+                total_rows=total)
+        except Exception as e:
+            _log.warning("training fingerprint capture failed (%s: %s) — "
+                         "model will have no drift fingerprint",
+                         type(e).__name__, e)
+            _log.debug("fingerprint capture traceback", exc_info=True)
+            return None
 
     @staticmethod
     def _is_selector(est) -> bool:
@@ -348,6 +414,9 @@ class WorkflowModel:
         self.blocklist: List[str] = []
         self._check_finite = False
         self.loaded_from: Optional[str] = None  # set by load_model
+        # drift-detection fingerprint of the predictor's training matrix
+        # (continual/drift.TrainingFingerprint), set by Workflow.train()
+        self.training_fingerprint = None
 
     def with_finite_checks(self, enabled: bool = True) -> "WorkflowModel":
         """Numeric-sanitizer discipline (SURVEY §5.2 — the build's
@@ -784,12 +853,15 @@ class WorkflowModel:
     # ------------------------------------------------------------------ #
 
     def save(self, path: str, overwrite: bool = True,
-             strict_fns: bool = False) -> None:
+             strict_fns: bool = False, extra_json=None) -> None:
         """`strict_fns=True` refuses to persist cloudpickled closures —
         callable params must be `@extract_fn`-registered or module-level
-        (see `workflow/serialization.py`)."""
+        (see `workflow/serialization.py`). `extra_json` stages sidecar
+        JSON files (e.g. insights with the training fingerprint) under
+        the same integrity manifest."""
         from transmogrifai_tpu.workflow.serialization import save_model
-        save_model(self, path, overwrite=overwrite, strict_fns=strict_fns)
+        save_model(self, path, overwrite=overwrite, strict_fns=strict_fns,
+                   extra_json=extra_json)
 
     @staticmethod
     def load(path: str, verify: bool = True) -> "WorkflowModel":
