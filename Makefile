@@ -16,7 +16,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	exit $$rc
 
 .PHONY: lint serve-smoke ingest-smoke faults-smoke trace-smoke \
-	cache-smoke multichip-smoke continual-smoke test check
+	cache-smoke multichip-smoke continual-smoke costmodel-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -77,8 +77,17 @@ continual-smoke:
 trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.obs.smoke
 
+# learned-cost-model smoke: a synthetic corpus fits to holdout MAPE
+# under the gate per target; then a real multi-block sweep on 8 forced
+# host devices schedules count-LPT (cold model, recording its block
+# rows) and predicted-LPT (refit from that corpus) — winners and fold
+# metrics bit-identical, residuals recorded, packing pair reported.
+# See transmogrifai_tpu/perf/smoke.py.
+costmodel-smoke:
+	$(PY) -m transmogrifai_tpu.perf.smoke
+
 test:
 	@$(TIER1)
 
 check: lint serve-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
-	multichip-smoke continual-smoke test
+	multichip-smoke continual-smoke costmodel-smoke test

@@ -534,6 +534,21 @@ def run_big(platform: str, payload: dict) -> None:
         payload[f"big_sweep84_mesh{n_mesh}_extrapolated_s"] = round(
             total / n_mesh, 1)
         payload["big_sweep84_pod256_extrapolated_s"] = round(total / 256.0, 1)
+        # honesty layer: the learned cost model's prediction for the
+        # same 84-fit sweep, WITH residual-quantile error bars — when
+        # the corpus is warm this replaces the bare scale() level-cost
+        # model as the quoted figure (value/lo/hi + training support)
+        try:
+            from transmogrifai_tpu.perf.model import predict_sweep_seconds
+            from transmogrifai_tpu.selector.model_selector import (
+                _default_binary_models)
+            predicted = predict_sweep_seconds(
+                _default_binary_models(), n_rows=n_pad, n_cols=d,
+                n_folds=3, dtype_bytes=2)
+            if predicted is not None:
+                payload["big_sweep84_model_s"] = predicted
+        except Exception as e:
+            payload["big_sweep84_model_err"] = f"{type(e).__name__}: {e}"[:200]
 
     t0 = time.perf_counter()
     edges = store.quantile_edges(32)
@@ -541,9 +556,13 @@ def run_big(platform: str, payload: dict) -> None:
     # pipelined ingest (data/pipeline.py): worker threads read+cast
     # chunks while up to `depth` donated writes are in flight — the r5
     # serial loop burned 634.9s (63% of budget) on this upload
-    up_workers = int(os.environ.get("BENCH_UPLOAD_WORKERS",
-                                    bd.UPLOAD_WORKERS))
-    up_depth = int(os.environ.get("BENCH_UPLOAD_DEPTH", bd.UPLOAD_DEPTH))
+    # env knobs pin the pipeline shape; unset, the learned cost model
+    # picks workers/depth from the predicted read-vs-upload balance
+    # (cold corpus -> the UPLOAD_WORKERS/UPLOAD_DEPTH defaults exactly)
+    _w = os.environ.get("BENCH_UPLOAD_WORKERS")
+    _d = os.environ.get("BENCH_UPLOAD_DEPTH")
+    up_workers = int(_w) if _w else None
+    up_depth = int(_d) if _d else None
     from transmogrifai_tpu.utils.profiling import RunProfile
     ingest_prof = RunProfile(run_type="bench-big-ingest")
     # persistent device-matrix cache (data/feature_cache.py):
@@ -608,8 +627,12 @@ def run_big(platform: str, payload: dict) -> None:
     if Xb is not None:
         payload["big_upload_gbps"] = round(up_stats.gbps, 4)
         payload["big_upload_overlap_frac"] = round(up_stats.overlap_frac, 3)
-        payload["big_upload_workers"] = up_workers
-        payload["big_upload_depth"] = up_depth
+        payload["big_upload_workers"] = up_stats.workers
+        payload["big_upload_depth"] = up_stats.depth
+        if up_stats.plan:
+            payload["big_upload_plan"] = up_stats.plan
+            payload["big_upload_predicted_s"] = round(
+                up_stats.predicted_wall_s, 1)
         _note_upload_cache(up_stats)
         payload["big_ingest_phases"] = [p.to_json()
                                         for p in ingest_prof.phases]
@@ -905,6 +928,31 @@ def run_multichip() -> None:
     })
 
 
+def run_costmodel() -> None:
+    """Learned-cost-model bench (`python bench.py costmodel`): the
+    model's production scorecard. Reports holdout MAPE per target on
+    the synthetic smoke corpus (can the fit learn the structure at
+    all?) and on the REAL block-runtime rows the measured schedules
+    just recorded, plus the packing improvement: mesh_utilization_frac
+    with predicted-LPT vs count-LPT on the forced 8-device host mesh,
+    winners asserted bit-identical either way. MUST run in a fresh
+    process (device-count flags precede backend init), hence an argv
+    mode."""
+    n_dev = int(os.environ.get("BENCH_MESH_DEVICES", 8))
+    n_rows = int(os.environ.get("BENCH_MESH_ROWS", 2048))
+    from transmogrifai_tpu.perf.smoke import run_costmodel_bench
+    payload = run_costmodel_bench(n_devices=n_dev, n_rows=n_rows)
+    _emit({
+        "metric": "costmodel_packing_improvement",
+        "value": payload.get("packing_improvement", 0.0),
+        "unit": "mesh_utilization_frac (predicted-LPT minus count-LPT)",
+        "vs_baseline": payload.get("packing_improvement", 0.0),
+        "platform": "cpu-hostmesh",
+        "n_rows": n_rows,
+        **payload,
+    })
+
+
 def merge_multichip_measurement(payload: dict) -> None:
     """Run `bench.py multichip` in a FRESH subprocess (the forced
     host-device count must precede backend init, so the resident
@@ -1146,6 +1194,19 @@ def main() -> None:
     _BENCH_ROOT_CM = _TRACER.span("run:bench", category="run",
                                   new_trace=True)
     _BENCH_ROOT = _BENCH_ROOT_CM.__enter__()
+    if "costmodel" in sys.argv[1:]:
+        # BEFORE any backend probe: the forced host-device count must
+        # precede JAX backend initialization
+        try:
+            run_costmodel()
+        except Exception as e:
+            _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"costmodel bench failed: "
+                            f"{type(e).__name__}: {e}",
+                   "trace_tail":
+                       traceback.format_exc().strip().splitlines()[-3:]})
+        return
     if "multichip" in sys.argv[1:]:
         # BEFORE any backend probe: the forced host-device count must
         # precede JAX backend initialization

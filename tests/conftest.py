@@ -15,6 +15,13 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Learned cost model (perf/): OFF by default in the suite — tier-1
+# sweeps/schedules must make bit-deterministic cold-start decisions and
+# must not read or append to the developer's ~/.cache profile corpus.
+# Tests that exercise the model opt back in with monkeypatch.setenv
+# (perf.params.enabled() reads the env per call).
+os.environ["TRANSMOGRIFAI_PERF_MODEL"] = "0"
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -872,3 +872,54 @@ def test_lint_l012_testkit_exempt():
     exempt = L.lint_source(
         src, path="transmogrifai_tpu/testkit/random_data.py")
     assert not any(f.code == "L012" for f in exempt)
+
+
+def test_lint_l013_magic_knob_in_hot_path():
+    """L013: a new module-level numeric tuning knob in a data//parallel//
+    serving/ hot path bypasses the params/env/cost-model plumbing."""
+    src = '''
+WORKERS = 4
+QUEUE_DEPTH: int = 16    # annotated spelling is the same knob
+PREP_THREADS, SEND_DEPTH = 2, 8   # tuple spelling too
+FORMAT_VERSION = 2       # not a tuning knob name
+_PRIVATE_DEPTH = 3       # module-private: not flagged
+MAX_WAIT_S = 0.5
+
+def f():
+    BATCH = 8            # function-local: not module level
+    return BATCH
+'''
+    flagged = L.lint_source(
+        src, path="transmogrifai_tpu/serving/newmod.py")
+    l013 = [f for f in flagged if f.code == "L013"]
+    assert len(l013) == 5
+    names = {f.message.split("`")[1].split(" ")[0] for f in l013}
+    assert names == {"WORKERS", "QUEUE_DEPTH", "PREP_THREADS",
+                     "SEND_DEPTH", "MAX_WAIT_S"}
+
+
+def test_lint_l013_allowlisted_and_env_derived_clean():
+    """The documented env-tunable sites stay allowlisted, and a knob
+    DERIVED from env/params is the fix, not a finding."""
+    src = '''
+UPLOAD_WORKERS = 2
+UPLOAD_DEPTH = 4
+TUNED_WORKERS = int(os.environ.get("TRANSMOGRIFAI_UPLOAD_WORKERS", "2"))
+'''
+    flagged = L.lint_source(
+        src, path="transmogrifai_tpu/parallel/bigdata.py")
+    assert not any(f.code == "L013" for f in flagged)
+    # the same bare constants OUTSIDE the allowlisted file DO flag
+    flagged = L.lint_source(
+        src, path="transmogrifai_tpu/data/newpipe.py")
+    assert sum(1 for f in flagged if f.code == "L013") == 2
+
+
+def test_lint_l013_not_flagged_outside_hot_paths():
+    src = "WORKERS = 4\n"
+    assert not any(
+        f.code == "L013"
+        for f in L.lint_source(src, path="transmogrifai_tpu/models/m.py"))
+    assert not any(
+        f.code == "L013"
+        for f in L.lint_source(src, path="tests/test_x.py"))

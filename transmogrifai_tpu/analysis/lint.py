@@ -104,6 +104,18 @@ silent slowness or nondeterminism once XLA is in the loop:
   ``np.random.default_rng(seed)`` ``Generator`` instead (`testkit/` is
   exempt: test fixtures own their processes).
 
+- ``L013 magic-knob``: a NEW module-level hand-set tuning knob — an
+  ALL-CAPS constant whose name says it tunes throughput
+  (``WORKERS``/``DEPTH``/``QUEUE``/``BATCH``/``WAIT``/``TIMEOUT``/
+  ``BUDGET``/``TARGET``/``RETRIES``/``WIDTH``/``CHUNK``/``THREADS``)
+  assigned a bare numeric literal in a ``data/``/``parallel/``/
+  ``serving/`` hot path. The learned cost model (`perf/`) exists so
+  these decisions come from measurements through the params/env
+  plumbing; a fresh ``WORKERS = 4`` bypasses both and fossilizes one
+  machine's guess. The documented env-tunable sites that predate the
+  model are allowlisted (`_L013_ALLOW`); everything new must route
+  through `PerfModelParams`/`OpParams`/an env knob instead.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -969,6 +981,81 @@ def _check_legacy_np_random(tree: ast.AST, path: str) -> List[LintFinding]:
     return findings
 
 
+# -- L013: hand-set magic tuning knobs in hot paths -------------------------- #
+
+import re as _re
+
+_L013_DIRS = ("data", "parallel", "serving")
+_L013_KNOB_WORDS = ("WORKERS", "DEPTH", "QUEUE", "BATCH", "WAIT",
+                    "TIMEOUT", "BUDGET", "TARGET", "RETRIES", "WIDTH",
+                    "CHUNK", "THREADS", "POLL", "FEEDERS", "LADDER")
+_L013_NAME_RE = _re.compile(r"^[A-Z][A-Z0-9_]*$")
+# documented env-tunable sites that predate the cost model: each is
+# overridable per call (builder kwargs) and via BENCH_*/TRANSMOGRIFAI_*
+# env knobs, and the model now fills the unset axes — keyed by file
+# basename so a rename forces a fresh look
+_L013_ALLOW = {
+    ("bigdata.py", "UPLOAD_CHUNK_ROWS"),
+    ("bigdata.py", "HIST_CHUNK_ROWS"),
+    ("bigdata.py", "UPLOAD_WORKERS"),
+    ("bigdata.py", "UPLOAD_DEPTH"),
+    ("columnar_store.py", "DEFAULT_CHUNK_ROWS"),
+}
+
+
+def _check_magic_knobs(tree: ast.AST, path: str) -> List[LintFinding]:
+    """Flag new module-level numeric tuning-knob constants in the
+    data//parallel//serving/ hot paths that bypass the params/env/cost-
+    model plumbing (allowlisted: the documented env-tunable sites)."""
+    parts = os.path.normpath(path).split(os.sep)
+    if not any(d in parts for d in _L013_DIRS):
+        return []
+    base = os.path.basename(path)
+    findings: List[LintFinding] = []
+
+    def pairs(node):
+        """(target Name, value node) pairs for plain, annotated, and
+        tuple assignments — `WORKERS: int = 4` and
+        `WORKERS, DEPTH = 4, 8` are the same knob in other spellings."""
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                yield node.target, node.value
+            return
+        if not isinstance(node, ast.Assign):
+            return
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                yield target, node.value
+            elif isinstance(target, ast.Tuple) and \
+                    isinstance(node.value, ast.Tuple) and \
+                    len(target.elts) == len(node.value.elts):
+                for t, v in zip(target.elts, node.value.elts):
+                    if isinstance(t, ast.Name):
+                        yield t, v
+
+    for node in getattr(tree, "body", []):  # module top level only
+        for target, v in pairs(node):
+            name = target.id
+            if not _L013_NAME_RE.match(name):
+                continue
+            if not any(w in name for w in _L013_KNOB_WORDS):
+                continue
+            if not (isinstance(v, ast.Constant)
+                    and isinstance(v.value, (int, float))
+                    and not isinstance(v.value, bool)):
+                continue  # env-derived/computed values are the fix, not a hit
+            if (base, name) in _L013_ALLOW:
+                continue
+            findings.append(LintFinding(
+                path, node.lineno, "L013",
+                f"hand-set tuning knob `{name} = {v.value!r}` in a hot "
+                "path bypasses the params/env plumbing and the learned "
+                "cost model (perf/) — thread it through "
+                "PerfModelParams/OpParams or an env knob so "
+                "measurements, not one machine's guess, drive it"))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -984,6 +1071,7 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.visit(tree)
     linter.findings.extend(_check_spmd_callbacks(tree, path))
     linter.findings.extend(_check_legacy_np_random(tree, path))
+    linter.findings.extend(_check_magic_knobs(tree, path))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 

@@ -98,6 +98,21 @@ def cmd_run(args) -> int:
         if getattr(args, "feature_cache_wire", None):
             base.wire = args.feature_cache_wire
         params.feature_cache = base
+    if getattr(args, "perf_model", None) or \
+            getattr(args, "perf_corpus_dir", None) or \
+            getattr(args, "perf_model_path", None):
+        # learned cost model: corpus/model locations + the kill switch
+        # (a cold corpus degrades every consumer to today's heuristics,
+        # so enabling is always safe)
+        from transmogrifai_tpu.perf.params import PerfModelParams
+        pm = params.perf_model or PerfModelParams()
+        if getattr(args, "perf_model", None):
+            pm.enabled = args.perf_model != "off"
+        if getattr(args, "perf_corpus_dir", None):
+            pm.corpus_dir = args.perf_corpus_dir
+        if getattr(args, "perf_model_path", None):
+            pm.model_path = args.perf_model_path
+        params.perf_model = pm
     result = runner.run(args.run_type, params)
     print(json.dumps(result.to_json(), indent=2, default=str))
     return 0
@@ -673,6 +688,23 @@ def main(argv: Optional[list] = None) -> int:
         help="artifact directory for --feature-cache (default "
              "~/.cache/transmogrifai_tpu/feature_cache); implies "
              "readwrite when --feature-cache is not given")
+    run_p.add_argument(
+        "--perf-model", choices=["on", "off"],
+        help="learned cost model (perf/): on fits from the profile "
+             "corpus and drives scheduler packing, the HBM gate, upload "
+             "workers/depth, and the serving ladder; off pins every "
+             "knob to the hand-tuned heuristics (same as "
+             "TRANSMOGRIFAI_PERF_MODEL=0)")
+    run_p.add_argument(
+        "--perf-corpus-dir",
+        help="profile-corpus directory for --perf-model (default "
+             "TRANSMOGRIFAI_PERF_CORPUS_DIR or "
+             "~/.cache/transmogrifai_tpu/perf)")
+    run_p.add_argument(
+        "--perf-model-path",
+        help="fitted cost-model JSON (perf.model.CostModel.save) to "
+             "load instead of fitting from the corpus — ships a tuned "
+             "predictor with a saved workflow")
     run_p.add_argument(
         "--feature-cache-wire", choices=["auto", "f16", "int8", "int4"],
         help="cold-miss wire compression: int8/int4 ship a quantized "

@@ -82,6 +82,11 @@ class IngestStats:
     # shows 0 there and its artifact IO lands in `cache_read_s` /
     # `cache_bytes` instead — the warm-path proof tests assert exactly
     # that split
+    # learned-cost-model plan accounting (perf/): when the upload shape
+    # (workers/depth) was model-chosen, the predicted wall rides along
+    # so the pipeline can score predicted-vs-measured at drain time
+    plan: str = ""             # "" (heuristic/explicit) or "model"
+    predicted_wall_s: float = 0.0
     wire: str = ""             # wire mode label (f16/int8/int4/...)
     cache: str = ""            # "", "off", "miss", "hit", "resident"
     cache_key: str = ""        # content address of this build
@@ -170,6 +175,9 @@ class IngestStats:
             "retries": self.retries,
             "retry_wait_s": round(self.retry_wait_s, 4),
             **({"wire": self.wire} if self.wire else {}),
+            **({"plan": self.plan,
+                "predicted_wall_s": round(self.predicted_wall_s, 4),
+                } if self.plan else {}),
             **({"cache": self.cache,
                 "cache_key": self.cache_key,
                 "cache_read_s": round(self.cache_read_s, 4),
@@ -317,6 +325,29 @@ def run_chunk_pipeline(items: Iterable[Any],
                 reg.counter("ingest_retries_total",
                             "transient chunk-read retries"
                             ).inc(st.retries)
+            if st.chunks > 0 and st.wall_s > 0:
+                # cost-model corpus row for this upload (+ residual when
+                # the plan was model-predicted); recording never raises
+                try:
+                    from transmogrifai_tpu import perf
+                    # the upload plan was predicted BEFORE the cache
+                    # decision, for a cold store read — scoring it
+                    # against a cache-hit replay (10x faster, different
+                    # bytes) would pollute the residual histogram with
+                    # a feature mismatch, so hits record the training
+                    # row but skip the residual
+                    predicted = ((st.predicted_wall_s or None)
+                                 if not st.cache_hit else None)
+                    perf.note(
+                        "ingest",
+                        perf.ingest_features(st.bytes_wire, st.workers,
+                                             st.depth, st.chunks,
+                                             st.cache_hit),
+                        predicted, st.wall_s)
+                except Exception:
+                    import logging as _logging
+                    _logging.getLogger(__name__).debug(
+                        "perf ingest recording failed", exc_info=True)
     return st
 
 

@@ -76,6 +76,11 @@ class GoodputReport:
     # outcome, refit wall time, and append-to-fresh-model staleness.
     # Empty when no continual loop ran in this trace.
     continual: Dict[str, Any] = field(default_factory=dict)
+    # learned-cost-model scorecard: rolled up from ``perf_residual``
+    # events (one per consumer decision a prediction backed) — how many
+    # predictions this run made and how far off they were. Empty when
+    # the model was cold/disabled.
+    perf: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def badput_s(self) -> float:
@@ -104,6 +109,8 @@ class GoodputReport:
             out["mesh"] = dict(sorted(self.mesh.items()))
         if self.continual:
             out["continual"] = dict(sorted(self.continual.items()))
+        if self.perf:
+            out["perf"] = dict(sorted(self.perf.items()))
         return out
 
     def pretty(self) -> str:
@@ -129,7 +136,8 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     counts = {"retries": 0, "recompiles": 0, "oom_redos": 0,
               "resumed_blocks": 0, "faults_injected": 0,
               "cache_hits": 0, "cache_misses": 0,
-              "steals": 0, "workers_retired": 0}
+              "steals": 0, "workers_retired": 0,
+              "hbm_preshrinks": 0, "block_resizes": 0}
     saved = 0.0
     cache_saved = 0.0
     # mesh rollup accumulators: several schedules (one per selector fit)
@@ -139,6 +147,9 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     mesh_busy = 0.0
     mesh: Dict[str, Any] = {}
     continual: Dict[str, Any] = {}
+    perf_n = 0
+    perf_err_sum = 0.0
+    perf_by_target: Dict[str, int] = {}
     seen: set = set()
     for sp in [root, *spans]:
         if sp.span_id in seen or sp.trace_id != root.trace_id:
@@ -177,6 +188,15 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
                 counts["steals"] += 1
             elif name == "worker_retired":
                 counts["workers_retired"] += 1
+            elif name == "hbm_preshrink":
+                counts["hbm_preshrinks"] += 1
+            elif name == "block_resize":
+                counts["block_resizes"] += 1
+            elif name == "perf_residual":
+                perf_n += 1
+                perf_err_sum += float(attrs.get("abs_rel_err", 0.0) or 0.0)
+                t = str(attrs.get("target") or "unknown")
+                perf_by_target[t] = perf_by_target.get(t, 0) + 1
             elif name == "continual_cycle":
                 continual["cycles"] = continual.get("cycles", 0) + 1
                 st = attrs.get("status") or "unknown"
@@ -222,5 +242,10 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
         report.mesh = mesh
     if continual:
         report.continual = continual
+    if perf_n:
+        report.perf = {
+            "predictions": perf_n,
+            "mean_abs_rel_err": round(perf_err_sum / perf_n, 4),
+            "by_target": dict(sorted(perf_by_target.items()))}
     report.counts = {k: v for k, v in counts.items() if v}
     return report

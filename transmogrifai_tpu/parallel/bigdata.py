@@ -159,6 +159,41 @@ def _put(chunk_np, sharding):
             else jax.device_put(chunk_np, sharding))
 
 
+def _resolve_upload_plan(store, chunk_rows: int, workers, depth,
+                         stats, bytes_per_elem: float = 2.0
+                         ) -> Tuple[int, int]:
+    """Pick the upload pipeline shape. Explicit `workers`/`depth` win
+    unchanged (bench env knobs, tests); a None axis is filled by the
+    learned cost model's predicted read-vs-upload balance
+    (`perf.choose_upload_plan`) when the ingest target is warm, else by
+    the hand-tuned `UPLOAD_WORKERS`/`UPLOAD_DEPTH` defaults — a cold
+    corpus reproduces today's plan exactly. The chosen plan's predicted
+    wall lands in `stats.predicted_wall_s` so the pipeline can score
+    the prediction against the measured wall."""
+    if workers is not None and depth is not None:
+        return workers, depth
+    try:
+        from transmogrifai_tpu import perf
+        # bytes_per_elem comes from the RESOLVED wire (f16=2, int8=1,
+        # int4=0.5): training rows carry measured wire bytes, so the
+        # plan query must use the same scale or the model is read off
+        # its training distribution
+        bytes_wire = float(store.n_rows) * store.n_features * bytes_per_elem
+        chunks = -(-store.n_rows // max(chunk_rows, 1))
+        w, d, pred = perf.choose_upload_plan(
+            bytes_wire, chunks, UPLOAD_WORKERS, UPLOAD_DEPTH,
+            fixed_workers=workers, fixed_depth=depth)
+        if pred is not None:
+            stats.predicted_wall_s = pred.value
+            stats.plan = "model"
+        return w, d
+    except Exception:
+        log.debug("upload plan resolution failed; using defaults",
+                  exc_info=True)
+        return (workers if workers is not None else UPLOAD_WORKERS,
+                depth if depth is not None else UPLOAD_DEPTH)
+
+
 def _default_ingest_retry():
     """Bounded-retry policy for transient IO during bulk ingest
     (tf.data-style bounded retry instead of fail-fast: a single flaky
@@ -506,7 +541,8 @@ class _CacheSession:
 def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
                   chunk_rows: int = UPLOAD_CHUNK_ROWS,
                   deadline_s: Optional[float] = None, *,
-                  workers: int = UPLOAD_WORKERS, depth: int = UPLOAD_DEPTH,
+                  workers: Optional[int] = None,
+                  depth: Optional[int] = None,
                   sharding=None, profile=None, return_stats: bool = False,
                   retry=None, cache=None):
     """Stream the store into one (n_pad, d) device buffer through the
@@ -555,6 +591,10 @@ def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
             profile.record_ingest("device_matrix_upload", stats)
         return (x, stats) if return_stats else x
     stats = IngestStats(label="device_matrix")
+    workers, depth = _resolve_upload_plan(
+        store, chunk_rows, workers, depth, stats,
+        bytes_per_elem=(sess.bits / 8.0 if sess.bits
+                        else float(sess.legacy_wire.itemsize)))
     prepare, items = sess.begin(stats)
     n_pad = sess.n_pad
     bufs = {"x": _zeros((n_pad, store.n_features), dtype, sharding)}
@@ -589,7 +629,8 @@ def device_matrix(store: ColumnarStore, dtype=jnp.bfloat16,
 def device_binned(store: ColumnarStore, edges: np.ndarray,
                   chunk_rows: int = UPLOAD_CHUNK_ROWS,
                   deadline_s: Optional[float] = None, *,
-                  workers: int = UPLOAD_WORKERS, depth: int = UPLOAD_DEPTH,
+                  workers: Optional[int] = None,
+                  depth: Optional[int] = None,
                   sharding=None, profile=None, return_stats: bool = False,
                   retry=None, cache=None):
     """(n_pad, d) int8 quantile-binned device buffer through the same
@@ -611,6 +652,10 @@ def device_binned(store: ColumnarStore, edges: np.ndarray,
             profile.record_ingest("device_binned_upload", stats)
         return (b, stats) if return_stats else b
     stats = IngestStats(label="device_binned")
+    workers, depth = _resolve_upload_plan(
+        store, chunk_rows, workers, depth, stats,
+        bytes_per_elem=(sess.bits / 8.0 if sess.bits
+                        else float(sess.legacy_wire.itemsize)))
     prepare, items = sess.begin(stats)
     n_pad = sess.n_pad
     edges_dev = jnp.asarray(edges)
@@ -648,8 +693,8 @@ def dual_device_matrices(store: ColumnarStore, edges: np.ndarray,
                          dtype=jnp.bfloat16,
                          chunk_rows: int = UPLOAD_CHUNK_ROWS,
                          deadline_s: Optional[float] = None, *,
-                         workers: int = UPLOAD_WORKERS,
-                         depth: int = UPLOAD_DEPTH, sharding=None,
+                         workers: Optional[int] = None,
+                         depth: Optional[int] = None, sharding=None,
                          profile=None, return_stats: bool = False,
                          retry=None, cache=None):
     """ONE pass over the store → BOTH device representations: the
@@ -687,6 +732,10 @@ def dual_device_matrices(store: ColumnarStore, edges: np.ndarray,
             profile.record_ingest("dual_upload", stats)
         return (x, b, stats) if return_stats else (x, b)
     stats = IngestStats(label="dual")
+    workers, depth = _resolve_upload_plan(
+        store, chunk_rows, workers, depth, stats,
+        bytes_per_elem=(sess.bits / 8.0 if sess.bits
+                        else float(sess.legacy_wire.itemsize)))
     prepare, items = sess.begin(stats)
     n_pad = sess.n_pad
     edges_dev = jnp.asarray(edges)

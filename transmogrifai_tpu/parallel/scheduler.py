@@ -93,6 +93,7 @@ class _Block:
     key: Tuple                 # static_signature group key
     idxs: List[int]            # grid indices within the job
     home: int = 0              # worker the block was dealt to
+    pred_s: Optional[float] = None  # cost-model predicted seconds
 
 
 @dataclass
@@ -260,13 +261,25 @@ class GridScheduler:
                     groups.setdefault(
                         static_signature(job.est, g), []).append(i)
             blocks += [_Block(ji, key, idxs) for key, idxs in groups.items()]
-        # longest-first (LPT) for packing; deterministic tie-break
-        blocks.sort(key=lambda b: (-len(b.idxs), b.job, repr(b.key)))
+        blocks = self._plan(blocks, X, y, folds)
 
         self._queues = [deque() for _ in range(self.n_workers)]
-        for bi, blk in enumerate(blocks):
-            blk.home = bi % self.n_workers
-            self._queues[blk.home].append(blk)
+        if any(b.pred_s is None for b in blocks):
+            # cold cost model: count-LPT + round-robin deal — today's
+            # heuristic, bit for bit
+            for bi, blk in enumerate(blocks):
+                blk.home = bi % self.n_workers
+                self._queues[blk.home].append(blk)
+        else:
+            # warm model: TRUE LPT — each block (longest predicted
+            # first) lands on the least-loaded lane, so the packing is
+            # driven by predicted seconds instead of config counts
+            loads = [0.0] * self.n_workers
+            for blk in blocks:
+                k = min(range(self.n_workers), key=lambda j: (loads[j], j))
+                blk.home = k
+                self._queues[k].append(blk)
+                loads[k] += blk.pred_s or 0.0
         self._inflight = 0
         self._abort_exc = None
         self._placed = {}  # drop a previous run's pinned device buffers
@@ -302,6 +315,79 @@ class GridScheduler:
                 f"{leftover} grid blocks unfinished")
         return [self._job_errors.get(ji, results[ji])
                 for ji in range(len(jobs))]
+
+    def _plan(self, blocks: List[_Block], X, y, folds) -> List[_Block]:
+        """Order (and, with a warm cost model, size) the grid blocks.
+
+        Cold model (empty corpus / disabled): EXACTLY today's heuristic
+        — blocks sorted by config count, longest-first, deterministic
+        tie-break (`pred_s` stays None and the caller deals
+        round-robin). Warm model: every block gets a predicted wall
+        time from `perf` block features; blocks predicted far past the
+        seconds-per-block target are SPLIT into narrower sub-blocks
+        (same static signature, so each part still compiles as one
+        batched program — the same regrouping a journal resume already
+        exercises), then sorted by predicted seconds for true-LPT
+        packing. A single cold block degrades the WHOLE plan to the
+        count heuristic: half-predicted orderings are worse than
+        either."""
+        count_key = lambda b: (-len(b.idxs), b.job, repr(b.key))  # noqa: E731
+        blocks.sort(key=count_key)
+        if not blocks:
+            return blocks
+        try:
+            from transmogrifai_tpu import perf
+            model = perf.get_model()
+        except Exception:
+            model = None
+        if model is None:
+            return blocks
+        n_rows = int(np.shape(y)[0])
+        try:
+            n_cols = int(X.shape[1])
+            dtype_bytes = int(np.dtype(X.dtype).itemsize)
+        except (AttributeError, IndexError, TypeError):
+            n_cols, dtype_bytes = 0, 4
+        n_folds = len(folds)
+        for blk in blocks:
+            family = blk.key[0] if blk.key else "generic"
+            static = blk.key[1] if len(blk.key) > 1 else ()
+            p = model.predict("block_runtime", perf.block_features(
+                family, static, len(blk.idxs), n_rows, n_cols, n_folds,
+                dtype_bytes))
+            if p is None:
+                for b in blocks:
+                    b.pred_s = None
+                return blocks
+            blk.pred_s = p.value
+        # width sizing: a block predicted well past the target makes the
+        # tail lane a straggler no steal can fix (blocks are atomic) —
+        # split it toward target seconds per block. Only clearly
+        # oversize blocks split (2x hysteresis): every extra part is an
+        # extra dispatch + journal granularity, and near-target blocks
+        # pack fine as-is.
+        target = perf.target_block_s()
+        sized: List[_Block] = []
+        for blk in blocks:
+            if target > 0 and blk.pred_s > 2.0 * target \
+                    and len(blk.idxs) > 1:
+                k = min(len(blk.idxs),
+                        max(2, int(np.ceil(blk.pred_s / target))))
+                step = -(-len(blk.idxs) // k)
+                parts = [blk.idxs[i:i + step]
+                         for i in range(0, len(blk.idxs), step)]
+                frac = 1.0 / len(blk.idxs)
+                obs_export.record_event(
+                    "block_resize", job=blk.job, configs=len(blk.idxs),
+                    parts=len(parts), predicted_s=round(blk.pred_s, 3),
+                    target_s=target)
+                for part in parts:
+                    sized.append(_Block(blk.job, blk.key, part,
+                                        pred_s=blk.pred_s * len(part) * frac))
+            else:
+                sized.append(blk)
+        sized.sort(key=lambda b: (-(b.pred_s or 0.0),) + count_key(b))
+        return sized
 
     def _worker_ctx(self, k: int, ctx):
         """Same n_rows and — critically — the SAME seed as the caller's
@@ -443,7 +529,14 @@ class GridScheduler:
                 with self._cond:
                     for i, row in zip(blk.idxs, rows):
                         results[blk.job][i] = row
-                stats.busy_s += time.perf_counter() - t0
+                block_s = time.perf_counter() - t0
+                # NOT residual-scored here: the lane's run_sweep already
+                # predicts and scores this same block with the same
+                # features inside _run_groups_resilient — a second note
+                # would double-weight scheduled blocks in the
+                # perf_model_abs_rel_err scorecard (blk.pred_s exists
+                # for the packing decision, which that residual covers)
+                stats.busy_s += block_s
                 stats.blocks += 1
                 self._complete()
 

@@ -232,6 +232,12 @@ def _smoke_steal(payload: Dict[str, Any], n_rows: int = 240) -> None:
 
 
 def _smoke() -> int:
+    # fresh perf corpus: the kill/resume block arithmetic below assumes
+    # count-LPT blocks (no model-driven splits) — a warm corpus from
+    # earlier runs on this machine must not re-plan the schedule
+    if "TRANSMOGRIFAI_PERF_CORPUS_DIR" not in os.environ:
+        os.environ["TRANSMOGRIFAI_PERF_CORPUS_DIR"] = \
+            tempfile.mkdtemp(prefix="perf-corpus-")
     payload: Dict[str, Any] = {}
     payload.update(run_measured())
     _smoke_kill_resume(payload)

@@ -137,37 +137,85 @@ def _journal_prefill(grids: List[Dict],
 def _journal_commit(grids: List[Dict],
                     metrics: List[Optional[List[float]]],
                     idxs: List[int],
-                    block_s: Optional[float] = None) -> None:
+                    block_s: Optional[float] = None,
+                    facts: Optional[Dict] = None) -> None:
     journal = _active_journal()
     if journal is None:
         return
     best = getattr(_SWEEP_TL, "best", None)
     # the block ran its configs as one program: attribute wall time evenly
     per_cfg = (block_s / len(idxs)) if (block_s and idxs) else None
+    block_facts = None
+    if facts is not None and block_s is not None:
+        # static-signature facts + the block's wall cost, stamped on
+        # every record of the block under one block_key so a resumed
+        # run's journal contributes training rows to the cost-model
+        # corpus (perf/corpus.harvest_journal dedupes per block)
+        block_facts = dict(facts)
+        block_facts["block_s"] = round(float(block_s), 6)
+        block_facts["block_key"] = _block_key_fn(grids)(idxs)
     for i in idxs:
         row = metrics[i]
         if row is None or any(m is None for m in row):
             continue
         journal.append(grids[i], row,
                        best=best.note(grids[i], row) if best else None,
-                       duration_s=per_cfg)
+                       duration_s=per_cfg, facts=block_facts)
 
 
 def _run_groups_resilient(groups: Dict[Tuple, List[int]], run_one,
-                          commit, family: str) -> None:
+                          commit, family: str, facts=None,
+                          block_key=None) -> None:
     """Execute grid-block groups with the fault-tolerance contract:
 
     - `fault_point(SITE_RUN_BLOCK)` fires before every block, so a chaos
       plan can kill/fail the sweep at any block boundary;
+    - with a warm cost model (`perf/`), a block whose PREDICTED HBM
+      footprint exceeds the budget is pre-shrunk into narrower parts
+      BEFORE dispatch — the ``oom_redo`` badput the halving path would
+      have paid is never spent (an ``hbm_preshrink`` event marks the
+      decision); the halving path below stays as the fallback, and
+      every OOM observed becomes a negative training example;
     - a device-OOM failure HALVES the block width and retries each half
       before surfacing (narrower blocks fit where wide ones did not —
       the compiled program per half persists in the compile cache); the
       failed wide attempt's wall time is recorded as an ``oom_redo``
       badput event on the enclosing span;
-    - `commit(idxs, block_s)` journals a block only after it fully
-      completes, stamped with its wall cost (resume-skip accounting).
+    - `commit(idxs, block_s, facts)` journals a block only after it
+      fully completes, stamped with its wall cost + static-signature
+      facts (resume-skip accounting and cost-model training rows).
+
+    `facts(static, idxs)` returns the block's cost-model feature dict
+    (`perf/features.block_features`); when provided, every executed
+    block records its measured wall time (and predicted-vs-measured
+    residual, when the model was warm) into the perf corpus — cold
+    start changes NOTHING about execution, it only collects rows.
+    `block_key(idxs)` stamps each row with the block's content key
+    (same formula as the journal's `facts["block_key"]`) so a later
+    `harvest_journal` of this run's journal recognizes the block as
+    already recorded instead of duplicating it.
     """
+    model = None
+    budget = 0.0
+    if facts is not None:
+        try:
+            from transmogrifai_tpu import perf as _perf
+            model = _perf.get_model()
+            budget = _perf.hbm_budget_bytes()
+        except Exception:
+            model = None
+
+    def _note(target, feats, predicted, measured, **extra):
+        try:
+            from transmogrifai_tpu import perf as _perf
+            _perf.note(target, feats, predicted, measured, **extra)
+        except Exception:
+            log.debug("perf recording failed", exc_info=True)
+
     def run(static, idxs):
+        feats = facts(static, idxs) if facts is not None else None
+        pred = (model.predict("block_runtime", feats)
+                if model is not None and feats is not None else None)
         t0 = time.perf_counter()
         try:
             with TRACER.span("sweep:block", category="sweep",
@@ -182,6 +230,16 @@ def _run_groups_resilient(groups: Dict[Tuple, List[int]], run_one,
             obs_export.record_event("oom_redo", family=family,
                                     configs=len(idxs),
                                     wasted_s=round(wasted, 6))
+            if feats is not None:
+                # negative training example: this block's footprint
+                # exceeded the device — teach the HBM target that shapes
+                # like it sit past the budget, so the NEXT run's gate
+                # pre-shrinks instead of paying this redo again
+                from transmogrifai_tpu.perf.features import \
+                    hbm_proxy_bytes
+                proxy = hbm_proxy_bytes(feats)
+                _note("hbm", feats, None,
+                      max(proxy, budget or proxy) * 1.25, oom=True)
             mid = (len(idxs) + 1) // 2
             log.warning(
                 "sweep %s block %r: device OOM with %d configs (%s) — "
@@ -190,10 +248,40 @@ def _run_groups_resilient(groups: Dict[Tuple, List[int]], run_one,
             run(static, idxs[:mid])
             run(static, idxs[mid:])
             return
-        commit(idxs, time.perf_counter() - t0)
+        block_s = time.perf_counter() - t0
+        if feats is not None:
+            from transmogrifai_tpu.perf.features import hbm_proxy_bytes
+            extra = ({"block_key": block_key(idxs)}
+                     if block_key is not None else {})
+            _note("block_runtime", feats, pred, block_s, **extra)
+            _note("hbm", feats, None, hbm_proxy_bytes(feats))
+        commit(idxs, block_s, feats)
 
     for static, idxs in groups.items():
-        run(static, idxs)
+        parts = [idxs]
+        if model is not None and facts is not None and budget > 0 \
+                and len(idxs) > 1:
+            hp = model.predict("hbm", facts(static, idxs))
+            if hp is not None and hp.value > budget:
+                import math as _math
+                k = min(len(idxs), int(_math.ceil(hp.value / budget)))
+                if k > 1:
+                    step = -(-len(idxs) // k)
+                    parts = [idxs[i:i + step]
+                             for i in range(0, len(idxs), step)]
+                    obs_export.record_event(
+                        "hbm_preshrink", family=family,
+                        configs=len(idxs), parts=len(parts),
+                        predicted_bytes=round(hp.value),
+                        budget_bytes=round(budget))
+                    log.info(
+                        "sweep %s block %r: predicted HBM %.2f GB over "
+                        "the %.2f GB budget — pre-shrinking %d configs "
+                        "into %d parts (no OOM redo)", family, static,
+                        hp.value / 2**30, budget / 2**30, len(idxs),
+                        len(parts))
+        for part in parts:
+            run(static, part)
 
 
 # --------------------------------------------------------------------------- #
@@ -329,6 +417,7 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
                                                 int, bool], int]] = None,
                   fit_takes_val: bool = False,
                   family: str = "generic",
+                  x_info: Optional[Tuple[int, int]] = None,
                   ) -> List[List[float]]:
     """Shared scaffold: group grids by static params; per group, stack the
     dynamic params into traced vectors and run fit→predict→metric as one
@@ -347,6 +436,11 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
     monolithic sweep executions past ~60s get killed by serving
     infrastructure (and a host loop also bounds peak HBM). With a mesh
     (`sharding`), the batched path runs so the grid axis shards.
+
+    `x_info` = (n_features, wire dtype bytes) of the training matrix —
+    the handlers pass it so every executed block can be described to
+    the cost model (`perf/features.block_features`) without this
+    scaffold touching X itself.
     """
     metrics: List[Optional[List[float]]] = [None] * len(grids)
     _journal_prefill(grids, metrics)  # resume: skip completed blocks
@@ -481,10 +575,49 @@ def _sweep_blocks(grids: List[Dict], y, W, V, metric_fn, sharding,
     # (c) let later groups reuse calibration learned by earlier ones.
     _run_groups_resilient(
         groups, _run_group,
-        commit=lambda idxs, block_s=None: _journal_commit(
-            grids, metrics, idxs, block_s),
-        family=family)
+        commit=lambda idxs, block_s=None, facts=None: _journal_commit(
+            grids, metrics, idxs, block_s, facts),
+        family=family,
+        facts=_block_facts_fn(family, y, W, x_info),
+        block_key=_block_key_fn(grids))
     return metrics  # type: ignore[return-value]
+
+
+def _block_key_fn(grids: List[Dict]):
+    """Content key of a block (the grids it ran), matching
+    `_journal_commit`'s `facts["block_key"]` — one identity shared by
+    live corpus rows and journal records so harvests never duplicate a
+    block this process already recorded."""
+    from transmogrifai_tpu.runtime.journal import SweepJournal
+
+    def key(idxs: List[int]) -> str:
+        return SweepJournal.key_of({"block": [grids[i] for i in idxs]})
+    return key
+
+
+def _x_info(X) -> Tuple[int, int]:
+    """(n_features, wire dtype bytes) of a training matrix — the shape
+    facts the cost model keys block features on."""
+    try:
+        return int(X.shape[1]), int(np.dtype(X.dtype).itemsize)
+    except (AttributeError, IndexError, TypeError):
+        return 0, 4
+
+
+def _block_facts_fn(family: str, y, W, x_info: Optional[Tuple[int, int]]):
+    """The `facts(static, idxs)` callback `_run_groups_resilient` feeds
+    the cost model; None (no x_info) keeps the group runner silent."""
+    if x_info is None:
+        return None
+    n_cols, dtype_bytes = x_info
+    n_rows = int(np.shape(y)[0])
+    n_folds = int(np.shape(W)[0]) if hasattr(W, "shape") else len(W)
+
+    def facts(static, idxs):
+        from transmogrifai_tpu.perf.features import block_features
+        return block_features(family, static, len(idxs), n_rows, n_cols,
+                              n_folds, dtype_bytes)
+    return facts
 
 
 # --------------------------------------------------------------------------- #
@@ -602,7 +735,7 @@ def _sweep_logistic(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         grids, y, W, V, metric_fn, sharding,
         static_of=lambda g: _static_logistic(est, g),
         dyn_of=lambda g: _l1_l2_of(est, g),
-        build=build, family="logistic")
+        build=build, family="logistic", x_info=_x_info(X))
 
 
 def _sweep_linreg(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -616,7 +749,7 @@ def _sweep_linreg(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         grids, y, W, V, metric_fn, sharding,
         static_of=lambda g: _static_linreg(est, g),
         dyn_of=lambda g: _l1_l2_of(est, g),
-        build=build, family="linreg")
+        build=build, family="linreg", x_info=_x_info(X))
 
 
 def _sweep_svc(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -626,7 +759,7 @@ def _sweep_svc(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
         build=lambda st, idxs: lambda d, w: predict_linear_svc(
             fit_linear_svc(X, y, w, d["reg"], st[0]), X),
-        family="svc")
+        family="svc", x_info=_x_info(X))
 
 
 def _sweep_glm(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -640,7 +773,7 @@ def _sweep_glm(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         grids, y, W, V, metric_fn, sharding,
         static_of=lambda g: _static_glm(est, g),
         dyn_of=lambda g: {"reg": float(_grid_param(est, g, "reg_param"))},
-        build=build, family="glm")
+        build=build, family="glm", x_info=_x_info(X))
 
 
 def _sweep_nb(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -663,7 +796,7 @@ def _sweep_nb(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         dyn_of=lambda g: {"smoothing": float(_grid_param(est, g, "smoothing"))},
         build=lambda st, idxs: lambda d, w: predict_naive_bayes(
             fit_naive_bayes(X, y, w, d["smoothing"], n_classes), X),
-        family="naive_bayes")
+        family="naive_bayes", x_info=_x_info(X))
 
 
 def _sweep_mlp(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -679,7 +812,7 @@ def _sweep_mlp(est, grids, X, y, W, V, metric_fn, ctx, sharding):
         grids, y, W, V, metric_fn, sharding,
         static_of=lambda g: _static_mlp(est, g),
         dyn_of=lambda g: {"lr": float(_grid_param(est, g, "learning_rate"))},
-        build=build, family="mlp")
+        build=build, family="mlp", x_info=_x_info(X))
 
 
 # --------------------------------------------------------------------------- #
@@ -1043,7 +1176,8 @@ def _sweep_forest(est, grids, X, y, W, V, metric_fn, ctx, sharding,
         grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6,
         host_dispatch=True,
         pair_width=lambda st, idxs, k: width_of(st, idxs),
-        calibrate=calibrate, family="forest")
+        calibrate=calibrate, family="forest",
+        x_info=_x_info(X))
 
 
 def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
@@ -1136,7 +1270,8 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
             grid_vmap=lambda st, idxs: _pad_depth_of(est, grids, idxs) <= 6,
             host_dispatch=sharding is None,
             pair_width=lambda st, idxs, k: width_of(st, idxs),
-            fit_takes_val=True, family="gbt")
+            fit_takes_val=True, family="gbt",
+            x_info=_x_info(X))
 
     # ---- single-device binary/squared: ROUND-CHUNKED host dispatch ---- #
     # A 200-round depth-10 fit at 100k rows is a >60s single execution
@@ -1271,9 +1406,11 @@ def _sweep_gbt(est, grids, X, y, W, V, metric_fn, ctx, sharding):
 
     _run_groups_resilient(
         groups, _run_gbt_group,
-        commit=lambda idxs, block_s=None: _journal_commit(
-            grids, metrics, idxs, block_s),
-        family="gbt")
+        commit=lambda idxs, block_s=None, facts=None: _journal_commit(
+            grids, metrics, idxs, block_s, facts),
+        family="gbt",
+        facts=_block_facts_fn("gbt", y, W, _x_info(X)),
+        block_key=_block_key_fn(grids))
     return metrics  # type: ignore[return-value]
 
 

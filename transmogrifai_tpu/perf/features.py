@@ -1,0 +1,98 @@
+"""Feature engineering for the cost model: one dict of numeric features
+per decision, shared by the RECORDING side (parallel/sweep.py journaling
+measured block wall times) and the PREDICTION side (the scheduler, the
+HBM gate, bench extrapolations) — the two must agree on names or the
+model silently predicts garbage for half its consumers.
+
+The static-signature layouts mirrored here are the module-level
+`_static_<family>` functions in `parallel/sweep.py` (the compile-group
+keys the scheduler already cuts blocks along); this module is kept
+import-light (numpy only) so `perf.params`/`workflow.params` never drag
+jax in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = ["block_features", "hbm_proxy_bytes", "ingest_features",
+           "serving_features"]
+
+
+def block_features(family: str, static: Tuple, n_configs: int,
+                   n_rows: int, n_cols: int, n_folds: int,
+                   dtype_bytes: int = 4) -> Dict[str, float]:
+    """Features of one sweep grid block (a compile group, or a scheduler
+    sub-block of one): the family one-hot plus the static-signature
+    facts that drive its runtime — iteration counts for linear-likes,
+    learners × nodes × bins for trees — and the training-matrix shape.
+    Unknown families degrade to the shape facts alone."""
+    f: Dict[str, float] = {
+        "n_configs": float(n_configs),
+        "n_rows": float(n_rows),
+        "n_cols": float(n_cols),
+        "n_folds": float(n_folds),
+        "dtype_bytes": float(dtype_bytes),
+        f"fam_{family}": 1.0,
+    }
+    try:
+        if family == "logistic":
+            f["iters"] = float(static[0])
+            f["enet"] = 1.0 if static[1] else 0.0
+        elif family == "linreg":
+            f["enet"] = 1.0 if static[0] else 0.0
+        elif family == "svc":
+            f["iters"] = float(static[0])
+        elif family == "glm":
+            f["iters"] = float(static[1])
+        elif family == "mlp":
+            hidden, iters = static[0], static[1]
+            f["units"] = float(sum(int(h) for h in hidden))
+            f["iters"] = float(iters)
+        elif family in ("forest", "gbt"):
+            learners, bins = int(static[0]), int(static[1])
+            depth = int(static[3])
+            f["learners"] = float(learners)
+            f["bins"] = float(bins)
+            f["depth"] = float(depth)
+            f["nodes"] = float(2 ** min(depth, 14))
+    except (IndexError, TypeError, ValueError):
+        pass  # foreign static layout: shape facts still predict coarsely
+    return f
+
+
+def hbm_proxy_bytes(feats: Dict[str, float]) -> float:
+    """Analytic peak-HBM proxy for a block, in bytes — the 'observed
+    peak-HBM proxy' training target. Tree families: per-pair bin
+    one-hots (n·d·bins bf16) plus deepest-level routing one-hots
+    (n·nodes bf16), times the grid×fold pairs simultaneously live
+    (mirrors `_tree_pair_width`'s memory bound in parallel/sweep.py).
+    Linear-likes: the per-config parameter/logit working set on top of
+    the shared X."""
+    n = feats.get("n_rows", 0.0)
+    d = feats.get("n_cols", 0.0)
+    pairs = feats.get("n_configs", 1.0) * max(feats.get("n_folds", 1.0), 1.0)
+    if feats.get("nodes"):
+        per_pair = n * (d * max(feats.get("bins", 1.0), 1.0)
+                        + feats["nodes"]) * 2.0
+        return pairs * per_pair
+    # linear-likes: X (shared) + per-pair logits/params f32
+    return n * d * feats.get("dtype_bytes", 4.0) + pairs * n * 4.0
+
+
+def ingest_features(bytes_wire: float, workers: int, depth: int,
+                    chunks: int, cache_hit: bool = False
+                    ) -> Dict[str, float]:
+    """Features of one pipelined upload (data/pipeline.py): wire bytes,
+    pipeline shape, and whether the bytes came from a cache artifact
+    (artifact replay has different read characteristics than a store
+    sweep, so the model must be able to tell them apart)."""
+    return {"bytes_wire": float(bytes_wire), "workers": float(workers),
+            "depth": float(depth), "chunks": float(chunks),
+            "cache_hit": 1.0 if cache_hit else 0.0}
+
+
+def serving_features(bucket: int) -> Dict[str, float]:
+    """Features of one serving device batch: the padded bucket size is
+    the compiled shape, which is what drives the latency."""
+    return {"bucket": float(bucket)}

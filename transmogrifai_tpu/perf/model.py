@@ -1,0 +1,448 @@
+"""CostModel: a deliberately small learned performance model.
+
+"A Learned Performance Model for TPUs" (arxiv 2008.01040) learns
+runtime from program features with a GNN; this repo's programs are a
+closed family (sweep blocks, chunk uploads, serving buckets), so a
+per-target **log-linear ridge** over engineered features
+(`perf/features.py`) captures the same multiplicative structure —
+runtime ≈ c · Πᵢ fᵢ^wᵢ — at a few hundred bytes per target, fit with
+the repo's own JAX `lstsq` (no new deps) in milliseconds:
+
+    z = log(value),  φ(x) = [1, log1p(f₁), log1p(f₂), ...]
+    w = argmin ‖Φw − z‖² + λ‖w‖²      (ridge via row augmentation)
+
+Per-prediction uncertainty comes from the RESIDUAL QUANTILES of the fit
+(no distributional assumption): ``Prediction.lo``/``hi`` are the
+10th/90th-percentile multiplicative error bands around the median-
+calibrated point estimate — exactly the error bars bench attaches to
+its (formerly bare) extrapolations.
+
+Cold-start contract: a target with fewer than `min_rows` training rows
+predicts **None**, and every consumer falls back to today's heuristics
+bit-for-bit (regression-tested per call site). A fitted model
+save/loads as JSON so a saved workflow ships with its predictor.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu.perf import params as perf_params
+from transmogrifai_tpu.perf.corpus import CostCorpus, get_corpus
+from transmogrifai_tpu.perf.features import block_features, ingest_features
+
+__all__ = ["Prediction", "CostModel", "fit_corpus", "get_model",
+           "set_model", "refresh", "choose_upload_plan",
+           "predict_block_seconds", "predict_sweep_seconds",
+           "holdout_mape"]
+
+log = logging.getLogger(__name__)
+
+_EPS = 1e-6
+_RIDGE = 1e-3
+# refit cadence for the lazily-fitted process model: enough new rows to
+# move the fit, cheap enough to never matter on the critical path
+_REFIT_ROWS = 512
+
+
+@dataclass
+class Prediction:
+    """One cost prediction with its uncertainty band (residual-quantile
+    multiplicative error bars) and the training support behind it."""
+
+    value: float
+    lo: float
+    hi: float
+    n: int  # training rows behind this target
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"value": round(self.value, 6), "lo": round(self.lo, 6),
+                "hi": round(self.hi, 6), "n": self.n}
+
+
+class _TargetFit:
+    """One target's fitted log-linear ridge."""
+
+    def __init__(self, names: List[str], w: Sequence[float],
+                 resid_q: Sequence[float], n: int):
+        self.names = list(names)
+        self.w = np.asarray(w, np.float64)
+        self.resid_q = [float(q) for q in resid_q]  # [q10, q50, q90]
+        self.n = int(n)
+
+    def phi(self, feats: Dict[str, float]) -> np.ndarray:
+        row = [1.0] + [math.log1p(max(float(feats.get(nm, 0.0)), 0.0))
+                       for nm in self.names]
+        return np.asarray(row, np.float64)
+
+    def predict(self, feats: Dict[str, float]) -> Prediction:
+        z = float(self.phi(feats) @ self.w)
+        q10, q50, q90 = self.resid_q
+        return Prediction(value=math.exp(z + q50), lo=math.exp(z + q10),
+                          hi=math.exp(z + q90), n=self.n)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"names": self.names, "w": [float(x) for x in self.w],
+                "resid_q": self.resid_q, "n": self.n}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "_TargetFit":
+        return _TargetFit(d["names"], d["w"], d["resid_q"], int(d["n"]))
+
+
+class CostModel:
+    """Per-target predictors + the cold-start floor."""
+
+    def __init__(self, min_rows: Optional[int] = None):
+        self.targets: Dict[str, _TargetFit] = {}
+        self.min_rows = int(min_rows if min_rows is not None
+                            else perf_params.get_params().min_rows)
+
+    def predict(self, target: str,
+                feats: Dict[str, float]) -> Optional[Prediction]:
+        """Point estimate + error band, or None when this target is
+        cold (unfitted, or fitted on fewer than `min_rows` rows) — the
+        caller then uses today's heuristic unchanged."""
+        fit = self.targets.get(target)
+        if fit is None or fit.n < self.min_rows:
+            return None
+        try:
+            return fit.predict(feats)
+        except Exception:
+            log.debug("cost model predict failed for %s", target,
+                      exc_info=True)
+            return None
+
+    def fit_target(self, target: str,
+                   rows: List[Dict[str, Any]], ridge: float = _RIDGE) -> None:
+        """Fit one target from corpus rows ({"features", "value"}).
+        Non-positive values are dropped (log space); OOM rows keep their
+        inflated value — they pull the HBM fit UP near the boundary,
+        which is the conservative direction for a pre-dispatch gate."""
+        rows = [r for r in rows if float(r.get("value", 0.0)) > 0.0]
+        if not rows:
+            return
+        names = sorted({k for r in rows for k in r["features"]})
+        import jax.numpy as jnp
+        phi = np.asarray(
+            [[1.0] + [math.log1p(max(float(r["features"].get(nm, 0.0)), 0.0))
+                      for nm in names] for r in rows], np.float64)
+        z = np.log(np.maximum(
+            np.asarray([float(r["value"]) for r in rows]), _EPS))
+        k = phi.shape[1]
+        lam = math.sqrt(ridge)
+        A = np.vstack([phi, lam * np.eye(k)])
+        b = np.concatenate([z, np.zeros(k)])
+        w = np.asarray(jnp.linalg.lstsq(
+            jnp.asarray(A), jnp.asarray(b))[0], np.float64)
+        resid = z - phi @ w
+        q10, q50, q90 = (np.quantile(resid, (0.1, 0.5, 0.9))
+                         if len(resid) > 1 else (0.0, 0.0, 0.0))
+        self.targets[target] = _TargetFit(names, w, [q10, q50, q90],
+                                          len(rows))
+
+    # -- persistence ------------------------------------------------------- #
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"cost_model": 1, "min_rows": self.min_rows,
+                "targets": {t: f.to_json() for t, f in self.targets.items()}}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "CostModel":
+        m = CostModel(min_rows=d.get("min_rows"))
+        for t, fd in (d.get("targets") or {}).items():
+            m.targets[t] = _TargetFit.from_json(fd)
+        return m
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(self.to_json(), fh)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "CostModel":
+        with open(path, encoding="utf-8") as fh:
+            return CostModel.from_json(json.load(fh))
+
+
+def fit_corpus(corpus: CostCorpus,
+               min_rows: Optional[int] = None) -> CostModel:
+    """Fit every known target from the corpus. An empty corpus yields a
+    model with no fitted targets — every predict() is None, every
+    consumer cold."""
+    from transmogrifai_tpu.perf.corpus import TARGETS
+    model = CostModel(min_rows=min_rows)
+    for target in TARGETS:
+        rows = corpus.rows(target)
+        if rows:
+            try:
+                model.fit_target(target, rows)
+            except Exception:
+                log.warning("cost model fit failed for target %s",
+                            target, exc_info=True)
+    return model
+
+
+# -- process-default model -------------------------------------------------- #
+
+_MODEL_LOCK = threading.Lock()
+_MODEL: Optional[CostModel] = None
+_MODEL_KEY: Optional[tuple] = None
+_MODEL_VERSION: Optional[tuple] = None  # corpus.version() at fit time
+# foreign-writer invalidation: another process growing the shared
+# corpus file by this much since our fit triggers a refit even though
+# OUR _appended counter never moved
+_FOREIGN_BYTES = 1 << 20
+
+
+def get_model() -> Optional[CostModel]:
+    """The process's active cost model, or None when disabled. Lazily
+    fitted from the active corpus and refitted when the corpus version
+    moves enough (~_REFIT_ROWS rows appended by this process, or ≥1 MB
+    written by another), or loaded once from
+    `PerfModelParams.model_path` when a fitted model ships with the
+    workflow. A load FAILURE is cached too: an unreadable model_path
+    falls back to the corpus fit once and must not re-open the bad
+    file (with a warning) on every subsequent decision."""
+    global _MODEL, _MODEL_KEY, _MODEL_VERSION
+    if not perf_params.enabled():
+        return None
+    with _MODEL_LOCK:
+        if _MODEL_KEY == ("explicit",):
+            return _MODEL  # set_model() pins it against lazy refits
+    p = perf_params.get_params()
+    path_failed = False
+    if p.model_path:
+        key = ("path", p.model_path)
+        fail_key = ("path-failed", p.model_path)
+        with _MODEL_LOCK:
+            if _MODEL_KEY == key:
+                return _MODEL
+            path_failed = _MODEL_KEY == fail_key
+        if not path_failed:
+            try:
+                loaded = CostModel.load(p.model_path)
+            except (OSError, ValueError, KeyError, TypeError):
+                log.warning("cost model at %s unreadable; falling back "
+                            "to corpus fit", p.model_path, exc_info=True)
+                path_failed = True
+            else:
+                with _MODEL_LOCK:
+                    _MODEL = loaded
+                    _MODEL_KEY = key
+                    return _MODEL
+    corpus = get_corpus()
+    if corpus is None:
+        return None
+    key = (("path-failed", p.model_path) if path_failed
+           else ("corpus", corpus.path))
+    with _MODEL_LOCK:
+        version = corpus.version()
+        stale = (_MODEL is None or _MODEL_KEY != key
+                 or _MODEL_VERSION is None)
+        if not stale:
+            appended_delta = version[2] - _MODEL_VERSION[2]
+            size_delta = abs(version[1] - _MODEL_VERSION[1])
+            # size trigger is NOT gated on appended_delta == 0: our own
+            # sub-_REFIT_ROWS appends are far under _FOREIGN_BYTES, so
+            # a >=1MB growth means another process wrote the bulk of it
+            # (a serving process recording a few sampled rows must not
+            # mask a concurrent training run's corpus)
+            stale = (appended_delta >= _REFIT_ROWS
+                     or size_delta >= _FOREIGN_BYTES)
+        if stale:
+            _MODEL = fit_corpus(corpus)
+            _MODEL_KEY = key
+            _MODEL_VERSION = version
+        return _MODEL
+
+
+def set_model(model: Optional[CostModel]) -> None:
+    """Install an explicit model as the process default (tests, smoke;
+    None reverts to lazy corpus fitting)."""
+    global _MODEL, _MODEL_KEY, _MODEL_VERSION
+    with _MODEL_LOCK:
+        _MODEL = model
+        _MODEL_KEY = ("explicit",) if model is not None else None
+        _MODEL_VERSION = None
+
+
+def refresh() -> Optional[CostModel]:
+    """Drop the cached model and refit from the current corpus."""
+    set_model(None)
+    return get_model()
+
+
+# -- consumer helpers -------------------------------------------------------- #
+
+def predict_block_seconds(family: str, static: Tuple, n_configs: int,
+                          n_rows: int, n_cols: int, n_folds: int,
+                          dtype_bytes: int = 4,
+                          model: Optional[CostModel] = None
+                          ) -> Optional[Prediction]:
+    m = model if model is not None else get_model()
+    if m is None:
+        return None
+    return m.predict("block_runtime",
+                     block_features(family, static, n_configs, n_rows,
+                                    n_cols, n_folds, dtype_bytes))
+
+
+_PLAN_WORKERS = (1, 2, 4, 8)
+_PLAN_DEPTHS = (1, 2, 4, 8)
+
+
+def choose_upload_plan(bytes_wire: float, chunks: int,
+                       default_workers: int, default_depth: int,
+                       fixed_workers: Optional[int] = None,
+                       fixed_depth: Optional[int] = None,
+                       model: Optional[CostModel] = None
+                       ) -> Tuple[int, int, Optional[Prediction]]:
+    """Pick upload (workers, depth) from the predicted read-vs-upload
+    balance: predict the pipeline wall for each candidate plan and take
+    the fastest (ties prefer the default — compiled-shape stability).
+    Cold model → exactly today's defaults with no prediction. Explicit
+    `fixed_*` values are honored (only the free axis is searched)."""
+    m = model if model is not None else get_model()
+    best = (fixed_workers if fixed_workers is not None else default_workers,
+            fixed_depth if fixed_depth is not None else default_depth)
+    if m is None:
+        return best[0], best[1], None
+    ws = (fixed_workers,) if fixed_workers is not None else _PLAN_WORKERS
+    ds = (fixed_depth,) if fixed_depth is not None else _PLAN_DEPTHS
+    best_pred = m.predict("ingest", ingest_features(
+        bytes_wire, best[0], best[1], chunks))
+    if best_pred is None:
+        return best[0], best[1], None
+    for w in ws:
+        for d in ds:
+            p = m.predict("ingest",
+                          ingest_features(bytes_wire, w, d, chunks))
+            if p is not None and p.value < best_pred.value:
+                best, best_pred = (w, d), p
+    return best[0], best[1], best_pred
+
+
+def predict_sweep_seconds(models, n_rows: int, n_cols: int, n_folds: int,
+                          dtype_bytes: int = 4,
+                          model: Optional[CostModel] = None
+                          ) -> Optional[Dict[str, Any]]:
+    """Predicted wall seconds for a whole selector sweep — the learned
+    replacement for bench's hand-rolled ``scale()`` extrapolation.
+    `models` is the selector shape: [(estimator, grids), ...]. Blocks
+    are cut along the REAL compile-group boundaries
+    (`sweep.static_signature`), predicted independently, and summed;
+    the lo/hi band sums the per-block bands (blocks run sequentially
+    per chip, so the sum is the right composition). Returns None when
+    ANY block is cold — a half-predicted extrapolation would be the
+    dishonesty this replaces."""
+    m = model if model is not None else get_model()
+    if m is None:
+        return None
+    from transmogrifai_tpu.parallel.sweep import static_signature
+    total = lo = hi = 0.0
+    per_family: Dict[str, float] = {}
+    n_min = None
+    for est, grids in models:
+        groups: Dict[Tuple, int] = {}
+        for g in grids:
+            key = static_signature(est, g)
+            groups[key] = groups.get(key, 0) + 1
+        for (family, static), n_cfg in groups.items():
+            p = m.predict("block_runtime",
+                          block_features(family, static, n_cfg, n_rows,
+                                         n_cols, n_folds, dtype_bytes))
+            if p is None:
+                return None
+            total += p.value
+            lo += p.lo
+            hi += p.hi
+            per_family[family] = per_family.get(family, 0.0) + p.value
+            n_min = p.n if n_min is None else min(n_min, p.n)
+    return {"value": round(total, 3), "lo": round(lo, 3),
+            "hi": round(hi, 3), "n_min": n_min,
+            "per_family": {k: round(v, 3) for k, v in per_family.items()}}
+
+
+def holdout_mape(corpus: CostCorpus, target: str,
+                 holdout_frac: float = 0.3, seed: int = 7,
+                 min_rows: Optional[int] = None) -> Optional[float]:
+    """Mean absolute percentage error on a random holdout split of one
+    target's corpus rows — the continuous scorecard `bench.py costmodel`
+    reports. None when the target has too few rows to split."""
+    rows = corpus.rows(target)
+    if len(rows) < 10:
+        return None
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(rows))
+    n_hold = max(1, int(len(rows) * holdout_frac))
+    hold = [rows[i] for i in idx[:n_hold]]
+    train = [rows[i] for i in idx[n_hold:]]
+    model = CostModel(min_rows=min_rows if min_rows is not None else 1)
+    model.fit_target(target, train)
+    fit = model.targets.get(target)
+    if fit is None:
+        return None
+    errs = []
+    for r in hold:
+        v = float(r["value"])
+        if v <= 0:
+            continue
+        p = fit.predict(r["features"])
+        errs.append(abs(p.value - v) / v)
+    return float(np.mean(errs)) if errs else None
+
+
+def main(argv=None) -> int:
+    """``python -m transmogrifai_tpu.perf.model fit [--out model.json]``
+    fits from the active corpus and reports per-target row counts +
+    holdout MAPE; ``predict <target> k=v ...`` prints one prediction."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m transmogrifai_tpu.perf.model")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    fit_p = sub.add_parser("fit")
+    fit_p.add_argument("--out", help="save the fitted model JSON here")
+    pred_p = sub.add_parser("predict")
+    pred_p.add_argument("target")
+    pred_p.add_argument("kv", nargs="+", help="feature=value pairs")
+    args = parser.parse_args(argv)
+    corpus = get_corpus()
+    if corpus is None:
+        print(json.dumps({"error": "perf model disabled"}))
+        return 1
+    if args.cmd == "fit":
+        model = fit_corpus(corpus)
+        out: Dict[str, Any] = {"corpus": corpus.path, "targets": {}}
+        for t, f in model.targets.items():
+            out["targets"][t] = {
+                "rows": f.n,
+                "holdout_mape": holdout_mape(corpus, t)}
+        if args.out:
+            model.save(args.out)
+            out["saved"] = args.out
+        print(json.dumps(out))
+        return 0
+    model = get_model()
+    feats = {}
+    for kv in args.kv:
+        k, _, v = kv.partition("=")
+        feats[k] = float(v)
+    p = model.predict(args.target, feats) if model is not None else None
+    print(json.dumps({"target": args.target, "features": feats,
+                      "prediction": p.to_json() if p else None}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -60,6 +60,9 @@ class SweepJournal:
         self._rows: Dict[str, List[float]] = {}
         self._durations: Dict[str, float] = {}  # key -> block wall seconds
         self._grids: Dict[str, Dict[str, Any]] = {}  # key -> grid config
+        # key -> static-signature facts (cost-model training features,
+        # perf/corpus.harvest_journal)
+        self._facts: Dict[str, Dict[str, Any]] = {}
         self._header_written = False
         self._load()
 
@@ -85,6 +88,7 @@ class SweepJournal:
         rows: Dict[str, List[float]] = {}
         durations: Dict[str, float] = {}
         grids: Dict[str, Dict[str, Any]] = {}
+        facts: Dict[str, Dict[str, Any]] = {}
         header_ok = False
         valid_bytes = 0   # length of the intact, newline-terminated prefix
         saw_record_line = False
@@ -135,6 +139,8 @@ class SweepJournal:
                     durations[key] = float(dur)
                 if isinstance(rec.get("grid"), dict):
                     grids[key] = rec["grid"]
+                if isinstance(rec.get("facts"), dict):
+                    facts[key] = rec["facts"]
             valid_bytes += len(bline)
         if valid_bytes < len(raw):
             log.warning("sweep journal %s: torn record after %d intact "
@@ -160,6 +166,7 @@ class SweepJournal:
         self._rows = rows
         self._durations = durations
         self._grids = grids
+        self._facts = facts
         # only a validated header makes appends skip re-writing it — an
         # empty or header-torn file must get a fresh header first
         self._header_written = header_ok
@@ -187,6 +194,18 @@ class SweepJournal:
             return [(self._grids[k], list(self._rows[k]))
                     for k in self._rows if k in self._grids]
 
+    def records(self) -> List[Dict[str, Any]]:
+        """Every journaled record as a dict (grid, fold_metrics,
+        duration_s, facts) — the cost-model harvest view
+        (`perf/corpus.harvest_journal` reads raw files; this is the
+        in-process equivalent)."""
+        with self._lock:
+            return [{"grid": self._grids.get(k),
+                     "fold_metrics": list(self._rows[k]),
+                     "duration_s": self._durations.get(k),
+                     "facts": self._facts.get(k)}
+                    for k in self._rows]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._rows)
@@ -203,12 +222,17 @@ class SweepJournal:
 
     def append(self, grid: Dict[str, Any], fold_metrics: List[float],
                best: Optional[Dict[str, Any]] = None,
-               duration_s: Optional[float] = None) -> None:
+               duration_s: Optional[float] = None,
+               facts: Optional[Dict[str, Any]] = None) -> None:
         """Record one completed grid-config block. Idempotent per config;
         never raises (journaling is an optimization — a full disk must
         degrade resume granularity, not kill the sweep). `duration_s`
         stamps the block's wall cost so a resume can report how much
-        work the journal saved (goodput resume-skip accounting)."""
+        work the journal saved (goodput resume-skip accounting).
+        `facts` carries the block's static-signature feature dict
+        (family, grid shape, matrix dims — `perf/features.py`) so a
+        journal written by ANY run is a cost-model training source
+        (`perf/corpus.harvest_journal`), resumed runs included."""
         key = self.key_of(grid)
         with self._lock:
             if key in self._rows:
@@ -219,6 +243,8 @@ class SweepJournal:
                 "best": best}
             if duration_s is not None:
                 rec["duration_s"] = round(float(duration_s), 6)
+            if facts is not None:
+                rec["facts"] = facts
             try:
                 if not self._header_written:
                     dirname = os.path.dirname(self.path)
@@ -236,6 +262,8 @@ class SweepJournal:
             self._grids[key] = grid
             if duration_s is not None:
                 self._durations[key] = float(duration_s)
+            if facts is not None:
+                self._facts[key] = facts
 
 
 # --------------------------------------------------------------------------- #
@@ -267,9 +295,13 @@ class _ShardWriter:
 
     def append(self, grid: Dict[str, Any], fold_metrics: List[float],
                best: Optional[Dict[str, Any]] = None,
-               duration_s: Optional[float] = None) -> None:
+               duration_s: Optional[float] = None,
+               facts: Optional[Dict[str, Any]] = None) -> None:
         self._shard.append(grid, fold_metrics, best=best,
-                           duration_s=duration_s)
+                           duration_s=duration_s, facts=facts)
+
+    def records(self) -> List[Dict[str, Any]]:
+        return self._parent.records()
 
     def __len__(self) -> int:
         return len(self._parent)
@@ -368,13 +400,22 @@ class ShardedSweepJournal:
                 seen.setdefault(SweepJournal.key_of(g), (g, row))
         return list(seen.values())
 
+    def records(self) -> List[Dict[str, Any]]:
+        seen: Dict[str, Dict[str, Any]] = {}
+        for sj in self._all():
+            for rec in sj.records():
+                if isinstance(rec.get("grid"), dict):
+                    seen.setdefault(SweepJournal.key_of(rec["grid"]), rec)
+        return list(seen.values())
+
     def append(self, grid: Dict[str, Any], fold_metrics: List[float],
                best: Optional[Dict[str, Any]] = None,
-               duration_s: Optional[float] = None) -> None:
+               duration_s: Optional[float] = None,
+               facts: Optional[Dict[str, Any]] = None) -> None:
         """Single-writer convenience (callers outside a scheduler worker
         context append to shard 0)."""
         self.shard(0).append(grid, fold_metrics, best=best,
-                             duration_s=duration_s)
+                             duration_s=duration_s, facts=facts)
 
     def __len__(self) -> int:
         seen: set = set()

@@ -61,6 +61,47 @@ def bucket_ladder(max_batch: int, min_bucket: int = 1) -> Tuple[int, ...]:
     return tuple(ladder)
 
 
+def derive_ladder(max_batch: int, min_bucket: int = 1,
+                  sizes: Optional[List[int]] = None, model=None,
+                  pad_tolerance: float = 0.08) -> Tuple[int, ...]:
+    """Bucket ladder from the OBSERVED request-size distribution plus
+    the cost model's predicted per-bucket latency (`perf/`).
+
+    Cold start (no model, or the ``serving_bucket`` target unfitted, or
+    no observed sizes yet): EXACTLY ``bucket_ladder(max_batch,
+    min_bucket)`` — today's power-of-two heuristic, bit for bit.
+
+    Warm: candidate rungs are the power-of-two ladder plus the p50/p90/
+    p99 of the observed sizes (rounded up), and a rung survives only if
+    padding its requests up to the NEXT surviving rung would cost more
+    than `pad_tolerance` predicted latency — on hardware where latency
+    is flat across neighboring shapes, rungs collapse and the jit cache
+    holds fewer programs; where latency climbs steeply, the
+    traffic-shaped rungs stay. ``max_batch`` is always the top rung
+    (every admitted request must fit)."""
+    base = bucket_ladder(max_batch, min_bucket)
+    if model is None or not sizes:
+        return base
+    import numpy as np
+    qs = np.quantile(np.asarray(sizes, dtype=float), (0.5, 0.9, 0.99))
+    cand = sorted({*base,
+                   *(min(max_batch, max(min_bucket, int(np.ceil(q))))
+                     for q in qs)})
+    preds = {}
+    for b in cand:
+        p = model.predict("serving_bucket", {"bucket": float(b)})
+        if p is None:
+            return base  # cold target: today's ladder exactly
+        preds[b] = p.value
+    keep = [cand[-1]]  # the cap must always be reachable
+    for b in reversed(cand[:-1]):
+        if preds[keep[-1]] > (1.0 + pad_tolerance) * preds[b]:
+            keep.append(b)
+        # else: padding b-row batches up to the next rung is within
+        # tolerance — drop the rung (one fewer compiled shape)
+    return tuple(sorted(keep))
+
+
 def bucket_for(n_rows: int, ladder: Tuple[int, ...]) -> int:
     """Smallest bucket >= n_rows; raises when no bucket fits."""
     for b in ladder:

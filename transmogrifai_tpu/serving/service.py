@@ -29,6 +29,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -40,7 +41,7 @@ from transmogrifai_tpu.obs.metrics import MetricsRegistry
 from transmogrifai_tpu.obs.trace import TRACER
 from transmogrifai_tpu.serving.batcher import (
     MicroBatcher, Request, ScoreError, bucket_for, bucket_ladder,
-    pad_requests)
+    derive_ladder, pad_requests)
 from transmogrifai_tpu.workflow.compiled import slice_result_tree
 
 log = logging.getLogger(__name__)
@@ -59,6 +60,11 @@ class ServingConfig:
     default_deadline_ms: float = 2000.0  # per-request deadline
     warm_on_load: bool = True      # AOT-compile every bucket at load
     keep_versions: int = 2         # live + rollback
+    # derive the ladder from observed request sizes + the cost model's
+    # predicted per-bucket latency once enough traffic has been seen
+    # (serving/batcher.derive_ladder; a cold model keeps the power-of-
+    # two ladder exactly). Ignored when `buckets` is explicit.
+    auto_ladder: bool = False
     # optional data/feature_cache.py policy (a FeatureCacheParams JSON
     # dict) installed as the process default at service construction:
     # any store-backed scoring this process runs through the
@@ -222,6 +228,15 @@ class ScoringService:
         self._started_mono = time.monotonic()  # uptime arithmetic (L009)
         self._trace_parent = None  # span the batcher thread nests under
         self._schema: Dict[str, type] = {}
+        # observed request-size distribution (rows per request): the
+        # sample `derive_ladder` shapes the bucket ladder from
+        self._sizes: deque = deque(maxlen=4096)
+        self._auto_done = False   # an auto rebucket landed
+        self._auto_seen = 0       # batches processed (auto trigger)
+        self._auto_next = 256     # next attempt threshold
+        # serializes ladder derivation+warm+swap: a slow warm must not
+        # overlap a second derivation computed from the stale ladder
+        self._rebucket_lock = threading.Lock()
         self._init_metrics()
         if self.config.feature_cache:
             # device-matrix cache policy for this serving process: warm
@@ -365,6 +380,7 @@ class ScoringService:
                     f"deadline_ms must be a number, got {deadline_ms!r}")
         deadline = (time.monotonic() + ddl_ms / 1000.0) if ddl_ms > 0 \
             else None
+        self._sizes.append(len(ds))
         req = Request(ds, deadline)
         try:
             self._batcher.put(req)
@@ -448,6 +464,83 @@ class ScoringService:
         return {"status": "rolled_back", "version": restored.version_id,
                 "previous": demoted.version_id}
 
+    # -- learned bucket ladder ---------------------------------------------- #
+
+    def suggest_ladder(self) -> Tuple[int, ...]:
+        """The ladder the cost model + observed request sizes would
+        pick right now (`serving/batcher.derive_ladder`). With an
+        explicit `buckets` config, a cold model, or no traffic yet,
+        this is the current ladder unchanged."""
+        if self.config.buckets:
+            return self.ladder
+        try:
+            from transmogrifai_tpu import perf
+            model = perf.get_model()
+        except Exception:
+            model = None
+        return derive_ladder(self.config.max_batch, self.config.min_bucket,
+                             list(self._sizes), model)
+
+    def rebucket(self) -> Dict[str, Any]:
+        """Re-derive the bucket ladder from observed traffic + predicted
+        per-bucket latency and swap it in under traffic: new rungs are
+        AOT-warmed on the active version OFF the serving path first, so
+        the scoring thread never compiles mid-request. The top rung
+        (max_batch) never changes, so admission capacity is stable.
+        Serialized: concurrent rebuckets (auto + manual) would each
+        derive from the same stale ladder and double-swap."""
+        with self._rebucket_lock:
+            return self._rebucket_locked()
+
+    def _rebucket_locked(self) -> Dict[str, Any]:
+        new = tuple(self.suggest_ladder())
+        if new == tuple(self.ladder):
+            return {"status": "unchanged", "ladder": list(self.ladder)}
+        fresh = tuple(b for b in new if b not in self.ladder)
+        if self.config.warm_on_load and fresh:
+            with self._swap_lock:
+                versions = list(self._versions)
+            for version in versions:
+                # EVERY resident version, not just the active one: a
+                # post-rebucket rollback() must stay 'already warm — no
+                # compile', so the demoted version needs the new rungs
+                # compiled too
+                version.warm(fresh, self.warm_rows)
+        old = self.ladder
+        with self._swap_lock:
+            self.ladder = new
+        self.registry.counter(
+            "serving_rebuckets_total",
+            "bucket-ladder re-derivations applied").inc()
+        log.info("serving: bucket ladder rebucketed %s -> %s",
+                 list(old), list(new))
+        try:
+            from transmogrifai_tpu.obs.export import record_event
+            record_event("ladder_rebucket", previous=list(old),
+                         ladder=list(new))
+        except Exception:
+            log.debug("rebucket event emission failed", exc_info=True)
+        return {"status": "rebucketed", "ladder": list(new),
+                "previous": list(old)}
+
+    def _auto_rebucket(self) -> None:
+        if not self._rebucket_lock.acquire(blocking=False):
+            return  # a previous attempt is still deriving/warming
+        try:
+            # refit from the corpus first: the serving_bucket rows this
+            # process has been recording are younger than the cached
+            # model's refit cadence, and a stale fit derives the cold
+            # (unchanged) ladder
+            from transmogrifai_tpu import perf
+            perf.refresh()
+            if self._rebucket_locked()["status"] == "rebucketed":
+                self._auto_done = True
+        except Exception:
+            log.warning("serving: auto rebucket failed; ladder unchanged",
+                        exc_info=True)
+        finally:
+            self._rebucket_lock.release()
+
     # -- introspection ----------------------------------------------------- #
 
     def health(self) -> Dict[str, Any]:
@@ -474,6 +567,21 @@ class ScoringService:
                     "request deadline passed while queued"))
             if not batch:
                 continue
+            self._auto_seen += 1
+            if (self.config.auto_ladder and not self._auto_done
+                    and not self.config.buckets
+                    and self._auto_seen >= self._auto_next):
+                # deferred rebucket once the size sample is dense enough;
+                # off-thread — warming new rungs must not stall the
+                # scoring loop. RETRIED every ~512 batches until one
+                # lands: at the first attempt the cached model is often
+                # still cold on the serving target (its fit predates the
+                # bucket rows this very traffic recorded), and a one-shot
+                # flag would silently disable the feature forever.
+                self._auto_next = self._auto_seen + 512
+                threading.Thread(target=self._auto_rebucket,
+                                 name="serving-rebucket",
+                                 daemon=True).start()
             try:
                 self._process(batch)
             except Exception as e:  # the scoring thread must NEVER die
@@ -535,6 +643,13 @@ class ScoringService:
 
     def _account_batch(self, n_requests: int, n_valid: int, bucket: int,
                        latency_s: float) -> None:
+        # cost-model corpus row (sampled) + predicted-vs-measured
+        # residual for this bucket's compiled shape; never raises
+        try:
+            from transmogrifai_tpu import perf
+            perf.note_serving(bucket, latency_s)
+        except Exception:
+            log.debug("perf serving recording failed", exc_info=True)
         self._m_batches.inc()
         self._m_rows.inc(n_valid)
         self._m_pad_rows.inc(bucket - n_valid)

@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional
 
 from transmogrifai_tpu.continual.params import ContinualParams
 from transmogrifai_tpu.data.feature_cache import FeatureCacheParams
+from transmogrifai_tpu.perf.params import PerfModelParams
 
 
 @dataclass
@@ -59,13 +60,17 @@ class ServingParams:
     default_deadline_ms: float = 2000.0
     warm_on_load: bool = True
     keep_versions: int = 2
+    # derive the bucket ladder from observed request sizes + the cost
+    # model's predicted per-bucket latency (serving/batcher.derive_ladder)
+    auto_ladder: bool = False
     # FeatureCacheParams JSON dict: installed as the serving process's
     # device-matrix cache policy (resident matrices survive hot-swaps)
     feature_cache: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("host", "port", "max_batch", "min_bucket", "buckets",
                "max_queue", "batch_wait_ms", "default_deadline_ms",
-               "warm_on_load", "keep_versions", "feature_cache")
+               "warm_on_load", "keep_versions", "auto_ladder",
+               "feature_cache")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "ServingParams":
@@ -86,6 +91,7 @@ class ServingParams:
             default_deadline_ms=self.default_deadline_ms,
             warm_on_load=self.warm_on_load,
             keep_versions=self.keep_versions,
+            auto_ladder=self.auto_ladder,
             feature_cache=self.feature_cache)
 
 
@@ -206,6 +212,10 @@ class OpParams:
     # continuous-training loop thresholds (continual/params.py): drift
     # triggers, warm-refit budget, promotion gate, rollback policy
     continual: Optional[ContinualParams] = None
+    # learned cost model (perf/): corpus/model locations and the knobs
+    # it drives (scheduler block sizing, HBM gate); installed for the
+    # train's extent by `Workflow.train()` like the feature cache
+    perf_model: Optional[PerfModelParams] = None
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -220,6 +230,8 @@ class OpParams:
         mesh = MeshParams.from_json(d["mesh"]) if d.get("mesh") else None
         continual = (ContinualParams.from_json(d["continual"])
                      if d.get("continual") else None)
+        perf_model = (PerfModelParams.from_json(d["perf_model"])
+                      if d.get("perf_model") else None)
         return OpParams(
             stage_params=dict(d.get("stage_params") or {}),
             reader_params=readers,
@@ -237,7 +249,8 @@ class OpParams:
             sweep_checkpoint=sweep_ckpt,
             mesh=mesh,
             feature_cache=feature_cache,
-            continual=continual)
+            continual=continual,
+            perf_model=perf_model)
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -267,6 +280,8 @@ class OpParams:
                               if self.feature_cache else None),
             "continual": (self.continual.to_json()
                           if self.continual else None),
+            "perf_model": (self.perf_model.to_json()
+                           if self.perf_model else None),
         }
 
 

@@ -158,9 +158,14 @@ class Workflow:
         process-default device-matrix cache policy for the train's
         extent (`data/feature_cache.py`), so any big-data matrix built
         under this train — selector sweeps, out-of-core fits — resolves
-        the run's cache policy without per-call plumbing."""
+        the run's cache policy without per-call plumbing. An OpParams
+        ``perf_model`` config installs the same way (`perf/params.py`):
+        the learned cost model's corpus location and tuning knobs apply
+        to every scheduler/sweep/ingest decision under this train."""
         from transmogrifai_tpu.data.feature_cache import cache_scope
-        with cache_scope(self.parameters.get("feature_cache")):
+        from transmogrifai_tpu.perf.params import params_scope
+        with cache_scope(self.parameters.get("feature_cache")), \
+                params_scope(self.parameters.get("perf_model")):
             return self._train_impl(dataset, seed, mesh, strict)
 
     def _train_impl(self, dataset: Optional[Dataset], seed: int,
