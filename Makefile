@@ -15,8 +15,9 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-.PHONY: lint serve-smoke ingest-smoke faults-smoke trace-smoke \
-	cache-smoke multichip-smoke continual-smoke costmodel-smoke test check
+.PHONY: lint serve-smoke fleet-smoke ingest-smoke faults-smoke \
+	trace-smoke cache-smoke multichip-smoke continual-smoke \
+	costmodel-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -49,6 +50,17 @@ ingest-smoke:
 # no-op) -> clean shutdown. See transmogrifai_tpu/serving/smoke.py.
 serve-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.smoke
+
+# fleet-serving smoke: three models (two same-shaped, one different)
+# across two tenants in ONE process — the same-shaped pair shares
+# compiled bucket programs (zero new traces, RetraceMonitor-asserted),
+# the over-quota tenant collects the only 429s under mixed HTTP load,
+# a rolling swap of one model drops zero in-flight requests for the
+# others, and cold-start-to-first-score is measured without and with
+# the persistent compile cache + warmup manifest. See
+# transmogrifai_tpu/serving/fleet_smoke.py.
+fleet-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.fleet_smoke
 
 # distributed-sweep smoke: on 8 forced host devices, a 2-family grid
 # sweep scheduled across the mesh must return the bit-identical winner
@@ -89,5 +101,5 @@ costmodel-smoke:
 test:
 	@$(TIER1)
 
-check: lint serve-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
-	multichip-smoke continual-smoke costmodel-smoke test
+check: lint serve-smoke fleet-smoke ingest-smoke cache-smoke faults-smoke \
+	trace-smoke multichip-smoke continual-smoke costmodel-smoke test

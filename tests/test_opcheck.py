@@ -923,3 +923,62 @@ def test_lint_l013_not_flagged_outside_hot_paths():
     assert not any(
         f.code == "L013"
         for f in L.lint_source(src, path="tests/test_x.py"))
+
+
+def test_lint_l014_service_in_loop_and_handler():
+    """L014: ScoringService/FleetService construction inside a loop body
+    or an HTTP request-handler method — per-request construction pays
+    model load + full-ladder AOT warmup on the latency path and defeats
+    the fleet's shared-program registry."""
+    src = '''
+for path in paths:
+    svc = ScoringService.from_path(path)    # loop body: flagged
+
+while waiting():
+    fleet = FleetService(cfg)               # flagged
+
+class Handler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        svc = serving.ScoringService(model)  # request handler: flagged
+        svc.score(rows)
+
+def handle_request(body):
+    return ScoringService.from_path(body["dir"])  # flagged
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L014"]
+    assert len(findings) == 4
+    assert any("request handler `do_POST`" in f.message
+               for f in findings)
+    assert any("loop body" in f.message for f in findings)
+
+
+def test_lint_l014_clean_patterns_not_flagged():
+    """Construct-once-and-route is the sanctioned shape: module level,
+    setup functions, and a loop that merely USES a resident service are
+    all clean; a def nested in a loop resets the loop context."""
+    src = '''
+SVC = ScoringService.from_path("model_dir")
+
+def boot(cfg):
+    fleet = FleetService(cfg)     # one-time setup: clean
+    fleet.start()
+    return fleet
+
+def drive(svc, batches):
+    for rows in batches:
+        svc.score(rows)           # using, not constructing: clean
+
+for name in names:
+    def factory():                # the loop runs the DEF, not the call
+        return ScoringService.from_path(name)
+'''
+    assert not any(f.code == "L014" for f in L.lint_source(src))
+
+
+def test_lint_l014_fleet_member_service_counts_too():
+    src = '''
+def do_GET(self):
+    return FleetMemberService("a", pool, model=m)
+'''
+    findings = [f for f in L.lint_source(src) if f.code == "L014"]
+    assert len(findings) == 1

@@ -116,6 +116,16 @@ silent slowness or nondeterminism once XLA is in the loop:
   model are allowlisted (`_L013_ALLOW`); everything new must route
   through `PerfModelParams`/`OpParams`/an env knob instead.
 
+- ``L014 per-request-service``: a ``ScoringService``/``FleetService``
+  (or ``.from_path``) constructed inside a LOOP body or an HTTP
+  request-handler method (``do_GET``/``do_POST``/``handle*``).
+  Constructing a service is the expensive path by design — model load,
+  compiled-scorer build, AOT warmup of every bucket, shared-program
+  registration — so a per-request or per-iteration construction defeats
+  the warmup AND the fleet's shared-program registry (every instance
+  re-traces its own programs instead of adopting the resident ones).
+  Construct once, `start()`, and route requests through it.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1056,6 +1066,65 @@ def _check_magic_knobs(tree: ast.AST, path: str) -> List[LintFinding]:
     return findings
 
 
+# -- L014: per-request/per-iteration service construction -------------------- #
+
+_L014_SERVICES = ("ScoringService", "FleetService", "FleetMemberService")
+_L014_HANDLER_RE = _re.compile(r"^(do_[A-Z]+|handle\w*)$")
+
+
+def _l014_service_call(call: ast.Call) -> Optional[str]:
+    """The service class name when `call` constructs one (direct
+    constructor or the `from_path` classmethod), else None."""
+    dotted = _dotted(call.func)
+    if dotted is None:
+        return None
+    parts = dotted.split(".")
+    if parts[-1] in _L014_SERVICES:
+        return parts[-1]
+    if len(parts) >= 2 and parts[-1] == "from_path" \
+            and parts[-2] in _L014_SERVICES:
+        return parts[-2]
+    return None
+
+
+def _check_service_construction(tree: ast.AST,
+                                path: str) -> List[LintFinding]:
+    """Flag ScoringService/FleetService construction inside loop bodies
+    or request-handler methods — per-request service construction pays
+    model load + compile + full-ladder AOT warmup on the latency path
+    and bypasses the fleet's shared-program registry."""
+    findings: List[LintFinding] = []
+
+    def visit(node: ast.AST, loop_depth: int, handler: Optional[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def resets loop context (the loop runs the DEF,
+            # not the construction) but keeps handler context only for
+            # its own name
+            handler = node.name if _L014_HANDLER_RE.match(node.name) \
+                else None
+            loop_depth = 0
+        elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            loop_depth += 1
+        elif isinstance(node, ast.Call):
+            svc = _l014_service_call(node)
+            if svc is not None and (loop_depth > 0 or handler):
+                where = ("loop body" if loop_depth > 0
+                         else f"request handler `{handler}`")
+                findings.append(LintFinding(
+                    path, getattr(node, "lineno", 0), "L014",
+                    f"`{svc}(...)` constructed inside a {where} — "
+                    "service construction loads the model, builds the "
+                    "compiled scorer, and AOT-warms every bucket, so a "
+                    "per-request/per-iteration instance defeats warmup "
+                    "and the fleet's shared-program registry; construct "
+                    "once outside and route requests through it"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, loop_depth, handler)
+
+    visit(tree, 0, None)
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1072,6 +1141,7 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_spmd_callbacks(tree, path))
     linter.findings.extend(_check_legacy_np_random(tree, path))
     linter.findings.extend(_check_magic_knobs(tree, path))
+    linter.findings.extend(_check_service_construction(tree, path))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 

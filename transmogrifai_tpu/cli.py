@@ -598,16 +598,20 @@ def cmd_warmup(args) -> int:
 
 def cmd_serve(args) -> int:
     """Boot the online scoring service over a saved model dir (no app
-    factory needed — serving is model-only). Blocks until Ctrl-C.
+    factory needed — serving is model-only), or a multi-model
+    FleetService when `--fleet-config` (or a params `serving.fleet`
+    block) names one. Blocks until Ctrl-C.
 
     `--params` may carry a `serving` section (ServingParams JSON:
-    buckets/queue/deadline knobs); flags override its host/port."""
-    from transmogrifai_tpu.utils.compile_cache import enable_compile_cache
-    enable_compile_cache()
+    buckets/queue/deadline knobs); flags override its host/port. The
+    persistent XLA compile cache defaults ON here (cold replica starts
+    are the production path this command exists for); `--compile-cache
+    off` pins it off."""
     if args.platform:  # must happen before any backend init
         import jax
         jax.config.update("jax_platforms", args.platform)
-    from transmogrifai_tpu.serving.http import serve as http_serve
+    from transmogrifai_tpu.serving.http import (
+        serve as http_serve, serve_fleet)
     from transmogrifai_tpu.serving.service import ScoringService
     from transmogrifai_tpu.workflow.params import OpParams, ServingParams
 
@@ -619,6 +623,44 @@ def cmd_serve(args) -> int:
         sp.port = args.port
     if args.max_batch is not None:
         sp.max_batch = args.max_batch
+    if args.compile_cache:
+        sp.compile_cache = args.compile_cache == "on"
+    elif sp.compile_cache is None:
+        sp.compile_cache = True
+    if args.compile_cache_dir:
+        sp.compile_cache_dir = args.compile_cache_dir
+
+    fleet_cfg = None
+    if args.fleet_config:
+        from transmogrifai_tpu.serving.fleet import FleetConfig
+        fleet_cfg = FleetConfig.load(args.fleet_config)
+        if fleet_cfg.compile_cache is None:
+            fleet_cfg.compile_cache = sp.compile_cache
+        if fleet_cfg.compile_cache_dir is None:
+            fleet_cfg.compile_cache_dir = sp.compile_cache_dir
+    elif sp.fleet:
+        fleet_cfg = sp.to_fleet_config()
+
+    if fleet_cfg is not None:
+        from transmogrifai_tpu.serving.fleet import FleetService
+        fleet = FleetService(fleet_cfg).start()
+        server, thread = serve_fleet(fleet, host=sp.host, port=sp.port,
+                                     block=False)
+        shared = fleet.pool.report()
+        print(f"fleet serving {len(fleet.models())} model(s) "
+              f"({len(shared)} compiled program set(s)) on "
+              f"http://{sp.host}:{server.port} — Ctrl-C to stop")
+        try:
+            while thread.is_alive():
+                thread.join(1.0)
+        except KeyboardInterrupt:
+            print("shutting down")
+        finally:
+            server.shutdown()
+            server.server_close()
+            fleet.stop()
+        return 0
+
     model_location = args.model_location or params.model_location
     if not model_location:
         raise SystemExit("serve: --model-location (or params."
@@ -774,6 +816,21 @@ def main(argv: Optional[list] = None) -> int:
                          help="largest device batch (top shape bucket)")
     serve_p.add_argument("--platform", choices=["cpu", "tpu"],
                          help="force a JAX backend (before initialization)")
+    serve_p.add_argument(
+        "--fleet-config",
+        help="FleetConfig JSON (serving/fleet.py): host N named models "
+             "in this process with per-tenant quotas/priorities; "
+             "same-shaped models share compiled bucket programs")
+    serve_p.add_argument(
+        "--compile-cache", choices=["on", "off"],
+        help="persistent XLA compilation cache at startup (default on "
+             "for this command): a replica or same-shaped swap warms "
+             "on cache hits instead of recompiling the bucket ladder")
+    serve_p.add_argument(
+        "--compile-cache-dir",
+        help="cache directory for --compile-cache (default "
+             "TRANSMOGRIFAI_TPU_CACHE or "
+             "~/.cache/transmogrifai_tpu/xla-cache)")
     serve_p.set_defaults(fn=cmd_serve)
 
     lint_p = sub.add_parser(

@@ -32,7 +32,10 @@ sweep blocks a resumed run skipped (``journal_resume`` events), and
 ``cache_saved_s`` sums the upload seconds feature-cache hits avoided —
 each artifact records its cold build's wall time, so a warm replay
 reports cold-minus-warm as recovered ingest badput (``cache_hit``
-events from `parallel/bigdata.py`).
+events from `parallel/bigdata.py`); ``compile_cache_saved_s`` sums the
+warmup seconds serving's persistent XLA compile cache recovered vs each
+model's recorded cold warmup (``compile_cache_saved`` events from
+`serving/service.py`).
 
 A ``mesh`` section (present when a distributed sweep ran) rolls up the
 scheduler's ``mesh_utilization`` events: the fraction of workers × wall
@@ -81,6 +84,12 @@ class GoodputReport:
     # predictions this run made and how far off they were. Empty when
     # the model was cold/disabled.
     perf: Dict[str, Any] = field(default_factory=dict)
+    # fleet-serving accounting: rolled up from ``fleet_swap`` events
+    # (one per rolling model swap — wall time plus the PER-TENANT
+    # traffic served/shed during the swap window, the goodput-under-
+    # rolling-swaps number of serving/fleet.py) and ``tenant_shed``
+    # admission events. Empty when no fleet ran in this trace.
+    fleet: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def badput_s(self) -> float:
@@ -111,6 +120,8 @@ class GoodputReport:
             out["continual"] = dict(sorted(self.continual.items()))
         if self.perf:
             out["perf"] = dict(sorted(self.perf.items()))
+        if self.fleet:
+            out["fleet"] = dict(sorted(self.fleet.items()))
         return out
 
     def pretty(self) -> str:
@@ -140,6 +151,9 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
               "hbm_preshrinks": 0, "block_resizes": 0}
     saved = 0.0
     cache_saved = 0.0
+    compile_saved = 0.0
+    compile_hits = 0
+    fleet: Dict[str, Any] = {}
     # mesh rollup accumulators: several schedules (one per selector fit)
     # can land in one trace — utilization averages weighted by each
     # schedule's wall, counters sum
@@ -182,6 +196,35 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
                 counts["cache_hits"] += 1
             elif name == "cache_miss":
                 counts["cache_misses"] += 1
+            elif name == "compile_cache_saved":
+                compile_saved += float(attrs.get("saved_s", 0.0) or 0.0)
+                compile_hits += 1
+            elif name == "fleet_swap":
+                fleet["swaps"] = fleet.get("swaps", 0) + 1
+                st = str(attrs.get("status") or "unknown")
+                fleet[st] = fleet.get(st, 0) + 1
+                fleet["swap_wall_s"] = round(
+                    fleet.get("swap_wall_s", 0.0)
+                    + float(attrs.get("wall_s", 0.0) or 0.0), 6)
+                fleet["requests_during_swaps"] = \
+                    fleet.get("requests_during_swaps", 0) + int(
+                        attrs.get("requests_during_swap", 0) or 0)
+                fleet["shed_during_swaps"] = \
+                    fleet.get("shed_during_swaps", 0) + int(
+                        attrs.get("shed_during_swap", 0) or 0)
+                per_tenant = attrs.get("per_tenant") or {}
+                if isinstance(per_tenant, dict):
+                    tenants = fleet.setdefault("tenants", {})
+                    for tname, d in per_tenant.items():
+                        cur = tenants.setdefault(
+                            str(tname), {"requests_during_swaps": 0,
+                                         "shed_during_swaps": 0})
+                        cur["requests_during_swaps"] += int(
+                            (d or {}).get("requests", 0) or 0)
+                        cur["shed_during_swaps"] += int(
+                            (d or {}).get("shed", 0) or 0)
+            elif name == "tenant_shed":
+                fleet["sheds"] = fleet.get("sheds", 0) + 1
             elif name == "fault":
                 counts["faults_injected"] += 1
             elif name == "steal":
@@ -236,6 +279,11 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
         report.savings["resume_saved_s"] = saved
     if cache_saved > 0.0 or counts["cache_hits"]:
         report.savings["cache_saved_s"] = cache_saved
+    if compile_hits:
+        report.savings["compile_cache_saved_s"] = compile_saved
+        counts["compile_cache_hits"] = compile_hits
+    if fleet:
+        report.fleet = fleet
     if mesh:
         mesh["utilization_frac"] = round(
             mesh_busy / mesh_wall, 4) if mesh_wall > 0 else 0.0

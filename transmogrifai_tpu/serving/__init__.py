@@ -9,15 +9,26 @@ fast as the hardware allows"):
 - `batcher`  — bounded queue, deadlines, load-shedding, bucket ladder
 - `service`  — ScoringService: AOT bucket warmup, versioned hot-swap
                with rollback, per-request error quarantine
+- `fleet`    — FleetService: N named models per process, shared bucket
+               programs across same-signature models (ProgramPool),
+               warmup-manifest/persistent-compile cold starts
+- `router`   — per-tenant token-bucket quotas, priority shedding,
+               per-tenant metrics
 - `http`     — /score /healthz /metrics /reload over http.server
+               (single-model `serve` + multi-model `serve_fleet`)
 - `smoke`    — self-contained boot-score-scrape-shutdown check
-               (`make serve-smoke`)
+               (`make serve-smoke`); `fleet_smoke` covers the
+               multi-tenant fleet path (`make fleet-smoke`)
 """
 
 from transmogrifai_tpu.serving.batcher import (  # noqa: F401
     MicroBatcher, Request, ScoreError, bucket_for, bucket_ladder)
+from transmogrifai_tpu.serving.fleet import (  # noqa: F401
+    FleetConfig, FleetService, ProgramPool, scoring_signature)
 from transmogrifai_tpu.serving.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry)
+from transmogrifai_tpu.serving.router import (  # noqa: F401
+    Router, TenantPolicy, TokenBucket)
 from transmogrifai_tpu.serving.service import (  # noqa: F401
     ModelVersion, ScoreResult, ScoringService, ServingConfig)
 
@@ -25,4 +36,6 @@ __all__ = [
     "MicroBatcher", "Request", "ScoreError", "bucket_for", "bucket_ladder",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "ModelVersion", "ScoreResult", "ScoringService", "ServingConfig",
+    "FleetConfig", "FleetService", "ProgramPool", "scoring_signature",
+    "Router", "TenantPolicy", "TokenBucket",
 ]

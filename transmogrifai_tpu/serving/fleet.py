@@ -1,0 +1,623 @@
+"""FleetService: multi-model tenancy with shared bucket programs.
+
+The reference's production story is many models served to many tenants
+(TF-Serving's multi-model servables, arxiv 1605.08695); until this
+module one process served exactly ONE `WorkflowModel` and every cold
+start re-traced and re-compiled the whole bucket ladder. A
+`FleetService` hosts N named models in one process, each member keeping
+the full `ScoringService` contract (own micro-batcher and scoring
+thread, versioned hot-swap with resident rollback, per-request error
+quarantine), and adds the two fleet-scale mechanisms:
+
+**Shared bucket programs.** Two models whose scoring-segment static
+signature agrees compile ONE set of bucket programs — keyed the same
+way `parallel/sweep.static_signature` keys compile groups: everything
+that shapes the traced program goes into the key, everything that flows
+as a traced ARGUMENT stays out. Concretely (`scoring_signature`): the
+canonical device/host segment wiring with uids replaced by traversal
+indices, each stage's class + fitted params — where a stage that routes
+its fitted arrays through `device_constants()` (the tree families, the
+megabyte tables that dominate compile time) contributes only their
+SHAPES/dtypes, because those arrays are jit arguments, while fitted
+state a `device_apply` reads off `self` is a closure constant baked
+into the XLA program and is therefore value-digested. The upshot: K
+replicas of one artifact, and K tree-family models that differ only in
+tree-table values (e.g. a continual warm-refit candidate next to its
+parent), all execute the FIRST member's compiled programs — the
+`ProgramPool` rewires an adopting scorer's segment functions onto the
+reference scorer's jitted callables through a uid-bijection adapter, so
+the second model's warmup performs ZERO new traces
+(`RetraceMonitor.delta()`-asserted in tests and `make fleet-smoke`).
+
+**Persistent-compile cold starts.** `ServingConfig.compile_cache`
+(threaded from `ServingParams`/CLI) turns on JAX's persistent
+compilation cache with a 0-second persistence threshold at service
+construction, and each cold warmup writes an AOT warmup manifest
+(`workflow/serialization.save_warmup_manifest`) beside the model
+artifact recording the ladder, scoring signature, and cold warm wall
+seconds. A replica (or a same-shaped swap) that finds a matching
+manifest reaches first-score on cache hits instead of fresh XLA
+compiles and reports the recovered seconds as
+`serving_compile_cache_saved_s` (+ a `compile_cache_saved` goodput
+event).
+
+Admission and routing (per-tenant token-bucket quotas, priority
+shedding, per-tenant metrics) live in `serving/router.py`; the fleet
+HTTP frontend in `serving/http.py` (`serve_fleet`).
+
+Thread-safety note: adopted members call the reference member's jitted
+callables from their own scoring threads — `jax.jit` executables are
+safe for concurrent invocation; mutation of the member table itself is
+lock-guarded.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.obs.trace import TRACER
+from transmogrifai_tpu.serving.batcher import ScoreError
+from transmogrifai_tpu.serving.router import Router, TenantPolicy
+from transmogrifai_tpu.serving.service import ScoringService, ServingConfig
+
+log = logging.getLogger(__name__)
+
+__all__ = ["FleetConfig", "FleetService", "ProgramPool",
+           "scoring_signature"]
+
+
+# --------------------------------------------------------------------------- #
+# Scoring-segment static signature                                            #
+# --------------------------------------------------------------------------- #
+
+def _canonical_graph(model) -> Tuple[List[Any], List[Any]]:
+    """Deterministic (features, fitted stages) walk of a model graph —
+    the SAME traversal `save_model` serializes with, so two loads of one
+    pipeline shape enumerate in the same order. Returns (feature list,
+    fitted-stage list); uids map to positions in these lists."""
+    feats: Dict[str, Any] = {}
+    order: List[Any] = []
+    for rf in model.result_features:
+        for f in rf.traverse():
+            if f.uid not in feats:
+                feats[f.uid] = f
+                order.append(f)
+    stages: List[Any] = []
+    seen: set = set()
+    for f in order:
+        st = f.origin_stage
+        if st is not None and st.uid not in seen:
+            seen.add(st.uid)
+            stages.append(model.fitted.get(st.uid, st))
+    return order, stages
+
+
+def canonical_uids(model) -> List[str]:
+    """Feature uids then stage uids in canonical order: two models with
+    equal `scoring_signature` zip these lists into the uid bijection the
+    program-sharing adapter remaps argument pytrees with."""
+    order, stages = _canonical_graph(model)
+    return [f.uid for f in order] + [s.uid for s in stages]
+
+
+def _digest_value(v: Any, shape_only: bool) -> Any:
+    """Canonical JSON-able form of one fitted-param value. Arrays under
+    `shape_only` (the stage ships them as `device_constants()` jit
+    arguments) contribute shape+dtype; otherwise their BYTES are hashed
+    — they are closure constants of the traced program, so their values
+    are part of the compile key."""
+    if isinstance(v, dict):
+        return {str(k): _digest_value(x, shape_only)
+                for k, x in sorted(v.items(), key=lambda kv: str(kv[0]))}
+    if isinstance(v, (list, tuple, np.ndarray)) or (
+            hasattr(v, "shape") and hasattr(v, "dtype")):  # jax arrays too
+        try:
+            arr = np.asarray(v)
+        except Exception:
+            arr = None
+        if arr is not None and arr.dtype != object:
+            if shape_only:
+                return ["#array", list(arr.shape), str(arr.dtype)]
+            h = hashlib.sha256(np.ascontiguousarray(arr).tobytes())
+            return ["#array", list(arr.shape), str(arr.dtype),
+                    h.hexdigest()[:16]]
+        return [_digest_value(x, shape_only) for x in v]
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if callable(v):
+        # stable identity only — never the repr (memory addresses drift)
+        return ["#fn", getattr(v, "__module__", "?"),
+                getattr(v, "__qualname__", getattr(v, "__name__", "?"))]
+    return ["#repr", type(v).__name__, str(v)]
+
+
+def _stage_signature(stage) -> Dict[str, Any]:
+    from transmogrifai_tpu.stages.base import (
+        FeatureGeneratorStage, is_host_stage)
+    if isinstance(stage, FeatureGeneratorStage):
+        # generators run on host per batch; only the produced ftype
+        # shapes the device program (raw column NAMES stay out of the
+        # key — renamed tenants still share)
+        return {"kind": "raw"}
+    entry: Dict[str, Any] = {
+        "kind": "host" if is_host_stage(stage) else "device"}
+    consts = None
+    try:
+        consts = stage.device_constants()
+    except Exception:  # unfitted/host stages may not support it
+        consts = None
+    shape_only = consts is not None
+    entry["params"] = _digest_value(stage.get_params(), shape_only)
+    if shape_only:
+        # the consts pytree structure is part of the jit argument
+        # structure even when its values are not
+        entry["consts"] = _digest_value(consts, True)
+    return entry
+
+
+def scoring_signature(model) -> str:
+    """The compile-group key of a model's bucket programs (the serving
+    analogue of `parallel/sweep.static_signature`): a sha256 digest of
+    the canonical scoring graph — segment wiring with uids replaced by
+    traversal indices, stage classes, and fitted state partitioned into
+    traced-argument facts (shape/dtype for `device_constants()` arrays)
+    vs closure-constant facts (value digests for everything a
+    `device_apply` reads off `self`). Two models with equal signatures
+    trace byte-identical XLA programs per bucket and may share one
+    compiled set through the `ProgramPool`."""
+    order, stages = _canonical_graph(model)
+    fidx = {f.uid: i for i, f in enumerate(order)}
+    sidx = {s.uid: i for i, s in enumerate(stages)}
+    doc = {
+        "features": [{
+            "ftype": f.ftype.__name__,
+            "is_response": bool(f.is_response),
+            "origin": (sidx.get(f.origin_stage.uid)
+                       if f.origin_stage is not None else None),
+            "parents": [fidx[p.uid] for p in f.parents],
+        } for f in order],
+        "stages": [{
+            "class": type(s).__name__,
+            "op": s.operation_name,
+            "inputs": [fidx[f.uid] for f in s.input_features],
+            **_stage_signature(s),
+        } for s in stages],
+        "results": [fidx[f.uid] for f in model.result_features],
+    }
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# --------------------------------------------------------------------------- #
+# Program pool                                                                #
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class _PoolEntry:
+    signature: str
+    owner: str                      # "<model>:<version>" of the reference
+    scorer: Any                     # the reference CompiledScorer (alive!)
+    uids: List[str]                 # its canonical uid list
+    members: List[str] = field(default_factory=list)
+
+
+class ProgramPool:
+    """signature -> reference compiled scorer. The first model to
+    register a signature keeps its own jitted segment functions and
+    becomes the REFERENCE; later models with the same signature are
+    ADOPTED: their scorer's segment functions are replaced by adapters
+    that remap every uid-keyed argument pytree (consts / encs /
+    dev_vals) onto the reference's uids, invoke the reference's
+    already-compiled program, and remap the outputs back. Values that
+    differ between members (device_constants arrays, host_prepare
+    encodings, raw batch columns) are exactly the values that flow as
+    jit ARGUMENTS, so adoption is numerics-preserving by construction;
+    everything baked into the trace is signature-equal.
+
+    The entry holds the reference scorer, so its programs outlive the
+    reference model's own serving lifecycle (unloading the reference
+    member never invalidates its adoptees)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _PoolEntry] = {}
+
+    def adopt_or_register(self, member: str, model,
+                          scorer) -> Optional[str]:
+        """Register `scorer` as the reference for its signature, or
+        adopt it onto an existing reference. Returns the reference
+        owner's member id when adopted, None when this scorer IS the
+        reference."""
+        sig = scoring_signature(model)
+        uids = canonical_uids(model)
+        with self._lock:
+            entry = self._entries.get(sig)
+            if entry is None:
+                self._entries[sig] = _PoolEntry(
+                    signature=sig, owner=member, scorer=scorer,
+                    uids=uids, members=[member])
+                scorer.program_signature = sig
+                return None
+            entry.members.append(member)
+        self._adopt(scorer, uids, entry)
+        scorer.program_signature = sig
+        scorer.shared_from = entry.owner
+        log.info("fleet: %s adopts bucket programs of %s (signature %s)",
+                 member, entry.owner, sig)
+        return entry.owner
+
+    @staticmethod
+    def _adopt(scorer, uids: List[str], entry: _PoolEntry) -> None:
+        if len(uids) != len(entry.uids) or \
+                len(scorer.segments) != len(entry.scorer.segments):
+            # signatures collided but graphs disagree structurally —
+            # impossible short of a hash collision; serve solo
+            log.warning("fleet: signature %s structural mismatch; "
+                        "member keeps its own programs", entry.signature)
+            return
+        b2a = dict(zip(uids, entry.uids))
+        a2b = {a: b for b, a in b2a.items()}
+        fns: List[Any] = []
+        for (kind, _), ref_fn in zip(scorer.segments,
+                                     entry.scorer._seg_fns):
+            if kind != "device":
+                fns.append(None)
+                continue
+
+            def adapter(consts, encs, dev_vals, _ref=ref_fn):
+                out = _ref({b2a[k]: v for k, v in consts.items()},
+                           {b2a[k]: v for k, v in encs.items()},
+                           {b2a[k]: v for k, v in dev_vals.items()})
+                return {a2b[k]: v for k, v in out.items()}
+
+            fns.append(adapter)
+        scorer._seg_fns = fns
+
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        """signature -> {owner, members}: the dedup proof surface the
+        fleet exposes on /healthz."""
+        with self._lock:
+            return {sig: {"owner": e.owner, "members": list(e.members)}
+                    for sig, e in self._entries.items()}
+
+
+# --------------------------------------------------------------------------- #
+# Fleet service                                                               #
+# --------------------------------------------------------------------------- #
+
+class FleetMemberService(ScoringService):
+    """One named model inside a fleet: a full ScoringService whose every
+    installed version (initial load, hot-swap candidates) first offers
+    its compiled scorer to the fleet's ProgramPool — so a same-shaped
+    swap candidate adopts the resident programs and warms with zero new
+    traces."""
+
+    def __init__(self, fleet_name: str, pool: ProgramPool, **kw):
+        self._fleet_name = fleet_name
+        self._pool = pool
+        self.shared_from: Optional[str] = None
+        super().__init__(**kw)
+
+    def _install(self, model, version_id: str, path: Optional[str] = None):
+        scorer = model._ensure_compiled()
+        self.shared_from = self._pool.adopt_or_register(
+            f"{self._fleet_name}:{version_id}", model, scorer)
+        return super()._install(model, version_id, path=path)
+
+
+@dataclass
+class FleetConfig:
+    """JSON-loadable fleet layout: named models, tenant policies, shared
+    serving defaults. Example::
+
+        {"models": {"churn": "models/churn",
+                    "churn-eu": {"path": "models/churn_eu",
+                                 "serving": {"max_batch": 32}}},
+         "tenants": {"acme": {"rate": 200, "burst": 400, "priority": 1},
+                     "trial": {"rate": 20, "priority": 0}},
+         "serving": {"max_batch": 16},
+         "compile_cache": true}
+    """
+
+    models: Dict[str, Any] = field(default_factory=dict)
+    tenants: Dict[str, Any] = field(default_factory=dict)
+    # policy for tenants not named above (None = admit unmetered at the
+    # lowest priority, so configured tenants always outrank anonymous
+    # traffic under pressure)
+    default_tenant: Optional[Dict[str, Any]] = None
+    shed_watermark: float = 0.5
+    serving: Dict[str, Any] = field(default_factory=dict)
+    compile_cache: Optional[bool] = None
+    compile_cache_dir: Optional[str] = None
+
+    _FIELDS = ("models", "tenants", "default_tenant", "shed_watermark",
+               "serving", "compile_cache", "compile_cache_dir")
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "FleetConfig":
+        return FleetConfig(**{k: d[k] for k in FleetConfig._FIELDS
+                              if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+    @staticmethod
+    def load(path: str) -> "FleetConfig":
+        with open(path) as fh:
+            return FleetConfig.from_json(json.load(fh))
+
+
+def _model_spec(spec: Any) -> Tuple[str, Dict[str, Any]]:
+    if isinstance(spec, str):
+        return spec, {}
+    if isinstance(spec, dict) and spec.get("path"):
+        return str(spec["path"]), dict(spec.get("serving") or {})
+    raise ValueError(f"fleet model spec must be a path or "
+                     f'{{"path": ...}}: {spec!r}')
+
+
+class FleetService:
+    """N named models, one process. See module docstring.
+
+    Usage::
+
+        fleet = FleetService(FleetConfig(
+            models={"a": "dir_a", "b": "dir_b"},
+            tenants={"acme": {"rate": 100, "priority": 1}}))
+        fleet.start()
+        fleet.score("a", rows, tenant="acme")
+        fleet.reload_model("b", "dir_b_v2")   # others undisturbed
+        fleet.stop()
+    """
+
+    def __init__(self, config: Optional[FleetConfig] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.config = config or FleetConfig()
+        self.registry = registry or MetricsRegistry()
+        self.pool = ProgramPool()
+        self.router = Router(
+            tenants={name: TenantPolicy.from_json(p)
+                     for name, p in (self.config.tenants or {}).items()},
+            default=(TenantPolicy.from_json(self.config.default_tenant)
+                     if self.config.default_tenant else None),
+            shed_watermark=self.config.shed_watermark,
+            registry=self.registry)
+        self._lock = threading.Lock()
+        self._services: Dict[str, FleetMemberService] = {}
+        self._started = False
+        self.started_at = time.time()
+        self._m_models = self.registry.gauge(
+            "fleet_models", "models currently hosted by this process")
+        self._m_shared = self.registry.gauge(
+            "fleet_shared_signatures",
+            "distinct compiled bucket-program sets across all models")
+        for name, spec in (self.config.models or {}).items():
+            path, overrides = _model_spec(spec)
+            self.add_model(name, path, overrides)
+
+    # -- membership -------------------------------------------------------- #
+
+    def _serving_config(self, overrides: Dict[str, Any]) -> ServingConfig:
+        base = dict(self.config.serving or {})
+        base.update(overrides or {})
+        if self.config.compile_cache is not None:
+            base.setdefault("compile_cache", self.config.compile_cache)
+        if self.config.compile_cache_dir is not None:
+            base.setdefault("compile_cache_dir",
+                            self.config.compile_cache_dir)
+        known = {f for f in ServingConfig.__dataclass_fields__}
+        unknown = set(base) - known
+        if unknown:
+            raise ValueError(
+                f"unknown serving config keys: {sorted(unknown)}")
+        return ServingConfig(**base)
+
+    def add_model(self, name: str, path: str,
+                  overrides: Optional[Dict[str, Any]] = None
+                  ) -> FleetMemberService:
+        """Load + warm a model under `name` (programs shared through the
+        pool where signatures agree) and start serving it if the fleet
+        is running. Rejects duplicate names."""
+        from transmogrifai_tpu.workflow.serialization import (
+            load_model, model_fingerprint)
+        cfg = self._serving_config(overrides or {})
+        # reserve the name UNDER the lock before the slow load/warm: a
+        # concurrent duplicate add_model must fail fast, not overwrite a
+        # member whose scoring thread would then leak for the process
+        # lifetime
+        with self._lock:
+            if name in self._services:
+                raise ScoreError("bad_request",
+                                 f"model {name!r} already hosted")
+            self._services[name] = None  # reservation
+        try:
+            model = load_model(path)
+            svc = FleetMemberService(
+                name, self.pool, model=model,
+                version_id=model_fingerprint(path), config=cfg)
+        except BaseException:
+            with self._lock:
+                if self._services.get(name) is None:
+                    self._services.pop(name, None)
+            raise
+        with self._lock:
+            if name not in self._services:
+                # removed (or the whole fleet reconfigured) mid-load:
+                # don't resurrect a member nobody tracks
+                removed = True
+            else:
+                removed = False
+                self._services[name] = svc
+                if self._started:
+                    svc.start()
+        if removed:
+            svc.stop()
+            raise ScoreError("bad_request",
+                             f"model {name!r} was removed while loading")
+        self._note_membership()
+        return svc
+
+    def remove_model(self, name: str) -> None:
+        with self._lock:
+            if name not in self._services:
+                raise ScoreError("not_found", f"no model named {name!r}")
+            svc = self._services.pop(name)
+        if svc is not None:  # None = reservation of an in-flight add
+            svc.stop()
+        self._note_membership()
+
+    def _note_membership(self) -> None:
+        with self._lock:
+            n = sum(1 for s in self._services.values() if s is not None)
+        self._m_models.set(n)
+        self._m_shared.set(len(self.pool.report()))
+
+    def _service(self, name: str) -> FleetMemberService:
+        with self._lock:
+            svc = self._services.get(name)
+        if svc is None:
+            # absent, or a reservation whose load/warm is still running
+            raise ScoreError("not_found",
+                             f"no model named {name!r} (or still loading)")
+        return svc
+
+    # -- lifecycle --------------------------------------------------------- #
+
+    def start(self) -> "FleetService":
+        with self._lock:
+            self._started = True
+            services = [s for s in self._services.values()
+                        if s is not None]
+        for svc in services:
+            svc.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._started = False
+            services = [s for s in self._services.values()
+                        if s is not None]
+        for svc in services:
+            svc.stop(timeout=timeout)
+
+    def __enter__(self) -> "FleetService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- scoring ----------------------------------------------------------- #
+
+    def score(self, model: str, rows: List[Dict[str, Any]],
+              tenant: Optional[str] = None,
+              deadline_ms: Optional[float] = None):
+        """Route one request: resolve the model, pass tenant admission
+        (token-bucket quota + priority shedding against the target
+        model's queue pressure), then score through that model's own
+        micro-batcher. Per-tenant accounting happens here so every
+        member's latency lands in the tenant's labeled series."""
+        svc = self._service(model)
+        queue_frac = svc._batcher.depth() / max(1, svc.config.max_queue)
+        tname = self.router.admit(tenant, len(rows or ()), queue_frac,
+                                  model=model)
+        t0 = time.monotonic()
+        with TRACER.span("fleet:score", category="serving",
+                         tenant=tname, model=model):
+            try:
+                result = svc.score(rows, deadline_ms=deadline_ms)
+            except ScoreError as e:
+                self.router.note_error(tname, model, e.code)
+                raise
+        self.router.note_success(tname, model, len(rows),
+                                 time.monotonic() - t0)
+        return result
+
+    # -- rolling swap ------------------------------------------------------ #
+
+    def reload_model(self, name: str, model_location: str
+                     ) -> Dict[str, Any]:
+        """Rolling swap of ONE member under traffic: the candidate is
+        loaded, pool-adopted (a same-shaped candidate warms with zero
+        new compiles), warmed OFF the serving path, then atomically
+        flipped — in-flight requests on every OTHER model never touch
+        this path at all, and this model's in-flight batches finish on
+        the version they were dispatched with. Emits a `fleet_swap`
+        goodput event carrying the per-tenant traffic served DURING the
+        swap window (the goodput report's rolling-swap accounting)."""
+        svc = self._service(name)
+        before = self.router.snapshot()
+        t0 = time.monotonic()
+        status = "failed"
+        try:
+            result = svc.reload(model_location)
+            status = result.get("status", "swapped")
+        finally:
+            wall = time.monotonic() - t0
+            during = self.router.delta(before)
+            try:
+                from transmogrifai_tpu.obs.export import record_event
+                record_event(
+                    "fleet_swap", model=name, wall_s=round(wall, 6),
+                    status=status,
+                    requests_during_swap=sum(
+                        d.get("requests", 0) for d in during.values()),
+                    shed_during_swap=sum(
+                        d.get("shed", 0) for d in during.values()),
+                    per_tenant=during)
+            except Exception:
+                log.debug("fleet_swap event emission failed",
+                          exc_info=True)
+        if status == "swapped":
+            self.registry.counter(
+                "fleet_swaps_total", "rolling model swaps applied",
+                model=name).inc()
+        self._note_membership()
+        return result
+
+    def rollback_model(self, name: str) -> Dict[str, Any]:
+        return self._service(name).rollback()
+
+    # -- introspection ----------------------------------------------------- #
+
+    def models(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            services = {k: v for k, v in self._services.items()
+                        if v is not None}
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, svc in services.items():
+            health = svc.health()
+            health["shared_from"] = svc.shared_from
+            out[name] = health
+        return out
+
+    def health(self) -> Dict[str, Any]:
+        models = self.models()
+        ok = bool(models) and all(m["status"] == "ok"
+                                  for m in models.values())
+        return {
+            "status": "ok" if (self._started and ok) else "down",
+            "models": models,
+            "tenants": self.router.snapshot(),
+            "shared_programs": self.pool.report(),
+        }
+
+    def metrics_json(self) -> Dict[str, Any]:
+        with self._lock:
+            services = {k: v for k, v in self._services.items()
+                        if v is not None}
+        return {"fleet": self.registry.to_json(),
+                "models": {name: svc.registry.to_json()
+                           for name, svc in services.items()}}

@@ -18,6 +18,13 @@ Endpoints:
   ``?format=json``.
 - ``POST /reload``  ``{"model_location": "dir"}`` hot-swap, or
   ``{"rollback": true}`` to restore the previous version.
+
+The FLEET frontend (``serve_fleet`` / `_FleetHandler`) serves the
+multi-model process (`serving/fleet.py`): ``/score`` takes a ``model``
+name and optional ``tenant`` (body field or ``X-Tenant`` header),
+``/reload`` swaps one named member while the others keep serving, and
+``/healthz`` adds per-model versions, tenant counters, and the
+shared-bucket-program report.
 """
 
 from __future__ import annotations
@@ -35,8 +42,11 @@ log = logging.getLogger(__name__)
 
 _ERROR_STATUS = {
     "queue_full": 429,
+    "quota_exceeded": 429,
+    "shed_low_priority": 429,
     "deadline_exceeded": 504,
     "bad_request": 400,
+    "not_found": 404,
     "record_error": 422,
     "shutdown": 503,
     "internal": 500,
@@ -77,14 +87,10 @@ class ServingHTTPServer(ThreadingHTTPServer):
         return self.server_address[1]
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _JSONHandler(BaseHTTPRequestHandler):
+    """Shared JSON plumbing for the single-model and fleet handlers."""
+
     protocol_version = "HTTP/1.1"
-
-    # -- helpers ----------------------------------------------------------- #
-
-    @property
-    def service(self) -> ScoringService:
-        return self.server.service  # type: ignore[attr-defined]
 
     def log_message(self, fmt: str, *args: Any) -> None:
         log.debug("http: " + fmt, *args)
@@ -112,6 +118,15 @@ class _Handler(BaseHTTPRequestHandler):
         if not isinstance(body, dict):
             raise ScoreError("bad_request", "body must be a JSON object")
         return body
+
+
+class _Handler(_JSONHandler):
+
+    # -- helpers ----------------------------------------------------------- #
+
+    @property
+    def service(self) -> ScoringService:
+        return self.server.service  # type: ignore[attr-defined]
 
     # -- routes ------------------------------------------------------------ #
 
@@ -195,6 +210,169 @@ def _jsonable(v: Any) -> Any:
     if isinstance(v, np.ndarray):
         return v.tolist()
     return str(v)
+
+
+# --------------------------------------------------------------------------- #
+# Fleet frontend                                                              #
+# --------------------------------------------------------------------------- #
+
+def fleet_metrics_text(fleet) -> str:
+    """Prometheus exposition for the fleet `/metrics`: the fleet
+    registry (tenant/model-LABELED series — one family, many labeled
+    series, so N models never collide) plus the process-global
+    registry. Per-model un-labeled serving_* registries are exposed as
+    JSON under `/metrics?format=json` instead — concatenating N copies
+    of the same un-labeled family would be invalid exposition."""
+    from transmogrifai_tpu.obs.metrics import get_registry
+    return fleet.registry.to_prometheus() + get_registry().to_prometheus()
+
+
+def fleet_metrics_json(fleet) -> Dict[str, Any]:
+    from transmogrifai_tpu.obs.metrics import get_registry
+    out = fleet.metrics_json()
+    out["process"] = get_registry().to_json()
+    return out
+
+
+class FleetHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the FleetService reference."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address: Tuple[str, int], fleet):
+        super().__init__(address, _FleetHandler)
+        self.fleet = fleet
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+class _FleetHandler(_JSONHandler):
+    """Fleet routes:
+
+    - ``POST /score``   ``{"model": "name", "rows": [...],
+      "tenant": "acme", "deadline_ms": 500}`` (tenant also accepted via
+      the ``X-Tenant`` header; ``{"row": {...}}`` shorthand works).
+      Adds 429 ``quota_exceeded`` / ``shed_low_priority`` and 404
+      ``not_found`` to the single-model status mapping.
+    - ``GET /healthz``  fleet + per-model health, tenant counters, and
+      the shared-program report (signature -> members).
+    - ``GET /models``   model listing only.
+    - ``GET /metrics``  fleet+process Prometheus text; ``?format=json``
+      nests per-model registries under their names.
+    - ``POST /reload``  ``{"model": "name", "model_location": "dir"}``
+      rolling swap of ONE member, or ``{"model": ..., "rollback":
+      true}``.
+    """
+
+    @property
+    def fleet(self):
+        return self.server.fleet  # type: ignore[attr-defined]
+
+    def do_GET(self) -> None:  # noqa: N802
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            health = self.fleet.health()
+            status = 200 if health["status"] == "ok" else 503
+            self._send_json(status, health)
+        elif path == "/models":
+            self._send_json(200, {"models": self.fleet.models()})
+        elif path == "/metrics":
+            if "format=json" in query:
+                self._send_json(200, fleet_metrics_json(self.fleet))
+            else:
+                self._send(200, fleet_metrics_text(self.fleet).encode(),
+                           content_type="text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": "not_found",
+                                  "message": f"no route {path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = self.path.partition("?")[0]
+        try:
+            body = self._read_json()
+            if path == "/score":
+                self._score(body)
+            elif path == "/reload":
+                self._reload(body)
+            else:
+                self._send_json(404, {"error": "not_found",
+                                      "message": f"no route {path}"})
+        except ScoreError as e:
+            self._send_json(_ERROR_STATUS.get(e.code, 500), e.to_json())
+        except Exception as e:  # keep the server alive on handler bugs
+            log.exception("http: unhandled fleet error on %s", path)
+            self._send_json(500, {"error": "internal",
+                                  "message": f"{type(e).__name__}: {e}"})
+
+    def _score(self, body: Dict[str, Any]) -> None:
+        model = body.get("model")
+        if not model:
+            raise ScoreError("bad_request",
+                             'expected {"model": "name", "rows": [...]}')
+        rows = body.get("rows")
+        if rows is None and "row" in body:
+            rows = [body["row"]]
+        if not isinstance(rows, list) or not rows or \
+                not all(isinstance(r, dict) for r in rows):
+            raise ScoreError("bad_request",
+                             'expected {"rows": [{...}, ...]}')
+        tenant = body.get("tenant") or self.headers.get("X-Tenant")
+        result = self.fleet.score(str(model), rows, tenant=tenant,
+                                  deadline_ms=body.get("deadline_ms"))
+        self._send_json(200, {
+            "scores": result.rows(),
+            "model": model,
+            "model_version": result.model_version,
+            "latency_ms": round(result.latency_s * 1000.0, 3),
+        })
+
+    def _reload(self, body: Dict[str, Any]) -> None:
+        model = body.get("model")
+        if not model:
+            raise ScoreError(
+                "bad_request",
+                'expected {"model": "name", "model_location": "dir"} '
+                'or {"model": "name", "rollback": true}')
+        if body.get("rollback"):
+            self._send_json(200, self.fleet.rollback_model(str(model)))
+            return
+        loc = body.get("model_location")
+        if not loc:
+            raise ScoreError(
+                "bad_request",
+                'expected {"model_location": "dir"} or {"rollback": true}')
+        try:
+            self._send_json(200, self.fleet.reload_model(str(model), loc))
+        except ScoreError:
+            raise
+        except Exception as e:
+            # a bad reload must leave the resident member serving
+            raise ScoreError("bad_request",
+                             f"reload failed, keeping current version: "
+                             f"{type(e).__name__}: {e}")
+
+
+def serve_fleet(fleet, host: str = "127.0.0.1", port: int = 0,
+                block: bool = True
+                ) -> Tuple[FleetHTTPServer, Optional[threading.Thread]]:
+    """Boot the fleet HTTP frontend over a (started) FleetService —
+    same contract as `serve` (port=0 binds a free port; block=False
+    runs on a daemon thread)."""
+    server = FleetHTTPServer((host, port), fleet)
+    if block:
+        try:
+            server.serve_forever(poll_interval=0.2)
+        finally:
+            server.server_close()
+        return server, None
+    thread = threading.Thread(target=server.serve_forever,
+                              kwargs={"poll_interval": 0.2},
+                              name="fleet-http", daemon=True)
+    thread.start()
+    return server, thread
 
 
 def serve(service: ScoringService, host: str = "127.0.0.1",

@@ -1,0 +1,260 @@
+"""Admission + routing for fleet serving: per-tenant quotas, priorities.
+
+The single-model serving layer already degrades gracefully under load
+(bounded queue, deadlines — `serving/batcher.py`); what a MULTI-tenant
+process needs on top is fairness and isolation, decided at admission,
+the cheapest point:
+
+- **token-bucket quotas** per tenant, metered in ROWS per second (a
+  64-row batch spends 64 tokens — requests are not equal work), with a
+  configurable burst so bursty-but-within-rate tenants are not
+  penalized. An over-quota tenant is shed with a structured
+  ``quota_exceeded`` error (HTTP 429) while every other tenant's
+  traffic is untouched;
+- **priority classes**: under queue pressure on the TARGET model, the
+  lowest-priority classes are shed first (``shed_low_priority``, also
+  429) and the highest-priority class is never priority-shed — it still
+  ends at the bounded queue's own ``queue_full`` backstop. Pressure is
+  graded: as the queue fills past ``shed_watermark`` toward capacity,
+  progressively higher classes are shed, top class excepted;
+- **per-tenant metrics**: labeled ``fleet_*`` series (requests, rows,
+  sheds by reason, latency histogram) on the fleet registry, plus a
+  plain-dict ``snapshot()``/``delta()`` used by the rolling-swap
+  goodput accounting (`FleetService.reload_model`).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.serving.batcher import ScoreError
+
+log = logging.getLogger(__name__)
+
+__all__ = ["TenantPolicy", "TokenBucket", "Router"]
+
+DEFAULT_TENANT = "default"
+
+
+@dataclass
+class TenantPolicy:
+    """One tenant's admission contract: sustained rate (rows/second;
+    inf = unmetered), burst capacity (rows; defaults to 2s of rate),
+    and priority class (higher survives pressure longer)."""
+
+    rate: float = math.inf
+    burst: Optional[float] = None
+    priority: int = 0
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "TenantPolicy":
+        return TenantPolicy(
+            rate=float(d.get("rate", math.inf)),
+            burst=(float(d["burst"]) if d.get("burst") is not None
+                   else None),
+            priority=int(d.get("priority", 0)))
+
+    def effective_burst(self) -> float:
+        if self.burst is not None:
+            return max(1.0, self.burst)
+        if math.isinf(self.rate):
+            return math.inf
+        return max(1.0, 2.0 * self.rate)
+
+
+class TokenBucket:
+    """Classic token bucket over a monotonic clock; thread-safe."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float) -> bool:
+        if math.isinf(self.rate):
+            return True
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class _TenantState:
+    __slots__ = ("policy", "bucket", "requests", "rows", "shed", "errors")
+
+    def __init__(self, policy: TenantPolicy):
+        self.policy = policy
+        self.bucket = TokenBucket(policy.rate, policy.effective_burst())
+        self.requests = 0
+        self.rows = 0
+        self.shed = 0
+        self.errors = 0
+
+
+class Router:
+    """Tenant admission + accounting. See module docstring."""
+
+    def __init__(self, tenants: Optional[Dict[str, TenantPolicy]] = None,
+                 default: Optional[TenantPolicy] = None,
+                 shed_watermark: float = 0.5,
+                 registry: Optional[MetricsRegistry] = None,
+                 max_tenants: int = 1024):
+        if not (0.0 < shed_watermark <= 1.0):
+            raise ValueError(
+                f"shed_watermark must be in (0, 1]: {shed_watermark}")
+        self.registry = registry or MetricsRegistry()
+        self.shed_watermark = float(shed_watermark)
+        # unknown tenant names come straight off the wire (X-Tenant):
+        # cap how many may mint per-tenant state + labeled metric series,
+        # or a client cycling random names grows memory and Prometheus
+        # label cardinality without bound; past the cap they share the
+        # DEFAULT_TENANT bucket
+        self.max_tenants = int(max_tenants)
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantState] = {
+            name: _TenantState(p) for name, p in (tenants or {}).items()}
+        # anonymous/unknown tenants: unmetered but LOWEST priority by
+        # default, so configured tenants outrank them under pressure
+        self._default = default or TenantPolicy(
+            rate=math.inf, priority=min(
+                [s.policy.priority for s in self._tenants.values()] + [0]))
+        # priority ladder for graded shedding (top class is exempt)
+        self._levels = sorted({s.policy.priority
+                               for s in self._tenants.values()}
+                              | {self._default.priority})
+
+    # -- admission --------------------------------------------------------- #
+
+    def _state(self, tenant: Optional[str]) -> Tuple[str, "_TenantState"]:
+        name = tenant or DEFAULT_TENANT
+        with self._lock:
+            state = self._tenants.get(name)
+            if state is None:
+                if len(self._tenants) >= self.max_tenants:
+                    # cardinality cap: overflow tenants share the default
+                    # bucket (state AND metric labels) instead of minting
+                    # fresh series per wire-supplied name
+                    name = DEFAULT_TENANT
+                    state = self._tenants.get(DEFAULT_TENANT)
+                    if state is None:
+                        state = _TenantState(self._default)
+                        self._tenants[DEFAULT_TENANT] = state
+                else:
+                    state = _TenantState(self._default)
+                    self._tenants[name] = state
+        return name, state
+
+    def _shed_floor(self, queue_frac: float) -> Optional[int]:
+        """Minimum priority admitted at this queue pressure, or None
+        when below the watermark. Pressure grades linearly from the
+        watermark to full: just past the watermark only the lowest
+        class sheds; approaching capacity everything below the TOP
+        class sheds (the top class is left to the bounded queue's own
+        queue_full backstop — priorities order tenants, they never
+        starve the whole process)."""
+        if len(self._levels) < 2 or queue_frac < self.shed_watermark:
+            return None
+        span = max(1e-9, 1.0 - self.shed_watermark)
+        frac = min(1.0, (queue_frac - self.shed_watermark) / span)
+        k = min(len(self._levels) - 1,
+                1 + int(frac * (len(self._levels) - 1)))
+        return self._levels[k]
+
+    def admit(self, tenant: Optional[str], n_rows: int,
+              queue_frac: float, model: str = "") -> str:
+        """Admission gate: returns the resolved tenant name or raises a
+        structured ScoreError (quota_exceeded / shed_low_priority)."""
+        name, state = self._state(tenant)
+        floor = self._shed_floor(queue_frac)
+        if floor is not None and state.policy.priority < floor:
+            self._shed(name, state, model, "shed_low_priority")
+            raise ScoreError(
+                "shed_low_priority",
+                f"tenant {name!r} (priority {state.policy.priority}) shed "
+                f"under queue pressure ({queue_frac:.0%} of capacity); "
+                "retry with backoff")
+        if not state.bucket.try_take(max(1, int(n_rows))):
+            self._shed(name, state, model, "quota_exceeded")
+            raise ScoreError(
+                "quota_exceeded",
+                f"tenant {name!r} over its row quota "
+                f"({state.policy.rate:g} rows/s, burst "
+                f"{state.bucket.burst:g}); retry after backoff")
+        return name
+
+    def _shed(self, name: str, state: "_TenantState", model: str,
+              reason: str) -> None:
+        with self._lock:
+            state.shed += 1
+        self.registry.counter(
+            "fleet_shed_total", "requests shed at fleet admission",
+            tenant=name, reason=reason).inc()
+        try:
+            from transmogrifai_tpu.obs.export import record_event
+            record_event("tenant_shed", tenant=name, model=model,
+                         reason=reason)
+        except Exception:
+            log.debug("tenant_shed event emission failed", exc_info=True)
+
+    # -- accounting -------------------------------------------------------- #
+
+    def note_success(self, tenant: str, model: str, n_rows: int,
+                     latency_s: float) -> None:
+        _, state = self._state(tenant)
+        with self._lock:
+            state.requests += 1
+            state.rows += int(n_rows)
+        self.registry.counter(
+            "fleet_requests_total", "requests served per tenant/model",
+            tenant=tenant, model=model).inc()
+        self.registry.counter(
+            "fleet_rows_total", "rows scored per tenant",
+            tenant=tenant).inc(int(n_rows))
+        self.registry.histogram(
+            "fleet_request_latency_seconds",
+            "fleet routing + scoring latency per tenant",
+            tenant=tenant).observe(latency_s)
+
+    def note_error(self, tenant: str, model: str, code: str) -> None:
+        _, state = self._state(tenant)
+        with self._lock:
+            state.errors += 1
+        self.registry.counter(
+            "fleet_errors_total", "scoring errors per tenant",
+            tenant=tenant, code=code).inc()
+
+    # -- snapshots (rolling-swap goodput) ----------------------------------- #
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {name: {"requests": s.requests, "rows": s.rows,
+                           "shed": s.shed, "errors": s.errors,
+                           "priority": s.policy.priority}
+                    for name, s in self._tenants.items()}
+
+    def delta(self, before: Dict[str, Dict[str, int]]
+              ) -> Dict[str, Dict[str, int]]:
+        """Per-tenant traffic since `before` (a `snapshot()`); tenants
+        with no movement are omitted."""
+        now = self.snapshot()
+        out: Dict[str, Dict[str, int]] = {}
+        for name, cur in now.items():
+            prev = before.get(name, {})
+            d = {k: cur[k] - prev.get(k, 0)
+                 for k in ("requests", "rows", "shed", "errors")}
+            if any(d.values()):
+                out[name] = d
+        return out

@@ -27,6 +27,7 @@ cross-thread interleaving. Model swap flips one attribute under a lock.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -73,6 +74,20 @@ class ServingConfig:
     # hot-swaps instead of re-uploading after every /reload (the row
     # /score path itself builds no device matrices)
     feature_cache: Optional[Dict[str, Any]] = None
+    # persistent XLA compilation cache (utils/compile_cache.py) enabled
+    # at service construction with a 0s persistence threshold (a bucket
+    # ladder is MANY small programs; a replica's cold start is their
+    # compile-time sum). None = read TRANSMOGRIFAI_SERVING_COMPILE_CACHE
+    # (off when unset — tests and embedded callers stay hermetic);
+    # `cli serve` defaults it ON.
+    compile_cache: Optional[bool] = None
+    compile_cache_dir: Optional[str] = None
+    # write/read the AOT warmup manifest beside each model artifact
+    # (workflow/serialization.save_warmup_manifest): a cold warmup
+    # records its wall seconds + ladder; a later replica (or same-shaped
+    # swap) that matches the manifest reports the recovered compile
+    # seconds as `serving_compile_cache_saved_s`
+    warmup_manifest: bool = True
 
     def ladder(self) -> Tuple[int, ...]:
         if self.buckets:
@@ -122,6 +137,8 @@ class ModelVersion:
         self.loaded_at = time.time()
         self.scorer = model._ensure_compiled()
         self.compile_counts: Dict[int, int] = {}  # bucket -> traces seen
+        self.warm_s: float = 0.0                  # measured warmup wall
+        self.cache_saved_s: Optional[float] = None  # vs manifest cold warm
 
     def warm(self, ladder: Tuple[int, ...],
              warm_rows: Optional[List[Dict[str, Any]]] = None) -> None:
@@ -149,10 +166,14 @@ class ModelVersion:
                 self.compile_counts.get(bucket, 0) + new
 
     def info(self) -> Dict[str, Any]:
-        return {"version": self.version_id, "path": self.path,
-                "loaded_at": self.loaded_at,
-                "compile_counts": {str(k): v
-                                   for k, v in self.compile_counts.items()}}
+        out = {"version": self.version_id, "path": self.path,
+               "loaded_at": self.loaded_at,
+               "warm_s": round(self.warm_s, 6),
+               "compile_counts": {str(k): v
+                                  for k, v in self.compile_counts.items()}}
+        if self.cache_saved_s is not None:
+            out["compile_cache_saved_s"] = round(self.cache_saved_s, 6)
+        return out
 
 
 @dataclass
@@ -237,6 +258,18 @@ class ScoringService:
         # serializes ladder derivation+warm+swap: a slow warm must not
         # overlap a second derivation computed from the stale ladder
         self._rebucket_lock = threading.Lock()
+        # persistent XLA compile cache: resolved BEFORE the first model
+        # install so its warmup compiles land in (or hit) the cache
+        cc = self.config.compile_cache
+        if cc is None:
+            cc = os.environ.get("TRANSMOGRIFAI_SERVING_COMPILE_CACHE",
+                                "").lower() in ("1", "on", "true")
+        self._compile_cache_path: Optional[str] = None
+        if cc:
+            from transmogrifai_tpu.utils.compile_cache import (
+                enable_compile_cache)
+            self._compile_cache_path = enable_compile_cache(
+                self.config.compile_cache_dir, min_compile_s=0.0)
         self._init_metrics()
         if self.config.feature_cache:
             # device-matrix cache policy for this serving process: warm
@@ -293,8 +326,20 @@ class ScoringService:
         """Load-side half of a swap: compile + warm OFF the serving path,
         then atomically flip `_active`."""
         version = ModelVersion(model, version_id, path=path)
+        path = version.path  # falls back to the model's loaded_from
         if self.config.warm_on_load:
+            manifest = None
+            if path and self.config.warmup_manifest:
+                from transmogrifai_tpu.workflow.serialization import (
+                    load_warmup_manifest)
+                manifest = load_warmup_manifest(path)
+                if manifest is not None and (
+                        manifest.get("fingerprint") != version_id
+                        or manifest.get("ladder") != list(self.ladder)):
+                    manifest = None  # stale sidecar: treat as cold
+            t0 = time.perf_counter()
             version.warm(self.ladder, self.warm_rows)
+            version.warm_s = time.perf_counter() - t0
             # bucket label only (no version label): label cardinality must
             # stay bounded by the ladder width, not grow per reload — the
             # per-version breakdown lives in health()['versions'] instead
@@ -303,6 +348,7 @@ class ScoringService:
                     "serving_bucket_compiles_total",
                     "XLA traces attributed to each shape bucket at warmup",
                     bucket=bucket).inc(n)
+            self._note_warmup(version, manifest)
         with self._swap_lock:
             self._versions.append(version)
             keep = max(2, self.config.keep_versions)
@@ -313,6 +359,57 @@ class ScoringService:
             "serving_model_versions", "versions held (active + rollback)"
         ).set(len(self._versions))
         return version
+
+    def _note_warmup(self, version: ModelVersion,
+                     manifest: Optional[Dict[str, Any]]) -> None:
+        """Cold-start accounting around one warmup: with a matching
+        manifest AND the persistent compile cache enabled, the delta to
+        the manifest's recorded cold warmup is the measured recovery
+        (`serving_compile_cache_saved_s` + a `compile_cache_saved`
+        goodput event); a warmup that actually compiled programs with
+        no prior manifest IS the cold baseline and writes one. A warmup
+        absorbed by shared programs (zero traces, no manifest claim)
+        records neither — its near-zero wall must not become a 'cold'
+        baseline that poisons future savings."""
+        n_compiles = sum(version.compile_counts.values())
+        if manifest is not None and self._compile_cache_path \
+                and n_compiles > 0:
+            # n_compiles gate: a warmup absorbed by the fleet's SHARED
+            # programs traces nothing — its near-zero wall against the
+            # manifest's cold baseline is program-sharing's win, not the
+            # compile cache's, and must not be booked here
+            saved = max(0.0, float(manifest.get("warm_s") or 0.0)
+                        - version.warm_s)
+            version.cache_saved_s = saved
+            self.registry.counter(
+                "serving_compile_cache_saved_s",
+                "warmup seconds recovered by the persistent compile "
+                "cache vs the recorded cold warmup").inc(saved)
+            try:
+                from transmogrifai_tpu.obs.export import record_event
+                record_event("compile_cache_saved",
+                             saved_s=round(saved, 6),
+                             warm_s=round(version.warm_s, 6),
+                             model_version=version.version_id)
+            except Exception:
+                log.debug("compile_cache_saved event failed",
+                          exc_info=True)
+        elif (version.path and self.config.warmup_manifest
+                and manifest is None and n_compiles > 0):
+            from transmogrifai_tpu.workflow.serialization import (
+                save_warmup_manifest)
+            save_warmup_manifest(version.path, {
+                "fingerprint": version.version_id,
+                "ladder": list(self.ladder),
+                "warm_s": round(version.warm_s, 6),
+                "compiles": n_compiles,
+                "compile_counts": {str(k): v for k, v
+                                   in version.compile_counts.items()},
+                "signature": getattr(version.scorer,
+                                     "program_signature", None),
+                "compile_cache": bool(self._compile_cache_path),
+                "warmed_at": time.time(),
+            })
 
     # -- lifecycle --------------------------------------------------------- #
 
@@ -551,6 +648,7 @@ class ScoringService:
             "uptime_s": round(time.monotonic() - self._started_mono, 3),
             "queue_depth": self._batcher.depth(),
             "buckets": list(self.ladder),
+            "compile_cache": self._compile_cache_path,
             "versions": [v.info() for v in self._versions],
         }
 

@@ -10,21 +10,47 @@ examples; tests keep the default (CPU compiles are cheap and hermetic).
 
 from __future__ import annotations
 
+import logging
 import os
+
+log = logging.getLogger(__name__)
 
 _DEFAULT = os.path.expanduser("~/.cache/transmogrifai_tpu/xla-cache")
 
+# the JAX compilation cache is PROCESS-GLOBAL config: remember what was
+# applied so a second caller asking for a different dir/threshold gets a
+# loud warning instead of silently re-pointing every other subsystem's
+# compiles (e.g. a serving member reconfiguring under a training run)
+_applied: "tuple | None" = None
 
-def enable_compile_cache(path: str | None = None) -> str | None:
+
+def enable_compile_cache(path: str | None = None,
+                         min_compile_s: float = 0.5) -> str | None:
     """Best-effort: an unwritable HOME/cache dir must never break startup
-    (returns None and leaves JAX's default config in place)."""
+    (returns None and leaves JAX's default config in place).
+
+    `min_compile_s` is the persistence threshold: the 0.5s default skips
+    throwaway programs during training, while the serving layer passes
+    0.0 — a bucket ladder is MANY small programs, and a replica's
+    cold-start-to-first-score is their compile-time SUM, so each one is
+    worth persisting even where a single compile is cheap."""
+    global _applied
     import jax
 
     path = path or os.environ.get("TRANSMOGRIFAI_TPU_CACHE", _DEFAULT)
     try:
         os.makedirs(path, exist_ok=True)
+        if _applied is not None and _applied != (path, float(min_compile_s)):
+            # explicit wins (last caller), but never silently: the config
+            # is process-global, so everyone's compiles move with it
+            log.warning(
+                "compile cache reconfigured process-wide: %s (min %.2fs) "
+                "-> %s (min %.2fs)", _applied[0], _applied[1], path,
+                float(min_compile_s))
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_s))
+        _applied = (path, float(min_compile_s))
         return path
     except OSError:
         return None

@@ -66,11 +66,27 @@ class ServingParams:
     # FeatureCacheParams JSON dict: installed as the serving process's
     # device-matrix cache policy (resident matrices survive hot-swaps)
     feature_cache: Optional[Dict[str, Any]] = None
+    # persistent XLA compilation cache at serving startup
+    # (utils/compile_cache.py, 0s persistence threshold): a replica or
+    # same-shaped swap warms on cache hits instead of recompiling the
+    # bucket ladder; None = TRANSMOGRIFAI_SERVING_COMPILE_CACHE env
+    # (cli `serve` defaults it on)
+    compile_cache: Optional[bool] = None
+    compile_cache_dir: Optional[str] = None
+    # write/read the AOT warmup manifest beside each model artifact so
+    # warm starts report `serving_compile_cache_saved_s`
+    warmup_manifest: bool = True
+    # FleetConfig JSON block (serving/fleet.py): when set, `cli serve`
+    # boots a multi-model FleetService (named models, per-tenant
+    # quotas/priorities, shared bucket programs) instead of the
+    # single-model service
+    fleet: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("host", "port", "max_batch", "min_bucket", "buckets",
                "max_queue", "batch_wait_ms", "default_deadline_ms",
                "warm_on_load", "keep_versions", "auto_ladder",
-               "feature_cache")
+               "feature_cache", "compile_cache", "compile_cache_dir",
+               "warmup_manifest", "fleet")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "ServingParams":
@@ -92,7 +108,33 @@ class ServingParams:
             warm_on_load=self.warm_on_load,
             keep_versions=self.keep_versions,
             auto_ladder=self.auto_ladder,
-            feature_cache=self.feature_cache)
+            feature_cache=self.feature_cache,
+            compile_cache=self.compile_cache,
+            compile_cache_dir=self.compile_cache_dir,
+            warmup_manifest=self.warmup_manifest)
+
+    def to_fleet_config(self):
+        """The serving.fleet.FleetConfig view of the `fleet` block, with
+        the service-level serving knobs as the members' shared defaults
+        (each model spec may still override per-member)."""
+        from transmogrifai_tpu.serving.fleet import FleetConfig
+        if not self.fleet:
+            raise ValueError("serving params carry no `fleet` block")
+        block = dict(self.fleet)
+        serving = {
+            "max_batch": self.max_batch, "min_bucket": self.min_bucket,
+            "buckets": self.buckets, "max_queue": self.max_queue,
+            "batch_wait_ms": self.batch_wait_ms,
+            "default_deadline_ms": self.default_deadline_ms,
+            "warm_on_load": self.warm_on_load,
+            "keep_versions": self.keep_versions,
+            "auto_ladder": self.auto_ladder,
+            "feature_cache": self.feature_cache,
+            "warmup_manifest": self.warmup_manifest,
+            **(block.pop("serving", None) or {})}
+        block.setdefault("compile_cache", self.compile_cache)
+        block.setdefault("compile_cache_dir", self.compile_cache_dir)
+        return FleetConfig.from_json({**block, "serving": serving})
 
 
 @dataclass

@@ -32,7 +32,7 @@ import json
 import logging
 import os
 import shutil
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -50,7 +50,9 @@ log = logging.getLogger(__name__)
 MANIFEST = "op-model.json"
 ARRAYS = "arrays.npz"
 INTEGRITY = "integrity.json"
+WARMUP = "warmup.json"
 VERSION = 1
+WARMUP_VERSION = 1
 INTEGRITY_VERSION = 1
 NPZ_MIN_SIZE = 64  # numeric payloads at/above this many elements offload
 
@@ -258,6 +260,53 @@ def model_fingerprint(path: str) -> str:
             for chunk in iter(lambda: fh.read(1 << 20), b""):
                 h.update(chunk)
     return h.hexdigest()[:12]
+
+
+def save_warmup_manifest(model_dir: str, payload: Dict[str, Any]) -> bool:
+    """Persist an AOT warmup manifest BESIDE a serialized model (the
+    serving layer's cold-start record: bucket ladder, scoring-signature,
+    cold warmup wall seconds, compile counts). Written as
+    `<model_dir>/warmup.json` via tmp-file + atomic rename.
+
+    Deliberately OUTSIDE the integrity manifest: the model artifact is
+    sealed at save time, while this file is operational metadata the
+    serving layer rewrites after each cold warmup (`verify_model_dir`
+    checks only the files the integrity manifest lists, so the sidecar
+    never trips verification). Best-effort: a read-only artifact dir
+    must not break model load/serve — returns False instead of raising."""
+    record = {"warmup_version": WARMUP_VERSION, **payload}
+    path = os.path.join(model_dir, WARMUP)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(record, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        log.debug("warmup manifest write to %s failed", path, exc_info=True)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load_warmup_manifest(model_dir: str) -> Optional[Dict[str, Any]]:
+    """Read the warmup manifest beside a model dir, or None when absent,
+    unreadable, or from a different manifest version (a torn/garbage
+    sidecar means 'cold start', never an error)."""
+    path = os.path.join(model_dir, WARMUP)
+    try:
+        with open(path) as fh:
+            record = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(record, dict) or \
+            record.get("warmup_version") != WARMUP_VERSION:
+        return None
+    return record
 
 
 def _ensure_stage_library() -> None:
