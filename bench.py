@@ -1405,6 +1405,85 @@ def run_fleet() -> None:
                "errors_during_load": dict(errors)})
 
 
+def run_chaos_bench() -> None:
+    """Chaos-mode bench (`python bench.py chaos`): the numbers that make
+    "graceful degradation" falsifiable. Drives the 3-model/2-tenant
+    fleet through the deterministic fault storms of
+    `serving/chaos.run_chaos` (device-error storm -> breaker + degraded
+    fallback, killed scoring thread -> watchdog restart, stalled
+    dispatch -> in-budget recovery, corrupt reload under traffic) plus
+    `run_continual_crash` (a killed continual cycle -> supervisor
+    restart), and emits:
+
+    - ``chaos_mttr_s``: measured HEALTHY->QUARANTINED->HEALTHY recovery
+      of the stormed member, with breaker open/close transition counts
+      and degraded-fallback request counts;
+    - ``chaos_availability`` per tenant:model stream (non-error
+      fraction) + p50/p99 under the storm — the stormed member degrades,
+      the untouched members must hold availability 1.0;
+    - ``chaos_recovery_s``: time-to-structured-answer for the killed
+      and stalled scoring threads vs the configured stall budget;
+    - ``chaos_supervisor_restart``: the continual supervisor surviving
+      a killed cycle."""
+    import tempfile
+
+    from transmogrifai_tpu.serving.chaos import (
+        _train_models, run_chaos, run_continual_crash)
+
+    platform = probe_backend()
+    load_s = float(os.environ.get("BENCH_CHAOS_SECONDS", 4.0))
+    with tempfile.TemporaryDirectory(prefix="bench-chaos-") as tmp:
+        if "TRANSMOGRIFAI_PERF_CORPUS_DIR" not in os.environ:
+            # fleet-bench precedent: a dev machine's accumulated corpus
+            # fires serving-bucket refits mid-window and pollutes p99
+            os.environ["TRANSMOGRIFAI_PERF_CORPUS_DIR"] = \
+                f"{tmp}/perf-corpus"
+        report = run_chaos(_train_models(tmp), seed=0, load_s=load_s)
+        storm = report["storm"]
+        _emit({"metric": "chaos_mttr_s", "platform": platform,
+               "value": storm.get("mttr_s") or 0.0, "unit": "s",
+               "vs_baseline": 0.0, "member": storm["member"],
+               "breaker_opens": storm["breaker_opens"],
+               "breaker_closes": storm["breaker_closes"],
+               "quarantined": storm["quarantined"],
+               "recovered": storm["recovered"],
+               "fallback_requests": storm["fallback_requests"],
+               "fallback_version_responses":
+                   storm["fallback_version_responses"],
+               "faults_fired": storm["fired"],
+               "goodput_resilience": report["goodput_resilience"]})
+        for stream, stats in report["tenants"].items():
+            _emit({"metric": "chaos_availability", "platform": platform,
+                   "value": stats["availability"], "unit": "frac",
+                   "vs_baseline": 0.0, "stream": stream,
+                   "requests": stats["requests"],
+                   "errors": stats["errors"],
+                   "p50_ms": stats["p50_ms"], "p99_ms": stats["p99_ms"]})
+        for scenario in ("kill", "stall"):
+            s = report[scenario]
+            _emit({"metric": "chaos_recovery_s", "platform": platform,
+                   "value": s.get("answered_in_s") or 0.0, "unit": "s",
+                   "vs_baseline": 0.0, "scenario": scenario,
+                   "member": s["member"], "answer": s.get("answer"),
+                   "watchdog_restarts": s["restarts"],
+                   "recovered": s["recovered"],
+                   **({"stall_budget_s": s["stall_budget_s"],
+                       "within_budget": s["within_budget"]}
+                      if "stall_budget_s" in s else {})})
+        rel = report["reload"]
+        _emit({"metric": "chaos_reload_rejected", "platform": platform,
+               "value": 1.0 if rel["rejected"] else 0.0, "unit": "bool",
+               "vs_baseline": 0.0,
+               "resident_version_kept": rel["resident_version_kept"],
+               "traffic_errors": rel["traffic"]["errors"],
+               "traffic_requests": rel["traffic"]["requests"]})
+        crash = run_continual_crash(tmp)
+        _emit({"metric": "chaos_supervisor_restart",
+               "platform": platform,
+               "value": float(crash["supervisor_restarts"]),
+               "unit": "count", "vs_baseline": 0.0, **crash})
+
+
 def main() -> None:
     global _BENCH_ROOT, _BENCH_ROOT_CM
     # root span for the whole bench: main-thread phase spans (train,
@@ -1448,6 +1527,16 @@ def main() -> None:
             _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
                    "vs_baseline": 0.0,
                    "error": f"serving bench failed: {type(e).__name__}: {e}",
+                   "trace_tail":
+                       traceback.format_exc().strip().splitlines()[-3:]})
+        return
+    if "chaos" in sys.argv[1:]:
+        try:
+            run_chaos_bench()
+        except Exception as e:
+            _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"chaos bench failed: {type(e).__name__}: {e}",
                    "trace_tail":
                        traceback.format_exc().strip().splitlines()[-3:]})
         return

@@ -982,3 +982,34 @@ def do_GET(self):
 '''
     findings = [f for f in L.lint_source(src) if f.code == "L014"]
     assert len(findings) == 1
+
+
+def test_lint_l015_unnamed_thread_in_package_code():
+    """L015: `threading.Thread(...)` without `name=` in package code —
+    unnamed threads make watchdog/hang diagnostics and span attribution
+    useless."""
+    src = '''
+import threading
+from threading import Thread
+
+t1 = threading.Thread(target=work)              # flagged
+t2 = Thread(target=work, daemon=True)           # flagged (bare import)
+t3 = threading.Thread(target=work, name="ok")   # named: clean
+t4 = threading.Thread(target=work, **kw)        # **kwargs may name it
+pool = ThreadPoolExecutor(max_workers=2)        # not a Thread ctor
+'''
+    findings = [f for f in L.lint_source(
+        src, path="transmogrifai_tpu/serving/newmod.py")
+        if f.code == "L015"]
+    assert len(findings) == 2
+    assert all("name=" in f.message for f in findings)
+
+
+def test_lint_l015_exempt_in_tests_and_testkit():
+    src = "import threading\nt = threading.Thread(target=f)\n"
+    for path in ("tests/test_x.py", "transmogrifai_tpu/testkit/gen.py"):
+        assert not any(f.code == "L015"
+                       for f in L.lint_source(src, path=path))
+    # but package smoke modules ARE covered
+    assert any(f.code == "L015" for f in L.lint_source(
+        src, path="transmogrifai_tpu/serving/fleet_smoke.py"))

@@ -1,0 +1,750 @@
+"""Serving-plane resilience (serving/resilience.py + wiring): health
+state machine (HEALTHY -> DEGRADED -> QUARANTINED with half-open probe
+recovery), circuit breaker + degraded fallback onto the resident
+previous version, hang watchdog (killed/stalled scoring threads), the
+serving fault sites, Retry-After plumbing, shutdown under load, and the
+continual supervisor restart satellite."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.runtime.faults import (
+    SITE_BATCH_ASSEMBLE, SITE_DEVICE_DISPATCH, SITE_RELOAD_LOAD,
+    FaultPlan, FaultSpec, InjectedFault, InjectedKill)
+from transmogrifai_tpu.serving import (
+    DEGRADED, HEALTHY, QUARANTINED, MemberHealth, ResilienceParams,
+    ScoreError, ScoringService, ServingConfig, TokenBucket, Watchdog)
+from transmogrifai_tpu.serving.router import Router, TenantPolicy
+from transmogrifai_tpu.workflow import Workflow
+from transmogrifai_tpu.workflow.serialization import model_fingerprint
+
+
+def _make_ds(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = ((x1 + 0.5 * x2 + rng.normal(0, 0.3, n)) > 0).astype(np.float64)
+    return Dataset({"x1": x1, "x2": x2, "y": y},
+                   {"x1": t.Real, "x2": t.Real, "y": t.Integral})
+
+
+def _train(ds, reg_param=0.01):
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    vec = RealVectorizer(track_nulls=False).set_input(*preds).get_output()
+    pred = OpLogisticRegression(reg_param=reg_param, max_iter=40) \
+        .set_input(label, vec).get_output()
+    return Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+
+
+ROW = {"x1": 0.4, "x2": -0.2}
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    base = tmp_path_factory.mktemp("resilience-models")
+    ds = _make_ds()
+    _train(ds, reg_param=0.01).save(str(base / "v1"))
+    _train(ds, reg_param=0.5).save(str(base / "v2"))
+    return str(base / "v1"), str(base / "v2")
+
+
+def _fast_params(**over):
+    base = dict(window=16, min_window=4, degraded_error_rate=0.25,
+                quarantine_error_rate=0.6, breaker_failures=2,
+                half_open_after_s=0.15, probe_successes=1,
+                watchdog_period_s=0.05, watchdog_stall_s=0.4)
+    base.update(over)
+    return base
+
+
+def _service(path, **resilience_over):
+    return ScoringService.from_path(
+        path, config=ServingConfig(
+            max_batch=4, batch_wait_ms=1.0,
+            resilience=_fast_params(**resilience_over)))
+
+
+def _wait(cond, timeout_s=8.0, period_s=0.02):
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        if cond():
+            return True
+        time.sleep(period_s)
+    return False
+
+
+def _counter_total(registry, name):
+    series = registry.to_json().get(name, {"series": []})["series"]
+    return sum(s.get("value", 0) for s in series)
+
+
+# --------------------------------------------------------------------- #
+# ResilienceParams + MemberHealth units                                 #
+# --------------------------------------------------------------------- #
+
+def test_resilience_params_roundtrip_and_validation():
+    p = ResilienceParams.from_json(_fast_params())
+    assert ResilienceParams.from_json(p.to_json()) == p
+    assert ResilienceParams.from_json(None).enabled
+    with pytest.raises(ValueError):
+        ResilienceParams(breaker_failures=0)
+    with pytest.raises(ValueError):
+        ResilienceParams(degraded_error_rate=0.9,
+                         quarantine_error_rate=0.5)
+    with pytest.raises(ValueError):
+        ResilienceParams(watchdog_stall_s=0)
+    with pytest.raises(ValueError):
+        # a floor above the deque cap would silently disable the
+        # error-rate machine
+        ResilienceParams(window=8, min_window=16)
+
+
+def test_member_health_window_transitions():
+    h = MemberHealth(ResilienceParams.from_json(_fast_params(
+        min_window=4, window=8)), member="m")
+    for _ in range(4):
+        h.note_request(True, 0.01)
+    assert h.state == HEALTHY
+    # 2 errors out of 6 -> 33% >= degraded threshold
+    h.note_request(False)
+    h.note_request(False)
+    assert h.state == DEGRADED
+    # pile on errors past the quarantine threshold
+    for _ in range(6):
+        h.note_request(False)
+    assert h.state == QUARANTINED
+    assert any(tr["to"] == QUARANTINED for tr in h.transitions)
+    # quarantined with no fallback -> fast-fail with a retry hint
+    assert h.admit(has_fallback=False) is not None
+    assert h.admit(has_fallback=True) is None
+
+
+def test_member_health_breaker_and_probe_recovery():
+    h = MemberHealth(ResilienceParams.from_json(_fast_params(
+        breaker_failures=3, half_open_after_s=0.05)), member="m")
+    h.note_dispatch(False)
+    h.note_dispatch(False)
+    assert not h.breaker_open  # below the consecutive threshold
+    h.note_dispatch(True)
+    h.note_dispatch(False)
+    h.note_dispatch(False)
+    assert not h.breaker_open  # the success reset the streak
+    h.note_dispatch(False)
+    assert h.breaker_open and h.state == QUARANTINED
+    assert h.breaker_opens == 1
+    # half-open: exactly one probe per window
+    assert _wait(h.probe_due, timeout_s=1.0)
+    assert not h.probe_due()
+    # failed probe re-arms; successful probe closes
+    h.note_dispatch(False, probe=True)
+    assert h.breaker_open
+    assert _wait(h.probe_due, timeout_s=1.0)
+    h.note_dispatch(True, probe=True)
+    assert not h.breaker_open and h.state == HEALTHY
+    assert h.breaker_closes == 1
+    recs = [tr for tr in h.transitions if tr.get("recovery_s") is not None]
+    assert recs and recs[-1]["recovery_s"] > 0  # measured MTTR
+
+
+def test_member_health_stall_recovery_records_mttr():
+    h = MemberHealth(ResilienceParams.from_json(_fast_params()))
+    t0 = time.monotonic() - 0.5  # backdated outage start
+    h.note_stall(since=t0)
+    assert h.state == QUARANTINED
+    h.clear_stall()
+    assert h.state == HEALTHY
+    rec = [tr for tr in h.transitions if tr.get("recovery_s")][-1]
+    assert rec["recovery_s"] >= 0.5  # measured from the REAL stall start
+
+
+# --------------------------------------------------------------------- #
+# Retry-After plumbing                                                  #
+# --------------------------------------------------------------------- #
+
+def test_token_bucket_refill_eta():
+    b = TokenBucket(rate=10.0, burst=10.0)
+    assert b.refill_eta_s(5) == 0.0
+    assert b.try_take(10)
+    eta = b.refill_eta_s(5)
+    assert 0.0 < eta <= 0.5 + 1e-6
+    import math
+    assert TokenBucket(math.inf, math.inf).refill_eta_s(100) == 0.0
+    # a zero-rate (blocked) tenant must yield a FINITE hint — inf would
+    # overflow the HTTP Retry-After integer and break JSON clients
+    eta = TokenBucket(0.0, 1.0).refill_eta_s(5)
+    assert math.isfinite(eta) and eta <= 3600.0
+    from transmogrifai_tpu.serving.http import _retry_after_header
+    assert _retry_after_header(math.inf) == "3600"
+    assert _retry_after_header(0.2) == "1"
+    assert _retry_after_header(None) == "1"
+
+
+def test_router_shed_errors_carry_retry_after():
+    r = Router(tenants={"slow": TenantPolicy(rate=10, burst=10,
+                                             priority=0),
+                        "gold": TenantPolicy(rate=1e9, priority=1)},
+               shed_watermark=0.5)
+    with pytest.raises(ScoreError) as ei:
+        r.admit("slow", 1000, queue_frac=0.0)
+    assert ei.value.code == "quota_exceeded"
+    assert ei.value.retry_after_s is not None
+    assert ei.value.retry_after_s > 0
+    assert "retry_after_s" in ei.value.to_json()
+    with pytest.raises(ScoreError) as ei:
+        r.admit("slow", 1, queue_frac=0.95)
+    assert ei.value.code == "shed_low_priority"
+    assert ei.value.retry_after_s is not None
+
+
+# --------------------------------------------------------------------- #
+# circuit breaker + degraded fallback on a live service                 #
+# --------------------------------------------------------------------- #
+
+def test_breaker_trips_and_fast_fails_without_fallback(model_dirs):
+    """Single resident version: a dispatch-error storm opens the
+    breaker; with no fallback the member FAST-FAILS new requests with a
+    structured circuit_open + retry-after instead of queueing them."""
+    v1, _ = model_dirs
+    svc = _service(v1, breaker_failures=2, half_open_after_s=30.0)
+    svc.start()
+    try:
+        svc.score([dict(ROW)])  # healthy baseline
+        plan = FaultPlan([FaultSpec(site=SITE_DEVICE_DISPATCH, at=1,
+                                    times=0, kind="error")])
+        with plan.active():
+            for _ in range(3):
+                with pytest.raises(ScoreError):
+                    svc.score([dict(ROW)], deadline_ms=4000)
+                if svc._health.breaker_open:
+                    break
+            assert _wait(lambda: svc._health.state == QUARANTINED)
+            with pytest.raises(ScoreError) as ei:
+                svc.score([dict(ROW)])
+            assert ei.value.code == "circuit_open"
+            assert ei.value.retry_after_s is not None
+            assert ei.value.retry_after_s > 0
+        assert svc.health()["status"] == "quarantined"
+        assert svc.health()["retry_after_s"] > 0
+    finally:
+        svc.stop()
+
+
+def test_degraded_fallback_serves_previous_version(model_dirs):
+    """Breaker open + resident previous version: the member degrades to
+    the PR-2 rollback chain instead of going dark — responses carry the
+    previous version id, `serving_degraded_fallback_total` ticks, and
+    once the storm exhausts the half-open probes close the breaker
+    (HEALTHY again, MTTR recorded)."""
+    v1, v2 = model_dirs
+    svc = _service(v1, breaker_failures=2, half_open_after_s=0.15)
+    svc.start()
+    try:
+        assert svc.reload(v2)["status"] == "swapped"
+        fp1, fp2 = model_fingerprint(v1), model_fingerprint(v2)
+        assert svc.score([dict(ROW)]).model_version == fp2
+        plan = FaultPlan([FaultSpec(site=SITE_DEVICE_DISPATCH, at=1,
+                                    times=6, kind="error")])
+        fallback_versions = []
+        with plan.active():
+            for _ in range(40):
+                try:
+                    res = svc.score([dict(ROW)], deadline_ms=4000)
+                    fallback_versions.append(res.model_version)
+                except ScoreError:
+                    pass
+                if fp1 in fallback_versions:
+                    break
+            assert fp1 in fallback_versions, \
+                "no response served by the resident previous version"
+
+            def _traffic_then_check():
+                # recovery is traffic-driven: half-open probes dispatch
+                # on the NEXT batch, so keep requests flowing
+                _score_ok(svc)
+                return not svc._health.breaker_open
+
+            assert _wait(_traffic_then_check), \
+                "breaker never closed after the storm exhausted"
+        assert _counter_total(svc.registry,
+                              "serving_degraded_fallback_total") > 0
+        assert svc._health.state == HEALTHY
+        recs = [tr for tr in svc._health.transitions
+                if tr.get("recovery_s") is not None]
+        assert recs, "recovery transition must record the MTTR"
+        # primary path back: fresh scores come from the active version
+        assert _wait(lambda: svc.score(
+            [dict(ROW)]).model_version == fp2, timeout_s=4.0)
+    finally:
+        svc.stop()
+
+
+# --------------------------------------------------------------------- #
+# hang watchdog                                                         #
+# --------------------------------------------------------------------- #
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_watchdog_restarts_killed_scoring_thread(model_dirs):
+    """An InjectedKill (BaseException, like a fatal runtime error)
+    kills the scoring thread mid-batch: the watchdog restarts it, the
+    in-flight request is ANSWERED with a structured error, and the next
+    request scores normally. (The unhandled-thread-exception warning IS
+    the scenario: the scoring thread dies for real.)"""
+    v1, _ = model_dirs
+    svc = _service(v1)
+    svc.start()
+    try:
+        outcome = {}
+        plan = FaultPlan([FaultSpec(site=SITE_DEVICE_DISPATCH, at=1,
+                                    kind="kill")])
+
+        def client():
+            t0 = time.perf_counter()
+            try:
+                svc.score([dict(ROW)], deadline_ms=8000)
+                outcome["answer"] = "scored"
+            except ScoreError as e:
+                outcome["answer"] = e.code
+            outcome["elapsed"] = time.perf_counter() - t0
+
+        with plan.active():
+            th = threading.Thread(target=client, name="test-victim")
+            th.start()
+            th.join(timeout=8.0)
+            assert not th.is_alive(), "client hung on a killed thread"
+            assert _wait(lambda: _counter_total(
+                svc.registry, "serving_watchdog_restarts_total") >= 1)
+        assert outcome["answer"] == "watchdog_restart"
+        assert outcome["elapsed"] < 4.0
+        # restarted loop serves again
+        assert _wait(lambda: _score_ok(svc), timeout_s=4.0)
+    finally:
+        svc.stop()
+
+
+def _score_ok(svc):
+    try:
+        svc.score([dict(ROW)], deadline_ms=4000)
+        return True
+    except ScoreError:
+        return False
+
+
+def test_watchdog_recovers_stalled_loop_within_budget(model_dirs):
+    """A dispatch wedged past `watchdog_stall_s` (injected delay) gets
+    its in-flight batch quarantined within the stall budget — the
+    client is answered LONG before the hang would have resolved."""
+    v1, _ = model_dirs
+    svc = _service(v1, watchdog_stall_s=0.4, watchdog_period_s=0.05)
+    svc.start()
+    try:
+        outcome = {}
+        plan = FaultPlan([FaultSpec(site=SITE_DEVICE_DISPATCH, at=1,
+                                    kind="delay", delay_s=2.5)])
+
+        def client():
+            t0 = time.perf_counter()
+            try:
+                svc.score([dict(ROW)], deadline_ms=8000)
+                outcome["answer"] = "scored"
+            except ScoreError as e:
+                outcome["answer"] = e.code
+            outcome["elapsed"] = time.perf_counter() - t0
+
+        with plan.active():
+            th = threading.Thread(target=client, name="test-stall-victim")
+            th.start()
+            th.join(timeout=8.0)
+            assert not th.is_alive()
+        assert outcome["answer"] == "watchdog_restart"
+        assert outcome["elapsed"] < 1.5, \
+            f"answered only after the hang resolved: {outcome}"
+        assert _counter_total(svc.registry,
+                              "serving_watchdog_restarts_total") >= 1
+        # the stale thread wakes later and must NOT disturb the fresh one
+        time.sleep(2.3)
+        assert _score_ok(svc)
+    finally:
+        svc.stop()
+
+
+def test_watchdog_sweep_is_noop_on_healthy_service(model_dirs):
+    v1, _ = model_dirs
+    svc = _service(v1)
+    svc.start()
+    try:
+        wd = Watchdog(lambda: {"s": svc}, period_s=0.05)
+        assert wd.sweep() == 0
+        assert svc.check_liveness() is None
+    finally:
+        svc.stop()
+
+
+# --------------------------------------------------------------------- #
+# fault sites                                                           #
+# --------------------------------------------------------------------- #
+
+def test_batch_assemble_fault_degrades_to_per_request(model_dirs):
+    """An injected batch-assembly failure quarantines per-request: the
+    requests still get ANSWERS (scored singly) and the breaker is not
+    touched (assembly is not a device failure)."""
+    v1, _ = model_dirs
+    svc = _service(v1)
+    svc.start()
+    try:
+        plan = FaultPlan([FaultSpec(site=SITE_BATCH_ASSEMBLE, at=1,
+                                    kind="error")])
+        with plan.active():
+            res = svc.score([dict(ROW)], deadline_ms=4000)
+        assert res.n_rows == 1
+        assert plan.fired and plan.fired[0][0] == SITE_BATCH_ASSEMBLE
+        assert not svc._health.breaker_open
+    finally:
+        svc.stop()
+
+
+def test_reload_load_fault_keeps_resident_serving(model_dirs):
+    v1, v2 = model_dirs
+    svc = _service(v1)
+    svc.start()
+    try:
+        before = svc.health()["model_version"]
+        plan = FaultPlan([FaultSpec(site=SITE_RELOAD_LOAD, at=1,
+                                    kind="error")])
+        with plan.active():
+            with pytest.raises(InjectedFault):
+                svc.reload(v2)
+        assert svc.health()["model_version"] == before
+        assert _score_ok(svc)
+        # and without the fault the same reload lands
+        assert svc.reload(v2)["status"] == "swapped"
+    finally:
+        svc.stop()
+
+
+def test_fleet_member_sites_are_scoped_by_name(model_dirs):
+    """A chaos plan storming `serving.device_dispatch#a` must not touch
+    member b's dispatches."""
+    from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+    v1, v2 = model_dirs
+    fleet = FleetService(FleetConfig(
+        models={"a": v1, "b": v2},
+        serving={"max_batch": 4, "batch_wait_ms": 1.0},
+        resilience=_fast_params(breaker_failures=2,
+                                half_open_after_s=30.0)))
+    fleet.start()
+    try:
+        plan = FaultPlan([FaultSpec(site=f"{SITE_DEVICE_DISPATCH}#a",
+                                    at=1, times=0, kind="error")])
+        with plan.active():
+            with pytest.raises(ScoreError):
+                fleet.score("a", [dict(ROW)], deadline_ms=4000)
+            fleet.score("b", [dict(ROW)], deadline_ms=4000)  # untouched
+        assert any(site == f"{SITE_DEVICE_DISPATCH}#a"
+                   for site, _, _ in plan.fired)
+        assert all(site != f"{SITE_DEVICE_DISPATCH}#b"
+                   for site, _, _ in plan.fired)
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------- #
+# HTTP: Retry-After headers + quarantined healthz                       #
+# --------------------------------------------------------------------- #
+
+def test_http_quarantined_healthz_and_circuit_open_retry_after(model_dirs):
+    from transmogrifai_tpu.serving.http import serve
+    v1, _ = model_dirs
+    svc = _service(v1, half_open_after_s=30.0)
+    svc.start()
+    server, _ = serve(svc, port=0, block=False)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            assert r.status == 200
+        svc._health.note_stall()  # force quarantine (no fallback)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/healthz", timeout=30)
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.loads(ei.value.read())
+        assert body["status"] == "quarantined"
+        req = urllib.request.Request(
+            f"{base}/score",
+            data=json.dumps({"rows": [dict(ROW)]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert json.loads(ei.value.read())["error"] == "circuit_open"
+        svc._health.clear_stall()
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            assert r.status == 200
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.stop()
+
+
+def test_http_fleet_quota_429_carries_retry_after(model_dirs):
+    from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+    from transmogrifai_tpu.serving.http import serve_fleet
+    v1, _ = model_dirs
+    fleet = FleetService(FleetConfig(
+        models={"a": v1},
+        tenants={"trial": {"rate": 1, "burst": 1, "priority": 0}},
+        serving={"max_batch": 4, "batch_wait_ms": 1.0}))
+    fleet.start()
+    server, _ = serve_fleet(fleet, port=0, block=False)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        def post():
+            req = urllib.request.Request(
+                f"{base}/score",
+                data=json.dumps({"model": "a", "rows": [dict(ROW)] * 2,
+                                 "tenant": "trial"}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            return urllib.request.urlopen(req, timeout=30)
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post()  # 2 rows vs burst 1: over quota immediately
+            post()
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert json.loads(ei.value.read())["error"] == "quota_exceeded"
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.stop()
+
+
+# --------------------------------------------------------------------- #
+# shutdown under load (satellite)                                       #
+# --------------------------------------------------------------------- #
+
+def test_stop_under_load_answers_every_request(model_dirs):
+    """stop() with requests queued + in flight: every submitted request
+    gets a response or a structured shutdown error — no client blocks
+    forever, none silently dropped."""
+    v1, _ = model_dirs
+    svc = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=2, batch_wait_ms=1.0,
+                                 max_queue=64,
+                                 resilience=_fast_params()))
+    svc.start()
+    results = {}
+
+    def client(i):
+        try:
+            svc.score([dict(ROW)], deadline_ms=0, timeout_s=15.0)
+            results[i] = "scored"
+        except ScoreError as e:
+            results[i] = e.code
+        except Exception as e:  # pragma: no cover
+            results[i] = f"UNSTRUCTURED:{type(e).__name__}"
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"shutdown-client-{i}")
+               for i in range(12)]
+    for th in threads:
+        th.start()
+    time.sleep(0.05)  # some in flight, some still queued
+    svc.stop()
+    for th in threads:
+        th.join(timeout=10.0)
+    assert all(not th.is_alive() for th in threads), \
+        "a client is still blocked after stop()"
+    assert len(results) == 12
+    assert all(v == "scored" or v == "shutdown" for v in results.values()), \
+        results
+
+
+def test_stop_with_wedged_dispatch_answers_inflight(model_dirs):
+    """A scoring thread wedged INSIDE a dispatch at stop() time: the
+    join times out and the in-flight batch is still failed structurally
+    (no client left blocking on a dead service)."""
+    v1, _ = model_dirs
+    svc = _service(v1, watchdog_stall_s=30.0)  # watchdog out of the way
+    svc.start()
+    gate = threading.Event()
+    real = svc._active.scorer.score_padded
+
+    def wedged(ds, bucket):
+        gate.wait(timeout=10.0)
+        return real(ds, bucket)
+
+    svc._active.scorer.score_padded = wedged
+    outcome = {}
+
+    def client():
+        try:
+            svc.score([dict(ROW)], deadline_ms=0, timeout_s=15.0)
+            outcome["answer"] = "scored"
+        except ScoreError as e:
+            outcome["answer"] = e.code
+
+    th = threading.Thread(target=client, name="wedged-client")
+    th.start()
+    try:
+        assert _wait(lambda: svc._busy_since is not None, timeout_s=4.0)
+        svc.stop(timeout=0.3)  # join times out; in-flight must be failed
+        th.join(timeout=5.0)
+        assert not th.is_alive(), "client hung through stop()"
+        assert outcome["answer"] == "shutdown"
+    finally:
+        gate.set()
+        th.join(timeout=5.0)
+
+
+def test_fleet_stop_under_load_answers_every_request(model_dirs):
+    from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+    v1, v2 = model_dirs
+    fleet = FleetService(FleetConfig(
+        models={"a": v1, "b": v2},
+        serving={"max_batch": 2, "batch_wait_ms": 1.0, "max_queue": 64},
+        resilience=_fast_params()))
+    fleet.start()
+    results = {}
+
+    def client(i, model):
+        try:
+            fleet.score(model, [dict(ROW)], deadline_ms=0)
+            results[i] = "scored"
+        except ScoreError as e:
+            results[i] = e.code
+        except Exception as e:  # pragma: no cover
+            results[i] = f"UNSTRUCTURED:{type(e).__name__}"
+
+    threads = [threading.Thread(target=client,
+                                args=(i, "a" if i % 2 else "b"),
+                                name=f"fleet-shutdown-client-{i}")
+               for i in range(10)]
+    for th in threads:
+        th.start()
+    time.sleep(0.05)
+    fleet.stop()
+    for th in threads:
+        th.join(timeout=10.0)
+    assert all(not th.is_alive() for th in threads)
+    assert len(results) == 10
+    assert all(v in ("scored", "shutdown") for v in results.values()), \
+        results
+
+
+# --------------------------------------------------------------------- #
+# continual supervisor restart (satellite)                              #
+# --------------------------------------------------------------------- #
+
+def test_continual_supervisor_survives_killed_cycle(tmp_path):
+    """A BaseException (InjectedKill — e.g. a fault-injected holdout
+    path) escaping a cycle used to kill the supervisor thread
+    permanently; now it restarts under the RetryPolicy's backoff with a
+    counter + event, and the NEXT cycle still runs."""
+    from transmogrifai_tpu.continual import ContinualLoop, ContinualParams
+    from transmogrifai_tpu.data.columnar_store import ColumnarStore
+
+    rng = np.random.default_rng(5)
+    w = ColumnarStore.create(str(tmp_path / "store"), 16, 2,
+                             dtype="float32")
+    w.write_chunk(0, rng.standard_normal((16, 2)).astype(np.float32),
+                  (rng.uniform(size=16) > 0.5).astype(np.float32))
+    store = w.close()
+    registry = MetricsRegistry()
+    loop = ContinualLoop(store, str(tmp_path / "model"),
+                         params=ContinualParams(check_interval_s=0.05),
+                         registry=registry)
+    ran = threading.Event()
+    killed = []
+
+    def cycle():
+        if not killed:
+            killed.append(1)
+            raise InjectedKill("test.cycle", 1)
+        ran.set()
+        return {"status": "no_drift"}
+
+    loop.run_cycle = cycle
+    loop.start()
+    try:
+        loop._wake.set()
+        assert ran.wait(timeout=10.0), \
+            "supervisor never ran another cycle after the kill"
+        assert _counter_total(
+            registry, "continual_supervisor_restarts_total") == 1
+        assert loop._thread.is_alive()
+    finally:
+        loop.stop()
+
+
+# --------------------------------------------------------------------- #
+# params threading + goodput rollup                                     #
+# --------------------------------------------------------------------- #
+
+def test_serving_params_resilience_roundtrip():
+    from transmogrifai_tpu.workflow.params import ServingParams
+    sp = ServingParams.from_json(
+        {"max_batch": 8, "resilience": _fast_params()})
+    assert ServingParams.from_json(sp.to_json()).resilience == \
+        _fast_params()
+    cfg = sp.to_config()
+    assert cfg.resilience == _fast_params()
+    sp2 = ServingParams.from_json(
+        {"fleet": {"models": {"a": "dir"}},
+         "resilience": {"enabled": False}})
+    assert sp2.to_fleet_config().resilience == {"enabled": False}
+
+
+def test_resilience_disabled_service_has_no_health(model_dirs):
+    v1, _ = model_dirs
+    svc = ScoringService.from_path(
+        v1, config=ServingConfig(max_batch=4,
+                                 resilience={"enabled": False}))
+    svc.start()
+    try:
+        assert svc._health is None and svc._watchdog is None
+        assert "health" not in svc.health()
+        assert _score_ok(svc)
+    finally:
+        svc.stop()
+
+
+def test_goodput_resilience_section_rollup():
+    from transmogrifai_tpu.obs.goodput import build_report
+    from transmogrifai_tpu.obs.trace import TRACER
+    with TRACER.span("run:resilience-test", category="run",
+                     new_trace=True) as root:
+        root.event("breaker_open", member="a")
+        root.event("health_transition", member="a", to="quarantined",
+                   reason="breaker_open", **{"from": "healthy"})
+        root.event("degraded_fallback", member="a", requests=3)
+        root.event("breaker_close", member="a")
+        root.event("health_transition", member="a", to="healthy",
+                   reason="breaker_close", recovery_s=1.5,
+                   **{"from": "quarantined"})
+        root.event("watchdog_restart", member="b", reason="dead")
+        root.event("supervisor_restart", restarts=1)
+        root.event("continual_cycle", status="no_drift", wall_s=0.1)
+    rep = build_report(root, TRACER.trace_spans(root.trace_id)).to_json()
+    res = rep["resilience"]
+    assert res["breaker_opens"] == 1 and res["breaker_closes"] == 1
+    assert res["quarantines"] == 1 and res["recoveries"] == 1
+    assert res["mean_mttr_s"] == 1.5 and res["max_mttr_s"] == 1.5
+    assert res["fallback_batches"] == 1
+    assert res["fallback_requests"] == 3
+    assert res["watchdog_restarts"] == 1
+    assert rep["continual"]["supervisor_restarts"] == 1

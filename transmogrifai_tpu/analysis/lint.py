@@ -126,6 +126,15 @@ silent slowness or nondeterminism once XLA is in the loop:
   re-traces its own programs instead of adopting the resident ones).
   Construct once, `start()`, and route requests through it.
 
+- ``L015 unnamed-thread``: a ``threading.Thread(...)`` constructed in
+  package code (outside ``testkit/``/tests) without a ``name=``. The
+  serving watchdog, hang diagnostics, and span attribution all key off
+  thread names — an anonymous ``Thread-23`` in a stack dump or trace
+  is unattributable exactly when a wedged scoring loop or supervisor
+  needs diagnosing. Name every long-lived OR short-lived thread for
+  what it does (``scoring-batcher-1``, ``fleet-watchdog``,
+  ``continual-loop``).
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1125,6 +1134,38 @@ def _check_service_construction(tree: ast.AST,
     return findings
 
 
+# -- L015: unnamed threads in package code ----------------------------------- #
+
+_L015_EXEMPT_DIRS = ("testkit", "tests")
+
+
+def _check_unnamed_threads(tree: ast.AST, path: str) -> List[LintFinding]:
+    """Flag `threading.Thread(...)` constructions missing `name=` in
+    package code — unnamed threads make watchdog/hang diagnostics and
+    span attribution useless (which thread is the wedged one?)."""
+    parts = os.path.normpath(path).split(os.sep)
+    if any(d in parts for d in _L015_EXEMPT_DIRS):
+        return []
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted not in ("threading.Thread", "Thread"):
+            continue
+        if any(kw.arg == "name" for kw in node.keywords):
+            continue
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **kwargs may carry the name; can't prove it doesn't
+        findings.append(LintFinding(
+            path, getattr(node, "lineno", 0), "L015",
+            "`threading.Thread(...)` without a `name=` — unnamed "
+            "threads make watchdog/hang diagnostics and span "
+            "attribution useless; name it for what it runs "
+            "(e.g. name=\"scoring-batcher\")"))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1142,6 +1183,7 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_legacy_np_random(tree, path))
     linter.findings.extend(_check_magic_knobs(tree, path))
     linter.findings.extend(_check_service_construction(tree, path))
+    linter.findings.extend(_check_unnamed_threads(tree, path))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 

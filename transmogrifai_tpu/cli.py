@@ -629,6 +629,12 @@ def cmd_serve(args) -> int:
         sp.compile_cache = True
     if args.compile_cache_dir:
         sp.compile_cache_dir = args.compile_cache_dir
+    if args.resilience:
+        sp.resilience = {**(sp.resilience or {}),
+                         "enabled": args.resilience == "on"}
+    if args.watchdog_stall_s is not None:
+        sp.resilience = {**(sp.resilience or {}),
+                         "watchdog_stall_s": args.watchdog_stall_s}
 
     fleet_cfg = None
     if args.fleet_config:
@@ -831,6 +837,15 @@ def main(argv: Optional[list] = None) -> int:
         help="cache directory for --compile-cache (default "
              "TRANSMOGRIFAI_TPU_CACHE or "
              "~/.cache/transmogrifai_tpu/xla-cache)")
+    serve_p.add_argument(
+        "--resilience", choices=["on", "off"],
+        help="serving resilience layer (health state machine, circuit "
+             "breaker + degraded fallback, hang watchdog; default on — "
+             "fine knobs via the params `serving.resilience` block)")
+    serve_p.add_argument(
+        "--watchdog-stall-s", type=float, default=None,
+        help="per-batch stall budget before the watchdog quarantines "
+             "the in-flight batch and restarts the scoring thread")
     serve_p.set_defaults(fn=cmd_serve)
 
     lint_p = sub.add_parser(

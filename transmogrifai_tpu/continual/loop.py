@@ -706,6 +706,42 @@ class ContinualLoop:
         return self
 
     def _supervise(self) -> None:
+        """Supervisor shell: the poll loop must survive ANYTHING short
+        of process death. A per-cycle Exception is logged and the next
+        poll retries from journaled state (inner handler); anything
+        that ESCAPES that — an `InjectedKill`, a real fatal error in
+        the fault-injected holdout path, a MemoryError — used to kill
+        the thread permanently and silently stall continual training
+        forever. Now it restarts the loop under the RetryPolicy's
+        backoff schedule, with a `continual_supervisor_restarts_total`
+        tick and a ``supervisor_restart`` event per restart."""
+        import random as _random
+        rng = _random.Random(f"{self.seed}:supervisor")
+        restarts = 0
+        while self._running:
+            try:
+                self._poll_loop()
+                return  # stop() requested: clean exit
+            except BaseException as e:
+                if not self._running or isinstance(
+                        e, (KeyboardInterrupt, SystemExit)):
+                    raise
+                restarts += 1
+                delay = self._retry.delay_for(min(restarts, 8), rng)
+                self.registry.counter(
+                    "continual_supervisor_restarts_total",
+                    "supervisor poll loops restarted after an escaped "
+                    "failure").inc()
+                record_event("supervisor_restart",
+                             error=f"{type(e).__name__}: {e}"[:200],
+                             restarts=restarts,
+                             delay_s=round(delay, 6))
+                log.error("continual: supervisor loop died (%s: %s); "
+                          "restarting in %.3fs (restart %d)",
+                          type(e).__name__, e, delay, restarts)
+                time.sleep(delay)
+
+    def _poll_loop(self) -> None:
         while self._running:
             self._wake.wait(timeout=self.params.check_interval_s)
             self._wake.clear()

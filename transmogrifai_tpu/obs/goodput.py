@@ -90,6 +90,14 @@ class GoodputReport:
     # rolling-swaps number of serving/fleet.py) and ``tenant_shed``
     # admission events. Empty when no fleet ran in this trace.
     fleet: Dict[str, Any] = field(default_factory=dict)
+    # serving-resilience accounting (serving/resilience.py): breaker
+    # open/close transitions, quarantine entries and recoveries with
+    # the measured MTTR (mean/max seconds from outage start to the
+    # HEALTHY transition), degraded-fallback traffic served by the
+    # resident previous version, and watchdog thread restarts — the
+    # availability story of a run that survived injected (or real)
+    # serving faults. Empty when nothing tripped.
+    resilience: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def badput_s(self) -> float:
@@ -122,6 +130,8 @@ class GoodputReport:
             out["perf"] = dict(sorted(self.perf.items()))
         if self.fleet:
             out["fleet"] = dict(sorted(self.fleet.items()))
+        if self.resilience:
+            out["resilience"] = dict(sorted(self.resilience.items()))
         return out
 
     def pretty(self) -> str:
@@ -154,6 +164,8 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     compile_saved = 0.0
     compile_hits = 0
     fleet: Dict[str, Any] = {}
+    resilience: Dict[str, Any] = {}
+    mttrs: list = []
     # mesh rollup accumulators: several schedules (one per selector fit)
     # can land in one trace — utilization averages weighted by each
     # schedule's wall, counters sum
@@ -225,6 +237,34 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
                             (d or {}).get("shed", 0) or 0)
             elif name == "tenant_shed":
                 fleet["sheds"] = fleet.get("sheds", 0) + 1
+            elif name == "breaker_open":
+                resilience["breaker_opens"] = \
+                    resilience.get("breaker_opens", 0) + 1
+            elif name == "breaker_close":
+                resilience["breaker_closes"] = \
+                    resilience.get("breaker_closes", 0) + 1
+            elif name == "health_transition":
+                to = str(attrs.get("to") or "")
+                if to == "quarantined":
+                    resilience["quarantines"] = \
+                        resilience.get("quarantines", 0) + 1
+                rec = attrs.get("recovery_s")
+                if rec is not None:
+                    resilience["recoveries"] = \
+                        resilience.get("recoveries", 0) + 1
+                    mttrs.append(float(rec))
+            elif name == "degraded_fallback":
+                resilience["fallback_batches"] = \
+                    resilience.get("fallback_batches", 0) + 1
+                resilience["fallback_requests"] = \
+                    resilience.get("fallback_requests", 0) + int(
+                        attrs.get("requests", 0) or 0)
+            elif name == "watchdog_restart":
+                resilience["watchdog_restarts"] = \
+                    resilience.get("watchdog_restarts", 0) + 1
+            elif name == "supervisor_restart":
+                continual["supervisor_restarts"] = \
+                    continual.get("supervisor_restarts", 0) + 1
             elif name == "fault":
                 counts["faults_injected"] += 1
             elif name == "steal":
@@ -284,6 +324,11 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
         counts["compile_cache_hits"] = compile_hits
     if fleet:
         report.fleet = fleet
+    if resilience:
+        if mttrs:
+            resilience["mean_mttr_s"] = round(sum(mttrs) / len(mttrs), 6)
+            resilience["max_mttr_s"] = round(max(mttrs), 6)
+        report.resilience = resilience
     if mesh:
         mesh["utilization_frac"] = round(
             mesh_busy / mesh_wall, 4) if mesh_wall > 0 else 0.0

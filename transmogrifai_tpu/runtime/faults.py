@@ -27,6 +27,25 @@ Sites threaded through the codebase:
   holdout regression (metric unknowable → the gate must assume the
   worst), so chaos tests can force the automatic serving rollback path
   deterministically
+- ``serving.batch_assemble`` — serving/service.py, before the scoring
+  thread concatenates a micro-batch (an `error` degrades the batch to
+  per-request quarantine scoring)
+- ``serving.device_dispatch`` — serving/service.py, before each
+  PRIMARY-path compiled-scorer dispatch (`error` storms trip the
+  member's circuit breaker, `kill` kills the scoring thread the way a
+  fatal runtime error would — the watchdog's restart path — and
+  `delay` wedges the loop past the watchdog's stall budget). Degraded
+  FALLBACK dispatches skip the site: the fault models a broken active
+  version, not a broken device, so the resident previous version keeps
+  working
+- ``serving.reload_load`` — serving/service.py, between a reload's
+  integrity verification and the candidate model load (a fault here
+  must leave the resident version serving)
+
+In a fleet each member scopes its serving sites by name —
+``serving.device_dispatch#<member>`` — so a chaos plan targets ONE
+member's dispatches deterministically while its peers run clean; a
+single-model service uses the bare site names.
 
 Fault kinds:
 
@@ -61,6 +80,7 @@ __all__ = [
     "is_oom_error",
     "SITE_READ_CHUNK", "SITE_RUN_BLOCK", "SITE_WRITE_FILE",
     "SITE_WORKER_BLOCK", "SITE_HOLDOUT_EVAL",
+    "SITE_BATCH_ASSEMBLE", "SITE_DEVICE_DISPATCH", "SITE_RELOAD_LOAD",
 ]
 
 SITE_READ_CHUNK = "ingest.read_chunk"
@@ -75,6 +95,13 @@ SITE_WORKER_BLOCK = "scheduler.worker_block"
 # injected `error` makes the gate treat the eval as a regression and
 # auto-roll the serving swap back (deterministic rollback chaos testing)
 SITE_HOLDOUT_EVAL = "continual.holdout_eval"
+# serving/service.py (fleet members suffix `#<member>`): batch
+# concatenation on the scoring thread, the primary-path device
+# dispatch, and the post-integrity model load of a /reload — the
+# serving resilience layer's three injectable failure modes
+SITE_BATCH_ASSEMBLE = "serving.batch_assemble"
+SITE_DEVICE_DISPATCH = "serving.device_dispatch"
+SITE_RELOAD_LOAD = "serving.reload_load"
 
 
 class InjectedFault(RuntimeError):

@@ -14,6 +14,11 @@ fast as the hardware allows"):
                warmup-manifest/persistent-compile cold starts
 - `router`   — per-tenant token-bucket quotas, priority shedding,
                per-tenant metrics
+- `resilience` — per-member health state machine (HEALTHY/DEGRADED/
+               QUARANTINED), circuit breaker + degraded fallback onto
+               the resident previous version, hang watchdog
+- `chaos`    — deterministic fault-storm harness over the fleet
+               (`make chaos-smoke`, `python bench.py chaos`)
 - `http`     — /score /healthz /metrics /reload over http.server
                (single-model `serve` + multi-model `serve_fleet`)
 - `smoke`    — self-contained boot-score-scrape-shutdown check
@@ -27,6 +32,9 @@ from transmogrifai_tpu.serving.fleet import (  # noqa: F401
     FleetConfig, FleetService, ProgramPool, scoring_signature)
 from transmogrifai_tpu.serving.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry)
+from transmogrifai_tpu.serving.resilience import (  # noqa: F401
+    DEGRADED, HEALTHY, QUARANTINED, MemberHealth, ResilienceParams,
+    Watchdog)
 from transmogrifai_tpu.serving.router import (  # noqa: F401
     Router, TenantPolicy, TokenBucket)
 from transmogrifai_tpu.serving.service import (  # noqa: F401
@@ -38,4 +46,6 @@ __all__ = [
     "ModelVersion", "ScoreResult", "ScoringService", "ServingConfig",
     "FleetConfig", "FleetService", "ProgramPool", "scoring_signature",
     "Router", "TenantPolicy", "TokenBucket",
+    "HEALTHY", "DEGRADED", "QUARANTINED",
+    "MemberHealth", "ResilienceParams", "Watchdog",
 ]

@@ -33,15 +33,26 @@ from transmogrifai_tpu.data.dataset import Dataset
 class ScoreError(Exception):
     """Structured serving error: a machine-readable ``code`` plus a human
     message. Codes: ``queue_full``, ``deadline_exceeded``, ``bad_request``,
-    ``record_error``, ``internal``, ``shutdown``."""
+    ``record_error``, ``internal``, ``shutdown``, ``quota_exceeded``,
+    ``shed_low_priority``, ``circuit_open``, ``watchdog_restart``,
+    ``not_found``.
 
-    def __init__(self, code: str, message: str):
+    ``retry_after_s`` is the backoff hint a shed/fast-failed client
+    should honor (token-bucket refill time, breaker half-open deadline);
+    the HTTP layer surfaces it as a ``Retry-After`` header on 429/503."""
+
+    def __init__(self, code: str, message: str,
+                 retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.code = code
         self.message = message
+        self.retry_after_s = retry_after_s
 
-    def to_json(self) -> Dict[str, str]:
-        return {"error": self.code, "message": self.message}
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"error": self.code, "message": self.message}
+        if self.retry_after_s is not None:
+            out["retry_after_s"] = round(float(self.retry_after_s), 3)
+        return out
 
 
 def bucket_ladder(max_batch: int, min_bucket: int = 1) -> Tuple[int, ...]:

@@ -1,0 +1,504 @@
+"""Chaos harness: deterministic fault storms against a live fleet.
+
+"Graceful degradation" is a claim; this module makes it falsifiable.
+It boots the standard 3-model / 2-tenant fleet (`serving/fleet.py`)
+with the resilience layer tuned for fast transitions, drives live
+traffic, and injects seeded `FaultPlan` storms at the serving fault
+sites (`runtime/faults`):
+
+1. **Device-error storm** on one member: consecutive dispatch failures
+   trip its circuit breaker (HEALTHY → QUARANTINED), degraded-mode
+   fallback serves from the resident PREVIOUS version while the breaker
+   is open (responses carry the old version id — provable), half-open
+   probes close the breaker once the storm exhausts, and the measured
+   MTTR lands in the report. The untouched members' traffic must
+   complete with ZERO errors and bounded p99.
+2. **Killed scoring thread**: an injected `kill` (a BaseException, like
+   a real fatal runtime error) kills the member's scoring thread
+   mid-batch; the watchdog restarts it and every in-flight request is
+   ANSWERED (structured error, never a hang).
+3. **Stalled dispatch**: an injected `delay` wedges the scoring loop
+   past `watchdog_stall_s`; clients get answers within the stall budget
+   (+ one watchdog period), not after the multi-second hang.
+4. **Corrupt reload under traffic**: a bit-flipped artifact is rejected
+   by integrity verification while the resident version keeps serving
+   concurrent traffic error-free (PR-4 behavior, now asserted under
+   load).
+5. **Crashing continual cycle** (`run_continual_crash`): an injected
+   kill escapes a continual cycle's own handling; the supervisor
+   restarts (`continual_supervisor_restarts_total`) and the NEXT cycle
+   completes — used by ``python bench.py chaos``.
+
+`make chaos-smoke` runs ``main()`` (scenarios 1-4 with hard
+assertions); ``python bench.py chaos`` reuses `run_chaos` +
+`run_continual_crash` and emits per-tenant availability, p99, breaker
+transition counts, MTTR, and the goodput resilience section into the
+bench payload.
+
+Run: ``JAX_PLATFORMS=cpu python -m transmogrifai_tpu.serving.chaos``
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+D = 3          # features per model
+_MAX_BATCH = 4  # small ladder: chaos exercises failure paths, not shapes
+
+ROW = {f"x{j}": 0.2 * (j + 1) for j in range(D)}
+
+
+def _train_models(tmp: str) -> Dict[str, str]:
+    """Four small logistic pipelines: members a/b/c plus a_v2, the
+    same-shaped swap candidate that gives member `a` its resident
+    rollback chain (the degraded-fallback target)."""
+    import numpy as np
+
+    import transmogrifai_tpu.types as t
+    from transmogrifai_tpu.data import Dataset
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(23)
+    n = 160
+    X = rng.normal(size=(n, D))
+    beta = rng.normal(size=D)
+
+    def fit(name: str, y: np.ndarray) -> str:
+        ds = Dataset({**{f"x{j}": X[:, j] for j in range(D)}, "y": y},
+                     {**{f"x{j}": t.Real for j in range(D)},
+                      "y": t.Integral})
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = RealVectorizer(track_nulls=False).set_input(
+            *preds).get_output()
+        pred = OpLogisticRegression(max_iter=40).set_input(
+            label, vec).get_output()
+        Workflow().set_result_features(pred, label) \
+            .set_input_dataset(ds).train().save(f"{tmp}/{name}")
+        return f"{tmp}/{name}"
+
+    return {
+        "a": fit("a", (X @ beta > 0).astype(np.float64)),
+        "a_v2": fit("a_v2", (X @ beta > 0.3).astype(np.float64)),
+        "b": fit("b", (X @ -beta > 0).astype(np.float64)),
+        "c": fit("c", (X @ beta > -0.3).astype(np.float64)),
+    }
+
+
+class _LoadClient(threading.Thread):
+    """Steady in-process traffic to one (tenant, model): records ok /
+    error counts, latencies, and the serving version of each response
+    (how the fallback-serves-the-previous-version claim is proven)."""
+
+    def __init__(self, fleet, tenant: str, model: str, idx: int):
+        super().__init__(daemon=True, name=f"chaos-client-{idx}")
+        self.fleet = fleet
+        self.tenant = tenant
+        self.model = model
+        self.ok = 0
+        self.errors: List[str] = []
+        self.latencies: List[float] = []
+        self.versions: Dict[str, int] = {}
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            t0 = time.perf_counter()
+            try:
+                res = self.fleet.score(self.model, [dict(ROW)],
+                                       tenant=self.tenant,
+                                       deadline_ms=10_000)
+                self.ok += 1
+                self.latencies.append(time.perf_counter() - t0)
+                self.versions[res.model_version] = \
+                    self.versions.get(res.model_version, 0) + 1
+            except Exception as e:
+                self.errors.append(
+                    f"{getattr(e, 'code', type(e).__name__)}: {e}"[:120])
+            time.sleep(0.004)
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def stats(self) -> Dict[str, Any]:
+        import numpy as np
+        total = self.ok + len(self.errors)
+        lat = np.asarray(self.latencies) if self.latencies \
+            else np.zeros(1)
+        return {
+            "tenant": self.tenant, "model": self.model,
+            "requests": total, "ok": self.ok,
+            "errors": len(self.errors),
+            "error_sample": self.errors[:3],
+            "availability": round(self.ok / total, 4) if total else 1.0,
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "versions": dict(self.versions),
+        }
+
+
+def _wait_state(fleet, member: str, state: str,
+                timeout_s: float = 15.0) -> bool:
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        health = fleet.models()[member].get("health") or {}
+        if health.get("state") == state:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _corrupt_copy(src: str, dst: str) -> str:
+    """Copy a sealed model artifact and flip bytes in one payload file
+    (never integrity.json itself — the manifest must DETECT the flip)."""
+    shutil.copytree(src, dst)
+    for name in sorted(os.listdir(dst)):
+        if name in ("integrity.json", "warmup.json"):
+            continue
+        path = os.path.join(dst, name)
+        if os.path.isfile(path) and os.path.getsize(path) > 0:
+            with open(path, "r+b") as fh:
+                first = fh.read(1)
+                fh.seek(0)
+                fh.write(bytes([first[0] ^ 0xFF]))
+            return path
+    raise RuntimeError(f"no corruptible payload file in {dst}")
+
+
+def run_chaos(dirs: Dict[str, str], seed: int = 0,
+              load_s: float = 3.0) -> Dict[str, Any]:
+    """Scenarios 1-4 against one fleet; returns the falsifiability
+    report (see module docstring). `dirs` maps a/a_v2/b/c to trained
+    artifact dirs (`_train_models`)."""
+    from transmogrifai_tpu.obs.goodput import build_report
+    from transmogrifai_tpu.obs.trace import TRACER
+    from transmogrifai_tpu.runtime.faults import (
+        SITE_DEVICE_DISPATCH, FaultPlan, FaultSpec)
+    from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+    from transmogrifai_tpu.workflow.serialization import model_fingerprint
+
+    resilience = {
+        "window": 32, "min_window": 8,
+        "breaker_failures": 3, "half_open_after_s": 0.25,
+        "probe_successes": 1,
+        "watchdog_period_s": 0.05, "watchdog_stall_s": 0.75,
+    }
+    config = FleetConfig(
+        models={"a": dirs["a"], "b": dirs["b"], "c": dirs["c"]},
+        tenants={"gold": {"priority": 1}, "trial": {"priority": 0}},
+        serving={"max_batch": _MAX_BATCH, "batch_wait_ms": 1.0,
+                 "max_queue": 256},
+        resilience=resilience)
+    report: Dict[str, Any] = {"resilience_params": resilience}
+    with TRACER.span("run:chaos", category="run", new_trace=True) as root:
+        fleet = FleetService(config).start()
+        try:
+            v_a_old = model_fingerprint(dirs["a"])
+            # the rollback chain the degraded fallback rides: member a
+            # now holds [a, a_v2] with a_v2 active
+            swap = fleet.reload_model("a", dirs["a_v2"])
+            assert swap["status"] == "swapped", swap
+            v_a_new = swap["version"]
+
+            # -- scenario 1: device-error storm on member a ------------- #
+            clients = [_LoadClient(fleet, "gold", "a", 0),
+                       _LoadClient(fleet, "gold", "b", 1),
+                       _LoadClient(fleet, "trial", "c", 2)]
+            for c in clients:
+                c.start()
+            time.sleep(0.4)  # clean baseline traffic first
+            storm = FaultPlan(
+                [FaultSpec(site=f"{SITE_DEVICE_DISPATCH}#a", at=1,
+                           times=8, kind="error")], seed=seed)
+            t_storm = time.perf_counter()
+            with storm.active():
+                quarantined = _wait_state(fleet, "a", "quarantined",
+                                          timeout_s=10.0)
+                recovered = _wait_state(fleet, "a", "healthy",
+                                        timeout_s=15.0)
+            recovery_wall = time.perf_counter() - t_storm
+            time.sleep(max(0.2, load_s - recovery_wall - 0.4))
+            for c in clients:
+                c.stop()
+            for c in clients:
+                c.join(timeout=5)
+            a_health = fleet.models()["a"]["health"]
+            member_a = fleet._services["a"]
+            fallback_series = member_a.registry.to_json().get(
+                "serving_degraded_fallback_total", {"series": []})["series"]
+            fallback_n = int(sum(s.get("value", 0)
+                                 for s in fallback_series))
+            mttrs = [t.get("recovery_s") for t in a_health["transitions"]
+                     if t.get("recovery_s") is not None]
+            report["storm"] = {
+                "member": "a", "fired": len(storm.fired),
+                "quarantined": quarantined, "recovered": recovered,
+                "breaker_opens": a_health["breaker_opens"],
+                "breaker_closes": a_health["breaker_closes"],
+                "transitions": a_health["transitions"],
+                "mttr_s": (round(float(mttrs[-1]), 4) if mttrs else None),
+                "fallback_requests": fallback_n,
+                "fallback_version_responses":
+                    clients[0].versions.get(v_a_old, 0),
+                "active_version_before": v_a_new,
+                "fallback_version": v_a_old,
+            }
+            report["tenants"] = {f"{c.tenant}:{c.model}": c.stats()
+                                 for c in clients}
+
+            # -- scenario 2: killed scoring thread on member b ---------- #
+            report["kill"] = _run_thread_death(
+                fleet, "b", FaultPlan(
+                    [FaultSpec(site=f"{SITE_DEVICE_DISPATCH}#b", at=1,
+                               kind="kill")], seed=seed))
+
+            # -- scenario 3: stalled dispatch on member c --------------- #
+            stall_budget = resilience["watchdog_stall_s"]
+            report["stall"] = _run_thread_death(
+                fleet, "c", FaultPlan(
+                    [FaultSpec(site=f"{SITE_DEVICE_DISPATCH}#c", at=1,
+                               kind="delay", delay_s=3.0)], seed=seed),
+                stall_budget_s=stall_budget)
+            # give the stale (sleeping) thread time to wake and exit
+            # before scenario 4's traffic lands on the same fleet
+            time.sleep(0.3)
+
+            # -- scenario 4: corrupt reload under concurrent traffic ---- #
+            corrupt_dir = os.path.join(
+                os.path.dirname(dirs["b"]), "b_corrupt")
+            flipped = _corrupt_copy(dirs["b"], corrupt_dir)
+            steady = _LoadClient(fleet, "gold", "b", 9)
+            steady.start()
+            time.sleep(0.2)
+            v_b = fleet.models()["b"]["model_version"]
+            rejected: Optional[str] = None
+            try:
+                fleet.reload_model("b", corrupt_dir)
+            except Exception as e:
+                rejected = f"{type(e).__name__}: {e}"[:160]
+            time.sleep(0.3)
+            steady.stop()
+            steady.join(timeout=5)
+            report["reload"] = {
+                "flipped_file": os.path.basename(flipped),
+                "rejected": rejected is not None,
+                "rejection": rejected,
+                "resident_version_kept":
+                    fleet.models()["b"]["model_version"] == v_b,
+                "traffic": steady.stats(),
+            }
+        finally:
+            fleet.stop()
+    gp = build_report(root, TRACER.trace_spans(root.trace_id)).to_json()
+    report["goodput_resilience"] = gp.get("resilience") or {}
+    return report
+
+
+def _run_thread_death(fleet, member: str, plan,
+                      stall_budget_s: Optional[float] = None
+                      ) -> Dict[str, Any]:
+    """One request into an injected thread-death (kill) or wedge
+    (delay): the client MUST be answered (response or structured error,
+    never a hang), the watchdog must restart the loop, and the next
+    request must score normally."""
+    from transmogrifai_tpu.serving.batcher import ScoreError
+
+    svc = fleet._services[member]
+    before = _restart_count(svc)
+    outcome: Dict[str, Any] = {}
+
+    def fire() -> None:
+        t0 = time.perf_counter()
+        try:
+            fleet.score(member, [dict(ROW)], tenant="gold",
+                        deadline_ms=10_000)
+            outcome["answer"] = "scored"
+        except ScoreError as e:
+            outcome["answer"] = e.code
+        except Exception as e:  # pragma: no cover - diagnostics only
+            outcome["answer"] = f"{type(e).__name__}"
+        outcome["answered_in_s"] = round(time.perf_counter() - t0, 4)
+
+    with plan.active():
+        th = threading.Thread(target=fire, name=f"chaos-{member}-victim")
+        th.start()
+        th.join(timeout=10.0)
+        hung = th.is_alive()
+        # wait for the watchdog restart to land before clearing the plan
+        t0 = time.perf_counter()
+        while _restart_count(svc) == before and \
+                time.perf_counter() - t0 < 5.0:
+            time.sleep(0.02)
+    restarts = _restart_count(svc) - before
+    # post-recovery: the member must score again
+    recovered = None
+    for _ in range(40):
+        try:
+            fleet.score(member, [dict(ROW)], tenant="gold",
+                        deadline_ms=10_000)
+            recovered = True
+            break
+        except Exception:
+            recovered = False
+            time.sleep(0.05)
+    out = {"member": member, "hung": hung, "restarts": restarts,
+           "recovered": bool(recovered), **outcome}
+    if stall_budget_s is not None:
+        period = svc.resilience.watchdog_period_s
+        out["stall_budget_s"] = stall_budget_s
+        out["within_budget"] = (
+            not hung and outcome.get("answered_in_s", 99.0)
+            <= stall_budget_s + 4 * period + 0.5)
+    return out
+
+
+def _restart_count(svc) -> int:
+    series = svc.registry.to_json().get(
+        "serving_watchdog_restarts_total", {"series": []})["series"]
+    return int(sum(s.get("value", 0) for s in series))
+
+
+def run_continual_crash(tmp: str) -> Dict[str, Any]:
+    """Scenario 5 (bench): an injected kill escapes a continual cycle's
+    own handling mid-flight; the supervisor restarts under backoff and
+    the NEXT cycle still runs — continual training must never silently
+    stop. Returns {supervisor_restarts, next_cycle_ran, ...}."""
+    import numpy as np
+
+    from transmogrifai_tpu.continual import ContinualLoop, ContinualParams
+    from transmogrifai_tpu.data.columnar_store import ColumnarStore
+    from transmogrifai_tpu.obs.metrics import get_registry
+    from transmogrifai_tpu.runtime.faults import (
+        SITE_HOLDOUT_EVAL, FaultPlan, FaultSpec, InjectedKill)
+
+    rng = np.random.default_rng(29)
+    n, d = 600, 4
+    beta = rng.normal(size=d)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ beta > 0).astype(np.float32)
+    w = ColumnarStore.create(f"{tmp}/chaos-store", n, d, dtype="float32")
+    w.write_chunk(0, X, y)
+    store = w.close()
+    params = ContinualParams(window_rows=512, min_window_rows=128,
+                             check_interval_s=0.1)
+    loop = ContinualLoop(store, f"{tmp}/chaos-model", params=params,
+                         seed=29)
+    loop.train_initial()
+
+    cycles: List[str] = []
+    real_cycle = loop.run_cycle
+
+    def cycle_with_kill():
+        from transmogrifai_tpu.runtime.faults import fault_point
+        fault_point(SITE_HOLDOUT_EVAL)
+        result = real_cycle()
+        cycles.append(result["status"])
+        return result
+
+    loop.run_cycle = cycle_with_kill
+    reg = get_registry()
+
+    def restarts() -> int:
+        series = reg.to_json().get(
+            "continual_supervisor_restarts_total",
+            {"series": []})["series"]
+        return int(sum(s.get("value", 0) for s in series))
+
+    before = restarts()
+    plan = FaultPlan([FaultSpec(site=SITE_HOLDOUT_EVAL, at=1,
+                                kind="kill")])
+    loop.start()
+    try:
+        with plan.active():
+            loop._wake.set()
+            t0 = time.perf_counter()
+            while restarts() == before and \
+                    time.perf_counter() - t0 < 10.0:
+                time.sleep(0.05)
+        # the restarted supervisor's next poll must complete a cycle
+        t0 = time.perf_counter()
+        while not cycles and time.perf_counter() - t0 < 10.0:
+            loop._wake.set()
+            time.sleep(0.05)
+    finally:
+        loop.stop()
+    return {
+        "supervisor_restarts": restarts() - before,
+        "next_cycle_ran": bool(cycles),
+        "next_cycle_status": cycles[0] if cycles else None,
+        "kill_type": InjectedKill.__name__,
+    }
+
+
+def main() -> int:  # noqa: C901 (one linear acceptance script)
+    os.environ.setdefault("TRANSMOGRIFAI_PERF_MODEL", "0")
+    with tempfile.TemporaryDirectory(prefix="chaos-smoke-") as tmp:
+        dirs = _train_models(tmp)
+        report = run_chaos(dirs, seed=0)
+        try:
+            storm = report["storm"]
+            assert storm["quarantined"] and storm["recovered"], \
+                f"no HEALTHY->QUARANTINED->HEALTHY round trip: {storm}"
+            assert storm["breaker_opens"] >= 1 \
+                and storm["breaker_closes"] >= 1, storm
+            assert storm["mttr_s"] is not None and storm["mttr_s"] > 0, \
+                f"no measured MTTR: {storm}"
+            assert storm["fallback_requests"] > 0, \
+                f"breaker open but no degraded fallback served: {storm}"
+            assert storm["fallback_version_responses"] > 0, \
+                "no response carried the resident PREVIOUS version id " \
+                f"during the storm: {storm}"
+            by_model = {c["model"]: c
+                        for c in report["tenants"].values()}
+            for m in ("b", "c"):
+                assert by_model[m]["errors"] == 0, \
+                    f"untouched member {m} saw errors: {by_model[m]}"
+                assert by_model[m]["p99_ms"] < 2000.0, by_model[m]
+            kill = report["kill"]
+            assert not kill["hung"] and kill["restarts"] >= 1, kill
+            assert kill["answer"] != "scored" and "answered_in_s" in kill, \
+                f"killed-thread client not answered structurally: {kill}"
+            assert kill["recovered"], kill
+            stall = report["stall"]
+            assert stall["within_budget"], \
+                f"stall not recovered within budget: {stall}"
+            assert stall["restarts"] >= 1 and stall["recovered"], stall
+            rel = report["reload"]
+            assert rel["rejected"] and rel["resident_version_kept"], rel
+            assert rel["traffic"]["errors"] == 0, \
+                f"corrupt reload disturbed live traffic: {rel}"
+            gp = report["goodput_resilience"]
+            assert gp.get("breaker_opens", 0) >= 1 \
+                and gp.get("recoveries", 0) >= 1, gp
+        except AssertionError as e:
+            print(f"chaos-smoke FAILED: {e}", file=sys.stderr)
+            return 1
+    a = report["storm"]
+    print(f"chaos-smoke OK: storm tripped member a's breaker "
+          f"({a['breaker_opens']} open/{a['breaker_closes']} close, "
+          f"MTTR {a['mttr_s']}s), fallback served "
+          f"{a['fallback_requests']} request(s) on the previous version; "
+          f"untouched members 0 errors "
+          f"(p99 b={by_model['b']['p99_ms']}ms "
+          f"c={by_model['c']['p99_ms']}ms); killed thread answered in "
+          f"{report['kill']['answered_in_s']}s "
+          f"({report['kill']['answer']}); stall answered in "
+          f"{report['stall']['answered_in_s']}s (budget "
+          f"{report['stall']['stall_budget_s']}s); corrupt reload "
+          f"rejected with resident version serving")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

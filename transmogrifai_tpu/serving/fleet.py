@@ -310,6 +310,14 @@ class FleetMemberService(ScoringService):
         self._pool = pool
         self.shared_from: Optional[str] = None
         super().__init__(**kw)
+        # the FleetService-level watchdog supervises every member; a
+        # per-member watchdog thread would be N redundant supervisors
+        self._own_watchdog = False
+        # chaos plans target one member's fault sites by name
+        # (serving.device_dispatch#<member>) and health events carry it
+        self.fault_scope = fleet_name
+        if self._health is not None:
+            self._health.member = fleet_name
 
     def _install(self, model, version_id: str, path: Optional[str] = None):
         scorer = model._ensure_compiled()
@@ -342,9 +350,14 @@ class FleetConfig:
     serving: Dict[str, Any] = field(default_factory=dict)
     compile_cache: Optional[bool] = None
     compile_cache_dir: Optional[str] = None
+    # serving/resilience.ResilienceParams JSON: shared default for every
+    # member's health machine / breaker / watchdog (a member spec's
+    # serving overrides may still pin its own `resilience` block)
+    resilience: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("models", "tenants", "default_tenant", "shed_watermark",
-               "serving", "compile_cache", "compile_cache_dir")
+               "serving", "compile_cache", "compile_cache_dir",
+               "resilience")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "FleetConfig":
@@ -399,6 +412,20 @@ class FleetService:
         self._services: Dict[str, FleetMemberService] = {}
         self._started = False
         self.started_at = time.time()
+        # fleet-level hang watchdog: ONE supervisor heartbeats every
+        # member's scoring loop (serving/resilience.Watchdog); members
+        # skip their own per-service watchdog threads
+        from transmogrifai_tpu.serving.resilience import (
+            ResilienceParams, Watchdog)
+        self._resilience = ResilienceParams.from_json(
+            self.config.resilience
+            or (self.config.serving or {}).get("resilience"))
+        self.watchdog: Optional[Watchdog] = None
+        if self._resilience.enabled:
+            self.watchdog = Watchdog(
+                self._live_services,
+                period_s=self._resilience.watchdog_period_s,
+                name="fleet-watchdog")
         self._m_models = self.registry.gauge(
             "fleet_models", "models currently hosted by this process")
         self._m_shared = self.registry.gauge(
@@ -410,9 +437,16 @@ class FleetService:
 
     # -- membership -------------------------------------------------------- #
 
+    def _live_services(self) -> Dict[str, FleetMemberService]:
+        with self._lock:
+            return {k: v for k, v in self._services.items()
+                    if v is not None}
+
     def _serving_config(self, overrides: Dict[str, Any]) -> ServingConfig:
         base = dict(self.config.serving or {})
         base.update(overrides or {})
+        if self.config.resilience is not None:
+            base.setdefault("resilience", self.config.resilience)
         if self.config.compile_cache is not None:
             base.setdefault("compile_cache", self.config.compile_cache)
         if self.config.compile_cache_dir is not None:
@@ -503,9 +537,13 @@ class FleetService:
                         if s is not None]
         for svc in services:
             svc.start()
+        if self.watchdog is not None:
+            self.watchdog.start()
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         with self._lock:
             self._started = False
             services = [s for s in self._services.values()
@@ -605,14 +643,29 @@ class FleetService:
 
     def health(self) -> Dict[str, Any]:
         models = self.models()
-        ok = bool(models) and all(m["status"] == "ok"
-                                  for m in models.values())
-        return {
-            "status": "ok" if (self._started and ok) else "down",
+        statuses = [m["status"] for m in models.values()]
+        if not self._started or not models or \
+                not any(s == "ok" for s in statuses):
+            status = "down"
+        elif all(s == "ok" for s in statuses):
+            status = "ok"
+        else:
+            # one member quarantined/down must NOT 503 the whole fleet:
+            # the healthy members keep taking traffic, balancers see
+            # "degraded" with the per-member breakdown
+            status = "degraded"
+        out = {
+            "status": status,
             "models": models,
             "tenants": self.router.snapshot(),
             "shared_programs": self.pool.report(),
         }
+        if status == "down":
+            hints = [float(m.get("retry_after_s") or 0.0)
+                     for m in models.values()]
+            hints.append(self._resilience.watchdog_period_s)
+            out["retry_after_s"] = round(max(hints), 3)
+        return out
 
     def metrics_json(self) -> Dict[str, Any]:
         with self._lock:

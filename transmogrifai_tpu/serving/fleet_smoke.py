@@ -159,12 +159,12 @@ def main() -> int:  # noqa: C901 (one linear acceptance script)
                         counts[key] += 1
 
             stop_at = time.perf_counter() + 2.0
-            threads = [threading.Thread(target=client, args=args)
-                       for args in (("gold", "a", stop_at),
-                                    ("gold", "b", stop_at),
-                                    ("gold", "c", stop_at),
-                                    ("trial", "c", stop_at),
-                                    ("trial", "c", stop_at))]
+            specs = (("gold", "a", stop_at), ("gold", "b", stop_at),
+                     ("gold", "c", stop_at), ("trial", "c", stop_at),
+                     ("trial", "c", stop_at))
+            threads = [threading.Thread(target=client, args=args,
+                                        name=f"smoke-client-{i}")
+                       for i, args in enumerate(specs)]
             for th in threads:
                 th.start()
             for th in threads:
@@ -190,7 +190,8 @@ def main() -> int:  # noqa: C901 (one linear acceptance script)
                     except Exception:
                         errors[model] += 1
 
-            steady_threads = [threading.Thread(target=steady, args=(m,))
+            steady_threads = [threading.Thread(target=steady, args=(m,),
+                                               name=f"smoke-steady-{m}")
                               for m in ("b", "c")]
             for th in steady_threads:
                 th.start()

@@ -12,8 +12,14 @@ Endpoints:
   ``{"scores": [...], "model_version": "...", "latency_ms": ...}``;
   a single ``{"row": {...}}`` is accepted as shorthand. Structured
   errors map to status codes: 429 queue_full, 504 deadline_exceeded,
-  400 bad_request, 422 record_error, 503 shutdown, 500 internal.
-- ``GET /healthz``  liveness + active version + queue depth.
+  400 bad_request, 422 record_error, 503 shutdown/circuit_open/
+  watchdog_restart, 500 internal. Every 429/503 carries a
+  ``Retry-After`` header derived from the token-bucket refill or
+  breaker half-open deadline, so well-behaved clients back off
+  instead of hammering a tripped member.
+- ``GET /healthz``  liveness + active version + queue depth + the
+  member's resilience health state; a quarantined/down service answers
+  503 with ``Retry-After``.
 - ``GET /metrics``  Prometheus text (default) or JSON with
   ``?format=json``.
 - ``POST /reload``  ``{"model_location": "dir"}`` hot-swap, or
@@ -49,8 +55,23 @@ _ERROR_STATUS = {
     "not_found": 404,
     "record_error": 422,
     "shutdown": 503,
+    "circuit_open": 503,
+    "watchdog_restart": 503,
     "internal": 500,
 }
+
+
+def _retry_after_header(retry_after_s: Optional[float],
+                        default_s: float = 1.0) -> str:
+    """HTTP Retry-After delta-seconds: at least 1 (a 0 would tell
+    clients to hammer right back — the opposite of the point), at most
+    an hour (a non-finite or runaway hint must never overflow the
+    integer header or tell clients to go away for a day)."""
+    import math
+    v = default_s if retry_after_s is None else float(retry_after_s)
+    if not math.isfinite(v):
+        v = 3600.0
+    return str(max(1, int(math.ceil(min(v, 3600.0)))))
 
 
 def metrics_text(service: ScoringService) -> str:
@@ -96,15 +117,44 @@ class _JSONHandler(BaseHTTPRequestHandler):
         log.debug("http: " + fmt, *args)
 
     def _send(self, status: int, body: bytes,
-              content_type: str = "application/json") -> None:
+              content_type: str = "application/json",
+              headers: Optional[Dict[str, str]] = None) -> None:
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, status: int, payload: Dict[str, Any]) -> None:
-        self._send(status, json.dumps(payload, default=_jsonable).encode())
+    def _send_json(self, status: int, payload: Dict[str, Any],
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        self._send(status, json.dumps(payload, default=_jsonable).encode(),
+                   headers=headers)
+
+    def _send_error(self, e: ScoreError) -> None:
+        """Structured-error response. 429/503 answers carry a
+        ``Retry-After`` header (delta-seconds, ceil'd so a sub-second
+        hint still tells a well-behaved client to wait ~1s) derived
+        from the token-bucket refill or breaker half-open deadline."""
+        status = _ERROR_STATUS.get(e.code, 500)
+        headers = None
+        if status in (429, 503):
+            headers = {"Retry-After": _retry_after_header(
+                getattr(e, "retry_after_s", None))}
+        self._send_json(status, e.to_json(), headers=headers)
+
+    def _send_health(self, health: Dict[str, Any]) -> None:
+        """/healthz: 200 only when fully healthy; degraded fleets stay
+        200 (they serve), quarantined/down members 503 with a
+        Retry-After derived from the breaker half-open deadline /
+        watchdog cadence."""
+        if health["status"] in ("ok", "degraded"):
+            self._send_json(200, health)
+            return
+        self._send_json(503, health, headers={
+            "Retry-After": _retry_after_header(
+                health.get("retry_after_s"))})
 
     def _read_json(self) -> Dict[str, Any]:
         length = int(self.headers.get("Content-Length") or 0)
@@ -133,9 +183,7 @@ class _Handler(_JSONHandler):
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler casing)
         path, _, query = self.path.partition("?")
         if path == "/healthz":
-            health = self.service.health()
-            status = 200 if health["status"] == "ok" else 503
-            self._send_json(status, health)
+            self._send_health(self.service.health())
         elif path == "/metrics":
             if "format=json" in query:
                 self._send_json(200, metrics_json(self.service))
@@ -159,7 +207,7 @@ class _Handler(_JSONHandler):
                 self._send_json(404, {"error": "not_found",
                                       "message": f"no route {path}"})
         except ScoreError as e:
-            self._send_json(_ERROR_STATUS.get(e.code, 500), e.to_json())
+            self._send_error(e)
         except Exception as e:  # keep the server alive on handler bugs
             log.exception("http: unhandled error on %s", path)
             self._send_json(500, {"error": "internal",
@@ -274,9 +322,7 @@ class _FleetHandler(_JSONHandler):
     def do_GET(self) -> None:  # noqa: N802
         path, _, query = self.path.partition("?")
         if path == "/healthz":
-            health = self.fleet.health()
-            status = 200 if health["status"] == "ok" else 503
-            self._send_json(status, health)
+            self._send_health(self.fleet.health())
         elif path == "/models":
             self._send_json(200, {"models": self.fleet.models()})
         elif path == "/metrics":
@@ -301,7 +347,7 @@ class _FleetHandler(_JSONHandler):
                 self._send_json(404, {"error": "not_found",
                                       "message": f"no route {path}"})
         except ScoreError as e:
-            self._send_json(_ERROR_STATUS.get(e.code, 500), e.to_json())
+            self._send_error(e)
         except Exception as e:  # keep the server alive on handler bugs
             log.exception("http: unhandled fleet error on %s", path)
             self._send_json(500, {"error": "internal",

@@ -1,0 +1,449 @@
+"""Serving-plane resilience: health states, circuit breakers, watchdog.
+
+Everything fault-tolerant built so far (`runtime/faults`, `RetryPolicy`,
+journal resume) protects *training*; this module is the serving plane's
+defense. A fleet that melts down on one bad member is not goodput
+(arxiv 2502.06982), and the multi-model servable lifecycle mirrored
+from TF-Serving (arxiv 1605.08695) assumes exactly this health-state +
+supervision layer. Three mechanisms, one per failure mode:
+
+**Per-member health state machine** (`MemberHealth`). Each
+`ScoringService` rolls its recent request outcomes + latencies through
+a bounded window and walks HEALTHY → DEGRADED → QUARANTINED:
+
+- DEGRADED: rolling error rate past ``degraded_error_rate`` (with at
+  least ``min_window`` samples) — the member serves but is flagged;
+- QUARANTINED: error rate past ``quarantine_error_rate``, OR the
+  circuit breaker is open, OR the watchdog found the scoring loop
+  wedged. New requests to a quarantined member with no fallback
+  version FAST-FAIL with a structured ``circuit_open`` error (plus a
+  retry-after hint) instead of queueing into a dead batcher;
+- recovery is half-open: every ``half_open_after_s`` one probe batch is
+  dispatched on the primary path; ``probe_successes`` consecutive probe
+  wins close the breaker, clear the window, and restore HEALTHY.
+  Transitions are recorded (bounded history + ``health_transition``
+  events) with the measured outage duration on recovery — the MTTR the
+  goodput report and the chaos bench roll up.
+
+**Circuit breaker + degraded fallback.** ``breaker_failures``
+CONSECUTIVE device-dispatch failures open the member's breaker. While
+open, if a resident previous version exists (the hot-swap rollback
+chain), batches auto-fall-back to scoring on it — the member degrades
+to known-good answers (`serving_degraded_fallback_total`, a
+``degraded_fallback`` goodput event) instead of going dark; with no
+fallback the member fast-fails as above. Only PRIMARY-path dispatch
+outcomes feed the breaker: batch-assembly errors and fallback results
+count toward the health window but never toward the breaker.
+
+**Hang watchdog** (`Watchdog`). A fleet-level supervisor thread
+heartbeats every member's scoring loop via its per-batch liveness
+timestamp. A loop wedged past ``watchdog_stall_s`` (or a scoring
+thread killed outright — an `InjectedKill` or real fatal error sails
+through the loop's ``except Exception``) gets its in-flight batch
+quarantined per-request (structured ``watchdog_restart`` errors — no
+client ever hangs forever on a wedged jit dispatch), the scoring
+thread restarted under a fresh generation, and the event recorded
+(`serving_watchdog_restarts_total` + ``watchdog_restart`` event).
+
+All knobs live in `ResilienceParams`, JSON-threaded through
+``ServingConfig.resilience`` / ``ServingParams.resilience`` / cli
+``serve``. The deterministic exercise machinery is `runtime/faults`
+(sites ``serving.device_dispatch`` / ``serving.batch_assemble`` /
+``serving.reload_load``) and the chaos harness (`serving/chaos.py`,
+``make chaos-smoke``, ``python bench.py chaos``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["HEALTHY", "DEGRADED", "QUARANTINED", "ResilienceParams",
+           "MemberHealth", "Watchdog"]
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+QUARANTINED = "quarantined"
+
+
+@dataclass
+class ResilienceParams:
+    """Knobs for the serving resilience layer (JSON-loadable via
+    ``ServingConfig.resilience`` / ``ServingParams.resilience``)."""
+
+    enabled: bool = True
+    # rolling request-outcome window (count-based; per member)
+    window: int = 64
+    min_window: int = 16           # floor before error-rate judgments
+    degraded_error_rate: float = 0.25
+    quarantine_error_rate: float = 0.6
+    # consecutive PRIMARY device-dispatch failures that open the breaker
+    breaker_failures: int = 5
+    # open -> half-open probe cadence, and probes needed to close
+    half_open_after_s: float = 1.0
+    probe_successes: int = 2
+    # hang watchdog: supervisor poll period and per-batch stall budget
+    watchdog_period_s: float = 0.25
+    watchdog_stall_s: float = 30.0
+
+    _FIELDS = ("enabled", "window", "min_window", "degraded_error_rate",
+               "quarantine_error_rate", "breaker_failures",
+               "half_open_after_s", "probe_successes",
+               "watchdog_period_s", "watchdog_stall_s")
+
+    def __post_init__(self):
+        if self.window < 1 or self.min_window < 1:
+            raise ValueError("window sizes must be >= 1")
+        if self.min_window > self.window:
+            # the deque caps at `window` samples, so a larger floor
+            # could never be reached — the error-rate machine would be
+            # silently inert under a 100% error rate
+            raise ValueError(
+                f"min_window ({self.min_window}) must be <= window "
+                f"({self.window})")
+        if not (0.0 < self.degraded_error_rate
+                <= self.quarantine_error_rate <= 1.0):
+            raise ValueError(
+                "need 0 < degraded_error_rate <= quarantine_error_rate "
+                f"<= 1, got {self.degraded_error_rate} / "
+                f"{self.quarantine_error_rate}")
+        if self.breaker_failures < 1:
+            raise ValueError("breaker_failures must be >= 1")
+        if self.half_open_after_s <= 0 or self.watchdog_period_s <= 0 \
+                or self.watchdog_stall_s <= 0:
+            raise ValueError("resilience periods must be > 0")
+
+    @staticmethod
+    def from_json(d: Optional[Dict[str, Any]]) -> "ResilienceParams":
+        d = d or {}
+        return ResilienceParams(**{k: d[k] for k in ResilienceParams._FIELDS
+                                   if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+
+def _record_event(name: str, **attrs: Any) -> None:
+    """Best-effort goodput event (the health path must never raise)."""
+    try:
+        from transmogrifai_tpu.obs.export import record_event
+        record_event(name, **attrs)
+    except Exception:
+        log.debug("resilience event %s emission failed", name,
+                  exc_info=True)
+
+
+class MemberHealth:
+    """One member's health state machine + circuit breaker. Thread-safe:
+    noted from the scoring thread, read from caller threads and the
+    watchdog. See module docstring for the state semantics."""
+
+    def __init__(self, params: ResilienceParams, member: str = "",
+                 registry=None):
+        self.params = params
+        self.member = member
+        self.registry = registry
+        self._lock = threading.RLock()
+        self.state = HEALTHY
+        self._window: deque = deque(maxlen=params.window)   # ok bools
+        self._latencies: deque = deque(maxlen=params.window)
+        self._consecutive = 0          # primary dispatch failures in a row
+        self._breaker_open = False
+        self._probe_streak = 0
+        self._probe_anchor = 0.0       # last open/probe tick (monotonic)
+        self._stalled = False
+        self._down_since: Optional[float] = None  # outage start (monotonic)
+        self.breaker_opens = 0
+        self.breaker_closes = 0
+        self.recoveries: list = []     # measured MTTR seconds, bounded
+        self.transitions: deque = deque(maxlen=64)
+
+    # -- introspection ------------------------------------------------------ #
+
+    @property
+    def breaker_open(self) -> bool:
+        with self._lock:
+            return self._breaker_open
+
+    def error_rate(self) -> float:
+        with self._lock:
+            if not self._window:
+                return 0.0
+            return 1.0 - sum(self._window) / len(self._window)
+
+    def retry_after_s(self) -> float:
+        """Seconds until the next half-open probe slot — the backoff a
+        fast-failed client should honor (HTTP ``Retry-After``)."""
+        with self._lock:
+            if self.state != QUARANTINED:
+                return 0.0
+            return max(0.0, self.params.half_open_after_s
+                       - (time.monotonic() - self._probe_anchor))
+
+    def _latency_quantile(self, q: float) -> float:
+        vals = sorted(self._latencies)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self.state,
+                "error_rate": round(self.error_rate(), 4),
+                "window_n": len(self._window),
+                # rolling latency over the same window the error rate
+                # judges — the /healthz-visible half of the
+                # "error-rate/latency window"
+                "latency_p50_ms": round(
+                    self._latency_quantile(0.5) * 1e3, 3),
+                "latency_p99_ms": round(
+                    self._latency_quantile(0.99) * 1e3, 3),
+                "breaker_open": self._breaker_open,
+                "breaker_opens": self.breaker_opens,
+                "breaker_closes": self.breaker_closes,
+                "consecutive_failures": self._consecutive,
+                "stalled": self._stalled,
+                "recoveries": [round(r, 4) for r in self.recoveries[-8:]],
+                "transitions": [dict(t) for t in self.transitions],
+            }
+
+    # -- admission ---------------------------------------------------------- #
+
+    def admit(self, has_fallback: bool) -> Optional[float]:
+        """None = admit. A float = FAST-FAIL with that retry-after: the
+        member is quarantined and has no resident fallback to degrade
+        onto, so queueing the request would just park it in a dead (or
+        known-broken) batcher. Probe slots are admitted so recovery can
+        actually be observed."""
+        with self._lock:
+            if self.state != QUARANTINED or has_fallback:
+                return None
+            # leave the probe slot to the scoring loop's own dispatch
+            # plan; admit one request per probe window so the probe has
+            # something to score
+            remaining = self.params.half_open_after_s - (
+                time.monotonic() - self._probe_anchor)
+            if remaining <= 0:
+                return None
+            return remaining
+
+    def probe_due(self) -> bool:
+        """While open/quarantined: claim the half-open probe slot (one
+        per ``half_open_after_s``). Mutating on purpose — exactly one
+        batch per window becomes the probe."""
+        with self._lock:
+            if not (self._breaker_open or self.state == QUARANTINED):
+                return False
+            now = time.monotonic()
+            if now - self._probe_anchor >= self.params.half_open_after_s:
+                self._probe_anchor = now
+                return True
+            return False
+
+    # -- notes from the scoring path ---------------------------------------- #
+
+    def note_request(self, ok: bool, latency_s: float = 0.0) -> None:
+        """One request outcome into the rolling window (every resolved
+        or failed scoring request, fallback included)."""
+        with self._lock:
+            self._window.append(bool(ok))
+            self._latencies.append(float(latency_s))
+            self._recompute("error_rate")
+
+    def note_dispatch(self, ok: bool, probe: bool = False) -> None:
+        """One PRIMARY-path device dispatch outcome (per batch, or per
+        quarantined single). Feeds the breaker; fallback dispatches
+        must NOT be noted here (they prove nothing about the primary)."""
+        with self._lock:
+            if ok:
+                self._consecutive = 0
+                if self._breaker_open and probe:
+                    self._probe_streak += 1
+                    if self._probe_streak >= self.params.probe_successes:
+                        self._close_breaker()
+                return
+            self._consecutive += 1
+            if self._breaker_open:
+                if probe:
+                    # failed probe: re-arm the open window
+                    self._probe_streak = 0
+                    self._probe_anchor = time.monotonic()
+                return
+            if self._consecutive >= self.params.breaker_failures:
+                self._open_breaker()
+
+    def note_stall(self, since: Optional[float] = None) -> None:
+        """The watchdog found the scoring loop wedged/dead: quarantine
+        until the restart's probes prove recovery. `since` (monotonic)
+        backdates the outage to when the batch actually stalled so the
+        recorded MTTR measures the real client-visible gap."""
+        with self._lock:
+            self._stalled = True
+            if self._down_since is None:
+                self._down_since = since if since is not None \
+                    else time.monotonic()
+            self._probe_anchor = time.monotonic()
+            self._recompute("stall")
+
+    def clear_stall(self) -> None:
+        """Scoring thread restarted: the stall itself is over; state
+        recomputes from the window/breaker (errors the stall caused may
+        keep the member DEGRADED until traffic washes them out)."""
+        with self._lock:
+            self._stalled = False
+            self._recompute("stall_recovered")
+
+    # -- internals (lock held) ---------------------------------------------- #
+
+    def _open_breaker(self) -> None:
+        self._breaker_open = True
+        self._probe_streak = 0
+        self._probe_anchor = time.monotonic()
+        if self._down_since is None:
+            self._down_since = time.monotonic()
+        self.breaker_opens += 1
+        self._counter("serving_breaker_opens_total",
+                      "circuit breakers tripped open").inc()
+        _record_event("breaker_open", member=self.member,
+                      consecutive_failures=self._consecutive)
+        log.warning("serving%s: circuit breaker OPEN after %d consecutive "
+                    "dispatch failures",
+                    f"[{self.member}]" if self.member else "",
+                    self._consecutive)
+        self._recompute("breaker_open")
+
+    def _close_breaker(self) -> None:
+        self._breaker_open = False
+        self._consecutive = 0
+        self._probe_streak = 0
+        self.breaker_closes += 1
+        # the quarantine-era errors in the window are the breaker's own
+        # history, not fresh evidence — recovery must not instantly
+        # re-degrade on them
+        self._window.clear()
+        self._latencies.clear()
+        self._counter("serving_breaker_closes_total",
+                      "circuit breakers closed by probe recovery").inc()
+        _record_event("breaker_close", member=self.member)
+        log.info("serving%s: circuit breaker closed (probe recovery)",
+                 f"[{self.member}]" if self.member else "")
+        self._recompute("breaker_close")
+
+    def _counter(self, name: str, help_text: str):
+        if self.registry is not None:
+            return self.registry.counter(name, help_text)
+
+        class _Null:
+            def inc(self, *_: Any) -> None:
+                pass
+        return _Null()
+
+    def _target_state(self) -> str:
+        if self._breaker_open or self._stalled:
+            return QUARANTINED
+        n = len(self._window)
+        if n >= self.params.min_window:
+            rate = 1.0 - sum(self._window) / n
+            if rate >= self.params.quarantine_error_rate:
+                return QUARANTINED
+            if rate >= self.params.degraded_error_rate:
+                return DEGRADED
+        return HEALTHY
+
+    def _recompute(self, reason: str) -> None:
+        target = self._target_state()
+        if target == self.state:
+            return
+        prev, self.state = self.state, target
+        entry: Dict[str, Any] = {
+            "at": time.time(), "from": prev, "to": target,
+            "reason": reason}
+        if target == QUARANTINED:
+            if self._down_since is None:
+                self._down_since = time.monotonic()
+            self._probe_anchor = time.monotonic()
+        elif prev == QUARANTINED and self._down_since is not None:
+            mttr = time.monotonic() - self._down_since
+            self._down_since = None
+            entry["recovery_s"] = round(mttr, 6)
+            self.recoveries.append(mttr)
+            del self.recoveries[:-64]
+        self.transitions.append(entry)
+        self._counter(
+            "serving_health_transitions_total",
+            "health state-machine transitions").inc()
+        _record_event("health_transition", member=self.member,
+                      **{k: v for k, v in entry.items() if k != "at"})
+        log.log(logging.WARNING if target == QUARANTINED else logging.INFO,
+                "serving%s: health %s -> %s (%s)",
+                f"[{self.member}]" if self.member else "", prev, target,
+                reason)
+
+
+class Watchdog:
+    """Fleet-level hang supervisor: heartbeats every member's scoring
+    loop and recovers wedged/dead ones. ``members`` is a zero-arg
+    callable returning the CURRENT name -> service map (fleet
+    membership is dynamic); each service exposes ``check_liveness()``
+    (None | "dead" | "stalled") and ``recover_scoring_thread(reason)``.
+    The watchdog itself must never die: each sweep is exception-
+    isolated per member."""
+
+    def __init__(self, members: Callable[[], Dict[str, Any]],
+                 period_s: float = 0.25, name: str = "serving-watchdog"):
+        self._members = members
+        self.period_s = float(period_s)
+        self.name = name
+        self._halt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.restarts = 0
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._halt.clear()
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._halt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def sweep(self) -> int:
+        """One supervision pass (also callable synchronously in tests):
+        recover every member whose scoring loop is dead or stalled.
+        Returns the number of restarts performed."""
+        n = 0
+        try:
+            members = dict(self._members() or {})
+        except Exception:
+            log.exception("watchdog: membership enumeration failed")
+            return 0
+        for name, svc in members.items():
+            if svc is None:
+                continue
+            try:
+                reason = svc.check_liveness()
+                if reason is not None:
+                    svc.recover_scoring_thread(reason)
+                    self.restarts += 1
+                    n += 1
+            except Exception:
+                log.exception("watchdog: recovery of member %r failed",
+                              name)
+        return n
+
+    def _run(self) -> None:
+        while not self._halt.wait(timeout=self.period_s):
+            self.sweep()

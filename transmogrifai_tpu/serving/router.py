@@ -91,6 +91,25 @@ class TokenBucket:
                 return True
             return False
 
+    def refill_eta_s(self, n: float) -> float:
+        """Seconds until `n` tokens would accumulate at the sustained
+        rate — the ``Retry-After`` for a shed request (0 when unmetered
+        or already affordable). For n > burst this is optimistic (the
+        bucket can never hold n; the client should split the request),
+        but still a sane backoff rather than 0. Capped at an hour: a
+        zero-rate (blocked) tenant or a huge deficit must yield a
+        finite, JSON-safe hint, never inf (which would overflow the
+        HTTP Retry-After integer)."""
+        if math.isinf(self.rate):
+            return 0.0
+        with self._lock:
+            now = time.monotonic()
+            tokens = min(self.burst,
+                         self._tokens + (now - self._last) * self.rate)
+            need = float(n) - tokens
+            eta = need / self.rate if self.rate > 0 else math.inf
+            return max(0.0, min(eta, 3600.0))
+
 
 class _TenantState:
     __slots__ = ("policy", "bucket", "requests", "rows", "shed", "errors")
@@ -181,18 +200,24 @@ class Router:
         floor = self._shed_floor(queue_frac)
         if floor is not None and state.policy.priority < floor:
             self._shed(name, state, model, "shed_low_priority")
+            # backoff hint scaled by how deep past the watermark the
+            # queue is: pressure at the watermark suggests a short
+            # retry, pressure at capacity a full second
             raise ScoreError(
                 "shed_low_priority",
                 f"tenant {name!r} (priority {state.policy.priority}) shed "
                 f"under queue pressure ({queue_frac:.0%} of capacity); "
-                "retry with backoff")
-        if not state.bucket.try_take(max(1, int(n_rows))):
+                "retry with backoff",
+                retry_after_s=round(max(0.1, min(1.0, queue_frac)), 3))
+        n_take = max(1, int(n_rows))
+        if not state.bucket.try_take(n_take):
             self._shed(name, state, model, "quota_exceeded")
             raise ScoreError(
                 "quota_exceeded",
                 f"tenant {name!r} over its row quota "
                 f"({state.policy.rate:g} rows/s, burst "
-                f"{state.bucket.burst:g}); retry after backoff")
+                f"{state.bucket.burst:g}); retry after backoff",
+                retry_after_s=round(state.bucket.refill_eta_s(n_take), 3))
         return name
 
     def _shed(self, name: str, state: "_TenantState", model: str,

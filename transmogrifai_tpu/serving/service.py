@@ -40,9 +40,14 @@ from transmogrifai_tpu import types as T
 from transmogrifai_tpu.data.dataset import Dataset
 from transmogrifai_tpu.obs.metrics import MetricsRegistry
 from transmogrifai_tpu.obs.trace import TRACER
+from transmogrifai_tpu.runtime.faults import (
+    SITE_BATCH_ASSEMBLE, SITE_DEVICE_DISPATCH, SITE_RELOAD_LOAD,
+    fault_point)
 from transmogrifai_tpu.serving.batcher import (
     MicroBatcher, Request, ScoreError, bucket_for, bucket_ladder,
     derive_ladder, pad_requests)
+from transmogrifai_tpu.serving.resilience import (
+    QUARANTINED, MemberHealth, ResilienceParams, Watchdog)
 from transmogrifai_tpu.workflow.compiled import slice_result_tree
 
 log = logging.getLogger(__name__)
@@ -88,6 +93,11 @@ class ServingConfig:
     # swap) that matches the manifest reports the recovered compile
     # seconds as `serving_compile_cache_saved_s`
     warmup_manifest: bool = True
+    # serving resilience knobs (serving/resilience.ResilienceParams as a
+    # JSON dict): per-member health state machine, circuit breaker +
+    # degraded fallback, hang watchdog. None = defaults (enabled);
+    # {"enabled": false} turns the layer off
+    resilience: Optional[Dict[str, Any]] = None
 
     def ladder(self) -> Tuple[int, ...]:
         if self.buckets:
@@ -245,6 +255,24 @@ class ScoringService:
             batch_wait_s=self.config.batch_wait_ms / 1000.0)
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        # resilience layer: health state machine + breaker + watchdog
+        # bookkeeping (serving/resilience.py). `_generation` fences the
+        # scoring thread: a watchdog restart bumps it, and a stale
+        # (formerly wedged) loop that wakes later sees the mismatch and
+        # exits without touching shared state.
+        self.resilience = ResilienceParams.from_json(
+            self.config.resilience)
+        self.fault_scope: Optional[str] = None  # fleet member name
+        self._health: Optional[MemberHealth] = None
+        if self.resilience.enabled:
+            self._health = MemberHealth(self.resilience, registry=self.registry)
+        self._generation = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight: List[Request] = []
+        self._busy_since: Optional[float] = None
+        self._watchdog: Optional[Watchdog] = None
+        self._own_watchdog = True   # fleet members are fleet-supervised
+        self._m_fallback = None     # created lazily with member label
         self.started_at = time.time()          # epoch timestamp (display)
         self._started_mono = time.monotonic()  # uptime arithmetic (L009)
         self._trace_parent = None  # span the batcher thread nests under
@@ -427,18 +455,126 @@ class ScoringService:
         # started the service (e.g. the runner's serve phase)
         self._trace_parent = TRACER.current()
         self._running = True
-        self._thread = threading.Thread(
-            target=self._serve_loop, name="scoring-batcher", daemon=True)
-        self._thread.start()
+        self._start_scoring_thread()
+        if self._health is not None and self._own_watchdog:
+            # single-service mode supervises itself; fleet members are
+            # covered by the FleetService-level watchdog instead
+            self._watchdog = Watchdog(
+                lambda: {"service": self},
+                period_s=self.resilience.watchdog_period_s)
+            self._watchdog.start()
         return self
+
+    def _start_scoring_thread(self) -> None:
+        gen = self._generation
+        self._thread = threading.Thread(
+            target=self._serve_loop, args=(gen,),
+            name=f"scoring-batcher-{gen}", daemon=True)
+        self._thread.start()
 
     def stop(self, timeout: float = 5.0) -> None:
         self._running = False
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         for req in self._batcher.close():
             req.fail(ScoreError("shutdown", "service stopped"))
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+            if self._thread is not None and self._thread.is_alive():
+                # the scoring thread is wedged (e.g. a hung dispatch):
+                # its in-flight batch must still be ANSWERED, not left
+                # blocking clients forever on a dead service
+                self._fail_inflight(ScoreError(
+                    "shutdown",
+                    "service stopped with the batch still in flight"))
             self._thread = None
+
+    # -- resilience: liveness + recovery ------------------------------------ #
+
+    def _fault_site(self, base: str) -> str:
+        """Fleet members scope injection sites by member name
+        (`serving.device_dispatch#a`) so a chaos plan can storm ONE
+        member while its peers run clean."""
+        return f"{base}#{self.fault_scope}" if self.fault_scope else base
+
+    def _has_fallback(self) -> bool:
+        with self._swap_lock:
+            return len(self._versions) >= 2
+
+    def _fail_inflight(self, error: ScoreError) -> List[Request]:
+        """Quarantine the in-flight batch per-request: every client
+        blocked on it gets a structured error NOW (never a hang)."""
+        with self._inflight_lock:
+            batch, self._inflight = self._inflight, []
+            self._busy_since = None
+        for req in batch:
+            if not req._event.is_set():
+                self._m_errors.inc()
+                if self._health is not None:
+                    self._health.note_request(False)
+                req.fail(error)
+        return batch
+
+    def check_liveness(self) -> Optional[str]:
+        """Watchdog probe: ``"dead"`` when the scoring thread exited
+        (killed by a BaseException), ``"stalled"`` when its current
+        batch has been in flight past ``watchdog_stall_s`` (a wedged
+        jit dispatch), else None."""
+        if not self._running:
+            return None
+        th = self._thread
+        if th is None:
+            return None
+        if not th.is_alive():
+            return "dead"
+        busy = self._busy_since
+        if busy is not None and (
+                time.monotonic() - busy) > self.resilience.watchdog_stall_s:
+            return "stalled"
+        return None
+
+    def recover_scoring_thread(self, reason: str) -> None:
+        """Watchdog recovery: fence off the wedged/dead loop (generation
+        bump), answer its in-flight batch with structured errors, and
+        start a fresh scoring thread over the SAME batcher (queued
+        requests keep their place). Recorded as
+        `serving_watchdog_restarts_total` + a ``watchdog_restart``
+        event; the health machine quarantines until recovery is
+        re-proven (or the window washes clean)."""
+        with self._inflight_lock:
+            stalled_since = self._busy_since
+        self._generation += 1
+        # the recovery gets its own span under the service's trace so
+        # the watchdog_restart + health_transition events it emits land
+        # in the goodput rollup (the watchdog thread has no ambient span)
+        with TRACER.span("serving:watchdog_restart", category="serving",
+                         parent=self._trace_parent, reason=reason,
+                         member=self.fault_scope or "service"):
+            if self._health is not None:
+                self._health.note_stall(since=stalled_since)
+            self._fail_inflight(ScoreError(
+                "watchdog_restart",
+                f"scoring loop {reason}; thread restarted — retry",
+                retry_after_s=self.resilience.watchdog_period_s))
+            self.registry.counter(
+                "serving_watchdog_restarts_total",
+                "scoring threads restarted by the hang watchdog",
+                reason=reason).inc()
+            try:
+                from transmogrifai_tpu.obs.export import record_event
+                record_event("watchdog_restart", reason=reason,
+                             member=self.fault_scope or "service")
+            except Exception:
+                log.debug("watchdog_restart event failed", exc_info=True)
+            log.warning("serving%s: scoring loop %s; restarting thread "
+                        "(generation %d)",
+                        f"[{self.fault_scope}]" if self.fault_scope
+                        else "", reason, self._generation)
+            if self._running:
+                self._start_scoring_thread()
+            if self._health is not None:
+                self._health.clear_stall()
 
     def __enter__(self) -> "ScoringService":
         return self.start()
@@ -457,6 +593,18 @@ class ScoringService:
         input — the service keeps serving others regardless."""
         if not self._running:
             raise ScoreError("shutdown", "service is not running")
+        if self._health is not None:
+            # quarantined member with no resident fallback: FAST-FAIL
+            # with a structured error + retry-after instead of queueing
+            # into a dead (or known-broken) batcher
+            retry_after = self._health.admit(self._has_fallback())
+            if retry_after is not None:
+                self._shed("circuit_open").inc()
+                raise ScoreError(
+                    "circuit_open",
+                    f"member quarantined (breaker open / scoring loop "
+                    f"down); retry in {retry_after:.2f}s",
+                    retry_after_s=retry_after)
         if not rows:
             raise ScoreError("bad_request", "empty rows")
         try:
@@ -529,6 +677,10 @@ class ScoringService:
         active = self._active
         if active is not None and active.version_id == vid:
             return {"status": "unchanged", "version": vid}
+        # injectable load failure (chaos: serving.reload_load) — an
+        # error here propagates to the caller while the resident
+        # version keeps serving untouched
+        fault_point(self._fault_site(SITE_RELOAD_LOAD))
         model = load_model(model_location, verify=False)  # verified above
         version = self._install(model, vid, path=model_location)
         self._m_swaps.inc()
@@ -642,8 +794,18 @@ class ScoringService:
 
     def health(self) -> Dict[str, Any]:
         active = self._active
-        return {
-            "status": "ok" if (self._running and active) else "down",
+        if not (self._running and active):
+            status = "down"
+        elif self._health is not None and \
+                self._health.state == QUARANTINED:
+            # still "serving" when a fallback version exists, but the
+            # primary path is dark — /healthz reports it as unhealthy
+            # (503 + Retry-After) so balancers drain this member
+            status = "quarantined"
+        else:
+            status = "ok"
+        out = {
+            "status": status,
             "model_version": active.version_id if active else None,
             "uptime_s": round(time.monotonic() - self._started_mono, 3),
             "queue_depth": self._batcher.depth(),
@@ -651,12 +813,30 @@ class ScoringService:
             "compile_cache": self._compile_cache_path,
             "versions": [v.info() for v in self._versions],
         }
+        if self._health is not None:
+            out["health"] = self._health.snapshot()
+            if status == "quarantined":
+                out["retry_after_s"] = round(
+                    max(self._health.retry_after_s(),
+                        self.resilience.watchdog_period_s), 3)
+        return out
 
     # -- scoring thread ---------------------------------------------------- #
 
-    def _serve_loop(self) -> None:
-        while self._running:
+    def _serve_loop(self, gen: int = 0) -> None:
+        while self._running and self._generation == gen:
             batch, expired = self._batcher.next_batch()
+            if self._generation != gen:
+                # fenced off by a watchdog restart while we were blocked:
+                # hand anything we popped back to the live loop's clients
+                # as structured errors (they were already answered if
+                # they were in flight when the restart fired)
+                for req in [*batch, *expired]:
+                    if not req._event.is_set():
+                        req.fail(ScoreError(
+                            "watchdog_restart",
+                            "scoring loop restarted; retry"))
+                return
             self._m_queue.set(self._batcher.depth())
             for req in expired:
                 self._shed("deadline_exceeded").inc()
@@ -680,8 +860,18 @@ class ScoringService:
                 threading.Thread(target=self._auto_rebucket,
                                  name="serving-rebucket",
                                  daemon=True).start()
+            with self._inflight_lock:
+                if self._generation != gen:
+                    continue  # fenced: top of loop exits
+                self._inflight = list(batch)
+                self._busy_since = time.monotonic()
+            # NO `finally` around the in-flight clear: a BaseException
+            # (InjectedKill / fatal runtime error) must leave the batch
+            # REGISTERED as in flight while it kills this thread, so the
+            # watchdog's recovery can answer those clients — a finally
+            # would wipe the list on the way out and orphan them
             try:
-                self._process(batch)
+                self._process(batch, gen)
             except Exception as e:  # the scoring thread must NEVER die
                 log.exception("serving: unexpected batch failure")
                 for req in batch:
@@ -690,33 +880,112 @@ class ScoringService:
                             "internal",
                             f"unexpected serving failure: "
                             f"{type(e).__name__}: {e}"))
+            with self._inflight_lock:
+                if self._generation == gen:
+                    self._inflight = []
+                    self._busy_since = None
 
-    def _process(self, batch: List[Request]) -> None:
-        version = self._active  # pinned: swaps cannot mis-version a batch
+    def _dispatch_plan(self) -> Tuple[ModelVersion, str]:
+        """(version, mode) for this batch. Modes:
+
+        - ``primary``: the active version, breaker closed (normal path);
+        - ``probe``: breaker open, half-open slot claimed — dispatch the
+          active version to test recovery;
+        - ``fallback``: breaker open, resident previous version exists —
+          degraded mode, serve known-good answers instead of going dark;
+        - ``reject``: breaker open, no fallback, probe not due — fail
+          the batch fast with ``circuit_open``."""
+        version = self._active
+        h = self._health
+        if h is None or not h.breaker_open:
+            return version, "primary"
+        if h.probe_due():
+            return version, "probe"
+        prev = None
+        with self._swap_lock:
+            if len(self._versions) >= 2:
+                prev = self._versions[-2]
+        if prev is not None:
+            return prev, "fallback"
+        return version, "reject"
+
+    def _live(self, gen: Optional[int]) -> bool:
+        """True while `gen` is still the current scoring generation. A
+        stale (watchdog-fenced) thread that wakes mid-batch may still
+        RESOLVE its requests (harmless — they were already answered)
+        but must not note health/breaker state or account metrics for
+        a generation it no longer belongs to."""
+        return gen is None or self._generation == gen
+
+    def _process(self, batch: List[Request],
+                 gen: Optional[int] = None) -> None:
+        version, mode = self._dispatch_plan()
         assert version is not None
+        if mode == "reject":
+            retry_after = self._health.retry_after_s() if self._health \
+                else None
+            for req in batch:
+                self._m_errors.inc()
+                req.fail(ScoreError(
+                    "circuit_open",
+                    "breaker open and no resident fallback version",
+                    retry_after_s=retry_after))
+            return
         t0 = time.monotonic()
-        try:
-            # batch ASSEMBLY is inside the quarantine too: two requests
-            # with mismatched column sets fail Dataset.concat, and that
-            # must degrade to per-request scoring, not kill the batch
-            with TRACER.span("serving:batch", category="serving",
-                             parent=self._trace_parent,
-                             requests=len(batch),
-                             version=version.version_id) as sp:
+        with TRACER.span("serving:batch", category="serving",
+                         parent=self._trace_parent,
+                         requests=len(batch), mode=mode,
+                         version=version.version_id) as sp:
+            try:
+                # batch ASSEMBLY quarantines too: two requests with
+                # mismatched column sets fail Dataset.concat, and that
+                # must degrade to per-request scoring, not kill the
+                # batch — and it is NOT a device failure, so it feeds
+                # the health window but never the breaker
+                fault_point(self._fault_site(SITE_BATCH_ASSEMBLE))
                 ds, n_valid, bucket = pad_requests(batch, self.ladder)
                 sp.set(bucket=bucket, rows=n_valid)
+            except Exception as e:
+                log.warning("serving: batch assembly of %d requests "
+                            "failed (%s); quarantining per-request",
+                            len(batch), e)
+                for req in batch:
+                    self._score_single(req, version, mode, gen)
+                return
+            try:
+                if mode != "fallback":
+                    # degraded fallback skips the site: the injected
+                    # fault models a broken ACTIVE version, and the
+                    # resident previous version is the recovery path
+                    fault_point(self._fault_site(SITE_DEVICE_DISPATCH))
                 out = version.scorer.score_padded(ds, bucket)
-        except Exception as e:
-            # error quarantine: one bad record must fail ONE request.
-            # Re-score each request alone so its batchmates still get
-            # answers; only the offender sees the error.
-            log.warning("serving: batch of %d requests failed (%s); "
-                        "quarantining per-request", len(batch), e)
-            for req in batch:
-                self._score_single(req, version)
-            return
-        self._account_batch(len(batch), n_valid, bucket,
-                            time.monotonic() - t0)
+            except Exception as e:
+                if self._live(gen):
+                    self._note_dispatch(False, mode)
+                # error quarantine: one bad record must fail ONE
+                # request. Re-score each request alone so its
+                # batchmates still get answers; only the offender sees
+                # the error.
+                log.warning("serving: batch of %d requests failed (%s); "
+                            "quarantining per-request", len(batch), e)
+                for req in batch:
+                    self._score_single(req, version, mode, gen)
+                return
+            # success-path health notes stay INSIDE the batch span:
+            # their events (breaker_close on a probe win, degraded_
+            # fallback, health_transition) attach to this trace —
+            # outside the span they would vanish from the goodput rollup
+            latency = time.monotonic() - t0
+            live = self._live(gen)
+            if live:
+                self._note_dispatch(True, mode)
+                if mode == "fallback":
+                    self._note_fallback(len(batch), version)
+                if self._health is not None:
+                    for _ in batch:
+                        self._health.note_request(True, latency)
+        if live:
+            self._account_batch(len(batch), n_valid, bucket, latency)
         off = 0
         for req in batch:
             sliced = {name: slice_result_tree(v, off, off + req.n_rows)
@@ -724,16 +993,61 @@ class ScoringService:
             req.resolve(sliced, version.version_id)
             off += req.n_rows
 
-    def _score_single(self, req: Request, version: ModelVersion) -> None:
+    def _note_dispatch(self, ok: bool, mode: str) -> None:
+        """Primary-path dispatch outcomes feed the breaker; fallback
+        dispatches prove nothing about the broken primary and stay out."""
+        if self._health is not None and mode in ("primary", "probe"):
+            self._health.note_dispatch(ok, probe=(mode == "probe"))
+
+    def _note_fallback(self, n_requests: int, version: ModelVersion) -> None:
+        if self._m_fallback is None:
+            self._m_fallback = self.registry.counter(
+                "serving_degraded_fallback_total",
+                "requests served by the resident previous version while "
+                "the breaker was open")
+        self._m_fallback.inc(n_requests)
+        try:
+            from transmogrifai_tpu.obs.export import record_event
+            record_event("degraded_fallback", requests=n_requests,
+                         member=self.fault_scope or "service",
+                         version=version.version_id)
+        except Exception:
+            log.debug("degraded_fallback event failed", exc_info=True)
+
+    def _score_single(self, req: Request, version: ModelVersion,
+                      mode: str = "primary",
+                      gen: Optional[int] = None) -> None:
+        t0 = time.monotonic()
         try:
             bucket = bucket_for(req.n_rows, self.ladder)
-            t0 = time.monotonic()
+            if mode != "fallback":
+                fault_point(self._fault_site(SITE_DEVICE_DISPATCH))
             out = version.scorer.score_padded(req.dataset, bucket)
-            self._account_batch(1, req.n_rows, bucket,
-                                time.monotonic() - t0)
+            latency = time.monotonic() - t0
+            if self._live(gen):
+                self._note_dispatch(True, mode)
+                self._account_batch(1, req.n_rows, bucket, latency)
+                if mode == "fallback":
+                    self._note_fallback(1, version)
+                if self._health is not None:
+                    self._health.note_request(True, latency)
             req.resolve(out, version.version_id)
+        except ScoreError as e:
+            # admission-shaped failure (oversized request): not a
+            # dispatch failure — never feeds the breaker
+            if self._live(gen):
+                self._m_errors.inc()
+                if self._health is not None:
+                    self._health.note_request(False,
+                                              time.monotonic() - t0)
+            req.fail(e)
         except Exception as e:
-            self._m_errors.inc()
+            if self._live(gen):
+                self._note_dispatch(False, mode)
+                self._m_errors.inc()
+                if self._health is not None:
+                    self._health.note_request(False,
+                                              time.monotonic() - t0)
             req.fail(ScoreError(
                 "record_error",
                 f"request failed scoring in isolation: "

@@ -81,12 +81,16 @@ class ServingParams:
     # quotas/priorities, shared bucket programs) instead of the
     # single-model service
     fleet: Optional[Dict[str, Any]] = None
+    # serving/resilience.ResilienceParams JSON: health state machine,
+    # circuit breaker + degraded fallback, hang watchdog (None =
+    # defaults, enabled; {"enabled": false} turns the layer off)
+    resilience: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("host", "port", "max_batch", "min_bucket", "buckets",
                "max_queue", "batch_wait_ms", "default_deadline_ms",
                "warm_on_load", "keep_versions", "auto_ladder",
                "feature_cache", "compile_cache", "compile_cache_dir",
-               "warmup_manifest", "fleet")
+               "warmup_manifest", "fleet", "resilience")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "ServingParams":
@@ -111,7 +115,8 @@ class ServingParams:
             feature_cache=self.feature_cache,
             compile_cache=self.compile_cache,
             compile_cache_dir=self.compile_cache_dir,
-            warmup_manifest=self.warmup_manifest)
+            warmup_manifest=self.warmup_manifest,
+            resilience=self.resilience)
 
     def to_fleet_config(self):
         """The serving.fleet.FleetConfig view of the `fleet` block, with
@@ -134,6 +139,8 @@ class ServingParams:
             **(block.pop("serving", None) or {})}
         block.setdefault("compile_cache", self.compile_cache)
         block.setdefault("compile_cache_dir", self.compile_cache_dir)
+        if self.resilience is not None:
+            block.setdefault("resilience", self.resilience)
         return FleetConfig.from_json({**block, "serving": serving})
 
 
