@@ -17,7 +17,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 
 .PHONY: lint serve-smoke fleet-smoke chaos-smoke ingest-smoke \
 	faults-smoke trace-smoke cache-smoke multichip-smoke \
-	continual-smoke costmodel-smoke test check
+	continual-smoke costmodel-smoke roofline-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -61,6 +61,16 @@ serve-smoke:
 # transmogrifai_tpu/serving/fleet_smoke.py.
 fleet-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.fleet_smoke
+
+# roofline-scoring smoke: a warm service executes exactly ONE device
+# dispatch per bucket per score call (whole-pipeline fusion,
+# DISPATCHES-asserted), int8 scoring agrees with f32 within the stated
+# wire tolerance and never adopts the f32 programs, two same-shaped
+# linear tenants share one compiled program set (zero traces on the
+# second, bit-identical vs solo), and scoring_hbm_frac is present and
+# nonzero. See transmogrifai_tpu/serving/roofline_smoke.py.
+roofline-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.roofline_smoke
 
 # serving-resilience chaos smoke: a seeded device-error storm trips one
 # fleet member's breaker (HEALTHY->QUARANTINED->HEALTHY with measured
@@ -112,6 +122,6 @@ costmodel-smoke:
 test:
 	@$(TIER1)
 
-check: lint serve-smoke fleet-smoke chaos-smoke ingest-smoke cache-smoke \
-	faults-smoke trace-smoke multichip-smoke continual-smoke \
-	costmodel-smoke test
+check: lint serve-smoke fleet-smoke chaos-smoke roofline-smoke \
+	ingest-smoke cache-smoke faults-smoke trace-smoke multichip-smoke \
+	continual-smoke costmodel-smoke test

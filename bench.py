@@ -85,6 +85,64 @@ def _emit(payload: dict) -> None:
     sys.stdout.flush()
 
 
+def _peak_hbm_bytes_per_s() -> float:
+    """Device peak memory bandwidth for the roofline denominator.
+    BENCH_PEAK_HBM_GBPS overrides; default 819 GB/s (TPU v5e HBM2E) —
+    on the CPU bench host the fraction is still reported against the
+    TPU target so trajectories stay comparable across runs."""
+    return float(os.environ.get("BENCH_PEAK_HBM_GBPS", 819.0)) * 1e9
+
+
+def _measure_fused(scorer, encs, raw_dev, repeats: int = 3) -> dict:
+    """Shared measurement protocol for the fused scoring program at one
+    input shape: XLA "bytes accessed" + flops from cost analysis, warm
+    device execution averaged over `repeats`, derived bytes/s and
+    `hbm_frac` against the peak-bandwidth denominator. Raises on
+    cost-analysis/compile failure — callers decide how to degrade."""
+    import jax
+    jfn = scorer.fused_jitted()
+    ca = jfn.lower(scorer._consts, encs, raw_dev).compile() \
+        .cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    ca = ca or {}
+    jax.block_until_ready(jfn(scorer._consts, encs, raw_dev))  # warm
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        jax.block_until_ready(jfn(scorer._consts, encs, raw_dev))
+    dev_s = (time.perf_counter() - t0) / repeats
+    out = {"dev_s": dev_s, "flops": float(ca.get("flops", 0.0)),
+           "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+    if out["bytes_accessed"] > 0 and dev_s > 0:
+        bps = out["bytes_accessed"] / dev_s
+        out["bytes_per_sec"] = bps
+        out["hbm_frac"] = bps / _peak_hbm_bytes_per_s()
+    return out
+
+
+def score_roofline(model, ds, repeats: int = 3) -> dict:
+    """Measured HBM-roofline numbers for the fused scoring program on
+    `ds`'s batch shape: XLA's "bytes accessed" (the bytes the compiled
+    program actually touches, device dtype widths post-quantization)
+    over the measured warm device execution, as a fraction of peak
+    bandwidth. Empty dict when the plan is not fusable or cost
+    analysis is unavailable."""
+    out: dict = {}
+    try:
+        scorer = model._compiled or model._ensure_compiled()
+        encs, raw_dev, _ = scorer.host_phase(ds)
+        m = _measure_fused(scorer, encs, raw_dev, repeats)
+        out["score_device_s"] = m["dev_s"]
+        out["scoring_flops"] = m["flops"]
+        if "bytes_per_sec" in m:
+            out["scoring_bytes_accessed"] = m["bytes_accessed"]
+            out["scoring_bytes_per_sec"] = round(m["bytes_per_sec"], 1)
+            out["scoring_hbm_frac"] = round(m["hbm_frac"], 6)
+    except Exception:
+        pass
+    return out
+
+
 def probe_backend() -> str:
     """Initialize a JAX backend up front; fall back to CPU rather than die.
 
@@ -237,27 +295,16 @@ def run(platform: str) -> dict:
     t_score = time.perf_counter() - t0
     rows_per_sec = n_rows / t_score
 
-    # MFU of the fused scoring program: XLA's own FLOP estimate over the
-    # measured DEVICE execution (host phase excluded), against v5e peak
-    scoring_mfu = None
-    score_device_s = None
-    try:
-        scorer = model._compiled
-        encs, raw_dev, _ = scorer.host_phase(ds)
-        jfn = scorer.fused_jitted()
-        ca = jfn.lower(scorer._consts, encs, raw_dev).compile() \
-            .cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops = float(ca.get("flops", 0.0))
-        t0 = time.perf_counter()
-        jax.block_until_ready(jfn(scorer._consts, encs, raw_dev))
-        score_device_s = time.perf_counter() - t0
-        peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e bf16
-        if flops > 0 and score_device_s > 0:
-            scoring_mfu = flops / score_device_s / peak
-    except Exception:
-        pass
+    # HBM roofline of the fused scoring program (VERDICT §4/§7, arxiv
+    # 2008.01040): tabular scoring is memory-bound, so the honest
+    # utilization number is achieved bytes/s against peak HBM bandwidth
+    # — not MFU, which reads ~1e-6 on a workload whose arithmetic
+    # intensity is a few FLOPs/byte. Bytes are XLA's own "bytes
+    # accessed" estimate of the compiled program (device dtype widths
+    # post-quantization included); time is the measured warm device
+    # execution (host phase excluded). FLOPs stay as a secondary field.
+    roofline = score_roofline(model, ds)
+    score_device_s = roofline.get("score_device_s")
 
     # streaming micro-batch scoring: parquet batches, host encode of batch
     # i+1 overlapped with device compute of batch i (score_stream)
@@ -402,8 +449,11 @@ def run(platform: str) -> dict:
                                     else None),
         "sweep_compile_est_s": (round(sweep_compile_s, 1)
                                 if sweep_compile_s is not None else None),
-        "scoring_mfu": (round(scoring_mfu, 6)
-                        if scoring_mfu is not None else None),
+        # headline roofline fields; scoring_flops is secondary context
+        "scoring_hbm_frac": roofline.get("scoring_hbm_frac"),
+        "scoring_bytes_per_sec": roofline.get("scoring_bytes_per_sec"),
+        "scoring_bytes_accessed": roofline.get("scoring_bytes_accessed"),
+        "scoring_flops": roofline.get("scoring_flops"),
         "score_device_s": (round(score_device_s, 4)
                            if score_device_s is not None else None),
         "holdout_aupr": round(holdout.get("AuPR", 0.0), 4),
@@ -987,13 +1037,57 @@ def merge_multichip_measurement(payload: dict) -> None:
             payload[k] = v
 
 
+def _bucket_roofline(svc, rows) -> dict:
+    """Per-bucket achieved-bandwidth roofline on a warm service: for
+    each ladder rung, XLA 'bytes accessed' of the fused program at that
+    shape over the measured warm device execution, plus the per-call
+    dispatch count (1 = whole-pipeline fusion held)."""
+    from transmogrifai_tpu.analysis.retrace import DISPATCHES
+    from transmogrifai_tpu.data.dataset import Dataset
+    from transmogrifai_tpu.workflow.compiled import pad_dataset
+
+    out: dict = {}
+    version = svc._active
+    scorer = version.scorer
+    if not scorer.fusable:
+        return out
+    schema = {k: v for k, v in svc._schema.items() if k in rows[0]}
+    try:
+        for bucket in svc.ladder:
+            base = Dataset.from_rows(
+                [rows[i % len(rows)] for i in range(min(bucket, len(rows)))],
+                schema=schema)
+            ds = pad_dataset(base, bucket)
+            encs, raw_dev, _ = scorer.host_phase(ds)
+            m = _measure_fused(scorer, encs, raw_dev, repeats=5)
+            before = DISPATCHES.snapshot()
+            scorer.score_padded(base, bucket)
+            entry = {
+                "device_ms": round(m["dev_s"] * 1e3, 4),
+                "dispatches_per_call": sum(
+                    DISPATCHES.delta(before).values()),
+            }
+            if "bytes_per_sec" in m:
+                entry.update(
+                    bytes_accessed=int(m["bytes_accessed"]),
+                    gbps=round(m["bytes_per_sec"] / 1e9, 3),
+                    hbm_frac=round(m["hbm_frac"], 6))
+            out[str(bucket)] = entry
+    except Exception as e:  # roofline is reporting, never a bench killer
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def run_serving() -> None:
     """Serving-mode bench (`python bench.py serve`): throughput/latency of
     the online scoring service vs. batch-ladder config. Trains one small
     model, then for each ladder drives concurrent single/multi-row
     clients through the micro-batcher and emits one JSON line per
     config: rows/s, request p50/p99, batches, padding fraction, sheds —
-    the knobs-vs-goodput curve the ML Goodput paper says to watch."""
+    plus the per-bucket HBM roofline (`bucket_roofline`: achieved
+    bytes/s and `hbm_frac` per rung, with the dispatch count proving
+    one fused program per score call) and a quantized-serving config
+    beside the f32 ones."""
     import tempfile
     import threading
 
@@ -1023,14 +1117,16 @@ def run_serving() -> None:
         _emit({"metric": "serve_setup_s", "platform": platform,
                "value": round(time.perf_counter() - t0, 2), "unit": "s",
                "vs_baseline": 0.0, "model_version": version})
-        for max_batch in (8, 32, 128):
+        for max_batch, quantize in ((8, None), (32, None), (128, None),
+                                    (128, "int8")):
             if _remaining() < duration_s + 30.0:
                 _emit({"metric": "serve_skipped", "value": float(max_batch),
                        "unit": "config", "vs_baseline": 0.0,
                        "reason": "budget"})
                 break
             svc = ScoringService.from_path(tmp, config=ServingConfig(
-                max_batch=max_batch, batch_wait_ms=1.0, max_queue=1024))
+                max_batch=max_batch, batch_wait_ms=1.0, max_queue=1024,
+                quantize=quantize))
             svc.start()
             stop_at = time.perf_counter() + duration_s
             sent = [0] * n_clients
@@ -1061,18 +1157,26 @@ def run_serving() -> None:
             pad = reg.get("serving_padded_rows_total",
                           {"series": [{"value": 0}]})["series"][0]["value"]
             scored = sum(sent)
+            # per-bucket HBM roofline, MEASURED on the warm fused
+            # programs the clients just exercised: bytes the compiled
+            # program touches (XLA cost analysis — narrow dtypes when
+            # quantized) over warm score_padded wall, beside the
+            # dispatch count that proves whole-pipeline fusion held
+            buckets = _bucket_roofline(svc, rows)
             svc.stop()
             _emit({
                 "metric": "serve_rows_per_sec", "platform": platform,
                 "value": round(scored / max(wall, 1e-9), 1),
                 "unit": "rows/s", "vs_baseline": 0.0,
                 "max_batch": max_batch, "clients": n_clients,
+                "quantize": quantize,
                 "rows": scored, "errors": sum(errors),
                 "latency_p50_ms": (round(lat["p50"] * 1e3, 3)
                                    if lat["p50"] is not None else None),
                 "latency_p99_ms": (round(lat["p99"] * 1e3, 3)
                                    if lat["p99"] is not None else None),
                 "pad_fraction": round(pad / max(pad + scored, 1), 4),
+                "bucket_roofline": buckets,
             })
 
 

@@ -98,15 +98,31 @@ def test_scoring_signature_groups_tree_models(model_dirs):
         load_model(model_dirs["m1"]))
 
 
-def test_scoring_signature_is_value_sensitive_for_closure_constants():
-    """Linear-family weights are read off `self` inside device_apply —
-    closure constants baked into the trace — so two different LR fits
-    must NOT claim program sharing."""
+def test_scoring_signature_groups_lifted_linear_tenants():
+    """PR 13 parameter lifting: linear-family weights flow as traced
+    jit arguments (`LogisticRegressionModel.device_constants`), so two
+    different same-shaped LR fits SHARE one compiled program — the
+    zero-trace-onboarding contract for K-replica and warm-refit
+    tenants."""
     a = _train(y_sign=1.0, forest=False)
     b = _train(y_sign=-1.0, forest=False)
-    assert scoring_signature(a) != scoring_signature(b)
-    # ... while a re-load of the same fit shares trivially
+    assert scoring_signature(a) == scoring_signature(b)
     assert scoring_signature(a) == scoring_signature(a)
+
+
+def test_scoring_signature_is_value_sensitive_for_closure_constants():
+    """Honesty check for state that still BAKES into the trace: a
+    different max_iter changes nothing traced (both fits share), but
+    hyperparams steering static control flow — a GBT learning rate, a
+    GLM link — are value-digested via `signature_params`, and the
+    quantization mode is folded into the key so a quantized and an f32
+    build of ONE model can never adopt each other's programs."""
+    a = _train(y_sign=1.0, forest=False)
+    assert scoring_signature(a) != scoring_signature(a, quant="int8")
+    assert scoring_signature(a, quant="int8") == \
+        scoring_signature(a, quant="int8")
+    assert scoring_signature(a, quant="int8") != \
+        scoring_signature(a, quant="int4")
 
 
 # --------------------------------------------------------------------- #

@@ -1013,3 +1013,56 @@ def test_lint_l015_exempt_in_tests_and_testkit():
     # but package smoke modules ARE covered
     assert any(f.code == "L015" for f in L.lint_source(
         src, path="transmogrifai_tpu/serving/fleet_smoke.py"))
+
+
+def test_lint_l016_closure_constant_array_in_device_apply():
+    """L016: `jnp.asarray(self.X)` inside device_apply/predict_arrays of
+    a class WITHOUT device_constants — fitted arrays value-baked into
+    the compiled program and re-staged per dispatch."""
+    src = '''
+import jax.numpy as jnp
+
+class BigTableModel(Transformer):
+    def device_apply(self, enc, dev):
+        return dev[-1] @ jnp.asarray(self.table)    # flagged
+
+class PredictorNoLift(PredictionModel):
+    def predict_arrays(self, X):
+        return X @ jnp.asarray(self.W)              # flagged
+
+class LiftedModel(Transformer):
+    def device_constants(self):
+        return {"table": jnp.asarray(self.table)}
+    def device_apply(self, enc, dev):
+        return dev[-1] @ jnp.asarray(self.table)    # clean: lifted class
+    def device_apply_with(self, consts, enc, dev):
+        return dev[-1] @ consts["table"]
+
+class SmallStateModel(Transformer):
+    def host_prepare(self, cols):
+        return jnp.asarray(self.table)              # clean: host method
+'''
+    findings = [f for f in L.lint_source(
+        src, path="transmogrifai_tpu/models/newfam.py")
+        if f.code == "L016"]
+    assert len(findings) == 2
+    assert all("device_constants" in f.message for f in findings)
+
+
+def test_lint_l016_allowlist_and_test_exemption():
+    src = '''
+import jax.numpy as jnp
+
+class PercentileCalibratorModel(Transformer):
+    def device_apply(self, enc, dev):
+        return jnp.searchsorted(jnp.asarray(self.quantiles), dev[0])
+'''
+    # the documented known-small site is allowlisted
+    assert not any(f.code == "L016" for f in L.lint_source(
+        src, path="transmogrifai_tpu/ops/scalers.py"))
+    # tests/testkit are exempt entirely
+    bad = src.replace("PercentileCalibratorModel", "SomeModel")
+    assert not any(f.code == "L016" for f in L.lint_source(
+        bad, path="tests/test_x.py"))
+    assert any(f.code == "L016" for f in L.lint_source(
+        bad, path="transmogrifai_tpu/ops/other.py"))

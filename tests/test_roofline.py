@@ -1,0 +1,402 @@
+"""Roofline scoring (PR 13): whole-pipeline fusion (one dispatch per
+score call, trimmed program outputs), quantized int8/int4 inference
+with stated wire tolerance, parameter-lifted linear tenants sharing one
+compiled program, quant-aware scoring signatures, and the goodput
+`scoring` section."""
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.analysis.retrace import DISPATCHES, MONITOR
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import (
+    OpGeneralizedLinearRegression, OpLinearRegression,
+    OpLogisticRegression, OpRandomForestClassifier, OpXGBoostClassifier)
+from transmogrifai_tpu.ops.numeric import RealVectorizer
+from transmogrifai_tpu.serving.fleet import (
+    FleetConfig, FleetService, scoring_signature)
+from transmogrifai_tpu.serving.service import ScoringService, ServingConfig
+from transmogrifai_tpu.workflow import Workflow
+from transmogrifai_tpu.workflow.compiled import (
+    CompiledScorer, ScoringQuant, dequantize_leaf, quantize_leaf)
+
+N = 200
+
+
+def _data(seed=7, n=N, y_from=lambda x1, x2, eps: x1 + 0.5 * x2 + eps):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = 3.0 * rng.normal(size=n) - 1.0
+    y = (y_from(x1, x2, rng.normal(0, 0.3, n)) > 0).astype(np.float64)
+    return Dataset({"x1": x1, "x2": x2, "y": y},
+                   {"x1": t.Real, "x2": t.Real, "y": t.Integral})
+
+
+def _train(est, seed=7, **data_kw):
+    ds = _data(seed=seed, **data_kw)
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = RealVectorizer(track_nulls=False).set_input(*preds).get_output()
+    pred = est.set_input(label, vec).get_output()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+    return model, pred, ds
+
+
+def _score_ds(n=16, seed=3):
+    rng = np.random.default_rng(seed)
+    return Dataset({"x1": rng.normal(size=n),
+                    "x2": 3.0 * rng.normal(size=n) - 1.0},
+                   {"x1": t.Real, "x2": t.Real})
+
+
+# --------------------------------------------------------------------- #
+# whole-pipeline fusion                                                 #
+# --------------------------------------------------------------------- #
+
+def test_fused_plan_single_dispatch_per_score_call():
+    model, pf, _ = _train(OpLogisticRegression(max_iter=20))
+    scorer = model._ensure_compiled()
+    assert scorer.fusable
+    sub = _score_ds(5)
+    scorer.score_padded(sub, 16)  # warm (compile)
+    before = DISPATCHES.snapshot()
+    scorer.score_padded(sub, 16)
+    delta = DISPATCHES.delta(before)
+    assert sum(delta.values()) == 1, delta
+    # and a second bucket is again exactly one dispatch
+    scorer.score_padded(sub, 8)
+    before = DISPATCHES.snapshot()
+    scorer.score_padded(sub, 8)
+    assert sum(DISPATCHES.delta(before).values()) == 1
+
+
+def test_fused_path_matches_general_segmented_path_exactly():
+    model, pf, _ = _train(OpLogisticRegression(max_iter=20))
+    scorer = model._ensure_compiled()
+    sub = _score_ds(8)
+    fused = scorer.score_fused(sub)
+    general = scorer(sub)
+    np.testing.assert_array_equal(
+        np.asarray(fused[pf.name]["probability"]),
+        np.asarray(general[pf.name]["probability"]))
+    np.testing.assert_array_equal(
+        np.asarray(fused[pf.name]["prediction"]),
+        np.asarray(general[pf.name]["prediction"]))
+
+
+def test_segment_outputs_trimmed_to_needed_uids():
+    """The fused program returns ONLY result features — intermediates
+    (vectorizer outputs) stay XLA-internal instead of becoming forced
+    HBM materializations."""
+    model, pf, _ = _train(OpLogisticRegression(max_iter=20))
+    scorer = model._ensure_compiled()
+    (out_uids,) = [u for i, u in enumerate(scorer._seg_out_uids)
+                   if scorer.segments[i][0] == "device"]
+    assert out_uids == [pf.uid]
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_score_padded_pad_region_invariance(quant):
+    """Valid-row results are invariant to the bucket a batch was padded
+    to — pad rows repeat a REAL row, so they never widen the quantized
+    wire's per-batch range either."""
+    model, pf, _ = _train(OpLogisticRegression(max_iter=20))
+    scorer = model._ensure_compiled(quant=quant)
+    sub = _score_ds(6)
+    a = scorer.score_padded(sub, 8)
+    b = scorer.score_padded(sub, 32)
+    np.testing.assert_array_equal(
+        np.asarray(a[pf.name]["probability"]),
+        np.asarray(b[pf.name]["probability"]))
+
+
+# --------------------------------------------------------------------- #
+# quantized wire primitives                                             #
+# --------------------------------------------------------------------- #
+
+def test_quantize_leaf_roundtrip_within_stated_tolerance():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 7)).astype(np.float32) * 10.0
+    for bits in (8, 4):
+        wire = quantize_leaf(x, bits)
+        back = np.asarray(dequantize_leaf(
+            {k: np.asarray(v) for k, v in wire.items()}, bits))
+        tol = wire["scale"] / 2.0 + 1e-6
+        assert back.shape == x.shape
+        assert np.all(np.abs(back - x) <= tol[None, :]), bits
+
+
+def test_quantize_leaf_inf_never_corrupts_finite_batchmates():
+    """A ±inf value must clip to the FINITE range bounds — it must not
+    degenerate the affine fit and drag every finite batchmate's value
+    off by orders of magnitude (post-review regression)."""
+    x = np.array([[1000.0], [2000.0], [np.inf]], np.float32)
+    wire = quantize_leaf(x, 8)
+    back = np.asarray(dequantize_leaf(
+        {k: np.asarray(v) for k, v in wire.items()}, 8))
+    tol = float(wire["scale"][0]) / 2.0 + 1e-3
+    assert abs(back[0, 0] - 1000.0) <= tol
+    assert abs(back[1, 0] - 2000.0) <= tol
+    assert back[2, 0] == pytest.approx(2000.0, abs=tol)  # clips to hi
+    neg = np.array([-5.0, -3.0, -np.inf], np.float32)
+    nwire = quantize_leaf(neg, 8)
+    nback = np.asarray(dequantize_leaf(
+        {k: np.asarray(v) for k, v in nwire.items()}, 8))
+    ntol = float(nwire["scale"][0]) / 2.0 + 1e-4
+    assert abs(nback[0] + 5.0) <= ntol
+    assert abs(nback[1] + 3.0) <= ntol
+    assert nback[2] == pytest.approx(-5.0, abs=ntol)  # clips to lo
+
+
+def test_quantize_leaf_one_d_nan_and_constant_columns():
+    x = np.array([1.0, np.nan, 3.0, 1.0], np.float32)
+    wire = quantize_leaf(x, 8)
+    assert "q1" in wire  # rank marker
+    back = np.asarray(dequantize_leaf(
+        {k: np.asarray(v) for k, v in wire.items()}, 8))
+    assert back.shape == x.shape
+    # NaN maps to lo — never an undefined uint8 cast
+    assert back[1] == pytest.approx(1.0, abs=1e-6)
+    # constant column round-trips exactly (scale degenerates to 1)
+    const = quantize_leaf(np.full(8, 2.5, np.float32), 8)
+    cback = np.asarray(dequantize_leaf(
+        {k: np.asarray(v) for k, v in const.items()}, 8))
+    np.testing.assert_allclose(cback, 2.5, atol=1e-6)
+
+
+def test_scoring_quant_validation():
+    assert ScoringQuant("int4").bits == 4
+    assert ScoringQuant.resolve(None) is None
+    assert ScoringQuant.resolve("int8") == ScoringQuant("int8")
+    with pytest.raises(ValueError):
+        ScoringQuant("fp8")
+
+
+# --------------------------------------------------------------------- #
+# quantized-vs-f32 parity per family                                    #
+# --------------------------------------------------------------------- #
+
+def _parity(est, prob_tol=None, agree_min=0.97, seed=7):
+    model, pf, _ = _train(est, seed=seed)
+    sub = _score_ds(64)
+    f32 = model._ensure_compiled().score_padded(sub, 64)
+    q = model._ensure_compiled(quant="int8").score_padded(sub, 64)
+    pa = np.asarray(f32[pf.name]["prediction"])
+    pb = np.asarray(q[pf.name]["prediction"])
+    assert (pa == pb).mean() >= agree_min
+    ra = np.asarray(f32[pf.name]["rawPrediction"], np.float64)
+    rb = np.asarray(q[pf.name]["rawPrediction"], np.float64)
+    if prob_tol is not None:
+        assert float(np.abs(ra - rb).max()) <= prob_tol, \
+            float(np.abs(ra - rb).max())
+
+
+def _linear_tol(model, sub, bits=8):
+    """Stated linear-path bound: |Δ raw| <= sum_d |w_d|·scale_d/2 over
+    the batch's own per-feature range, plus bf16 table rounding."""
+    pred_stage = [s for s in model.fitted.values()
+                  if hasattr(s, "beta") or hasattr(s, "W")][0]
+    w = np.abs(np.asarray(getattr(pred_stage, "beta",
+                                  getattr(pred_stage, "W", None))))
+    X = np.stack([np.asarray(sub.column("x1")),
+                  np.asarray(sub.column("x2"))], axis=1)
+    span = X.max(0) - X.min(0)
+    scale = span / float((1 << bits) - 1)
+    wmat = w.reshape(len(scale), -1)
+    quant_err = float((wmat * scale[:, None] / 2.0).sum())
+    bf16_err = float(np.abs(wmat).sum()) * 2.0 ** -8 * float(
+        np.abs(X).max())
+    return quant_err + bf16_err + 1e-5
+
+
+def test_quant_parity_logistic_within_stated_tolerance():
+    model, pf, _ = _train(OpLogisticRegression(max_iter=30))
+    sub = _score_ds(64)
+    f32 = model._ensure_compiled().score_padded(sub, 64)
+    q = model._ensure_compiled(quant="int8").score_padded(sub, 64)
+    tol = _linear_tol(model, sub)
+    ra = np.asarray(f32[pf.name]["rawPrediction"], np.float64)
+    rb = np.asarray(q[pf.name]["rawPrediction"], np.float64)
+    assert float(np.abs(ra - rb).max()) <= tol, \
+        (float(np.abs(ra - rb).max()), tol)
+
+
+def test_quant_parity_linear_regression():
+    model, pf, _ = _train(OpLinearRegression())
+    sub = _score_ds(64)
+    f32 = model._ensure_compiled().score_padded(sub, 64)
+    q = model._ensure_compiled(quant="int8").score_padded(sub, 64)
+    tol = _linear_tol(model, sub)
+    assert float(np.abs(
+        np.asarray(f32[pf.name]["prediction"], np.float64)
+        - np.asarray(q[pf.name]["prediction"], np.float64)).max()) <= tol
+
+
+def test_quant_parity_glm():
+    model, pf, _ = _train(
+        OpGeneralizedLinearRegression(family="binomial", max_iter=40))
+    sub = _score_ds(64)
+    f32 = model._ensure_compiled().score_padded(sub, 64)
+    q = model._ensure_compiled(quant="int8").score_padded(sub, 64)
+    # the GLM raw prediction is the linear eta — the stated linear bound
+    tol = _linear_tol(model, sub)
+    ra = np.asarray(f32[pf.name]["rawPrediction"], np.float64)
+    rb = np.asarray(q[pf.name]["rawPrediction"], np.float64)
+    assert float(np.abs(ra - rb).max()) <= tol
+
+
+def test_quant_parity_forest():
+    _parity(OpRandomForestClassifier(n_trees=5, max_depth=3),
+            agree_min=0.95)
+
+
+def test_quant_parity_gbt():
+    _parity(OpXGBoostClassifier(n_estimators=5, max_depth=3),
+            agree_min=0.95)
+
+
+def test_quant_int4_parity_is_coarser_but_bounded():
+    model, pf, _ = _train(OpLogisticRegression(max_iter=30))
+    sub = _score_ds(64)
+    f32 = model._ensure_compiled().score_padded(sub, 64)
+    q4 = model._ensure_compiled(quant="int4").score_padded(sub, 64)
+    tol = _linear_tol(model, sub, bits=4)
+    ra = np.asarray(f32[pf.name]["rawPrediction"], np.float64)
+    rb = np.asarray(q4[pf.name]["rawPrediction"], np.float64)
+    assert float(np.abs(ra - rb).max()) <= tol
+
+
+def test_narrowed_tree_tables_are_shape_gated():
+    model, pf, _ = _train(OpRandomForestClassifier(n_trees=3, max_depth=2))
+    forest = [s for s in model.fitted.values()
+              if type(s).__name__ == "ForestClassificationModel"][0]
+    consts = forest.device_constants()
+    narrow = forest.narrow_device_constants(consts)
+    assert str(narrow["edges"].dtype) == "float16"
+    assert str(narrow["trees"]["feat"].dtype) == "int16"
+    assert str(narrow["trees"]["bin"].dtype) == "uint8"
+    assert str(narrow["trees"]["leaf"].dtype) == "float32"
+
+
+# --------------------------------------------------------------------- #
+# signatures: quant folding + lifted linear sharing                     #
+# --------------------------------------------------------------------- #
+
+def test_signature_folds_quant_config():
+    model, _, _ = _train(OpLogisticRegression(max_iter=20))
+    s_f32 = scoring_signature(model)
+    s_q8 = scoring_signature(model, quant="int8")
+    s_q4 = scoring_signature(model, quant=ScoringQuant("int4"))
+    assert len({s_f32, s_q8, s_q4}) == 3
+    assert scoring_signature(model, quant="int8") == s_q8
+
+
+def test_fleet_dedup_honesty_quantized_never_adopts_f32(tmp_path):
+    """A quantized and an unquantized member over the SAME artifact must
+    land in different compile groups (no cross-adoption)."""
+    model, _, _ = _train(OpLogisticRegression(max_iter=20))
+    path = str(tmp_path / "m")
+    model.save(path)
+    fleet = FleetService(FleetConfig(
+        models={"f32": path,
+                "q8": {"path": path, "serving": {"quantize": "int8"}}},
+        serving={"max_batch": 4, "batch_wait_ms": 1.0}))
+    shared = fleet.pool.report()
+    assert len(shared) == 2
+    assert all(len(e["members"]) == 1 for e in shared.values())
+    fleet.stop()
+
+
+def test_lifted_linear_tenants_share_one_program(tmp_path):
+    """Two DIFFERENT same-shaped linear fits: one signature, the second
+    member warms with zero new traces, and its scores are bit-identical
+    to a solo load — parameters are per-tenant state, the program is
+    fleet state."""
+    m_a, _, _ = _train(OpLogisticRegression(max_iter=30), seed=7)
+    m_b, pf_b, _ = _train(
+        OpLogisticRegression(max_iter=30), seed=7,
+        y_from=lambda x1, x2, eps: x1 - 0.5 * x2 + eps)
+    assert scoring_signature(m_a) == scoring_signature(m_b)
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    m_a.save(dir_a)
+    m_b.save(dir_b)
+
+    rows = [{"x1": 0.3, "x2": -1.2}, {"x1": -0.5, "x2": 0.8}]
+    solo = ScoringService.from_path(dir_b, config=ServingConfig(
+        max_batch=4, batch_wait_ms=1.0))
+    solo.start()
+    solo_rows = solo.score(rows).rows()
+    solo.stop()
+
+    fleet = FleetService(FleetConfig(
+        models={"a": dir_a},
+        serving={"max_batch": 4, "batch_wait_ms": 1.0}))
+    before = MONITOR.snapshot()
+    fleet.add_model("b", dir_b)
+    assert MONITOR.delta(before) == {}, "second tenant must trace nothing"
+    assert len(fleet.pool.report()) == 1
+    fleet.start()
+    fleet_rows = fleet.score("b", rows).rows()
+    fleet.stop()
+    for s_row, f_row in zip(solo_rows, fleet_rows):
+        for key, sv in s_row.items():
+            if isinstance(sv, dict):
+                for kk in sv:
+                    assert sv[kk] == f_row[key][kk]
+
+
+def test_lifted_hot_swap_same_shaped_refit_compiles_nothing(tmp_path):
+    """`/reload` of a same-shaped linear refit adopts the resident
+    programs: zero new traces — the warm-refit rolling-swap case PR 10
+    proved for trees, now closed for the linear families."""
+    m_a, _, _ = _train(OpLogisticRegression(max_iter=30), seed=7)
+    m_b, _, _ = _train(
+        OpLogisticRegression(max_iter=30), seed=7,
+        y_from=lambda x1, x2, eps: x1 - 0.7 * x2 + eps)
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    m_a.save(dir_a)
+    m_b.save(dir_b)
+    fleet = FleetService(FleetConfig(
+        models={"m": dir_a},
+        serving={"max_batch": 4, "batch_wait_ms": 1.0}))
+    fleet.start()
+    before = MONITOR.snapshot()
+    result = fleet.reload_model("m", dir_b)
+    assert result["status"] == "swapped"
+    assert MONITOR.delta(before) == {}, \
+        "same-shaped refit swap must compile nothing"
+    fleet.stop()
+
+
+# --------------------------------------------------------------------- #
+# accounting                                                            #
+# --------------------------------------------------------------------- #
+
+def test_goodput_scoring_section_from_device_dispatch_events():
+    from transmogrifai_tpu.obs.goodput import build_report
+    from transmogrifai_tpu.obs.trace import TRACER
+
+    model, pf, _ = _train(OpLogisticRegression(max_iter=20))
+    scorer = model._ensure_compiled()
+    sub = _score_ds(4)
+    scorer.score_padded(sub, 4)  # warm outside the span
+    with TRACER.span("run:test", new_trace=True) as root:
+        scorer.score_padded(sub, 4)
+        scorer.score_padded(sub, 4)
+    report = build_report(root, TRACER.trace_spans(root.trace_id))
+    assert report.scoring["dispatches"] == 2
+    assert report.scoring["bytes_in"] > 0
+    assert report.scoring["bytes_out"] > 0
+    assert "scoring" in report.to_json()
+
+
+def test_serving_params_quantize_roundtrip():
+    from transmogrifai_tpu.workflow.params import ServingParams
+    sp = ServingParams.from_json({"quantize": "int8", "max_batch": 8})
+    assert sp.quantize == "int8"
+    assert sp.to_json()["quantize"] == "int8"
+    cfg = sp.to_config()
+    assert cfg.quantize == "int8"
+    assert ServingParams.from_json(sp.to_json()).quantize == "int8"

@@ -12,4 +12,4 @@
 from transmogrifai_tpu.analysis.opcheck import (  # noqa: F401
     GraphValidationError, ValidationIssue, ValidationReport, validate_graph)
 from transmogrifai_tpu.analysis.retrace import (  # noqa: F401
-    MONITOR, RetraceMonitor, instrumented_jit)
+    DISPATCHES, MONITOR, RetraceMonitor, instrumented_jit)

@@ -135,6 +135,17 @@ silent slowness or nondeterminism once XLA is in the loop:
   what it does (``scoring-batcher-1``, ``fleet-watchdog``,
   ``continual-loop``).
 
+- ``L016 closure-constant-array``: a ``device_apply``/``predict_arrays``
+  body converting ``self.<attr>`` to a device array
+  (``jnp.asarray(self.W)``) in a class WITHOUT ``device_constants()``.
+  The converted array is a closure constant of the compiled scoring
+  program: megabyte-scale fitted state gets value-baked into the XLA
+  executable (every fleet tenant then compiles its own bucket programs
+  instead of sharing one) and re-staged host→device on every dispatch
+  through the serving tunnel. Route fitted arrays through
+  ``device_constants()``/``device_apply_with`` — the known-small
+  scalar/index sites are allowlisted in ``_L016_ALLOW``.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1166,6 +1177,73 @@ def _check_unnamed_threads(tree: ast.AST, path: str) -> List[LintFinding]:
     return findings
 
 
+# -- L016: closure-captured fitted arrays on the compiled scoring path ------- #
+
+# known-small fitted state (a handful of scalars / (d,)-scale index
+# vectors) where per-call staging is noise — everything NEW that
+# converts `self.<attr>` to a device array inside a compiled-path body
+# must either route through device_constants() or be allowlisted here
+_L016_ALLOW = {
+    # (class, attr): ~100-entry quantile table / kept-index vector —
+    # kilobytes, not the megabyte tables the lint exists for
+    ("PercentileCalibratorModel", "quantiles"),
+    ("DropIndicesByTransformer", "_indices"),
+    ("SanityCheckerModel", "indices"),
+}
+_L016_METHODS = ("device_apply", "predict_arrays")
+_L016_CASTS = ("jnp.asarray", "jnp.array", "jax.numpy.asarray",
+               "jax.numpy.array")
+
+
+def _check_closure_constants(tree: ast.AST, path: str) -> List[LintFinding]:
+    """Flag `jnp.asarray(self.X)` inside `device_apply`/`predict_arrays`
+    bodies of Transformer classes that do NOT define
+    `device_constants()`: the converted array is a closure constant of
+    the compiled scoring program — megabyte-scale fitted state gets
+    value-baked into the XLA executable (every tenant compiles its own
+    program, serving/fleet.py) and re-staged host→device per dispatch
+    through the serving tunnel. Route big fitted arrays through
+    `device_constants()`/`device_apply_with` so they flow as traced jit
+    arguments instead."""
+    parts = os.path.normpath(path).split(os.sep)
+    if any(d in parts for d in ("testkit", "tests")):
+        return []
+    findings: List[LintFinding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        method_names = {n.name for n in cls.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        if "device_constants" in method_names:
+            continue  # already lifted
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in _L016_METHODS:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                if _dotted(node.func) not in _L016_CASTS:
+                    continue
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Attribute)
+                        and isinstance(arg.value, ast.Name)
+                        and arg.value.id == "self"):
+                    continue
+                if (cls.name, arg.attr) in _L016_ALLOW:
+                    continue
+                findings.append(LintFinding(
+                    path, getattr(node, "lineno", 0), "L016",
+                    f"`{cls.name}.{fn.name}` converts `self.{arg.attr}` "
+                    f"to a device array inside a compiled-path body — a "
+                    f"closure constant value-baked into the XLA program "
+                    f"and re-staged per dispatch; route fitted arrays "
+                    f"through device_constants()/device_apply_with (or "
+                    f"allowlist known-small state in _L016_ALLOW)"))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1184,6 +1262,7 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_magic_knobs(tree, path))
     linter.findings.extend(_check_service_construction(tree, path))
     linter.findings.extend(_check_unnamed_threads(tree, path))
+    linter.findings.extend(_check_closure_constants(tree, path))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 

@@ -144,6 +144,16 @@ class RetraceMonitor:
 
 MONITOR = RetraceMonitor()
 
+# Device-dispatch accounting, same snapshot()/delta() contract as the
+# trace monitor but counting EXECUTIONS of compiled scoring segments
+# (CompiledScorer._dispatch), not traces: `DISPATCHES.delta(before)`
+# around one score call proves how many XLA programs it launched — the
+# fused-plan invariant ("exactly ONE device dispatch per bucket per
+# score call") that `make roofline-smoke` and tests assert. warn_after
+# is effectively disabled: thousands of dispatches of one program are
+# the healthy steady state, not churn.
+DISPATCHES = RetraceMonitor(warn_after=1 << 62)
+
 
 def instrumented_jit(fn: Callable, label: Optional[str] = None,
                      monitor: Optional[RetraceMonitor] = None,

@@ -635,6 +635,8 @@ def cmd_serve(args) -> int:
     if args.watchdog_stall_s is not None:
         sp.resilience = {**(sp.resilience or {}),
                          "watchdog_stall_s": args.watchdog_stall_s}
+    if args.quantize:
+        sp.quantize = None if args.quantize == "off" else args.quantize
 
     fleet_cfg = None
     if args.fleet_config:
@@ -846,6 +848,12 @@ def main(argv: Optional[list] = None) -> int:
         "--watchdog-stall-s", type=float, default=None,
         help="per-batch stall budget before the watchdog quarantines "
              "the in-flight batch and restarts the scoring thread")
+    serve_p.add_argument(
+        "--quantize", choices=["int8", "int4", "off"],
+        help="quantized inference: requests ship on a per-batch affine "
+             "narrow wire and fitted tables compute in narrowed dtypes "
+             "inside the fused bucket programs (per-feature tolerance "
+             "(hi-lo)/(2*(2^bits-1)); default off = exact f32)")
     serve_p.set_defaults(fn=cmd_serve)
 
     lint_p = sub.add_parser(

@@ -196,6 +196,25 @@ class GLMModel(PredictionModel):
                             "b": jnp.float32(self.b)}, X, self.family,
                            self.link, self.var_power)
 
+    # parameter lifting: beta/b are traced jit arguments; family/link/
+    # var_power stay in signature_params — they steer static control
+    # flow in the trace (`_inverse_link`), so two GLMs share a program
+    # only when their link functions agree
+    def device_constants(self):
+        return {"beta": jnp.asarray(self.beta), "b": jnp.float32(self.b)}
+
+    def device_apply_with(self, consts, enc, dev):
+        return predict_glm(consts, jnp.asarray(dev[-1]), self.family,
+                           self.link, self.var_power)
+
+    def signature_params(self):
+        return {"family": self.family, "link": self.link,
+                "var_power": self.var_power}
+
+    def narrow_device_constants(self, consts):
+        return {"beta": consts["beta"].astype(jnp.bfloat16),
+                "b": consts["b"]}
+
     def get_params(self):
         return {"beta": self.beta.tolist(), "b": self.b,
                 "family": self.family, "link": self.link,

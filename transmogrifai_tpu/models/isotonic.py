@@ -64,6 +64,22 @@ class IsotonicCalibratorModel(Transformer):
                          jnp.asarray(self.values))
         return {"value": cal, "mask": dev[-1]["mask"]}
 
+    # parameter lifting: the PAV step table can reach 2·n_blocks entries
+    # — per-tenant state, not program state (serving/fleet.py). No
+    # narrow variant: `jnp.interp` needs strictly ordered boundaries and
+    # f16 rounding could collapse adjacent steps.
+    def device_constants(self):
+        return {"boundaries": jnp.asarray(self.boundaries),
+                "values": jnp.asarray(self.values)}
+
+    def device_apply_with(self, consts, enc, dev):
+        cal = jnp.interp(dev[-1]["value"], consts["boundaries"],
+                         consts["values"])
+        return {"value": cal, "mask": dev[-1]["mask"]}
+
+    def signature_params(self):
+        return {}
+
     def get_params(self):
         return {"boundaries": self.boundaries.tolist(),
                 "values": self.values.tolist()}

@@ -103,6 +103,27 @@ class LinearRegressionModel(PredictionModel):
             {"beta": jnp.asarray(self.beta),
              "intercept": jnp.float32(self.intercept)}, X)
 
+    # parameter lifting (serving/fleet.py): fitted weights flow into the
+    # compiled scorer as traced jit ARGUMENTS, so every same-shaped
+    # linear tenant in a fleet shares ONE compiled program and a
+    # tenant's resident HBM cost is its parameters, not a program copy
+    def device_constants(self):
+        return {"beta": jnp.asarray(self.beta),
+                "intercept": jnp.float32(self.intercept)}
+
+    def device_apply_with(self, consts, enc, dev):
+        return predict_linreg(consts, jnp.asarray(dev[-1]))
+
+    def signature_params(self):
+        return {}  # all fitted state is lifted; shapes key via consts
+
+    def narrow_device_constants(self, consts):
+        # memory-bound predict: bf16 weights halve the table read; the
+        # matmul accumulates in f32 (~0.4% relative weight error, the
+        # same documented tradeoff as the GBT bf16 histograms)
+        return {"beta": consts["beta"].astype(jnp.bfloat16),
+                "intercept": consts["intercept"]}
+
     def get_params(self):
         return {"beta": self.beta.tolist(), "intercept": self.intercept}
 

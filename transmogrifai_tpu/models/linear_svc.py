@@ -69,6 +69,20 @@ class LinearSVCModel(PredictionModel):
         return predict_linear_svc(
             {"beta": jnp.asarray(self.beta), "b": jnp.float32(self.b)}, X)
 
+    # parameter lifting: see LinearRegressionModel
+    def device_constants(self):
+        return {"beta": jnp.asarray(self.beta), "b": jnp.float32(self.b)}
+
+    def device_apply_with(self, consts, enc, dev):
+        return predict_linear_svc(consts, jnp.asarray(dev[-1]))
+
+    def signature_params(self):
+        return {}
+
+    def narrow_device_constants(self, consts):
+        return {"beta": consts["beta"].astype(jnp.bfloat16),
+                "b": consts["b"]}
+
     def get_params(self):
         return {"beta": self.beta.tolist(), "b": self.b}
 

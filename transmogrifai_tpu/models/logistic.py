@@ -164,6 +164,20 @@ class LogisticRegressionModel(PredictionModel):
     def predict_arrays(self, X):
         return predict_logreg({"W": jnp.asarray(self.W), "b": jnp.asarray(self.b)}, X)
 
+    # parameter lifting: see LinearRegressionModel — weights are traced
+    # jit arguments, so same-shaped LR tenants share one bucket program
+    def device_constants(self):
+        return {"W": jnp.asarray(self.W), "b": jnp.asarray(self.b)}
+
+    def device_apply_with(self, consts, enc, dev):
+        return predict_logreg(consts, jnp.asarray(dev[-1]))
+
+    def signature_params(self):
+        return {}
+
+    def narrow_device_constants(self, consts):
+        return {"W": consts["W"].astype(jnp.bfloat16), "b": consts["b"]}
+
     def get_params(self):
         return {"W": self.W.tolist(), "b": self.b.tolist()}
 

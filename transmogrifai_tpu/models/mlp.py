@@ -88,6 +88,24 @@ class MLPModel(PredictionModel):
                   for l in self.weights]
         return predict_mlp(params, X)
 
+    # parameter lifting: see LinearRegressionModel — the layer count and
+    # widths key the program via the consts structure digest
+    def device_constants(self):
+        return {"layers": [
+            {"W": jnp.asarray(l["W"]), "b": jnp.asarray(l["b"])}
+            for l in self.weights]}
+
+    def device_apply_with(self, consts, enc, dev):
+        return predict_mlp(consts["layers"], jnp.asarray(dev[-1]))
+
+    def signature_params(self):
+        return {}
+
+    def narrow_device_constants(self, consts):
+        return {"layers": [
+            {"W": l["W"].astype(jnp.bfloat16), "b": l["b"]}
+            for l in consts["layers"]]}
+
     def get_params(self):
         return {"weights": [
             {"W": l["W"].tolist(), "b": l["b"].tolist()} for l in self.weights]}

@@ -52,6 +52,21 @@ class NaiveBayesModel(PredictionModel):
             {"log_prior": jnp.asarray(self.log_prior),
              "log_theta": jnp.asarray(self.log_theta)}, X)
 
+    # parameter lifting: see LinearRegressionModel
+    def device_constants(self):
+        return {"log_prior": jnp.asarray(self.log_prior),
+                "log_theta": jnp.asarray(self.log_theta)}
+
+    def device_apply_with(self, consts, enc, dev):
+        return predict_naive_bayes(consts, jnp.asarray(dev[-1]))
+
+    def signature_params(self):
+        return {}
+
+    def narrow_device_constants(self, consts):
+        return {"log_prior": consts["log_prior"],
+                "log_theta": consts["log_theta"].astype(jnp.bfloat16)}
+
     def get_params(self):
         return {"log_prior": self.log_prior.tolist(),
                 "log_theta": self.log_theta.tolist()}

@@ -996,6 +996,24 @@ class _TreeModelBase(PredictionModel):
         return {"edges": jnp.asarray(self.edges),
                 "trees": self._tree_pytree()}
 
+    def narrow_device_constants(self, consts):
+        """Quantized-inference dtypes for the tables the predict walk
+        re-reads every level. Gates are SHAPE facts only (so every model
+        sharing a scoring signature narrows to identical traced dtypes):
+        split-feature ids fit int16 when d < 2^15, split-bin thresholds
+        fit uint8 when there are at most 255 edges (bin ids <= n_edges),
+        both lossless; threshold EDGES drop to f16 — lossy at f16 eps,
+        inside the quantized mode's stated wire tolerance. Leaves stay
+        f32 (tiny, and they carry the output precision)."""
+        edges = consts["edges"]
+        trees = dict(consts["trees"])
+        d, n_edges = int(edges.shape[0]), int(edges.shape[1])
+        if d < (1 << 15):
+            trees["feat"] = trees["feat"].astype(jnp.int16)
+        if n_edges <= 255:
+            trees["bin"] = trees["bin"].astype(jnp.uint8)
+        return {"edges": edges.astype(jnp.float16), "trees": trees}
+
     def device_apply_with(self, consts, enc, dev):
         return self._apply_arrays(consts["trees"],
                                   bin_features(jnp.asarray(dev[-1]),

@@ -90,6 +90,14 @@ class GoodputReport:
     # rolling-swaps number of serving/fleet.py) and ``tenant_shed``
     # admission events. Empty when no fleet ran in this trace.
     fleet: Dict[str, Any] = field(default_factory=dict)
+    # compiled-scoring accounting: rolled up from ``device_dispatch``
+    # events (CompiledScorer._dispatch emits one per XLA program launch
+    # with the bytes shipped in and returned) — dispatch counts prove
+    # whole-pipeline fusion held (one per score call on fused plans) and
+    # the byte totals are the numerator of the achieved-bandwidth
+    # roofline bench reports as `scoring_hbm_frac`. Empty when no
+    # compiled scoring ran inside a span.
+    scoring: Dict[str, Any] = field(default_factory=dict)
     # serving-resilience accounting (serving/resilience.py): breaker
     # open/close transitions, quarantine entries and recoveries with
     # the measured MTTR (mean/max seconds from outage start to the
@@ -130,6 +138,8 @@ class GoodputReport:
             out["perf"] = dict(sorted(self.perf.items()))
         if self.fleet:
             out["fleet"] = dict(sorted(self.fleet.items()))
+        if self.scoring:
+            out["scoring"] = dict(sorted(self.scoring.items()))
         if self.resilience:
             out["resilience"] = dict(sorted(self.resilience.items()))
         return out
@@ -165,6 +175,7 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     compile_hits = 0
     fleet: Dict[str, Any] = {}
     resilience: Dict[str, Any] = {}
+    scoring: Dict[str, Any] = {}
     mttrs: list = []
     # mesh rollup accumulators: several schedules (one per selector fit)
     # can land in one trace — utilization averages weighted by each
@@ -237,6 +248,18 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
                             (d or {}).get("shed", 0) or 0)
             elif name == "tenant_shed":
                 fleet["sheds"] = fleet.get("sheds", 0) + 1
+            elif name == "device_dispatch":
+                scoring["dispatches"] = scoring.get("dispatches", 0) + 1
+                scoring["bytes_in"] = scoring.get("bytes_in", 0) + int(
+                    attrs.get("bytes_in", 0) or 0)
+                scoring["bytes_out"] = scoring.get("bytes_out", 0) + int(
+                    attrs.get("bytes_out", 0) or 0)
+                scoring["dispatch_s"] = round(
+                    scoring.get("dispatch_s", 0.0)
+                    + float(attrs.get("dispatch_s", 0.0) or 0.0), 6)
+                if attrs.get("quant"):
+                    scoring["quant_dispatches"] = \
+                        scoring.get("quant_dispatches", 0) + 1
             elif name == "breaker_open":
                 resilience["breaker_opens"] = \
                     resilience.get("breaker_opens", 0) + 1
@@ -324,6 +347,8 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
         counts["compile_cache_hits"] = compile_hits
     if fleet:
         report.fleet = fleet
+    if scoring:
+        report.scoring = scoring
     if resilience:
         if mttrs:
             resilience["mean_mttr_s"] = round(sum(mttrs) / len(mttrs), 6)

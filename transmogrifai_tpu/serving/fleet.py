@@ -160,7 +160,17 @@ def _stage_signature(stage) -> Dict[str, Any]:
     except Exception:  # unfitted/host stages may not support it
         consts = None
     shape_only = consts is not None
-    entry["params"] = _digest_value(stage.get_params(), shape_only)
+    # signature_params (stages/base.py) is the stage's own statement of
+    # which fitted facts shape the TRACE: lifted families (linear/GLM/
+    # trees…) exclude the weight values they route through
+    # device_constants() — two same-shaped fits then share — while
+    # trace-steering hyperparams (GLM link, GBT learning rate) stay
+    # value-digested
+    try:
+        params = stage.signature_params()
+    except Exception:
+        params = stage.get_params()
+    entry["params"] = _digest_value(params, shape_only)
     if shape_only:
         # the consts pytree structure is part of the jit argument
         # structure even when its values are not
@@ -168,7 +178,7 @@ def _stage_signature(stage) -> Dict[str, Any]:
     return entry
 
 
-def scoring_signature(model) -> str:
+def scoring_signature(model, quant: Any = None) -> str:
     """The compile-group key of a model's bucket programs (the serving
     analogue of `parallel/sweep.static_signature`): a sha256 digest of
     the canonical scoring graph — segment wiring with uids replaced by
@@ -177,11 +187,20 @@ def scoring_signature(model) -> str:
     vs closure-constant facts (value digests for everything a
     `device_apply` reads off `self`). Two models with equal signatures
     trace byte-identical XLA programs per bucket and may share one
-    compiled set through the `ProgramPool`."""
+    compiled set through the `ProgramPool`.
+
+    `quant` (a `workflow.compiled.ScoringQuant`, its mode string, or
+    None) folds the quantized-inference config into the key: a
+    quantized and an unquantized build of one model trace DIFFERENT
+    programs (narrow wire structure, narrowed table dtypes) and must
+    never adopt each other's bucket programs."""
+    from transmogrifai_tpu.workflow.compiled import ScoringQuant
+    q = ScoringQuant.resolve(quant)
     order, stages = _canonical_graph(model)
     fidx = {f.uid: i for i, f in enumerate(order)}
     sidx = {s.uid: i for i, s in enumerate(stages)}
     doc = {
+        "quant": q.mode if q is not None else None,
         "features": [{
             "ftype": f.ftype.__name__,
             "is_response": bool(f.is_response),
@@ -241,7 +260,10 @@ class ProgramPool:
         adopt it onto an existing reference. Returns the reference
         owner's member id when adopted, None when this scorer IS the
         reference."""
-        sig = scoring_signature(model)
+        # the scorer's quantization config is part of the compile-group
+        # key: a quantized member can never adopt an f32 member's
+        # programs (different wire structure and table dtypes)
+        sig = scoring_signature(model, quant=getattr(scorer, "quant", None))
         uids = canonical_uids(model)
         with self._lock:
             entry = self._entries.get(sig)
@@ -320,7 +342,7 @@ class FleetMemberService(ScoringService):
             self._health.member = fleet_name
 
     def _install(self, model, version_id: str, path: Optional[str] = None):
-        scorer = model._ensure_compiled()
+        scorer = model._ensure_compiled(quant=self.config.quantize)
         self.shared_from = self._pool.adopt_or_register(
             f"{self._fleet_name}:{version_id}", model, scorer)
         return super()._install(model, version_id, path=path)

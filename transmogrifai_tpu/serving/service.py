@@ -98,6 +98,14 @@ class ServingConfig:
     # degraded fallback, hang watchdog. None = defaults (enabled);
     # {"enabled": false} turns the layer off
     resilience: Optional[Dict[str, Any]] = None
+    # quantized inference ("int8"/"int4", workflow.compiled.ScoringQuant):
+    # the request matrix ships on a per-batch affine narrow wire and
+    # fitted tables compute in narrowed dtypes inside the fused bucket
+    # programs. Stated per-feature tolerance scale/2 =
+    # (hi − lo)/(2·(2^bits − 1)) on each batch's own range; None = exact
+    # f32 scoring. Folded into the fleet's program-sharing signature, so
+    # quantized and f32 members never adopt each other's programs.
+    quantize: Optional[str] = None
 
     def ladder(self) -> Tuple[int, ...]:
         if self.buckets:
@@ -140,12 +148,12 @@ class ModelVersion:
     """One loaded + warmed model: the unit of hot-swap."""
 
     def __init__(self, model, version_id: str,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None, quant: Optional[str] = None):
         self.model = model
         self.version_id = version_id
         self.path = path or getattr(model, "loaded_from", None)
         self.loaded_at = time.time()
-        self.scorer = model._ensure_compiled()
+        self.scorer = model._ensure_compiled(quant=quant)
         self.compile_counts: Dict[int, int] = {}  # bucket -> traces seen
         self.warm_s: float = 0.0                  # measured warmup wall
         self.cache_saved_s: Optional[float] = None  # vs manifest cold warm
@@ -353,7 +361,8 @@ class ScoringService:
                  path: Optional[str] = None) -> ModelVersion:
         """Load-side half of a swap: compile + warm OFF the serving path,
         then atomically flip `_active`."""
-        version = ModelVersion(model, version_id, path=path)
+        version = ModelVersion(model, version_id, path=path,
+                               quant=self.config.quantize)
         path = version.path  # falls back to the model's loaded_from
         if self.config.warm_on_load:
             manifest = None

@@ -193,6 +193,29 @@ class Transformer(Stage):
         traced argument. Default ignores consts."""
         return self.device_apply(enc, dev)
 
+    def signature_params(self) -> Dict[str, Any]:
+        """Fitted params that shape the TRACED program — the facts
+        `serving/fleet.scoring_signature` folds into the compile-group
+        key. Defaults to `get_params()` (every fitted value is a closure
+        constant baked into the XLA program). Stages that lift their
+        fitted arrays through `device_constants()` override this to
+        exclude the lifted VALUES — they flow as jit arguments, so only
+        their shapes/dtypes key the program (via the consts digest) and
+        same-shaped tenants share one compiled program — while keeping
+        any hyperparams that still steer the trace (static control flow,
+        baked scalars like a GBT learning rate)."""
+        return self.get_params()
+
+    def narrow_device_constants(self, consts: Any) -> Any:
+        """Quantized-inference view of `device_constants()`: the same
+        pytree with HBM-heavy tables re-typed to narrower dtypes, used
+        by the compiled scorer's int8/int4 scoring mode. The narrowing
+        rule must depend only on STATIC shape facts (never array
+        values), so every model sharing a scoring signature narrows to
+        identical traced dtypes and program adoption stays zero-trace.
+        Default: unchanged (nothing to narrow)."""
+        return consts
+
     def output_meta(self) -> Optional[VectorMetadata]:
         """Static vector metadata (set at fit time for fitted models)."""
         return None

@@ -16,22 +16,185 @@ host/device SEGMENTS: each device segment is still one fused XLA program,
 and device outputs materialize to host columns only when a host stage
 actually reads them. A pipeline with no such crossing keeps the single
 fused program.
+
+Roofline scoring (PR 13): tabular scoring is memory-bound, so the hot
+path is engineered against the HBM roofline rather than MFU:
+
+- each device segment's program returns ONLY the outputs something
+  downstream actually reads (a later host segment or a result feature)
+  — intermediates stay fusion-eligible instead of being forced into
+  HBM as program outputs;
+- plans with a single trailing device segment (the overwhelmingly
+  common shape — host string work happens in `host_prepare`, not in
+  host stages) score through `score_padded`'s fused fast path: ONE
+  device dispatch per call, accounted per segment in
+  `analysis.retrace.DISPATCHES` and as `device_dispatch` trace events
+  (bytes in/out per dispatch — the numerator of the achieved-bandwidth
+  roofline `bench.py` reports as `scoring_hbm_frac`);
+- `quant=ScoringQuant("int8"|"int4")` turns on end-to-end quantized
+  inference: the request matrix ships on a per-batch affine uint8 wire
+  (int4 packs two features per byte — same nibble layout as
+  `data/feature_cache.QuantPlan`/`parallel/bigdata._unpack_dequant`)
+  with dequant fused into the scoring program, and fitted tables
+  compute from narrowed dtypes (`Transformer.narrow_device_constants`:
+  f16 tree thresholds, uint8 bin ids, bf16 linear weights). Stated
+  tolerance per feature: scale/2 = (hi − lo)/(2·(2^bits − 1)) on the
+  batch's own [lo, hi] range; masks ride the wire as exact uint8 0/1.
 """
 
 from __future__ import annotations
 
+import time
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from transmogrifai_tpu import types as T
+from transmogrifai_tpu.analysis.retrace import DISPATCHES
 from transmogrifai_tpu.data.columns import Column
 from transmogrifai_tpu.data.dataset import Dataset
 from transmogrifai_tpu.features.dag import topological_layers
+from transmogrifai_tpu.obs.trace import TRACER, add_event
 from transmogrifai_tpu.stages.base import (
     HOST_KINDS as _HOST_KINDS, FeatureGeneratorStage, HostTransformer,
     Transformer, is_host_stage)
+
+
+@dataclass(frozen=True)
+class ScoringQuant:
+    """Quantized-inference mode for the compiled scorer: ``"int8"``
+    ships 1 byte/element on the wire, ``"int4"`` half that (two
+    features per byte). Per-feature max abs error is scale/2 with
+    scale = (hi − lo)/(2^bits − 1) over the BATCH's own value range —
+    a request therefore quantizes against its batchmates' range, so
+    repeat scoring of one row in different batches agrees within the
+    stated tolerance, not bitwise."""
+
+    mode: str = "int8"
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("int8", "int4"):
+            raise ValueError(
+                f"quantized scoring mode must be 'int8' or 'int4', "
+                f"got {self.mode!r}")
+
+    @property
+    def bits(self) -> int:
+        return 4 if self.mode == "int4" else 8
+
+    @staticmethod
+    def resolve(q: Any) -> Optional["ScoringQuant"]:
+        """None | "int8" | "int4" | ScoringQuant -> Optional[ScoringQuant]."""
+        if q is None or isinstance(q, ScoringQuant):
+            return q
+        return ScoringQuant(str(q))
+
+
+# -- quantized request wire -------------------------------------------------- #
+
+def _pack4_np(q: np.ndarray) -> np.ndarray:
+    """(n, d) uint8 in [0,15] -> (n, ceil(d/2)) uint8; feature 2j in the
+    low nibble, 2j+1 high — the `data/feature_cache._pack4` layout, so
+    the device unpack below and `parallel/bigdata._unpack_dequant` agree
+    on the wire format."""
+    n, d = q.shape
+    if d % 2:
+        q = np.concatenate([q, np.zeros((n, 1), np.uint8)], axis=1)
+    return (q[:, 0::2] | (q[:, 1::2] << 4)).astype(np.uint8)
+
+
+def quantize_leaf(arr: np.ndarray, bits: int) -> Dict[str, np.ndarray]:
+    """Host half of the quantized wire: per-feature affine uint8 of one
+    (n,) or (n, d) float leaf against the batch's own [lo, hi] range.
+    NaN quantizes to lo (uint8 casts of NaN are platform-undefined),
+    ±inf clips to the range bounds. The "q1" key marks a 1-D leaf so
+    the device side restores the original rank."""
+    a = np.asarray(arr, np.float32)
+    one_d = a.ndim == 1
+    if one_d:
+        a = a[:, None]
+    import warnings
+    with np.errstate(invalid="ignore"), warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        # FINITE range only: a single ±inf must not degenerate the
+        # affine fit and corrupt its finite batchmates — non-finite
+        # values fall outside [lo, hi] and clip to the bounds below
+        fin = np.where(np.isfinite(a), a, np.nan)
+        lo = np.nanmin(fin, axis=0) if a.shape[0] else np.zeros(a.shape[1])
+        hi = np.nanmax(fin, axis=0) if a.shape[0] else np.zeros(a.shape[1])
+    lo = np.where(np.isfinite(lo), lo, 0.0).astype(np.float32)
+    hi = np.where(np.isfinite(hi), hi, lo).astype(np.float32)
+    qmax = float((1 << bits) - 1)
+    scale = np.where(hi > lo, (hi - lo) / qmax, 1.0).astype(np.float32)
+    q = np.rint((a - lo) / scale)
+    q = np.where(np.isnan(q), 0.0, q)
+    q = np.clip(q, 0.0, qmax).astype(np.uint8)
+    if bits == 4:
+        q = _pack4_np(q)
+    return {("q1" if one_d else "q"): q, "scale": scale, "lo": lo}
+
+
+def dequantize_leaf(wire: Dict[str, Any], bits: int):
+    """Device half (pure jnp, traced INSIDE the scoring program so the
+    dequant fuses with the first consumer): affine x = q·scale + lo,
+    unpacking int4 nibbles first. Mirrors `bigdata._unpack_dequant`."""
+    one_d = "q1" in wire
+    q = wire["q1"] if one_d else wire["q"]
+    scale, lo = wire["scale"], wire["lo"]
+    d = scale.shape[0]
+    if bits == 4:
+        lo_nib = q & jnp.uint8(0x0F)
+        hi_nib = (q >> 4).astype(jnp.uint8)
+        q = jnp.stack([lo_nib, hi_nib], axis=-1) \
+            .reshape(q.shape[0], -1)[:, :d]
+    x = q.astype(jnp.float32) * scale + lo
+    return x[:, 0] if one_d else x
+
+
+_WIRE_KEYS = ({"q", "scale", "lo"}, {"q1", "scale", "lo"})
+
+
+def quantize_wire(tree: Any, bits: int) -> Any:
+    """Structure-preserving wire form of a host device-input pytree:
+    float numpy leaves become affine uint8 wire dicts, "mask" leaves
+    (exact 0/1 floats by the Column contract) become exact uint8, and
+    anything already on device (jax arrays from an earlier segment)
+    passes through untouched."""
+    def walk(node, key=None):
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if isinstance(node, np.ndarray) and node.dtype.kind == "f":
+            if key == "mask":
+                return node.astype(np.uint8)
+            if node.ndim in (1, 2):
+                return quantize_leaf(node, bits)
+        return node
+    return walk(tree)
+
+
+def dequantize_wire(tree: Any, bits: int) -> Any:
+    """Inverse walk, traced inside the jitted program: wire dicts
+    dequantize, uint8 mask leaves cast back to the f32 0/1 contract,
+    device-resident leaves pass through."""
+    def walk(node):
+        if isinstance(node, dict):
+            if set(node) in _WIRE_KEYS:
+                return dequantize_leaf(node, bits)
+            return {k: walk(v) for k, v in node.items()}
+        if getattr(node, "dtype", None) == np.uint8:
+            return node.astype(jnp.float32)
+        return node
+    return walk(tree)
+
+
+def _tree_nbytes(tree: Any) -> int:
+    """Total array bytes in a pytree (the wire/HBM traffic a dispatch
+    ships and returns — the roofline numerator per call)."""
+    return int(sum(getattr(leaf, "nbytes", 0)
+                   for leaf in jax.tree_util.tree_leaves(tree)))
 
 
 def pad_dataset(dataset: Dataset, target_rows: int) -> Dataset:
@@ -79,7 +242,8 @@ def _column_from_device(ftype: type, dev) -> Column:
 
 
 class CompiledScorer:
-    def __init__(self, model, sharding: Optional[Any] = None):
+    def __init__(self, model, sharding: Optional[Any] = None,
+                 quant: Any = None):
         self.model = model
         # optional jax.sharding.NamedSharding for the batch (row) axis:
         # raw device inputs are placed with it, so the fused program's
@@ -87,6 +251,9 @@ class CompiledScorer:
         # any cross-shard collectives (batch scoring is embarrassingly
         # row-parallel, so there are none in practice)
         self.sharding = sharding
+        # quantized inference mode (module docstring): request matrix on
+        # the narrow wire, fitted tables in narrowed dtypes
+        self.quant = ScoringQuant.resolve(quant)
         layers = topological_layers(model.result_features)
         self.generators: List[FeatureGeneratorStage] = list(layers[0]) if layers else []
         ordered: List[Transformer] = []
@@ -107,38 +274,69 @@ class CompiledScorer:
             if not self.segments or self.segments[-1][0] != kind:
                 self.segments.append((kind, []))
             self.segments[-1][1].append(s)
+        # per-segment needed outputs: a device segment returns ONLY what
+        # a later segment's stage or a result feature reads. Everything
+        # else stays an XLA-internal value — fusion-eligible instead of
+        # a forced HBM materialization (the roofline win: the old
+        # every-stage-output contract made each intermediate a program
+        # output the device had to write back per call).
+        result_uids = {f.uid for f in model.result_features}
+        self._seg_out_uids: List[List[str]] = []
+        for i, (kind, stages) in enumerate(self.segments):
+            produced = {self._stage_out_uid[s.uid] for s in stages}
+            needed = set(result_uids)
+            for _, later in self.segments[i + 1:]:
+                for s2 in later:
+                    needed.update(f.uid for f in s2.input_features)
+            self._seg_out_uids.append(sorted(produced & needed))
         # instrumented jit: the retrace monitor counts traces per segment
         # (label = stage ops), so per-batch shape drift shows up as churn
         # on a NAMED program instead of silent recompiles
         from transmogrifai_tpu.analysis.retrace import instrumented_jit
+        self._seg_labels = [
+            "compiled:seg%d[%s]%s" % (
+                i, ",".join(s.operation_name for s in stages),
+                f"@{self.quant.mode}" if self.quant else "")
+            if kind == "device" else None
+            for i, (kind, stages) in enumerate(self.segments)]
         self._seg_fns = [
             (instrumented_jit(
-                self._make_segment_fn(stages),
-                label="compiled:seg%d[%s]" % (
-                    i, ",".join(s.operation_name for s in stages)))
+                self._make_segment_fn(stages, self._seg_out_uids[i]),
+                label=self._seg_labels[i])
              if kind == "device" else None)
             for i, (kind, stages) in enumerate(self.segments)]
         self.device_stages: List[Transformer] = [
             s for kind, stages in self.segments if kind == "device"
             for s in stages]
-        # megabyte-scale fitted arrays (tree tables) flow into the jitted
-        # segments as ARGUMENTS: closure constants are re-staged
-        # host→device on every execution through the serving tunnel
+        # megabyte-scale fitted arrays (tree tables, lifted linear/GLM
+        # weights) flow into the jitted segments as ARGUMENTS: closure
+        # constants are re-staged host→device on every execution through
+        # the serving tunnel, and value-baked weights would force every
+        # tenant onto its own compiled program (serving/fleet.py). In
+        # quantized mode the stage may narrow its tables (shape-gated
+        # dtype rules only, so same-signature tenants narrow alike).
         self._consts: Dict[str, Any] = {}
         for s in self.device_stages:
             c = s.device_constants()
             if c is not None:
-                self._consts[s.uid] = c
+                self._consts[s.uid] = (
+                    s.narrow_device_constants(c) if self.quant else c)
 
     # ------------------------------------------------------------------ #
 
-    def _make_segment_fn(self, stages: List[Transformer]):
+    def _make_segment_fn(self, stages: List[Transformer],
+                         out_uids: Optional[List[str]] = None):
         out_uid = self._stage_out_uid
+        quant = self.quant
 
         def seg_fn(consts: Dict[str, Any], encs: Dict[str, Any],
                    dev_vals: Dict[str, Any]):
+            if quant is not None:
+                # dequant INSIDE the program: XLA fuses the affine
+                # x = q·scale + lo into each leaf's first consumer, so
+                # the f32 request matrix never lands in HBM at full width
+                dev_vals = dequantize_wire(dev_vals, quant.bits)
             vals = dict(dev_vals)
-            outs: Dict[str, Any] = {}
             for stage in stages:
                 dev_inputs = [vals.get(f.uid) for f in stage.input_features]
                 if stage.uid in consts:
@@ -147,10 +345,35 @@ class CompiledScorer:
                 else:
                     out = stage.device_apply(encs.get(stage.uid), dev_inputs)
                 vals[out_uid[stage.uid]] = out
-                outs[out_uid[stage.uid]] = out
-            return outs
+            keep = out_uids if out_uids is not None else \
+                [out_uid[s.uid] for s in stages]
+            return {u: vals[u] for u in keep}
 
         return seg_fn
+
+    def _dispatch(self, seg_idx: int, encs: Dict[str, Any],
+                  dev_vals: Dict[str, Any]) -> Dict[str, Any]:
+        """The ONE device-dispatch site: per-segment dispatch counts land
+        in `analysis.retrace.DISPATCHES` (the roofline smoke asserts one
+        dispatch per score call on fused plans) and a `device_dispatch`
+        event carries the bytes shipped/returned for the current obs
+        span (serving batch spans, bench runs) — fusion and wire wins
+        are visible per call, not just in aggregate."""
+        label = self._seg_labels[seg_idx]
+        t0 = time.perf_counter()
+        out = self._seg_fns[seg_idx](self._consts, encs, dev_vals)
+        DISPATCHES.record(label)
+        if TRACER.current() is not None:
+            # byte accounting only when a span will actually keep the
+            # event — two pytree walks are waste on an untraced hot path
+            add_event("device_dispatch", segment=label,
+                      bytes_in=_tree_nbytes((encs, dev_vals)),
+                      bytes_out=_tree_nbytes(out),
+                      # async dispatch: this is time-to-enqueue, not
+                      # device execution — the honest per-call host cost
+                      dispatch_s=round(time.perf_counter() - t0, 6),
+                      quant=self.quant.mode if self.quant else None)
+        return out
 
     def _fused_index(self) -> int:
         """Index of the single trailing device segment, or raise."""
@@ -161,6 +384,23 @@ class CompiledScorer:
                 "pipeline does not compile to a single trailing device "
                 "segment; use __call__")
         return dev_segs[0]
+
+    @property
+    def fusable(self) -> bool:
+        """True when the whole pipeline collapses to ONE device program
+        per batch shape (host prefix + a single trailing device segment
+        — `score_padded` then takes the one-dispatch fast path; plans
+        with a host stage BETWEEN device segments fall back to the
+        general segmented `__call__`)."""
+        cached = getattr(self, "_fusable", None)
+        if cached is None:
+            try:
+                self._fused_index()
+                cached = True
+            except RuntimeError:
+                cached = False
+            self._fusable = cached
+        return cached
 
     # the driver's single-chip compile check (__graft_entry__) jits this
     @property
@@ -199,6 +439,11 @@ class CompiledScorer:
                 dv = c.device_value()
                 if dv is not None:
                     raw_dev[uid] = dv
+        if self.quant is not None:
+            # quantize HERE, before placement: streaming workers
+            # device_put this pytree, so the narrow wire is what crosses
+            # the host→device link (1 byte/elem int8, 0.5 int4)
+            raw_dev = quantize_wire(raw_dev, self.quant.bits)
         n_rows = len(dataset)
         return (self._place(encs, n_rows), self._place(raw_dev, n_rows),
                 columns)
@@ -244,9 +489,12 @@ class CompiledScorer:
             columns[f.uid] = c
             if c.kind not in _HOST_KINDS:
                 dev_vals[f.uid] = c.device_value()
-        dev_vals = self._place(dev_vals, n_rows)
+        if self.quant is None:
+            # quantized mode defers placement to the dispatch site so
+            # the NARROW wire (not the f32 original) crosses the link
+            dev_vals = self._place(dev_vals, n_rows)
 
-        for (kind, stages), jfn in zip(self.segments, self._seg_fns):
+        for seg_idx, (kind, stages) in enumerate(self.segments):
             if kind == "host":
                 for stage in stages:
                     inputs = []
@@ -261,7 +509,12 @@ class CompiledScorer:
                     columns[uid] = out_col
                     dv = out_col.device_value()
                     if dv is not None:
-                        dev_vals[uid] = self._place(dv, n_rows)
+                        # quantized mode keeps host outputs HOST-side
+                        # until the dispatch site quantizes+places them:
+                        # placing here would ship full-width f32 and make
+                        # numerics depend on whether sharding is set
+                        dev_vals[uid] = dv if self.quant is not None \
+                            else self._place(dv, n_rows)
             else:
                 encs: Dict[str, Any] = {}
                 for stage in stages:
@@ -269,8 +522,15 @@ class CompiledScorer:
                     enc = stage.host_prepare(cols)
                     if enc is not None:
                         encs[stage.uid] = enc
-                dev_vals.update(jfn(self._consts, self._place(encs, n_rows),
-                                    dev_vals))
+                args = dev_vals
+                if self.quant is not None:
+                    # wire form of the still-host-resident leaves only;
+                    # device arrays from earlier segments pass through
+                    # (quantizing them would round-trip HBM→host)
+                    args = self._place(
+                        quantize_wire(dev_vals, self.quant.bits), n_rows)
+                dev_vals.update(
+                    self._dispatch(seg_idx, self._place(encs, n_rows), args))
         return dev_vals, columns
 
     def __call__(self, dataset: Dataset) -> Dict[str, Any]:
@@ -283,6 +543,29 @@ class CompiledScorer:
                 result[f.name] = columns[f.uid].data
         return result
 
+    def score_fused(self, dataset: Dataset) -> Dict[str, Any]:
+        """One-dispatch scoring for single-trailing-device-segment plans:
+        host phase (generators + host prefix + host_prepare + wire
+        quantization) then EXACTLY ONE device dispatch of the fused
+        program, which returns only the result features. Raises
+        RuntimeError on multi-device-segment plans — `__call__` is the
+        general fallback."""
+        fi = self._fused_index()
+        encs, raw_dev, columns = self.host_phase(dataset)
+        out = self._dispatch(fi, encs, raw_dev)
+        result: Dict[str, Any] = {}
+        for f in self.model.result_features:
+            if f.uid in out:
+                result[f.name] = out[f.uid]
+            else:
+                c = columns[f.uid]
+                dv = c.device_value()
+                # raw/host-prefix result features never ride the wire:
+                # their original (unquantized) host values are returned
+                # exactly, matching __call__'s dev_vals
+                result[f.name] = dv if dv is not None else c.data
+        return result
+
     def score_padded(self, dataset: Dataset,
                      pad_to: int) -> Dict[str, Any]:
         """Score `dataset` padded up to `pad_to` rows (a shape bucket),
@@ -292,9 +575,16 @@ class CompiledScorer:
         [0, n_valid) of every result leaf are the real ones and the tail
         is sliced off before anything leaves this call. Each distinct
         `pad_to` value compiles once; every batch size <= `pad_to` then
-        reuses that program (the serving batcher's bucket ladder)."""
+        reuses that program (the serving batcher's bucket ladder).
+
+        Fusable plans (the serving hot path) route through `score_fused`:
+        one device dispatch per call, result features only. Pad rows
+        repeat a REAL row, so they never widen the quantized wire's
+        per-batch [lo, hi] range — valid-row results are invariant to
+        the bucket they were padded to."""
         n_valid = len(dataset)
-        out = self(pad_dataset(dataset, pad_to))
+        padded = pad_dataset(dataset, pad_to)
+        out = self.score_fused(padded) if self.fusable else self(padded)
         if pad_to == n_valid:
             return out
         return {name: slice_result_tree(v, 0, n_valid)

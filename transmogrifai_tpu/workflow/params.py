@@ -85,12 +85,17 @@ class ServingParams:
     # circuit breaker + degraded fallback, hang watchdog (None =
     # defaults, enabled; {"enabled": false} turns the layer off)
     resilience: Optional[Dict[str, Any]] = None
+    # quantized inference mode ("int8"/"int4"): request matrix on a
+    # per-batch affine narrow wire + narrowed fitted-table dtypes inside
+    # the fused bucket programs (workflow/compiled.ScoringQuant; None =
+    # exact f32 scoring)
+    quantize: Optional[str] = None
 
     _FIELDS = ("host", "port", "max_batch", "min_bucket", "buckets",
                "max_queue", "batch_wait_ms", "default_deadline_ms",
                "warm_on_load", "keep_versions", "auto_ladder",
                "feature_cache", "compile_cache", "compile_cache_dir",
-               "warmup_manifest", "fleet", "resilience")
+               "warmup_manifest", "fleet", "resilience", "quantize")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "ServingParams":
@@ -116,7 +121,8 @@ class ServingParams:
             compile_cache=self.compile_cache,
             compile_cache_dir=self.compile_cache_dir,
             warmup_manifest=self.warmup_manifest,
-            resilience=self.resilience)
+            resilience=self.resilience,
+            quantize=self.quantize)
 
     def to_fleet_config(self):
         """The serving.fleet.FleetConfig view of the `fleet` block, with

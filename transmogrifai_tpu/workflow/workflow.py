@@ -485,19 +485,28 @@ class WorkflowModel:
             return columns
         return {f.name: columns[f.uid] for f in self.result_features}
 
-    def _ensure_compiled(self, sharding=None, strict: bool = True):
+    def _ensure_compiled(self, sharding=None, strict: bool = True,
+                         quant=None):
         """Shared gate for EVERY compiled entry point (score_compiled,
         score_stream, score_function): opcheck-validate the fitted graph
         before building a new CompiledScorer. Post-train the graph's
         origin stages ARE the fitted transformers (the estimator→model
         swap in stages/base.py mutates the feature nodes in place), so
-        the device-contract checks see exactly what the planner traces."""
-        from transmogrifai_tpu.workflow.compiled import CompiledScorer
+        the device-contract checks see exactly what the planner traces.
+
+        `quant` ("int8"/"int4"/ScoringQuant/None) selects the quantized
+        inference mode — a different compiled program set, so the cached
+        scorer is rebuilt when it changes."""
+        from transmogrifai_tpu.workflow.compiled import (
+            CompiledScorer, ScoringQuant)
+        q = ScoringQuant.resolve(quant)
         if self._compiled is None or \
-                getattr(self._compiled, "sharding", None) != sharding:
+                getattr(self._compiled, "sharding", None) != sharding or \
+                getattr(self._compiled, "quant", None) != q:
             _validate_or_raise(self.result_features, strict,
                                where="compile")
-            self._compiled = CompiledScorer(self, sharding=sharding)
+            self._compiled = CompiledScorer(self, sharding=sharding,
+                                            quant=q)
         return self._compiled
 
     def score_compiled(self, dataset: Dataset, sharding=None,
