@@ -17,7 +17,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 
 .PHONY: lint serve-smoke fleet-smoke chaos-smoke ingest-smoke \
 	faults-smoke trace-smoke cache-smoke multichip-smoke \
-	continual-smoke costmodel-smoke roofline-smoke test check
+	continual-smoke costmodel-smoke roofline-smoke slo-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -110,6 +110,17 @@ continual-smoke:
 trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.obs.smoke
 
+# observability-plane smoke: scripted traffic + one injected
+# device-error storm against a served model — asserts the traceparent
+# roundtrip (caller trace id echoed; queue-wait/assemble+parse/pad/
+# dispatch spans under the request root), tail sampling keeping every
+# error trace while head-sampling successes, the breaker-open flight
+# dump validating as a Chrome trace with the failing dispatch spans,
+# and the availability SLO burn-rate alert firing during the storm and
+# clearing after recovery. See transmogrifai_tpu/obs/slo_smoke.py.
+slo-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.obs.slo_smoke
+
 # learned-cost-model smoke: a synthetic corpus fits to holdout MAPE
 # under the gate per target; then a real multi-block sweep on 8 forced
 # host devices schedules count-LPT (cold model, recording its block
@@ -123,5 +134,5 @@ test:
 	@$(TIER1)
 
 check: lint serve-smoke fleet-smoke chaos-smoke roofline-smoke \
-	ingest-smoke cache-smoke faults-smoke trace-smoke multichip-smoke \
-	continual-smoke costmodel-smoke test
+	ingest-smoke cache-smoke faults-smoke trace-smoke slo-smoke \
+	multichip-smoke continual-smoke costmodel-smoke test

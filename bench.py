@@ -1117,8 +1117,13 @@ def run_serving() -> None:
         _emit({"metric": "serve_setup_s", "platform": platform,
                "value": round(time.perf_counter() - t0, 2), "unit": "s",
                "vs_baseline": 0.0, "model_version": version})
-        for max_batch, quantize in ((8, None), (32, None), (128, None),
-                                    (128, "int8")):
+        # (max_batch, quantize, tracing): the extra (128, None, False)
+        # config is the tail-sampled-tracing overhead control — same
+        # ladder, tracing off — for the `serve_trace_overhead` emission
+        p99_by_config: dict = {}
+        for max_batch, quantize, tracing in (
+                (8, None, True), (32, None, True), (128, None, True),
+                (128, None, False), (128, "int8", True)):
             if _remaining() < duration_s + 30.0:
                 _emit({"metric": "serve_skipped", "value": float(max_batch),
                        "unit": "config", "vs_baseline": 0.0,
@@ -1126,7 +1131,7 @@ def run_serving() -> None:
                 break
             svc = ScoringService.from_path(tmp, config=ServingConfig(
                 max_batch=max_batch, batch_wait_ms=1.0, max_queue=1024,
-                quantize=quantize))
+                quantize=quantize, tracing={"enabled": tracing}))
             svc.start()
             stop_at = time.perf_counter() + duration_s
             sent = [0] * n_clients
@@ -1163,13 +1168,27 @@ def run_serving() -> None:
             # quantized) over warm score_padded wall, beside the
             # dispatch count that proves whole-pipeline fusion held
             buckets = _bucket_roofline(svc, rows)
+            # per-phase breakdown (parse called out by ROADMAP as the
+            # serving-p50 dominator): p50/p99 of every
+            # serving_phase_seconds series this config populated
+            phases = {}
+            for entry in reg.get("serving_phase_seconds",
+                                 {"series": []})["series"]:
+                name = entry["labels"].get("phase", "?")
+                phases[name] = {
+                    "p50_ms": (round(entry["p50"] * 1e3, 4)
+                               if entry.get("p50") is not None else None),
+                    "p99_ms": (round(entry["p99"] * 1e3, 4)
+                               if entry.get("p99") is not None else None),
+                }
             svc.stop()
+            p99_by_config[(max_batch, quantize, tracing)] = lat["p99"]
             _emit({
                 "metric": "serve_rows_per_sec", "platform": platform,
                 "value": round(scored / max(wall, 1e-9), 1),
                 "unit": "rows/s", "vs_baseline": 0.0,
                 "max_batch": max_batch, "clients": n_clients,
-                "quantize": quantize,
+                "quantize": quantize, "tracing": tracing,
                 "rows": scored, "errors": sum(errors),
                 "latency_p50_ms": (round(lat["p50"] * 1e3, 3)
                                    if lat["p50"] is not None else None),
@@ -1178,6 +1197,24 @@ def run_serving() -> None:
                 "pad_fraction": round(pad / max(pad + scored, 1), 4),
                 "bucket_roofline": buckets,
             })
+            if phases:
+                _emit({"metric": "serve_phase_breakdown",
+                       "platform": platform,
+                       "value": float(len(phases)), "unit": "phases",
+                       "vs_baseline": 0.0, "max_batch": max_batch,
+                       "quantize": quantize, "phases": phases})
+        on = p99_by_config.get((128, None, True))
+        off = p99_by_config.get((128, None, False))
+        if on is not None and off is not None and off > 0:
+            # acceptance gate: tail-sampled tracing must cost < 5% p99
+            # at the 128-ladder config
+            _emit({"metric": "serve_trace_overhead", "platform": platform,
+                   "value": round(on / off - 1.0, 4), "unit": "frac",
+                   "vs_baseline": 0.0,
+                   "p99_tracing_on_ms": round(on * 1e3, 3),
+                   "p99_tracing_off_ms": round(off * 1e3, 3),
+                   "budget_frac": 0.05,
+                   "within_budget": bool(on / off - 1.0 < 0.05)})
 
 
 def run_continual() -> None:
@@ -1527,6 +1564,9 @@ def run_chaos_bench() -> None:
       the untouched members must hold availability 1.0;
     - ``chaos_recovery_s``: time-to-structured-answer for the killed
       and stalled scoring threads vs the configured stall budget;
+    - ``chaos_slo_alert_s``: storm start → availability burn-rate alert
+      firing (and the measured clear after recovery), plus the
+      breaker-open flight-dump proof;
     - ``chaos_supervisor_restart``: the continual supervisor surviving
       a killed cycle."""
     import tempfile
@@ -1542,8 +1582,21 @@ def run_chaos_bench() -> None:
             # fires serving-bucket refits mid-window and pollutes p99
             os.environ["TRANSMOGRIFAI_PERF_CORPUS_DIR"] = \
                 f"{tmp}/perf-corpus"
-        report = run_chaos(_train_models(tmp), seed=0, load_s=load_s)
+        report = run_chaos(_train_models(tmp), seed=0, load_s=load_s,
+                           flight_dir=f"{tmp}/flight")
         storm = report["storm"]
+        slo = report.get("slo") or {}
+        fl = report.get("flight") or {}
+        _emit({"metric": "chaos_slo_alert_s", "platform": platform,
+               "value": slo.get("alert_s") or 0.0, "unit": "s",
+               "vs_baseline": 0.0, "fired": slo.get("fired"),
+               "cleared": slo.get("cleared"),
+               "clear_s": slo.get("clear_s"),
+               "goodput_slo": report.get("goodput_slo"),
+               "flight_breaker_dump": fl.get("breaker_dump"),
+               "flight_valid_chrome_trace": fl.get("valid_chrome_trace"),
+               "flight_failing_dispatch_spans":
+                   fl.get("failing_dispatch_spans")})
         _emit({"metric": "chaos_mttr_s", "platform": platform,
                "value": storm.get("mttr_s") or 0.0, "unit": "s",
                "vs_baseline": 0.0, "member": storm["member"],

@@ -22,6 +22,17 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # (perf.params.enabled() reads the env per call).
 os.environ["TRANSMOGRIFAI_PERF_MODEL"] = "0"
 
+# Crash flight recorder: serving tests trip breakers/watchdogs on
+# purpose, and each incident dumps a post-mortem artifact — point the
+# dump dir at a per-run temp location instead of the developer's
+# ~/.cache (same hygiene rule as the perf corpus above).
+import tempfile as _tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "TRANSMOGRIFAI_FLIGHT_DIR",
+    os.path.join(_tempfile.gettempdir(),
+                 f"transmogrifai-flight-tests-{os.getpid()}"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -352,6 +352,100 @@ class TestMetricsExposition:
         assert h.count == n_threads * per
         assert h.bucket_counts()[-1][1] == n_threads * per
 
+    def test_exemplar_exposition_and_escaping(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("ex_seconds", "h", bounds=(0.1, 1.0),
+                          phase="parse")
+        h.observe(0.05, exemplar="trace-1")
+        h.observe(0.5)                       # no exemplar on this bucket
+        h.observe(5.0, exemplar='t"2\\x')    # +Inf bucket, hostile id
+        text = reg.to_prometheus()
+        b = [ln for ln in text.splitlines()
+             if ln.startswith("ex_seconds_bucket")]
+        assert len(b) == 3
+        # OpenMetrics exemplar: ` # {trace_id="..."} value ts` on the
+        # buckets that hold one; the escaping keeps the line parseable
+        assert '# {trace_id="trace-1"} 0.05' in b[0]
+        assert "# {" not in b[1]
+        assert r'# {trace_id="t\"2\\x"}' in b[2]
+        # last-write-wins per bucket
+        h.observe(0.01, exemplar="trace-9")
+        assert any(e[1] == "trace-9" for e in h.exemplars())
+        assert not any(e[1] == "trace-1" for e in h.exemplars())
+
+    def test_exemplar_json_parity(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("par_seconds", "h", bounds=(0.1, 1.0),
+                          phase="dispatch")
+        h.observe(0.05, exemplar="tid-a")
+        h.observe(0.5, exemplar="tid-b")
+        entry = reg.to_json()["par_seconds"]["series"][0]
+        assert entry["labels"] == {"phase": "dispatch"}
+        got = {(e["le"], e["trace_id"]) for e in entry["exemplars"]}
+        assert got == {(0.1, "tid-a"), (1.0, "tid-b")}
+        # the ?format=json values mirror exactly what exemplars() holds
+        assert {(b, i) for b, i, _, _ in h.exemplars()} == got
+
+    def test_scrape_under_concurrent_writes_with_exemplars(self):
+        # the satellite contract: scraping (text + json) while writer
+        # threads hammer the labeled serving_phase_seconds family (with
+        # exemplars) never crashes, never emits a malformed line, and
+        # cumulative bucket counts never dip within one scrape
+        reg = MetricsRegistry()
+        phases = ("parse", "queue_wait", "pad", "dispatch")
+        stop = threading.Event()
+        errors = []
+
+        def writer(i):
+            k = 0
+            while not stop.is_set():
+                h = reg.histogram("serving_phase_seconds", "h",
+                                  phase=phases[k % len(phases)])
+                h.observe((k % 7) / 10.0,
+                          exemplar=f"trace-{i}-{k}" if k % 3 == 0
+                          else None)
+                k += 1
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    text = reg.to_prometheus()
+                    j = reg.to_json()
+                except Exception as e:  # pragma: no cover - the assert
+                    errors.append(repr(e))
+                    return
+                per_series = {}
+                for ln in text.splitlines():
+                    if ln.startswith("serving_phase_seconds_bucket"):
+                        key = ln.split("phase=")[1].split('"')[1]
+                        val = int(ln.split(" # ")[0].rsplit(" ", 1)[1])
+                        per_series.setdefault(key, []).append(val)
+                for key, vals in per_series.items():
+                    if vals != sorted(vals):
+                        errors.append(f"cumulative dip in {key}: {vals}")
+                        return
+                for fam in j.values():
+                    for entry in fam["series"]:
+                        for ex in entry.get("exemplars", ()):
+                            if not ex["trace_id"].startswith("trace-"):
+                                errors.append(f"garbled exemplar: {ex}")
+                                return
+
+        writers = [threading.Thread(target=writer, args=(i,))
+                   for i in range(4)]
+        scrapers = [threading.Thread(target=scraper) for _ in range(2)]
+        for t in writers + scrapers:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in writers + scrapers:
+            t.join(timeout=10)
+        assert errors == []
+        total = sum(
+            e["count"] for e in
+            reg.to_json()["serving_phase_seconds"]["series"])
+        assert total > 0
+
     def test_concurrent_family_registration(self):
         reg = MetricsRegistry()
         out = []
@@ -366,10 +460,18 @@ class TestMetricsExposition:
             t.join()
         assert all(o is out[0] for o in out), "one series, not eight"
 
-    def test_serving_import_path_still_works(self):
-        # the compatibility contract: serving.metrics is obs.metrics
+    def test_serving_import_path_still_works_but_warns(self):
+        # the compatibility contract: serving.metrics is obs.metrics —
+        # and importing the shim says so with a DeprecationWarning
+        import importlib
+
+        import pytest
+
         from transmogrifai_tpu.obs import metrics as om
-        from transmogrifai_tpu.serving import metrics as sm
+        with pytest.warns(DeprecationWarning,
+                          match="obs.metrics instead"):
+            import transmogrifai_tpu.serving.metrics as sm
+            sm = importlib.reload(sm)  # warn even if already imported
         assert sm.MetricsRegistry is om.MetricsRegistry
         assert sm.Histogram is om.Histogram
         assert sm.REGISTRY is om.REGISTRY

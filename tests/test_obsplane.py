@@ -1,0 +1,762 @@
+"""PR-14 observability plane: request-scoped tracing (W3C traceparent,
+tail sampling, phase spans + histogram exemplars), the crash flight
+recorder, and the SLO burn-rate engine — plus the multi-process
+Chrome-trace validator satellite.
+
+The acceptance test (`TestRequestTracingE2E`) drives a real HTTP
+`/score` with a caller-supplied ``traceparent`` and asserts ONE trace
+containing queue-wait, assembly (with a nonzero ``parse`` child), pad,
+and device-dispatch spans parented under the request, the same trace id
+echoed in the response headers, and that id attached as an exemplar on
+the latency-histogram bucket the request landed in.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.obs import flight as flight_mod
+from transmogrifai_tpu.obs.export import (
+    chrome_trace, merge_chrome_traces, validate_chrome_trace)
+from transmogrifai_tpu.obs.flight import FlightRecorder
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.obs.slo import (
+    SLO, SLOEngine, SLOParams, availability_source, latency_source,
+    staleness_source)
+from transmogrifai_tpu.obs.trace import (
+    TRACER, RequestTrace, Span, TailSampler, TraceContext, TracingParams,
+    format_traceparent, now_s, parse_traceparent)
+from transmogrifai_tpu.ops.numeric import RealVectorizer
+from transmogrifai_tpu.serving.http import serve
+from transmogrifai_tpu.serving.service import ScoringService, ServingConfig
+from transmogrifai_tpu.workflow import Workflow
+
+D = 3
+ROW = {f"x{j}": 0.2 * (j + 1) for j in range(D)}
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    rng = np.random.default_rng(17)
+    n = 160
+    X = rng.normal(size=(n, D))
+    beta = rng.normal(size=D)
+    ds = Dataset({**{f"x{j}": X[:, j] for j in range(D)},
+                  "y": (X @ beta > 0).astype(np.float64)},
+                 {**{f"x{j}": t.Real for j in range(D)},
+                  "y": t.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = RealVectorizer(track_nulls=False).set_input(*preds).get_output()
+    pred = OpLogisticRegression(max_iter=40).set_input(
+        label, vec).get_output()
+    out = str(tmp_path_factory.mktemp("obsplane") / "model")
+    Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train().save(out)
+    return out
+
+
+@pytest.fixture(scope="module")
+def svc(model_dir):
+    service = ScoringService.from_path(
+        model_dir, config=ServingConfig(max_batch=8, batch_wait_ms=1.0))
+    service.start()
+    yield service
+    service.stop()
+
+
+# --------------------------------------------------------------------- #
+# W3C traceparent                                                       #
+# --------------------------------------------------------------------- #
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+        hdr = format_traceparent(tid, 0x1234, sampled=True)
+        parsed = parse_traceparent(hdr)
+        assert parsed == (tid, "0000000000001234", True)
+
+    def test_unsampled_flag(self):
+        hdr = format_traceparent("ab" * 16, 1, sampled=False)
+        assert parse_traceparent(hdr)[2] is False
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-00f067aa0ba902b7-01",
+        "00-" + "0" * 32 + "-00f067aa0ba902b7-01",   # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero parent
+        "ff-" + "ab" * 16 + "-00f067aa0ba902b7-01",  # invalid version
+    ])
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_short_internal_id_left_padded(self):
+        hdr = format_traceparent("abc123def456", 7)
+        assert parse_traceparent(hdr) is not None
+
+    def test_context_from_header_carries_sampled(self):
+        ctx = TraceContext.from_traceparent(
+            format_traceparent("cd" * 16, 9, sampled=True))
+        assert ctx.trace_id == "cd" * 16 and ctx.sampled
+        rt = RequestTrace(ctx=ctx)
+        assert rt.forced and rt.trace_id == "cd" * 16
+        assert rt.root.attributes["parent_traceparent"] \
+            == "0000000000000009"
+
+
+# --------------------------------------------------------------------- #
+# tail sampler                                                          #
+# --------------------------------------------------------------------- #
+
+class TestTailSampler:
+    def test_errors_and_forced_always_kept(self):
+        s = TailSampler(TracingParams(head_sample_every=1000))
+        assert s.decide(0.001, error=True) == (True, "error")
+        assert s.decide(0.001, forced=True) == (True, "forced")
+
+    def test_head_sampling_cadence(self):
+        s = TailSampler(TracingParams(head_sample_every=8,
+                                      min_latency_samples=10_000))
+        kept = [s.decide(0.001)[0] for _ in range(32)]
+        assert sum(kept) == 4  # 1 in 8
+        assert s.dropped == 28
+
+    def test_slow_tail_kept_after_warmup(self):
+        s = TailSampler(TracingParams(head_sample_every=10_000,
+                                      slow_quantile=0.9,
+                                      min_latency_samples=50))
+        for _ in range(100):
+            s.decide(0.001)
+        keep, reason = s.decide(1.0)  # far past the rolling q90
+        assert keep and reason == "slow"
+
+    def test_observe_collects_only_kept(self):
+        from transmogrifai_tpu.obs.trace import Tracer
+        tracer = Tracer()
+        s = TailSampler(TracingParams(head_sample_every=1000,
+                                      min_latency_samples=10_000))
+        rt_drop = RequestTrace()
+        # first decision is the head sample; burn it so the next drops
+        s.decide(0.001)
+        assert not s.observe(rt_drop, 0.001, tracer=tracer)
+        rt_keep = RequestTrace()
+        rt_keep.finish("boom")
+        assert s.observe(rt_keep, 0.001, error=True, tracer=tracer)
+        names = [sp.trace_id for sp in tracer.spans()]
+        assert rt_keep.trace_id in names
+        assert rt_drop.trace_id not in names
+
+    def test_sampler_counters_land_in_registry(self):
+        reg = MetricsRegistry()
+        s = TailSampler(TracingParams(head_sample_every=4,
+                                      min_latency_samples=10_000),
+                        registry=reg)
+        for _ in range(8):
+            s.decide(0.001)
+        j = reg.to_json()
+        kept = sum(e["value"] for e in
+                   j["serving_trace_kept_total"]["series"])
+        dropped = j["serving_trace_dropped_total"]["series"][0]["value"]
+        assert kept == 2 and dropped == 6
+
+    def test_params_validation(self):
+        with pytest.raises(ValueError):
+            TracingParams(slow_quantile=1.5)
+        with pytest.raises(ValueError):
+            TracingParams(head_sample_every=0)
+
+
+# --------------------------------------------------------------------- #
+# request-trace span buffer                                             #
+# --------------------------------------------------------------------- #
+
+class TestRequestTrace:
+    def test_backdated_children_and_phase_durations(self):
+        rt = RequestTrace(rows=3)
+        t0 = now_s()
+        rt.child_at("serving:queue_wait", t0 - 0.010, t0 - 0.004)
+        rt.child_at("serving:device_dispatch", t0 - 0.004, t0 - 0.001,
+                    bucket=4)
+        with rt.child("serving:demux"):
+            pass
+        rt.finish()
+        phases = rt.phase_durations()
+        assert phases["queue_wait"] == pytest.approx(0.006, abs=1e-4)
+        assert phases["device_dispatch"] == pytest.approx(0.003, abs=1e-4)
+        assert "demux" in phases
+        assert all(sp.parent_id == rt.root.span_id
+                   for sp in rt.spans[1:])
+
+    def test_finish_idempotent_and_error(self):
+        rt = RequestTrace()
+        rt.finish("deadline_exceeded")
+        end = rt.root.end_s
+        rt.finish()  # second finish is a no-op
+        assert rt.root.end_s == end
+        assert rt.root.error == "deadline_exceeded"
+
+
+# --------------------------------------------------------------------- #
+# flight recorder                                                       #
+# --------------------------------------------------------------------- #
+
+class TestFlightRecorder:
+    def _span(self, name, error=None, parent=None):
+        sp = Span(name, category="serving", parent=parent)
+        sp.error = error
+        sp.end()
+        return sp
+
+    def test_ring_bounded_and_drops_counted(self):
+        rec = FlightRecorder(capacity=8)
+        rec.enabled = True
+        for i in range(20):
+            rec.note_event("tick", {"i": i})
+        assert len(rec.snapshot()) == 8
+        assert rec.records_seen == 20
+
+    def test_dump_is_valid_chrome_trace(self, tmp_path):
+        rec = FlightRecorder(capacity=64, dump_dir=str(tmp_path),
+                             min_interval_s=0.0)
+        rec.enabled = True
+        parent = self._span("serving:batch")
+        rec.note_span(parent)
+        child = self._span("serving:device_dispatch", error="boom",
+                           parent=parent)
+        rec.note_span(child)
+        rec.note_event("breaker_open", {"member": "a"})
+        rec.note_metric("queue_depth", 3.0)
+        path = rec.dump("unit")
+        assert path is not None and path.endswith("unit")
+        with open(f"{path}/trace.json") as fh:
+            trace = json.load(fh)
+        assert validate_chrome_trace(trace) == []
+        names = [ev["name"] for ev in trace["traceEvents"]]
+        assert "serving:device_dispatch" in names
+        assert "breaker_open" in names and "queue_depth" in names
+        lines = open(f"{path}/events.jsonl").read().splitlines()
+        assert len(lines) == 4
+        meta = json.load(open(f"{path}/meta.json"))
+        assert meta["reason"] == "unit" and meta["records"] == 4
+
+    def test_orphaned_parent_detached(self, tmp_path):
+        rec = FlightRecorder(dump_dir=str(tmp_path), min_interval_s=0.0)
+        rec.enabled = True
+        never_finished = Span("open:root")
+        rec.note_span(self._span("child", parent=never_finished))
+        path = rec.dump("orphan")
+        with open(f"{path}/trace.json") as fh:
+            trace = json.load(fh)
+        assert validate_chrome_trace(trace) == []
+        ev = next(e for e in trace["traceEvents"]
+                  if e.get("name") == "child")
+        assert ev["args"]["parent_id"] is None
+        assert ev["args"]["orphaned_parent"] == never_finished.span_id
+
+    def test_debounce_and_force(self, tmp_path):
+        rec = FlightRecorder(dump_dir=str(tmp_path), min_interval_s=60.0)
+        rec.enabled = True
+        rec.note_event("x", {})
+        assert rec.dump("first") is not None
+        assert rec.dump("second") is None          # debounced
+        assert rec.dump("third", force=True) is not None
+        assert len(rec.dumps) == 2
+
+    def test_disabled_recorder_is_inert(self, tmp_path):
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        rec.note_event("x", {})
+        assert rec.snapshot() == []
+        assert rec.dump("nope") is None
+
+    def test_record_event_feeds_process_recorder(self, tmp_path):
+        from transmogrifai_tpu.obs.export import record_event
+        rec = flight_mod.get_recorder()
+        was = rec.enabled
+        rec.enabled = True
+        try:
+            before = rec.records_seen
+            record_event("unit_test_event", foo=1)
+            assert rec.records_seen == before + 1
+        finally:
+            rec.enabled = was
+
+
+# --------------------------------------------------------------------- #
+# SLO burn-rate engine                                                  #
+# --------------------------------------------------------------------- #
+
+class TestSLOEngine:
+    def _engine(self, reg=None, objective=0.99):
+        params = SLOParams(
+            slos=[{"name": "avail", "kind": "availability",
+                   "objective": objective}],
+            windows=[[60.0, 10.0, 2.0, "page"]], eval_period_s=1.0)
+        engine = SLOEngine(params, registry=reg)
+        state = {"good": 0.0, "total": 0.0}
+        engine.set_source("avail",
+                          lambda: (state["good"], state["total"]))
+        return engine, state
+
+    def test_fires_on_burn_and_clears(self):
+        engine, state = self._engine()
+        now = 1000.0
+        for i in range(12):  # healthy baseline across the long window
+            state["good"] += 10
+            state["total"] += 10
+            engine.evaluate(now=now + i)
+        assert engine.firing() == []
+        # storm: 50% errors for a few ticks — burn >> 2x of a 1% budget
+        for i in range(4):
+            state["good"] += 5
+            state["total"] += 10
+            engine.evaluate(now=now + 12 + i)
+        assert engine.firing() == ["avail"]
+        st = engine.status(now=now + 16)["slos"]["avail"]
+        assert st["state"] == "firing" and st["alerts"] == 1
+        # recovery: healthy traffic while the bad samples age out of
+        # the 60s window
+        for i in range(70):
+            state["good"] += 10
+            state["total"] += 10
+            engine.evaluate(now=now + 16 + i)
+        assert engine.firing() == []
+        st = engine.status(now=now + 86)["slos"]["avail"]
+        assert st["state"] == "ok"
+
+    def test_multiwindow_requires_both(self):
+        # a blip that clears before the LONG window accumulates enough
+        # budget burn must not page: short window spikes, long stays ok
+        params = SLOParams(
+            slos=[{"name": "avail", "kind": "availability",
+                   "objective": 0.9}],
+            windows=[[100.0, 5.0, 5.0, "page"]], eval_period_s=1.0)
+        engine = SLOEngine(params)
+        state = {"good": 0.0, "total": 0.0}
+        engine.set_source("avail",
+                          lambda: (state["good"], state["total"]))
+        now = 0.0
+        for i in range(99):
+            state["good"] += 100
+            state["total"] += 100
+            engine.evaluate(now=now + i)
+        # one bad tick: short-window burn explodes (100% of 10 samples
+        # over 5s), long window barely moves (10/9910)
+        state["total"] += 10
+        engine.evaluate(now=now + 99)
+        assert engine.firing() == []
+
+    def test_gauges_and_events(self):
+        reg = MetricsRegistry()
+        engine, state = self._engine(reg=reg)
+        carrier = Span("run:test")
+        engine.span = carrier
+        now = 0.0
+        for i in range(12):
+            state["good"] += 10
+            state["total"] += 10
+            engine.evaluate(now=now + i)
+        for i in range(4):
+            state["total"] += 10
+            engine.evaluate(now=now + 12 + i)
+        j = reg.to_json()
+        assert j["slo_alert_active"]["series"][0]["value"] == 1.0
+        burn = j["slo_burn_rate"]["series"][0]
+        assert burn["labels"]["slo"] == "avail" and burn["value"] > 2.0
+        assert j["slo_budget_remaining"]["series"][0]["value"] < 1.0
+        events = [(n, a) for n, _, a in carrier.events
+                  if n == "slo_alert"]
+        assert events and events[0][1]["state"] == "firing"
+
+    def test_goodput_slo_section(self):
+        from transmogrifai_tpu.obs.goodput import build_report
+        with TRACER.span("run:slounit", category="run",
+                         new_trace=True) as root:
+            root.event("slo_alert", slo="avail", state="firing",
+                       windows="page:60s")
+            root.event("slo_alert", slo="avail", state="resolved",
+                       alert_s=2.5)
+        report = build_report(root, TRACER.trace_spans(root.trace_id))
+        assert report.slo["alerts_fired"] == 1
+        assert report.slo["alerts_resolved"] == 1
+        assert report.slo["by_slo"]["avail"]["alert_s"] == 2.5
+        assert "slo" in report.to_json()
+
+    def test_latency_source_reads_histogram_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "h")
+        for v in (0.001, 0.002, 0.2, 0.4):
+            h.observe(v)
+        good, total = latency_source(reg, "lat_seconds", 0.05)()
+        assert (good, total) == (2.0, 4.0)
+        # missing family: no traffic, not a crash
+        assert latency_source(reg, "nope", 0.05)() == (0.0, 0.0)
+
+    def test_staleness_source_time_slices(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("continual_staleness_current_seconds", "h")
+        src = staleness_source(reg, "continual_staleness_current_seconds",
+                               threshold_s=10.0)
+        g.set(3.0)
+        assert src() == (1.0, 1.0)
+        g.set(30.0)
+        assert src() == (1.0, 2.0)
+        g.set(1.0)
+        assert src() == (2.0, 3.0)
+
+    def test_availability_source_sheds_count_against_budget(self):
+        reg = MetricsRegistry()
+        reg.counter("req_total", "h").inc(90)
+        reg.counter("err_total", "h").inc(5)
+        reg.counter("shed_total", "h", reason="quota").inc(10)
+        good, total = availability_source(
+            reg, "req_total", error_families=("err_total",),
+            shed_families=("shed_total",))()
+        assert (good, total) == (85.0, 100.0)
+
+    def test_availability_successes_mode_sees_total_outage(self):
+        # the fleet's requests family ticks on SUCCESS only: during a
+        # 100% outage (errors, zero successes) the denominator must
+        # come from the error counters or the alert is blind
+        reg = MetricsRegistry()
+        reg.counter("ok_total", "h", tenant="gold").inc(0)
+        reg.counter("err_total", "h", tenant="gold").inc(20)
+        good, total = availability_source(
+            reg, "ok_total", error_families=("err_total",),
+            requests_count="successes", tenant="gold")()
+        assert (good, total) == (0.0, 20.0)
+        with pytest.raises(ValueError):
+            availability_source(reg, "ok_total",
+                                requests_count="nonsense")
+
+    def test_latency_source_aggregates_labeled_series(self):
+        # a per-tenant-labeled family with NO tenant scope must sum
+        # every series, not exact-match an empty label key to nothing
+        reg = MetricsRegistry()
+        reg.histogram("fleet_lat", "h", tenant="a").observe(0.01)
+        reg.histogram("fleet_lat", "h", tenant="a").observe(0.5)
+        reg.histogram("fleet_lat", "h", tenant="b").observe(0.02)
+        good, total = latency_source(reg, "fleet_lat", 0.05)()
+        assert (good, total) == (2.0, 3.0)
+        good_a, total_a = latency_source(reg, "fleet_lat", 0.05,
+                                         tenant="a")()
+        assert (good_a, total_a) == (1.0, 2.0)
+
+    def test_staleness_missing_gauge_is_no_data_not_fresh(self):
+        reg = MetricsRegistry()
+        src = staleness_source(reg, "continual_staleness_current_seconds",
+                               threshold_s=10.0)
+        # no gauge published: counters stay frozen -> window rates None
+        assert src() == (0.0, 0.0)
+        assert src() == (0.0, 0.0)
+        reg.gauge("continual_staleness_current_seconds", "h").set(3.0)
+        assert src() == (1.0, 1.0)
+
+    def test_slo_validation(self):
+        with pytest.raises(ValueError):
+            SLO(name="x", kind="nonsense")
+        with pytest.raises(ValueError):
+            SLO(name="x", objective=1.5)
+        with pytest.raises(ValueError):
+            SLO(name="x", kind="latency")  # needs threshold_s
+        with pytest.raises(ValueError):
+            SLOParams(time_scale=0)
+
+
+# --------------------------------------------------------------------- #
+# multi-process Chrome traces (satellite)                               #
+# --------------------------------------------------------------------- #
+
+class TestMultiProcessChromeTrace:
+    def _spans(self, label):
+        with TRACER.span(f"run:{label}", category="run",
+                         new_trace=True) as root:
+            with TRACER.span("serving:batch"):
+                pass
+        return TRACER.trace_spans(root.trace_id)
+
+    def test_merged_distinct_pids_validate(self):
+        a = chrome_trace(self._spans("procA"), process_name="fleet",
+                         pid=100)
+        b = chrome_trace(self._spans("procB"), process_name="frontend",
+                         pid=200)
+        merged = merge_chrome_traces(a, b)
+        assert validate_chrome_trace(merged) == []
+        pids = {ev["pid"] for ev in merged["traceEvents"]}
+        assert pids == {100, 200}
+        names = [ev["args"]["name"] for ev in merged["traceEvents"]
+                 if ev["ph"] == "M" and ev["name"] == "process_name"]
+        assert sorted(names) == ["fleet", "frontend"]
+
+    def test_span_ids_scoped_per_pid(self):
+        # the same span id in two pids is legal; a parent reference
+        # must resolve within its OWN pid
+        ev = lambda pid, sid, parent: {  # noqa: E731
+            "ph": "X", "name": "s", "cat": "c", "ts": 10, "dur": 5,
+            "pid": pid, "tid": 1,
+            "args": {"span_id": sid, "parent_id": parent}}
+        meta = lambda pid: {"ph": "M", "name": "process_name",  # noqa: E731
+                            "pid": pid, "tid": 0, "args": {"name": "p"}}
+        good = {"traceEvents": [meta(1), meta(2), ev(1, 7, None),
+                                ev(2, 7, None), ev(2, 8, 7)]}
+        assert validate_chrome_trace(good) == []
+        # pid 2's child points at a span that only exists in pid 1
+        bad = {"traceEvents": [meta(1), meta(2), ev(1, 7, None),
+                               ev(2, 8, 7)]}
+        assert any("parent 7 not in trace" in p
+                   for p in validate_chrome_trace(bad))
+
+    def test_unnamed_pid_with_spans_flagged(self):
+        ev = {"ph": "X", "name": "s", "cat": "c", "ts": 10, "dur": 5,
+              "pid": 3, "tid": 1, "args": {"span_id": 1,
+                                           "parent_id": None}}
+        problems = validate_chrome_trace({"traceEvents": [ev]})
+        assert any("no process_name metadata" in p for p in problems)
+
+
+# --------------------------------------------------------------------- #
+# acceptance: end-to-end request tracing over HTTP                      #
+# --------------------------------------------------------------------- #
+
+class TestRequestTracingE2E:
+    CALLER_TID = "feed" * 8
+
+    def _score(self, port, headers=None, rows=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/score",
+            data=json.dumps({"rows": rows or [dict(ROW)]}).encode(),
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
+        return urllib.request.urlopen(req, timeout=30)
+
+    def test_caller_traceparent_single_trace_and_exemplar(self, svc):
+        server, _ = serve(svc, block=False)
+        try:
+            caller = format_traceparent(self.CALLER_TID, 0xBEEF,
+                                        sampled=True)
+            resp = self._score(server.port,
+                               headers={"traceparent": caller})
+            body = json.loads(resp.read())
+
+            # 1. the SAME trace id echoed in the response headers
+            echo = resp.headers.get("traceparent")
+            assert echo is not None
+            etid, espan, esampled = parse_traceparent(echo)
+            assert etid == self.CALLER_TID and esampled
+            assert resp.headers.get("X-Trace-Id") == self.CALLER_TID
+            assert body["trace_id"] == self.CALLER_TID
+
+            # 2. ONE trace containing every phase, parented under the
+            # request root
+            spans = TRACER.trace_spans(self.CALLER_TID)
+            by_name = {}
+            for sp in spans:
+                by_name.setdefault(sp.name, sp)
+            root = by_name["serving:request"]
+            assert root.parent_id is None
+            assert root.attributes["parent_traceparent"] \
+                == "000000000000beef"
+            # the echoed span id IS the request root
+            assert int(espan, 16) == root.span_id
+            for phase in ("serving:queue_wait", "serving:assemble",
+                          "serving:pad", "serving:device_dispatch",
+                          "serving:demux"):
+                assert phase in by_name, f"missing {phase}"
+            by_id = {sp.span_id: sp for sp in spans}
+            for sp in spans:
+                if sp is root:
+                    continue
+                anc = sp
+                while anc.parent_id is not None:
+                    assert anc.parent_id in by_id, \
+                        f"{sp.name}: broken parent chain"
+                    anc = by_id[anc.parent_id]
+                assert anc is root, f"{sp.name} not under the request"
+            # assembly has a NONZERO parse child, parented under it
+            parse = by_name["serving:parse"]
+            assert parse.parent_id == by_name["serving:assemble"].span_id
+            assert parse.duration_s > 0
+
+            # 3. the trace id rides as an exemplar on the latency bucket
+            # this request landed in
+            hist = svc.registry.find("serving_request_latency_seconds")
+            ex = [e for e in hist.exemplars()
+                  if e[1] == self.CALLER_TID]
+            assert ex, "trace id not attached as a latency exemplar"
+            bound, _, value, _ = ex[0]
+            assert value <= bound
+            # and on the phase family
+            ph = svc.registry.find("serving_phase_seconds",
+                                   phase="parse")
+            assert any(e[1] == self.CALLER_TID for e in ph.exemplars())
+
+            # the exposition renders the exemplar OpenMetrics-style
+            txt = svc.registry.to_prometheus()
+            assert f'# {{trace_id="{self.CALLER_TID}"}}' in txt
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_no_header_mints_fresh_trace(self, svc):
+        server, _ = serve(svc, block=False)
+        try:
+            resp = self._score(server.port)
+            tid = resp.headers.get("X-Trace-Id")
+            assert tid and len(tid) == 32
+            assert parse_traceparent(resp.headers["traceparent"]) \
+                is not None
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_phase_histograms_have_fixed_label_set(self, svc):
+        j = svc.registry.to_json()
+        phases = {e["labels"]["phase"]
+                  for e in j["serving_phase_seconds"]["series"]}
+        # pre-bound fixed set (hot path never takes the registry lock);
+        # request-derived values never become labels
+        assert phases == {"parse", "queue_wait", "assemble", "pad",
+                          "device_dispatch", "demux", "admission"}
+
+    def test_error_trace_kept_with_error_span(self, svc):
+        before = svc.sampler.kept
+        with pytest.raises(Exception):
+            svc.score([{"bogus_column": object()}])
+        assert svc.sampler.kept == before + 1
+        errs = [sp for sp in TRACER.spans()
+                if sp.name == "serving:request" and sp.error]
+        assert errs
+
+    def test_error_response_echoes_trace_id_over_http(self, svc):
+        # a failed request must be as correlatable as a slow one: the
+        # error response carries the KEPT error trace's id in headers
+        # and body
+        server, _ = serve(svc, block=False)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._score(server.port,
+                            rows=[{"x0": "not-a-number"}])
+            err = ei.value
+            tid = err.headers.get("X-Trace-Id")
+            assert tid and len(tid) == 32
+            assert parse_traceparent(
+                err.headers.get("traceparent")) is not None
+            body = json.loads(err.read())
+            assert body["trace_id"] == tid
+            # and that trace actually exists in the ring
+            assert any(sp.trace_id == tid and sp.error
+                       for sp in TRACER.spans()
+                       if sp.name == "serving:request")
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_tracing_disabled_service_still_scores(self, model_dir):
+        service = ScoringService.from_path(
+            model_dir, config=ServingConfig(
+                max_batch=4, tracing={"enabled": False}))
+        service.start()
+        try:
+            res = service.score([dict(ROW)])
+            assert res.trace_id is None and res.traceparent is None
+            assert service.sampler is None
+        finally:
+            service.stop()
+
+
+# --------------------------------------------------------------------- #
+# in-process trace propagation (continual-cycle style)                  #
+# --------------------------------------------------------------------- #
+
+class TestInProcessPropagation:
+    def test_parent_span_context_joins_trace_and_forces_keep(self, svc):
+        with TRACER.span("continual:promote", category="continual",
+                         new_trace=True) as parent:
+            ctx = TraceContext.from_span(parent)
+            res = svc.score([dict(ROW)], trace=ctx)
+        assert res.trace_id == parent.trace_id
+        spans = TRACER.trace_spans(parent.trace_id)
+        root = next(sp for sp in spans if sp.name == "serving:request")
+        assert root.parent_id == parent.span_id
+        assert root.attributes.get("sampled") == "forced"
+
+    def test_live_holdout_rides_cycle_trace(self, svc):
+        # the continual loop's live gate: requests parent under the
+        # open cycle span via current_span()
+        from transmogrifai_tpu.continual.loop import live_holdout_metric
+        y = np.ones(2)
+        with TRACER.span("continual:cycle", category="continual",
+                         new_trace=True) as cycle:
+            live_holdout_metric(svc, [dict(ROW), dict(ROW)], y,
+                                classification=True)
+        spans = TRACER.trace_spans(cycle.trace_id)
+        reqs = [sp for sp in spans if sp.name == "serving:request"]
+        assert reqs and all(sp.parent_id == cycle.span_id
+                            for sp in reqs)
+
+
+# --------------------------------------------------------------------- #
+# SLO + flight over a live service                                      #
+# --------------------------------------------------------------------- #
+
+class TestServiceSLOWiring:
+    def test_slo_endpoint_and_engine(self, model_dir, tmp_path):
+        service = ScoringService.from_path(
+            model_dir, config=ServingConfig(
+                max_batch=4,
+                slo={"slos": [{"name": "avail",
+                               "kind": "availability",
+                               "objective": 0.99}],
+                     "windows": [[2.0, 0.5, 2.0, "page"]],
+                     "eval_period_s": 0.05}))
+        service.start()
+        try:
+            assert service.slo_engine is not None
+            for _ in range(4):
+                service.score([dict(ROW)])
+            status = service.slo_engine.evaluate()
+            assert "avail" in status["slos"]
+            server, _ = serve(service, block=False)
+            try:
+                got = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/slo",
+                    timeout=10).read())
+                assert got["slos"]["avail"]["objective"] == 0.99
+            finally:
+                server.shutdown()
+                server.server_close()
+        finally:
+            service.stop()
+
+    def test_slo_endpoint_404_when_unconfigured(self, svc):
+        server, _ = serve(svc, block=False)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/slo", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_debug_dump_endpoint(self, svc, tmp_path):
+        rec = flight_mod.get_recorder()
+        old_dir = rec.dump_dir
+        rec.configure(dump_dir=str(tmp_path))
+        server, _ = serve(svc, block=False)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/debug/dump", data=b"{}")
+            out = json.loads(urllib.request.urlopen(
+                req, timeout=30).read())
+            assert out["status"] == "dumped"
+            with open(f"{out['path']}/trace.json") as fh:
+                assert validate_chrome_trace(json.load(fh)) == []
+        finally:
+            rec.configure(dump_dir=old_dir)
+            server.shutdown()
+            server.server_close()

@@ -1066,3 +1066,66 @@ class PercentileCalibratorModel(Transformer):
         bad, path="tests/test_x.py"))
     assert any(f.code == "L016" for f in L.lint_source(
         bad, path="transmogrifai_tpu/ops/other.py"))
+
+
+def test_lint_l017_dynamic_event_names():
+    """L017: span/event names built with f-strings or `+` concatenation
+    — unbounded name cardinality breaks the flight-recorder ring,
+    goodput by-name rollups, and Prometheus series hygiene."""
+    src = '''
+from transmogrifai_tpu.obs.export import record_event
+from transmogrifai_tpu.obs.trace import TRACER, add_event
+
+record_event(f"cache_hit_{key}")                      # flagged
+record_event("cache_hit", key=key)                    # clean: literal
+add_event("shed_" + tenant)                           # flagged
+with TRACER.span(f"serve:{path}"):                    # flagged
+    pass
+with TRACER.span("serving:batch", bucket=b):          # clean
+    pass
+sp.event(f"req_{request_id}_done")                    # flagged
+'''
+    findings = [f for f in L.lint_source(
+        src, path="transmogrifai_tpu/serving/newmod.py")
+        if f.code == "L017"]
+    assert len(findings) == 4
+    assert all("cardinality" in f.message for f in findings)
+
+
+def test_lint_l017_allowlisted_prefixes():
+    """Bounded-by-construction families (worker lanes, run types,
+    retry/ingest site labels, profile phases) keep their dynamic
+    names."""
+    src = '''
+from transmogrifai_tpu.obs.trace import TRACER
+
+with TRACER.span(f"retry:{label}"):                    # allowlisted
+    pass
+with TRACER.span(f"sweep:worker:{k}"):                 # allowlisted
+    pass
+with TRACER.span(f"run:{run_type}"):                   # allowlisted
+    pass
+with TRACER.span(f"stage:fit:{op_name}"):              # allowlisted
+    pass
+'''
+    assert not any(f.code == "L017" for f in L.lint_source(
+        src, path="transmogrifai_tpu/workflow/newmod.py"))
+    # a short literal head that merely STARTS an allowlist entry must
+    # NOT be exempt (f"r{x}" vs "retry:")
+    sneaky = 'record_event(f"r{request_id}")\n'
+    assert any(f.code == "L017" for f in L.lint_source(
+        sneaky, path="transmogrifai_tpu/obs/newmod.py"))
+
+
+def test_lint_l017_exempt_in_tests_and_repo_clean():
+    src = 'record_event(f"x_{i}")\n'
+    assert not any(f.code == "L017" for f in L.lint_source(
+        src, path="tests/test_x.py"))
+    assert any(f.code == "L017" for f in L.lint_source(
+        src, path="transmogrifai_tpu/obs/newmod.py"))
+    # the whole package lints clean under L017 (repo gate)
+    import os
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "transmogrifai_tpu")
+    findings = [f for f in L.lint_paths([pkg]) if f.code == "L017"]
+    assert findings == []

@@ -23,7 +23,7 @@ from transmogrifai_tpu.models import OpLogisticRegression
 from transmogrifai_tpu.serving import (
     MetricsRegistry, MicroBatcher, Request, ScoreError, ScoringService,
     ServingConfig, bucket_for, bucket_ladder)
-from transmogrifai_tpu.serving.metrics import Histogram
+from transmogrifai_tpu.obs.metrics import Histogram
 from transmogrifai_tpu.workflow import Workflow
 
 

@@ -146,6 +146,19 @@ silent slowness or nondeterminism once XLA is in the loop:
   ``device_constants()``/``device_apply_with`` — the known-small
   scalar/index sites are allowlisted in ``_L016_ALLOW``.
 
+- ``L017 dynamic-event-name``: a span/event NAME built with an f-string
+  or ``+`` concatenation at a tracing call site (``record_event`` /
+  ``emit_event`` / ``add_event`` / ``.span(...)`` / ``.event(...)`` /
+  ``.child(...)``). Event and span names are CARDINALITY keys: the
+  flight-recorder ring, the goodput rollup's by-name buckets, and any
+  Prometheus series derived from them all assume a small closed name
+  set — a name interpolating a request id, tenant, or path mints
+  unbounded distinct names and quietly breaks all three. Put the
+  variable part in an ATTRIBUTE (``record_event("cache_hit",
+  key=key)``), not the name. Bounded-by-construction dynamic names
+  (worker lanes, run types, site labels) are allowlisted by their
+  literal prefix in ``_L017_ALLOW_PREFIXES``.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1244,6 +1257,101 @@ def _check_closure_constants(tree: ast.AST, path: str) -> List[LintFinding]:
     return findings
 
 
+# -- L017: unbounded span/event name cardinality ------------------------------ #
+
+# bare/dotted function names whose FIRST argument is an event name
+_L017_FUNCS = ("record_event", "emit_event", "add_event")
+# method names whose first argument is a span/event name (Tracer.span,
+# Span.event, RequestTrace.child/child_at, RunProfile.phase)
+_L017_METHODS = ("span", "event", "child", "child_at")
+# bounded-by-construction dynamic name families: the interpolated part
+# is a worker index, run type, retry/ingest site label, or profile
+# phase — closed sets fixed at build time, not wire-derived values.
+# Everything NEW must either use a literal name (variability goes in
+# attributes) or extend this list with a justified prefix.
+_L017_ALLOW_PREFIXES = (
+    "retry:", "sweep:worker:", "sweep:family:", "ingest:", "run:",
+    "phase:", "stage:",
+)
+
+
+def _l017_dynamic_name(arg: ast.AST) -> bool:
+    """True when `arg` builds a string dynamically: an f-string with
+    interpolation, or a ``+`` concatenation involving a string
+    literal."""
+    if isinstance(arg, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in arg.values)
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+        sides = (arg.left, arg.right)
+        return any(isinstance(s, ast.Constant) and isinstance(s.value, str)
+                   for s in sides) or any(
+            _l017_dynamic_name(s) for s in sides)
+    return False
+
+
+def _l017_literal_prefix(arg: ast.AST) -> str:
+    """The leading literal text of a dynamic name (the f-string's first
+    constant chunk / the concatenation's left literal), for the
+    allowlist check."""
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+        if isinstance(arg.left, ast.Constant) \
+                and isinstance(arg.left.value, str):
+            return arg.left.value
+        return _l017_literal_prefix(arg.left)
+    return ""
+
+
+def _check_event_name_cardinality(tree: ast.AST,
+                                  path: str) -> List[LintFinding]:
+    """Flag span/event names built with f-strings or ``+`` concatenation
+    outside the allowlisted bounded families — unbounded event-name
+    cardinality breaks the flight-recorder ring's usefulness, the
+    goodput by-name rollups, and Prometheus label hygiene."""
+    parts = os.path.normpath(path).split(os.sep)
+    if any(d in parts for d in ("testkit", "tests")):
+        return []
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        dotted = _dotted(node.func)
+        if dotted is None:
+            continue
+        leaf = dotted.split(".")[-1]
+        is_attr = isinstance(node.func, ast.Attribute)
+        if leaf in _L017_FUNCS:
+            pass
+        elif is_attr and leaf in _L017_METHODS:
+            pass
+        else:
+            continue
+        name_arg = node.args[0]
+        if not _l017_dynamic_name(name_arg):
+            continue
+        # the literal head must fully CONTAIN an allowlist entry
+        # (prefix.startswith(entry)); the reverse direction would let
+        # any 1-char head that happens to start an entry (f"r{x}" vs
+        # "retry:") smuggle unbounded names past the check
+        prefix = _l017_literal_prefix(name_arg)
+        if prefix and any(prefix.startswith(a)
+                          for a in _L017_ALLOW_PREFIXES):
+            continue
+        findings.append(LintFinding(
+            path, getattr(node, "lineno", 0), "L017",
+            f"`{leaf}(...)` name built dynamically (f-string/`+` "
+            f"concatenation) — span/event names key the flight-recorder "
+            f"ring, goodput rollups, and Prometheus series, so an "
+            f"interpolated name mints unbounded cardinality; use a "
+            f"literal name and carry the variable part as an attribute "
+            f"(or add a justified bounded prefix to "
+            f"_L017_ALLOW_PREFIXES)"))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1263,6 +1371,7 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_service_construction(tree, path))
     linter.findings.extend(_check_unnamed_threads(tree, path))
     linter.findings.extend(_check_closure_constants(tree, path))
+    linter.findings.extend(_check_event_name_cardinality(tree, path))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 

@@ -637,6 +637,30 @@ def cmd_serve(args) -> int:
                          "watchdog_stall_s": args.watchdog_stall_s}
     if args.quantize:
         sp.quantize = None if args.quantize == "off" else args.quantize
+    if args.tracing:
+        sp.tracing = {**(sp.tracing or {}),
+                      "enabled": args.tracing == "on"}
+    if args.slo_config:
+        import json as _json
+        with open(args.slo_config) as fh:
+            sp.slo = _json.load(fh)
+    if args.flight_dir:
+        sp.flight = {**(sp.flight or {}), "dir": args.flight_dir}
+
+    # SIGTERM black box: an orchestrator tearing this replica down gets
+    # a flight dump of its last seconds before the default handler runs
+    import signal
+
+    def _sigterm_dump(signum, frame):  # pragma: no cover - signal path
+        from transmogrifai_tpu.obs import flight
+        flight.request_dump("sigterm", force=True)
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.raise_signal(signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_dump)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
 
     fleet_cfg = None
     if args.fleet_config:
@@ -854,6 +878,21 @@ def main(argv: Optional[list] = None) -> int:
              "narrow wire and fitted tables compute in narrowed dtypes "
              "inside the fused bucket programs (per-feature tolerance "
              "(hi-lo)/(2*(2^bits-1)); default off = exact f32)")
+    serve_p.add_argument(
+        "--tracing", choices=["on", "off"],
+        help="request-scoped tracing + tail sampling (default on): "
+             "W3C traceparent honored/echoed, per-request phase spans, "
+             "serving_phase_seconds histograms with trace-id exemplars")
+    serve_p.add_argument(
+        "--slo-config",
+        help="SLOParams JSON path (obs/slo.py): declarative per-tenant "
+             "availability/latency/staleness objectives with "
+             "multi-window burn-rate alerting on /slo + slo_* gauges")
+    serve_p.add_argument(
+        "--flight-dir",
+        help="crash-flight-recorder dump directory (default "
+             "TRANSMOGRIFAI_FLIGHT_DIR or "
+             "~/.cache/transmogrifai_tpu/flight)")
     serve_p.set_defaults(fn=cmd_serve)
 
     lint_p = sub.add_parser(

@@ -98,12 +98,20 @@ def live_holdout_metric(service, rows: List[Dict[str, Any]],
     Requests are cut to the service's own bucket ladder, so the eval
     coexists with live traffic instead of monopolizing the top bucket.
     The `continual.holdout_eval` fault site fires first, so chaos tests
-    can force this eval to fail deterministically."""
+    can force this eval to fail deterministically.
+
+    The CYCLE's trace context rides on every eval request: each one is
+    a `serving:request` span parented under the open continual span
+    (promote/cycle), force-kept past the tail sampler — so "why did
+    the gate decide that" reads as one trace: the cycle, its eval
+    requests, and each request's parse/queue/dispatch phases."""
     fault_point(SITE_HOLDOUT_EVAL)
+    from transmogrifai_tpu.obs.trace import TraceContext, current_span
+    ctx = TraceContext.from_span(current_span())
     step = int(service.ladder[-1])
     preds: List[np.ndarray] = []
     for i in range(0, len(rows), step):
-        result = service.score(rows[i:i + step])
+        result = service.score(rows[i:i + step], trace=ctx)
         tree = next((v for v in result.outputs.values()
                      if isinstance(v, dict) and "prediction" in v), None)
         if tree is None:
@@ -379,6 +387,7 @@ class ContinualLoop:
             self.monitor.observe(X, y)
         if self._pending_since is None:
             self._pending_since = time.perf_counter()
+        self.note_staleness()
         self.registry.counter(
             "continual_rows_appended_total",
             "records appended to the live store").inc(len(X))
@@ -689,6 +698,25 @@ class ContinualLoop:
                      staleness_s=(round(staleness, 6)
                                   if staleness is not None else None))
         self._cycle += 1
+        self.note_staleness()
+
+    def staleness_s(self) -> float:
+        """CURRENT freshness debt: seconds since the oldest append not
+        yet absorbed by a promoted model (0 when fully fresh) — what
+        the staleness SLO judges each tick."""
+        if self._pending_since is None:
+            return 0.0
+        return max(0.0, time.perf_counter() - self._pending_since)
+
+    def note_staleness(self) -> None:
+        """Publish the live freshness gauge the SLO engine's staleness
+        source reads (`continual_staleness_current_seconds` on this
+        loop's registry — the process registry by default, so serving
+        `/metrics` and a fleet SLO both see it)."""
+        self.registry.gauge(
+            "continual_staleness_current_seconds",
+            "seconds since the oldest store append not yet served by a "
+            "promoted model (0 = fully fresh)").set(self.staleness_s())
 
     # -- supervisor thread -------------------------------------------------- #
 
@@ -747,6 +775,7 @@ class ContinualLoop:
             self._wake.clear()
             if not self._running:
                 return
+            self.note_staleness()  # freshness gauge ticks every poll
             try:
                 self.run_cycle()
             except Exception:

@@ -27,15 +27,17 @@ interval, modulo a clock-read epsilon).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from typing import Any, Dict, IO, Iterable, List, Optional, Tuple
 
 from transmogrifai_tpu.obs.trace import Span, add_event
 
-__all__ = ["chrome_trace", "write_chrome_trace", "validate_chrome_trace",
-           "EventLog", "install_event_log", "uninstall_event_log",
-           "emit_event", "active_event_log", "record_event"]
+__all__ = ["chrome_trace", "merge_chrome_traces", "write_chrome_trace",
+           "validate_chrome_trace", "EventLog", "install_event_log",
+           "uninstall_event_log", "emit_event", "active_event_log",
+           "record_event"]
 
 
 # -- Chrome trace / Perfetto -------------------------------------------------- #
@@ -51,17 +53,24 @@ def _args_jsonable(attrs: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def chrome_trace(spans: Iterable[Span],
-                 process_name: str = "transmogrifai_tpu") -> Dict[str, Any]:
+                 process_name: str = "transmogrifai_tpu",
+                 pid: int = 0) -> Dict[str, Any]:
     """Render spans as a Chrome Trace Event JSON object.
 
     Timestamps are the spans' perf-counter offsets from the process
     trace epoch, in integer microseconds — monotonic and non-negative
     regardless of wall-clock steps. Unfinished spans export with "now"
     as their end so a live process can dump a coherent trace.
+
+    `pid` labels the Perfetto process row; multi-process payloads (a
+    fleet flight dump merged with another process's trace) concatenate
+    each source's ``traceEvents`` under distinct pids plus their own
+    ``process_name`` metadata events — `merge_chrome_traces` does the
+    concatenation, `validate_chrome_trace` accepts the result.
     """
     spans = list(spans)
     events: List[Dict[str, Any]] = [{
-        "ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
         "args": {"name": process_name},
     }]
     seen_tids = set()
@@ -69,7 +78,7 @@ def chrome_trace(spans: Iterable[Span],
         if sp.thread_id not in seen_tids:
             seen_tids.add(sp.thread_id)
             events.append({
-                "ph": "M", "name": "thread_name", "pid": 0,
+                "ph": "M", "name": "thread_name", "pid": pid,
                 "tid": sp.thread_id, "args": {"name": sp.thread_name},
             })
         args = {
@@ -83,15 +92,27 @@ def chrome_trace(spans: Iterable[Span],
             "ph": "X", "name": sp.name, "cat": sp.category,
             "ts": int(sp.start_s * 1e6),
             "dur": max(1, int(sp.duration_s * 1e6)),
-            "pid": 0, "tid": sp.thread_id, "args": args,
+            "pid": pid, "tid": sp.thread_id, "args": args,
         })
         for name, t_s, attrs in sp.events:
             events.append({
                 "ph": "i", "name": name, "cat": sp.category,
-                "ts": int(t_s * 1e6), "pid": 0, "tid": sp.thread_id,
+                "ts": int(t_s * 1e6), "pid": pid, "tid": sp.thread_id,
                 "s": "t",
                 "args": {"span_id": sp.span_id, **_args_jsonable(attrs)},
             })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def merge_chrome_traces(*traces: Dict[str, Any]) -> Dict[str, Any]:
+    """Concatenate chrome_trace payloads from DISTINCT pids into one
+    multi-process trace (the fleet flight dump merges the serving
+    process's ring with any sidecar payloads this way). Events pass
+    through untouched — each source already carries its own pid and
+    process_name metadata."""
+    events: List[Dict[str, Any]] = []
+    for tr in traces:
+        events.extend(tr.get("traceEvents") or [])
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -105,20 +126,32 @@ def write_chrome_trace(path: str, spans: Iterable[Span],
 def validate_chrome_trace(obj: Dict[str, Any]) -> List[str]:
     """Structural validation of a chrome_trace() payload; returns a list
     of problems (empty = valid). Checked: traceEvents shape, required
-    keys per phase, non-negative ts / positive dur, and span parenting
-    (parents exist; a child starts inside its parent's interval)."""
+    keys per phase, non-negative ts / positive dur, span parenting
+    (parents exist; a child starts inside its parent's interval), and —
+    for multi-process payloads — that every pid carrying spans declares
+    a ``process_name`` metadata event.
+
+    Span ids are scoped PER PID: a merged multi-process trace (a fleet
+    flight dump beside another process's run trace) may legitimately
+    reuse span ids across pids, and a child's parent must live in its
+    own process row."""
     problems: List[str] = []
     events = obj.get("traceEvents")
     if not isinstance(events, list) or not events:
         return ["traceEvents missing or empty"]
-    spans: Dict[int, Tuple[int, int]] = {}  # span_id -> (ts, ts+dur)
-    parents: List[Tuple[int, Optional[int]]] = []
+    # (pid, span_id) -> (ts, ts+dur); parent lookups stay inside the pid
+    spans: Dict[Tuple[Any, int], Tuple[int, int]] = {}
+    parents: List[Tuple[Any, int, Optional[int]]] = []
+    named_pids = set()
+    span_pids = set()
     for i, ev in enumerate(events):
         if not isinstance(ev, dict) or "ph" not in ev:
             problems.append(f"event {i}: not an object with 'ph'")
             continue
         ph = ev["ph"]
         if ph == "M":
+            if ev.get("name") == "process_name":
+                named_pids.add(ev.get("pid"))
             continue
         for key in ("name", "ts", "pid", "tid"):
             if key not in ev:
@@ -134,21 +167,27 @@ def validate_chrome_trace(obj: Dict[str, Any]) -> List[str]:
                 continue
             sid = ev.get("args", {}).get("span_id")
             if isinstance(sid, int):
-                spans[sid] = (ts, ts + dur)
-                parents.append((sid, ev["args"].get("parent_id")))
-    for sid, pid in parents:
-        if pid is None:
+                pid = ev.get("pid")
+                span_pids.add(pid)
+                spans[(pid, sid)] = (ts, ts + dur)
+                parents.append((pid, sid, ev["args"].get("parent_id")))
+    for pid in sorted(span_pids - named_pids, key=repr):
+        problems.append(
+            f"pid {pid}: spans present but no process_name metadata")
+    for pid, sid, parent_id in parents:
+        if parent_id is None:
             continue
-        if pid not in spans:
-            problems.append(f"span {sid}: parent {pid} not in trace")
+        if (pid, parent_id) not in spans:
+            problems.append(
+                f"span {sid} (pid {pid}): parent {parent_id} not in trace")
             continue
-        p0, p1 = spans[pid]
-        c0, _ = spans[sid]
+        p0, p1 = spans[(pid, parent_id)]
+        c0, _ = spans[(pid, sid)]
         # 1ms grace: parent/child read the clock microseconds apart
         if c0 + 1000 < p0 or c0 > p1 + 1000:
             problems.append(
-                f"span {sid}: starts at {c0}us outside parent {pid} "
-                f"interval [{p0}, {p1}]us")
+                f"span {sid} (pid {pid}): starts at {c0}us outside "
+                f"parent {parent_id} interval [{p0}, {p1}]us")
     return problems
 
 
@@ -224,6 +263,15 @@ def record_event(name: str, **fields: Any) -> None:
     on the current trace span and a structured JSONL record — with the
     same name and fields, so the Perfetto timeline and the event log
     can never silently diverge. The single call site for every
-    retry/fault/oom-redo/journal-resume emission."""
+    retry/fault/oom-redo/journal-resume emission. Events also land in
+    the crash flight recorder's ring (obs/flight.py) when one is
+    enabled, so a post-mortem dump carries the last N events even when
+    no span/log was open."""
     add_event(name, **fields)
     emit_event(name, **fields)
+    try:
+        from transmogrifai_tpu.obs import flight
+        flight.note_event(name, fields)
+    except Exception:  # the recorder must never break an emitter
+        logging.getLogger(__name__).debug(
+            "flight note_event failed", exc_info=True)

@@ -106,6 +106,12 @@ class GoodputReport:
     # availability story of a run that survived injected (or real)
     # serving faults. Empty when nothing tripped.
     resilience: Dict[str, Any] = field(default_factory=dict)
+    # SLO accounting (obs/slo.py): rolled from ``slo_alert`` events —
+    # alerts fired/resolved per SLO name with total measured
+    # time-in-alert seconds. A run whose chaos storm fired and cleared
+    # an availability alert reports it here. Empty when no SLO engine
+    # ran (or nothing fired).
+    slo: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def badput_s(self) -> float:
@@ -142,6 +148,8 @@ class GoodputReport:
             out["scoring"] = dict(sorted(self.scoring.items()))
         if self.resilience:
             out["resilience"] = dict(sorted(self.resilience.items()))
+        if self.slo:
+            out["slo"] = dict(sorted(self.slo.items()))
         return out
 
     def pretty(self) -> str:
@@ -176,6 +184,7 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     fleet: Dict[str, Any] = {}
     resilience: Dict[str, Any] = {}
     scoring: Dict[str, Any] = {}
+    slo: Dict[str, Any] = {}
     mttrs: list = []
     # mesh rollup accumulators: several schedules (one per selector fit)
     # can land in one trace — utilization averages weighted by each
@@ -285,6 +294,22 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
             elif name == "watchdog_restart":
                 resilience["watchdog_restarts"] = \
                     resilience.get("watchdog_restarts", 0) + 1
+            elif name == "slo_alert":
+                sname = str(attrs.get("slo") or "unknown")
+                per = slo.setdefault("by_slo", {}).setdefault(
+                    sname, {"fired": 0, "resolved": 0,
+                            "alert_s": 0.0})
+                state = str(attrs.get("state") or "")
+                if state == "firing":
+                    slo["alerts_fired"] = slo.get("alerts_fired", 0) + 1
+                    per["fired"] += 1
+                elif state == "resolved":
+                    slo["alerts_resolved"] = \
+                        slo.get("alerts_resolved", 0) + 1
+                    per["resolved"] += 1
+                    per["alert_s"] = round(
+                        per["alert_s"]
+                        + float(attrs.get("alert_s", 0.0) or 0.0), 6)
             elif name == "supervisor_restart":
                 continual["supervisor_restarts"] = \
                     continual.get("supervisor_restarts", 0) + 1
@@ -354,6 +379,8 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
             resilience["mean_mttr_s"] = round(sum(mttrs) / len(mttrs), 6)
             resilience["max_mttr_s"] = round(max(mttrs), 6)
         report.resilience = resilience
+    if slo:
+        report.slo = slo
     if mesh:
         mesh["utilization_frac"] = round(
             mesh_busy / mesh_wall, 4) if mesh_wall > 0 else 0.0

@@ -25,6 +25,14 @@ Histograms use fixed log-spaced buckets so p50/p95/p99 estimates are
 O(buckets) with bounded memory — no reservoir, safe under sustained
 traffic. Quantiles interpolate linearly inside the winning bucket.
 
+Histograms also carry TRACE-ID EXEMPLARS (one per bucket,
+last-write-wins): ``observe(v, exemplar=trace_id)`` pins the id of a
+concrete kept trace to the bucket the observation landed in, and the
+Prometheus exposition renders it OpenMetrics-style
+(``... 42 # {trace_id="..."} 0.0041 1699999999.5``) so a p99 spike on
+a dashboard links straight to the request trace that lives in that
+bucket. The JSON export mirrors them under ``exemplars``.
+
 All mutation is lock-protected: the batcher thread, HTTP worker threads,
 ingest workers, and scrapers hit the same registry concurrently.
 """
@@ -32,6 +40,7 @@ ingest workers, and scrapers hit the same registry concurrently.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # default latency ladder (seconds): 100 us .. 60 s, roughly 2-2.5x steps
@@ -124,8 +133,11 @@ class Histogram:
         self._count = 0
         self._min: Optional[float] = None
         self._max: Optional[float] = None
+        # bucket index -> (exemplar_id, value, epoch_ts); last-write-wins
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         v = float(value)
         i = 0
         for i, b in enumerate(self.bounds):
@@ -139,6 +151,8 @@ class Histogram:
             self._count += 1
             self._min = v if self._min is None else min(self._min, v)
             self._max = v if self._max is None else max(self._max, v)
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), v, time.time())
 
     @property
     def count(self) -> int:
@@ -201,6 +215,17 @@ class Histogram:
         out.append((float("inf"), cum + counts[-1]))
         return out
 
+    def exemplars(self) -> List[Tuple[float, str, float, float]]:
+        """(bucket_upper_bound, exemplar_id, value, epoch_ts) for every
+        bucket holding one; the +inf bucket reports float('inf')."""
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        out: List[Tuple[float, str, float, float]] = []
+        for i, (eid, v, ts) in items:
+            bound = self.bounds[i] if i < len(self.bounds) else float("inf")
+            out.append((bound, eid, v, ts))
+        return out
+
 
 class MetricsRegistry:
     """Named, labeled metric families with dual JSON/Prometheus export."""
@@ -240,6 +265,51 @@ class MetricsRegistry:
         return self._get(name, "histogram", help, labels,
                          lambda: Histogram(bounds))
 
+    # -- read-side lookups (SLO engine, tests) ------------------------------ #
+
+    def find(self, name: str, **labels: Any):
+        """The live metric object for (name, labels), or None — a READ
+        that never mints a series (the SLO engine polls families that
+        may not exist yet)."""
+        key = _label_key({str(k): v for k, v in labels.items()})
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return None
+            return fam["series"].get(key)
+
+    def find_all(self, name: str, **label_filter: Any) -> List[Any]:
+        """Every live metric of a family whose labels match each (k, v)
+        in `label_filter` (empty filter = all series) — how the SLO
+        latency source aggregates a per-tenant-labeled histogram family
+        without knowing the tenant set."""
+        want = {str(k): str(v) for k, v in label_filter.items()}
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return []
+            series = dict(fam["series"])
+        return [metric for key, metric in series.items()
+                if all(dict(key).get(k) == v for k, v in want.items())]
+
+    def sum_family(self, name: str, **label_filter: Any) -> float:
+        """Sum of a family's series values, optionally restricted to
+        series whose labels match every (k, v) in `label_filter` —
+        how the SLO engine reads 'total errors for tenant=gold' off
+        labeled counters without enumerating reasons/codes."""
+        want = {str(k): str(v) for k, v in label_filter.items()}
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return 0.0
+            series = dict(fam["series"])
+        total = 0.0
+        for key, metric in series.items():
+            labels = dict(key)
+            if all(labels.get(k) == v for k, v in want.items()):
+                total += metric.value if hasattr(metric, "value") else 0.0
+        return total
+
     # -- export ----------------------------------------------------------- #
 
     def to_json(self) -> Dict[str, Any]:
@@ -254,6 +324,13 @@ class MetricsRegistry:
                 if mtype == "histogram":
                     entry: Dict[str, Any] = {"labels": labels,
                                              **metric.summary()}
+                    ex = metric.exemplars()
+                    if ex:
+                        entry["exemplars"] = [
+                            {"le": ("+Inf" if b == float("inf") else b),
+                             "trace_id": eid, "value": v,
+                             "ts": round(ts, 3)}
+                            for b, eid, v, ts in ex]
                 else:
                     entry = {"labels": labels, "value": metric.value}
                 entries.append(entry)
@@ -271,12 +348,22 @@ class MetricsRegistry:
             lines.append(f"# TYPE {name} {mtype}")
             for key, metric in sorted(series.items()):
                 if mtype == "histogram":
+                    # per-bucket trace-id exemplars, OpenMetrics syntax
+                    # (` # {trace_id="..."} value ts` after the bucket
+                    # sample) — we control both ends of this scrape
+                    ex = {b: (eid, v, ts)
+                          for b, eid, v, ts in metric.exemplars()}
                     for bound, cum in metric.bucket_counts():
                         le = "+Inf" if bound == float("inf") else repr(bound)
                         le_label = 'le="%s"' % le
-                        lines.append(
-                            f"{name}_bucket"
-                            f"{_fmt_labels(key, le_label)} {cum}")
+                        line = (f"{name}_bucket"
+                                f"{_fmt_labels(key, le_label)} {cum}")
+                        if bound in ex:
+                            eid, v, ts = ex[bound]
+                            line += (f' # {{trace_id='
+                                     f'"{_escape_label_value(eid)}"}} '
+                                     f"{v} {round(ts, 3)}")
+                        lines.append(line)
                     lines.append(
                         f"{name}_sum{_fmt_labels(key)} {metric.sum}")
                     lines.append(

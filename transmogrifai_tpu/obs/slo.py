@@ -1,0 +1,519 @@
+"""SLO burn-rate engine: declarative objectives over the live registries,
+multi-window multi-burn-rate alerting, budget gauges, alert events.
+
+The goodput line of work (arxiv 2502.06982) argues a fleet is managed by
+its SERVICE objectives, not its raw counters; the serving plane (PRs
+10-13) already exports every counter an SLO needs but nothing judges
+them. This module closes that: an `SLOEngine` evaluates a list of
+declarative `SLO`\\ s against `MetricsRegistry` counters/histograms/
+gauges and raises/clears alerts with the standard multi-window
+multi-burn-rate recipe (Google SRE workbook): an alert fires only when
+the error-budget burn rate exceeds a threshold over BOTH a long window
+(enough budget burned to matter) and a short window (it is still
+happening now), which keeps pages fast on cliffs and quiet on blips.
+
+Every SLO reduces to a GOOD/TOTAL pair sampled from cumulative
+counters, so one burn-rate implementation serves all three kinds:
+
+- ``availability``: good = total - errors - sheds (client-visible
+  failures count against the budget);
+- ``latency``: good = requests under ``threshold_s``, read from the
+  cumulative bucket counts of a latency histogram (the le-bucket at or
+  above the threshold) — the standard counter-ization of a latency SLO;
+- ``staleness``: a time-slice SLO — each evaluation tick contributes
+  one good/bad sample depending on whether the current staleness gauge
+  is under ``threshold_s`` (the continual loop maintains the gauge).
+
+Surfaces: ``/slo`` (HTTP JSON), ``slo_burn_rate{slo,window}`` /
+``slo_budget_remaining{slo}`` / ``slo_alert_active{slo}`` gauges,
+``slo_alert`` events in the trace timeline + JSONL log + flight
+recorder (fired AND resolved, with measured time-in-alert), and a
+GoodputReport ``slo`` section rolled from those events. The chaos
+harness (`serving/chaos.py`) proves the loop: a seeded device-error
+storm must fire the availability alert during the storm and clear it
+after recovery.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+__all__ = ["SLO", "SLOParams", "SLOEngine", "BurnWindow"]
+
+
+@dataclass
+class BurnWindow:
+    """One (long, short, burn-threshold) alerting pair. ``burn`` is in
+    budget-multiples: burn 14.4 over 1h/5m is the classic fast-page
+    (2% of a 30-day budget in one hour); burn 1.0 over 3d/6h is the
+    slow ticket."""
+
+    long_s: float
+    short_s: float
+    burn: float
+    severity: str = "page"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"long_s": self.long_s, "short_s": self.short_s,
+                "burn": self.burn, "severity": self.severity}
+
+
+# the standard multiwindow ladder (seconds), scaled by SLOParams.time_scale
+DEFAULT_WINDOWS: Tuple[Tuple[float, float, float, str], ...] = (
+    (3600.0, 300.0, 14.4, "page"),        # fast: 1h / 5m
+    (21600.0, 1800.0, 6.0, "page"),       # 6h / 30m
+    (259200.0, 21600.0, 1.0, "ticket"),   # slow: 3d / 6h
+)
+
+
+@dataclass
+class SLO:
+    """One declarative objective. ``kind``: availability | latency |
+    staleness. ``objective`` is the good-fraction target (0.999 =
+    "three nines"); latency/staleness additionally carry
+    ``threshold_s`` (what counts as good). ``tenant``/``model`` scope
+    the metric selectors on a fleet."""
+
+    name: str
+    kind: str = "availability"
+    objective: float = 0.999
+    threshold_s: Optional[float] = None
+    tenant: Optional[str] = None
+    model: Optional[str] = None
+
+    _FIELDS = ("name", "kind", "objective", "threshold_s", "tenant",
+               "model")
+
+    def __post_init__(self):
+        if self.kind not in ("availability", "latency", "staleness"):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError(
+                f"objective must be in (0,1): {self.objective}")
+        if self.kind in ("latency", "staleness") \
+                and not self.threshold_s:
+            raise ValueError(f"{self.kind} SLO needs threshold_s")
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "SLO":
+        return SLO(**{k: d[k] for k in SLO._FIELDS if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS
+                if getattr(self, k) is not None}
+
+    @property
+    def budget(self) -> float:
+        """The error budget: the allowed bad fraction."""
+        return 1.0 - self.objective
+
+
+@dataclass
+class SLOParams:
+    """JSON-loadable engine config (``ServingConfig.slo`` /
+    ``ServingParams.slo`` / ``FleetConfig.slo``)::
+
+        {"slos": [{"name": "avail", "kind": "availability",
+                   "objective": 0.999, "tenant": "gold"}],
+         "time_scale": 1.0, "eval_period_s": 5.0}
+
+    ``time_scale`` shrinks every burn window by the same factor —
+    chaos/smoke runs use 0.001-ish scales so a 3-second storm exercises
+    the same fast-window/slow-window machinery a real 30-minute outage
+    would. An empty/absent ``slos`` list defaults to one process-wide
+    99.9% availability SLO."""
+
+    enabled: bool = True
+    slos: List[Dict[str, Any]] = field(default_factory=list)
+    time_scale: float = 1.0
+    eval_period_s: float = 5.0
+    # override the default multiwindow ladder: [[long_s, short_s, burn,
+    # severity], ...] (pre-scale)
+    windows: Optional[List[List[Any]]] = None
+
+    _FIELDS = ("enabled", "slos", "time_scale", "eval_period_s",
+               "windows")
+
+    def __post_init__(self):
+        if self.time_scale <= 0 or self.eval_period_s <= 0:
+            raise ValueError("time_scale/eval_period_s must be > 0")
+
+    @staticmethod
+    def from_json(d: Optional[Dict[str, Any]]) -> "SLOParams":
+        d = d or {}
+        return SLOParams(**{k: d[k] for k in SLOParams._FIELDS if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+    def build_slos(self) -> List[SLO]:
+        if self.slos:
+            return [SLO.from_json(dict(d)) for d in self.slos]
+        return [SLO(name="availability", kind="availability",
+                    objective=0.999)]
+
+    def build_windows(self) -> List[BurnWindow]:
+        raw = self.windows or [list(w) for w in DEFAULT_WINDOWS]
+        out = []
+        for w in raw:
+            long_s, short_s, burn = float(w[0]), float(w[1]), float(w[2])
+            sev = str(w[3]) if len(w) > 3 else "page"
+            out.append(BurnWindow(long_s * self.time_scale,
+                                  short_s * self.time_scale, burn, sev))
+        return out
+
+
+# good/total source: () -> (good, total) cumulative floats
+Source = Callable[[], Tuple[float, float]]
+
+
+class _SLOState:
+    """Per-SLO sample ring + alert latch."""
+
+    def __init__(self, slo: SLO, source: Source, max_window_s: float,
+                 eval_period_s: float):
+        self.slo = slo
+        self.source = source
+        # enough samples to cover the longest window at the eval cadence
+        # (+ slack for jitter), bounded regardless of uptime
+        n = max(16, int(max_window_s / max(eval_period_s, 1e-3)) + 8)
+        self.samples: List[Tuple[float, float, float]] = []  # (t, good, tot)
+        self.max_samples = min(n, 100_000)
+        self.firing = False
+        self.fired_at: Optional[float] = None
+        self.last_change: Optional[float] = None
+        self.fired_windows: List[str] = []
+        self.alerts = 0
+
+    def sample(self, now: float) -> None:
+        good, total = self.source()
+        self.samples.append((now, float(good), float(total)))
+        if len(self.samples) > self.max_samples:
+            del self.samples[:len(self.samples) - self.max_samples]
+
+    def window_rate(self, now: float, window_s: float
+                    ) -> Optional[float]:
+        """Bad fraction over the trailing window, from cumulative
+        sample deltas; None when the window saw no traffic."""
+        if not self.samples:
+            return None
+        cutoff = now - window_s
+        # the newest sample at or before the cutoff anchors the delta
+        # (so a window is never silently narrower than asked)
+        anchor = self.samples[0]
+        for s in self.samples:
+            if s[0] <= cutoff:
+                anchor = s
+            else:
+                break
+        last = self.samples[-1]
+        d_total = last[2] - anchor[2]
+        if d_total <= 0:
+            return None
+        d_bad = max(0.0, d_total - (last[1] - anchor[1]))
+        return min(1.0, d_bad / d_total)
+
+
+class SLOEngine:
+    """Evaluate SLOs against registries; see module docstring.
+
+    `sources` maps SLO name -> good/total callable; `attach_*` helpers
+    build the standard ones. `evaluate()` is one tick (tests and the
+    serving watchdog cadence call it directly); `start()` runs it on an
+    own named thread at ``eval_period_s``."""
+
+    def __init__(self, params: Optional[SLOParams] = None,
+                 registry=None):
+        self.params = params or SLOParams()
+        self.registry = registry
+        self.windows = self.params.build_windows()
+        self._lock = threading.Lock()
+        self._states: Dict[str, _SLOState] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._halt = threading.Event()
+        # the span alert events attach to: the engine thread has no
+        # ambient span, so the owning service pins its serving-trace
+        # parent here at start() — slo_alert events then land in the
+        # run's trace timeline and its GoodputReport `slo` section
+        self.span = None
+        max_window = max((w.long_s for w in self.windows), default=60.0)
+        self._max_window_s = max_window
+        for slo in self.params.build_slos():
+            self._states[slo.name] = _SLOState(
+                slo, lambda: (0.0, 0.0), max_window,
+                self.params.eval_period_s)
+
+    # -- wiring -------------------------------------------------------------- #
+
+    def slos(self) -> List[SLO]:
+        with self._lock:
+            return [st.slo for st in self._states.values()]
+
+    def set_source(self, name: str, source: Source) -> None:
+        with self._lock:
+            if name not in self._states:
+                raise KeyError(f"no SLO named {name!r}")
+            self._states[name].source = source
+
+    def add_slo(self, slo: SLO, source: Source) -> None:
+        with self._lock:
+            self._states[slo.name] = _SLOState(
+                slo, source, self._max_window_s,
+                self.params.eval_period_s)
+
+    # -- evaluation ---------------------------------------------------------- #
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One tick: sample every SLO, recompute burn rates per window,
+        latch/unlatch alerts, refresh gauges. Returns `status()`."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            states = list(self._states.values())
+        for st in states:
+            try:
+                st.sample(now)
+            except Exception:
+                log.debug("slo: source for %s failed", st.slo.name,
+                          exc_info=True)
+                continue
+            self._judge(st, now)
+        return self.status(now=now)
+
+    def _judge(self, st: _SLOState, now: float) -> None:
+        budget = st.slo.budget
+        fired: List[str] = []
+        for w in self.windows:
+            long_rate = st.window_rate(now, w.long_s)
+            short_rate = st.window_rate(now, w.short_s)
+            if long_rate is None or short_rate is None:
+                continue
+            if long_rate / budget >= w.burn \
+                    and short_rate / budget >= w.burn:
+                fired.append(f"{w.severity}:{w.long_s:g}s")
+        was = st.firing
+        st.fired_windows = fired
+        st.firing = bool(fired)
+        if st.firing and not was:
+            st.fired_at = now
+            st.last_change = now
+            st.alerts += 1
+            self._note_alert(st, "firing", now)
+        elif was and not st.firing:
+            st.last_change = now
+            self._note_alert(st, "resolved", now)
+        self._gauges(st, now)
+
+    def _note_alert(self, st: _SLOState, state: str, now: float) -> None:
+        attrs: Dict[str, Any] = {
+            "slo": st.slo.name, "state": state,
+            "objective": st.slo.objective,
+            "windows": ",".join(st.fired_windows)}
+        if state == "resolved" and st.fired_at is not None:
+            attrs["alert_s"] = round(now - st.fired_at, 3)
+        try:
+            from transmogrifai_tpu.obs.export import record_event
+            if self.span is not None:
+                # explicit span target (the engine thread has no
+                # ambient span): the event lands on the owning run's
+                # trace; record_event still feeds the JSONL log +
+                # flight ring
+                self.span.event("slo_alert", **attrs)
+            record_event("slo_alert", **attrs)
+        except Exception:
+            log.debug("slo_alert event emission failed", exc_info=True)
+        if state == "firing":
+            # an SLO alert IS an incident: snapshot the flight ring so
+            # the burn's cause is in the post-mortem even if nothing
+            # else (breaker, watchdog) trips
+            try:
+                from transmogrifai_tpu.obs import flight
+                flight.request_dump("slo_alert")
+            except Exception:  # best-effort black box
+                log.debug("flight dump on slo alert failed",
+                          exc_info=True)
+        log.log(logging.WARNING if state == "firing" else logging.INFO,
+                "slo: %s %s (%s)", st.slo.name, state,
+                attrs.get("windows") or "recovered")
+
+    def _gauges(self, st: _SLOState, now: float) -> None:
+        if self.registry is None:
+            return
+        budget = st.slo.budget
+        for w in self.windows:
+            rate = st.window_rate(now, w.long_s)
+            self.registry.gauge(
+                "slo_burn_rate",
+                "error-budget burn rate per SLO and long window",
+                slo=st.slo.name, window=f"{w.long_s:g}s"
+            ).set(0.0 if rate is None else rate / budget)
+        slow = self.windows[-1] if self.windows else None
+        remaining = 1.0
+        if slow is not None:
+            rate = st.window_rate(now, slow.long_s)
+            if rate is not None:
+                remaining = max(0.0, 1.0 - rate / budget)
+        self.registry.gauge(
+            "slo_budget_remaining",
+            "fraction of the error budget left over the slow window",
+            slo=st.slo.name).set(remaining)
+        self.registry.gauge(
+            "slo_alert_active", "1 while the SLO's alert is firing",
+            slo=st.slo.name).set(1.0 if st.firing else 0.0)
+
+    def status(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The `/slo` endpoint payload."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            states = list(self._states.values())
+        slos: Dict[str, Any] = {}
+        for st in states:
+            budget = st.slo.budget
+            burns = {}
+            for w in self.windows:
+                rate = st.window_rate(now, w.long_s)
+                srate = st.window_rate(now, w.short_s)
+                burns[f"{w.long_s:g}s/{w.short_s:g}s"] = {
+                    "threshold": w.burn, "severity": w.severity,
+                    "long_burn": (None if rate is None
+                                  else round(rate / budget, 4)),
+                    "short_burn": (None if srate is None
+                                   else round(srate / budget, 4)),
+                }
+            slow = self.windows[-1] if self.windows else None
+            remaining = None
+            if slow is not None:
+                rate = st.window_rate(now, slow.long_s)
+                if rate is not None:
+                    remaining = round(max(0.0, 1.0 - rate / budget), 4)
+            slos[st.slo.name] = {
+                **st.slo.to_json(),
+                "state": "firing" if st.firing else "ok",
+                "fired_windows": list(st.fired_windows),
+                "alerts": st.alerts,
+                "budget_remaining": remaining,
+                "windows": burns,
+                "samples": len(st.samples),
+            }
+        return {"slos": slos,
+                "windows": [w.to_json() for w in self.windows],
+                "eval_period_s": self.params.eval_period_s}
+
+    def firing(self) -> List[str]:
+        with self._lock:
+            return [n for n, st in self._states.items() if st.firing]
+
+    # -- lifecycle ------------------------------------------------------------ #
+
+    def start(self) -> "SLOEngine":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._halt.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="slo-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._halt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._halt.wait(timeout=self.params.eval_period_s):
+            try:
+                self.evaluate()
+            except Exception:
+                log.exception("slo: evaluation tick failed")
+
+
+# -- standard sources --------------------------------------------------------- #
+
+def availability_source(registry, requests_family: str,
+                        error_families: Tuple[str, ...] = (),
+                        shed_families: Tuple[str, ...] = (),
+                        requests_count: str = "admitted",
+                        **label_filter: Any) -> Source:
+    """good/total from cumulative counters. `requests_count` names what
+    `requests_family` actually ticks:
+
+    - ``"admitted"``: every admitted request, errors included (the
+      single-service `serving_requests_total` semantics) — good =
+      requests − errors, total = requests + sheds;
+    - ``"successes"``: successful requests ONLY (the fleet's
+      `fleet_requests_total`, ticked in `Router.note_success`) — good =
+      requests, total = requests + errors + sheds. Wiring a
+      successes-only family as "admitted" makes the SLO BLIND during a
+      total outage (no successes → zero denominator → no window rate →
+      no alert), which is the one failure mode an availability alert
+      must not have.
+
+    Sheds are client-visible failures either way: they grow the
+    denominator AND count against the budget."""
+    if requests_count not in ("admitted", "successes"):
+        raise ValueError(
+            f"requests_count must be 'admitted' or 'successes': "
+            f"{requests_count!r}")
+
+    def src() -> Tuple[float, float]:
+        requests = registry.sum_family(requests_family, **label_filter)
+        errors = sum(registry.sum_family(f, **label_filter)
+                     for f in error_families)
+        sheds = sum(registry.sum_family(f, **label_filter)
+                    for f in shed_families)
+        if requests_count == "successes":
+            return requests, requests + errors + sheds
+        return max(0.0, requests - errors), requests + sheds
+    return src
+
+
+def latency_source(registry, family: str, threshold_s: float,
+                   **label_filter: Any) -> Source:
+    """good/total from a latency histogram family's cumulative buckets:
+    good = observations at or under the smallest bucket bound >=
+    threshold. Aggregates across EVERY series matching `label_filter`
+    (a per-tenant-labeled family with no tenant scope sums all
+    tenants) — an exact-key lookup would silently never match a
+    labeled family and leave the SLO permanently "ok" with no data."""
+    def src() -> Tuple[float, float]:
+        good = 0.0
+        total = 0.0
+        for hist in registry.find_all(family, **label_filter):
+            series_good = None
+            series_total = 0.0
+            for bound, cum in hist.bucket_counts():
+                series_total = float(cum)
+                if series_good is None and bound >= threshold_s:
+                    series_good = float(cum)
+            good += series_total if series_good is None else series_good
+            total += series_total
+        return good, total
+    return src
+
+
+def staleness_source(registry, gauge_family: str, threshold_s: float,
+                     **labels: Any) -> Source:
+    """Time-slice SLO: each tick contributes one sample — good while
+    the current staleness gauge is under the threshold. Cumulative
+    counts are synthesized on the closure so the burn-rate windows see
+    a good/total stream like any other SLO.
+
+    A MISSING gauge is no-data, not freshness: until a continual loop
+    publishes it, the sample counters stay frozen, window rates return
+    None, and the SLO reports no burn instead of a fraudulent "ok"."""
+    state = {"good": 0.0, "total": 0.0}
+
+    def src() -> Tuple[float, float]:
+        g = registry.find(gauge_family, **labels)
+        if g is not None:
+            state["total"] += 1.0
+            if g.value <= threshold_s:
+                state["good"] += 1.0
+        return state["good"], state["total"]
+    return src

@@ -1,0 +1,249 @@
+"""`make slo-smoke`: the observability plane's end-to-end acceptance gate.
+
+One scripted scenario over a real served model with ONE injected
+device-error storm, asserting the four contracts this plane exists for:
+
+1. **traceparent roundtrip** — a caller-supplied W3C ``traceparent``
+   comes back in the response headers with the SAME trace id, and the
+   process trace contains that request's queue-wait / assemble (with a
+   nonzero ``parse`` child) / pad / device-dispatch spans parented
+   under the request root;
+2. **tail sampling** — after a burst of healthy traffic plus the storm,
+   the sampler KEPT every error trace and DROPPED head-sampled
+   successes (kept < sent, dropped > 0, all error traces present);
+3. **flight recorder** — the breaker-open dump exists, is a VALID
+   Chrome trace (`validate_chrome_trace`), and contains the failing
+   dispatch spans;
+4. **SLO burn rate** — the availability SLO's multi-window alert FIRES
+   during the storm and CLEARS after recovery.
+
+Run: ``JAX_PLATFORMS=cpu python -m transmogrifai_tpu.obs.slo_smoke``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List
+
+D = 3
+ROW = {f"x{j}": 0.2 * (j + 1) for j in range(D)}
+
+
+def _train(tmp: str) -> str:
+    import numpy as np
+
+    import transmogrifai_tpu.types as t
+    from transmogrifai_tpu.data import Dataset
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models import OpLogisticRegression
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(31)
+    n = 160
+    X = rng.normal(size=(n, D))
+    beta = rng.normal(size=D)
+    ds = Dataset({**{f"x{j}": X[:, j] for j in range(D)},
+                  "y": (X @ beta > 0).astype(np.float64)},
+                 {**{f"x{j}": t.Real for j in range(D)},
+                  "y": t.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = RealVectorizer(track_nulls=False).set_input(*preds).get_output()
+    pred = OpLogisticRegression(max_iter=40).set_input(
+        label, vec).get_output()
+    Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train().save(f"{tmp}/model")
+    return f"{tmp}/model"
+
+
+def _post_score(port: int, headers: Dict[str, str]):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/score",
+        data=json.dumps({"rows": [dict(ROW)],
+                         "deadline_ms": 10_000}).encode(),
+        headers={"Content-Type": "application/json", **headers})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def main() -> int:  # noqa: C901 (one linear acceptance script)
+    os.environ.setdefault("TRANSMOGRIFAI_PERF_MODEL", "0")
+    from transmogrifai_tpu.obs import flight
+    from transmogrifai_tpu.obs.export import validate_chrome_trace
+    from transmogrifai_tpu.obs.trace import TRACER
+    from transmogrifai_tpu.runtime.faults import (
+        SITE_DEVICE_DISPATCH, FaultPlan, FaultSpec)
+    from transmogrifai_tpu.serving.http import serve
+    from transmogrifai_tpu.serving.service import (
+        ScoringService, ServingConfig)
+
+    with tempfile.TemporaryDirectory(prefix="slo-smoke-") as tmp:
+        model_dir = _train(tmp)
+        flight.get_recorder().configure(
+            dump_dir=os.path.join(tmp, "flight"), min_interval_s=0.0)
+        svc = ScoringService.from_path(model_dir, config=ServingConfig(
+            max_batch=4, batch_wait_ms=1.0, max_queue=256,
+            resilience={"window": 32, "min_window": 8,
+                        "breaker_failures": 3,
+                        "half_open_after_s": 0.25, "probe_successes": 1,
+                        "watchdog_period_s": 0.05,
+                        "watchdog_stall_s": 2.0},
+            tracing={"head_sample_every": 16,
+                     "min_latency_samples": 10_000},
+            slo={"slos": [{"name": "availability",
+                           "kind": "availability",
+                           "objective": 0.999}],
+                 "windows": [[2.4, 1.2, 2.0, "page"]],
+                 "eval_period_s": 0.05}))
+        svc.start()
+        server, thread = serve(svc, block=False)
+        port = server.port
+        failures: List[str] = []
+
+        def check(ok: bool, msg: str) -> None:
+            if not ok:
+                failures.append(msg)
+
+        try:
+            # -- 1. traceparent roundtrip --------------------------------- #
+            caller_tp = ("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+            resp = _post_score(port, {"traceparent": caller_tp})
+            echo = resp.headers.get("traceparent") or ""
+            body = json.loads(resp.read())
+            check(echo.split("-")[1] == "ab" * 16,
+                  f"traceparent echo lost the caller's trace id: {echo}")
+            check(body.get("trace_id") == "ab" * 16,
+                  f"body trace_id mismatch: {body.get('trace_id')}")
+            spans = TRACER.trace_spans("ab" * 16)
+            names = {sp.name for sp in spans}
+            want = {"serving:request", "serving:assemble", "serving:parse",
+                    "serving:queue_wait", "serving:pad",
+                    "serving:device_dispatch", "serving:demux"}
+            check(want <= names,
+                  f"request trace missing phases: {sorted(want - names)}")
+            root = next(sp for sp in spans
+                        if sp.name == "serving:request")
+            parse = next(sp for sp in spans if sp.name == "serving:parse")
+            check(parse.duration_s > 0, "parse child has zero duration")
+            by_id = {sp.span_id: sp for sp in spans}
+            for sp in spans:
+                if sp is root:
+                    continue
+                anc = sp
+                while anc.parent_id is not None and anc.parent_id in by_id:
+                    anc = by_id[anc.parent_id]
+                check(anc is root,
+                      f"{sp.name} not parented under the request root")
+
+            # -- 2. healthy burst + storm --------------------------------- #
+            sampler = svc.sampler
+            kept0, dropped0 = sampler.kept, sampler.dropped
+            for _ in range(48):
+                _post_score(port, {})
+            check(sampler.dropped > dropped0,
+                  "tail sampler dropped no head-sampled successes")
+            kept_healthy = sampler.kept - kept0
+
+            stop = threading.Event()
+            pump_errors = [0]
+
+            def pump() -> None:
+                while not stop.is_set():
+                    try:
+                        _post_score(port, {})
+                    except Exception:
+                        # storm errors are the point: count them so the
+                        # SLO has bad samples to judge
+                        pump_errors[0] += 1
+                    time.sleep(0.004)
+
+            pumper = threading.Thread(target=pump, name="slo-smoke-load",
+                                      daemon=True)
+            pumper.start()
+            storm = FaultPlan([FaultSpec(site=SITE_DEVICE_DISPATCH, at=1,
+                                         times=8, kind="error")], seed=0)
+            t_storm = time.perf_counter()
+            fired_s = cleared_s = None
+            with storm.active():
+                while time.perf_counter() - t_storm < 10.0:
+                    if "availability" in svc.slo_engine.firing():
+                        fired_s = time.perf_counter() - t_storm
+                        break
+                    time.sleep(0.02)
+                # wait out the storm (breaker opens, probes recover)
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < 10.0 and storm.fired \
+                        and len(storm.fired) < 8:
+                    time.sleep(0.02)
+            t_clear = time.perf_counter()
+            while time.perf_counter() - t_clear < 15.0:
+                if "availability" not in svc.slo_engine.firing():
+                    cleared_s = time.perf_counter() - t_clear
+                    break
+                time.sleep(0.02)
+            stop.set()
+            pumper.join(timeout=5)
+
+            check(fired_s is not None,
+                  "availability SLO alert never fired during the storm")
+            check(cleared_s is not None,
+                  "availability SLO alert never cleared after recovery")
+
+            # -- 3. tail sampling kept the error traces ------------------- #
+            err_traces = [sp for sp in TRACER.spans()
+                          if sp.name == "serving:request"
+                          and sp.error is not None]
+            check(len(err_traces) >= 1,
+                  "no error request trace survived tail sampling")
+            kept_reasons = {sp.attributes.get("sampled")
+                            for sp in TRACER.spans()
+                            if sp.name == "serving:request"}
+            check("error" in kept_reasons,
+                  f"no trace kept for reason=error: {kept_reasons}")
+            check(kept_healthy < 48,
+                  f"head sampling kept every success ({kept_healthy}/48)")
+
+            # -- 4. breaker-open flight dump ------------------------------ #
+            breaker_dumps = [d for d in flight.get_recorder().dumps
+                             if d.endswith("breaker_open")]
+            check(bool(breaker_dumps),
+                  "breaker open produced no flight dump")
+            if breaker_dumps:
+                with open(os.path.join(breaker_dumps[0], "trace.json"),
+                          encoding="utf-8") as fh:
+                    trace = json.load(fh)
+                problems = validate_chrome_trace(trace)
+                check(not problems,
+                      f"flight dump invalid: {problems[:3]}")
+                failing = [ev for ev in trace["traceEvents"]
+                           if ev.get("ph") == "X"
+                           and ev.get("name") == "serving:device_dispatch"
+                           and ev.get("args", {}).get("error")]
+                check(len(failing) >= 1,
+                      "flight dump has no failing dispatch spans")
+        finally:
+            server.shutdown()
+            server.server_close()
+            svc.stop()
+
+        if failures:
+            for f in failures:
+                print(f"slo-smoke FAILED: {f}", file=sys.stderr)
+            return 1
+        print(f"slo-smoke OK: traceparent roundtrip + full phase tree "
+              f"(parse {parse.duration_s * 1e6:.0f}us); sampler kept "
+              f"{sampler.kept}/{sampler.kept + sampler.dropped} traces "
+              f"(errors always, successes head-sampled); SLO alert "
+              f"fired {fired_s:.3f}s into the storm, cleared "
+              f"{cleared_s:.3f}s after recovery; breaker flight dump "
+              f"valid with {len(failing)} failing dispatch span(s)")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,14 +40,20 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import itertools
+import logging
+import re
 import threading
 import time
 import uuid
 from collections import deque
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple)
 
 __all__ = ["Span", "Tracer", "TRACER", "get_tracer", "current_span",
-           "add_event", "new_run_id"]
+           "add_event", "new_run_id", "now_s", "new_trace_id",
+           "span_id_hex", "parse_traceparent", "format_traceparent",
+           "TraceContext", "RequestTrace", "TracingParams", "TailSampler"]
 
 # one process epoch for both clocks: export timestamps are
 # perf_counter-relative to this origin, mapped onto the epoch origin
@@ -61,6 +67,60 @@ def new_run_id() -> str:
     """Run-level correlation id: unique across processes, short enough
     to grep in a JSONL event log."""
     return uuid.uuid4().hex[:12]
+
+
+def now_s() -> float:
+    """Perf-clock offset from the process trace epoch — the timebase
+    every span's start_s/end_s lives in. Exposed so code that measures
+    a phase boundary OUTSIDE a span (e.g. the micro-batcher's enqueue
+    tick) can later backdate a span to it."""
+    return time.perf_counter() - _EPOCH_PERF
+
+
+# -- W3C trace context (traceparent) ----------------------------------------- #
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def new_trace_id() -> str:
+    """A W3C-shaped 32-hex trace id (uuid4 bytes)."""
+    return uuid.uuid4().hex
+
+
+def span_id_hex(span_id: int) -> str:
+    """A span id as the 16-hex W3C parent-id field."""
+    return format(span_id & 0xFFFFFFFFFFFFFFFF, "016x")
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[Tuple[str, str, bool]]:
+    """Parse a W3C ``traceparent`` header into ``(trace_id,
+    parent_span_id, sampled)``; None for a missing/malformed header or
+    the all-zero ids the spec forbids. Unknown versions are accepted
+    per spec (fields we understand are read; ff is invalid)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, parent_id, flags = m.groups()
+    if version == "ff" or trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None
+    return trace_id, parent_id, bool(int(flags, 16) & 0x01)
+
+
+def format_traceparent(trace_id: str, span_id: Any,
+                       sampled: bool = True) -> str:
+    """Render a version-00 ``traceparent``. `trace_id` shorter than 32
+    hex chars (internal run ids are 12) is left-padded with zeros so
+    the header stays spec-shaped; `span_id` may be an int (internal
+    span ids) or a 16-hex string."""
+    tid = str(trace_id).lower()
+    tid = ("0" * 32 + re.sub(r"[^0-9a-f]", "", tid))[-32:]
+    sid = span_id_hex(span_id) if isinstance(span_id, int) \
+        else str(span_id).lower()
+    return f"00-{tid}-{sid}-{'01' if sampled else '00'}"
 
 
 class Span:
@@ -152,6 +212,10 @@ class Tracer:
         self._finished: deque = deque(maxlen=max_spans)
         self._live: Dict[int, Span] = {}
         self.dropped = 0
+        # finished-span sinks (the flight recorder's feed): called once
+        # per finished span, outside the tracer lock, exceptions eaten —
+        # a broken sink must never take down a scoring thread
+        self._sinks: List[Callable[[Span], None]] = []
         # NOTE: a per-Tracer ContextVar would leak on tracer churn;
         # module scope is fine because tests always reset the global.
         self._current: contextvars.ContextVar[Optional[Span]] = \
@@ -194,9 +258,46 @@ class Tracer:
                 if len(self._finished) == self._finished.maxlen:
                     self.dropped += 1
                 self._finished.append(sp)
+            self._notify(sp)
 
     def current(self) -> Optional[Span]:
         return self._current.get()
+
+    # -- sinks + out-of-band collection ------------------------------------- #
+
+    def add_sink(self, fn: Callable[[Span], None]) -> None:
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn: Callable[[Span], None]) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
+    def _notify(self, sp: Span) -> None:
+        for fn in list(self._sinks):
+            try:
+                fn(sp)
+            except Exception:  # a broken sink must not break tracing
+                logging.getLogger(__name__).debug(
+                    "span sink %r failed", fn, exc_info=True)
+
+    def collect(self, spans: Iterable[Span]) -> None:
+        """Admit externally-constructed FINISHED spans into the ring
+        (the tail sampler's kept request traces come through here:
+        their spans are buffered per request and only land in the
+        process timeline once the keep decision is made)."""
+        spans = list(spans)
+        with self._lock:
+            for sp in spans:
+                if sp.end_s is None:
+                    sp.end()
+                if len(self._finished) == self._finished.maxlen:
+                    self.dropped += 1
+                self._finished.append(sp)
+        for sp in spans:
+            self._notify(sp)
 
     # -- collection views --------------------------------------------------- #
 
@@ -243,3 +344,244 @@ def add_event(name: str, **attributes: Any) -> bool:
         return False
     sp.event(name, **attributes)
     return True
+
+
+# -- request-scoped tracing --------------------------------------------------- #
+
+@dataclass
+class TraceContext:
+    """Incoming trace context for one request: a W3C wire context
+    (`trace_id` + `parent_hex`, from a ``traceparent`` header) or an
+    in-process parent span (the continual loop parents its live
+    holdout requests under the cycle span). ``sampled`` carries the
+    caller's sampling decision: a sampled=01 wire context (or any
+    in-process parent) is force-kept past tail sampling, so a
+    distributed trace never loses its serving leg."""
+
+    trace_id: Optional[str] = None
+    parent_hex: Optional[str] = None
+    parent: Optional[Span] = None
+    sampled: bool = False
+
+    @staticmethod
+    def from_traceparent(header: Optional[str]) -> Optional["TraceContext"]:
+        parsed = parse_traceparent(header)
+        if parsed is None:
+            return None
+        trace_id, parent_hex, sampled = parsed
+        return TraceContext(trace_id=trace_id, parent_hex=parent_hex,
+                            sampled=sampled)
+
+    @staticmethod
+    def from_span(sp: Optional[Span]) -> Optional["TraceContext"]:
+        if sp is None:
+            return None
+        return TraceContext(trace_id=sp.trace_id, parent=sp, sampled=True)
+
+
+class RequestTrace:
+    """One request's span buffer: a root ``serving:request`` span plus
+    phase children, held OUT of the process ring until the tail sampler
+    decides to keep it (`Tracer.collect`). Children may be opened live
+    (`child(...)` context manager, caller thread) or BACKDATED from
+    measured phase boundaries (`child_at(...)`, the scoring thread's
+    per-batch timestamps replicated onto every member request)."""
+
+    __slots__ = ("root", "spans", "forced", "enqueued_s", "_done")
+
+    def __init__(self, name: str = "serving:request",
+                 ctx: Optional[TraceContext] = None,
+                 **attributes: Any):
+        ctx = ctx or TraceContext()
+        self.root = Span(name, category="serving",
+                         parent=ctx.parent,
+                         trace_id=ctx.trace_id or new_trace_id(),
+                         attributes=attributes)
+        if ctx.parent is None and ctx.parent_hex:
+            # wire-context parent: not an in-process span, carried as an
+            # attribute so the exported trace still links to the caller
+            self.root.attributes["parent_traceparent"] = ctx.parent_hex
+        self.forced = ctx.sampled or ctx.parent is not None
+        self.spans: List[Span] = [self.root]
+        self.enqueued_s: Optional[float] = None
+        self._done = False
+
+    @property
+    def trace_id(self) -> str:
+        return self.root.trace_id
+
+    def traceparent(self, sampled: bool = True) -> str:
+        """The response-header echo: same trace id, the request root as
+        the span id."""
+        return format_traceparent(self.root.trace_id, self.root.span_id,
+                                  sampled=sampled)
+
+    @contextlib.contextmanager
+    def child(self, name: str, parent: Optional[Span] = None,
+              **attributes: Any) -> Iterator[Span]:
+        sp = Span(name, category="serving", parent=parent or self.root,
+                  trace_id=self.root.trace_id, attributes=attributes)
+        self.spans.append(sp)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            sp.end()
+
+    def child_at(self, name: str, start_s: float, end_s: float,
+                 error: Optional[str] = None, **attributes: Any) -> Span:
+        """Backdated phase child from measured boundaries (perf offsets
+        from `now_s()`): how the scoring thread attributes one batch's
+        pad/dispatch/demux wall to every request it carried."""
+        sp = Span(name, category="serving", parent=self.root,
+                  trace_id=self.root.trace_id, attributes=attributes)
+        sp.start_s = float(start_s)
+        sp.start_at = _EPOCH_TIME + sp.start_s
+        sp.end_s = max(float(end_s), sp.start_s)
+        sp.error = error
+        self.spans.append(sp)
+        return sp
+
+    def phase_durations(self) -> Dict[str, float]:
+        """phase suffix -> seconds, for the ``serving_phase_seconds``
+        histograms (span names are ``serving:<phase>``)."""
+        out: Dict[str, float] = {}
+        for sp in self.spans[1:]:
+            phase = sp.name.rsplit(":", 1)[-1]
+            out[phase] = out.get(phase, 0.0) + sp.duration_s
+        return out
+
+    def finish(self, error: Optional[str] = None) -> Span:
+        """Idempotently end the root (phase children were ended by
+        their own scopes)."""
+        if not self._done:
+            self._done = True
+            if error:
+                self.root.error = error
+            self.root.end()
+        return self.root
+
+
+@dataclass
+class TracingParams:
+    """Knobs for request-scoped tracing + tail sampling (JSON-loadable
+    via ``ServingConfig.tracing`` / ``ServingParams.tracing``). On by
+    default: the per-request cost is a handful of Span objects and
+    clock reads, and the tail sampler keeps the ring bounded at fleet
+    QPS."""
+
+    enabled: bool = True
+    # tail sampling: always keep error/deadline/shed/fallback traces
+    # and anything at or above the rolling `slow_quantile` of request
+    # latency; head-sample 1-in-`head_sample_every` of the rest
+    slow_quantile: float = 0.95
+    head_sample_every: int = 64
+    # latency-quantile estimator: rolling sample buffer + the floor of
+    # observations before "slow" judgments start (cold = head sampling
+    # only, so a warmup burst can't define "slow" forever)
+    latency_window: int = 2048
+    min_latency_samples: int = 64
+
+    _FIELDS = ("enabled", "slow_quantile", "head_sample_every",
+               "latency_window", "min_latency_samples")
+
+    def __post_init__(self):
+        if not (0.0 < self.slow_quantile < 1.0):
+            raise ValueError(
+                f"slow_quantile must be in (0,1): {self.slow_quantile}")
+        if self.head_sample_every < 1 or self.latency_window < 1 \
+                or self.min_latency_samples < 1:
+            raise ValueError("tracing windows/rates must be >= 1")
+
+    @staticmethod
+    def from_json(d: Optional[Dict[str, Any]]) -> "TracingParams":
+        d = d or {}
+        return TracingParams(**{k: d[k] for k in TracingParams._FIELDS
+                                if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+
+class TailSampler:
+    """Tail-based sampling decision for finished request traces.
+
+    Head sampling decides at request START and throws the interesting
+    traces away with the boring ones; tail sampling decides at the END,
+    when the outcome is known: errors, sheds, deadline misses, degraded
+    fallbacks and force-sampled contexts are ALWAYS kept, the slowest
+    `slow_quantile` tail of latencies is kept, and a deterministic
+    1-in-N head sample of the healthy fast majority survives as the
+    baseline. Everything else is dropped BEFORE it reaches the process
+    span ring, which is what makes always-on tracing affordable at
+    fleet QPS. Thread-safe; counters land in the service registry as
+    ``serving_trace_kept_total{reason=...}`` /
+    ``serving_trace_dropped_total``."""
+
+    def __init__(self, params: Optional[TracingParams] = None,
+                 registry=None):
+        self.params = params or TracingParams()
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._latencies: deque = deque(maxlen=self.params.latency_window)
+        self._seen = 0
+        self.kept = 0
+        self.dropped = 0
+
+    def _threshold(self) -> Optional[float]:
+        vals = sorted(self._latencies)
+        if len(vals) < self.params.min_latency_samples:
+            return None
+        return vals[min(len(vals) - 1,
+                        int(self.params.slow_quantile * len(vals)))]
+
+    def decide(self, latency_s: float, error: bool = False,
+               forced: bool = False) -> Tuple[bool, str]:
+        """(keep, reason) for one finished request. `error` covers every
+        non-success outcome (scoring error, shed, deadline, fallback);
+        `forced` is a caller-sampled wire context or in-process parent."""
+        with self._lock:
+            self._latencies.append(float(latency_s))
+            self._seen += 1
+            if error:
+                keep, reason = True, "error"
+            elif forced:
+                keep, reason = True, "forced"
+            else:
+                thr = self._threshold()
+                if thr is not None and latency_s >= thr:
+                    keep, reason = True, "slow"
+                elif self._seen % self.params.head_sample_every == 1 \
+                        or self.params.head_sample_every == 1:
+                    keep, reason = True, "head"
+                else:
+                    keep, reason = False, "dropped"
+            if keep:
+                self.kept += 1
+            else:
+                self.dropped += 1
+        if self.registry is not None:
+            if keep:
+                self.registry.counter(
+                    "serving_trace_kept_total",
+                    "request traces kept by the tail sampler",
+                    reason=reason).inc()
+            else:
+                self.registry.counter(
+                    "serving_trace_dropped_total",
+                    "request traces dropped by the tail sampler").inc()
+        return keep, reason
+
+    def observe(self, rt: RequestTrace, latency_s: float,
+                error: bool = False,
+                tracer: Optional[Tracer] = None) -> bool:
+        """Finish-side entry point: decide, and on keep admit the
+        request's span buffer into the process ring."""
+        keep, reason = self.decide(latency_s, error=error,
+                                   forced=rt.forced)
+        if keep:
+            rt.root.set(sampled=reason)
+            (tracer or TRACER).collect(rt.spans)
+        return keep

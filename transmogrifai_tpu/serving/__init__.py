@@ -26,12 +26,12 @@ fast as the hardware allows"):
                multi-tenant fleet path (`make fleet-smoke`)
 """
 
+from transmogrifai_tpu.obs.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry)
 from transmogrifai_tpu.serving.batcher import (  # noqa: F401
     MicroBatcher, Request, ScoreError, bucket_for, bucket_ladder)
 from transmogrifai_tpu.serving.fleet import (  # noqa: F401
     FleetConfig, FleetService, ProgramPool, scoring_signature)
-from transmogrifai_tpu.serving.metrics import (  # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry)
 from transmogrifai_tpu.serving.resilience import (  # noqa: F401
     DEGRADED, HEALTHY, QUARANTINED, MemberHealth, ResilienceParams,
     Watchdog)

@@ -39,7 +39,12 @@ class ScoreError(Exception):
 
     ``retry_after_s`` is the backoff hint a shed/fast-failed client
     should honor (token-bucket refill time, breaker half-open deadline);
-    the HTTP layer surfaces it as a ``Retry-After`` header on 429/503."""
+    the HTTP layer surfaces it as a ``Retry-After`` header on 429/503.
+
+    ``trace_id``/``traceparent`` (set by the service when request
+    tracing is on) name the KEPT error trace this failure left behind —
+    the HTTP layer echoes them on error responses too, so a failed
+    request is as correlatable as a slow one."""
 
     def __init__(self, code: str, message: str,
                  retry_after_s: Optional[float] = None):
@@ -47,11 +52,15 @@ class ScoreError(Exception):
         self.code = code
         self.message = message
         self.retry_after_s = retry_after_s
+        self.trace_id: Optional[str] = None
+        self.traceparent: Optional[str] = None
 
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"error": self.code, "message": self.message}
         if self.retry_after_s is not None:
             out["retry_after_s"] = round(float(self.retry_after_s), 3)
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
         return out
 
 
@@ -126,16 +135,20 @@ def bucket_for(n_rows: int, ladder: Tuple[int, ...]) -> int:
 
 class Request:
     """One in-flight scoring request: rows already parsed to a Dataset,
-    a future the caller blocks on, and an absolute deadline."""
+    a future the caller blocks on, an absolute deadline, and (when
+    request tracing is on) the `obs.trace.RequestTrace` span buffer the
+    scoring thread backdates its per-batch phase spans into."""
 
     __slots__ = ("dataset", "n_rows", "deadline", "enqueued_at",
-                 "_event", "_result", "_error")
+                 "trace", "_event", "_result", "_error")
 
-    def __init__(self, dataset: Dataset, deadline: Optional[float]):
+    def __init__(self, dataset: Dataset, deadline: Optional[float],
+                 trace=None):
         self.dataset = dataset
         self.n_rows = len(dataset)
         self.deadline = deadline          # absolute time.monotonic() or None
         self.enqueued_at = time.monotonic()
+        self.trace = trace                # Optional[RequestTrace]
         self._event = threading.Event()
         self._result: Optional[Tuple[Dict[str, Any], str]] = None
         self._error: Optional[ScoreError] = None

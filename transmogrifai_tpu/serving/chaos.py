@@ -159,6 +159,54 @@ def _wait_state(fleet, member: str, state: str,
     return False
 
 
+def _wait_slo(fleet, name: str, firing: bool,
+              timeout_s: float = 10.0) -> bool:
+    """Poll the fleet SLO engine until `name` is (not) firing."""
+    if fleet.slo_engine is None:
+        return False
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        if (name in fleet.slo_engine.firing()) == firing:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _flight_proof(dumps_before: int) -> Dict[str, Any]:
+    """The breaker-open flight dump, validated: it must exist, parse as
+    a VALID Chrome trace, and contain the failing device-dispatch
+    spans that caused the incident (the 30-seconds-before story)."""
+    import json
+
+    from transmogrifai_tpu.obs import flight
+    from transmogrifai_tpu.obs.export import validate_chrome_trace
+
+    dumps = flight.get_recorder().dumps[dumps_before:]
+    breaker = [d for d in dumps if d.endswith("breaker_open")]
+    out: Dict[str, Any] = {"dumps": len(dumps),
+                           "breaker_dump": bool(breaker)}
+    if not breaker:
+        return out
+    path = breaker[0]
+    out["path"] = path
+    try:
+        with open(os.path.join(path, "trace.json"),
+                  encoding="utf-8") as fh:
+            trace = json.load(fh)
+        problems = validate_chrome_trace(trace)
+        out["valid_chrome_trace"] = not problems
+        out["problems"] = problems[:3]
+        out["failing_dispatch_spans"] = sum(
+            1 for ev in trace.get("traceEvents", [])
+            if ev.get("ph") == "X"
+            and ev.get("name") == "serving:device_dispatch"
+            and ev.get("args", {}).get("error"))
+    except Exception as e:
+        out["valid_chrome_trace"] = False
+        out["problems"] = [f"{type(e).__name__}: {e}"]
+    return out
+
+
 def _corrupt_copy(src: str, dst: str) -> str:
     """Copy a sealed model artifact and flip bytes in one payload file
     (never integrity.json itself — the manifest must DETECT the flip)."""
@@ -177,10 +225,20 @@ def _corrupt_copy(src: str, dst: str) -> str:
 
 
 def run_chaos(dirs: Dict[str, str], seed: int = 0,
-              load_s: float = 3.0) -> Dict[str, Any]:
+              load_s: float = 3.0,
+              flight_dir: Optional[str] = None) -> Dict[str, Any]:
     """Scenarios 1-4 against one fleet; returns the falsifiability
     report (see module docstring). `dirs` maps a/a_v2/b/c to trained
-    artifact dirs (`_train_models`)."""
+    artifact dirs (`_train_models`).
+
+    The storm scenario also proves the PR-14 observability loop: the
+    fleet runs an availability SLO (time-scaled burn windows so a
+    seconds-long storm exercises the same multi-window machinery a
+    real outage would) whose alert must FIRE during the storm and
+    CLEAR after recovery, and the breaker-open flight dump must
+    contain the failing dispatch spans and validate as a Chrome
+    trace."""
+    from transmogrifai_tpu.obs import flight
     from transmogrifai_tpu.obs.goodput import build_report
     from transmogrifai_tpu.obs.trace import TRACER
     from transmogrifai_tpu.runtime.faults import (
@@ -194,13 +252,27 @@ def run_chaos(dirs: Dict[str, str], seed: int = 0,
         "probe_successes": 1,
         "watchdog_period_s": 0.05, "watchdog_stall_s": 0.75,
     }
+    # availability SLO over the gold tenant, burn windows scaled so the
+    # fast pair is ~2.4s/1.2s wall: a storm lasting a second+ burns the
+    # 0.1% budget orders of magnitude too fast -> both windows trip
+    slo = {
+        "slos": [{"name": "gold-availability",
+                  "kind": "availability", "objective": 0.999,
+                  "tenant": "gold"}],
+        "windows": [[2.4, 1.2, 2.0, "page"]],
+        "time_scale": 1.0, "eval_period_s": 0.05,
+    }
     config = FleetConfig(
         models={"a": dirs["a"], "b": dirs["b"], "c": dirs["c"]},
         tenants={"gold": {"priority": 1}, "trial": {"priority": 0}},
         serving={"max_batch": _MAX_BATCH, "batch_wait_ms": 1.0,
                  "max_queue": 256},
-        resilience=resilience)
-    report: Dict[str, Any] = {"resilience_params": resilience}
+        resilience=resilience, slo=slo)
+    if flight_dir:
+        flight.get_recorder().configure(dump_dir=flight_dir,
+                                        min_interval_s=0.0)
+    report: Dict[str, Any] = {"resilience_params": resilience,
+                              "slo_params": slo}
     with TRACER.span("run:chaos", category="run", new_trace=True) as root:
         fleet = FleetService(config).start()
         try:
@@ -221,18 +293,42 @@ def run_chaos(dirs: Dict[str, str], seed: int = 0,
             storm = FaultPlan(
                 [FaultSpec(site=f"{SITE_DEVICE_DISPATCH}#a", at=1,
                            times=8, kind="error")], seed=seed)
+            dumps_before = len(flight.get_recorder().dumps)
             t_storm = time.perf_counter()
             with storm.active():
+                slo_fired = _wait_slo(fleet, "gold-availability",
+                                      firing=True, timeout_s=10.0)
+                slo_alert_s = (time.perf_counter() - t_storm
+                               if slo_fired else None)
                 quarantined = _wait_state(fleet, "a", "quarantined",
                                           timeout_s=10.0)
                 recovered = _wait_state(fleet, "a", "healthy",
                                         timeout_s=15.0)
             recovery_wall = time.perf_counter() - t_storm
-            time.sleep(max(0.2, load_s - recovery_wall - 0.4))
+            # the alert must CLEAR after recovery: healthy traffic keeps
+            # flowing while the bad samples age out of the burn windows
+            t_clear0 = time.perf_counter()
+            slo_cleared = _wait_slo(fleet, "gold-availability",
+                                    firing=False, timeout_s=15.0)
+            slo_clear_s = (time.perf_counter() - t_clear0
+                           if slo_cleared else None)
+            elapsed = time.perf_counter() - t_storm
+            time.sleep(max(0.2, load_s - elapsed - 0.4))
             for c in clients:
                 c.stop()
             for c in clients:
                 c.join(timeout=5)
+            report["slo"] = {
+                "fired": slo_fired, "cleared": slo_cleared,
+                "alert_s": (round(slo_alert_s, 4)
+                            if slo_alert_s is not None else None),
+                "clear_s": (round(slo_clear_s, 4)
+                            if slo_clear_s is not None else None),
+                "status": (fleet.slo_engine.status()["slos"]
+                           ["gold-availability"]
+                           if fleet.slo_engine else None),
+            }
+            report["flight"] = _flight_proof(dumps_before)
             a_health = fleet.models()["a"]["health"]
             member_a = fleet._services["a"]
             fallback_series = member_a.registry.to_json().get(
@@ -302,6 +398,7 @@ def run_chaos(dirs: Dict[str, str], seed: int = 0,
             fleet.stop()
     gp = build_report(root, TRACER.trace_spans(root.trace_id)).to_json()
     report["goodput_resilience"] = gp.get("resilience") or {}
+    report["goodput_slo"] = gp.get("slo") or {}
     return report
 
 
@@ -445,7 +542,8 @@ def main() -> int:  # noqa: C901 (one linear acceptance script)
     os.environ.setdefault("TRANSMOGRIFAI_PERF_MODEL", "0")
     with tempfile.TemporaryDirectory(prefix="chaos-smoke-") as tmp:
         dirs = _train_models(tmp)
-        report = run_chaos(dirs, seed=0)
+        report = run_chaos(dirs, seed=0,
+                           flight_dir=os.path.join(tmp, "flight"))
         try:
             storm = report["storm"]
             assert storm["quarantined"] and storm["recovered"], \
@@ -481,6 +579,20 @@ def main() -> int:  # noqa: C901 (one linear acceptance script)
             gp = report["goodput_resilience"]
             assert gp.get("breaker_opens", 0) >= 1 \
                 and gp.get("recoveries", 0) >= 1, gp
+            slo = report["slo"]
+            assert slo["fired"] and slo["cleared"], \
+                f"SLO alert did not fire-then-clear: {slo}"
+            assert slo["alert_s"] is not None and slo["alert_s"] < 10, slo
+            fl = report["flight"]
+            assert fl["breaker_dump"], \
+                f"breaker open produced no flight dump: {fl}"
+            assert fl.get("valid_chrome_trace"), \
+                f"flight dump is not a valid Chrome trace: {fl}"
+            assert fl.get("failing_dispatch_spans", 0) >= 1, \
+                f"flight dump has no failing dispatch spans: {fl}"
+            gslo = report["goodput_slo"]
+            assert gslo.get("alerts_fired", 0) >= 1 \
+                and gslo.get("alerts_resolved", 0) >= 1, gslo
         except AssertionError as e:
             print(f"chaos-smoke FAILED: {e}", file=sys.stderr)
             return 1
@@ -496,7 +608,11 @@ def main() -> int:  # noqa: C901 (one linear acceptance script)
           f"({report['kill']['answer']}); stall answered in "
           f"{report['stall']['answered_in_s']}s (budget "
           f"{report['stall']['stall_budget_s']}s); corrupt reload "
-          f"rejected with resident version serving")
+          f"rejected with resident version serving; SLO alert fired in "
+          f"{report['slo']['alert_s']}s and cleared in "
+          f"{report['slo']['clear_s']}s; breaker flight dump valid with "
+          f"{report['flight']['failing_dispatch_spans']} failing "
+          f"dispatch span(s)")
     return 0
 
 

@@ -53,6 +53,7 @@ lock-guarded.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import logging
@@ -64,7 +65,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from transmogrifai_tpu.obs.metrics import MetricsRegistry
-from transmogrifai_tpu.obs.trace import TRACER
+from transmogrifai_tpu.obs.trace import (
+    TRACER, RequestTrace, TailSampler, TraceContext, TracingParams)
 from transmogrifai_tpu.serving.batcher import ScoreError
 from transmogrifai_tpu.serving.router import Router, TenantPolicy
 from transmogrifai_tpu.serving.service import ScoringService, ServingConfig
@@ -376,10 +378,15 @@ class FleetConfig:
     # member's health machine / breaker / watchdog (a member spec's
     # serving overrides may still pin its own `resilience` block)
     resilience: Optional[Dict[str, Any]] = None
+    # obs/slo.SLOParams JSON evaluated over the FLEET registry: per-
+    # tenant/per-model availability + latency objectives judged from
+    # the labeled fleet_* series (member-level SLOs go in a member's
+    # own serving config instead)
+    slo: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("models", "tenants", "default_tenant", "shed_watermark",
                "serving", "compile_cache", "compile_cache_dir",
-               "resilience")
+               "resilience", "slo")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "FleetConfig":
@@ -453,9 +460,60 @@ class FleetService:
         self._m_shared = self.registry.gauge(
             "fleet_shared_signatures",
             "distinct compiled bucket-program sets across all models")
+        # fleet-level request tracing: admission (router) spans + the
+        # sampler that judges admission-shed traces (requests that never
+        # reach a member service); members sample their own
+        self.tracing = TracingParams.from_json(
+            (self.config.serving or {}).get("tracing"))
+        self.sampler: Optional[TailSampler] = (
+            TailSampler(self.tracing, registry=self.registry)
+            if self.tracing.enabled else None)
+        # fleet-level SLO engine over the labeled fleet_* series
+        self.slo_engine = None
+        if self.config.slo and dict(self.config.slo).get("enabled", True):
+            self._build_slo_engine()
         for name, spec in (self.config.models or {}).items():
             path, overrides = _model_spec(spec)
             self.add_model(name, path, overrides)
+
+    def _build_slo_engine(self) -> None:
+        """Per-tenant/per-model SLOs judged from the fleet registry's
+        labeled series: availability from fleet_requests/errors/shed,
+        latency from the per-tenant latency histogram, staleness from
+        the continual freshness gauge on the process registry."""
+        from transmogrifai_tpu.obs.metrics import get_registry
+        from transmogrifai_tpu.obs.slo import (
+            SLOEngine, SLOParams, availability_source, latency_source,
+            staleness_source)
+        params = SLOParams.from_json(self.config.slo)
+        engine = SLOEngine(params, registry=self.registry)
+        for slo in engine.slos():
+            if slo.kind == "availability":
+                # the error/shed families carry a tenant label but no
+                # model label, so availability SLOs scope by TENANT
+                # (a model-scoped availability needs per-member SLOs
+                # on that member's own serving config).
+                # fleet_requests_total ticks in Router.note_success —
+                # SUCCESSES only — so the source must build the
+                # denominator as successes+errors+sheds, or a total
+                # outage (no successes) would zero the window and
+                # never fire
+                scope = {"tenant": slo.tenant} if slo.tenant else {}
+                engine.set_source(slo.name, availability_source(
+                    self.registry, "fleet_requests_total",
+                    error_families=("fleet_errors_total",),
+                    shed_families=("fleet_shed_total",),
+                    requests_count="successes", **scope))
+            elif slo.kind == "latency":
+                engine.set_source(slo.name, latency_source(
+                    self.registry, "fleet_request_latency_seconds",
+                    slo.threshold_s,
+                    **({"tenant": slo.tenant} if slo.tenant else {})))
+            elif slo.kind == "staleness":
+                engine.set_source(slo.name, staleness_source(
+                    get_registry(), "continual_staleness_current_seconds",
+                    slo.threshold_s))
+        self.slo_engine = engine
 
     # -- membership -------------------------------------------------------- #
 
@@ -561,9 +619,16 @@ class FleetService:
             svc.start()
         if self.watchdog is not None:
             self.watchdog.start()
+        if self.slo_engine is not None:
+            # alert events attach to the caller's span (chaos/bench run
+            # roots): the engine thread has no ambient span of its own
+            self.slo_engine.span = TRACER.current()
+            self.slo_engine.start()
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         if self.watchdog is not None:
             self.watchdog.stop()
         with self._lock:
@@ -583,21 +648,47 @@ class FleetService:
 
     def score(self, model: str, rows: List[Dict[str, Any]],
               tenant: Optional[str] = None,
-              deadline_ms: Optional[float] = None):
+              deadline_ms: Optional[float] = None,
+              trace: Optional[TraceContext] = None):
         """Route one request: resolve the model, pass tenant admission
         (token-bucket quota + priority shedding against the target
         model's queue pressure), then score through that model's own
         micro-batcher. Per-tenant accounting happens here so every
-        member's latency lands in the tenant's labeled series."""
+        member's latency lands in the tenant's labeled series.
+
+        The request trace OPENS here (not in the member), so router
+        admission is its first phase child and an admission-shed
+        request still leaves a kept trace (sheds are errors to the
+        tail sampler)."""
         svc = self._service(model)
-        queue_frac = svc._batcher.depth() / max(1, svc.config.max_queue)
-        tname = self.router.admit(tenant, len(rows or ()), queue_frac,
-                                  model=model)
+        rt: Optional[RequestTrace] = None
+        if self.sampler is not None and svc.sampler is not None:
+            rt = RequestTrace(ctx=trace, rows=len(rows or ()),
+                              tenant=tenant or "default", model=model)
         t0 = time.monotonic()
+        try:
+            admission = (rt.child("serving:admission", model=model)
+                         if rt is not None else contextlib.nullcontext())
+            with admission:
+                queue_frac = svc._batcher.depth() / max(
+                    1, svc.config.max_queue)
+                tname = self.router.admit(tenant, len(rows or ()),
+                                          queue_frac, model=model)
+        except ScoreError as e:
+            # admission shed: the member never saw this request, so the
+            # fleet finishes + samples the trace itself (always kept)
+            if rt is not None:
+                rt.finish(e.code)
+                self.sampler.observe(rt, time.monotonic() - t0,
+                                     error=True)
+            raise
         with TRACER.span("fleet:score", category="serving",
                          tenant=tname, model=model):
             try:
-                result = svc.score(rows, deadline_ms=deadline_ms)
+                # the member's score() owns the trace from here: phase
+                # children, finish, tail sampling, exemplars
+                result = svc.score(rows, deadline_ms=deadline_ms,
+                                   trace=rt if rt is not None else trace)
             except ScoreError as e:
                 self.router.note_error(tname, model, e.code)
                 raise

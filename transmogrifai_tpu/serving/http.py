@@ -17,13 +17,24 @@ Endpoints:
   ``Retry-After`` header derived from the token-bucket refill or
   breaker half-open deadline, so well-behaved clients back off
   instead of hammering a tripped member.
+
+  A W3C ``traceparent`` request header joins the caller's distributed
+  trace (sampled=01 contexts are force-kept past tail sampling); with
+  no header a fresh trace id is minted. Either way the response echoes
+  ``traceparent`` (+ ``X-Trace-Id``) naming the request's own root
+  span, so "this exact slow response" is greppable in the exported
+  trace and in the histogram exemplars.
 - ``GET /healthz``  liveness + active version + queue depth + the
   member's resilience health state; a quarantined/down service answers
   503 with ``Retry-After``.
 - ``GET /metrics``  Prometheus text (default) or JSON with
   ``?format=json``.
+- ``GET /slo``      the SLO engine's burn-rate/alert status (404 when
+  no SLOs are configured).
 - ``POST /reload``  ``{"model_location": "dir"}`` hot-swap, or
   ``{"rollback": true}`` to restore the previous version.
+- ``POST /debug/dump``  on-demand crash-flight-recorder dump; returns
+  the committed artifact path.
 
 The FLEET frontend (``serve_fleet`` / `_FleetHandler`) serves the
 multi-model process (`serving/fleet.py`): ``/score`` takes a ``model``
@@ -41,6 +52,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+from transmogrifai_tpu.obs.trace import TraceContext
 from transmogrifai_tpu.serving.batcher import ScoreError
 from transmogrifai_tpu.serving.service import ScoringService
 
@@ -136,13 +148,19 @@ class _JSONHandler(BaseHTTPRequestHandler):
         """Structured-error response. 429/503 answers carry a
         ``Retry-After`` header (delta-seconds, ceil'd so a sub-second
         hint still tells a well-behaved client to wait ~1s) derived
-        from the token-bucket refill or breaker half-open deadline."""
+        from the token-bucket refill or breaker half-open deadline.
+        Errors that left a kept trace behind (tail sampling always
+        keeps them) echo its ``traceparent``/``X-Trace-Id`` too — a
+        failed request must be as correlatable as a slow one."""
         status = _ERROR_STATUS.get(e.code, 500)
-        headers = None
+        headers: Dict[str, str] = {}
         if status in (429, 503):
-            headers = {"Retry-After": _retry_after_header(
-                getattr(e, "retry_after_s", None))}
-        self._send_json(status, e.to_json(), headers=headers)
+            headers["Retry-After"] = _retry_after_header(
+                getattr(e, "retry_after_s", None))
+        if getattr(e, "traceparent", None):
+            headers["traceparent"] = e.traceparent
+            headers["X-Trace-Id"] = e.trace_id
+        self._send_json(status, e.to_json(), headers=headers or None)
 
     def _send_health(self, health: Dict[str, Any]) -> None:
         """/healthz: 200 only when fully healthy; degraded fleets stay
@@ -169,6 +187,40 @@ class _JSONHandler(BaseHTTPRequestHandler):
             raise ScoreError("bad_request", "body must be a JSON object")
         return body
 
+    def _trace_ctx(self) -> Optional[TraceContext]:
+        """The caller's W3C trace context, when a valid ``traceparent``
+        header came in (malformed headers are ignored per spec, not
+        400'd)."""
+        return TraceContext.from_traceparent(
+            self.headers.get("traceparent"))
+
+    @staticmethod
+    def _trace_headers(result) -> Optional[Dict[str, str]]:
+        """Response-side trace echo: the request's trace id (as both
+        the raw id and a spec-shaped traceparent naming the request's
+        root span) — None when tracing is off."""
+        tid = getattr(result, "trace_id", None)
+        if not tid:
+            return None
+        return {"traceparent": result.traceparent, "X-Trace-Id": tid}
+
+    def _send_slo(self, engine) -> None:
+        if engine is None:
+            self._send_json(404, {
+                "error": "not_found",
+                "message": "no SLOs configured (serving config `slo`)"})
+            return
+        self._send_json(200, engine.status())
+
+    def _debug_dump(self) -> None:
+        from transmogrifai_tpu.obs import flight
+        path = flight.request_dump("debug", force=True)
+        if path is None:
+            self._send_json(500, {"error": "internal",
+                                  "message": "flight dump failed"})
+            return
+        self._send_json(200, {"status": "dumped", "path": path})
+
 
 class _Handler(_JSONHandler):
 
@@ -184,6 +236,8 @@ class _Handler(_JSONHandler):
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             self._send_health(self.service.health())
+        elif path == "/slo":
+            self._send_slo(self.service.slo_engine)
         elif path == "/metrics":
             if "format=json" in query:
                 self._send_json(200, metrics_json(self.service))
@@ -203,6 +257,8 @@ class _Handler(_JSONHandler):
                 self._score(body)
             elif path == "/reload":
                 self._reload(body)
+            elif path == "/debug/dump":
+                self._debug_dump()
             else:
                 self._send_json(404, {"error": "not_found",
                                       "message": f"no route {path}"})
@@ -222,12 +278,14 @@ class _Handler(_JSONHandler):
             raise ScoreError("bad_request",
                              'expected {"rows": [{...}, ...]}')
         result = self.service.score(rows,
-                                    deadline_ms=body.get("deadline_ms"))
+                                    deadline_ms=body.get("deadline_ms"),
+                                    trace=self._trace_ctx())
         self._send_json(200, {
             "scores": result.rows(),
             "model_version": result.model_version,
             "latency_ms": round(result.latency_s * 1000.0, 3),
-        })
+            "trace_id": result.trace_id,
+        }, headers=self._trace_headers(result))
 
     def _reload(self, body: Dict[str, Any]) -> None:
         if body.get("rollback"):
@@ -323,6 +381,8 @@ class _FleetHandler(_JSONHandler):
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             self._send_health(self.fleet.health())
+        elif path == "/slo":
+            self._send_slo(self.fleet.slo_engine)
         elif path == "/models":
             self._send_json(200, {"models": self.fleet.models()})
         elif path == "/metrics":
@@ -343,6 +403,8 @@ class _FleetHandler(_JSONHandler):
                 self._score(body)
             elif path == "/reload":
                 self._reload(body)
+            elif path == "/debug/dump":
+                self._debug_dump()
             else:
                 self._send_json(404, {"error": "not_found",
                                       "message": f"no route {path}"})
@@ -367,13 +429,15 @@ class _FleetHandler(_JSONHandler):
                              'expected {"rows": [{...}, ...]}')
         tenant = body.get("tenant") or self.headers.get("X-Tenant")
         result = self.fleet.score(str(model), rows, tenant=tenant,
-                                  deadline_ms=body.get("deadline_ms"))
+                                  deadline_ms=body.get("deadline_ms"),
+                                  trace=self._trace_ctx())
         self._send_json(200, {
             "scores": result.rows(),
             "model": model,
             "model_version": result.model_version,
             "latency_ms": round(result.latency_s * 1000.0, 3),
-        })
+            "trace_id": result.trace_id,
+        }, headers=self._trace_headers(result))
 
     def _reload(self, body: Dict[str, Any]) -> None:
         model = body.get("model")

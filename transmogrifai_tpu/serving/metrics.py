@@ -1,17 +1,26 @@
-"""Compatibility re-export: the metrics registry moved to `obs/metrics.py`.
+"""DEPRECATED compatibility re-export: the registry lives in
+`obs/metrics.py`.
 
 Serving grew the Counter/Gauge/Histogram registry first; once train-time
 ingest, retries, and fit counters wanted the same `/metrics` surface it
 was promoted to the cross-cutting `obs/` package (single process-wide
-`REGISTRY`, Prometheus label escaping). Import from
-`transmogrifai_tpu.obs.metrics` in new code; this module keeps every
-existing `serving.metrics` import path working.
-"""
+`REGISTRY`, Prometheus label escaping, trace-id exemplars). Every
+in-repo importer has been migrated to `transmogrifai_tpu.obs.metrics`;
+this shim remains for external callers and now says so out loud — a
+`DeprecationWarning` on import (one shim test pins the contract:
+identical objects, warning emitted)."""
+
+import warnings
 
 from transmogrifai_tpu.obs.metrics import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS, REGISTRY, Counter, Gauge, Histogram,
     MetricsRegistry, get_registry, _escape_help, _escape_label_value,
     _fmt_labels, _label_key)
+
+warnings.warn(
+    "transmogrifai_tpu.serving.metrics is deprecated; import from "
+    "transmogrifai_tpu.obs.metrics instead",
+    DeprecationWarning, stacklevel=2)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS", "REGISTRY", "get_registry"]

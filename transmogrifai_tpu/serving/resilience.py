@@ -139,6 +139,18 @@ def _record_event(name: str, **attrs: Any) -> None:
                   exc_info=True)
 
 
+def _flight_dump(reason: str) -> None:
+    """Best-effort crash-flight-recorder dump on an incident transition
+    (breaker open, quarantine entry): the ring holds the failing
+    dispatch spans that caused it — capture them before they scroll
+    out. Debounced inside the recorder, never raises."""
+    try:
+        from transmogrifai_tpu.obs import flight
+        flight.request_dump(reason)
+    except Exception:
+        log.debug("flight dump (%s) failed", reason, exc_info=True)
+
+
 class MemberHealth:
     """One member's health state machine + circuit breaker. Thread-safe:
     noted from the scoring thread, read from caller threads and the
@@ -313,6 +325,7 @@ class MemberHealth:
                       "circuit breakers tripped open").inc()
         _record_event("breaker_open", member=self.member,
                       consecutive_failures=self._consecutive)
+        _flight_dump("breaker_open")
         log.warning("serving%s: circuit breaker OPEN after %d consecutive "
                     "dispatch failures",
                     f"[{self.member}]" if self.member else "",
@@ -381,6 +394,8 @@ class MemberHealth:
             "health state-machine transitions").inc()
         _record_event("health_transition", member=self.member,
                       **{k: v for k, v in entry.items() if k != "at"})
+        if target == QUARANTINED:
+            _flight_dump("quarantine")
         log.log(logging.WARNING if target == QUARANTINED else logging.INFO,
                 "serving%s: health %s -> %s (%s)",
                 f"[{self.member}]" if self.member else "", prev, target,

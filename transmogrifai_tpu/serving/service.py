@@ -39,7 +39,8 @@ import numpy as np
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.data.dataset import Dataset
 from transmogrifai_tpu.obs.metrics import MetricsRegistry
-from transmogrifai_tpu.obs.trace import TRACER
+from transmogrifai_tpu.obs.trace import (
+    TRACER, RequestTrace, TailSampler, TraceContext, TracingParams, now_s)
 from transmogrifai_tpu.runtime.faults import (
     SITE_BATCH_ASSEMBLE, SITE_DEVICE_DISPATCH, SITE_RELOAD_LOAD,
     fault_point)
@@ -106,6 +107,24 @@ class ServingConfig:
     # f32 scoring. Folded into the fleet's program-sharing signature, so
     # quantized and f32 members never adopt each other's programs.
     quantize: Optional[str] = None
+    # request-scoped tracing + tail sampling (obs/trace.TracingParams
+    # JSON): every /score request gets a span tree (W3C traceparent
+    # honored + echoed, parse/queue-wait/pad/dispatch/demux phase
+    # children, serving_phase_seconds histograms with trace-id
+    # exemplars); the tail sampler keeps errors + the slow tail and
+    # head-samples the healthy majority. None = defaults (ON);
+    # {"enabled": false} turns request tracing off.
+    tracing: Optional[Dict[str, Any]] = None
+    # SLO burn-rate engine (obs/slo.SLOParams JSON): declarative
+    # availability/latency/staleness objectives evaluated over this
+    # service's registry with multi-window multi-burn-rate alerting,
+    # surfaced on /slo + slo_* gauges + slo_alert events. None = off
+    # (opt-in: an SLO without an operator reading it is noise).
+    slo: Optional[Dict[str, Any]] = None
+    # crash flight recorder (obs/flight.py): {"enabled": bool, "dir":
+    # str, "capacity": int, "min_interval_s": float}. None = enabled
+    # with defaults — serving processes should always have a black box.
+    flight: Optional[Dict[str, Any]] = None
 
     def ladder(self) -> Tuple[int, ...]:
         if self.buckets:
@@ -203,6 +222,11 @@ class ScoreResult:
     model_version: str
     n_rows: int = 0
     latency_s: float = 0.0
+    # request-scoped trace correlation (set when tracing is on): the
+    # trace id this request's spans carry and the W3C traceparent echo
+    # the HTTP layer returns as a response header
+    trace_id: Optional[str] = None
+    traceparent: Optional[str] = None
 
     def rows(self) -> List[Dict[str, Any]]:
         """Row-dict view of the outputs (the `/score` JSON shape),
@@ -285,6 +309,27 @@ class ScoringService:
         self._started_mono = time.monotonic()  # uptime arithmetic (L009)
         self._trace_parent = None  # span the batcher thread nests under
         self._schema: Dict[str, type] = {}
+        # request-scoped tracing: per-request span trees + tail sampling
+        # (obs/trace.py). ON by default — the cost is a few Span objects
+        # per request and the sampler keeps the process ring bounded.
+        self.tracing = TracingParams.from_json(self.config.tracing)
+        self.sampler: Optional[TailSampler] = (
+            TailSampler(self.tracing, registry=self.registry)
+            if self.tracing.enabled else None)
+        # crash flight recorder: ring always armed for serving processes
+        # (the serving plane is exactly where a post-mortem matters);
+        # {"enabled": false} opts out, dir/capacity/debounce overridable
+        flight_cfg = dict(self.config.flight or {})
+        if flight_cfg.get("enabled", True):
+            from transmogrifai_tpu.obs import flight
+            flight.enable(
+                dump_dir=flight_cfg.get("dir"),
+                capacity=flight_cfg.get("capacity"),
+                min_interval_s=flight_cfg.get("min_interval_s"))
+        # SLO burn-rate engine (opt-in via config.slo)
+        self.slo_engine = None
+        if self.config.slo and dict(self.config.slo).get("enabled", True):
+            self._build_slo_engine()
         # observed request-size distribution (rows per request): the
         # sample `derive_ladder` shapes the bucket ladder from
         self._sizes: deque = deque(maxlen=4096)
@@ -351,11 +396,91 @@ class ScoringService:
         self._m_batch_lat = r.histogram(
             "serving_batch_latency_seconds",
             "device batch execution latency")
+        self._phase_hists = {
+            phase: r.histogram(
+                "serving_phase_seconds",
+                "per-request time spent in each serving phase",
+                phase=phase)
+            for phase in self._PHASES}
 
     def _shed(self, reason: str):
         return self.registry.counter(
             "serving_shed_total", "requests shed under overload",
             reason=reason)
+
+    def _build_slo_engine(self) -> None:
+        """Wire the declarative SLOs (obs/slo.py) onto this service's
+        own registry: availability from the request/error/shed
+        counters, latency from the request-latency histogram,
+        staleness from the continual loop's freshness gauge on the
+        process registry."""
+        from transmogrifai_tpu.obs.metrics import get_registry
+        from transmogrifai_tpu.obs.slo import (
+            SLOEngine, SLOParams, availability_source, latency_source,
+            staleness_source)
+        params = SLOParams.from_json(self.config.slo)
+        engine = SLOEngine(params, registry=self.registry)
+        for slo in engine.slos():
+            if slo.kind == "availability":
+                engine.set_source(slo.name, availability_source(
+                    self.registry, "serving_requests_total",
+                    error_families=("serving_errors_total",),
+                    shed_families=("serving_shed_total",)))
+            elif slo.kind == "latency":
+                engine.set_source(slo.name, latency_source(
+                    self.registry, "serving_request_latency_seconds",
+                    slo.threshold_s))
+            elif slo.kind == "staleness":
+                engine.set_source(slo.name, staleness_source(
+                    get_registry(), "continual_staleness_current_seconds",
+                    slo.threshold_s))
+        self.slo_engine = engine
+
+    # the closed phase-label set (span names are `serving:<phase>`);
+    # request-derived values never become labels
+    _PHASES = ("parse", "assemble", "queue_wait", "pad",
+               "device_dispatch", "demux", "admission")
+
+    def _phase_hist(self, phase: str):
+        """The labeled per-phase latency family (`serving_phase_seconds
+        {phase=...}`). The fixed set is pre-bound at init (the
+        `_init_metrics` convention) so the per-request finish path
+        never takes the registry lock; an unexpected phase still
+        resolves through the registry rather than dropping data."""
+        hist = self._phase_hists.get(phase)
+        if hist is None:
+            hist = self.registry.histogram(
+                "serving_phase_seconds",
+                "per-request time spent in each serving phase",
+                phase=phase)
+            self._phase_hists[phase] = hist
+        return hist
+
+    def _finish_request_trace(self, rt: Optional[RequestTrace],
+                              latency_s: float,
+                              error: Optional[str] = None) -> None:
+        """Request-trace epilogue on EVERY exit path (success, shed,
+        deadline, scoring error): end the root, run the tail-sampling
+        decision, and on keep record the phase histograms with this
+        trace's id pinned as the bucket exemplar (exemplars must point
+        at traces that EXIST — a dropped trace id would 404)."""
+        if rt is None:
+            if error is None:
+                self._m_latency.observe(latency_s)
+            return
+        rt.finish(error)
+        kept = False
+        if self.sampler is not None:
+            kept = self.sampler.observe(rt, latency_s,
+                                        error=error is not None)
+        exemplar = rt.trace_id if kept else None
+        for phase, dur in rt.phase_durations().items():
+            self._phase_hist(phase).observe(dur, exemplar=exemplar)
+        if error is None:
+            # the request-latency family has always counted SUCCESSFUL
+            # resolves only; the kept trace's id rides along as the
+            # exemplar on whichever bucket this latency landed in
+            self._m_latency.observe(latency_s, exemplar=exemplar)
 
     def _install(self, model, version_id: str,
                  path: Optional[str] = None) -> ModelVersion:
@@ -472,6 +597,11 @@ class ScoringService:
                 lambda: {"service": self},
                 period_s=self.resilience.watchdog_period_s)
             self._watchdog.start()
+        if self.slo_engine is not None:
+            # alert events attach to the span that started the service
+            # (the engine thread has no ambient span of its own)
+            self.slo_engine.span = self._trace_parent
+            self.slo_engine.start()
         return self
 
     def _start_scoring_thread(self) -> None:
@@ -483,6 +613,8 @@ class ScoringService:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._running = False
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
@@ -576,6 +708,14 @@ class ScoringService:
                              member=self.fault_scope or "service")
             except Exception:
                 log.debug("watchdog_restart event failed", exc_info=True)
+            # black box: the ring holds the batches that led up to the
+            # wedge/death — dump it before the evidence scrolls away
+            try:
+                from transmogrifai_tpu.obs import flight
+                flight.request_dump(f"watchdog_{reason}")
+            except Exception:
+                log.debug("flight dump on watchdog restart failed",
+                          exc_info=True)
             log.warning("serving%s: scoring loop %s; restarting thread "
                         "(generation %d)",
                         f"[{self.fault_scope}]" if self.fault_scope
@@ -593,13 +733,63 @@ class ScoringService:
 
     # -- client API -------------------------------------------------------- #
 
+    def _begin_request_trace(self, trace: Any,
+                             n_rows: int) -> Optional[RequestTrace]:
+        """The request's span buffer: an incoming `RequestTrace` (the
+        fleet router already opened it around admission) passes
+        through; a `TraceContext` (W3C wire context or an in-process
+        parent span, e.g. a continual cycle) roots the request under
+        the caller's trace; None mints a fresh trace id."""
+        if isinstance(trace, RequestTrace):
+            return trace
+        if self.sampler is None:
+            return None
+        ctx = trace if isinstance(trace, TraceContext) else None
+        return RequestTrace(ctx=ctx, rows=n_rows,
+                            member=self.fault_scope or "service")
+
     def score(self, rows: List[Dict[str, Any]],
               deadline_ms: Optional[float] = None,
-              timeout_s: Optional[float] = None) -> ScoreResult:
+              timeout_s: Optional[float] = None,
+              trace: Any = None) -> ScoreResult:
         """Score `rows` (list of raw-column dicts). Blocks until the
         micro-batcher resolves this request or its deadline passes.
         Raises ScoreError with a structured code on shed/expiry/bad
-        input — the service keeps serving others regardless."""
+        input — the service keeps serving others regardless.
+
+        `trace` carries request-scoped trace context (an
+        `obs.trace.TraceContext` from a ``traceparent`` header or an
+        in-process parent span, or a pre-opened `RequestTrace`); every
+        exit path — success, shed, deadline, error — finishes the
+        request's span tree and runs it through the tail sampler."""
+        rt = self._begin_request_trace(trace, len(rows or ()))
+        t0 = time.monotonic()
+        try:
+            result = self._score_inner(rows, deadline_ms, timeout_s, rt)
+        except ScoreError as e:
+            self._finish_request_trace(rt, time.monotonic() - t0,
+                                       error=e.code)
+            if rt is not None:
+                # error traces are the ones tail sampling ALWAYS keeps:
+                # the failed response must carry the id a client needs
+                # to find them (HTTP echoes it as headers + body field)
+                e.trace_id = rt.trace_id
+                e.traceparent = rt.traceparent()
+            raise
+        except BaseException as e:
+            self._finish_request_trace(rt, time.monotonic() - t0,
+                                       error=type(e).__name__)
+            raise
+        self._finish_request_trace(rt, result.latency_s)
+        if rt is not None:
+            result.trace_id = rt.trace_id
+            result.traceparent = rt.traceparent()
+        return result
+
+    def _score_inner(self, rows: List[Dict[str, Any]],
+                     deadline_ms: Optional[float],
+                     timeout_s: Optional[float],
+                     rt: Optional[RequestTrace]) -> ScoreResult:
         if not self._running:
             raise ScoreError("shutdown", "service is not running")
         if self._health is not None:
@@ -616,13 +806,19 @@ class ScoringService:
                     retry_after_s=retry_after)
         if not rows:
             raise ScoreError("bad_request", "empty rows")
-        try:
-            ds = Dataset.from_rows(
-                rows, schema={k: v for k, v in self._schema.items()
-                              if k in rows[0]})
-        except Exception as e:
-            raise ScoreError("bad_request", f"unparseable rows: {e}")
-        bucket_for(len(ds), self.ladder)  # admission: must fit a bucket
+        # request assembly on the caller thread, with the host-side row
+        # parse (Dataset.from_rows — the serving-p50 cost ROADMAP calls
+        # out) as its own timed child so a latency regression here is
+        # attributable per request
+        if rt is not None:
+            with rt.child("serving:assemble") as asm:
+                with rt.child("serving:parse", parent=asm,
+                              rows=len(rows)):
+                    ds = self._parse_rows(rows)
+                bucket_for(len(ds), self.ladder)  # must fit a bucket
+        else:
+            ds = self._parse_rows(rows)
+            bucket_for(len(ds), self.ladder)  # admission: must fit a bucket
         if deadline_ms is None:
             ddl_ms = self.config.default_deadline_ms
         else:
@@ -635,7 +831,9 @@ class ScoringService:
         deadline = (time.monotonic() + ddl_ms / 1000.0) if ddl_ms > 0 \
             else None
         self._sizes.append(len(ds))
-        req = Request(ds, deadline)
+        req = Request(ds, deadline, trace=rt)
+        if rt is not None:
+            rt.enqueued_s = now_s()  # queue-wait span starts here
         try:
             self._batcher.put(req)
         except ScoreError as e:
@@ -647,9 +845,16 @@ class ScoringService:
             ddl_ms / 1000.0 + 30.0 if ddl_ms > 0 else None)
         outputs, version = req.wait(wait_s)
         latency = time.monotonic() - req.enqueued_at
-        self._m_latency.observe(latency)
         return ScoreResult(outputs=outputs, model_version=version,
                            n_rows=req.n_rows, latency_s=latency)
+
+    def _parse_rows(self, rows: List[Dict[str, Any]]) -> Dataset:
+        try:
+            return Dataset.from_rows(
+                rows, schema={k: v for k, v in self._schema.items()
+                              if k in rows[0]})
+        except Exception as e:
+            raise ScoreError("bad_request", f"unparseable rows: {e}")
 
     def score_row(self, row: Dict[str, Any], **kw) -> Dict[str, Any]:
         """Single-row convenience: returns the one result row dict."""
@@ -926,10 +1131,23 @@ class ScoringService:
         a generation it no longer belongs to."""
         return gen is None or self._generation == gen
 
+    def _queue_wait_spans(self, batch: List[Request],
+                          t_end: float) -> List[Request]:
+        """Backdate one ``serving:queue_wait`` child per traced request
+        (enqueue tick → batch pickup) and return the traced subset."""
+        traced = [r for r in batch if r.trace is not None]
+        for r in traced:
+            if r.trace.enqueued_s is not None:
+                r.trace.child_at("serving:queue_wait",
+                                 r.trace.enqueued_s, t_end)
+        return traced
+
     def _process(self, batch: List[Request],
                  gen: Optional[int] = None) -> None:
         version, mode = self._dispatch_plan()
         assert version is not None
+        t_pickup = now_s()
+        traced = self._queue_wait_spans(batch, t_pickup)
         if mode == "reject":
             retry_after = self._health.retry_after_s() if self._health \
                 else None
@@ -952,7 +1170,9 @@ class ScoringService:
                 # batch — and it is NOT a device failure, so it feeds
                 # the health window but never the breaker
                 fault_point(self._fault_site(SITE_BATCH_ASSEMBLE))
+                t_pad0 = now_s()
                 ds, n_valid, bucket = pad_requests(batch, self.ladder)
+                t_pad1 = now_s()
                 sp.set(bucket=bucket, rows=n_valid)
             except Exception as e:
                 log.warning("serving: batch assembly of %d requests "
@@ -961,6 +1181,10 @@ class ScoringService:
                 for req in batch:
                     self._score_single(req, version, mode, gen)
                 return
+            for r in traced:
+                r.trace.child_at("serving:pad", t_pad0, t_pad1,
+                                 bucket=bucket, batch_rows=n_valid)
+            t_d0 = now_s()
             try:
                 if mode != "fallback":
                     # degraded fallback skips the site: the injected
@@ -969,6 +1193,16 @@ class ScoringService:
                     fault_point(self._fault_site(SITE_DEVICE_DISPATCH))
                 out = version.scorer.score_padded(ds, bucket)
             except Exception as e:
+                t_d1 = now_s()
+                for r in traced:
+                    # the failing dispatch is part of this request's
+                    # story (and the flight recorder's): the quarantine
+                    # re-score appends its own dispatch span after it
+                    r.trace.child_at(
+                        "serving:device_dispatch", t_d0, t_d1,
+                        error=f"{type(e).__name__}: {e}"[:200],
+                        bucket=bucket, mode=mode,
+                        version=version.version_id)
                 if self._live(gen):
                     self._note_dispatch(False, mode)
                 # error quarantine: one bad record must fail ONE
@@ -980,6 +1214,11 @@ class ScoringService:
                 for req in batch:
                     self._score_single(req, version, mode, gen)
                 return
+            t_d1 = now_s()
+            for r in traced:
+                r.trace.child_at("serving:device_dispatch", t_d0, t_d1,
+                                 bucket=bucket, mode=mode,
+                                 version=version.version_id)
             # success-path health notes stay INSIDE the batch span:
             # their events (breaker_close on a probe win, degraded_
             # fallback, health_transition) attach to this trace —
@@ -997,8 +1236,11 @@ class ScoringService:
             self._account_batch(len(batch), n_valid, bucket, latency)
         off = 0
         for req in batch:
+            t_x0 = now_s()
             sliced = {name: slice_result_tree(v, off, off + req.n_rows)
                       for name, v in out.items()}
+            if req.trace is not None:
+                req.trace.child_at("serving:demux", t_x0, now_s())
             req.resolve(sliced, version.version_id)
             off += req.n_rows
 
@@ -1027,11 +1269,17 @@ class ScoringService:
                       mode: str = "primary",
                       gen: Optional[int] = None) -> None:
         t0 = time.monotonic()
+        t_d0 = now_s()
         try:
             bucket = bucket_for(req.n_rows, self.ladder)
             if mode != "fallback":
                 fault_point(self._fault_site(SITE_DEVICE_DISPATCH))
             out = version.scorer.score_padded(req.dataset, bucket)
+            if req.trace is not None:
+                req.trace.child_at("serving:device_dispatch", t_d0,
+                                   now_s(), bucket=bucket, mode=mode,
+                                   quarantined=True,
+                                   version=version.version_id)
             latency = time.monotonic() - t0
             if self._live(gen):
                 self._note_dispatch(True, mode)
@@ -1051,6 +1299,11 @@ class ScoringService:
                                               time.monotonic() - t0)
             req.fail(e)
         except Exception as e:
+            if req.trace is not None:
+                req.trace.child_at(
+                    "serving:device_dispatch", t_d0, now_s(),
+                    error=f"{type(e).__name__}: {e}"[:200], mode=mode,
+                    quarantined=True, version=version.version_id)
             if self._live(gen):
                 self._note_dispatch(False, mode)
                 self._m_errors.inc()

@@ -90,12 +90,21 @@ class ServingParams:
     # the fused bucket programs (workflow/compiled.ScoringQuant; None =
     # exact f32 scoring)
     quantize: Optional[str] = None
+    # request-scoped tracing + tail sampling (obs/trace.TracingParams
+    # JSON; None = defaults, ON; {"enabled": false} disables)
+    tracing: Optional[Dict[str, Any]] = None
+    # SLO burn-rate engine (obs/slo.SLOParams JSON; None = off)
+    slo: Optional[Dict[str, Any]] = None
+    # crash flight recorder config ({"enabled", "dir", "capacity",
+    # "min_interval_s"}; None = enabled with defaults)
+    flight: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("host", "port", "max_batch", "min_bucket", "buckets",
                "max_queue", "batch_wait_ms", "default_deadline_ms",
                "warm_on_load", "keep_versions", "auto_ladder",
                "feature_cache", "compile_cache", "compile_cache_dir",
-               "warmup_manifest", "fleet", "resilience", "quantize")
+               "warmup_manifest", "fleet", "resilience", "quantize",
+               "tracing", "slo", "flight")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "ServingParams":
@@ -122,7 +131,10 @@ class ServingParams:
             compile_cache_dir=self.compile_cache_dir,
             warmup_manifest=self.warmup_manifest,
             resilience=self.resilience,
-            quantize=self.quantize)
+            quantize=self.quantize,
+            tracing=self.tracing,
+            slo=self.slo,
+            flight=self.flight)
 
     def to_fleet_config(self):
         """The serving.fleet.FleetConfig view of the `fleet` block, with
@@ -143,10 +155,16 @@ class ServingParams:
             "feature_cache": self.feature_cache,
             "warmup_manifest": self.warmup_manifest,
             **(block.pop("serving", None) or {})}
+        if self.tracing is not None:
+            serving.setdefault("tracing", self.tracing)
+        if self.flight is not None:
+            serving.setdefault("flight", self.flight)
         block.setdefault("compile_cache", self.compile_cache)
         block.setdefault("compile_cache_dir", self.compile_cache_dir)
         if self.resilience is not None:
             block.setdefault("resilience", self.resilience)
+        if self.slo is not None:
+            block.setdefault("slo", self.slo)
         return FleetConfig.from_json({**block, "serving": serving})
 
 
