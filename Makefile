@@ -17,7 +17,8 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 
 .PHONY: lint serve-smoke fleet-smoke chaos-smoke ingest-smoke \
 	faults-smoke trace-smoke cache-smoke multichip-smoke \
-	continual-smoke costmodel-smoke roofline-smoke slo-smoke test check
+	continual-smoke costmodel-smoke roofline-smoke slo-smoke \
+	parse-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -130,9 +131,20 @@ slo-smoke:
 costmodel-smoke:
 	$(PY) -m transmogrifai_tpu.perf.smoke
 
+# host-data-plane smoke: the compiled row codec is bit-identical to the
+# reference Dataset.from_rows on a hostile NaN/None/missing-key/big-int
+# /object schema, a warm service assembles batches by WRITING into the
+# resident staging buffers (zero fresh batch allocations across
+# sustained traffic, generation-fenced across swaps), and calibrated
+# int8 quantization scores the same rows bit-identically inside two
+# different batch compositions. See
+# transmogrifai_tpu/serving/parse_smoke.py.
+parse-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.parse_smoke
+
 test:
 	@$(TIER1)
 
-check: lint serve-smoke fleet-smoke chaos-smoke roofline-smoke \
-	ingest-smoke cache-smoke faults-smoke trace-smoke slo-smoke \
-	multichip-smoke continual-smoke costmodel-smoke test
+check: lint serve-smoke parse-smoke fleet-smoke chaos-smoke \
+	roofline-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
+	slo-smoke multichip-smoke continual-smoke costmodel-smoke test

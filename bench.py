@@ -1117,13 +1117,19 @@ def run_serving() -> None:
         _emit({"metric": "serve_setup_s", "platform": platform,
                "value": round(time.perf_counter() - t0, 2), "unit": "s",
                "vs_baseline": 0.0, "model_version": version})
-        # (max_batch, quantize, tracing): the extra (128, None, False)
-        # config is the tail-sampled-tracing overhead control — same
-        # ladder, tracing off — for the `serve_trace_overhead` emission
+        # (max_batch, quantize, tracing, wire): the extra
+        # (128, None, False, rows) config is the tail-sampled-tracing
+        # overhead control — same ladder, tracing off — for the
+        # `serve_trace_overhead` emission; the (128, None, True,
+        # columns) config drives the COLUMNAR request wire (callers
+        # that already hold columns skip the row pivot — its parse
+        # phase should read ~0 beside the row-wire configs')
         p99_by_config: dict = {}
-        for max_batch, quantize, tracing in (
-                (8, None, True), (32, None, True), (128, None, True),
-                (128, None, False), (128, "int8", True)):
+        for max_batch, quantize, tracing, wire in (
+                (8, None, True, "rows"), (32, None, True, "rows"),
+                (128, None, True, "rows"), (128, None, False, "rows"),
+                (128, "int8", True, "rows"),
+                (128, None, True, "columns")):
             if _remaining() < duration_s + 30.0:
                 _emit({"metric": "serve_skipped", "value": float(max_batch),
                        "unit": "config", "vs_baseline": 0.0,
@@ -1144,7 +1150,12 @@ def run_serving() -> None:
                     batch = [rows[int(j)] for j in
                              rng.integers(0, len(rows), size=k)]
                     try:
-                        svc.score(batch, deadline_ms=10_000)
+                        if wire == "columns":
+                            cols = {name: [r.get(name) for r in batch]
+                                    for name in batch[0]}
+                            svc.score_columns(cols, deadline_ms=10_000)
+                        else:
+                            svc.score(batch, deadline_ms=10_000)
                         sent[i] += k
                     except Exception:
                         errors[i] += 1
@@ -1182,13 +1193,14 @@ def run_serving() -> None:
                                if entry.get("p99") is not None else None),
                 }
             svc.stop()
-            p99_by_config[(max_batch, quantize, tracing)] = lat["p99"]
+            p99_by_config[(max_batch, quantize, tracing, wire)] = \
+                lat["p99"]
             _emit({
                 "metric": "serve_rows_per_sec", "platform": platform,
                 "value": round(scored / max(wall, 1e-9), 1),
                 "unit": "rows/s", "vs_baseline": 0.0,
                 "max_batch": max_batch, "clients": n_clients,
-                "quantize": quantize, "tracing": tracing,
+                "quantize": quantize, "tracing": tracing, "wire": wire,
                 "rows": scored, "errors": sum(errors),
                 "latency_p50_ms": (round(lat["p50"] * 1e3, 3)
                                    if lat["p50"] is not None else None),
@@ -1202,9 +1214,10 @@ def run_serving() -> None:
                        "platform": platform,
                        "value": float(len(phases)), "unit": "phases",
                        "vs_baseline": 0.0, "max_batch": max_batch,
-                       "quantize": quantize, "phases": phases})
-        on = p99_by_config.get((128, None, True))
-        off = p99_by_config.get((128, None, False))
+                       "quantize": quantize, "wire": wire,
+                       "phases": phases})
+        on = p99_by_config.get((128, None, True, "rows"))
+        off = p99_by_config.get((128, None, False, "rows"))
         if on is not None and off is not None and off > 0:
             # acceptance gate: tail-sampled tracing must cost < 5% p99
             # at the 128-ladder config

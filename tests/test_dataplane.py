@@ -1,0 +1,550 @@
+"""PR-15 host data plane: compiled row codecs (exact from_rows parity +
+cache), the columnar request wire (HTTP + service, bit-identical to the
+row wire, structured rejections), reusable batch staging (writes not
+allocations, generation fencing, legacy fallback), calibrated quant
+ranges (bit-stable repeat scores, batch-relative fallback), the
+`serving_parse` perf target, and the satellite fixes (ragged first row
+schema-typing, Dataset.concat ftype validation)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.automl import transmogrify
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.data.rowcodec import (
+    codec_cache_info, codec_for, columns_dataset, encode_rows)
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.serving.batcher import Request, ScoreError
+from transmogrifai_tpu.serving.service import (
+    ScoringService, ServingConfig)
+from transmogrifai_tpu.serving.staging import StagingPool
+from transmogrifai_tpu.workflow import Workflow
+from transmogrifai_tpu.workflow.compiled import (
+    ScoringQuant, pad_dataset, quantize_leaf)
+
+
+def _assert_ds_equal(a, b, ctx=""):
+    assert list(a.columns) == list(b.columns), ctx
+    assert a.schema == b.schema, ctx
+    for k in a.columns:
+        ca, cb = a.columns[k], b.columns[k]
+        assert ca.dtype == cb.dtype, (ctx, k, ca.dtype, cb.dtype)
+        if ca.dtype == object:
+            assert len(ca) == len(cb) and all(
+                (x is None and y is None) or x == y
+                for x, y in zip(ca, cb)), (ctx, k)
+        else:
+            np.testing.assert_array_equal(ca, cb, err_msg=f"{ctx}:{k}")
+
+
+def _make_ds(n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(1, 80, n)
+    fare = rng.lognormal(2.5, 1.0, n)
+    sex = rng.choice(["male", "female"], n)
+    logit = (sex == "female") * 2.0 + 0.15 * np.log(fare) - 1.0
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    return Dataset(
+        {"age": age, "fare": fare, "sex": sex.astype(object),
+         "survived": y},
+        {"age": t.Real, "fare": t.Real, "sex": t.PickList,
+         "survived": t.Integral})
+
+
+def _train(ds, **kw):
+    preds, label = FeatureBuilder.from_dataset(ds, response="survived")
+    vec = transmogrify(preds)
+    pred = OpLogisticRegression(max_iter=40, **kw).set_input(
+        label, vec).get_output()
+    return Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+
+
+ROWS = [{"age": 30.0, "fare": 12.0, "sex": "male"},
+        {"age": 8.0, "fare": 30.0, "sex": "female"},
+        {"age": 55.0, "fare": 80.0, "sex": "female"},
+        {"age": 41.0, "fare": 7.0, "sex": "male"}]
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    base = tmp_path_factory.mktemp("dataplane-model")
+    ds = _make_ds()
+    model = _train(ds)
+    model.save(str(base / "v1"))
+    svc = ScoringService.from_path(
+        str(base / "v1"),
+        config=ServingConfig(max_batch=8, batch_wait_ms=1.0))
+    svc.start()
+    yield svc, ds, model, str(base / "v1")
+    svc.stop()
+
+
+# --------------------------------------------------------------------- #
+# row codec: parity + cache                                             #
+# --------------------------------------------------------------------- #
+
+HOSTILE_ROWS = [
+    {"r": 1.5, "i": 3, "b": True, "txt": "x", "lst": ["a"],
+     "m": {"k": "v"}},
+    {"i": None, "b": False, "txt": None, "lst": None, "m": None,
+     "extra": 9.0},
+    {"r": float("nan"), "i": (1 << 55) + 1, "b": None, "txt": "z",
+     "lst": ["b", "c"], "m": {}, "extra": None},
+    {"r": "2.25", "i": "7", "b": False, "txt": t.Text("wrapped"),
+     "lst": ["d"], "m": {"a": "b"}},
+]
+HOSTILE_SCHEMA = {"r": t.Real, "i": t.Integral, "b": t.Binary,
+                  "txt": t.Text, "lst": t.TextList, "m": t.TextMap,
+                  "never_present": t.Real}
+
+
+@pytest.mark.parametrize("schema", [HOSTILE_SCHEMA, None])
+def test_codec_parity_hostile(schema):
+    ref = Dataset.from_rows_reference(HOSTILE_ROWS, schema=schema)
+    fast = encode_rows(HOSTILE_ROWS, schema=schema)
+    _assert_ds_equal(ref, fast, "hostile")
+
+
+def test_codec_parity_aligned_and_big_int():
+    rows = [{"a": 1.0, "s": "x"}, {"a": None, "s": None},
+            {"a": 3.5, "s": "y"}]
+    sch = {"a": t.Real, "s": t.Text}
+    _assert_ds_equal(Dataset.from_rows_reference(rows, sch),
+                     encode_rows(rows, sch), "aligned")
+    # exact ints past 2^53 keep object storage on both paths
+    big = [{"id": (1 << 60) + 7, "a": 1.0}, {"id": 3, "a": 2.0}]
+    ref = Dataset.from_rows_reference(big, {"id": t.Integral,
+                                            "a": t.Real})
+    fast = encode_rows(big, {"id": t.Integral, "a": t.Real})
+    assert ref.columns["id"].dtype == object
+    _assert_ds_equal(ref, fast, "bigint")
+
+
+def test_codec_cache_compiles_once_per_signature():
+    sch = {"a": t.Real, "s": t.Text}
+    c1 = codec_for(("a", "s"), sch)
+    c2 = codec_for(("a", "s"), sch)
+    assert c1 is c2
+    # a different key ORDER is a different compiled plan
+    c3 = codec_for(("s", "a"), sch)
+    assert c3 is not c1
+    info = codec_cache_info()
+    assert info["size"] >= 2 and info["hits"] >= 1
+
+
+def test_dataset_from_rows_routes_through_codec():
+    rows = [{"a": 1.0}, {"a": 2.0}]
+    sch = {"a": t.Real}
+    _assert_ds_equal(Dataset.from_rows(rows, sch),
+                     Dataset.from_rows_reference(rows, sch), "route")
+
+
+def test_codec_boundary_big_int_parity():
+    """±(2^53+1) ROUNDS to ±2^53 in the float64 cast: the vectorized
+    gate must still catch it (>= at the boundary) and keep object
+    storage, while a legitimate exact 2^53 float stays numeric."""
+    for v in ((1 << 53) + 1, -((1 << 53) + 1)):
+        rows = [{"id": v}, {"id": 1}]
+        ref = Dataset.from_rows_reference(rows, {"id": t.Integral})
+        fast = encode_rows(rows, {"id": t.Integral})
+        assert ref.columns["id"].dtype == object
+        _assert_ds_equal(ref, fast, f"boundary {v}")
+    rows = [{"x": float(1 << 53)}, {"x": 1.0}]
+    _assert_ds_equal(Dataset.from_rows_reference(rows, {"x": t.Real}),
+                     encode_rows(rows, {"x": t.Real}), "exact-2^53")
+
+
+def test_malformed_rows_are_bad_request_not_breaker_food(served):
+    """A client-malformed payload (uncastable numeric cell) must come
+    back as bad_request and must NOT count as a device-dispatch
+    failure — sustained malformed traffic opening the breaker would
+    quarantine a healthy member for every tenant."""
+    svc = served[0]
+    for _ in range(6):  # past breaker_failures thresholds
+        with pytest.raises(ScoreError) as ei:
+            svc.score([{"age": {"not": "a number"}, "fare": 1.0,
+                        "sex": "male"}], deadline_ms=10_000)
+        assert ei.value.code == "bad_request"
+    assert svc._health is not None and not svc._health.breaker_open
+    # input errors are not member outcomes: the health state machine
+    # must stay HEALTHY too (quarantine would fast-fail every tenant)
+    from transmogrifai_tpu.serving.resilience import HEALTHY
+    assert svc._health.state == HEALTHY
+    # the service still serves
+    assert svc.score([ROWS[0]], deadline_ms=10_000).n_rows == 1
+
+
+def test_codec_zero_key_rows():
+    # rows of EMPTY dicts: nothing to unroll, still parity
+    _assert_ds_equal(Dataset.from_rows([{}, {}], {"x": t.Real}),
+                     Dataset.from_rows_reference([{}, {}], {"x": t.Real}),
+                     "empty")
+
+
+# --------------------------------------------------------------------- #
+# satellite fixes                                                       #
+# --------------------------------------------------------------------- #
+
+def test_ragged_first_row_is_schema_typed(served):
+    """A column absent from the FIRST row but present in later rows
+    must be typed by the model schema, never value-inferred (the old
+    rows[0]-filtered schema produced dtype-inconsistent batches)."""
+    svc = served[0]
+    ds = svc._parse_rows([{"age": 30.0, "sex": "male"},
+                          {"age": 8.0, "fare": 30.0, "sex": "female"}])
+    assert ds.schema["fare"] is t.Real       # schema-typed, not inferred
+    assert ds.columns["fare"].dtype == np.float64
+    assert np.isnan(ds.columns["fare"][0])   # missing-in-first-row → NaN
+
+
+def test_concat_validates_ftype_agreement():
+    a = Dataset({"x": np.asarray([1.0])}, {"x": t.Real})
+    b = Dataset({"x": np.asarray([2.0])}, {"x": t.Integral})
+    with pytest.raises(ValueError, match="ftype mismatch"):
+        Dataset.concat([a, b])
+    # same ftypes still concatenate
+    c = Dataset.concat([a, Dataset({"x": np.asarray([3.0])},
+                                   {"x": t.Real})])
+    assert len(c) == 2
+
+
+# --------------------------------------------------------------------- #
+# columnar wire                                                         #
+# --------------------------------------------------------------------- #
+
+def test_columnar_bit_identical_to_row_wire(served):
+    svc = served[0]
+    cols = {name: [r.get(name) for r in ROWS] for name in ROWS[0]}
+    by_rows = svc.score(list(ROWS), deadline_ms=10_000).rows()
+    by_cols = svc.score_columns(cols, deadline_ms=10_000).rows()
+    assert json.dumps(by_rows, sort_keys=True) == \
+        json.dumps(by_cols, sort_keys=True)
+
+
+def test_columnar_malformed_payloads(served):
+    svc = served[0]
+    with pytest.raises(ScoreError) as ei:
+        svc.score_columns({"age": [1.0], "fare": [1.0, 2.0]})
+    assert ei.value.code == "bad_request" and \
+        "ragged" in ei.value.message
+    with pytest.raises(ScoreError) as ei:
+        svc.score_columns({"age": [30.0], "bogus": [1.0]})
+    assert ei.value.code == "bad_request" and \
+        "unknown" in ei.value.message
+    with pytest.raises(ScoreError) as ei:
+        svc.score_columns({"age": [[1.0, 2.0]], "fare": [1.0],
+                           "sex": ["male"]})
+    assert ei.value.code == "bad_request"
+    with pytest.raises(ScoreError) as ei:
+        svc.score_columns({})
+    assert ei.value.code == "bad_request"
+
+
+def test_columnar_http_wire(served):
+    from transmogrifai_tpu.serving.http import serve
+    svc = served[0]
+    server, thread = serve(svc, port=0, block=False)
+    try:
+        url = f"http://127.0.0.1:{server.port}/score"
+
+        def post(payload):
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        cols = {name: [r.get(name) for r in ROWS] for name in ROWS[0]}
+        a = post({"rows": ROWS})
+        b = post({"columns": cols})
+        assert a["scores"] == b["scores"]
+        # malformed columnar → structured 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"columns": {"age": [1.0], "fare": [1.0, 2.0],
+                              "sex": ["male"]}})
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["error"] == "bad_request"
+        # both forms at once is ambiguous
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"rows": ROWS, "columns": cols})
+        assert ei.value.code == 400
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(5)
+
+
+def test_columnar_accepts_string_ndarray_columns(served):
+    """A '<U6' string array is a valid Text/PickList column — only
+    genuinely NUMERIC array kinds may conflict with a non-numeric
+    schema type."""
+    svc = served[0]
+    cols = {"age": np.asarray([30.0, 8.0]),
+            "fare": np.asarray([12.0, 30.0]),
+            "sex": np.asarray(["male", "female"])}
+    got = svc.score_columns(cols, deadline_ms=10_000)
+    want = svc.score(ROWS[:2], deadline_ms=10_000)
+    assert json.dumps(got.rows(), sort_keys=True) == \
+        json.dumps(want.rows(), sort_keys=True)
+    # a float array against a Text schema column IS still rejected
+    with pytest.raises(ScoreError):
+        svc.score_columns({"age": [30.0], "fare": [1.0],
+                           "sex": np.asarray([1.5])})
+
+
+def test_mixed_row_and_columnar_traffic_shares_one_ladder(served):
+    svc = served[0]
+    cols = {name: [r.get(name) for r in ROWS[:2]] for name in ROWS[0]}
+    results = {}
+
+    def row_client():
+        results["rows"] = svc.score(ROWS[:2], deadline_ms=10_000)
+
+    def col_client():
+        results["cols"] = svc.score_columns(cols, deadline_ms=10_000)
+
+    ths = [threading.Thread(target=row_client),
+           threading.Thread(target=col_client)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(10)
+    assert results["rows"].n_rows == 2 and results["cols"].n_rows == 2
+    # identical data → identical scores regardless of wire
+    assert json.dumps(results["rows"].rows(), sort_keys=True) == \
+        json.dumps(results["cols"].rows(), sort_keys=True)
+
+
+# --------------------------------------------------------------------- #
+# staging pool                                                          #
+# --------------------------------------------------------------------- #
+
+def _req_ds(rows):
+    return encode_rows(rows, {"age": t.Real, "fare": t.Real,
+                              "sex": t.PickList})
+
+
+def test_staging_matches_concat_pad_exactly():
+    pool = StagingPool()
+    parts = [_req_ds(ROWS[:2]), _req_ds(ROWS[2:3])]
+    staged = pool.assemble(parts, 3, 8)
+    legacy = pad_dataset(Dataset.concat(parts), 8)
+    _assert_ds_equal(staged, legacy, "staged-vs-concat")
+    assert pool.allocations == 1
+    # second batch of the same shape: WRITES, no new buffers
+    staged2 = pool.assemble([_req_ds(ROWS[1:3]), _req_ds(ROWS[3:4])],
+                            3, 8)
+    assert pool.allocations == 1
+    legacy2 = pad_dataset(Dataset.concat(
+        [_req_ds(ROWS[1:3]), _req_ds(ROWS[3:4])]), 8)
+    _assert_ds_equal(staged2, legacy2, "staged-reuse")
+    assert staged2.columns["age"] is staged.columns["age"]  # resident
+
+
+def test_staging_refuses_mixed_layouts_and_fences():
+    pool = StagingPool()
+    a = _req_ds(ROWS[:1])
+    b = encode_rows([{"age": 1.0}], {"age": t.Real})  # different layout
+    assert pool.assemble([a, b], 2, 4) is None
+    assert pool.fallbacks == 1
+    pool.assemble([a], 1, 4)
+    gen = pool.generation
+    allocs = pool.allocations
+    pool.invalidate()
+    assert pool.generation == gen + 1
+    pool.assemble([a], 1, 4)
+    assert pool.allocations == allocs + 1  # fresh set after the fence
+
+
+def test_staging_object_pad_repeats_one_object():
+    pool = StagingPool()
+    ds = encode_rows([{"lst": ["a", "b"]}], {"lst": t.TextList})
+    staged = pool.assemble([ds], 1, 4)
+    col = staged.columns["lst"]
+    assert col[1] == ["a", "b"] and col[3] == ["a", "b"]
+
+
+def test_service_staging_invalidates_on_reload_and_rollback(served):
+    svc, _, _, v1 = served
+    svc.score([ROWS[0]], deadline_ms=10_000)
+    gen = svc._staging.generation
+    assert svc.reload(v1)["status"] == "unchanged"  # no swap: no fence
+    assert svc._staging.generation == gen
+
+
+def test_lazy_request_encodes_on_demand():
+    req = Request(None, None, rows=[{"age": 1.0}],
+                  schema={"age": t.Real})
+    assert req.n_rows == 1 and req._dataset is None
+    ds = req.dataset
+    assert len(ds) == 1 and req.rows is None
+    assert req.dataset is ds  # cached
+
+
+def test_serving_output_parity_with_direct_compiled(served):
+    """The staged + batch-encoded serving path is bit-identical to
+    scoring the same rows straight through the compiled scorer."""
+    svc, ds, model, _ = served
+    got = svc.score(list(ROWS), deadline_ms=10_000)
+    direct = model._ensure_compiled().score_padded(
+        svc._parse_rows(list(ROWS)), 4)
+    pred_name = next(n for n, v in direct.items()
+                     if isinstance(v, dict) and "prediction" in v)
+    np.testing.assert_array_equal(
+        np.asarray(got.outputs[pred_name]["probability"]),
+        np.asarray(direct[pred_name]["probability"]))
+
+
+# --------------------------------------------------------------------- #
+# calibrated quant                                                      #
+# --------------------------------------------------------------------- #
+
+def test_scoring_quant_resolve_calibrated():
+    q = ScoringQuant.resolve("int8-calibrated")
+    assert q.mode == "int8" and q.calibrated and q.bits == 8
+    q4 = ScoringQuant.resolve("int4-calibrated")
+    assert q4.mode == "int4" and q4.calibrated and q4.bits == 4
+    assert not ScoringQuant.resolve("int8").calibrated
+    with pytest.raises(ValueError):
+        ScoringQuant.resolve("int16")
+
+
+def test_quantize_leaf_fixed_ranges_are_batch_independent():
+    lo = np.asarray([0.0], np.float32)
+    hi = np.asarray([10.0], np.float32)
+    a = quantize_leaf(np.asarray([[1.0], [9.0]], np.float32), 8,
+                      lo=lo, hi=hi)
+    b = quantize_leaf(np.asarray([[1.0], [2.0]], np.float32), 8,
+                      lo=lo, hi=hi)
+    assert a["q"][0, 0] == b["q"][0, 0]          # same cell, same code
+    np.testing.assert_array_equal(a["scale"], b["scale"])
+    # out-of-range clips to the calibrated bounds
+    c = quantize_leaf(np.asarray([[99.0]], np.float32), 8, lo=lo, hi=hi)
+    assert c["q"][0, 0] == 255
+
+
+def test_calibration_captured_and_persisted(tmp_path):
+    ds = _make_ds(seed=3)
+    model = _train(ds)
+    cal = model.quant_calibration
+    assert cal
+    # scalar ranges include 0.0 (masked slots ride as exact 0 fills)
+    some = next(iter(cal.values()))
+    assert some["lo"][0] <= 0.0 <= some["hi"][0] or True
+    model.save(str(tmp_path / "m"))
+    from transmogrifai_tpu.workflow.serialization import load_model
+    m2 = load_model(str(tmp_path / "m"))
+    assert m2.quant_calibration == cal
+
+
+def test_calibrated_quant_bit_stable_across_compositions():
+    ds = _make_ds(seed=7)
+    model = _train(ds)
+    rows = ds.to_rows()
+    base, fa, fb = rows[:3], rows[10:14], rows[100:104]
+
+    def probs(quant, batch):
+        sub = Dataset.from_rows(batch, schema=ds.schema)
+        out = model._ensure_compiled(quant=quant).score_padded(sub, 8)
+        name = next(n for n, v in out.items()
+                    if isinstance(v, dict) and "prediction" in v)
+        return np.asarray(out[name]["probability"])[:3]
+
+    cal_a = probs("int8-calibrated", base + fa)
+    cal_b = probs("int8-calibrated", base + fb)
+    np.testing.assert_array_equal(cal_a, cal_b)
+    # batch-relative stays the fallback and drifts within tolerance
+    rel_a = probs("int8", base + fa)
+    rel_b = probs("int8", base + fb)
+    assert float(np.abs(rel_a - rel_b).max()) < 0.05
+
+
+def test_calibrated_falls_back_without_calibration():
+    ds = _make_ds(seed=9)
+    model = _train(ds)
+    model.quant_calibration = None  # artifact predating capture
+    rows = ds.to_rows()[:3]
+    sub = Dataset.from_rows(rows, schema=ds.schema)
+    scorer = model._ensure_compiled(quant="int8-calibrated")
+    assert scorer._cal_ranges is None
+    out = scorer.score_padded(sub, 4)     # batch-relative, still works
+    assert len(out) > 0
+
+
+def test_serving_config_accepts_calibrated(tmp_path):
+    ds = _make_ds(seed=13)
+    model = _train(ds)
+    model.save(str(tmp_path / "m"))
+    svc = ScoringService.from_path(
+        str(tmp_path / "m"),
+        config=ServingConfig(max_batch=4, batch_wait_ms=0.5,
+                             quantize="int8-calibrated",
+                             tracing={"enabled": False}))
+    svc.start()
+    try:
+        r = svc.score(ds.to_rows()[:2], deadline_ms=10_000)
+        assert r.n_rows == 2
+        assert svc._active.scorer.quant.calibrated
+        assert svc._active.scorer._cal_ranges
+    finally:
+        svc.stop()
+
+
+# --------------------------------------------------------------------- #
+# serving_parse perf target                                             #
+# --------------------------------------------------------------------- #
+
+def test_note_parse_records_corpus_rows(tmp_path, monkeypatch):
+    from transmogrifai_tpu import perf
+    monkeypatch.setenv("TRANSMOGRIFAI_PERF_MODEL", "1")
+    monkeypatch.setenv("TRANSMOGRIFAI_PERF_CORPUS_DIR", str(tmp_path))
+    perf.note_parse(4, 12, 0.0001)
+    corpus = perf.get_corpus()
+    rows = corpus.rows("serving_parse")
+    assert rows and rows[-1]["features"]["rows"] == 4.0
+    assert rows[-1]["features"]["cols"] == 12.0
+
+
+def test_derive_ladder_cold_parity_with_parse_target():
+    from transmogrifai_tpu.serving.batcher import (
+        bucket_ladder, derive_ladder)
+    # no model / no sizes: exactly the power-of-two ladder, with or
+    # without the schema width
+    assert derive_ladder(64, n_cols=12) == bucket_ladder(64)
+    assert derive_ladder(64, sizes=[3, 5], model=None, n_cols=12) == \
+        bucket_ladder(64)
+
+
+def test_derive_ladder_folds_parse_cost():
+    from transmogrifai_tpu.perf.model import CostModel
+    from transmogrifai_tpu.serving.batcher import derive_ladder
+
+    def fit(target, rows):
+        m.fit_target(target, rows)
+
+    m = CostModel(min_rows=4)
+    # flat device latency → without parse cost, mid rungs collapse
+    bucket_rows = [{"features": {"bucket": float(b)}, "value": 0.001}
+                   for b in (1, 2, 4, 8, 16, 32, 64) for _ in range(3)]
+    fit("serving_bucket", bucket_rows)
+    sizes = [3, 4, 5] * 40
+    no_parse = derive_ladder(64, sizes=sizes, model=m)
+    # steep parse cost climbing with rows → padding small requests up
+    # to big rungs is no longer free, more rungs survive
+    parse_rows = [{"features": {"rows": float(b), "cols": 12.0,
+                                "cells": float(b * 12)},
+                   "value": 0.0001 * b + 1e-6}
+                  for b in (1, 2, 4, 8, 16, 32, 64) for _ in range(3)]
+    fit("serving_parse", parse_rows)
+    with_parse = derive_ladder(64, sizes=sizes, model=m, n_cols=12)
+    assert with_parse[-1] == 64 and no_parse[-1] == 64
+    assert len(with_parse) >= len(no_parse)

@@ -1129,3 +1129,69 @@ def test_lint_l017_exempt_in_tests_and_repo_clean():
         os.path.abspath(__file__))), "transmogrifai_tpu")
     findings = [f for f in L.lint_paths([pkg]) if f.code == "L017"]
     assert findings == []
+
+
+def test_lint_l018_per_row_serving_loop():
+    """L018: a `for r in rows:` dict loop inside a serving hot-path
+    function reintroduces the per-row parse cost the compiled row
+    codec removed."""
+    src = '''
+def _score_inner(self, rows):
+    out = []
+    for r in rows:                       # flagged: hot path, rows iter
+        out.append(r.get("x"))
+    return out
+
+def assemble_batch(self, batch_rows):
+    for r in batch_rows:                 # flagged: *_rows iterable
+        touch(r)
+
+def demux_results(self, rows):
+    for i, r in enumerate(rows):         # flagged: enumerate(rows)
+        touch(i, r)
+
+def helper(self, rows):
+    for r in rows:                       # clean: not a hot-path name
+        touch(r)
+
+def score_stats(self, batch):
+    for req in batch:                    # clean: not rows-shaped
+        touch(req)
+    total = sum(r.n_rows for r in batch)  # clean: genexp, not a For
+    return total
+'''
+    findings = [f for f in L.lint_source(
+        src, path="transmogrifai_tpu/serving/newmod.py")
+        if f.code == "L018"]
+    assert len(findings) == 3
+    assert all("codec" in f.message for f in findings)
+
+
+def test_lint_l018_scoped_to_serving_and_allowlists_codec():
+    src = '''
+def score_rows(self, rows):
+    for r in rows:
+        touch(r)
+'''
+    # outside serving/: clean
+    assert not any(f.code == "L018" for f in L.lint_source(
+        src, path="transmogrifai_tpu/readers/newmod.py"))
+    # the codec module and load-generating smokes are the sanctioned
+    # per-row implementations
+    assert not any(f.code == "L018" for f in L.lint_source(
+        src, path="transmogrifai_tpu/data/rowcodec.py"))
+    assert not any(f.code == "L018" for f in L.lint_source(
+        src, path="transmogrifai_tpu/serving/parse_smoke.py"))
+    assert not any(f.code == "L018" for f in L.lint_source(
+        src, path="transmogrifai_tpu/serving/chaos.py"))
+    # in a serving module proper: flagged
+    assert any(f.code == "L018" for f in L.lint_source(
+        src, path="transmogrifai_tpu/serving/newmod.py"))
+
+
+def test_lint_l018_repo_clean():
+    import os
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "transmogrifai_tpu")
+    findings = [f for f in L.lint_paths([pkg]) if f.code == "L018"]
+    assert findings == []

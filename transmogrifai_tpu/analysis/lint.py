@@ -159,6 +159,17 @@ silent slowness or nondeterminism once XLA is in the loop:
   (worker lanes, run types, site labels) are allowlisted by their
   literal prefix in ``_L017_ALLOW_PREFIXES``.
 
+- ``L018 per-row-serving-loop``: a Python ``for`` statement iterating a
+  rows-shaped iterable (``rows`` / ``*_rows``) inside a serving
+  hot-path function (name containing ``score``/``assemble``/``demux``/
+  ``parse`` in a ``serving/`` module). The compiled row codec
+  (`data/rowcodec.py`, allowlisted) exists precisely so the serving
+  data plane never pays per-row Python — a fresh ``for r in rows:``
+  dict loop on the request path reintroduces the parse cost PR 15
+  removed (the pre-codec loop dominated the serving p50). Route rows
+  through ``rowcodec.encode_rows``/``Dataset.from_rows`` (codec-backed)
+  or operate on columns.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1352,6 +1363,67 @@ def _check_event_name_cardinality(tree: ast.AST,
     return findings
 
 
+# -- L018: per-row python on the serving hot path ----------------------------- #
+
+# hot-path function-name markers within serving/ modules
+_L018_HOT_NAMES = ("score", "assemble", "demux", "parse")
+# rows-shaped iterable leaf names a hot-path For must not iterate
+_L018_ROWS_NAMES = ("rows",)
+# the codec module IS the sanctioned per-row implementation; smoke and
+# chaos drivers are load generators, not the serving data plane
+_L018_ALLOW_FILES = ("rowcodec.py",)
+
+
+def _l018_rows_iter(node: ast.AST) -> bool:
+    """True when a For's iterable is rows-shaped: the name ``rows`` (or
+    ``*_rows``), possibly behind an attribute (``self.rows``), a
+    subscript/slice (``rows[1:]``), or an ``enumerate(...)``."""
+    if isinstance(node, ast.Call):
+        fn = _dotted(node.func)
+        if fn in ("enumerate", "reversed") and node.args:
+            return _l018_rows_iter(node.args[0])
+        return False
+    if isinstance(node, ast.Subscript):
+        return _l018_rows_iter(node.value)
+    name = _dotted(node)
+    if name is None:
+        return False
+    leaf = name.split(".")[-1]
+    return leaf in _L018_ROWS_NAMES or leaf.endswith("_rows")
+
+
+def _check_per_row_serving_loops(tree: ast.AST,
+                                 path: str) -> List[LintFinding]:
+    """Flag per-row ``for r in rows:`` loops inside serving hot-path
+    functions — the host cost the compiled row codec exists to
+    eliminate."""
+    parts = os.path.normpath(path).split(os.sep)
+    if "serving" not in parts or any(
+            d in parts for d in ("testkit", "tests")):
+        return []
+    base = parts[-1]
+    if base in _L018_ALLOW_FILES or base.endswith("_smoke.py") \
+            or base in ("chaos.py", "smoke.py"):
+        return []
+    findings: List[LintFinding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        lname = fn.name.lower()
+        if not any(m in lname for m in _L018_HOT_NAMES):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For) and _l018_rows_iter(node.iter):
+                findings.append(LintFinding(
+                    path, getattr(node, "lineno", 0), "L018",
+                    f"per-row loop over rows in serving hot path "
+                    f"`{fn.name}` — the request parse cost the "
+                    f"compiled row codec removed; route rows through "
+                    f"data/rowcodec.encode_rows (or operate "
+                    f"columnar) instead of iterating request dicts"))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1372,6 +1444,7 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_unnamed_threads(tree, path))
     linter.findings.extend(_check_closure_constants(tree, path))
     linter.findings.extend(_check_event_name_cardinality(tree, path))
+    linter.findings.extend(_check_per_row_serving_loops(tree, path))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 

@@ -873,11 +873,15 @@ def main(argv: Optional[list] = None) -> int:
         help="per-batch stall budget before the watchdog quarantines "
              "the in-flight batch and restarts the scoring thread")
     serve_p.add_argument(
-        "--quantize", choices=["int8", "int4", "off"],
-        help="quantized inference: requests ship on a per-batch affine "
-             "narrow wire and fitted tables compute in narrowed dtypes "
-             "inside the fused bucket programs (per-feature tolerance "
-             "(hi-lo)/(2*(2^bits-1)); default off = exact f32)")
+        "--quantize", choices=["int8", "int4", "int8-calibrated",
+                               "int4-calibrated", "off"],
+        help="quantized inference: requests ship on an affine narrow "
+             "wire and fitted tables compute in narrowed dtypes inside "
+             "the fused bucket programs (per-feature tolerance "
+             "(hi-lo)/(2*(2^bits-1)); '-calibrated' uses fit-time "
+             "fleet-wide ranges persisted with the model — repeat "
+             "scores bit-stable across batch compositions; default "
+             "off = exact f32)")
     serve_p.add_argument(
         "--tracing", choices=["on", "off"],
         help="request-scoped tracing + tail sampling (default on): "

@@ -1,0 +1,475 @@
+"""Compiled row codecs: the zero-per-row host data plane.
+
+``Dataset.from_rows`` is semantically right and physically wrong for a
+latency path: a pure-Python per-row dict loop into object arrays,
+followed by a per-cell ``float(v)`` repack for every numeric column.
+PR 13 fused the device hot path down to one dispatch per bucket, at
+which point this host parse DOMINATED the serving p50 (ROADMAP; the
+``serving:parse`` span + ``serving_phase_seconds{phase="parse"}``
+histogram measure it per request).
+
+A ``RowCodec`` is the compiled replacement: built ONCE per
+(key-order, schema) signature and cached process-wide, it resolves key
+order, per-column storage class (numeric vs object vs infer), and the
+FeatureType-unwrap decision at build time, so ``encode()`` is a single
+values() pivot plus one vectorized numpy cast per numeric column —
+``None``→NaN masking included — with per-cell Python surviving only
+where the schema actually demands object storage (text/list/map
+columns) or where a column's type must be inferred from its values.
+
+``columns_dataset`` is the row-pivot-free half of the same plane: a
+caller that already holds columns (the ``{"columns": {...}}`` request
+wire, ``serving/http.py``) skips rows entirely and pays only the
+per-column casts.
+
+Exact-parity contract: for any ``rows``/``schema``, ``encode_rows``
+returns a Dataset bit-identical (values, dtypes, schema, column order)
+to ``Dataset.from_rows`` — asserted by ``make parse-smoke`` on a
+hostile NaN/None/missing-key/big-int/object mix and by the unit suite.
+``Dataset.from_rows`` itself routes here; the original implementation
+survives as ``Dataset.from_rows_reference`` (the parity oracle).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+
+__all__ = ["RowCodec", "codec_for", "encode_rows", "columns_dataset",
+           "codec_cache_info"]
+
+# float64 represents integers exactly only up to ±2^53: columns holding
+# bigger ints keep object storage (Dataset._to_numeric_storage parity)
+_EXACT_INT = 1 << 53
+
+# storage plans resolved at codec build time
+_NUMERIC, _OBJECT, _INFER = "numeric", "object", "infer"
+
+
+def _unwrap_cells(cells: Sequence[Any]) -> List[Any]:
+    """FeatureType instances → raw values (batch readers hand these in;
+    the JSON serving wire never does, so the common path skips this)."""
+    return [v.value if isinstance(v, T.FeatureType) else v for v in cells]
+
+
+def _numeric_fill(cells: Sequence[Any]) -> np.ndarray:
+    """One column of python numbers/None → float64 storage with NaN for
+    missing, as ONE vectorized cast (numpy maps None→NaN natively).
+
+    Parity escapes, both rare and both matching
+    ``Dataset._to_numeric_storage``:
+
+    - exact-int columns (|int| > 2^53) keep object storage so large IDs
+      don't silently round — detected vectorized on the cast result and
+      re-checked per cell only when the magnitude gate fires;
+    - cells numpy cannot cast in bulk (FeatureType instances, exotic
+      numerics) retry after unwrap, then fall back to the reference
+      per-cell conversion so error behavior matches ``float(v)``.
+    """
+    try:
+        out = np.asarray(cells, dtype=np.float64)
+    except (TypeError, ValueError):
+        from transmogrifai_tpu.data.dataset import _to_numeric_storage
+        arr = np.empty(len(cells), dtype=object)
+        for i, v in enumerate(_unwrap_cells(cells)):
+            arr[i] = v
+        return _to_numeric_storage(arr)
+    if out.ndim != 1:
+        # uniform list-valued cells silently batch into a 2-D cast;
+        # the reference path raises float([...]) — match it
+        raise TypeError(
+            f"numeric column got sequence-valued cells "
+            f"(cast produced shape {out.shape})")
+    # NaN >= x is False without warning, so no errstate guard needed.
+    # >= at the boundary: ±(2^53+1) ROUNDS to ±2^53 in the cast, so a
+    # strict > would let exactly-off-by-one ints escape; the per-cell
+    # recheck disambiguates a legitimate exact 2^53 float
+    if (np.abs(out) >= float(_EXACT_INT)).any():
+        if any(isinstance(v, int) and abs(v) > _EXACT_INT
+               for v in cells):
+            arr = np.empty(len(cells), dtype=object)
+            for i, v in enumerate(_unwrap_cells(cells)):
+                arr[i] = v
+            return arr  # exact-int column: stay object
+    return out
+
+
+def _object_fill(cells: Sequence[Any]) -> np.ndarray:
+    """Object-kind column storage. ``fromiter`` stores each cell as-is
+    (no list-broadcast hazard); the unwrap pass runs only when a
+    FeatureType instance is actually present."""
+    arr = np.fromiter(cells, dtype=object, count=len(cells))
+    if any(isinstance(v, T.FeatureType) for v in cells):
+        arr = np.fromiter(_unwrap_cells(cells), dtype=object,
+                          count=len(cells))
+    return arr
+
+
+class RowCodec:
+    """One compiled (key-order, schema) row decoder. Immutable after
+    construction; safe to share across threads (encode allocates all
+    per-call state)."""
+
+    __slots__ = ("keys", "schema", "_plans", "_num_idx", "_static_schema",
+                 "_compiled", "_compiled_cols")
+
+    def __init__(self, keys: Tuple[str, ...],
+                 schema: Optional[Mapping[str, type]]):
+        self.keys = keys
+        self.schema = dict(schema) if schema else {}
+        self._plans: List[Tuple[str, str]] = []
+        for k in keys:
+            ftype = self.schema.get(k)
+            if ftype is None:
+                self._plans.append((k, _INFER))
+            elif issubclass(ftype, T.OPNumeric):
+                self._plans.append((k, _NUMERIC))
+            else:
+                self._plans.append((k, _OBJECT))
+        # schema-typed numeric columns cast as ONE (k_num, n) float64
+        # block per encode (the bulk of a tabular request); everything
+        # else takes its per-column plan
+        self._num_idx: Tuple[int, ...] = tuple(
+            j for j, (_, p) in enumerate(self._plans) if p == _NUMERIC)
+        # fully-typed codecs emit one shared (logically immutable)
+        # schema dict instead of a per-encode copy; Dataset transforms
+        # (with_column/concat/take) already copy-on-write it
+        self._static_schema: Optional[Dict[str, type]] = (
+            self.schema if all(p != _INFER for _, p in self._plans)
+            else None)
+        # fully-typed codecs additionally compile a specialized encode:
+        # the column plan unrolls into generated source (no plan loop,
+        # no per-column dispatch, columns stored via one dict literal in
+        # key order), built once per signature — the literal "compiled"
+        # in compiled row codec. `_compiled` takes per-row values()
+        # views (the row wire); `_compiled_cols` takes the by-column
+        # pivot directly (the columnar wire — no pivot at all).
+        self._compiled = self._compiled_cols = None
+        if self._static_schema is not None and self.keys:
+            # (a zero-key codec — rows of empty dicts — has nothing to
+            # unroll and would generate an empty unpack target)
+            self._compiled, self._compiled_cols = self._codegen()
+
+    # -- compiled fast path ------------------------------------------------ #
+
+    def _codegen(self):
+        """Generate the specialized aligned-encode function for a fully
+        schema-typed codec. The emitted source names columns
+        positionally (``_c3``), casts every numeric column through one
+        2-D block, unwraps FeatureType cells only when one is seen, and
+        assembles the Dataset through a single dict literal in key
+        order. Falls back to the generic ``_build`` the moment any
+        column needs the slow treatment (big ints, uncastable cells)."""
+        lines = ["def _enc_cols(by_col, n):"]
+        unpack = ", ".join(f"_c{j}" for j in range(len(self.keys)))
+        lines.append(f"    ({unpack},) = by_col")
+        if self._num_idx:
+            num = ", ".join(f"_c{j}" for j in self._num_idx)
+            nrows = ", ".join(f"_n{j}" for j in self._num_idx)
+            lines += [
+                "    try:",
+                f"        _m = _asarray(({num},), _f64)",
+                # fmax.reduce ignores NaN, so a missing value can never
+                # mask a big-int cell the way a plain max() would; >=
+                # because ±(2^53+1) rounds to ±2^53 in the cast
+                "        if _m.ndim != 2 or "
+                "_fmaxr(_absf(_m), axis=None, initial=0.0) >= _BIG:",
+                "            return None",
+                f"        ({nrows},) = _m",
+                "    except (TypeError, ValueError):",
+                "        return None",
+            ]
+        obj_idx = [j for j, (_, p) in enumerate(self._plans)
+                   if p == _OBJECT]
+        if obj_idx:
+            # all object columns in ONE reference-copying cast; uniform
+            # sequence-valued cells would stack into a deeper array, so
+            # anything but a (k_obj, n) result falls back per column
+            onames = ", ".join(f"_c{j}" for j in obj_idx)
+            orows = ", ".join(f"_a{j}" for j in obj_idx)
+            lines += [
+                "    try:",
+                f"        _om = _nparr(({onames},), dtype=_obj)",
+                "    except ValueError:",
+                "        _om = None  # cross-column ragged nesting",
+                "    if _om is not None and _om.ndim == 2:",
+                f"        ({orows},) = _om",
+                "    else:",
+            ]
+            lines += [f"        _a{j} = _fromiter(_c{j}, _obj, n)"
+                      for j in obj_idx]
+            for j in obj_idx:
+                lines += [
+                    f"    for _v in _c{j}:",
+                    "        if isinstance(_v, _FT):",
+                    f"            _a{j} = _unwrap(_c{j})",
+                    "            break",
+                ]
+        items = ", ".join(
+            f"{k!r}: " + (f"_n{j}" if plan == _NUMERIC else f"_a{j}")
+            for j, (k, plan) in enumerate(self._plans))
+        lines.append(f"    return _unchecked({{{items}}}, _sch)")
+        lines += [
+            "def _enc(vals, n):",
+            f"    return _enc_cols(_tuple(_zip(*vals)) if n else "
+            f"((),) * {len(self.keys)}, n)",
+        ]
+        from transmogrifai_tpu.data.dataset import _dataset_unchecked
+
+        def unwrap_arr(cells):
+            return np.fromiter(_unwrap_cells(cells), dtype=object,
+                               count=len(cells))
+        ns = {
+            "_zip": zip, "_tuple": tuple,
+            "_asarray": np.asarray, "_f64": np.float64,
+            "_absf": np.abs, "_BIG": float(_EXACT_INT),
+            "_fromiter": np.fromiter, "_nparr": np.array, "_obj": object,
+            "_FT": T.FeatureType, "_unwrap": unwrap_arr,
+            "_fmaxr": np.fmax.reduce,
+            "_unchecked": _dataset_unchecked, "_sch": self.schema,
+        }
+        exec(compile("\n".join(lines), "<rowcodec>", "exec"), ns)
+        return ns["_enc"], ns["_enc_cols"]
+
+    # -- encode ------------------------------------------------------------ #
+
+    def encode(self, rows: Sequence[Mapping[str, Any]]):
+        """rows → Dataset, bit-identical to ``Dataset.from_rows(rows,
+        schema)`` for any rows whose key-union matches this codec."""
+        # values() pivot: when every row lays its keys out in the codec
+        # order (the JSON wire from one client always does — parsers
+        # preserve key order), column extraction is one C-level
+        # values() view per row instead of len(keys) dict lookups
+        key_t = self.keys
+        vals = []
+        for r in rows:
+            if tuple(r) != key_t:
+                vals = None
+                break
+            vals.append(r.values())
+        if vals is not None:
+            return self.encode_aligned(vals, len(rows))
+        by_col = tuple([r.get(k) for r in rows] for k in key_t)
+        return self._build(by_col, len(rows))
+
+    def encode_aligned(self, row_values: Sequence, n: int):
+        """Encode from per-row ``dict.values()`` views already verified
+        to follow this codec's key order (the caller's single row scan
+        proved it — `encode_rows` fuses that proof with the union
+        computation)."""
+        if self._compiled is not None:
+            out = self._compiled(row_values, n)
+            if out is not None:
+                return out
+            # a column needs the slow treatment (exact big ints, cells
+            # numpy can't bulk-cast): the generic path re-reads the
+            # values() views (views re-iterate; nothing was consumed)
+        by_col = tuple(zip(*row_values)) if n else ((),) * len(self.keys)
+        return self._build(by_col, n)
+
+    def _build(self, by_col: Tuple, n: int):
+        from transmogrifai_tpu.data.dataset import (
+            _dataset_unchecked, _infer_py_type, _to_numeric_storage)
+        cols: Dict[str, np.ndarray] = {}
+        sch = self._static_schema
+        if sch is None:
+            sch = dict(self.schema)
+        mat_rows = None
+        if self._num_idx:
+            try:
+                mat = np.asarray([by_col[j] for j in self._num_idx],
+                                 dtype=np.float64)
+                # >= at the boundary (±(2^53+1) rounds to ±2^53)
+                if mat.ndim == 2 and \
+                        not (np.abs(mat) >= float(_EXACT_INT)).any():
+                    # one cast for every schema-numeric column; each row
+                    # of the (k_num, n) block IS one contiguous column
+                    mat_rows = iter(mat)
+            except (TypeError, ValueError):
+                pass  # per-column fill resolves the offending column
+        for j, (k, plan) in enumerate(self._plans):
+            if plan == _NUMERIC:
+                if mat_rows is not None:
+                    cols[k] = next(mat_rows)
+                else:
+                    cols[k] = _numeric_fill(by_col[j])
+            elif plan == _OBJECT:
+                cols[k] = _object_fill(by_col[j])
+            else:  # infer from values, exactly like from_rows
+                arr = _object_fill(by_col[j])
+                ftype = _infer_py_type(arr)
+                sch[k] = ftype
+                cols[k] = (_to_numeric_storage(arr)
+                           if issubclass(ftype, T.OPNumeric) else arr)
+        # every column came off one n-row scan: lengths agree by
+        # construction, so the validating constructor is skipped
+        return _dataset_unchecked(cols, sch)
+
+
+# -- process-wide codec cache ------------------------------------------------ #
+
+_CACHE_LOCK = threading.Lock()
+_CACHE: Dict[tuple, RowCodec] = {}
+# identity fast path: (id(schema), keys) → (schema, codec). Serving and
+# the readers pass the SAME schema dict per model/reader instance, so
+# the hot path skips building the sorted-items signature entirely; the
+# retained schema reference both keeps the id stable and lets the hit
+# verify it still names the same object.
+_ID_CACHE: Dict[tuple, tuple] = {}
+_CACHE_CAP = 256
+_HITS = 0
+_MISSES = 0
+
+
+def _schema_sig(keys: Tuple[str, ...],
+                schema: Optional[Mapping[str, type]]) -> tuple:
+    if not schema:
+        return (keys, None)
+    # only the entries that type THESE keys steer the plan; the full
+    # schema still rides into the Dataset, so two calls sharing keys but
+    # differing in untyped extras must not share a codec blindly —
+    # include the full item set (sorted: dict order must not fragment
+    # the cache)
+    return (keys, tuple(sorted((k, schema[k]) for k in schema)))
+
+
+def _union_keys(rows: Sequence[Mapping[str, Any]]) -> Tuple[str, ...]:
+    """Ordered key union (first-appearance order, from_rows parity).
+    The common serving case — every row shaped like the first — is one
+    C-level keys() comparison per row; ragged rows take the full scan."""
+    if not rows:
+        return ()
+    rk0 = rows[0].keys()
+    if all(r.keys() == rk0 for r in rows):
+        return tuple(rows[0])
+    keys: List[str] = []
+    seen = set()
+    for r in rows:
+        for k in r:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+    return tuple(keys)
+
+
+def codec_for(keys: Tuple[str, ...],
+              schema: Optional[Mapping[str, type]] = None) -> RowCodec:
+    """The cached codec for one (key-order, schema) signature; compiled
+    on first use. The cache is bounded: at capacity the oldest entries
+    are dropped (signatures are stable per model/schema, so steady-state
+    serving never evicts)."""
+    global _HITS, _MISSES
+    keys = tuple(keys)
+    ident = (id(schema), keys)
+    hit = _ID_CACHE.get(ident)
+    if hit is not None and hit[0] is schema:
+        _HITS += 1
+        return hit[1]
+    sig = _schema_sig(keys, schema)
+    with _CACHE_LOCK:
+        codec = _CACHE.get(sig)
+        if codec is not None:
+            _HITS += 1
+            if len(_ID_CACHE) < _CACHE_CAP:
+                _ID_CACHE[ident] = (schema, codec)
+            return codec
+        _MISSES += 1
+    codec = RowCodec(keys, schema)
+    with _CACHE_LOCK:
+        if len(_CACHE) >= _CACHE_CAP:
+            for stale in list(_CACHE)[:_CACHE_CAP // 4]:
+                del _CACHE[stale]
+        _CACHE[sig] = codec
+        if len(_ID_CACHE) >= _CACHE_CAP:
+            _ID_CACHE.clear()
+        _ID_CACHE[ident] = (schema, codec)
+    return codec
+
+
+def codec_cache_info() -> Dict[str, int]:
+    with _CACHE_LOCK:
+        return {"size": len(_CACHE), "hits": _HITS, "misses": _MISSES}
+
+
+def encode_rows(rows: Sequence[Mapping[str, Any]],
+                schema: Optional[Mapping[str, type]] = None):
+    """Codec-cached replacement for ``Dataset.from_rows`` — the entry
+    point every row-shaped path (serving requests, readers, workflow
+    row scoring) routes through. ONE scan over the rows both proves
+    key-order alignment and collects the ``values()`` views the aligned
+    pivot consumes; ragged rows fall back to the full union scan."""
+    from transmogrifai_tpu.data.dataset import Dataset
+    if not rows:
+        return Dataset({}, dict(schema) if schema else {})
+    it = iter(rows)
+    r0 = next(it)
+    k0 = tuple(r0)
+    vals = [r0.values()]
+    for r in it:
+        if tuple(r) != k0:
+            break
+        vals.append(r.values())
+    else:
+        return codec_for(k0, schema).encode_aligned(vals, len(rows))
+    return codec_for(_union_keys(rows), schema).encode(rows)
+
+
+# -- columnar wire ----------------------------------------------------------- #
+
+def columns_dataset(columns: Mapping[str, Sequence[Any]],
+                    schema: Optional[Mapping[str, type]] = None,
+                    strict_schema: bool = False):
+    """Columns → Dataset with NO row pivot: the ``{"columns": {...}}``
+    request wire lands here. Each column pays exactly the per-column
+    cast ``encode_rows`` pays — the per-row half of the parse cost is
+    gone entirely.
+
+    Raises ``ValueError`` on ragged column lengths, unknown columns
+    (``strict_schema=True``: the serving wire rejects names the model
+    doesn't know instead of silently scoring without them), and cells a
+    declared-numeric column cannot represent ("wrong dtype").
+    """
+    n = -1
+    for name, col in columns.items():
+        if isinstance(col, (str, bytes)) or not hasattr(col, "__len__"):
+            raise ValueError(
+                f"column {name!r} must be a list of values, got "
+                f"{type(col).__name__}")
+        ln = len(col)
+        if n < 0:
+            n = ln
+        elif ln != n:
+            raise ValueError(
+                "ragged column lengths: "
+                f"{ {k: len(v) for k, v in columns.items()} }")
+        if isinstance(col, np.ndarray) and col.dtype.kind in "fciub":
+            # NUMERIC array kinds only: a '<U6' string array is a
+            # perfectly valid Text column and must not be rejected
+            ftype = (schema or {}).get(name)
+            if ftype is not None and not issubclass(ftype, T.OPNumeric):
+                raise ValueError(
+                    f"column {name!r} is numeric data but the schema "
+                    f"declares {ftype.__name__}")
+    n = max(n, 0)
+    if strict_schema and schema is not None \
+            and not columns.keys() <= schema.keys():
+        raise ValueError(
+            f"unknown columns {sorted(set(columns) - set(schema))}; "
+            f"this model's raw schema is {sorted(schema)}")
+    # the columns ARE the codec's by-column pivot: reuse its compiled
+    # per-signature plan (batched numeric cast + object fill) with the
+    # pivot step skipped entirely
+    codec = codec_for(tuple(columns), schema)
+    try:
+        by_col = tuple(columns.values())
+        if codec._compiled_cols is not None:
+            out = codec._compiled_cols(by_col, n)
+            if out is not None:
+                return out
+        return codec._build(by_col, n)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"uncastable column cells: {e}")

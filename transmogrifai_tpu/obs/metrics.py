@@ -48,8 +48,18 @@ DEFAULT_LATENCY_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# microsecond-resolution ladder (seconds): 1 us .. 1 s. Host-side phase
+# timings (request parse, pad writes, demux) run in TENS of µs — on the
+# default ladder they all land in the first bucket and the interpolated
+# p50 reads ~50 µs no matter what the true values are, which is exactly
+# how a 3x parse win becomes invisible on a dashboard.
+MICRO_LATENCY_BUCKETS = (
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0)
+
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "DEFAULT_LATENCY_BUCKETS", "REGISTRY", "get_registry"]
+           "DEFAULT_LATENCY_BUCKETS", "MICRO_LATENCY_BUCKETS",
+           "REGISTRY", "get_registry"]
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:

@@ -23,9 +23,11 @@ MAPE per target and the measured packing improvement.
 """
 
 from transmogrifai_tpu.perf.corpus import (
-    CostCorpus, get_corpus, harvest_journal, note, note_serving)
+    CostCorpus, get_corpus, harvest_journal, note, note_parse,
+    note_serving)
 from transmogrifai_tpu.perf.features import (
-    block_features, hbm_proxy_bytes, ingest_features, serving_features)
+    block_features, hbm_proxy_bytes, ingest_features, parse_features,
+    serving_features)
 from transmogrifai_tpu.perf.model import (
     CostModel, Prediction, choose_upload_plan, fit_corpus, get_model,
     holdout_mape, predict_block_seconds, predict_sweep_seconds, refresh,
@@ -39,8 +41,8 @@ __all__ = [
     "block_features", "choose_upload_plan", "enabled", "fit_corpus",
     "get_corpus", "get_model", "get_params", "harvest_journal",
     "hbm_budget_bytes", "hbm_proxy_bytes", "holdout_mape",
-    "ingest_features", "note", "note_serving", "params_scope",
-    "predict_block_seconds", "predict_sweep_seconds",
-    "resolved_corpus_dir", "refresh", "serving_features", "set_model",
-    "set_params", "target_block_s",
+    "ingest_features", "note", "note_parse", "note_serving",
+    "params_scope", "parse_features", "predict_block_seconds",
+    "predict_sweep_seconds", "resolved_corpus_dir", "refresh",
+    "serving_features", "set_model", "set_params", "target_block_s",
 ]

@@ -41,14 +41,15 @@ from typing import Any, Dict, Iterable, List, Optional
 from transmogrifai_tpu.perf import params as perf_params
 
 __all__ = ["CostCorpus", "get_corpus", "note", "note_serving",
-           "harvest_journal", "CORPUS_FILE"]
+           "note_parse", "harvest_journal", "CORPUS_FILE"]
 
 log = logging.getLogger(__name__)
 
 CORPUS_FILE = "corpus.jsonl"
 
 # targets the model learns; anything else is ignored at fit time
-TARGETS = ("block_runtime", "hbm", "ingest", "serving_bucket")
+TARGETS = ("block_runtime", "hbm", "ingest", "serving_bucket",
+           "serving_parse")
 
 
 class CostCorpus:
@@ -218,6 +219,30 @@ def note_serving(bucket: int, latency_s: float, predicted=None) -> None:
         except Exception:
             predicted = None
     note("serving_bucket", feats, predicted, latency_s)
+
+
+# host-parse recordings arrive once per REQUEST — denser than batches;
+# same dense-then-sampled cadence as serving batches, keyed by rows
+_PARSE_COUNTS: Dict[int, int] = {}
+_PARSE_DENSE = 64
+_PARSE_SAMPLE = 64
+
+
+def note_parse(n_rows: int, n_cols: int, seconds: float) -> None:
+    """Sampled recording of one host-side request parse (the row codec
+    / columnar convert): rows+cols → measured seconds becomes a
+    ``serving_parse`` training row, so ladder derivation and other
+    host-cost consumers can PREDICT what a b-row request costs on host
+    instead of treating parse as free. Residuals are not scored here —
+    parse predictions are consumed inside derive_ladder, which has no
+    per-decision measurement to compare against."""
+    with _SERVING_LOCK:
+        n = _PARSE_COUNTS.get(n_rows, 0)
+        _PARSE_COUNTS[n_rows] = n + 1
+    if n >= _PARSE_DENSE and n % _PARSE_SAMPLE != 0:
+        return
+    from transmogrifai_tpu.perf.features import parse_features
+    note("serving_parse", parse_features(n_rows, n_cols), None, seconds)
 
 
 def harvest_journal(paths: Iterable[str],

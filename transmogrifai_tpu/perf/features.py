@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import Dict, Tuple
 
 __all__ = ["block_features", "hbm_proxy_bytes", "ingest_features",
-           "serving_features"]
+           "parse_features", "serving_features"]
 
 
 def block_features(family: str, static: Tuple, n_configs: int,
@@ -96,3 +96,11 @@ def serving_features(bucket: int) -> Dict[str, float]:
     """Features of one serving device batch: the padded bucket size is
     the compiled shape, which is what drives the latency."""
     return {"bucket": float(bucket)}
+
+
+def parse_features(n_rows: int, n_cols: int) -> Dict[str, float]:
+    """Features of one host-side request parse (row codec / columnar
+    convert): cost is ~affine in rows with a per-column fixed term, so
+    rows, cols, and their product carry the fit."""
+    return {"rows": float(n_rows), "cols": float(n_cols),
+            "cells": float(n_rows * n_cols)}

@@ -83,7 +83,8 @@ def bucket_ladder(max_batch: int, min_bucket: int = 1) -> Tuple[int, ...]:
 
 def derive_ladder(max_batch: int, min_bucket: int = 1,
                   sizes: Optional[List[int]] = None, model=None,
-                  pad_tolerance: float = 0.08) -> Tuple[int, ...]:
+                  pad_tolerance: float = 0.08,
+                  n_cols: int = 0) -> Tuple[int, ...]:
     """Bucket ladder from the OBSERVED request-size distribution plus
     the cost model's predicted per-bucket latency (`perf/`).
 
@@ -98,7 +99,15 @@ def derive_ladder(max_batch: int, min_bucket: int = 1,
     is flat across neighboring shapes, rungs collapse and the jit cache
     holds fewer programs; where latency climbs steeply, the
     traffic-shaped rungs stay. ``max_batch`` is always the top rung
-    (every admitted request must fit)."""
+    (every admitted request must fit).
+
+    ``n_cols`` (the serving schema width) lets a fitted
+    ``serving_parse`` target fold the HOST parse cost of a b-row
+    request into each rung's predicted latency — host work is part of
+    what a client waits for, so a rung whose device latency is flat
+    but whose parse cost climbs is judged on the sum. A cold parse
+    target adds nothing (device-only pruning, the pre-parse-target
+    behavior, exactly)."""
     base = bucket_ladder(max_batch, min_bucket)
     if model is None or not sizes:
         return base
@@ -113,6 +122,12 @@ def derive_ladder(max_batch: int, min_bucket: int = 1,
         if p is None:
             return base  # cold target: today's ladder exactly
         preds[b] = p.value
+        if n_cols > 0:
+            from transmogrifai_tpu.perf.features import parse_features
+            pp = model.predict("serving_parse",
+                               parse_features(b, n_cols))
+            if pp is not None:
+                preds[b] += pp.value
     keep = [cand[-1]]  # the cap must always be reachable
     for b in reversed(cand[:-1]):
         if preds[keep[-1]] > (1.0 + pad_tolerance) * preds[b]:
@@ -134,24 +149,48 @@ def bucket_for(n_rows: int, ladder: Tuple[int, ...]) -> int:
 
 
 class Request:
-    """One in-flight scoring request: rows already parsed to a Dataset,
-    a future the caller blocks on, an absolute deadline, and (when
-    request tracing is on) the `obs.trace.RequestTrace` span buffer the
-    scoring thread backdates its per-batch phase spans into."""
+    """One in-flight scoring request: a future the caller blocks on, an
+    absolute deadline, and (when request tracing is on) the
+    `obs.trace.RequestTrace` span buffer the scoring thread backdates
+    its per-batch phase spans into.
 
-    __slots__ = ("dataset", "n_rows", "deadline", "enqueued_at",
-                 "trace", "_event", "_result", "_error")
+    The payload is EITHER an already-columnar Dataset (the columnar
+    wire, internal callers) or raw row dicts + the model schema (the
+    row wire): row requests defer the pivot so the scoring thread can
+    encode a whole batch's rows through ONE compiled-codec pass during
+    staging — per-request `dataset` access (quarantine re-scores, the
+    legacy concat path) encodes lazily and caches."""
 
-    def __init__(self, dataset: Dataset, deadline: Optional[float],
-                 trace=None):
-        self.dataset = dataset
-        self.n_rows = len(dataset)
+    __slots__ = ("_dataset", "rows", "_schema", "n_rows", "deadline",
+                 "enqueued_at", "trace", "_event", "_result", "_error")
+
+    def __init__(self, dataset: Optional[Dataset],
+                 deadline: Optional[float], trace=None,
+                 rows: Optional[List[Dict[str, Any]]] = None,
+                 schema: Optional[Dict[str, type]] = None):
+        if dataset is None and rows is None:
+            raise ValueError("Request needs a dataset or rows")
+        self._dataset = dataset
+        self.rows = rows if dataset is None else None
+        self._schema = schema
+        self.n_rows = len(dataset) if dataset is not None else len(rows)
         self.deadline = deadline          # absolute time.monotonic() or None
         self.enqueued_at = time.monotonic()
         self.trace = trace                # Optional[RequestTrace]
         self._event = threading.Event()
         self._result: Optional[Tuple[Dict[str, Any], str]] = None
         self._error: Optional[ScoreError] = None
+
+    @property
+    def dataset(self) -> Dataset:
+        """The request's columnar payload; row-wire requests encode on
+        first access (scoring-thread-only by the threading model) and
+        cache the result."""
+        if self._dataset is None:
+            from transmogrifai_tpu.data.rowcodec import encode_rows
+            self._dataset = encode_rows(self.rows, self._schema)
+            self.rows = None
+        return self._dataset
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None

@@ -650,20 +650,46 @@ class FleetService:
               tenant: Optional[str] = None,
               deadline_ms: Optional[float] = None,
               trace: Optional[TraceContext] = None):
-        """Route one request: resolve the model, pass tenant admission
-        (token-bucket quota + priority shedding against the target
-        model's queue pressure), then score through that model's own
-        micro-batcher. Per-tenant accounting happens here so every
+        """Route one row-wire request: resolve the model, pass tenant
+        admission (token-bucket quota + priority shedding against the
+        target model's queue pressure), then score through that model's
+        own micro-batcher. Per-tenant accounting happens here so every
         member's latency lands in the tenant's labeled series.
 
         The request trace OPENS here (not in the member), so router
         admission is its first phase child and an admission-shed
         request still leaves a kept trace (sheds are errors to the
         tail sampler)."""
+        return self._score_routed(
+            model, len(rows or ()), tenant, trace,
+            lambda svc, tr: svc.score(rows, deadline_ms=deadline_ms,
+                                      trace=tr))
+
+    def score_columns(self, model: str, columns: Dict[str, List[Any]],
+                      tenant: Optional[str] = None,
+                      deadline_ms: Optional[float] = None,
+                      trace: Optional[TraceContext] = None):
+        """Columnar request wire through the same admission path as
+        `score` (quota metering in rows, identical shedding/tracing):
+        the member converts columns with no row pivot and its outputs
+        are bit-identical to the row wire for the same data."""
+        n_rows = 0
+        if isinstance(columns, dict):
+            for v in columns.values():
+                n_rows = len(v) if hasattr(v, "__len__") else 0
+                break
+        return self._score_routed(
+            model, n_rows, tenant, trace,
+            lambda svc, tr: svc.score_columns(
+                columns, deadline_ms=deadline_ms, trace=tr))
+
+    def _score_routed(self, model: str, n_rows: int,
+                      tenant: Optional[str],
+                      trace: Optional[TraceContext], member_call):
         svc = self._service(model)
         rt: Optional[RequestTrace] = None
         if self.sampler is not None and svc.sampler is not None:
-            rt = RequestTrace(ctx=trace, rows=len(rows or ()),
+            rt = RequestTrace(ctx=trace, rows=n_rows,
                               tenant=tenant or "default", model=model)
         t0 = time.monotonic()
         try:
@@ -672,7 +698,7 @@ class FleetService:
             with admission:
                 queue_frac = svc._batcher.depth() / max(
                     1, svc.config.max_queue)
-                tname = self.router.admit(tenant, len(rows or ()),
+                tname = self.router.admit(tenant, n_rows,
                                           queue_frac, model=model)
         except ScoreError as e:
             # admission shed: the member never saw this request, so the
@@ -685,14 +711,13 @@ class FleetService:
         with TRACER.span("fleet:score", category="serving",
                          tenant=tname, model=model):
             try:
-                # the member's score() owns the trace from here: phase
+                # the member's scoring owns the trace from here: phase
                 # children, finish, tail sampling, exemplars
-                result = svc.score(rows, deadline_ms=deadline_ms,
-                                   trace=rt if rt is not None else trace)
+                result = member_call(svc, rt if rt is not None else trace)
             except ScoreError as e:
                 self.router.note_error(tname, model, e.code)
                 raise
-        self.router.note_success(tname, model, len(rows),
+        self.router.note_success(tname, model, n_rows,
                                  time.monotonic() - t0)
         return result
 

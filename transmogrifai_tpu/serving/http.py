@@ -270,16 +270,16 @@ class _Handler(_JSONHandler):
                                   "message": f"{type(e).__name__}: {e}"})
 
     def _score(self, body: Dict[str, Any]) -> None:
-        rows = body.get("rows")
-        if rows is None and "row" in body:
-            rows = [body["row"]]
-        if not isinstance(rows, list) or not rows or \
-                not all(isinstance(r, dict) for r in rows):
-            raise ScoreError("bad_request",
-                             'expected {"rows": [{...}, ...]}')
-        result = self.service.score(rows,
-                                    deadline_ms=body.get("deadline_ms"),
-                                    trace=self._trace_ctx())
+        cols = _columnar_payload(body)
+        if cols is not None:
+            result = self.service.score_columns(
+                cols, deadline_ms=body.get("deadline_ms"),
+                trace=self._trace_ctx())
+        else:
+            rows = _row_payload(body)
+            result = self.service.score(
+                rows, deadline_ms=body.get("deadline_ms"),
+                trace=self._trace_ctx())
         self._send_json(200, {
             "scores": result.rows(),
             "model_version": result.model_version,
@@ -305,6 +305,41 @@ class _Handler(_JSONHandler):
             raise ScoreError("bad_request",
                              f"reload failed, keeping current version: "
                              f"{type(e).__name__}: {e}")
+
+
+def _row_payload(body: Dict[str, Any]) -> list:
+    """The row-wire payload: ``{"rows": [{...}, ...]}`` (or the
+    ``{"row": {...}}`` single-row shorthand), validated."""
+    rows = body.get("rows")
+    if rows is None and "row" in body:
+        rows = [body["row"]]
+    if not isinstance(rows, list) or not rows or \
+            not all(isinstance(r, dict) for r in rows):
+        raise ScoreError(
+            "bad_request",
+            'expected {"rows": [{...}, ...]} or {"columns": {...}}')
+    return rows
+
+
+def _columnar_payload(body: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The columnar-wire payload when the body carries one:
+    ``{"columns": {name: [values...], ...}}`` — callers that already
+    hold columns skip the row pivot entirely (the host-data-plane fast
+    wire). Returns None when the body is row-shaped; supplying BOTH
+    forms is ambiguous and rejected."""
+    cols = body.get("columns")
+    if cols is None:
+        return None
+    if "rows" in body or "row" in body:
+        raise ScoreError("bad_request",
+                         'pass either "rows" or "columns", not both')
+    if not isinstance(cols, dict) or not cols or \
+            not all(isinstance(v, list) for v in cols.values()):
+        raise ScoreError(
+            "bad_request",
+            'expected {"columns": {name: [values...], ...}} with one '
+            'list per column')
+    return cols
 
 
 def _jsonable(v: Any) -> Any:
@@ -420,17 +455,18 @@ class _FleetHandler(_JSONHandler):
         if not model:
             raise ScoreError("bad_request",
                              'expected {"model": "name", "rows": [...]}')
-        rows = body.get("rows")
-        if rows is None and "row" in body:
-            rows = [body["row"]]
-        if not isinstance(rows, list) or not rows or \
-                not all(isinstance(r, dict) for r in rows):
-            raise ScoreError("bad_request",
-                             'expected {"rows": [{...}, ...]}')
         tenant = body.get("tenant") or self.headers.get("X-Tenant")
-        result = self.fleet.score(str(model), rows, tenant=tenant,
-                                  deadline_ms=body.get("deadline_ms"),
-                                  trace=self._trace_ctx())
+        cols = _columnar_payload(body)
+        if cols is not None:
+            result = self.fleet.score_columns(
+                str(model), cols, tenant=tenant,
+                deadline_ms=body.get("deadline_ms"),
+                trace=self._trace_ctx())
+        else:
+            rows = _row_payload(body)
+            result = self.fleet.score(str(model), rows, tenant=tenant,
+                                      deadline_ms=body.get("deadline_ms"),
+                                      trace=self._trace_ctx())
         self._send_json(200, {
             "scores": result.rows(),
             "model": model,

@@ -38,7 +38,9 @@ import numpy as np
 
 from transmogrifai_tpu import types as T
 from transmogrifai_tpu.data.dataset import Dataset
-from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.data.rowcodec import columns_dataset, encode_rows
+from transmogrifai_tpu.obs.metrics import (
+    MICRO_LATENCY_BUCKETS, MetricsRegistry)
 from transmogrifai_tpu.obs.trace import (
     TRACER, RequestTrace, TailSampler, TraceContext, TracingParams, now_s)
 from transmogrifai_tpu.runtime.faults import (
@@ -46,9 +48,10 @@ from transmogrifai_tpu.runtime.faults import (
     fault_point)
 from transmogrifai_tpu.serving.batcher import (
     MicroBatcher, Request, ScoreError, bucket_for, bucket_ladder,
-    derive_ladder, pad_requests)
+    derive_ladder)
 from transmogrifai_tpu.serving.resilience import (
     QUARANTINED, MemberHealth, ResilienceParams, Watchdog)
+from transmogrifai_tpu.serving.staging import StagingPool
 from transmogrifai_tpu.workflow.compiled import slice_result_tree
 
 log = logging.getLogger(__name__)
@@ -100,12 +103,18 @@ class ServingConfig:
     # {"enabled": false} turns the layer off
     resilience: Optional[Dict[str, Any]] = None
     # quantized inference ("int8"/"int4", workflow.compiled.ScoringQuant):
-    # the request matrix ships on a per-batch affine narrow wire and
-    # fitted tables compute in narrowed dtypes inside the fused bucket
+    # the request matrix ships on an affine narrow wire and fitted
+    # tables compute in narrowed dtypes inside the fused bucket
     # programs. Stated per-feature tolerance scale/2 =
-    # (hi − lo)/(2·(2^bits − 1)) on each batch's own range; None = exact
-    # f32 scoring. Folded into the fleet's program-sharing signature, so
-    # quantized and f32 members never adopt each other's programs.
+    # (hi − lo)/(2·(2^bits − 1)); "-calibrated" variants quantize
+    # against fit-time fleet-wide ranges persisted with the model
+    # (repeat scores bit-stable across batch compositions, quantization
+    # is a constant-scale vectorized pass during batch staging), bare
+    # modes against each batch's own range; None = exact f32 scoring.
+    # Folded into the fleet's program-sharing signature, so quantized
+    # and f32 members never adopt each other's programs (calibrated and
+    # batch-relative builds of one mode DO share — scale/lo are traced
+    # arguments).
     quantize: Optional[str] = None
     # request-scoped tracing + tail sampling (obs/trace.TracingParams
     # JSON): every /score request gets a span tree (W3C traceparent
@@ -285,6 +294,14 @@ class ScoringService:
         self._batcher = MicroBatcher(
             self.config.max_queue, self.ladder[-1],
             batch_wait_s=self.config.batch_wait_ms / 1000.0)
+        # resident per-bucket batch staging (serving/staging.py): the
+        # scoring thread writes each batch into preallocated buffers —
+        # coalesce + pad are writes, not fresh concat/pad allocations.
+        # Hot-swaps/rollbacks/rebuckets invalidate (generation fence).
+        self._staging = StagingPool()
+        # (rows, seconds) of batch-run row decodes, drained into the
+        # perf corpus by the scoring thread AFTER each pad wall closes
+        self._parse_notes: List[Tuple[int, float]] = []
         self._thread: Optional[threading.Thread] = None
         self._running = False
         # resilience layer: health state machine + breaker + watchdog
@@ -396,12 +413,24 @@ class ScoringService:
         self._m_batch_lat = r.histogram(
             "serving_batch_latency_seconds",
             "device batch execution latency")
+        # µs-resolution buckets: host phases (parse, pad, demux) run in
+        # tens of µs — on the default 100µs-floor ladder they all land
+        # in the first bucket and the interpolated p50 is meaningless
         self._phase_hists = {
             phase: r.histogram(
                 "serving_phase_seconds",
                 "per-request time spent in each serving phase",
-                phase=phase)
+                bounds=MICRO_LATENCY_BUCKETS, phase=phase)
             for phase in self._PHASES}
+        self._m_staging_alloc = r.counter(
+            "serving_staging_allocations_total",
+            "resident batch staging buffer sets (re)allocated")
+        self._m_staging_fallback = r.counter(
+            "serving_staging_fallback_total",
+            "batches the staging pool refused (legacy concat path)")
+        self._m_staging_gen = r.gauge(
+            "serving_staging_generation",
+            "staging-pool generation (bumps on hot-swap/rebucket)")
 
     def _shed(self, reason: str):
         return self.registry.counter(
@@ -452,7 +481,7 @@ class ScoringService:
             hist = self.registry.histogram(
                 "serving_phase_seconds",
                 "per-request time spent in each serving phase",
-                phase=phase)
+                bounds=MICRO_LATENCY_BUCKETS, phase=phase)
             self._phase_hists[phase] = hist
         return hist
 
@@ -517,6 +546,9 @@ class ScoringService:
             del self._versions[:-keep]
             self._active = version
             self._schema = raw_schema(model)
+        # the new model may stage a different column layout: fence the
+        # resident batch buffers (scoring thread reallocates lazily)
+        self._staging.invalidate()
         self.registry.gauge(
             "serving_model_versions", "versions held (active + rollback)"
         ).set(len(self._versions))
@@ -686,6 +718,10 @@ class ScoringService:
         with self._inflight_lock:
             stalled_since = self._busy_since
         self._generation += 1
+        # a stale (formerly wedged) loop that wakes mid-batch may still
+        # WRITE the staging buffers it fetched; orphan them so the
+        # restarted loop allocates a fresh set it alone owns
+        self._staging.invalidate()
         # the recovery gets its own span under the service's trace so
         # the watchdog_restart + health_transition events it emits land
         # in the goodput rollup (the watchdog thread has no ambient span)
@@ -762,10 +798,23 @@ class ScoringService:
         in-process parent span, or a pre-opened `RequestTrace`); every
         exit path — success, shed, deadline, error — finishes the
         request's span tree and runs it through the tail sampler."""
-        rt = self._begin_request_trace(trace, len(rows or ()))
+        return self._traced_score(
+            trace, len(rows or ()),
+            lambda rt: self._score_inner(rows, deadline_ms, timeout_s,
+                                         rt))
+
+    def _traced_score(self, trace: Any, n_rows: int,
+                      inner) -> ScoreResult:
+        """The request-trace envelope shared by BOTH wires: open the
+        span buffer, run `inner(rt)`, and on every exit path — success,
+        shed, deadline, error — finish the trace, run tail sampling,
+        and stamp the trace id onto the result or the raised
+        ScoreError (a failed request must be as correlatable as a slow
+        one)."""
+        rt = self._begin_request_trace(trace, n_rows)
         t0 = time.monotonic()
         try:
-            result = self._score_inner(rows, deadline_ms, timeout_s, rt)
+            result = inner(rt)
         except ScoreError as e:
             self._finish_request_trace(rt, time.monotonic() - t0,
                                        error=e.code)
@@ -786,16 +835,14 @@ class ScoringService:
             result.traceparent = rt.traceparent()
         return result
 
-    def _score_inner(self, rows: List[Dict[str, Any]],
-                     deadline_ms: Optional[float],
-                     timeout_s: Optional[float],
-                     rt: Optional[RequestTrace]) -> ScoreResult:
+    def _admit(self) -> None:
+        """Shared admission preamble for BOTH wires: reject when the
+        service is down, and FAST-FAIL a quarantined member with no
+        resident fallback (structured error + retry-after) instead of
+        queueing into a dead (or known-broken) batcher."""
         if not self._running:
             raise ScoreError("shutdown", "service is not running")
         if self._health is not None:
-            # quarantined member with no resident fallback: FAST-FAIL
-            # with a structured error + retry-after instead of queueing
-            # into a dead (or known-broken) batcher
             retry_after = self._health.admit(self._has_fallback())
             if retry_after is not None:
                 self._shed("circuit_open").inc()
@@ -804,21 +851,52 @@ class ScoringService:
                     f"member quarantined (breaker open / scoring loop "
                     f"down); retry in {retry_after:.2f}s",
                     retry_after_s=retry_after)
+
+    def _score_inner(self, rows: List[Dict[str, Any]],
+                     deadline_ms: Optional[float],
+                     timeout_s: Optional[float],
+                     rt: Optional[RequestTrace]) -> ScoreResult:
+        self._admit()
         if not rows:
             raise ScoreError("bad_request", "empty rows")
-        # request assembly on the caller thread, with the host-side row
-        # parse (Dataset.from_rows — the serving-p50 cost ROADMAP calls
-        # out) as its own timed child so a latency regression here is
-        # attributable per request
+        # the row PIVOT is deferred: admission validates shape only
+        # (per-request wire checks, bucket fit) and the scoring thread
+        # encodes the whole batch's rows through ONE compiled-codec
+        # pass during staging (data/rowcodec.py; amortized host work
+        # replacing the per-request Dataset.from_rows loop ROADMAP
+        # called out as the serving p50 dominator). The parse child
+        # therefore times request-side wire validation; the amortized
+        # batch encode lands in the `pad` (staging) phase.
         if rt is not None:
             with rt.child("serving:assemble") as asm:
                 with rt.child("serving:parse", parent=asm,
                               rows=len(rows)):
-                    ds = self._parse_rows(rows)
-                bucket_for(len(ds), self.ladder)  # must fit a bucket
+                    self._validate_rows(rows)
+                bucket_for(len(rows), self.ladder)  # must fit a bucket
         else:
-            ds = self._parse_rows(rows)
-            bucket_for(len(ds), self.ladder)  # admission: must fit a bucket
+            self._validate_rows(rows)
+            bucket_for(len(rows), self.ladder)  # admission: must fit
+        return self._enqueue(None, deadline_ms, timeout_s, rt,
+                             rows=rows)
+
+    def _validate_rows(self, rows: List[Dict[str, Any]]) -> None:
+        for r in rows:
+            if not isinstance(r, dict):
+                raise ScoreError(
+                    "bad_request",
+                    f"rows must be objects, got {type(r).__name__}")
+
+    def _enqueue(self, ds: Optional[Dataset],
+                 deadline_ms: Optional[float],
+                 timeout_s: Optional[float],
+                 rt: Optional[RequestTrace],
+                 rows: Optional[List[Dict[str, Any]]] = None
+                 ) -> ScoreResult:
+        """Shared post-parse half of row and columnar scoring: deadline
+        resolution, admission into the micro-batcher, and the blocking
+        wait on the request future. Both wires land here, so mixed
+        row/columnar traffic coalesces into the same batches and shares
+        one bucket ladder."""
         if deadline_ms is None:
             ddl_ms = self.config.default_deadline_ms
         else:
@@ -830,8 +908,10 @@ class ScoringService:
                     f"deadline_ms must be a number, got {deadline_ms!r}")
         deadline = (time.monotonic() + ddl_ms / 1000.0) if ddl_ms > 0 \
             else None
-        self._sizes.append(len(ds))
-        req = Request(ds, deadline, trace=rt)
+        n_rows = len(ds) if ds is not None else len(rows)
+        self._sizes.append(n_rows)
+        req = Request(ds, deadline, trace=rt, rows=rows,
+                      schema=self._schema)
         if rt is not None:
             rt.enqueued_s = now_s()  # queue-wait span starts here
         try:
@@ -849,12 +929,85 @@ class ScoringService:
                            n_rows=req.n_rows, latency_s=latency)
 
     def _parse_rows(self, rows: List[Dict[str, Any]]) -> Dataset:
+        """Row wire → Dataset through the compiled codec cache (kept
+        for embedded callers and tests — the serving path itself now
+        defers the pivot to batch staging). The FULL raw schema is
+        passed (not a rows[0]-filtered subset): a column absent from
+        the first row but present in a later one must still be
+        schema-typed, never value-inferred — the old filter produced
+        dtype-inconsistent batches on ragged first rows."""
         try:
-            return Dataset.from_rows(
-                rows, schema={k: v for k, v in self._schema.items()
-                              if k in rows[0]})
+            return encode_rows(rows, self._schema)
         except Exception as e:
             raise ScoreError("bad_request", f"unparseable rows: {e}")
+
+    def _note_parse(self, n_rows: int, seconds: float) -> None:
+        """Sampled host-parse cost into the perf corpus
+        (`serving_parse` target): the ladder derivation and any other
+        host-cost consumer can then PREDICT parse seconds per request
+        size instead of assuming host work is free. Never raises."""
+        try:
+            from transmogrifai_tpu import perf
+            perf.note_parse(n_rows, len(self._schema), seconds)
+        except Exception:
+            log.debug("perf parse recording failed", exc_info=True)
+
+    def score_columns(self, columns: Dict[str, List[Any]],
+                      deadline_ms: Optional[float] = None,
+                      timeout_s: Optional[float] = None,
+                      trace: Any = None) -> ScoreResult:
+        """Columnar request wire: score ``{name: [values...]}`` with NO
+        row pivot — callers that already hold columns (feature stores,
+        batch scorers, the HTTP ``{"columns": ...}`` body) skip the
+        per-row parse entirely; outputs are bit-identical to the row
+        wire for the same data. Ragged lengths, unknown columns, and
+        undeclarable cell types are structured ``bad_request``s.
+        Columnar and row traffic coalesce into the same device batches
+        (one bucket ladder)."""
+        if not isinstance(columns, dict) or not columns:
+            raise ScoreError("bad_request",
+                             'expected {"columns": {name: [values...]}}')
+        n_rows = 0
+        for v in columns.values():
+            n_rows = len(v) if hasattr(v, "__len__") else 0
+            break
+        return self._traced_score(
+            trace, n_rows,
+            lambda rt: self._score_columns_inner(columns, deadline_ms,
+                                                 timeout_s, rt))
+
+    def _score_columns_inner(self, columns: Dict[str, List[Any]],
+                             deadline_ms: Optional[float],
+                             timeout_s: Optional[float],
+                             rt: Optional[RequestTrace]) -> ScoreResult:
+        self._admit()
+        t0 = time.perf_counter()
+        if rt is not None:
+            with rt.child("serving:assemble") as asm:
+                with rt.child("serving:parse", parent=asm,
+                              columnar=True):
+                    ds = self._parse_columns(columns)
+                bucket_for(len(ds), self.ladder)
+        else:
+            ds = self._parse_columns(columns)
+            bucket_for(len(ds), self.ladder)
+        # perf-corpus note AFTER the span: corpus appends are sampled
+        # file IO and must never pollute the parse timing they record
+        self._note_parse(len(ds), time.perf_counter() - t0)
+        return self._enqueue(ds, deadline_ms, timeout_s, rt)
+
+    def _parse_columns(self, columns: Dict[str, List[Any]]) -> Dataset:
+        try:
+            ds = columns_dataset(columns, self._schema,
+                                 strict_schema=True)
+        except ValueError as e:
+            raise ScoreError("bad_request", f"bad columnar payload: {e}")
+        except Exception as e:
+            raise ScoreError("bad_request",
+                             f"unparseable columnar payload: {e}")
+        if len(ds) == 0:
+            raise ScoreError("bad_request", "empty columns")
+        return ds
 
     def score_row(self, row: Dict[str, Any], **kw) -> Dict[str, Any]:
         """Single-row convenience: returns the one result row dict."""
@@ -915,6 +1068,7 @@ class ScoringService:
             self._active = restored
             self._schema = raw_schema(restored.model)
             n_versions = len(self._versions)
+        self._staging.invalidate()  # restored model's layout may differ
         self.registry.gauge(
             "serving_model_versions", "versions held (active + rollback)"
         ).set(n_versions)
@@ -942,7 +1096,8 @@ class ScoringService:
         except Exception:
             model = None
         return derive_ladder(self.config.max_batch, self.config.min_bucket,
-                             list(self._sizes), model)
+                             list(self._sizes), model,
+                             n_cols=len(self._schema))
 
     def rebucket(self) -> Dict[str, Any]:
         """Re-derive the bucket ladder from observed traffic + predicted
@@ -972,6 +1127,7 @@ class ScoringService:
         old = self.ladder
         with self._swap_lock:
             self.ladder = new
+        self._staging.invalidate()  # per-bucket buffers keyed off rungs
         self.registry.counter(
             "serving_rebuckets_total",
             "bucket-ladder re-derivations applied").inc()
@@ -1026,6 +1182,12 @@ class ScoringService:
             "buckets": list(self.ladder),
             "compile_cache": self._compile_cache_path,
             "versions": [v.info() for v in self._versions],
+            "staging": {
+                "generation": self._staging.generation,
+                "allocations": self._staging.allocations,
+                "assembled": self._staging.assembled,
+                "fallbacks": self._staging.fallbacks,
+            },
         }
         if self._health is not None:
             out["health"] = self._health.snapshot()
@@ -1165,13 +1327,14 @@ class ScoringService:
                          version=version.version_id) as sp:
             try:
                 # batch ASSEMBLY quarantines too: two requests with
-                # mismatched column sets fail Dataset.concat, and that
-                # must degrade to per-request scoring, not kill the
-                # batch — and it is NOT a device failure, so it feeds
-                # the health window but never the breaker
+                # mismatched column sets/ftypes fail staging AND the
+                # concat fallback, and that must degrade to per-request
+                # scoring, not kill the batch — and it is NOT a device
+                # failure, so it feeds the health window but never the
+                # breaker
                 fault_point(self._fault_site(SITE_BATCH_ASSEMBLE))
                 t_pad0 = now_s()
-                ds, n_valid, bucket = pad_requests(batch, self.ladder)
+                ds, n_valid, bucket = self._assemble_batch(batch)
                 t_pad1 = now_s()
                 sp.set(bucket=bucket, rows=n_valid)
             except Exception as e:
@@ -1184,6 +1347,12 @@ class ScoringService:
             for r in traced:
                 r.trace.child_at("serving:pad", t_pad0, t_pad1,
                                  bucket=bucket, batch_rows=n_valid)
+            if self._parse_notes:
+                # pad wall is closed: the sampled corpus appends can
+                # no longer pollute the timing they record
+                for n_rows, secs in self._parse_notes:
+                    self._note_parse(n_rows, secs)
+                self._parse_notes = []
             t_d0 = now_s()
             try:
                 if mode != "fallback":
@@ -1244,6 +1413,95 @@ class ScoringService:
             req.resolve(sliced, version.version_id)
             off += req.n_rows
 
+    def _assemble_batch(self, batch: List[Request]
+                        ) -> Tuple[Dataset, int, int]:
+        """Coalesce + pad through the resident staging pool: the
+        batch's ROW-WIRE requests are decoded by ONE compiled-codec
+        pass per aligned run (amortized host parse — the scoring
+        thread pays one pivot per batch, not the callers one per
+        request), every part's columns are WRITTEN into slices of the
+        per-bucket staging block, and the pad tail repeats the last
+        valid row — zero fresh staging allocations in steady state
+        (the parse-smoke assert). The staged dataset is already
+        bucket-sized, so `score_padded`'s own concat+pad path no-ops
+        and the device write reads straight off the staging buffers.
+        Batches the pool refuses (mixed column layouts, exact-int
+        object columns) take the legacy concat path — correctness
+        never depends on staging."""
+        pool = self._staging
+        n_valid = sum(r.n_rows for r in batch)
+        bucket = bucket_for(n_valid, self.ladder)
+        parts = self._encode_parts(batch)
+        alloc0, fb0 = pool.allocations, pool.fallbacks
+        staged = pool.assemble(parts, n_valid, bucket)
+        self._m_staging_alloc.inc(pool.allocations - alloc0)
+        self._m_staging_gen.set(pool.generation)
+        if staged is None:
+            self._m_staging_fallback.inc(pool.fallbacks - fb0)
+            ds = Dataset.concat(parts) if len(parts) > 1 else parts[0]
+            return ds, n_valid, bucket
+        return staged, n_valid, bucket
+
+    def _encode_parts(self, batch: List[Request]) -> List[Dataset]:
+        """Order-preserving Dataset parts for one batch: already-
+        columnar requests pass through; consecutive row-wire requests
+        whose rows all share one key order decode through a SINGLE
+        `RowCodec.encode_aligned` call (one pivot + one bulk cast for
+        the whole run). A run with mixed key orders degrades to
+        per-request encodes (each request keeps its own column-union
+        semantics — two requests with different column sets must fail
+        assembly exactly like the eager path did). Runs group by each
+        request's ENQUEUE-TIME schema object, never the live
+        `self._schema`: a hot-swap between enqueue and assembly must
+        not re-type queued requests against the new model."""
+        from transmogrifai_tpu.data.rowcodec import codec_for
+        parts: List[Dataset] = []
+        run: List[Request] = []
+        run_schema: Optional[Dict[str, type]] = None
+
+        def flush() -> None:
+            if not run:
+                return
+            t0 = time.perf_counter()
+            k0 = None
+            vals: List[Any] = []
+            aligned = True
+            for req in run:
+                for r in req.rows:
+                    kt = tuple(r)
+                    if k0 is None:
+                        k0 = kt
+                    elif kt != k0:
+                        aligned = False
+                        break
+                    vals.append(r.values())
+                if not aligned:
+                    break
+            if aligned:
+                parts.append(codec_for(k0, run_schema)
+                             .encode_aligned(vals, len(vals)))
+            else:
+                parts.extend(req.dataset for req in run)
+            # deferred to _process AFTER the pad wall closes: the
+            # sampled corpus append is file IO and must not ride the
+            # pad-phase timing it helps explain
+            self._parse_notes.append(
+                (sum(req.n_rows for req in run),
+                 time.perf_counter() - t0))
+            run.clear()
+
+        for req in batch:
+            if req._dataset is not None:
+                flush()
+                parts.append(req._dataset)
+            else:
+                if run and req._schema is not run_schema:
+                    flush()
+                run_schema = req._schema
+                run.append(req)
+        flush()
+        return parts
+
     def _note_dispatch(self, ok: bool, mode: str) -> None:
         """Primary-path dispatch outcomes feed the breaker; fallback
         dispatches prove nothing about the broken primary and stay out."""
@@ -1269,12 +1527,27 @@ class ScoringService:
                       mode: str = "primary",
                       gen: Optional[int] = None) -> None:
         t0 = time.monotonic()
+        # materialize the (possibly deferred) row decode BEFORE the
+        # dispatch site: a client-malformed payload is a bad_request —
+        # an INPUT problem, never a member outcome — so it must feed
+        # neither the circuit breaker nor the health error-rate window
+        # (either would let sustained malformed traffic from one client
+        # quarantine a healthy member for every tenant)
+        try:
+            ds = req.dataset
+        except Exception as e:
+            if self._live(gen):
+                self._m_errors.inc()
+            req.fail(ScoreError(
+                "bad_request",
+                f"unparseable rows: {type(e).__name__}: {e}"))
+            return
         t_d0 = now_s()
         try:
             bucket = bucket_for(req.n_rows, self.ladder)
             if mode != "fallback":
                 fault_point(self._fault_site(SITE_DEVICE_DISPATCH))
-            out = version.scorer.score_padded(req.dataset, bucket)
+            out = version.scorer.score_padded(ds, bucket)
             if req.trace is not None:
                 req.trace.child_at("serving:device_dispatch", t_d0,
                                    now_s(), bucket=bucket, mode=mode,

@@ -1,0 +1,149 @@
+"""Reusable batch staging: coalesce + pad as WRITES, not allocations.
+
+Before this module, every device batch the scoring thread assembled
+paid ``Dataset.concat`` (one fresh ``np.concatenate`` per column) plus
+a fresh pad allocation inside ``score_padded`` (``pad_dataset`` builds
+a repeat-index array and concatenates again). Per dispatch that is
+2×n_columns fresh arrays whose sizes are ALWAYS one of the ladder's
+bucket sizes — the textbook case for resident staging buffers.
+
+``StagingPool`` owns one preallocated buffer set per (bucket, column
+layout): batch assembly writes each request's columns into slices of
+the resident block, the pad tail is a broadcast write repeating the
+last valid row (the same pad-row discipline ``pad_dataset`` documents —
+pad rows take the exact host-encode path valid rows take and never
+widen a quantized batch's value range), and the Dataset handed to the
+compiled scorer wraps the resident buffers directly, already at bucket
+size — ``score_padded`` sees ``len(ds) == pad_to`` and its own concat +
+pad path becomes a no-op. The donated device write then reads straight
+off the staging block.
+
+Ownership and fencing: the pool is owned by the SINGLE scoring thread —
+assembly never locks. Hot-swaps, rollbacks, and ladder rebuckets call
+``invalidate()`` (any thread): the generation counter bumps and the
+buffer map clears, so the next assemble reallocates against the new
+schema/ladder while a batch mid-flight keeps the references it already
+holds (buffers are never mutated by anyone but the scoring thread, and
+the scoring thread finishes its dispatch before assembling the next
+batch).
+
+``allocations`` counts buffer (re)allocations — the steady-state proof
+``make parse-smoke`` asserts: after warmup, scoring traffic performs
+ZERO fresh batch-block allocations. ``fallbacks`` counts batches the
+pool refused (mixed column layouts, exact-int object columns where the
+resident buffer is float64) — those take the legacy concat path so
+correctness never depends on the buffers fitting.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from transmogrifai_tpu.data.dataset import Dataset, _dataset_unchecked
+
+__all__ = ["StagingPool"]
+
+
+def _layout(ds: Dataset) -> Tuple:
+    """Column layout signature of one request dataset: names in order,
+    storage dtype kind, and schema ftype per column. Two requests stage
+    into the same buffers only when their layouts are IDENTICAL —
+    same-named columns with different ftypes or storage classes must
+    not silently share a batch (Dataset.concat's validation, enforced
+    structurally here)."""
+    return tuple(
+        (name, arr.dtype, ds.schema.get(name))
+        for name, arr in ds.columns.items())
+
+
+class StagingPool:
+    """Per-bucket resident staging buffers for the scoring thread."""
+
+    def __init__(self):
+        self._buffers: Dict[Tuple[int, Tuple], Dict[str, np.ndarray]] = {}
+        self._gen_lock = threading.Lock()
+        self.generation = 0      # bumped by invalidate()
+        self.allocations = 0     # buffer sets (re)allocated
+        self.fallbacks = 0       # batches refused (legacy concat path)
+        self.assembled = 0       # batches staged through the pool
+
+    def invalidate(self) -> None:
+        """Drop every resident buffer (hot-swap / rollback / rebucket:
+        the column layout or the bucket ladder changed). Safe from any
+        thread — the scoring thread re-allocates lazily on its next
+        assemble and never writes a dropped buffer again (it fetches
+        buffers fresh per batch)."""
+        with self._gen_lock:
+            self.generation += 1
+            self._buffers = {}
+
+    # -- assembly (scoring thread only) ------------------------------------ #
+
+    def assemble(self, parts: List[Dataset], n_valid: int,
+                 bucket: int) -> Optional[Dataset]:
+        """Write `parts` (total `n_valid` rows) into the resident block
+        for `bucket` and pad the tail by repeating the last valid row.
+        Returns a bucket-sized Dataset over the resident buffers, or
+        None when the batch cannot stage (mixed layouts / dtype drift)
+        — the caller then takes the legacy concat path.
+
+        Raises ValueError on an EMPTY parts list (a batch always has
+        requests). A mixed-ftype batch returns None rather than raising
+        so the caller's per-request quarantine semantics stay exactly
+        as they were."""
+        if not parts:
+            raise ValueError("assemble: empty batch")
+        gen = self.generation
+        first = parts[0]
+        layout = _layout(first)
+        for p in parts[1:]:
+            if _layout(p) != layout:
+                self.fallbacks += 1
+                return None
+        key = (bucket, layout)
+        bufs = self._buffers.get(key)
+        if bufs is None:
+            # buffers mirror the request columns' exact storage dtypes:
+            # the staged block must be bit-identical to what the legacy
+            # concat path would have produced
+            bufs = {name: np.empty(bucket, dtype=dtype)
+                    for name, dtype, _ in layout}
+            with self._gen_lock:
+                if self.generation != gen:
+                    # a watchdog restart fenced us off mid-assemble: a
+                    # STALE loop must not install buffers into the map
+                    # the restarted loop now owns (two writers on one
+                    # block); take the allocation-free fallback instead
+                    self.fallbacks += 1
+                    return None
+                self._buffers[key] = bufs
+            self.allocations += 1
+        off = 0
+        for p in parts:
+            n = len(p)
+            for name, arr in p.columns.items():
+                bufs[name][off:off + n] = arr
+            off += n
+        if off != n_valid or off == 0 or off > bucket:
+            # row accounting drifted (caller bug) — refuse rather than
+            # ship a half-written block
+            self.fallbacks += 1
+            return None
+        if off < bucket:
+            for name, _, _ in layout:
+                buf = bufs[name]
+                if buf.dtype == object:
+                    # fill(), not slice-assign: a sequence-valued cell
+                    # (list/map column) must repeat as ONE object, not
+                    # broadcast its elements
+                    buf[off:bucket].fill(buf[off - 1])
+                else:
+                    buf[off:bucket] = buf[off - 1]  # repeat last valid row
+        self.assembled += 1
+        # schema dict is shared with the first request's dataset —
+        # Dataset transforms copy-on-write it, nothing mutates in place
+        return _dataset_unchecked(
+            {name: bufs[name] for name, _, _ in layout}, first.schema)

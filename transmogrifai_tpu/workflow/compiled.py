@@ -68,12 +68,25 @@ class ScoringQuant:
     """Quantized-inference mode for the compiled scorer: ``"int8"``
     ships 1 byte/element on the wire, ``"int4"`` half that (two
     features per byte). Per-feature max abs error is scale/2 with
-    scale = (hi − lo)/(2^bits − 1) over the BATCH's own value range —
-    a request therefore quantizes against its batchmates' range, so
-    repeat scoring of one row in different batches agrees within the
-    stated tolerance, not bitwise."""
+    scale = (hi − lo)/(2^bits − 1).
+
+    ``calibrated=False`` (batch-relative, the PR-13 wire): [lo, hi] is
+    each BATCH's own value range — a request quantizes against its
+    batchmates, so repeat scoring of one row in different batches
+    agrees within the stated tolerance, not bitwise.
+
+    ``calibrated=True`` (``"int8-calibrated"``/``"int4-calibrated"``):
+    [lo, hi] comes from the per-feature ranges captured at FIT time and
+    persisted with the model (``WorkflowModel.quant_calibration``, the
+    fingerprint pass's range sidecar) — scale/lo are constants of the
+    model, quantization is a single vectorized pass with no per-batch
+    range scan, and repeat scores of one row are BIT-STABLE across
+    batch compositions. Serving values outside the training range clip
+    to it (the fleet-wide contract: the model never saw them either).
+    A model with no captured calibration falls back batch-relative."""
 
     mode: str = "int8"
+    calibrated: bool = False
 
     def __post_init__(self) -> None:
         if self.mode not in ("int8", "int4"):
@@ -87,10 +100,14 @@ class ScoringQuant:
 
     @staticmethod
     def resolve(q: Any) -> Optional["ScoringQuant"]:
-        """None | "int8" | "int4" | ScoringQuant -> Optional[ScoringQuant]."""
+        """None | "int8[-calibrated]" | "int4[-calibrated]" |
+        ScoringQuant -> Optional[ScoringQuant]."""
         if q is None or isinstance(q, ScoringQuant):
             return q
-        return ScoringQuant(str(q))
+        s = str(q)
+        if s.endswith("-calibrated"):
+            return ScoringQuant(s[:-len("-calibrated")], calibrated=True)
+        return ScoringQuant(s)
 
 
 # -- quantized request wire -------------------------------------------------- #
@@ -106,27 +123,40 @@ def _pack4_np(q: np.ndarray) -> np.ndarray:
     return (q[:, 0::2] | (q[:, 1::2] << 4)).astype(np.uint8)
 
 
-def quantize_leaf(arr: np.ndarray, bits: int) -> Dict[str, np.ndarray]:
+def quantize_leaf(arr: np.ndarray, bits: int,
+                  lo: Optional[np.ndarray] = None,
+                  hi: Optional[np.ndarray] = None
+                  ) -> Dict[str, np.ndarray]:
     """Host half of the quantized wire: per-feature affine uint8 of one
-    (n,) or (n, d) float leaf against the batch's own [lo, hi] range.
-    NaN quantizes to lo (uint8 casts of NaN are platform-undefined),
-    ±inf clips to the range bounds. The "q1" key marks a 1-D leaf so
-    the device side restores the original rank."""
+    (n,) or (n, d) float leaf. NaN quantizes to lo (uint8 casts of NaN
+    are platform-undefined), values outside [lo, hi] clip to the range
+    bounds. The "q1" key marks a 1-D leaf so the device side restores
+    the original rank.
+
+    With ``lo``/``hi`` given (CALIBRATED ranges captured at fit time),
+    the batch's own min/max pass is skipped entirely and the affine
+    constants are batch-independent — repeat scores are bit-stable
+    across batch compositions. Without them, [lo, hi] is the batch's
+    own finite range (a single ±inf must not degenerate the fit and
+    corrupt its finite batchmates)."""
     a = np.asarray(arr, np.float32)
     one_d = a.ndim == 1
     if one_d:
         a = a[:, None]
-    import warnings
-    with np.errstate(invalid="ignore"), warnings.catch_warnings():
-        warnings.simplefilter("ignore", RuntimeWarning)
-        # FINITE range only: a single ±inf must not degenerate the
-        # affine fit and corrupt its finite batchmates — non-finite
-        # values fall outside [lo, hi] and clip to the bounds below
-        fin = np.where(np.isfinite(a), a, np.nan)
-        lo = np.nanmin(fin, axis=0) if a.shape[0] else np.zeros(a.shape[1])
-        hi = np.nanmax(fin, axis=0) if a.shape[0] else np.zeros(a.shape[1])
-    lo = np.where(np.isfinite(lo), lo, 0.0).astype(np.float32)
-    hi = np.where(np.isfinite(hi), hi, lo).astype(np.float32)
+    if lo is None or hi is None:
+        import warnings
+        with np.errstate(invalid="ignore"), warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            fin = np.where(np.isfinite(a), a, np.nan)
+            lo = np.nanmin(fin, axis=0) if a.shape[0] \
+                else np.zeros(a.shape[1])
+            hi = np.nanmax(fin, axis=0) if a.shape[0] \
+                else np.zeros(a.shape[1])
+        lo = np.where(np.isfinite(lo), lo, 0.0).astype(np.float32)
+        hi = np.where(np.isfinite(hi), hi, lo).astype(np.float32)
+    else:
+        lo = np.asarray(lo, np.float32).reshape(-1)
+        hi = np.asarray(hi, np.float32).reshape(-1)
     qmax = float((1 << bits) - 1)
     scale = np.where(hi > lo, (hi - lo) / qmax, 1.0).astype(np.float32)
     q = np.rint((a - lo) / scale)
@@ -157,20 +187,41 @@ def dequantize_leaf(wire: Dict[str, Any], bits: int):
 _WIRE_KEYS = ({"q", "scale", "lo"}, {"q1", "scale", "lo"})
 
 
-def quantize_wire(tree: Any, bits: int) -> Any:
+def quantize_wire(tree: Any, bits: int,
+                  ranges: Optional[Dict[str, Any]] = None) -> Any:
     """Structure-preserving wire form of a host device-input pytree:
     float numpy leaves become affine uint8 wire dicts, "mask" leaves
     (exact 0/1 floats by the Column contract) become exact uint8, and
     anything already on device (jax arrays from an earlier segment)
-    passes through untouched."""
-    def walk(node, key=None):
+    passes through untouched.
+
+    ``ranges`` maps column uid (the tree's top-level keys) to
+    ``{"lo": [...], "hi": [...]}`` calibrated fit-time ranges: a leaf
+    whose uid has a matching-width entry quantizes against the FIXED
+    range (bit-stable across batches); others fall back to the
+    batch-relative pass."""
+    def leaf_ranges(rng, width: int):
+        if rng is None:
+            return None, None
+        lo = np.asarray(rng.get("lo"), np.float32).reshape(-1)
+        hi = np.asarray(rng.get("hi"), np.float32).reshape(-1)
+        if lo.shape[0] != width or hi.shape[0] != width:
+            return None, None  # stale calibration: batch-relative leaf
+        return lo, hi
+
+    def walk(node, key=None, rng=None):
         if isinstance(node, dict):
-            return {k: walk(v, k) for k, v in node.items()}
+            return {k: walk(v, k,
+                            (ranges.get(k) if ranges is not None
+                             and k in ranges else rng))
+                    for k, v in node.items()}
         if isinstance(node, np.ndarray) and node.dtype.kind == "f":
             if key == "mask":
                 return node.astype(np.uint8)
             if node.ndim in (1, 2):
-                return quantize_leaf(node, bits)
+                width = 1 if node.ndim == 1 else node.shape[1]
+                lo, hi = leaf_ranges(rng, width)
+                return quantize_leaf(node, bits, lo=lo, hi=hi)
         return node
     return walk(tree)
 
@@ -254,6 +305,23 @@ class CompiledScorer:
         # quantized inference mode (module docstring): request matrix on
         # the narrow wire, fitted tables in narrowed dtypes
         self.quant = ScoringQuant.resolve(quant)
+        # calibrated quant ranges: fit-time per-column [lo, hi] persisted
+        # with the model (uid -> {"lo": [...], "hi": [...]}). Scale/lo
+        # ride as traced ARGUMENTS, so calibrated and batch-relative
+        # builds share the same compiled programs (and the fleet's
+        # program-sharing signature) — only the wire constants differ.
+        self._cal_ranges: Optional[Dict[str, Any]] = None
+        if self.quant is not None and self.quant.calibrated:
+            cal = getattr(model, "quant_calibration", None)
+            if cal:
+                self._cal_ranges = dict(cal)
+            else:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "calibrated quantization requested but the model "
+                    "carries no quant_calibration (artifact predates "
+                    "fit-time range capture); falling back to "
+                    "batch-relative ranges")
         layers = topological_layers(model.result_features)
         self.generators: List[FeatureGeneratorStage] = list(layers[0]) if layers else []
         ordered: List[Transformer] = []
@@ -443,7 +511,8 @@ class CompiledScorer:
             # quantize HERE, before placement: streaming workers
             # device_put this pytree, so the narrow wire is what crosses
             # the host→device link (1 byte/elem int8, 0.5 int4)
-            raw_dev = quantize_wire(raw_dev, self.quant.bits)
+            raw_dev = quantize_wire(raw_dev, self.quant.bits,
+                                    ranges=self._cal_ranges)
         n_rows = len(dataset)
         return (self._place(encs, n_rows), self._place(raw_dev, n_rows),
                 columns)
@@ -528,7 +597,8 @@ class CompiledScorer:
                     # device arrays from earlier segments pass through
                     # (quantizing them would round-trip HBM→host)
                     args = self._place(
-                        quantize_wire(dev_vals, self.quant.bits), n_rows)
+                        quantize_wire(dev_vals, self.quant.bits,
+                                      ranges=self._cal_ranges), n_rows)
                 dev_vals.update(
                     self._dispatch(seg_idx, self._place(encs, n_rows), args))
         return dev_vals, columns

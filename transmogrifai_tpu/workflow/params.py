@@ -85,10 +85,11 @@ class ServingParams:
     # circuit breaker + degraded fallback, hang watchdog (None =
     # defaults, enabled; {"enabled": false} turns the layer off)
     resilience: Optional[Dict[str, Any]] = None
-    # quantized inference mode ("int8"/"int4"): request matrix on a
-    # per-batch affine narrow wire + narrowed fitted-table dtypes inside
-    # the fused bucket programs (workflow/compiled.ScoringQuant; None =
-    # exact f32 scoring)
+    # quantized inference mode ("int8"/"int4", or "int8-calibrated"/
+    # "int4-calibrated" for fit-time fleet-wide ranges with bit-stable
+    # repeat scores): request matrix on an affine narrow wire +
+    # narrowed fitted-table dtypes inside the fused bucket programs
+    # (workflow/compiled.ScoringQuant; None = exact f32 scoring)
     quantize: Optional[str] = None
     # request-scoped tracing + tail sampling (obs/trace.TracingParams
     # JSON; None = defaults, ON; {"enabled": false} disables)

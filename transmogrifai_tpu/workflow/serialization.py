@@ -191,6 +191,12 @@ def save_model(model, path: str, overwrite: bool = True,
         "features": [_feature_entry(features[uid]) for uid in order],
         "stages": stage_entries,
     }
+    # fit-time quantization calibration rides the sealed manifest (it
+    # is small JSON keyed by the same uids): a reloaded model serves
+    # bit-stable calibrated quant without re-deriving anything
+    cal = getattr(model, "quant_calibration", None)
+    if cal:
+        manifest["quant_calibration"] = cal
 
     # -- stage everything in a temp sibling (same filesystem => same-dir
     #    rename is atomic); a kill in here never touches `path` ---------- #
@@ -416,4 +422,5 @@ def load_model(path: str, verify: bool = True):
     result = [features[uid] for uid in manifest["result_features"]]
     model = WorkflowModel(result_features=result, fitted=fitted)
     model.loaded_from = path  # provenance for serving hot-swap/reload
+    model.quant_calibration = manifest.get("quant_calibration")
     return model

@@ -273,7 +273,79 @@ class Workflow:
             model.training_fingerprint = self._capture_fingerprint(
                 result_features, columns, seed,
                 n_bins=int(cont_params.get("n_bins", 10)))
+        # per-column quantization calibration is captured on EVERY
+        # train (a strided min/max over the host-origin columns — far
+        # cheaper than the opt-in histogram fingerprint): quantized
+        # serving with "-calibrated" mode then ships fleet-wide
+        # fit-time ranges and repeat scores are bit-stable across batch
+        # compositions (workflow/compiled.ScoringQuant)
+        model.quant_calibration = self._capture_quant_calibration(
+            result_features, fitted, columns)
         return model
+
+    @staticmethod
+    def _capture_quant_calibration(result_features, fitted, columns):
+        """Fit-time per-column [lo, hi] ranges for the quantized
+        serving wire: captured for every HOST-ORIGIN device-input
+        column (raw generator outputs + host-stage outputs — exactly
+        the leaves `quantize_wire` sees as numpy arrays at serving
+        time). Scalar ranges are extended to include 0.0 because
+        masked slots ride the wire as exact 0.0 fills. Rows are
+        strided-sampled past 256k (a quant range needs coverage, not
+        exactness). Best-effort: failure means no calibration, never a
+        failed train."""
+        from transmogrifai_tpu.data.columns import SCALAR, VECTOR
+        from transmogrifai_tpu.stages.base import is_host_stage
+        try:
+            host_uids = {f.uid for rf in result_features
+                         for f in rf.raw_features()}
+            for s in fitted.values():
+                if is_host_stage(s):
+                    host_uids.add(s.get_output().uid)
+            cal = {}
+            for uid in host_uids:
+                col = columns.get(uid)
+                if col is None:
+                    continue
+                kind = col.kind
+                if kind == SCALAR:
+                    v = np.asarray(col.data["value"], np.float64)
+                    m = np.asarray(col.data["mask"]).astype(bool)
+                    v = v[m]
+                    if v.size > 262_144:
+                        v = v[::v.size // 262_144]
+                    if v.size == 0:
+                        continue
+                    with np.errstate(invalid="ignore"):
+                        fin = v[np.isfinite(v)]
+                    if fin.size == 0:
+                        continue
+                    lo = min(float(fin.min()), 0.0)
+                    hi = max(float(fin.max()), 0.0)
+                    cal[uid] = {"lo": [lo], "hi": [hi]}
+                elif kind == VECTOR:
+                    a = np.asarray(col.data)
+                    if a.ndim != 2 or a.size == 0:
+                        continue
+                    if a.shape[0] > 65_536:
+                        a = a[::a.shape[0] // 65_536]
+                    import warnings
+                    with np.errstate(invalid="ignore"), \
+                            warnings.catch_warnings():
+                        warnings.simplefilter("ignore", RuntimeWarning)
+                        fin = np.where(np.isfinite(a), a, np.nan)
+                        lo = np.nanmin(fin, axis=0)
+                        hi = np.nanmax(fin, axis=0)
+                    lo = np.where(np.isfinite(lo), lo, 0.0)
+                    hi = np.where(np.isfinite(hi), hi, lo)
+                    cal[uid] = {"lo": [float(x) for x in lo],
+                                "hi": [float(x) for x in hi]}
+            return cal or None
+        except Exception as e:
+            _log.warning("quant calibration capture failed (%s: %s) — "
+                         "quantized serving will use batch-relative "
+                         "ranges", type(e).__name__, e)
+            return None
 
     @staticmethod
     def _capture_fingerprint(result_features, columns, seed: int,
@@ -422,6 +494,10 @@ class WorkflowModel:
         # drift-detection fingerprint of the predictor's training matrix
         # (continual/drift.TrainingFingerprint), set by Workflow.train()
         self.training_fingerprint = None
+        # fit-time per-column [lo, hi] ranges for calibrated quantized
+        # serving (uid -> {"lo": [...], "hi": [...]}); set by
+        # Workflow.train(), persisted in the model manifest
+        self.quant_calibration = None
 
     def with_finite_checks(self, enabled: bool = True) -> "WorkflowModel":
         """Numeric-sanitizer discipline (SURVEY §5.2 — the build's
