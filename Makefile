@@ -15,13 +15,21 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
 
-.PHONY: lint serve-smoke fleet-smoke chaos-smoke ingest-smoke \
-	faults-smoke trace-smoke cache-smoke multichip-smoke \
+.PHONY: lint conc-check serve-smoke fleet-smoke chaos-smoke \
+	ingest-smoke faults-smoke trace-smoke cache-smoke multichip-smoke \
 	continual-smoke costmodel-smoke roofline-smoke slo-smoke \
 	parse-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
+
+# whole-program concurrency audit (C001-C004): lock discipline,
+# lock-order cycles, blocking-under-lock, generation-fence re-checks.
+# Fails on any finding not in the reviewed baseline; prints the
+# lock-order graph so ordering regressions are visible in CI logs.
+conc-check:
+	$(PY) -m transmogrifai_tpu.analysis.concurrency transmogrifai_tpu/ \
+		--baseline conc_baseline.json --graph
 
 # fault-tolerance smoke: kill a ModelSelector sweep mid-grid with an
 # injected fault, resume it from the block journal, and assert the best
@@ -145,6 +153,6 @@ parse-smoke:
 test:
 	@$(TIER1)
 
-check: lint serve-smoke parse-smoke fleet-smoke chaos-smoke \
+check: lint conc-check serve-smoke parse-smoke fleet-smoke chaos-smoke \
 	roofline-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
 	slo-smoke multichip-smoke continual-smoke costmodel-smoke test

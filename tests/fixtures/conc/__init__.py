@@ -1,0 +1,18 @@
+"""Concurrency-auditor fixtures (`tests/test_concurrency.py`).
+
+Each module is a small, self-contained, *runnable* concurrency shape the
+auditor (`transmogrifai_tpu/analysis/concurrency.py`) must classify
+exactly one way:
+
+- ``racy.py``      — mixed guarded/bare writes from two roles → C001
+- ``clean.py``     — the same shape, consistently locked → no findings
+- ``deadlock.py``  — two locks taken in opposite orders → C002 cycle
+- ``blocking.py``  — sleep/file-I/O under a held lock → C003
+- ``fence.py``     — generation-fence write without a re-check → C004
+- ``annotated.py`` — the racy shape silenced by the two annotation
+  escape hatches (``# guarded-by: <lock>`` and ``# conc-ok: C001``)
+
+The auditor allowlists anything under ``tests/`` (fixtures must never
+show up in the repo audit), so the test suite feeds these files through
+``audit_source`` under a neutral synthetic path.
+"""

@@ -1,0 +1,32 @@
+"""Suppression fixture: the ``racy.py`` shape silenced two ways.
+
+``Documented._apply`` carries a def-line ``# guarded-by: _lock`` — the
+caller-holds-the-lock contract — so its bare writes count as guarded
+and no C001 exists at all. ``Documented.reset`` carries an inline
+``# conc-ok: C001``: the finding IS produced but arrives suppressed
+(reported, non-gating)."""
+
+import threading
+
+
+class Documented:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._worker, name="documented-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        with self._lock:
+            self._apply()
+
+    def _apply(self):  # guarded-by: _lock
+        self._count += 1
+
+    def reset(self):
+        # conc-ok: C001 (test-only reset; callers quiesce the worker first)
+        self._count = 0

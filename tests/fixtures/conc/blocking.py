@@ -1,0 +1,33 @@
+"""C003 fixture: blocking work under a held lock — a direct sleep in
+the critical section, and file I/O reached through a call while the
+lock is held. ``waiter`` shows the exempt shape: Condition.wait
+RELEASES the lock while blocked, so it must NOT be flagged."""
+
+import threading
+import time
+
+
+class Slow:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._state = 0
+
+    def throttle(self):
+        with self._lock:
+            self._state += 1
+            time.sleep(0.05)              # direct C003: sleep under lock
+
+    def save(self):
+        with self._lock:
+            self._flush()                 # C003 via call: reaches open()
+
+    def _flush(self):
+        with open("/tmp/slow.state", "w") as fh:
+            fh.write(str(self._state))
+
+    def waiter(self):
+        with self._cond:
+            while self._state == 0:
+                self._cond.wait()         # releases the lock: NOT C003
+            self._state = 0

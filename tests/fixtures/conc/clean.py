@@ -1,0 +1,37 @@
+"""Clean fixture: the same worker-thread + caller shape as ``racy.py``
+but every write takes the lock — the auditor must report nothing, and
+its two ``with`` orderings are consistent so no C002 either."""
+
+import threading
+
+
+class Clean:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._worker, name="clean-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        with self._lock:
+            self._count += 1
+
+    def poke(self):
+        with self._lock:
+            self._count = 0
+
+    def both_ab_1(self):
+        with self._lock:
+            with self._aux:
+                self._count += 1
+
+    def both_ab_2(self):
+        # same _lock -> _aux order as both_ab_1: an edge, never a cycle
+        with self._lock:
+            with self._aux:
+                self._count = 2

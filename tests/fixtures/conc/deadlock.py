@@ -1,0 +1,26 @@
+"""C002 fixture: a genuine two-lock ordering cycle. ``transfer_out``
+takes ledger→audit, ``transfer_in`` takes audit→ledger — two threads
+running one each can deadlock. The auditor must report the cycle with
+the full lock path (both legs, with their acquisition sites)."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._ledger_lock = threading.Lock()
+        self._audit_lock = threading.Lock()
+        self._balance = 0
+        self._log = []
+
+    def transfer_out(self, n):
+        with self._ledger_lock:
+            with self._audit_lock:        # edge: ledger -> audit
+                self._balance -= n
+                self._log.append(("out", n))
+
+    def transfer_in(self, n):
+        with self._audit_lock:
+            with self._ledger_lock:       # edge: audit -> ledger (CYCLE)
+                self._balance += n
+                self._log.append(("in", n))

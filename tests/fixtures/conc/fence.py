@@ -1,0 +1,51 @@
+"""C004 fixture: the generation-fence protocol from the serving staging
+pool. ``fill`` is the correct shape — it re-checks ``_live(gen)`` after
+the blocking encode, so a stale restarted worker never writes.
+``fill_unfenced`` writes the same registered structure with NO re-check:
+a worker restarted at generation g+1 leaves a stale g-thread behind that
+clobbers slots the live thread owns. The auditor must flag exactly the
+unfenced write."""
+
+import threading
+
+
+class SlotPool:
+    def __init__(self, n):
+        self._gen_lock = threading.Lock()
+        self._generation = 0
+        self._slots = [None] * n
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="slot-filler", daemon=True)
+        self._thread.start()
+
+    def _live(self, gen):
+        return gen == self._generation
+
+    def advance(self):
+        # fence owner: the only writer of the generation itself
+        with self._gen_lock:
+            self._generation += 1
+            self._slots = [None] * len(self._slots)
+
+    def _loop(self):
+        gen = self._generation
+        while True:
+            self.fill(0, b"x", gen)
+
+    def fill(self, i, payload, gen):
+        staged = payload * 2              # slow work while maybe stale
+        if not self._live(gen):
+            return                        # re-check dominates the write
+        with self._gen_lock:
+            self._slots[i] = staged
+
+    def fill_unfenced(self, i, payload, gen):
+        staged = payload * 2
+        # BUG (intentional): no _live(gen) re-check — the lock makes the
+        # write atomic but not CORRECT: a stale thread still clobbers a
+        # slot the live generation owns → C004
+        with self._gen_lock:
+            self._slots[i] = staged

@@ -1,0 +1,26 @@
+"""C001 fixture: an attribute written under a lock by the worker thread
+and written bare by public (caller-thread) methods — the classic
+sometimes-guarded counter race."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._worker, name="racy-worker", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        with self._lock:
+            self._count += 1
+
+    def poke(self):
+        # BUG (intentional): bare write to an attribute the worker
+        # thread guards — the auditor must flag this line as C001
+        self._count = 0

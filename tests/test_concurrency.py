@@ -1,0 +1,378 @@
+"""Tests for the whole-program concurrency auditor
+(`analysis/concurrency.py`), its fixture corpus
+(`tests/fixtures/conc/`), the L019 blocking-under-lock lint rule, and
+the shared finding envelope (`analysis/report.py`).
+
+The auditor allowlists everything under ``tests/`` (fixtures must never
+pollute the repo audit), so fixture files are read from disk and fed
+through ``audit_source`` under a neutral synthetic path.
+"""
+
+import json
+import os
+
+import pytest
+
+from transmogrifai_tpu.analysis import concurrency as C
+from transmogrifai_tpu.analysis import lint as L
+from transmogrifai_tpu.analysis import report
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "fixtures", "conc")
+
+
+def fixture_src(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        return f.read()
+
+
+def audit_fixture(name, baseline=()):
+    # a synthetic non-tests path: fixtures are allowlisted at their real
+    # location by design
+    return C.audit_source(fixture_src(name), f"conc_fix/{name}",
+                          baseline=baseline)
+
+
+def gating_rules(result):
+    return sorted(f.rule for f in result.gating)
+
+
+# --------------------------------------------------------------------------- #
+# C001: sometimes-guarded attribute writes                                    #
+# --------------------------------------------------------------------------- #
+
+def test_racy_fixture_fires_c001():
+    result = audit_fixture("racy.py")
+    assert gating_rules(result) == ["C001"]
+    (f,) = result.gating
+    assert f.symbol == "Racy._count"
+    assert "Racy._lock" in f.message
+    # both roles that can touch the attribute are named
+    assert "racy-worker" in f.message and "callers:Racy" in f.message
+
+
+def test_racy_finding_points_at_the_bare_write():
+    result = audit_fixture("racy.py")
+    (f,) = result.gating
+    src_lines = fixture_src("racy.py").splitlines()
+    assert "self._count = 0" in src_lines[f.line - 1]
+
+
+def test_clean_fixture_has_no_findings():
+    result = audit_fixture("clean.py")
+    assert result.gating == []
+    # consistent _lock -> _aux nesting makes an edge but never a cycle
+    assert result.cycles == []
+    assert any(e["to"].endswith("Clean._aux") for e in result.lock_edges)
+
+
+def test_c001_needs_two_roles():
+    # mixed guarded/bare writes, but _count is only ever touched from
+    # the worker closure — one role, no race, no finding
+    src = """
+import threading
+
+class Solo:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def start(self):
+        threading.Thread(target=self._worker, name="solo-w").start()
+
+    def _worker(self):
+        with self._lock:
+            self._count += 1
+        self._bump()
+
+    def _bump(self):
+        self._count = 0
+"""
+    result = C.audit_source(src, "conc_fix/solo.py")
+    assert gating_rules(result) == []
+
+
+def test_construction_phase_writes_are_exempt():
+    # bare writes inside a helper only __init__ reaches are published by
+    # Thread.start()'s happens-before — not a C001
+    src = """
+import threading
+
+class Lazy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._load()
+
+    def _load(self):
+        self._cache = {}
+
+    def start(self):
+        threading.Thread(target=self._worker, name="lazy-w").start()
+
+    def _worker(self):
+        with self._lock:
+            self._cache = {}
+
+    def snapshot(self):
+        with self._lock:
+            self._cache = dict(self._cache)
+"""
+    result = C.audit_source(src, "conc_fix/lazy.py")
+    assert gating_rules(result) == []
+
+
+# --------------------------------------------------------------------------- #
+# C002: lock-order cycles                                                     #
+# --------------------------------------------------------------------------- #
+
+def test_deadlock_fixture_fires_c002_with_full_path():
+    result = audit_fixture("deadlock.py")
+    assert "C002" in gating_rules(result)
+    assert len(result.cycles) == 1
+    (f,) = [f for f in result.gating if f.rule == "C002"]
+    # the full cycle path: both legs with their acquisition sites
+    assert "Ledger._ledger_lock -> Ledger._audit_lock" in f.message
+    assert "Ledger._audit_lock -> Ledger._ledger_lock" in f.message
+    assert "transfer_out" in f.message and "transfer_in" in f.message
+
+
+def test_deadlock_fixture_graph_summary_prints_cycle():
+    result = audit_fixture("deadlock.py")
+    text = C._graph_summary(result)
+    assert "2 edge(s), 1 cycle(s)" in text
+    assert "CYCLE:" in text
+
+
+# --------------------------------------------------------------------------- #
+# C003: blocking under a held lock                                            #
+# --------------------------------------------------------------------------- #
+
+def test_blocking_fixture_direct_sleep_under_lock():
+    result = audit_fixture("blocking.py")
+    direct = [f for f in result.gating
+              if f.rule == "C003" and "time.sleep" in f.message]
+    assert len(direct) == 1
+    assert "Slow._lock" in direct[0].message
+
+
+def test_blocking_fixture_interprocedural_io():
+    result = audit_fixture("blocking.py")
+    via = [f for f in result.gating
+           if f.rule == "C003" and "reaches" in f.message]
+    assert len(via) == 1
+    assert "_flush" in via[0].message
+    assert "file I/O (open)" in via[0].message
+
+
+def test_condition_wait_is_not_blocking():
+    # Condition.wait releases the lock while blocked — the waiter()
+    # shape in the fixture must produce no C003
+    result = audit_fixture("blocking.py")
+    assert not any("wait" in f.message for f in result.gating)
+    assert len([f for f in result.gating if f.rule == "C003"]) == 2
+
+
+# --------------------------------------------------------------------------- #
+# C004: generation-fence discipline                                           #
+# --------------------------------------------------------------------------- #
+
+def test_fence_fixture_flags_only_the_unfenced_write():
+    result = audit_fixture("fence.py")
+    assert gating_rules(result) == ["C004"]
+    (f,) = result.gating
+    assert f.symbol == "SlotPool._slots"
+    src_lines = fixture_src("fence.py").splitlines()
+    # the flagged line is inside fill_unfenced, not the re-checked fill
+    above = "\n".join(src_lines[:f.line])
+    assert "def fill_unfenced" in above
+    assert above.rindex("def fill_unfenced") > above.rindex("def fill(")
+
+
+def test_fence_owner_is_exempt():
+    # advance() writes _slots too, but it OWNS the generation (it is
+    # the restart); only gen-readers owe a re-check
+    result = audit_fixture("fence.py")
+    src_lines = fixture_src("fence.py").splitlines()
+    for f in result.gating:
+        assert "advance" not in src_lines[f.line - 1]
+
+
+# --------------------------------------------------------------------------- #
+# Suppression: annotations and the reviewed baseline                          #
+# --------------------------------------------------------------------------- #
+
+def test_guarded_by_def_annotation_marks_writes_guarded():
+    # _apply carries `# guarded-by: _lock` on its def line: its writes
+    # count as guarded, so the only C001 is reset()'s bare write
+    result = audit_fixture("annotated.py")
+    c001 = [f for f in result.findings if f.rule == "C001"]
+    assert len(c001) == 1
+
+
+def test_conc_ok_annotation_suppresses_but_reports():
+    result = audit_fixture("annotated.py")
+    (f,) = [f for f in result.findings if f.rule == "C001"]
+    assert f.suppression == "annotation"
+    assert result.gating == []
+
+
+def test_baseline_suppresses_by_symbol_not_line():
+    baseline = [{"file": "conc_fix/racy.py", "rule": "C001",
+                 "symbol": "Racy._count", "reason": "fixture"}]
+    result = audit_fixture("racy.py", baseline=baseline)
+    assert result.gating == []
+    (f,) = [f for f in result.findings if f.rule == "C001"]
+    assert f.suppression == "baseline"
+
+
+def test_baseline_wrong_symbol_does_not_suppress():
+    baseline = [{"file": "conc_fix/racy.py", "rule": "C001",
+                 "symbol": "Racy._other", "reason": "stale entry"}]
+    result = audit_fixture("racy.py", baseline=baseline)
+    assert gating_rules(result) == ["C001"]
+
+
+def test_parse_error_is_a_warning_not_gating():
+    result = C.audit_source("def broken(:\n", "conc_fix/broken.py")
+    assert result.gating == []
+    (f,) = result.findings
+    assert f.rule == "C000" and f.severity == "warning"
+
+
+# --------------------------------------------------------------------------- #
+# The repo itself + runtime budget                                            #
+# --------------------------------------------------------------------------- #
+
+def test_repo_audits_clean_with_reviewed_baseline():
+    baseline = C.load_baseline(os.path.join(REPO, "conc_baseline.json"))
+    result = C.audit_paths([os.path.join(REPO, "transmogrifai_tpu")],
+                           baseline=baseline)
+    assert result.gating == [], "\n".join(str(f) for f in result.gating)
+    assert result.cycles == []
+    # the make conc-check budget: whole-repo audit stays under 10s
+    assert result.elapsed_s < 10.0
+    # sanity: the audit actually saw the fleet (roles + locks exist)
+    assert len(result.roles) >= 5
+    assert result.n_locks >= 10
+
+
+# --------------------------------------------------------------------------- #
+# CLI                                                                         #
+# --------------------------------------------------------------------------- #
+
+def test_cli_missing_path_exits_2(capsys):
+    assert C.main(["/nonexistent/nowhere"]) == 2
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_cli_json_envelope(tmp_path, capsys):
+    p = tmp_path / "racy_case.py"
+    p.write_text(fixture_src("racy.py"), encoding="utf-8")
+    rc = C.main([str(p), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["tool"] == "concurrency" and doc["version"] == 1
+    (finding,) = doc["findings"]
+    assert finding["rule"] == "C001"
+    assert set(finding) >= {"file", "line", "rule", "severity",
+                            "message", "suppression"}
+    assert doc["counts"]["error"] == 1
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    p = tmp_path / "racy_case.py"
+    p.write_text(fixture_src("racy.py"), encoding="utf-8")
+    bl = tmp_path / "baseline.json"
+    assert C.main([str(p), "--baseline", str(bl),
+                   "--write-baseline"]) == 0
+    capsys.readouterr()
+    # the grandfathered baseline makes the same audit pass
+    assert C.main([str(p), "--baseline", str(bl)]) == 0
+    out = capsys.readouterr().out
+    assert "0 gating finding(s), 1 suppressed" in out
+
+
+# --------------------------------------------------------------------------- #
+# L019: blocking-under-lock lint + shared envelope                            #
+# --------------------------------------------------------------------------- #
+
+_L019_SRC = """
+import threading
+import time
+
+LOCK = threading.Lock()
+
+def throttle():
+    with LOCK:
+        time.sleep(1.0)
+"""
+
+
+def test_lint_l019_flags_sleep_under_lock():
+    findings = [f for f in L.lint_source(_L019_SRC, "svc.py")
+                if f.code == "L019"]
+    assert len(findings) == 1
+    assert findings[0].gating
+    assert "time.sleep" in findings[0].message
+
+
+def test_lint_l019_flags_file_io_under_lock():
+    src = _L019_SRC.replace("time.sleep(1.0)",
+                            'open("/tmp/x", "w").write("x")')
+    codes = {f.code for f in L.lint_source(src, "svc.py")}
+    assert "L019" in codes
+
+
+def test_lint_l019_allowlists_smoke_and_tests():
+    for path in ("ingest_smoke.py", "chaos.py",
+                 os.path.join("tests", "test_x.py")):
+        assert not any(f.code == "L019"
+                       for f in L.lint_source(_L019_SRC, path))
+
+
+def test_lint_l019_conc_ok_annotation_suppresses():
+    src = _L019_SRC.replace(
+        "        time.sleep(1.0)",
+        "        # conc-ok: C003 (deliberate pacing)\n"
+        "        time.sleep(1.0)")
+    (f,) = [f for f in L.lint_source(src, "svc.py") if f.code == "L019"]
+    assert f.suppression == "annotation"
+    assert not f.gating
+
+
+def test_lint_parse_failure_is_warning_and_exits_zero(tmp_path):
+    findings = L.lint_source("def broken(:\n", "bad.py")
+    assert [f.code for f in findings] == ["L000"]
+    assert findings[0].severity == "warning"
+    assert not findings[0].gating
+    p = tmp_path / "bad.py"
+    p.write_text("def broken(:\n", encoding="utf-8")
+    assert L.main([str(p)]) == 0  # parse-skips never gate the CLI
+
+
+def test_lint_cli_gates_on_real_findings(tmp_path, capsys):
+    p = tmp_path / "svc.py"
+    p.write_text(_L019_SRC, encoding="utf-8")
+    assert L.main([str(p)]) == 1
+    capsys.readouterr()
+    rc = L.main([str(p), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["tool"] == "lint" and doc["version"] == 1
+    assert doc["counts"]["error"] == 1
+    # one envelope shape across both analyzers
+    assert set(doc["findings"][0]) == {"file", "line", "rule",
+                                       "severity", "message",
+                                       "suppression"}
+
+
+def test_shared_envelope_counts():
+    findings = [
+        report.Finding("a.py", 1, "C001", "m"),
+        report.Finding("a.py", 2, "C003", "m", suppression="annotation"),
+        report.Finding("b.py", 3, "L000", "m", severity="warning"),
+    ]
+    doc = json.loads(report.render_json("lint", findings))
+    assert doc["counts"] == {"error": 1, "warning": 1, "suppressed": 1}
+    assert len(report.gating(findings)) == 1

@@ -267,6 +267,49 @@ class TestFlightRecorder:
         assert rec.dump("third", force=True) is not None
         assert len(rec.dumps) == 2
 
+    def test_reset_clears_debounce_anchor_and_rearms(self, tmp_path):
+        # regression (concurrency audit C001): reset() used to null
+        # _last_dump_s bare while dump() reads it twice under
+        # _dump_lock (None-check, then the subtraction) — a
+        # cross-thread reset landing between the reads crashed the
+        # dump path. reset() now takes the lock for that write.
+        rec = FlightRecorder(dump_dir=str(tmp_path), min_interval_s=60.0)
+        rec.enabled = True
+        rec.note_event("x", {})
+        assert rec.dump("first") is not None
+        assert rec.dump("second") is None        # debounced
+        rec.reset()
+        rec.note_event("y", {})
+        assert rec.dump("after-reset") is not None   # debounce re-armed
+        assert rec.records_seen == 1
+        assert len(rec.dumps) == 1 and rec.dump_failures == 0
+
+    def test_reset_serializes_with_dump_lock(self, tmp_path):
+        import threading
+        rec = FlightRecorder(dump_dir=str(tmp_path), min_interval_s=60.0)
+        rec.enabled = True
+        holding = threading.Event()
+        release = threading.Event()
+        done = threading.Event()
+
+        def holder():
+            with rec._dump_lock:
+                holding.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder, name="dump-holder")
+        t.start()
+        assert holding.wait(5.0)
+        r = threading.Thread(target=lambda: (rec.reset(), done.set()),
+                             name="resetter")
+        r.start()
+        # reset must queue behind the dump lock, not race past it
+        assert not done.wait(0.2)
+        release.set()
+        assert done.wait(5.0)
+        t.join()
+        r.join()
+
     def test_disabled_recorder_is_inert(self, tmp_path):
         rec = FlightRecorder(dump_dir=str(tmp_path))
         rec.note_event("x", {})

@@ -437,7 +437,10 @@ def test_lint_repo_is_clean():
     root = os.path.join(os.path.dirname(__file__), "..",
                         "transmogrifai_tpu")
     findings = L.lint_paths([root])
-    assert findings == [], "\n".join(str(f) for f in findings)
+    # annotated escape-hatch findings (e.g. `# conc-ok: C003` on the
+    # deliberately-serialized WAL writers) are reported but non-gating
+    gating = [f for f in findings if f.gating]
+    assert gating == [], "\n".join(str(f) for f in gating)
 
 
 # --------------------------------------------------------------------------- #

@@ -170,6 +170,44 @@ def test_member_health_stall_recovery_records_mttr():
     assert rec["recovery_s"] >= 0.5  # measured from the REAL stall start
 
 
+def test_breaker_flight_dump_flushed_outside_health_lock(monkeypatch):
+    # regression (concurrency audit C003): _open_breaker used to write
+    # the incident flight dump while holding self._lock — one slow disk
+    # stalled every thread noting or admitting requests. Dumps are now
+    # queued under the lock and written after release.
+    from transmogrifai_tpu.serving import resilience as R
+    h = MemberHealth(ResilienceParams.from_json(_fast_params()),
+                     member="m")
+    seen = []
+
+    def fake_dump(reason):
+        assert not h._lock._is_owned(), \
+            "flight dump ran inside the health lock"
+        seen.append(reason)
+
+    monkeypatch.setattr(R, "_flight_dump", fake_dump)
+    h.note_dispatch(False)
+    h.note_dispatch(False)  # breaker_failures=2: opens, queues the dump
+    # opening the breaker also quarantines the member — BOTH queued
+    # incident dumps flush, in order, with the lock released
+    assert seen == ["breaker_open", "quarantine"]
+    assert h._pending_dumps == []
+
+
+def test_quarantine_flight_dump_still_emitted(monkeypatch):
+    # the deferred-dump path must not LOSE the quarantine incident dump
+    from transmogrifai_tpu.serving import resilience as R
+    seen = []
+    monkeypatch.setattr(R, "_flight_dump",
+                        lambda reason: seen.append(reason))
+    h = MemberHealth(ResilienceParams.from_json(_fast_params(
+        min_window=4, window=8)), member="m")
+    for _ in range(8):
+        h.note_request(False)
+    assert h.state == QUARANTINED
+    assert "quarantine" in seen
+
+
 # --------------------------------------------------------------------- #
 # Retry-After plumbing                                                  #
 # --------------------------------------------------------------------- #

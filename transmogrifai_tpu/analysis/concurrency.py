@@ -1,0 +1,1280 @@
+"""Whole-program concurrency auditor for the serving plane.
+
+The serving process runs ~10 cooperating thread roles — the scoring
+loop, the watchdog, the continual supervisor, HTTP handler threads, the
+SLO engine, rebucket/chaos workers — over dozens of lock sites and the
+generation fence (`_generation` / `_live(gen)`) that keeps restarted
+scoring loops from racing their stale predecessors. Every one of those
+disciplines has, until now, been enforced by reviewer eyeball. This
+pass turns them into checkable contracts (the same move
+`analysis/opcheck.py` makes for the feature DAG): parse the whole
+package, recover the thread roles and lock bindings, and flag the
+places where the conventions are broken.
+
+Rules
+-----
+
+- ``C001 mixed-guard write``: a class attribute reachable from >= 2
+  thread roles whose non-``__init__`` writes are SOMETIMES inside
+  ``with self._lock:`` and sometimes bare. Mixed guarding is the racy
+  tell — either every write is guarded (shared state) or none is
+  (single-owner state); a half-guarded attribute means one path forgot.
+  Consistently-unguarded attributes do NOT fire (deliberately lock-free
+  single-writer paths, e.g. the flight recorder's feed counters, stay
+  legal). Helpers that are only ever called with the lock already held
+  declare it with a ``# guarded-by: _lock`` comment on the ``def`` (or
+  on the write line) — the annotation escape hatch.
+
+- ``C002 lock-order cycle``: a cycle in the lock-acquisition order
+  graph. Nodes are ``Class.lockattr``; an edge A -> B is recorded
+  whenever B is acquired (lexically, or anywhere below a call made)
+  while A is held. Any cycle is a potential deadlock — two threads
+  entering the cycle from different edges can each hold the lock the
+  other wants. The full lock path is reported.
+
+- ``C003 blocking-under-lock``: a blocking operation reached while a
+  lock is held — ``time.sleep``, file I/O (``open``/``write_text``/
+  ``os.replace``/``json.dump``...), device dispatch (``score_padded``,
+  ``device_put``, ``block_until_ready``), codec ``encode_aligned``/
+  ``encode_rows``, thread joins, event/queue waits. Interprocedural:
+  a call made under a held lock into a function that (transitively)
+  blocks is flagged at the call site. ``Condition.wait`` on the lock
+  actually held is exempt (the wait RELEASES that lock — the batcher's
+  coalescing linger is the canonical legal case).
+
+- ``C004 unfenced write``: generation-fence discipline. A function
+  that read the generation (takes a ``gen`` parameter or snapshots
+  ``self.generation``) runs on a fenceable thread; any write it makes
+  to a fence-REGISTERED structure must be dominated by a re-check
+  (``if self.generation != gen: return/continue``, or a positive
+  ``if self._live(gen):`` branch). A structure becomes fence-registered
+  by having at least one correctly re-checked store (the staging buffer
+  map, resident batch pools); an unchecked store to it elsewhere is how
+  a stale restarted loop clobbers state the live loop now owns.
+  Functions that BUMP the generation are the fence owners and exempt;
+  counter bumps (``+=``) are bookkeeping, not structure writes, and are
+  ignored.
+
+Thread roles
+------------
+
+Roles are recovered, not configured: every ``threading.Thread(
+target=self._m)`` site roots a role at ``_m`` (named by the thread's
+``name=`` when literal); every class defining ``do_GET``/``do_POST``/
+``handle*`` methods contributes an HTTP-handler role; and every class
+that owns a thread also gets a "callers" role over its public methods
+(the external threads that call ``score()``/``reload()`` concurrently).
+Reachability is a call-graph closure over ``self.m()`` calls (with
+inheritance), calls through attributes whose class is inferred from
+``self._x = ClassName(...)`` in ``__init__`` (or a property return
+annotation), module functions, and module-level singletons
+(``RECORDER = FlightRecorder()``).
+
+Suppression
+-----------
+
+Two mechanisms, both surfaced in the shared report envelope
+(`analysis/report.py`):
+
+- in-source annotations — ``# guarded-by: <lock>`` asserts a guard the
+  analysis cannot see; ``# conc-ok: C003`` (comma list, or bare
+  ``# conc-ok``) suppresses specific rules on that line, for patterns
+  that are blocking-by-design (a write-ahead journal serializing file
+  appends under its lock).
+- a reviewed BASELINE file (``--baseline conc_baseline.json``) keyed by
+  ``(file, rule, symbol)`` — line-independent, so grandfathered
+  findings survive unrelated edits. ``--write-baseline`` emits one.
+
+Smoke/chaos drivers (``*_smoke.py``, ``smoke.py``, ``chaos.py``) and
+test trees are parsed for type information but never reported on —
+load generators hold no serving invariants.
+
+Run: ``python -m transmogrifai_tpu.analysis.concurrency <paths...>``
+(``--json`` for the envelope, ``--graph`` for the lock-order graph,
+exit 1 only on non-suppressed findings). ``make conc-check`` gates CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from transmogrifai_tpu.analysis.lint import _dotted, iter_py_files
+from transmogrifai_tpu.analysis.report import (
+    WARNING, Finding, gating, render_human, render_json)
+
+__all__ = ["audit_paths", "audit_source", "AuditResult", "main"]
+
+# (class name, lock attr) — lock identity across the program; module-
+# level locks use ("<module>:" + basename, name)
+LockId = Tuple[str, str]
+# (path, class name or "", function name)
+FuncKey = Tuple[str, str, str]
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_CONC_OK_RE = re.compile(r"#\s*conc-ok(?::\s*([A-Z0-9,\s]+))?")
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTORS = {"Condition"}
+_MUTATORS = {"append", "appendleft", "extend", "add", "insert", "update",
+             "setdefault", "pop", "popleft", "popitem", "remove",
+             "discard", "clear"}
+_ALLOW_BASENAMES = ("smoke.py", "chaos.py")
+
+
+def _allowlisted(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    base = parts[-1]
+    if base.endswith("_smoke.py") or base in _ALLOW_BASENAMES:
+        return True
+    return any(d in parts for d in ("tests", "testkit"))
+
+
+def _lock_label(lock: LockId) -> str:
+    return f"{lock[0]}.{lock[1]}"
+
+
+def _blocking_label(call: ast.Call) -> Optional[str]:
+    """Name of the blocking operation a call performs, or None."""
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    leaf = d.split(".")[-1]
+    if d in ("time.sleep", "sleep"):
+        return "time.sleep"
+    if d == "open" or leaf in ("write_text", "read_text", "write_bytes",
+                               "read_bytes") or d in (
+            "os.replace", "os.fsync", "os.makedirs", "json.dump",
+            "pickle.dump"):
+        return f"file I/O ({leaf})"
+    if leaf in ("encode_aligned", "encode_rows"):
+        return f"codec {leaf}"
+    if leaf in ("score_padded", "block_until_ready") or d in (
+            "jax.device_put", "device_put"):
+        return f"device dispatch ({leaf})"
+    if leaf == "join" and "thread" in d.lower():
+        return "thread join"
+    if leaf == "wait":
+        return "wait"          # condition-on-held-lock exempted by caller
+    return None
+
+
+def _gen_attr(d: Optional[str]) -> bool:
+    return d is not None and (
+        d in ("generation", "_generation")
+        or d.endswith(".generation") or d.endswith("._generation"))
+
+
+def _is_live_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and (_dotted(node.func) or "").split(".")[-1] == "_live")
+
+
+def _classify_fence_test(test: ast.AST,
+                         gen_names: Set[str]) -> Optional[str]:
+    """'neg' (body is the STALE branch), 'pos' (body is the verified
+    branch), or None for a non-fence test."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return "neg" if _is_live_call(test.operand) else None
+    if _is_live_call(test):
+        return "pos"
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        ld = _dotted(test.left)
+        rd = _dotted(test.comparators[0])
+        paired = (_gen_attr(ld) and rd in gen_names) or \
+                 (_gen_attr(rd) and ld in gen_names)
+        if paired:
+            if isinstance(test.ops[0], ast.NotEq):
+                return "neg"
+            if isinstance(test.ops[0], ast.Eq):
+                return "pos"
+    return None
+
+
+def _body_exits(body: Sequence[ast.stmt]) -> bool:
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Continue,
+                              ast.Break)) for s in body)
+
+
+# --------------------------------------------------------------------------- #
+# Source model                                                                #
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class Access:
+    """One read/write of a (class, attr) pair inside a function."""
+    cls: str
+    attr: str
+    kind: str                  # read | assign | subscript | aug | mutcall
+    line: int
+    held: FrozenSet[LockId] = frozenset()
+    annotated: Optional[str] = None     # guard asserted via # guarded-by
+    fence: str = "unchecked"            # unchecked | checked | stale
+
+
+@dataclass
+class FuncRecord:
+    key: FuncKey
+    node: ast.AST
+    path: str
+    cls: Optional[str]
+    accesses: List[Access] = field(default_factory=list)
+    # (lock, line, locks already held at the acquire)
+    acquires: List[Tuple[LockId, int, FrozenSet[LockId]]] = \
+        field(default_factory=list)
+    # (raw dotted callee, call node, locks held at call)
+    raw_calls: List[Tuple[str, ast.Call, FrozenSet[LockId]]] = \
+        field(default_factory=list)
+    calls: List[Tuple[FuncKey, int, FrozenSet[LockId]]] = \
+        field(default_factory=list)
+    blocking: List[Tuple[str, int, FrozenSet[LockId]]] = \
+        field(default_factory=list)
+    thread_sites: List[Tuple[ast.Call, int]] = field(default_factory=list)
+    local_types: Dict[str, str] = field(default_factory=dict)
+    gen_reader: bool = False
+    fence_owner: bool = False
+    guard_annot: Optional[str] = None   # # guarded-by on the def line
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)
+    locks: Dict[str, str] = field(default_factory=dict)   # attr -> kind
+    aliases: Dict[str, str] = field(default_factory=dict)  # cond -> lock
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, FuncKey] = field(default_factory=dict)
+    http_roots: List[str] = field(default_factory=list)
+    owns_thread: bool = False
+
+
+@dataclass
+class Role:
+    name: str
+    roots: List[FuncKey] = field(default_factory=list)
+
+
+@dataclass
+class AuditResult:
+    findings: List[Finding]
+    roles: List[str]
+    lock_edges: List[Dict[str, object]]
+    cycles: List[List[str]]
+    n_files: int
+    n_locks: int
+    elapsed_s: float
+
+    @property
+    def gating(self) -> List[Finding]:
+        return gating(self.findings)
+
+
+class Program:
+    """Everything the rules need, recovered from a set of sources."""
+
+    def __init__(self) -> None:
+        self.classes: Dict[str, ClassInfo] = {}
+        self.funcs: Dict[FuncKey, FuncRecord] = {}
+        # module basename (no .py) -> {fn name -> FuncKey}
+        self.module_fns: Dict[str, Dict[str, FuncKey]] = defaultdict(dict)
+        # module basename -> {global name -> class name}
+        self.globals_types: Dict[str, Dict[str, str]] = defaultdict(dict)
+        # module basename -> {global lock name}
+        self.module_locks: Dict[str, Set[str]] = defaultdict(set)
+        self.lines: Dict[str, List[str]] = {}
+        self.parse_errors: List[Tuple[str, int, str]] = []
+
+    # -- lookups ----------------------------------------------------------- #
+
+    def resolve_method(self, cls_name: str,
+                       meth: str) -> Optional[FuncKey]:
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            ci = self.classes.get(name)
+            if ci is None:
+                continue
+            if meth in ci.methods:
+                return ci.methods[meth]
+            stack.extend(ci.bases)
+        return None
+
+    def attr_type(self, cls_name: str, attr: str) -> Optional[str]:
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            ci = self.classes.get(name)
+            if ci is None:
+                continue
+            if attr in ci.attr_types:
+                return ci.attr_types[attr]
+            stack.extend(ci.bases)
+        return None
+
+    def lock_for(self, cls_name: str, attr: str) -> Optional[LockId]:
+        """Dealias `attr` to the lock it guards with, walking bases
+        (Condition(self._lock) acquires _lock)."""
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            ci = self.classes.get(name)
+            if ci is None:
+                continue
+            if attr in ci.aliases:
+                return (cls_name, ci.aliases[attr])
+            if attr in ci.locks:
+                return (cls_name, attr)
+            stack.extend(ci.bases)
+        return None
+
+    def annotation_at(self, path: str, line: int) -> Optional[str]:
+        """# guarded-by: X on `line` (1-based) or the line above."""
+        lines = self.lines.get(path) or []
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(lines):
+                m = _GUARDED_BY_RE.search(lines[ln - 1])
+                if m:
+                    return m.group(1)
+        return None
+
+    def conc_ok_at(self, path: str, line: int, rule: str) -> bool:
+        lines = self.lines.get(path) or []
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(lines):
+                m = _CONC_OK_RE.search(lines[ln - 1])
+                if m:
+                    rules = m.group(1)
+                    if rules is None:
+                        return True
+                    if rule in {r.strip() for r in rules.split(",")}:
+                        return True
+        return False
+
+
+# --------------------------------------------------------------------------- #
+# Per-function walk: guards, fence state, accesses, calls                     #
+# --------------------------------------------------------------------------- #
+
+class _FuncWalker:
+    """Single in-order pass over one function body tracking the
+    lexically-held lock set and the generation-fence state."""
+
+    def __init__(self, program: Program, rec: FuncRecord,
+                 mod: str) -> None:
+        self.p = program
+        self.rec = rec
+        self.mod = mod
+        self.cls = rec.cls
+        self.held: Tuple[LockId, ...] = ()
+        self.fence = "unchecked"
+        self.gen_names: Set[str] = set()
+
+    # -- setup ------------------------------------------------------------- #
+
+    def prescan(self, fn: ast.AST) -> None:
+        """Gen locals + fence ownership, before the stateful walk."""
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs):
+                if a.arg == "gen":
+                    self.gen_names.add("gen")
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and _gen_attr(
+                        _dotted(node.value)):
+                    self.gen_names.add(t.id)
+                if _gen_attr(_dotted(t)):
+                    self.rec.fence_owner = True
+            elif isinstance(node, ast.AugAssign):
+                if _gen_attr(_dotted(node.target)):
+                    self.rec.fence_owner = True
+        self.rec.gen_reader = bool(self.gen_names)
+
+    # -- lock resolution --------------------------------------------------- #
+
+    def _lock_of(self, expr: ast.AST) -> Optional[LockId]:
+        d = _dotted(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 2 and parts[0] == "self" and self.cls:
+            return self.p.lock_for(self.cls, parts[1])
+        if len(parts) == 1:
+            if parts[0] in self.p.module_locks.get(self.mod, set()):
+                return (f"<module>:{self.mod}", parts[0])
+        return None
+
+    # -- statements -------------------------------------------------------- #
+
+    def walk_body(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self.stmt(stmt)
+
+    def stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return                      # closures analyzed separately (not)
+        if isinstance(s, ast.With):
+            self._with(s)
+        elif isinstance(s, ast.If):
+            self._if(s)
+        elif isinstance(s, (ast.While,)):
+            self.expr(s.test)
+            kind = _classify_fence_test(s.test, self.gen_names)
+            saved = self.fence
+            self.fence = "checked" if kind == "pos" else "unchecked"
+            self.walk_body(s.body)
+            self.fence = saved
+            self.walk_body(s.orelse)
+        elif isinstance(s, ast.For):
+            self.expr(s.iter)
+            self.walk_body(s.body)
+            self.walk_body(s.orelse)
+        elif isinstance(s, ast.Try):
+            self.walk_body(s.body)
+            entry = self.fence
+            for h in s.handlers:
+                self.fence = entry
+                self.walk_body(h.body)
+            self.fence = entry
+            self.walk_body(s.orelse)
+            self.walk_body(s.finalbody)
+        elif isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._assign(s)
+        elif isinstance(s, (ast.Expr, ast.Return,)):
+            v = getattr(s, "value", None)
+            if v is not None:
+                self.expr(v)
+        elif isinstance(s, ast.Raise):
+            if s.exc is not None:
+                self.expr(s.exc)
+        elif isinstance(s, ast.Assert):
+            self.expr(s.test)
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                self._record_store(t, "assign", s.lineno)
+
+    def _with(self, s: ast.With) -> None:
+        acquired: List[LockId] = []
+        for item in s.items:
+            lock = self._lock_of(item.context_expr)
+            if lock is not None:
+                self.rec.acquires.append(
+                    (lock, item.context_expr.lineno,
+                     frozenset(self.held)))
+                acquired.append(lock)
+            else:
+                self.expr(item.context_expr)
+        self.held = self.held + tuple(acquired)
+        self.walk_body(s.body)
+        if acquired:
+            self.held = self.held[:-len(acquired)]
+
+    def _if(self, s: ast.If) -> None:
+        kind = _classify_fence_test(s.test, self.gen_names)
+        self.expr(s.test)
+        entry = self.fence
+        if kind == "neg":
+            self.fence = "stale"
+            self.walk_body(s.body)
+            # a stale branch that EXITS dominates everything after with
+            # a verified fence; one that falls through verifies nothing
+            self.fence = "checked" if _body_exits(s.body) else entry
+            self.walk_body(s.orelse)
+        elif kind == "pos":
+            self.fence = "checked"
+            self.walk_body(s.body)
+            self.fence = entry
+            self.walk_body(s.orelse)
+        else:
+            self.walk_body(s.body)
+            after_body = self.fence
+            self.fence = entry
+            self.walk_body(s.orelse)
+            # keep a fence verified in BOTH arms; else back to entry
+            if not (after_body == "checked" and self.fence == "checked"):
+                self.fence = entry
+
+    # -- expressions and accesses ------------------------------------------ #
+
+    def _assign(self, s: ast.stmt) -> None:
+        value = getattr(s, "value", None)
+        if value is not None:
+            self.expr(value)
+        targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+        kind = "aug" if isinstance(s, ast.AugAssign) else "assign"
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                for el in t.elts:
+                    self._record_store(el, kind, s.lineno)
+            else:
+                self._record_store(t, kind, s.lineno)
+        if isinstance(s, ast.Assign) and len(s.targets) == 1 and \
+                isinstance(s.targets[0], ast.Name) and \
+                isinstance(value, ast.Call):
+            leaf = (_dotted(value.func) or "").split(".")[-1]
+            if leaf in self.p.classes:
+                self.rec.local_types[s.targets[0].id] = leaf
+
+    def _owner_of(self, base: ast.AST) -> Optional[str]:
+        """Class owning an attribute rooted at `base` (self.X -> the
+        current class, GLOBAL.X -> the singleton's class)."""
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return self.cls
+            return self.p.globals_types.get(self.mod, {}).get(base.id)
+        return None
+
+    def _record_store(self, t: ast.AST, kind: str, line: int) -> None:
+        node = t
+        if isinstance(node, ast.Subscript):
+            kind = "subscript" if kind == "assign" else kind
+            node = node.value
+        if isinstance(node, ast.Attribute):
+            owner = self._owner_of(node.value)
+            if owner is not None:
+                self._access(owner, node.attr, kind, line)
+            else:
+                self.expr(node.value)
+        elif isinstance(node, ast.Subscript):
+            self.expr(node)
+
+    def _access(self, owner: str, attr: str, kind: str,
+                line: int) -> None:
+        annot = self.p.annotation_at(self.rec.path, line) or \
+            self.rec.guard_annot
+        self.rec.accesses.append(Access(
+            owner, attr, kind, line, frozenset(self.held), annot,
+            self.fence))
+
+    def expr(self, e: ast.AST) -> None:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                self._call(node)
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                owner = self._owner_of(node.value)
+                if owner is not None:
+                    self._access(owner, node.attr, "read", node.lineno)
+
+    def _call(self, call: ast.Call) -> None:
+        d = _dotted(call.func) or ""
+        held = frozenset(self.held)
+        # thread creation sites
+        if d in ("threading.Thread", "Thread"):
+            self.rec.thread_sites.append((call, call.lineno))
+        # mutation-method writes (self._q.append(...), RECORDER.x.add())
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _MUTATORS and \
+                isinstance(call.func.value, ast.Attribute):
+            owner = self._owner_of(call.func.value.value)
+            if owner is not None:
+                self._access(owner, call.func.value.attr, "mutcall",
+                             call.lineno)
+        # blocking operations
+        label = _blocking_label(call)
+        if label == "wait":
+            # Condition.wait on the held lock RELEASES it — legal
+            obj_lock = None
+            if isinstance(call.func, ast.Attribute):
+                obj_lock = self._lock_of(call.func.value)
+            if obj_lock is not None and obj_lock in held:
+                label = None
+            elif held:
+                label = "wait"
+            else:
+                label = None
+        if label is not None:
+            self.rec.blocking.append((label, call.lineno, held))
+        # raw call for later resolution
+        if d and d not in ("threading.Thread", "Thread"):
+            self.rec.raw_calls.append((d, call, held))
+
+
+# --------------------------------------------------------------------------- #
+# Program construction                                                        #
+# --------------------------------------------------------------------------- #
+
+def _mod_of(path: str) -> str:
+    return os.path.basename(path)[:-3] if path.endswith(".py") \
+        else os.path.basename(path)
+
+
+def _collect_file(program: Program, path: str, src: str) -> None:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        program.parse_errors.append((path, e.lineno or 0,
+                                     e.msg or "syntax error"))
+        return
+    program.lines[path] = src.splitlines()
+    mod = _mod_of(path)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            _collect_class(program, path, mod, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = (path, "", node.name)
+            program.module_fns[mod][node.name] = key
+            program.funcs[key] = FuncRecord(key, node, path, None)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            name = node.targets[0].id
+            leaf = (_dotted(node.value.func) or "").split(".")[-1]
+            if leaf in _LOCK_CTORS:
+                program.module_locks[mod].add(name)
+            else:
+                # module-level singleton; class resolution is deferred
+                program.globals_types[mod][name] = leaf
+
+
+def _collect_class(program: Program, path: str, mod: str,
+                   node: ast.ClassDef) -> None:
+    ci = ClassInfo(node.name, path, node,
+                   bases=[(_dotted(b) or "").split(".")[-1]
+                          for b in node.bases])
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        key = (path, node.name, item.name)
+        ci.methods[item.name] = key
+        rec = FuncRecord(key, item, path, node.name)
+        rec.guard_annot = program.annotation_at(path, item.lineno) \
+            if path in program.lines else None
+        program.funcs[key] = rec
+        if item.name.startswith("do_") or item.name.startswith("handle"):
+            ci.http_roots.append(item.name)
+        # property return annotations type the attribute
+        if any((_dotted(dec) or "") == "property"
+               for dec in item.decorator_list):
+            ann = item.returns
+            t = None
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                t = ann.value.split(".")[-1].strip("'\"")
+            elif ann is not None:
+                t = (_dotted(ann) or "").split(".")[-1] or None
+            if t:
+                ci.attr_types[item.name] = t
+        # lock/ctor discovery (any method; __init__ in practice)
+        for sub in ast.walk(item):
+            if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Attribute)):
+                continue
+            tgt = sub.targets[0]
+            if not (isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            if not isinstance(sub.value, ast.Call):
+                continue
+            leaf = (_dotted(sub.value.func) or "").split(".")[-1]
+            if leaf in _LOCK_CTORS:
+                ci.locks[tgt.attr] = leaf
+            elif leaf in _COND_CTORS:
+                arg = sub.value.args[0] if sub.value.args else None
+                ad = _dotted(arg) if arg is not None else None
+                if ad and ad.startswith("self."):
+                    ci.aliases[tgt.attr] = ad.split(".", 1)[1]
+                else:
+                    ci.locks[tgt.attr] = leaf
+            else:
+                ci.attr_types.setdefault(tgt.attr, leaf)
+    program.classes[node.name] = ci
+
+
+def _build_program(sources: Dict[str, str]) -> Program:
+    program = Program()
+    for path in sorted(sources):
+        program.lines[path] = sources[path].splitlines()
+    for path in sorted(sources):
+        _collect_file(program, path, sources[path])
+    # drop singleton/attr "types" that aren't project classes
+    for mod, d in program.globals_types.items():
+        for name in list(d):
+            if d[name] not in program.classes:
+                del d[name]
+    for ci in program.classes.values():
+        for attr in list(ci.attr_types):
+            if ci.attr_types[attr] not in program.classes:
+                del ci.attr_types[attr]
+        # re-read guard annotations now that every file's lines exist
+        for meth, key in ci.methods.items():
+            rec = program.funcs[key]
+            rec.guard_annot = program.annotation_at(
+                ci.path, rec.node.lineno)
+    # the stateful walk, then call resolution
+    for key, rec in program.funcs.items():
+        walker = _FuncWalker(program, rec, _mod_of(rec.path))
+        walker.prescan(rec.node)
+        walker.walk_body(rec.node.body)  # type: ignore[attr-defined]
+        rec.gen_reader = walker.rec.gen_reader
+    for rec in program.funcs.values():
+        _resolve_calls(program, rec)
+    return program
+
+
+def _resolve_calls(program: Program, rec: FuncRecord) -> None:
+    mod = _mod_of(rec.path)
+    for d, call, held in rec.raw_calls:
+        key = _resolve_one(program, rec, mod, d)
+        if key is not None:
+            rec.calls.append((key, call.lineno, held))
+
+
+def _resolve_one(program: Program, rec: FuncRecord, mod: str,
+                 d: str) -> Optional[FuncKey]:
+    parts = d.split(".")
+    if parts[0] == "self" and rec.cls:
+        if len(parts) == 2:
+            return program.resolve_method(rec.cls, parts[1])
+        if len(parts) == 3:
+            t = program.attr_type(rec.cls, parts[1])
+            if t:
+                return program.resolve_method(t, parts[2])
+        return None
+    if len(parts) == 1:
+        name = parts[0]
+        if name in program.module_fns.get(mod, {}):
+            return program.module_fns[mod][name]
+        owners = [m for m, fns in program.module_fns.items()
+                  if name in fns]
+        if len(owners) == 1:
+            return program.module_fns[owners[0]][name]
+        return None
+    if len(parts) == 2:
+        base, meth = parts
+        t = rec.local_types.get(base) or \
+            program.globals_types.get(mod, {}).get(base)
+        if t:
+            return program.resolve_method(t, meth)
+        if base in program.module_fns and \
+                meth in program.module_fns[base]:
+            return program.module_fns[base][meth]
+    if len(parts) == 3:
+        base, attr, meth = parts
+        t = rec.local_types.get(base) or \
+            program.globals_types.get(mod, {}).get(base)
+        if t:
+            t2 = program.attr_type(t, attr)
+            if t2:
+                return program.resolve_method(t2, meth)
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# Thread roles                                                                #
+# --------------------------------------------------------------------------- #
+
+def _thread_name(call: ast.Call) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "name":
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str):
+                return kw.value.value
+            if isinstance(kw.value, ast.JoinedStr):
+                lits = [v.value for v in kw.value.values
+                        if isinstance(v, ast.Constant)]
+                if lits:
+                    return "".join(str(x) for x in lits).rstrip("-_") \
+                        or None
+    return None
+
+
+def _thread_target(program: Program, rec: FuncRecord,
+                   call: ast.Call) -> Optional[FuncKey]:
+    for kw in call.keywords:
+        if kw.arg != "target":
+            continue
+        d = _dotted(kw.value)
+        if d is None:
+            return None
+        return _resolve_one(program, rec, _mod_of(rec.path), d)
+    return None
+
+
+def _build_roles(program: Program) -> List[Role]:
+    roles: Dict[str, Role] = {}
+
+    def add(name: str, root: FuncKey) -> None:
+        roles.setdefault(name, Role(name)).roots.append(root)
+
+    owner_classes: Set[str] = set()
+    for rec in program.funcs.values():
+        for call, _line in rec.thread_sites:
+            target = _thread_target(program, rec, call)
+            if target is None:
+                continue
+            tname = _thread_name(call) or (
+                f"thread:{target[1] or _mod_of(target[0])}.{target[2]}")
+            add(tname, target)
+            if target[1]:
+                owner_classes.add(target[1])
+    for ci in program.classes.values():
+        if ci.http_roots:
+            for meth in ci.http_roots:
+                add(f"http:{ci.name}", ci.methods[meth])
+    for cls in sorted(owner_classes):
+        ci = program.classes.get(cls)
+        if ci is None:
+            continue
+        for meth, key in ci.methods.items():
+            if not meth.startswith("_"):
+                add(f"callers:{cls}", key)
+    return list(roles.values())
+
+
+def _closure(program: Program, roots: Sequence[FuncKey]) -> Set[FuncKey]:
+    seen: Set[FuncKey] = set()
+    stack = [r for r in roots if r in program.funcs]
+    while stack:
+        key = stack.pop()
+        if key in seen:
+            continue
+        seen.add(key)
+        for callee, _line, _held in program.funcs[key].calls:
+            if callee not in seen and callee in program.funcs:
+                stack.append(callee)
+    return seen
+
+
+# --------------------------------------------------------------------------- #
+# Rules                                                                       #
+# --------------------------------------------------------------------------- #
+
+def _role_touch_map(program: Program, roles: Sequence[Role]
+                    ) -> Dict[Tuple[str, str], Set[str]]:
+    touched: Dict[Tuple[str, str], Set[str]] = defaultdict(set)
+    for role in roles:
+        for key in _closure(program, role.roots):
+            for acc in program.funcs[key].accesses:
+                touched[(acc.cls, acc.attr)].add(role.name)
+    return touched
+
+
+def _construction_only(program: Program,
+                       roles: Sequence[Role]) -> Set[FuncKey]:
+    """Functions reachable from an ``__init__`` and from NO thread
+    role: construction-phase helpers (``journal._load`` style). Their
+    writes happen before the object is shared — thread ``start()``
+    publishes them — so they never race."""
+    init_roots = [key for key in program.funcs
+                  if key[2] in ("__init__", "__new__")]
+    init_reach = _closure(program, init_roots)
+    role_reach: Set[FuncKey] = set()
+    for role in roles:
+        role_reach |= _closure(program, role.roots)
+    return init_reach - role_reach
+
+
+def _check_c001(program: Program, roles: Sequence[Role]
+                ) -> List[Finding]:
+    touched = _role_touch_map(program, roles)
+    construction = _construction_only(program, roles)
+    writes: Dict[Tuple[str, str], List[Tuple[FuncRecord, Access]]] = \
+        defaultdict(list)
+    for rec in program.funcs.values():
+        if rec.key[2] in ("__init__", "__new__") or \
+                rec.key in construction:
+            continue
+        for acc in rec.accesses:
+            if acc.kind != "read":
+                writes[(acc.cls, acc.attr)].append((rec, acc))
+    findings: List[Finding] = []
+    for (cls, attr), sites in sorted(writes.items()):
+        ci = program.classes.get(cls)
+        if ci is None or attr in ci.locks or attr in ci.aliases:
+            continue
+        role_set = touched.get((cls, attr), set())
+        if len(role_set) < 2:
+            continue
+        guarded = [(r, a) for r, a in sites if a.held or a.annotated]
+        bare = [(r, a) for r, a in sites
+                if not a.held and not a.annotated]
+        if not guarded or not bare:
+            continue
+        locks = sorted({_lock_label(l) for _, a in guarded
+                        for l in a.held} |
+                       {f"{cls}.{a.annotated}" for _, a in guarded
+                        if a.annotated})
+        for rec, acc in bare:
+            findings.append(Finding(
+                rec.path, acc.line, "C001",
+                f"write to `{cls}.{attr}` without the lock other "
+                f"writers hold ({', '.join(locks)}); attribute is "
+                f"reachable from {len(role_set)} thread roles "
+                f"({', '.join(sorted(role_set))}) — guard the write or "
+                f"annotate the call path `# guarded-by: <lock>`",
+                symbol=f"{cls}.{attr}"))
+    return findings
+
+
+def _locks_below(program: Program) -> Dict[FuncKey, Set[LockId]]:
+    below: Dict[FuncKey, Set[LockId]] = {
+        key: {lock for lock, _l, _h in rec.acquires}
+        for key, rec in program.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, rec in program.funcs.items():
+            for callee, _line, _held in rec.calls:
+                extra = below.get(callee, set()) - below[key]
+                if extra:
+                    below[key] |= extra
+                    changed = True
+    return below
+
+
+def _blocking_below(program: Program
+                    ) -> Dict[FuncKey, List[Tuple[str, str, int]]]:
+    """(label, path, line) blocking sites in-or-below each function.
+
+    Only sites NOT under a lock in their own function seed the map —
+    locked sites are direct C003 findings at the site itself, and
+    re-reporting them at every locked caller would drown the report."""
+    below: Dict[FuncKey, List[Tuple[str, str, int]]] = {
+        key: [(lbl, rec.path, line)
+              for lbl, line, held in rec.blocking if not held]
+        for key, rec in program.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, rec in program.funcs.items():
+            have = set(below[key])
+            for callee, _line, _held in rec.calls:
+                for site in below.get(callee, []):
+                    if site not in have:
+                        below[key].append(site)
+                        have.add(site)
+                        changed = True
+    return below
+
+
+def _lock_graph(program: Program
+                ) -> List[Dict[str, object]]:
+    below = _locks_below(program)
+    edges: Dict[Tuple[LockId, LockId], Dict[str, object]] = {}
+
+    def add(a: LockId, b: LockId, path: str, line: int,
+            via: str) -> None:
+        if a == b:
+            return                      # re-entry, not an ordering edge
+        edges.setdefault((a, b), {
+            "from": _lock_label(a), "to": _lock_label(b),
+            "site": f"{path}:{line}", "via": via})
+
+    for key, rec in program.funcs.items():
+        where = f"{key[1] + '.' if key[1] else ''}{key[2]}"
+        for lock, line, held in rec.acquires:
+            for h in held:
+                add(h, lock, rec.path, line, where)
+        for callee, line, held in rec.calls:
+            if not held:
+                continue
+            callee_where = \
+                f"{callee[1] + '.' if callee[1] else ''}{callee[2]}"
+            for lock in below.get(callee, set()):
+                for h in held:
+                    add(h, lock, rec.path, line,
+                        f"{where} -> {callee_where}")
+    return [edges[k] for k in sorted(edges, key=lambda e: (
+        _lock_label(e[0]), _lock_label(e[1])))]
+
+
+def _find_cycles(edge_list: List[Dict[str, object]]
+                 ) -> List[List[str]]:
+    adj: Dict[str, List[str]] = defaultdict(list)
+    for e in edge_list:
+        adj[str(e["from"])].append(str(e["to"]))
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in adj.get(node, []):
+            if nxt == start and len(path) > 1:
+                # canonicalize on the smallest rotation
+                cyc = path + [start]
+                base = path[:]
+                k = base.index(min(base))
+                canon = tuple(base[k:] + base[:k])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(cyc)
+            elif nxt not in on_path and nxt > start:
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def _check_c002(program: Program, edge_list: List[Dict[str, object]]
+                ) -> Tuple[List[Finding], List[List[str]]]:
+    cycles = _find_cycles(edge_list)
+    by_pair = {(str(e["from"]), str(e["to"])): e for e in edge_list}
+    findings: List[Finding] = []
+    for cyc in cycles:
+        first = by_pair.get((cyc[0], cyc[1]))
+        site = str(first["site"]) if first else "?:0"
+        path, _, line = site.partition(":")
+        legs = []
+        for a, b in zip(cyc, cyc[1:]):
+            e = by_pair.get((a, b))
+            legs.append(f"{a} -> {b}"
+                        f" ({e['via']} at {e['site']})" if e else
+                        f"{a} -> {b}")
+        findings.append(Finding(
+            path, int(line or 0), "C002",
+            "lock-order cycle (potential deadlock): "
+            + "; ".join(legs)
+            + " — acquire these locks in one global order",
+            symbol="->".join(cyc)))
+    return findings, cycles
+
+
+def _check_c003(program: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    bbelow = _blocking_below(program)
+    for key, rec in program.funcs.items():
+        where = f"{key[1] + '.' if key[1] else ''}{key[2]}"
+        for label, line, held in rec.blocking:
+            if not held:
+                continue
+            locks = ", ".join(sorted(_lock_label(l) for l in held))
+            findings.append(Finding(
+                rec.path, line, "C003",
+                f"blocking {label} while holding {locks} in "
+                f"`{where}` — every other thread contending that lock "
+                f"stalls behind it; move the blocking work outside "
+                f"the critical section",
+                symbol=f"{where}:{label}"))
+        reported: Set[int] = set()
+        for callee, line, held in rec.calls:
+            if not held or line in reported:
+                continue
+            sites = bbelow.get(callee, [])
+            own = {(l, ln) for l, ln, _h in rec.blocking}
+            sites = [s for s in sites
+                     if not (s[1] == rec.path and (s[0], s[2]) in own)]
+            if not sites:
+                continue
+            lbl, spath, sline = sites[0]
+            locks = ", ".join(sorted(_lock_label(l) for l in held))
+            callee_where = \
+                f"{callee[1] + '.' if callee[1] else ''}{callee[2]}"
+            findings.append(Finding(
+                rec.path, line, "C003",
+                f"call to `{callee_where}` while holding {locks} "
+                f"reaches blocking {lbl} ({spath}:{sline}) — the lock "
+                f"is held across the blocking operation",
+                symbol=f"{where}->{callee_where}"))
+            reported.add(line)
+    return findings
+
+
+def _check_c004(program: Program) -> List[Finding]:
+    registered: Set[Tuple[str, str]] = set()
+    for rec in program.funcs.values():
+        if not rec.gen_reader or rec.fence_owner:
+            continue
+        for acc in rec.accesses:
+            if acc.kind in ("assign", "subscript") and \
+                    acc.fence == "checked":
+                registered.add((acc.cls, acc.attr))
+    findings: List[Finding] = []
+    for rec in sorted(program.funcs.values(), key=lambda r: r.key):
+        if not rec.gen_reader or rec.fence_owner:
+            continue
+        if rec.key[2] in ("__init__", "__new__"):
+            continue
+        where = f"{rec.key[1] + '.' if rec.key[1] else ''}{rec.key[2]}"
+        for acc in rec.accesses:
+            if acc.kind not in ("assign", "subscript", "mutcall"):
+                continue
+            if (acc.cls, acc.attr) not in registered:
+                continue
+            if acc.fence != "unchecked":
+                continue
+            findings.append(Finding(
+                rec.path, acc.line, "C004",
+                f"write to fence-registered `{acc.cls}.{acc.attr}` in "
+                f"`{where}` without a generation re-check — a stale "
+                f"restarted loop would clobber state the live loop "
+                f"owns; dominate the write with `if self.generation "
+                f"!= gen: return` (or `if self._live(gen):`)",
+                symbol=f"{acc.cls}.{acc.attr}"))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# Baseline + driver                                                           #
+# --------------------------------------------------------------------------- #
+
+def load_baseline(path: str) -> List[Dict[str, str]]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries", []) if isinstance(doc, dict) else doc
+    return [e for e in entries if isinstance(e, dict)]
+
+
+def _apply_suppressions(program: Program, findings: List[Finding],
+                        baseline: Sequence[Dict[str, str]]) -> None:
+    for f in findings:
+        if program.conc_ok_at(f.path, f.line, f.rule):
+            f.suppression = "annotation"
+            continue
+        for e in baseline:
+            if e.get("rule") != f.rule or e.get("symbol") != f.symbol:
+                continue
+            bf = str(e.get("file", ""))
+            if f.path.endswith(bf) or bf.endswith(f.path):
+                f.suppression = "baseline"
+                break
+
+
+def _audit(sources: Dict[str, str],
+           baseline: Sequence[Dict[str, str]] = ()) -> AuditResult:
+    t0 = time.monotonic()
+    program = _build_program(sources)
+    roles = _build_roles(program)
+    edge_list = _lock_graph(program)
+    findings: List[Finding] = []
+    findings.extend(_check_c001(program, roles))
+    c002, cycles = _check_c002(program, edge_list)
+    findings.extend(c002)
+    findings.extend(_check_c003(program))
+    findings.extend(_check_c004(program))
+    findings = [f for f in findings if not _allowlisted(f.path)]
+    for path, line, msg in program.parse_errors:
+        findings.append(Finding(path, line, "C000",
+                                f"parse skipped: {msg}",
+                                severity=WARNING))
+    _apply_suppressions(program, findings, baseline)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    n_locks = sum(len(ci.locks) for ci in program.classes.values()) + \
+        sum(len(v) for v in program.module_locks.values())
+    return AuditResult(
+        findings=findings,
+        roles=sorted(r.name for r in _build_roles(program)),
+        lock_edges=edge_list,
+        cycles=cycles,
+        n_files=len(program.lines),
+        n_locks=n_locks,
+        elapsed_s=time.monotonic() - t0)
+
+
+def audit_paths(paths: Sequence[str],
+                baseline: Sequence[Dict[str, str]] = ()) -> AuditResult:
+    sources: Dict[str, str] = {}
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            sources[path] = f.read()
+    return _audit(sources, baseline)
+
+
+def audit_source(src: str, path: str = "<fixture>.py",
+                 baseline: Sequence[Dict[str, str]] = ()) -> AuditResult:
+    """Single-source entry point (unit tests)."""
+    return _audit({path: src}, baseline)
+
+
+def _graph_summary(result: AuditResult) -> str:
+    lines = [f"lock-order graph: {len(result.lock_edges)} edge(s), "
+             f"{len(result.cycles)} cycle(s)"]
+    for e in result.lock_edges:
+        lines.append(f"  {e['from']} -> {e['to']}  "
+                     f"[{e['via']} at {e['site']}]")
+    for cyc in result.cycles:
+        lines.append("  CYCLE: " + " -> ".join(cyc))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m transmogrifai_tpu.analysis.concurrency",
+        description="whole-program concurrency audit (C001-C004)")
+    parser.add_argument("paths", nargs="+",
+                        help=".py files or directories to audit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the shared JSON report envelope")
+    parser.add_argument("--baseline", default=None,
+                        help="reviewed baseline file (grandfathered "
+                             "findings, keyed file/rule/symbol)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current gating findings to "
+                             "--baseline and exit 0")
+    parser.add_argument("--graph", action="store_true",
+                        help="print the lock-order graph summary")
+    args = parser.parse_args(argv)
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        for p in missing:
+            print(f"concurrency: path does not exist: {p}",
+                  file=sys.stderr)
+        return 2
+    baseline: List[Dict[str, str]] = []
+    if args.baseline and os.path.exists(args.baseline) and \
+            not args.write_baseline:
+        baseline = load_baseline(args.baseline)
+    result = audit_paths(args.paths, baseline)
+    if args.write_baseline:
+        if not args.baseline:
+            print("concurrency: --write-baseline needs --baseline",
+                  file=sys.stderr)
+            return 2
+        entries = [{"file": f.path, "rule": f.rule,
+                    "symbol": f.symbol or "",
+                    "reason": "grandfathered (review me)"}
+                   for f in result.gating]
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "entries": entries}, f, indent=2)
+            f.write("\n")
+        print(f"concurrency: wrote {len(entries)} baseline entrie(s) "
+              f"to {args.baseline}")
+        return 0
+    if args.json:
+        print(render_json("concurrency", result.findings, extra={
+            "roles": result.roles,
+            "lock_edges": result.lock_edges,
+            "cycles": result.cycles,
+        }))
+    else:
+        text = render_human(result.findings)
+        if text:
+            print(text)
+        if args.graph:
+            print(_graph_summary(result))
+        n_gate = len(result.gating)
+        n_sup = sum(1 for f in result.findings if f.suppression)
+        print(f"concurrency: {n_gate} gating finding(s), {n_sup} "
+              f"suppressed, {len(result.roles)} thread role(s), "
+              f"{result.n_locks} lock(s), "
+              f"{len(result.lock_edges)} order edge(s) across "
+              f"{result.n_files} file(s) in {result.elapsed_s:.2f}s")
+    return 1 if result.gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

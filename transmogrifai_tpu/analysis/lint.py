@@ -170,18 +170,38 @@ silent slowness or nondeterminism once XLA is in the loop:
   through ``rowcodec.encode_rows``/``Dataset.from_rows`` (codec-backed)
   or operate on columns.
 
+- ``L019 blocking-under-lock``: ``time.sleep`` or blocking file I/O
+  (``open`` / ``os.makedirs`` / ``os.replace`` / ``os.fsync`` /
+  ``Path.write_text``-family / ``json.dump`` / ``pickle.dump``)
+  lexically inside a ``with <lock>:`` block. A lock's critical section
+  prices every contender: one slow disk under ``self._lock`` stalls
+  every thread that touches that lock — the serving watchdog reads this
+  as a stall and restarts a healthy worker. Stage the data under the
+  lock, do the I/O after release (see
+  ``serving/resilience.py:_flush_flight_dumps`` for the pattern).
+  Deliberately serialized writers (WAL appends, append-only logs) annotate
+  the site ``# conc-ok: C003`` / ``# conc-ok: L019`` — the same escape
+  hatch the whole-program auditor (``analysis/concurrency.py``, which
+  also sees lock-holding CALLERS of the I/O) honors, so one annotation
+  satisfies both tools. Smoke/chaos drivers and tests are allowlisted.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
 
-Run: ``python -m transmogrifai_tpu.lint <paths...>`` (exit 1 on findings)
-or via the ``lint`` subcommand of ``transmogrifai_tpu.cli``.
+Run: ``python -m transmogrifai_tpu.lint <paths...>`` — exit 1 on GATING
+findings (error-severity, unsuppressed); files that fail to parse are
+reported as L000 warnings and do not gate. ``--json`` emits the same
+envelope ``analysis/concurrency.py`` uses (file/line/rule/severity/
+suppression). Also available via the ``lint`` subcommand of
+``transmogrifai_tpu.cli``.
 """
 
 from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -269,9 +289,23 @@ class LintFinding:
     line: int
     code: str
     message: str
+    # "error" findings gate CI; "warning" (parse-skipped files) are
+    # reported but never fail the run. `suppression` names the mechanism
+    # ("annotation") when an escape hatch silenced an error finding.
+    severity: str = "error"
+    suppression: Optional[str] = None
+
+    @property
+    def gating(self) -> bool:
+        return self.severity == "error" and self.suppression is None
 
     def __str__(self) -> str:
-        return f"{self.path}:{self.line}: {self.code} {self.message}"
+        s = f"{self.path}:{self.line}: {self.code} {self.message}"
+        if self.suppression is not None:
+            s += f" [suppressed: {self.suppression}]"
+        elif self.severity != "error":
+            s += f" [{self.severity}]"
+        return s
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -1424,6 +1458,99 @@ def _check_per_row_serving_loops(tree: ast.AST,
     return findings
 
 
+# -- L019: blocking work inside a lock's critical section -------------------- #
+
+# calls by dotted name that block on the clock or the disk
+_L019_BLOCKING_DOTTED = {
+    "time.sleep", "open", "io.open",
+    "os.makedirs", "os.replace", "os.fsync", "os.remove", "os.rename",
+    "json.dump", "json.load", "pickle.dump", "pickle.load",
+    "shutil.copy", "shutil.copyfile", "shutil.move", "shutil.rmtree",
+}
+# method leaves that are file I/O regardless of receiver (pathlib)
+_L019_BLOCKING_LEAVES = {"write_text", "read_text", "write_bytes",
+                         "read_bytes"}
+# same spelling the whole-program auditor (analysis/concurrency.py)
+# accepts — one `# conc-ok: C003` annotation silences both tools, since
+# both flag the same pattern (lint sees the lexical site, the auditor
+# also sees lock-holding callers)
+_L019_CONC_OK_RE = re.compile(r"#\s*conc-ok(?::\s*([A-Z0-9,\s]+))?")
+
+
+def _l019_lockish(node: ast.AST) -> Optional[str]:
+    """The dotted name of a with-item that names a lock (leaf contains
+    'lock', or is 'cond'/'mutex'), else None. Name-based on purpose:
+    the linter is single-file and cannot resolve types; the auditor
+    does the type-resolved pass."""
+    name = _dotted(node)
+    if name is None:
+        return None
+    leaf = name.split(".")[-1].lower()
+    if "lock" in leaf or leaf in ("cond", "mutex"):
+        return name
+    return None
+
+
+def _l019_suppressed(lines: Sequence[str], lineno: int) -> bool:
+    """True when the finding line (or the line above it) carries a
+    ``# conc-ok`` annotation naming L019 or C003 (or bare)."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = _L019_CONC_OK_RE.search(lines[ln - 1])
+            if m:
+                rules = m.group(1)
+                if rules is None:
+                    return True
+                named = {r.strip() for r in rules.split(",")}
+                if named & {"L019", "C003"}:
+                    return True
+    return False
+
+
+def _check_blocking_under_lock(tree: ast.AST, path: str,
+                               lines: Sequence[str]) -> List[LintFinding]:
+    """Flag sleep/file-I/O calls lexically inside ``with <lock>:``."""
+    parts = os.path.normpath(path).split(os.sep)
+    base = parts[-1]
+    if base.endswith("_smoke.py") or base in ("smoke.py", "chaos.py") \
+            or "tests" in parts or "testkit" in parts:
+        return []
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        lock_name = None
+        for item in node.items:
+            lock_name = _l019_lockish(item.context_expr)
+            if lock_name is not None:
+                break
+        if lock_name is None:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = _dotted(sub.func)
+            if fn is None:
+                continue
+            blocked = fn if fn in _L019_BLOCKING_DOTTED else None
+            if blocked is None and "." in fn \
+                    and fn.split(".")[-1] in _L019_BLOCKING_LEAVES:
+                blocked = fn
+            if blocked is None:
+                continue
+            lineno = getattr(sub, "lineno", 0)
+            findings.append(LintFinding(
+                path, lineno, "L019",
+                f"blocking call `{blocked}` inside `with {lock_name}:` — "
+                f"every thread contending {lock_name} stalls behind this "
+                f"sleep/disk operation; stage data under the lock and do "
+                f"the blocking work after release, or annotate a "
+                f"deliberately-serialized writer with `# conc-ok: C003`",
+                suppression=("annotation"
+                             if _l019_suppressed(lines, lineno) else None)))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1431,8 +1558,10 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
+        # a file the linter cannot parse is surfaced, but must not fail
+        # a CI gate the way a real finding does — warning severity
         return [LintFinding(path, e.lineno or 0, "L000",
-                            f"syntax error: {e.msg}")]
+                            f"syntax error: {e.msg}", severity="warning")]
     classes = {n.name: n for n in ast.walk(tree)
                if isinstance(n, ast.ClassDef)}
     linter = _FileLinter(path, classes)
@@ -1445,6 +1574,8 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_closure_constants(tree, path))
     linter.findings.extend(_check_event_name_cardinality(tree, path))
     linter.findings.extend(_check_per_row_serving_loops(tree, path))
+    linter.findings.extend(_check_blocking_under_lock(
+        tree, path, src.splitlines()))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 
 
@@ -1481,6 +1612,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         description="JAX-pitfall lint over stage/kernel source")
     parser.add_argument("paths", nargs="+",
                         help=".py files or directories to lint")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the shared analysis JSON envelope "
+                             "(same shape as analysis.concurrency)")
     args = parser.parse_args(argv)
     missing = [p for p in args.paths if not os.path.exists(p)]
     if missing:
@@ -1493,10 +1627,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     for path in iter_py_files(args.paths):
         n_files += 1
         findings.extend(lint_file(path))
-    for f in findings:
-        print(f)
-    print(f"lint: {len(findings)} finding(s) in {n_files} file(s)")
-    return 1 if findings else 0
+    gating = [f for f in findings if f.gating]
+    if args.json:
+        from transmogrifai_tpu.analysis import report
+        print(report.render_json("lint", [
+            report.Finding(path=f.path, line=f.line, rule=f.code,
+                           message=f.message, severity=f.severity,
+                           suppression=f.suppression)
+            for f in findings], {"files": n_files}))
+    else:
+        for f in findings:
+            print(f)
+        print(f"lint: {len(gating)} gating finding(s) "
+              f"({len(findings) - len(gating)} warning/suppressed) "
+              f"in {n_files} file(s)")
+    # parse-skipped files (L000, warning severity) and annotated
+    # escape-hatch findings are reported but never gate the exit code
+    return 1 if gating else 0
 
 
 if __name__ == "__main__":

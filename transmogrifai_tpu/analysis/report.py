@@ -1,0 +1,116 @@
+"""Shared finding envelope for the static-analysis tools.
+
+`analysis/lint.py` (AST pitfall lint, L-rules) and
+`analysis/concurrency.py` (whole-program concurrency audit, C-rules)
+grew up as separate CLIs with separate output shapes. Editors and CI
+want ONE format: a finding is a finding regardless of which pass
+produced it. This module is that contract — a tiny dataclass plus the
+two renderers (JSON envelope, human text) both tools emit through.
+
+Envelope shape (``--json``)::
+
+    {
+      "tool": "lint" | "concurrency",
+      "version": 1,
+      "findings": [
+        {"file": "...", "line": 12, "rule": "C001",
+         "severity": "error" | "warning",
+         "message": "...",
+         "suppression": null | "baseline" | "annotation"},
+        ...
+      ],
+      "counts": {"error": 2, "warning": 1, "suppressed": 3}
+    }
+
+Severity semantics are shared too: only ``error`` findings gate a CI
+exit code; ``warning`` (e.g. a parse-skipped file) is surfaced but
+never fails the build; a non-null ``suppression`` names WHY a finding
+is not gating (a reviewed baseline entry, or an in-source annotation
+like ``# guarded-by: _lock``).
+
+Import-light on purpose: both consumers must run without JAX.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = ["Finding", "render_json", "render_human", "gating"]
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclass
+class Finding:
+    """One analyzer finding in the shared envelope shape."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    severity: str = ERROR
+    # None = gating; "baseline" / "annotation" = suppressed (reported
+    # but not counted against the exit code)
+    suppression: Optional[str] = None
+    # stable symbol the finding is about (e.g. "Class.attr") — what
+    # baseline files key on, so entries survive line drift
+    symbol: Optional[str] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        out = {
+            "file": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "suppression": self.suppression,
+        }
+        if self.symbol is not None:
+            out["symbol"] = self.symbol
+        return out
+
+    def __str__(self) -> str:
+        tail = ""
+        if self.suppression:
+            tail = f" [suppressed: {self.suppression}]"
+        elif self.severity != ERROR:
+            tail = f" [{self.severity}]"
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{tail}"
+
+
+def gating(findings: Sequence[Finding]) -> List[Finding]:
+    """The findings that should fail a CI gate: severity ``error`` and
+    not suppressed."""
+    return [f for f in findings
+            if f.severity == ERROR and f.suppression is None]
+
+
+def render_json(tool: str, findings: Sequence[Finding],
+                extra: Optional[Dict[str, Any]] = None) -> str:
+    """The shared JSON envelope (one line-delimited document)."""
+    counts = {
+        "error": sum(1 for f in findings
+                     if f.severity == ERROR and f.suppression is None),
+        "warning": sum(1 for f in findings
+                       if f.severity == WARNING and f.suppression is None),
+        "suppressed": sum(1 for f in findings if f.suppression is not None),
+    }
+    doc: Dict[str, Any] = {
+        "tool": tool,
+        "version": 1,
+        "findings": [f.to_json() for f in findings],
+        "counts": counts,
+    }
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, indent=2, sort_keys=False)
+
+
+def render_human(findings: Sequence[Finding]) -> str:
+    """One finding per line, sorted (file, line, rule) — the editors'
+    grep format both CLIs print by default."""
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    return "\n".join(str(f) for f in ordered)

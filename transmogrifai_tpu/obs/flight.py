@@ -256,7 +256,12 @@ class FlightRecorder:
         self.records_seen = 0
         self.dumps = []
         self.dump_failures = 0
-        self._last_dump_s = None
+        # the debounce anchor is read twice under _dump_lock in dump()
+        # (`is not None`, then the subtraction) — nulling it bare from
+        # another thread can land between the two reads and crash the
+        # dump path; every _last_dump_s write takes the lock
+        with self._dump_lock:
+            self._last_dump_s = None
 
 
 RECORDER = FlightRecorder()

@@ -989,6 +989,10 @@ def _record_calib(kind: str, seconds: float, units: float) -> float:
         return _sec_per_unit(kind)
     with _CALIB_LOCK:
         measured = max(seconds - _DISPATCH_OVERHEAD_S, 0.02) / units
+        # the lock intentionally covers the read-modify-write AND the
+        # persisted .tmp/replace below: two interleaved writers would
+        # corrupt the calibration file (see docstring)
+        # conc-ok: C003 (calibration RMW + persist must be atomic)
         prev = _sec_per_unit(kind) if kind in _CALIB else None
         if prev is None:
             new = measured
@@ -997,6 +1001,7 @@ def _record_calib(kind: str, seconds: float, units: float) -> float:
         else:
             new = 0.7 * prev + 0.3 * measured
         _CALIB[kind] = new
+        # conc-ok: C003 (calibration RMW + persist must be atomic)
         _save_calib()
         return new
 

@@ -78,7 +78,12 @@ class CostCorpus:
         try:
             line = json.dumps(rec)
             with self._lock:
+                # the corpus IS an append-only log: the lock exists to
+                # serialize the disk appends (torn-tail repair + write
+                # must be atomic per row), so I/O under it is the design
+                # conc-ok: C003 (append-log serializer)
                 os.makedirs(self.dir, exist_ok=True)
+                # conc-ok: C003 (append-log serializer)
                 with open(self.path, "a+b") as fh:
                     # a torn tail from a killed writer has no newline:
                     # appending straight onto it would corrupt THIS row

@@ -269,7 +269,7 @@ class MicroBatcher:
 
     # -- assembly (scoring thread) ---------------------------------------- #
 
-    def _pop_fitting(self, budget: int) -> Optional[Request]:
+    def _pop_fitting(self, budget: int) -> Optional[Request]:  # guarded-by: _lock
         """Pop the head request if it fits `budget` rows (caller holds
         the lock)."""
         if self._queue and self._queue[0].n_rows <= budget:

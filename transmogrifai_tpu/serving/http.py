@@ -409,7 +409,7 @@ class _FleetHandler(_JSONHandler):
     """
 
     @property
-    def fleet(self):
+    def fleet(self) -> "FleetService":
         return self.server.fleet  # type: ignore[attr-defined]
 
     def do_GET(self) -> None:  # noqa: N802

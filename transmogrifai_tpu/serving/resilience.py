@@ -171,6 +171,9 @@ class MemberHealth:
         self._probe_anchor = 0.0       # last open/probe tick (monotonic)
         self._stalled = False
         self._down_since: Optional[float] = None  # outage start (monotonic)
+        # incident dumps queued by the state machine under the lock,
+        # written AFTER it is released (flight dumps are disk I/O)
+        self._pending_dumps: list = []
         self.breaker_opens = 0
         self.breaker_closes = 0
         self.recoveries: list = []     # measured MTTR seconds, bounded
@@ -268,6 +271,7 @@ class MemberHealth:
             self._window.append(bool(ok))
             self._latencies.append(float(latency_s))
             self._recompute("error_rate")
+        self._flush_flight_dumps()
 
     def note_dispatch(self, ok: bool, probe: bool = False) -> None:
         """One PRIMARY-path device dispatch outcome (per batch, or per
@@ -280,16 +284,16 @@ class MemberHealth:
                     self._probe_streak += 1
                     if self._probe_streak >= self.params.probe_successes:
                         self._close_breaker()
-                return
-            self._consecutive += 1
-            if self._breaker_open:
-                if probe:
-                    # failed probe: re-arm the open window
-                    self._probe_streak = 0
-                    self._probe_anchor = time.monotonic()
-                return
-            if self._consecutive >= self.params.breaker_failures:
-                self._open_breaker()
+            else:
+                self._consecutive += 1
+                if self._breaker_open:
+                    if probe:
+                        # failed probe: re-arm the open window
+                        self._probe_streak = 0
+                        self._probe_anchor = time.monotonic()
+                elif self._consecutive >= self.params.breaker_failures:
+                    self._open_breaker()
+        self._flush_flight_dumps()
 
     def note_stall(self, since: Optional[float] = None) -> None:
         """The watchdog found the scoring loop wedged/dead: quarantine
@@ -303,6 +307,7 @@ class MemberHealth:
                     else time.monotonic()
             self._probe_anchor = time.monotonic()
             self._recompute("stall")
+        self._flush_flight_dumps()
 
     def clear_stall(self) -> None:
         """Scoring thread restarted: the stall itself is over; state
@@ -311,10 +316,24 @@ class MemberHealth:
         with self._lock:
             self._stalled = False
             self._recompute("stall_recovered")
+        self._flush_flight_dumps()
+
+    def _flush_flight_dumps(self) -> None:
+        """Write incident dumps the state machine queued, AFTER the
+        lock is released. A flight dump is disk I/O (trace + event
+        artifacts); holding the health lock across it would stall every
+        thread noting or admitting requests behind one slow disk —
+        exactly the blocking-under-lock pattern C003 flags."""
+        with self._lock:
+            if not self._pending_dumps:
+                return
+            reasons, self._pending_dumps = self._pending_dumps, []
+        for reason in reasons:
+            _flight_dump(reason)
 
     # -- internals (lock held) ---------------------------------------------- #
 
-    def _open_breaker(self) -> None:
+    def _open_breaker(self) -> None:  # guarded-by: _lock
         self._breaker_open = True
         self._probe_streak = 0
         self._probe_anchor = time.monotonic()
@@ -325,14 +344,15 @@ class MemberHealth:
                       "circuit breakers tripped open").inc()
         _record_event("breaker_open", member=self.member,
                       consecutive_failures=self._consecutive)
-        _flight_dump("breaker_open")
+        # queued, not written here: the caller holds self._lock
+        self._pending_dumps.append("breaker_open")
         log.warning("serving%s: circuit breaker OPEN after %d consecutive "
                     "dispatch failures",
                     f"[{self.member}]" if self.member else "",
                     self._consecutive)
         self._recompute("breaker_open")
 
-    def _close_breaker(self) -> None:
+    def _close_breaker(self) -> None:  # guarded-by: _lock
         self._breaker_open = False
         self._consecutive = 0
         self._probe_streak = 0
@@ -358,7 +378,7 @@ class MemberHealth:
                 pass
         return _Null()
 
-    def _target_state(self) -> str:
+    def _target_state(self) -> str:  # guarded-by: _lock
         if self._breaker_open or self._stalled:
             return QUARANTINED
         n = len(self._window)
@@ -370,7 +390,7 @@ class MemberHealth:
                 return DEGRADED
         return HEALTHY
 
-    def _recompute(self, reason: str) -> None:
+    def _recompute(self, reason: str) -> None:  # guarded-by: _lock
         target = self._target_state()
         if target == self.state:
             return
@@ -395,7 +415,8 @@ class MemberHealth:
         _record_event("health_transition", member=self.member,
                       **{k: v for k, v in entry.items() if k != "at"})
         if target == QUARANTINED:
-            _flight_dump("quarantine")
+            # queued, not written here: the caller holds self._lock
+            self._pending_dumps.append("quarantine")
         log.log(logging.WARNING if target == QUARANTINED else logging.INFO,
                 "serving%s: health %s -> %s (%s)",
                 f"[{self.member}]" if self.member else "", prev, target,
