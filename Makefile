@@ -18,7 +18,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 .PHONY: lint conc-check serve-smoke fleet-smoke chaos-smoke \
 	ingest-smoke faults-smoke trace-smoke cache-smoke multichip-smoke \
 	continual-smoke costmodel-smoke roofline-smoke slo-smoke \
-	parse-smoke test check
+	parse-smoke router-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -150,9 +150,20 @@ costmodel-smoke:
 parse-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.parse_smoke
 
+# fleet-router smoke: two replicas over ONE shared artifact store —
+# replica-2's cold start is artifact replay (store-keyed warmup
+# manifest + shared compile cache, <= 1.5x a warm restart), the
+# over-quota tenant 429s from EITHER replica (CAS-guarded shared
+# balance), and concurrent binary-framed requests through the frontend
+# score bit-identically to the JSON columnar wire. See
+# transmogrifai_tpu/serving/router_smoke.py.
+router-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.router_smoke
+
 test:
 	@$(TIER1)
 
 check: lint conc-check serve-smoke parse-smoke fleet-smoke chaos-smoke \
 	roofline-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
-	slo-smoke multichip-smoke continual-smoke costmodel-smoke test
+	slo-smoke multichip-smoke continual-smoke costmodel-smoke \
+	router-smoke test

@@ -512,8 +512,8 @@ def run_big(platform: str, payload: dict) -> None:
 
     n_rows = int(os.environ.get("BENCH_BIG_ROWS", 10_000_000))
     d = int(os.environ.get("BENCH_BIG_D", 500))
-    path = os.path.expanduser(
-        f"~/.cache/transmogrifai_tpu/bigbench/{n_rows}x{d}")
+    from transmogrifai_tpu.store.config import cache_root
+    path = os.path.join(cache_root(), f"bigbench/{n_rows}x{d}")
 
     def note(msg):
         print(f"[big] {msg}", file=sys.stderr, flush=True)
@@ -1559,6 +1559,215 @@ def run_fleet() -> None:
                "errors_during_load": dict(errors)})
 
 
+def run_router() -> None:
+    """Router-mode bench (`python bench.py router`): the shared-state-
+    plane + warmth-routing numbers the PR-17 acceptance asks for.
+    Two fleet replicas over ONE shared artifact store, then emits:
+
+    - ``router_cold_replay_s``: replica-2's cold-start-to-first-score
+      when its warmup manifest comes out of the SHARED store (no local
+      sidecar) and its programs out of the shared persistent compile
+      cache — beside the true cold boot and a warm restart (the 1.5x
+      acceptance ratio);
+    - ``router_quota_rows_s``: admitted rows/s for one metered tenant
+      hammered open-loop THROUGH BOTH replicas with `shared_quota` —
+      the 2-replica sum must stay within 10% of the single-replica
+      quota (CAS-guarded shared balance, no per-request round trips);
+    - ``router_wire_p99_ms``: client-observed p99 through the frontend
+      HTTP server for the SAME columnar payload on the binary framing
+      vs the JSON wire (binary must not be slower)."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    platform = probe_backend()
+    n_rows = int(os.environ.get("BENCH_ROUTER_ROWS", 256))
+    quota_s = float(os.environ.get("BENCH_ROUTER_QUOTA_SECONDS", 3.0))
+    per_wire = int(os.environ.get("BENCH_ROUTER_REQUESTS", 80))
+    rate = 400.0  # metered tenant: rows/s, burst = 1s of rate
+
+    from transmogrifai_tpu.serving.binwire import (
+        CONTENT_TYPE, encode_frame)
+    from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+    from transmogrifai_tpu.serving.frontend import (
+        Frontend, serve_frontend)
+    from transmogrifai_tpu.workflow.serialization import WARMUP
+
+    rng = np.random.default_rng(23)
+
+    def fit(path: str) -> None:
+        import transmogrifai_tpu.types as t
+        from transmogrifai_tpu.data import Dataset
+        from transmogrifai_tpu.features import FeatureBuilder
+        from transmogrifai_tpu.models import OpLogisticRegression
+        from transmogrifai_tpu.ops.numeric import RealVectorizer
+        from transmogrifai_tpu.workflow import Workflow
+
+        n = 200
+        feats = {f"x{j}": rng.normal(size=n) for j in range(6)}
+        x = np.column_stack(list(feats.values()))
+        y = ((x @ rng.normal(size=6)) > 0).astype(np.float64)
+        ds = Dataset({**feats, "y": y},
+                     {**{k: t.Real for k in feats}, "y": t.Integral})
+        preds, label = FeatureBuilder.from_dataset(ds, response="y")
+        vec = RealVectorizer(track_nulls=False).set_input(
+            *preds).get_output()
+        pred = OpLogisticRegression(max_iter=40).set_input(
+            label, vec).get_output()
+        Workflow().set_result_features(pred, label) \
+            .set_input_dataset(ds).train().save(path)
+
+    with tempfile.TemporaryDirectory(prefix="bench-router-") as tmp:
+        os.environ["TRANSMOGRIFAI_STORE_DIR"] = f"{tmp}/store"
+        if "TRANSMOGRIFAI_PERF_CORPUS_DIR" not in os.environ:
+            os.environ["TRANSMOGRIFAI_PERF_CORPUS_DIR"] = \
+                f"{tmp}/perf-corpus"
+        fit(f"{tmp}/model-a")
+
+        def config(name: str, model_dir: str) -> FleetConfig:
+            return FleetConfig(
+                models={"m": model_dir},
+                tenants={"gold": {"rate": 1e6, "priority": 1},
+                         "meter": {"rate": rate, "burst": rate,
+                                   "priority": 0}},
+                serving={"max_batch": max(32, n_rows),
+                         "batch_wait_ms": 1.0, "max_queue": 1024},
+                compile_cache=True, compile_cache_dir=f"{tmp}/xla-cache",
+                store_dir=f"{tmp}/store", replica=name,
+                shared_quota=True)
+
+        cols = {f"x{j}": rng.normal(size=n_rows).tolist()
+                for j in range(6)}
+
+        def first_score_s(name: str, model_dir: str):
+            t0 = time.perf_counter()
+            fleet = FleetService(config(name, model_dir))
+            fleet.start()
+            fleet.score_columns("m", cols, tenant="gold")
+            return time.perf_counter() - t0, fleet
+
+        # -- cold boot / warm restart / replica-2 artifact replay ------- #
+        cold_s, boot = first_score_s("r0", f"{tmp}/model-a")
+        boot.stop()
+        warm_s, r1 = first_score_s("r1", f"{tmp}/model-a")
+        shutil.copytree(f"{tmp}/model-a", f"{tmp}/model-b")
+        os.remove(f"{tmp}/model-b/{WARMUP}")  # force the store fallback
+        r2_s, r2 = first_score_s("r2", f"{tmp}/model-b")
+        _emit({"metric": "router_cold_replay_s", "platform": platform,
+               "value": round(r2_s, 3), "unit": "s", "vs_baseline": 0.0,
+               "cold_s": round(cold_s, 3), "warm_s": round(warm_s, 3),
+               "ratio_vs_warm": round(r2_s / max(warm_s, 1e-9), 2),
+               "acceptance_max_ratio": 1.5})
+
+        try:
+            # -- shared-quota invariant across both replicas ------------ #
+            chunk = {k: v[:8] for k, v in cols.items()}
+            admitted = [0]
+            denied = [0]
+            lock = threading.Lock()
+            stop_at = time.perf_counter() + quota_s
+
+            def hammer(rep) -> None:
+                while time.perf_counter() < stop_at:
+                    try:
+                        rep.score_columns("m", chunk, tenant="meter")
+                        with lock:
+                            admitted[0] += 8
+                    except Exception:
+                        with lock:
+                            denied[0] += 1
+                        time.sleep(0.002)
+
+            threads = [threading.Thread(target=hammer, args=(rep,),
+                                        name=f"router-bench-{i}")
+                       for i, rep in enumerate((r1, r2, r1, r2))]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            window_s = time.perf_counter() - t0
+            # hard ceiling: burst + rate*window is every token that
+            # EXISTED fleet-wide during the window
+            allowed = rate + rate * window_s
+            measured = admitted[0] / window_s
+            assert admitted[0] <= allowed * 1.001, \
+                (f"2-replica tenant sum {admitted[0]} rows broke the "
+                 f"shared balance (allowed {allowed:.0f})")
+            assert admitted[0] >= 0.9 * rate * window_s, \
+                (f"shared metering starved the tenant: {admitted[0]} "
+                 f"rows admitted of {rate * window_s:.0f} earned")
+            _emit({"metric": "router_quota_rows_s", "platform": platform,
+                   "value": round(measured, 1), "unit": "rows/s",
+                   "vs_baseline": 0.0, "quota_rows_s": rate,
+                   "admitted_rows": admitted[0], "denials": denied[0],
+                   "window_s": round(window_s, 2),
+                   "overshoot_frac": round(
+                       admitted[0] / allowed - 1.0, 4)})
+
+            # -- binary vs JSON wire p99 through the frontend ----------- #
+            fe = Frontend({"r1": r1, "r2": r2})
+            server, _ = serve_frontend(fe, port=0, block=False)
+            base = f"http://127.0.0.1:{server.port}"
+            frame = encode_frame(cols, model="m", tenant="gold")
+            jbody = json.dumps({"model": "m", "columns": cols,
+                                "tenant": "gold"}).encode()
+            lat = {"json": [], "binary": []}
+
+            def shoot(wire: str) -> None:
+                data, ctype = ((frame, CONTENT_TYPE) if wire == "binary"
+                               else (jbody, "application/json"))
+                for _ in range(per_wire // 2):
+                    req = urllib.request.Request(
+                        f"{base}/score", data=data,
+                        headers={"Content-Type": ctype}, method="POST")
+                    t1 = time.perf_counter()
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        resp.read()
+                    with lock:
+                        lat[wire].append(
+                            (time.perf_counter() - t1) * 1000.0)
+
+            try:
+                shoot("json")      # interleaved warm pass per wire,
+                shoot("binary")    # then the measured concurrent pass
+                for wire in lat:
+                    lat[wire].clear()
+                threads = [threading.Thread(target=shoot, args=(w,),
+                                            name=f"router-wire-{w}-{i}")
+                           for i in range(2) for w in ("json", "binary")]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+
+                def pctl(xs, q):
+                    xs = sorted(xs)
+                    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+                j99 = pctl(lat["json"], 0.99)
+                b99 = pctl(lat["binary"], 0.99)
+                assert b99 <= j99 * 1.1, \
+                    (f"binary wire p99 {b99:.2f}ms regressed past JSON "
+                     f"{j99:.2f}ms")
+                _emit({"metric": "router_wire_p99_ms",
+                       "platform": platform, "value": round(b99, 2),
+                       "unit": "ms", "vs_baseline": 0.0,
+                       "json_p99_ms": round(j99, 2),
+                       "json_p50_ms": round(pctl(lat["json"], 0.5), 2),
+                       "binary_p50_ms": round(
+                           pctl(lat["binary"], 0.5), 2),
+                       "rows_per_request": n_rows,
+                       "requests_per_wire": len(lat["json"])})
+            finally:
+                server.shutdown()
+                server.server_close()
+        finally:
+            r1.stop()
+            r2.stop()
+
+
 def run_chaos_bench() -> None:
     """Chaos-mode bench (`python bench.py chaos`): the numbers that make
     "graceful degradation" falsifiable. Drives the 3-model/2-tenant
@@ -1717,6 +1926,16 @@ def main() -> None:
             _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
                    "vs_baseline": 0.0,
                    "error": f"fleet bench failed: {type(e).__name__}: {e}",
+                   "trace_tail":
+                       traceback.format_exc().strip().splitlines()[-3:]})
+        return
+    if "router" in sys.argv[1:]:
+        try:
+            run_router()
+        except Exception as e:
+            _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"router bench failed: {type(e).__name__}: {e}",
                    "trace_tail":
                        traceback.format_exc().strip().splitlines()[-3:]})
         return

@@ -1,0 +1,240 @@
+"""serving/binwire.py: Arrow-IPC-style binary framing of the columnar
+request wire — roundtrip bit-parity with the JSON columnar wire
+(including scores through a real FleetService), the endianness/dtype
+matrix, and the malformed-frame fuzz corpus (every mutation must be a
+structured ``bad_request`` that never feeds the breaker or the health
+window)."""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.ops.numeric import RealVectorizer
+from transmogrifai_tpu.serving import ScoreError
+from transmogrifai_tpu.serving.binwire import (
+    CONTENT_TYPE, MAGIC, WIRE_VERSION, decode_frame, encode_frame)
+from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+from transmogrifai_tpu.workflow import Workflow
+
+COLS = {"x1": [0.3, -0.5, 2.0], "x2": [-1.2, 0.8, 0.1]}
+
+
+def _frame(**kw):
+    kw.setdefault("model", "m1")
+    return encode_frame(dict(COLS), **kw)
+
+
+# --------------------------------------------------------------------- #
+# roundtrip + dtype matrix                                              #
+# --------------------------------------------------------------------- #
+
+class TestRoundtrip:
+    def test_float_lists_bit_identical(self):
+        columns, meta = decode_frame(
+            encode_frame(dict(COLS), model="m", tenant="acme",
+                         deadline_ms=25.0))
+        assert meta == {"n_rows": 3, "model": "m", "tenant": "acme",
+                        "deadline_ms": 25.0}
+        for name, vals in COLS.items():
+            got = np.asarray(columns[name])
+            assert got.dtype == np.float64
+            # bit parity, not approx: the frame carries the IEEE bytes
+            assert got.tobytes() == np.asarray(vals, "<f8").tobytes()
+
+    @pytest.mark.parametrize("dtype,code", [
+        ("<f8", "f64"), ("<f4", "f32"), ("<i8", "i64"), ("<i4", "i32"),
+        ("u1", "u8")])
+    def test_ndarray_dtype_preserved(self, dtype, code):
+        arr = np.array([1, 2, 3], dtype=dtype)
+        frame = encode_frame({"c": arr})
+        header = json.loads(frame[12:12 + struct.unpack(
+            "<I", frame[8:12])[0]])
+        assert header["columns"][0]["dtype"] == code
+        columns, _ = decode_frame(frame)
+        got = columns["c"]
+        assert got.dtype == np.dtype(dtype)
+        assert got.tobytes() == arr.tobytes()
+
+    def test_bool_column(self):
+        arr = np.array([True, False, True])
+        columns, _ = decode_frame(encode_frame({"b": arr}))
+        assert columns["b"].dtype == bool
+        assert columns["b"].tolist() == [True, False, True]
+
+    def test_big_endian_input_normalized(self):
+        arr = np.array([1.5, -2.25, 3.0], dtype=">f8")
+        columns, _ = decode_frame(encode_frame({"c": arr}))
+        assert np.asarray(columns["c"]).tobytes() == \
+            arr.astype("<f8").tobytes()
+
+    def test_big_endian_payload_flag_honored(self):
+        """A frame whose flags clear bit0 carries big-endian buffers —
+        the decoder must byte-swap on read."""
+        arr = np.array([1.0, 2.0, 3.0], dtype=">f8")
+        header = json.dumps({
+            "n_rows": 3, "model": None, "tenant": None,
+            "deadline_ms": None,
+            "columns": [{"name": "c", "dtype": "f64", "nulls": False,
+                         "nbytes": 24}]}).encode()
+        frame = MAGIC + struct.pack("<BBHI", WIRE_VERSION, 0, 0,
+                                    len(header)) + header + arr.tobytes()
+        columns, _ = decode_frame(frame)
+        assert np.asarray(columns["c"], "<f8").tolist() == [1.0, 2.0, 3.0]
+
+    def test_nullable_list_roundtrip(self):
+        columns, _ = decode_frame(encode_frame({"c": [1.0, None, 3.0]}))
+        assert columns["c"][0] == 1.0
+        assert columns["c"][1] is None
+        assert columns["c"][2] == 3.0
+
+    def test_json_column_roundtrip(self):
+        vals = ["a", None, "c"]
+        columns, _ = decode_frame(encode_frame({"s": vals}))
+        assert columns["s"] == vals
+
+    def test_zero_rows(self):
+        columns, meta = decode_frame(encode_frame({"c": []}))
+        assert meta["n_rows"] == 0 and len(columns["c"]) == 0
+
+    def test_ragged_columns_rejected_at_encode(self):
+        with pytest.raises(ValueError):
+            encode_frame({"a": [1.0, 2.0], "b": [1.0]})
+
+    def test_content_type_is_stable(self):
+        # the HTTP routing contract: this string IS the wire switch
+        assert CONTENT_TYPE == "application/x-transmogrifai-columnar"
+
+
+# --------------------------------------------------------------------- #
+# malformed-frame fuzz corpus                                           #
+# --------------------------------------------------------------------- #
+
+def _mutations():
+    good = _frame()
+    hlen = struct.unpack("<I", good[8:12])[0]
+    bad_header = lambda h: (MAGIC + struct.pack(
+        "<BBHI", WIRE_VERSION, 1, 0, len(h)) + h)
+    muts = {
+        "empty": b"",
+        "short_prefix": good[:7],
+        "bad_magic": b"NOPE" + good[4:],
+        "wrong_version": good[:4] + struct.pack(
+            "<BBHI", 99, 1, 0, hlen) + good[12:],
+        "header_len_past_end": good[:8] + struct.pack(
+            "<I", len(good) * 2) + good[12:],
+        "header_len_zero": good[:8] + struct.pack("<I", 0) + good[12:],
+        "header_not_json": bad_header(b"{torn" + b"x" * 10),
+        "header_not_object": bad_header(b'[1,2,3]'),
+        "n_rows_negative": bad_header(json.dumps(
+            {"n_rows": -1, "columns": []}).encode()),
+        "n_rows_huge": bad_header(json.dumps(
+            {"n_rows": 10**9, "columns": []}).encode()),
+        "n_rows_bool": bad_header(json.dumps(
+            {"n_rows": True, "columns": []}).encode()),
+        "columns_not_list": bad_header(json.dumps(
+            {"n_rows": 1, "columns": {}}).encode()),
+        "column_not_object": bad_header(json.dumps(
+            {"n_rows": 0, "columns": [7]}).encode()),
+        "unknown_dtype": bad_header(json.dumps(
+            {"n_rows": 0, "columns": [
+                {"name": "c", "dtype": "f128", "nbytes": 0}]}).encode()),
+        "nbytes_negative": bad_header(json.dumps(
+            {"n_rows": 0, "columns": [
+                {"name": "c", "dtype": "f64", "nbytes": -8}]}).encode()),
+        "torn_payload": good[:-5],
+        "trailing_bytes": good + b"junk",
+        "buffer_size_mismatch": bad_header(json.dumps(
+            {"n_rows": 2, "columns": [
+                {"name": "c", "dtype": "f64",
+                 "nbytes": 9}]}).encode()) + b"x" * 9,
+        "empty_column_name": bad_header(json.dumps(
+            {"n_rows": 0, "columns": [
+                {"name": "", "dtype": "f64", "nbytes": 0}]}).encode()),
+        "oversize_column_name": bad_header(json.dumps(
+            {"n_rows": 0, "columns": [
+                {"name": "c" * 300, "dtype": "f64",
+                 "nbytes": 0}]}).encode()),
+        "not_bytes": "a string",
+    }
+    # duplicate column names
+    dup = json.dumps({"n_rows": 1, "columns": [
+        {"name": "c", "dtype": "f64", "nulls": False, "nbytes": 8},
+        {"name": "c", "dtype": "f64", "nulls": False, "nbytes": 8},
+    ]}).encode()
+    muts["duplicate_column"] = bad_header(dup) + b"\0" * 16
+    return muts
+
+
+@pytest.mark.parametrize("label", sorted(_mutations()))
+def test_malformed_frame_is_bad_request(label):
+    with pytest.raises(ScoreError) as ei:
+        decode_frame(_mutations()[label])
+    assert ei.value.code == "bad_request"
+    assert "binary frame" in str(ei.value)
+
+
+# --------------------------------------------------------------------- #
+# through a real service: parity + breaker/health isolation             #
+# --------------------------------------------------------------------- #
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    rng = np.random.default_rng(11)
+    x1, x2 = rng.normal(size=80), rng.normal(size=80)
+    y = ((x1 + 0.5 * x2) > 0).astype(np.float64)
+    ds = Dataset({"x1": x1, "x2": x2, "y": y},
+                 {"x1": t.Real, "x2": t.Real, "y": t.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = RealVectorizer(track_nulls=False).set_input(*preds).get_output()
+    pred = OpLogisticRegression(max_iter=25).set_input(
+        label, vec).get_output()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+    mdir = tmp_path_factory.mktemp("binwire-model") / "m1"
+    model.save(str(mdir))
+    svc = FleetService(FleetConfig(
+        models={"m1": str(mdir)},
+        serving={"max_batch": 4, "batch_wait_ms": 1.0}))
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+class TestThroughService:
+    def test_binary_scores_bit_identical_to_json_wire(self, fleet):
+        json_result = fleet.score_columns("m1", {k: list(v)
+                                                 for k, v in COLS.items()})
+        bin_result = fleet.score_frame(_frame())
+        assert bin_result.rows() == json_result.rows()
+
+    def test_ndarray_frame_matches_too(self, fleet):
+        arrays = {k: np.asarray(v, np.float64) for k, v in COLS.items()}
+        assert fleet.score_frame(encode_frame(
+            arrays, model="m1")).rows() == \
+            fleet.score_columns("m1", arrays).rows()
+
+    def test_bad_frames_never_feed_breaker_or_health(self, fleet):
+        before = fleet.health()
+        assert before["status"] == "ok"
+        for label, frame in sorted(_mutations().items()):
+            with pytest.raises(ScoreError) as ei:
+                fleet.score_frame(frame)
+            assert ei.value.code == "bad_request", label
+        # a storm of framing bugs must not degrade the service…
+        after = fleet.health()
+        assert after["status"] == "ok"
+        m = after["models"]["m1"]
+        assert m["status"] == "ok"
+        # …and real traffic still scores
+        assert fleet.score_frame(_frame()).rows()
+
+    def test_frame_without_model_is_bad_request(self, fleet):
+        with pytest.raises(ScoreError) as ei:
+            fleet.score_frame(encode_frame(dict(COLS)))
+        assert ei.value.code == "bad_request"

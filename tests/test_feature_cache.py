@@ -587,9 +587,9 @@ class TestPolicyThreading:
         w.append(np.zeros((2, 2), np.float16))
         tmp_dir = w.tmp
 
-        def boom(tmp, final):
+        def boom(staged_dir, key):
             raise OSError("rename race lost")
-        monkeypatch.setattr(fc, "_commit_staged_dir", boom)
+        monkeypatch.setattr(w.store.backend, "commit", boom)
         with pytest.raises(OSError):
             w.finalize()
         assert not os.path.exists(tmp_dir), "staged dir leaked"

@@ -1,0 +1,352 @@
+"""serving/frontend.py: the warmth-aware L7 router over K fleet
+replicas — warmth scoring tiers, power-of-two-choices tiebreak, both
+request wires at the edge, the fleet-wide merged /metrics view, the
+K-replica shared-quota invariant (429 from EITHER replica), and the
+goodput report's router section."""
+
+import json
+import math
+import struct
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.obs.goodput as obsg
+import transmogrifai_tpu.types as t
+from transmogrifai_tpu.data import Dataset
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import OpLogisticRegression
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.obs.trace import Tracer
+from transmogrifai_tpu.ops.numeric import RealVectorizer
+from transmogrifai_tpu.serving import ScoreError
+from transmogrifai_tpu.serving.binwire import CONTENT_TYPE, encode_frame
+from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+from transmogrifai_tpu.serving.frontend import Frontend, serve_frontend
+from transmogrifai_tpu.workflow import Workflow
+
+COLS = {"x1": [0.3, -0.5, 2.0], "x2": [-1.2, 0.8, 0.1]}
+
+
+# --------------------------------------------------------------------- #
+# fakes: the replica surface the frontend consumes                      #
+# --------------------------------------------------------------------- #
+
+class _Result:
+    model_version = "v1"
+    latency_s = 0.001
+    trace_id = "t-0"
+
+    def rows(self):
+        return [{"prediction": 1.0}]
+
+
+class FakeReplica:
+    """Health report + score_* surface, recording every call."""
+
+    def __init__(self, status="ok", warm=False, staging=False,
+                 buckets=(4, 16), queue_depth=0, hosts=True,
+                 fail_health=False):
+        self.registry = MetricsRegistry()
+        self.calls = []
+        self.fail_health = fail_health
+        model = {
+            "status": "ok",
+            "buckets": list(buckets),
+            "queue_depth": queue_depth,
+            "versions": [{"compile_counts": {"4": 1}} if warm else {}],
+            "staging": {"allocations": [{"bucket": 4}]} if staging else {},
+        }
+        self._health = {"status": status,
+                        "models": ({"m1": model} if hosts else {})}
+
+    def health(self):
+        if self.fail_health:
+            raise ConnectionError("replica unreachable")
+        return json.loads(json.dumps(self._health))
+
+    def score(self, model, rows, tenant=None, deadline_ms=None,
+              trace=None):
+        self.calls.append(("rows", model, len(rows)))
+        return _Result()
+
+    def score_columns(self, model, columns, tenant=None,
+                      deadline_ms=None, trace=None):
+        self.calls.append(("columns", model, tenant))
+        return _Result()
+
+
+def _frontend(**replicas):
+    return Frontend(replicas, refresh_s=3600.0)
+
+
+# --------------------------------------------------------------------- #
+# warmth scoring + routing                                              #
+# --------------------------------------------------------------------- #
+
+class TestWarmthScore:
+    def test_tiers(self):
+        score = Frontend._score_warmth
+        assert score(None, 4) == 0
+        assert score({"status": "quarantined"}, 4) == 0
+        assert score({"status": "ok"}, 4) == 1            # hosts, cold
+        assert score({"status": "ok", "warm": True}, 4) == 2
+        assert score({"status": "ok", "warm": True, "staging": True,
+                      "buckets": [4, 16]}, 4) == 3
+
+    def test_ladder_overflow_drops_staging_point(self):
+        entry = {"status": "ok", "warm": True, "staging": True,
+                 "buckets": [4, 16]}
+        assert Frontend._score_warmth(entry, 1000) == 2
+
+    def test_degraded_replica_still_serves(self):
+        assert Frontend._score_warmth({"status": "degraded"}, 4) == 1
+
+
+class TestRouting:
+    def test_warm_replica_beats_cold(self):
+        fe = _frontend(cold=FakeReplica(), warm=FakeReplica(warm=True))
+        for _ in range(8):
+            name, _, warm = fe.route("m1", 3)
+            assert name == "warm" and warm
+
+    def test_staging_beats_warm_only(self):
+        fe = _frontend(warm=FakeReplica(warm=True),
+                       hot=FakeReplica(warm=True, staging=True))
+        assert fe.route("m1", 3)[0] == "hot"
+
+    def test_tie_breaks_on_queue_depth(self):
+        fe = _frontend(busy=FakeReplica(warm=True, queue_depth=9),
+                       idle=FakeReplica(warm=True, queue_depth=0))
+        for _ in range(8):
+            assert fe.route("m1", 3)[0] == "idle"
+
+    def test_unknown_model_spreads_over_everyone(self):
+        fe = _frontend(a=FakeReplica(hosts=False),
+                       b=FakeReplica(hosts=False))
+        picked = {fe.route("nope", 1)[0] for _ in range(32)}
+        assert picked == {"a", "b"}
+        assert fe.route("nope", 1)[2] is False
+
+    def test_down_replica_excluded(self):
+        fe = _frontend(up=FakeReplica(),
+                       down=FakeReplica(fail_health=True))
+        assert fe.route("m1", 3)[0] == "up"
+        health = fe.health()
+        assert health["status"] == "degraded"
+        assert health["replicas"]["down"]["status"] == "down"
+
+    def test_score_reaches_routed_replica_and_counts(self):
+        warm = FakeReplica(warm=True)
+        fe = _frontend(cold=FakeReplica(), warm=warm)
+        fe.score("m1", [{"x1": 1.0}])
+        fe.score_columns("m1", {"x1": [1.0]}, tenant="acme")
+        assert warm.calls == [("rows", "m1", 1),
+                              ("columns", "m1", "acme")]
+        got = fe.registry.find("router_requests_total",
+                               replica="warm", wire="json")
+        assert got is not None and got.value == 2.0
+        assert fe.registry.find("router_warm_hits_total").value == 2.0
+
+    def test_score_frame_routes_on_header(self):
+        warm = FakeReplica(warm=True)
+        fe = _frontend(warm=warm)
+        fe.score_frame(encode_frame(dict(COLS), model="m1",
+                                    tenant="acme"))
+        assert warm.calls == [("columns", "m1", "acme")]
+        assert fe.registry.find("router_requests_total",
+                                replica="warm", wire="binary").value == 1.0
+
+    def test_bad_frame_never_reaches_a_replica(self):
+        warm = FakeReplica(warm=True)
+        fe = _frontend(warm=warm)
+        for frame in (b"", b"NOPE" + b"\0" * 16,
+                      encode_frame(dict(COLS))):  # no model name
+            with pytest.raises(ScoreError) as ei:
+                fe.score_frame(frame)
+            assert ei.value.code == "bad_request"
+        assert warm.calls == []
+        assert fe.registry.find(
+            "router_frame_errors_total").value == 3.0
+
+    def test_replica_error_propagates_structured(self):
+        class Shedding(FakeReplica):
+            def score_columns(self, *a, **k):
+                raise ScoreError("quota_exceeded", "over quota",
+                                 retry_after_s=1.0)
+        fe = _frontend(only=Shedding(warm=True))
+        with pytest.raises(ScoreError) as ei:
+            fe.score_columns("m1", {"x1": [1.0]})
+        assert ei.value.code == "quota_exceeded"
+
+    def test_merged_registry_labels_replicas(self):
+        a, b = FakeReplica(warm=True), FakeReplica(warm=True)
+        a.registry.counter("scores_total").inc(3)
+        b.registry.counter("scores_total").inc(4)
+        a.registry.gauge("queue_depth").set(2)
+        b.registry.gauge("queue_depth").set(5)
+        fe = _frontend(a=a, b=b)
+        merged = fe.merged_registry()
+        # counters sum fleet-wide; gauges keep per-replica identity
+        assert merged.find("scores_total").value == 7.0
+        assert merged.find("queue_depth", replica="a").value == 2.0
+        assert merged.find("queue_depth", replica="b").value == 5.0
+        text = merged.to_prometheus()
+        assert 'queue_depth{replica="a"} 2' in text
+
+
+# --------------------------------------------------------------------- #
+# two REAL replicas over one shared store: quota + wires + HTTP         #
+# --------------------------------------------------------------------- #
+
+@pytest.fixture(scope="module")
+def duo(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    x1, x2 = rng.normal(size=80), rng.normal(size=80)
+    y = ((x1 + 0.5 * x2) > 0).astype(np.float64)
+    ds = Dataset({"x1": x1, "x2": x2, "y": y},
+                 {"x1": t.Real, "x2": t.Real, "y": t.Integral})
+    preds, label = FeatureBuilder.from_dataset(ds, response="y")
+    vec = RealVectorizer(track_nulls=False).set_input(*preds).get_output()
+    pred = OpLogisticRegression(max_iter=25).set_input(
+        label, vec).get_output()
+    model = Workflow().set_result_features(pred, label) \
+        .set_input_dataset(ds).train()
+    mdir = tmp_path_factory.mktemp("frontend-model") / "m1"
+    model.save(str(mdir))
+    store = tmp_path_factory.mktemp("frontend-store")
+    tenants = {"meter": {"rate": 0.001, "burst": 6.0}}
+
+    def replica(name):
+        svc = FleetService(FleetConfig(
+            models={"m1": str(mdir)},
+            serving={"max_batch": 4, "batch_wait_ms": 1.0},
+            tenants=dict(tenants),
+            store_dir=str(store), replica=name, shared_quota=True))
+        svc.start()
+        return svc
+
+    r1, r2 = replica("r1"), replica("r2")
+    fe = Frontend({"r1": r1, "r2": r2}, refresh_s=3600.0)
+    server, thread = serve_frontend(fe, port=0, block=False)
+    yield {"frontend": fe, "r1": r1, "r2": r2,
+           "url": f"http://127.0.0.1:{server.port}"}
+    server.shutdown()
+    r1.stop()
+    r2.stop()
+
+
+def _post(url, payload, content_type="application/json"):
+    data = (payload if isinstance(payload, bytes)
+            else json.dumps(payload).encode())
+    req = urllib.request.Request(
+        url + "/score", data=data,
+        headers={"Content-Type": content_type})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestSharedQuotaFleet:
+    def test_429_from_either_replica(self, duo):
+        r1, r2 = duo["r1"], duo["r2"]
+        # replica r1 drains the FLEET-WIDE balance (burst=6, ~no refill)
+        r1.score_columns("m1", {k: list(v) for k, v in COLS.items()},
+                         tenant="meter")
+        r1.score_columns("m1", {k: list(v) for k, v in COLS.items()},
+                         tenant="meter")
+        # …so replica r2 — which never served this tenant — denies:
+        # the K-replica sum stays inside ONE tenant's rate
+        with pytest.raises(ScoreError) as ei:
+            r2.score_columns("m1", {k: list(v) for k, v in COLS.items()},
+                             tenant="meter")
+        assert ei.value.code == "quota_exceeded"
+        assert (ei.value.retry_after_s or 0) > 0
+        # and r1 is out too — either replica 429s now
+        with pytest.raises(ScoreError) as e2:
+            r1.score_columns("m1", {k: list(v) for k, v in COLS.items()},
+                             tenant="meter")
+        assert e2.value.code == "quota_exceeded"
+
+    def test_unmetered_tenant_unaffected(self, duo):
+        out = duo["frontend"].score_columns(
+            "m1", {k: list(v) for k, v in COLS.items()})
+        assert len(out.rows()) == 3
+
+
+class TestFrontendHTTP:
+    def test_healthz_and_warmth(self, duo):
+        with urllib.request.urlopen(duo["url"] + "/healthz",
+                                    timeout=30) as resp:
+            health = json.loads(resp.read())
+            assert resp.status == 200
+        assert health["status"] == "ok"
+        assert set(health["replicas"]) == {"r1", "r2"}
+        with urllib.request.urlopen(duo["url"] + "/warmth",
+                                    timeout=30) as resp:
+            warmth = json.loads(resp.read())
+        assert "m1" in warmth["replicas"]["r1"]["models"]
+
+    def test_json_and_binary_wires_agree_over_http(self, duo):
+        body = {"model": "m1", "columns": {k: list(v)
+                                           for k, v in COLS.items()}}
+        status, via_json = _post(duo["url"], body)
+        assert status == 200
+        frame = encode_frame({k: list(v) for k, v in COLS.items()},
+                             model="m1")
+        status, via_bin = _post(duo["url"], frame,
+                                content_type=CONTENT_TYPE)
+        assert status == 200
+        assert via_bin["scores"] == via_json["scores"]
+
+    def test_malformed_frame_is_400_not_500(self, duo):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(duo["url"], b"TMGW" + b"\xff" * 20,
+                  content_type=CONTENT_TYPE)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["error"] == "bad_request"
+        # the storm did not degrade the fleet
+        with urllib.request.urlopen(duo["url"] + "/healthz",
+                                    timeout=30) as resp:
+            assert resp.status == 200
+
+    def test_metrics_is_fleet_wide_merge(self, duo):
+        with urllib.request.urlopen(
+                duo["url"] + "/metrics?format=json", timeout=30) as resp:
+            fams = json.loads(resp.read())
+        assert "router_requests_total" in fams
+        with urllib.request.urlopen(duo["url"] + "/metrics",
+                                    timeout=30) as resp:
+            text = resp.read().decode()
+        assert "# TYPE router_request_latency_seconds histogram" in text
+
+
+# --------------------------------------------------------------------- #
+# goodput report: router section                                        #
+# --------------------------------------------------------------------- #
+
+class TestGoodputRouterSection:
+    def test_router_route_events_distilled(self):
+        tr = Tracer()
+        with tr.span("run", new_trace=True) as root:
+            root.event("router_route", replica="r1", model="m1",
+                       wire="binary", warm=True, rows=4, outcome="ok")
+            root.event("router_route", replica="r2", model="m1",
+                       wire="json", warm=False, rows=2,
+                       outcome="quota_exceeded")
+        report = obsg.build_report(root, tr.trace_spans(root.trace_id))
+        assert report.router["requests"] == 2
+        assert report.router["rows"] == 6
+        assert report.router["warm_routes"] == 1
+        assert report.router["cold_routes"] == 1
+        assert report.router["by_replica"] == {"r1": 1, "r2": 1}
+        assert report.router["by_wire"] == {"binary": 1, "json": 1}
+        assert report.router["errors"] == {"quota_exceeded": 1}
+        assert report.to_json()["router"]["requests"] == 2
+
+    def test_no_events_no_section(self):
+        tr = Tracer()
+        with tr.span("run", new_trace=True) as root:
+            pass
+        assert obsg.build_report(root, []).router == {}

@@ -499,6 +499,87 @@ class TestMetricsExposition:
 
 
 # --------------------------------------------------------------------- #
+# MetricsRegistry.merge: the fleet-wide /metrics view (satellite)       #
+# --------------------------------------------------------------------- #
+
+class TestMetricsMerge:
+    def test_counters_sum_into_same_labeled_series(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("req_total", "h", wire="json").inc(3)
+        b.counter("req_total", "h", wire="json").inc(4)
+        b.counter("req_total", "h", wire="binary").inc(1)
+        merged = MetricsRegistry()
+        merged.merge(a, replica="r1").merge(b, replica="r2")
+        assert merged.find("req_total", wire="json").value == 7.0
+        assert merged.find("req_total", wire="binary").value == 1.0
+        # exposition: ONE fleet-total line per wire, no replica label
+        lines = [ln for ln in merged.to_prometheus().splitlines()
+                 if ln.startswith("req_total{")]
+        assert sorted(lines) == ['req_total{wire="binary"} 1.0',
+                                 'req_total{wire="json"} 7.0']
+
+    def test_gauges_keep_per_replica_identity(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.gauge("queue_depth", "h", model="m1").set(2)
+        b.gauge("queue_depth", "h", model="m1").set(9)
+        merged = MetricsRegistry().merge(a, replica="r1").merge(
+            b, replica="r2")
+        # two replicas' depths must never collapse into one number
+        assert merged.find("queue_depth", model="m1") is None
+        text = merged.to_prometheus()
+        assert 'queue_depth{model="m1",replica="r1"} 2' in text
+        assert 'queue_depth{model="m1",replica="r2"} 9' in text
+
+    def test_histograms_fold_buckets_when_ladders_agree(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        ha = a.histogram("lat_seconds", "h", bounds=(0.1, 1.0))
+        hb = b.histogram("lat_seconds", "h", bounds=(0.1, 1.0))
+        for v in (0.05, 0.5):
+            ha.observe(v)
+        hb.observe(5.0)
+        merged = MetricsRegistry().merge(a, replica="r1").merge(
+            b, replica="r2")
+        h = merged.find("lat_seconds")
+        assert h.count == 3
+        assert h.sum == pytest.approx(5.55)
+        assert h.bucket_counts() == [(0.1, 1), (1.0, 2),
+                                     (float("inf"), 3)]
+        assert "lat_seconds_count 3" in merged.to_prometheus()
+
+    def test_histogram_ladder_mismatch_falls_back_to_labeled_series(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.histogram("lat_seconds", "h", bounds=(0.1, 1.0)).observe(0.05)
+        b.histogram("lat_seconds", "h", bounds=(0.5,)).observe(0.05)
+        merged = MetricsRegistry().merge(a, replica="r1").merge(
+            b, replica="r2")
+        # r1's ladder claimed the unlabeled series; r2's incompatible
+        # ladder lands under its replica label instead of corrupting it
+        assert merged.find("lat_seconds").count == 1
+        assert merged.find("lat_seconds", replica="r2").count == 1
+
+    def test_type_conflict_skipped_not_fatal(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("depth", "h").inc(1)
+        b.gauge("depth", "h").set(9)
+        merged = MetricsRegistry().merge(a, replica="r1")
+        merged.merge(b, replica="r2")  # must not raise
+        assert merged.find("depth").value == 1.0
+        assert "depth 1.0" in merged.to_prometheus()
+
+    def test_merge_is_a_snapshot_not_a_link(self):
+        a = MetricsRegistry()
+        c = a.counter("req_total")
+        c.inc(2)
+        merged = MetricsRegistry().merge(a, replica="r1")
+        c.inc(10)  # later replica traffic must not mutate the scrape
+        assert merged.find("req_total").value == 2.0
+
+    def test_merge_returns_self_for_chaining(self):
+        merged = MetricsRegistry()
+        assert merged.merge(MetricsRegistry(), replica="x") is merged
+
+
+# --------------------------------------------------------------------- #
 # RunProfile satellites                                                 #
 # --------------------------------------------------------------------- #
 

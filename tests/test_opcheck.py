@@ -1198,3 +1198,61 @@ def test_lint_l018_repo_clean():
         os.path.abspath(__file__))), "transmogrifai_tpu")
     findings = [f for f in L.lint_paths([pkg]) if f.code == "L018"]
     assert findings == []
+
+
+# -- L020: store-bypass writes ----------------------------------------------- #
+
+def test_lint_l020_flags_direct_writes_into_store_paths():
+    src = '''
+import os, json
+import numpy as np
+
+def bad_manifest(cache, key, meta):
+    with open(os.path.join(cache.path_of(key), "artifact.json"), "w") as fh:
+        json.dump(meta, fh)
+
+def bad_np_save(arr):
+    np.save(os.path.join(default_cache_dir(), "tape.npy"), arr)
+
+def ok_read(cache, key):
+    with open(os.path.join(cache.path_of(key), "artifact.json")) as fh:
+        return fh.read()
+
+def ok_elsewhere(tmp_dir, arr):
+    np.save(os.path.join(tmp_dir, "tape.npy"), arr)
+'''
+    findings = [f for f in L.lint_source(
+        src, path="transmogrifai_tpu/data/newmod.py") if f.code == "L020"]
+    assert len(findings) == 2
+    assert all("ArtifactStore" in f.message for f in findings)
+
+
+def test_lint_l020_annotation_and_allowlists():
+    src = '''
+import os
+
+def sidecar(key):
+    p = os.path.join(cache_root(), ".access", key)
+    with open(os.path.join(cache_root(), ".access", key),  # store-ok: clock
+              "a") as fh:
+        pass
+'''
+    findings = [f for f in L.lint_source(
+        src, path="transmogrifai_tpu/data/newmod.py") if f.code == "L020"]
+    assert len(findings) == 1 and findings[0].suppression == "annotation"
+    assert not findings[0].gating
+    # the store itself and tests are the sanctioned writers
+    raw = src.replace("  # store-ok: clock", "")
+    assert not any(f.code == "L020" for f in L.lint_source(
+        raw, path="transmogrifai_tpu/store/artifact.py"))
+    assert not any(f.code == "L020" for f in L.lint_source(
+        raw, path="tests/test_store.py"))
+
+
+def test_lint_l020_repo_clean():
+    import os
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "transmogrifai_tpu")
+    findings = [f for f in L.lint_paths([pkg]) if f.code == "L020"
+                and f.gating]
+    assert findings == []

@@ -1,0 +1,353 @@
+"""store/: content-addressed artifact store (put/get/stat, corruption
+rejection, TTL+LRU gc, prefetch), filesystem CAS state cells, and the
+lease-based shared tenant quota built on them."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.store import (
+    ArtifactStore, LocalDirBackend, SharedQuota, StateCell,
+    StoreCorruptError, cache_root, resolve_dir, store_configured)
+from transmogrifai_tpu.store.artifact import MANIFEST
+
+
+def _store(tmp_path, **kw):
+    return ArtifactStore(LocalDirBackend(str(tmp_path / "store")),
+                         registry=MetricsRegistry(), **kw)
+
+
+def _put(store, key, payload=b"abc123", meta=None):
+    def stage(tmp):
+        with open(os.path.join(tmp, "payload.bin"), "wb") as fh:
+            fh.write(payload)
+    return store.put(key, stage, meta=meta or {"kind": "test"})
+
+
+# --------------------------------------------------------------------- #
+# config resolution                                                     #
+# --------------------------------------------------------------------- #
+
+class TestConfig:
+    def test_store_env_moves_every_kind(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_STORE_DIR", str(tmp_path))
+        assert store_configured()
+        assert cache_root() == str(tmp_path)
+        assert resolve_dir("feature_cache") == str(tmp_path / "feature_cache")
+        assert resolve_dir("perf") == str(tmp_path / "perf")
+
+    def test_subsystem_env_beats_store_root(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_STORE_DIR", str(tmp_path))
+        monkeypatch.setenv("TRANSMOGRIFAI_FEATURE_CACHE_DIR", "/elsewhere")
+        assert resolve_dir(
+            "feature_cache",
+            env="TRANSMOGRIFAI_FEATURE_CACHE_DIR") == "/elsewhere"
+
+    def test_explicit_beats_everything(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_STORE_DIR", str(tmp_path))
+        assert resolve_dir("perf", explicit="/mine") == "/mine"
+
+    def test_default_is_home_cache(self, monkeypatch):
+        monkeypatch.delenv("TRANSMOGRIFAI_STORE_DIR", raising=False)
+        assert not store_configured()
+        assert cache_root() == os.path.expanduser(
+            "~/.cache/transmogrifai_tpu")
+
+    def test_consumers_follow_store_root(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_STORE_DIR", str(tmp_path))
+        monkeypatch.delenv("TRANSMOGRIFAI_FEATURE_CACHE_DIR",
+                           raising=False)
+        monkeypatch.delenv("TRANSMOGRIFAI_PERF_CORPUS_DIR", raising=False)
+        from transmogrifai_tpu.data.feature_cache import default_cache_dir
+        from transmogrifai_tpu.perf.params import resolved_corpus_dir
+        assert default_cache_dir() == str(tmp_path / "feature_cache")
+        assert resolved_corpus_dir() == str(tmp_path / "perf")
+
+
+# --------------------------------------------------------------------- #
+# artifact roundtrip + verification                                     #
+# --------------------------------------------------------------------- #
+
+class TestArtifactStore:
+    def test_put_get_stat_roundtrip(self, tmp_path):
+        store = _store(tmp_path)
+        path = _put(store, "k1", b"hello world", meta={"kind": "tape"})
+        assert os.path.isfile(os.path.join(path, MANIFEST))
+        got = store.get("k1")
+        assert got == path
+        with open(os.path.join(got, "payload.bin"), "rb") as fh:
+            assert fh.read() == b"hello world"
+        info = store.stat("k1")
+        assert info.key == "k1" and info.bytes == 11 and info.files == 1
+        assert info.meta["kind"] == "tape"
+        assert store.keys() == ["k1"]
+
+    def test_miss_is_none_not_error(self, tmp_path):
+        store = _store(tmp_path)
+        assert store.get("absent") is None
+        assert store.stat("absent") is None
+
+    def test_bit_flip_rejected(self, tmp_path):
+        store = _store(tmp_path)
+        path = _put(store, "k1", b"x" * 256)
+        p = os.path.join(path, "payload.bin")
+        blob = bytearray(open(p, "rb").read())
+        blob[100] ^= 0xFF
+        with open(p, "wb") as fh:
+            fh.write(bytes(blob))
+        with pytest.raises(StoreCorruptError) as ei:
+            store.get("k1")
+        assert "checksum mismatch" in ei.value.reason
+
+    def test_truncation_rejected_even_without_verify(self, tmp_path):
+        store = _store(tmp_path)
+        path = _put(store, "k1", b"x" * 256)
+        p = os.path.join(path, "payload.bin")
+        with open(p, "r+b") as fh:
+            fh.truncate(10)
+        with pytest.raises(StoreCorruptError) as ei:
+            store.get("k1", verify=False)
+        assert "truncated" in ei.value.reason
+
+    def test_key_mismatch_and_garbage_manifest(self, tmp_path):
+        store = _store(tmp_path)
+        path = _put(store, "k1")
+        m = json.load(open(os.path.join(path, MANIFEST)))
+        m["key"] = "other"
+        with open(os.path.join(path, MANIFEST), "w") as fh:
+            json.dump(m, fh)
+        with pytest.raises(StoreCorruptError):
+            store.get("k1")
+        with open(os.path.join(path, MANIFEST), "w") as fh:
+            fh.write("{torn")
+        with pytest.raises(StoreCorruptError):
+            store.get("k1")
+
+    def test_illegal_keys_rejected(self, tmp_path):
+        store = _store(tmp_path)
+        for bad in ("../escape", "", ".hidden", "a/b"):
+            with pytest.raises(ValueError):
+                store.backend.path_of(bad)
+
+    def test_failed_stage_leaves_nothing(self, tmp_path):
+        store = _store(tmp_path)
+
+        def stage(tmp):
+            with open(os.path.join(tmp, "half.bin"), "wb") as fh:
+                fh.write(b"partial")
+            raise RuntimeError("staging died")
+
+        with pytest.raises(RuntimeError):
+            store.put("k1", stage)
+        assert store.get("k1") is None
+        assert store.keys() == []
+        # no stranded staging dirs either
+        root = store.backend.root
+        assert [n for n in os.listdir(root)
+                if n.startswith(".stage-")] == []
+
+    def test_metrics_count_hits_misses_corrupt(self, tmp_path):
+        reg = MetricsRegistry()
+        store = ArtifactStore(LocalDirBackend(str(tmp_path / "s")),
+                              registry=reg)
+        _put(store, "k1")
+        store.get("k1")
+        store.get("nope")
+        assert reg.find("store_hits_total",
+                        backend="localdir").value == 1.0
+        assert reg.find("store_misses_total",
+                        backend="localdir").value == 1.0
+        assert reg.find("store_puts_total",
+                        backend="localdir").value == 1.0
+
+
+# --------------------------------------------------------------------- #
+# prefetch                                                              #
+# --------------------------------------------------------------------- #
+
+class TestPrefetch:
+    def test_prefetch_verifies_then_get_skips_rehash(self, tmp_path,
+                                                     monkeypatch):
+        store = _store(tmp_path)
+        _put(store, "k1", b"y" * 1024)
+        t = store.prefetch("k1")
+        assert t is not None
+        t.join(5.0)
+        # after a verified prefetch the next get must not re-hash
+        import transmogrifai_tpu.store.artifact as art
+
+        def no_hash(path):
+            raise AssertionError("get re-hashed after verified prefetch")
+
+        monkeypatch.setattr(art, "sha256_file", no_hash)
+        assert store.get("k1") is not None
+        # the voucher is consume-once: a second get re-verifies
+        with pytest.raises(AssertionError):
+            store.get("k1")
+
+    def test_prefetch_finds_corruption(self, tmp_path):
+        store = _store(tmp_path)
+        path = _put(store, "k1", b"y" * 1024)
+        p = os.path.join(path, "payload.bin")
+        blob = bytearray(open(p, "rb").read())
+        blob[7] ^= 0x01
+        with open(p, "wb") as fh:
+            fh.write(bytes(blob))
+        t = store.prefetch("k1")
+        t.join(5.0)
+        with pytest.raises(StoreCorruptError):
+            store.get("k1")
+
+    def test_prefetch_absent_returns_none(self, tmp_path):
+        assert _store(tmp_path).prefetch("absent") is None
+
+
+# --------------------------------------------------------------------- #
+# gc: TTL + LRU                                                         #
+# --------------------------------------------------------------------- #
+
+class TestGC:
+    def test_ttl_evicts_stale_keeps_fresh(self, tmp_path):
+        store = _store(tmp_path)
+        _put(store, "old")
+        _put(store, "new")
+        # age the "old" access clock far past the TTL
+        old_touch = store._touch_path("old")
+        past = time.time() - 3600
+        os.utime(old_touch, (past, past))
+        out = store.gc(ttl_s=60, max_bytes=None)
+        assert out["evicted"] == ["old"]
+        assert store.keys() == ["new"]
+
+    def test_lru_evicts_down_to_budget(self, tmp_path):
+        store = _store(tmp_path)
+        now = time.time()
+        for i, key in enumerate(("a", "b", "c")):
+            _put(store, key, b"z" * 100)
+            t = now - (100 - i)  # a oldest, c newest
+            os.utime(store._touch_path(key), (t, t))
+        out = store.gc(ttl_s=None, max_bytes=250)
+        assert out["bytes"] <= 250
+        assert store.keys() == ["b", "c"]  # LRU victim was "a"
+
+    def test_replayed_artifact_stays_resident(self, tmp_path):
+        store = _store(tmp_path)
+        now = time.time()
+        for key in ("hot", "cold"):
+            _put(store, key, b"z" * 100)
+            t = now - 100
+            os.utime(store._touch_path(key), (t, t))
+        store.get("hot")  # replay refreshes the access clock
+        out = store.gc(ttl_s=None, max_bytes=150)
+        assert store.keys() == ["hot"]
+        assert out["evicted"] == ["cold"]
+
+    def test_gc_reclaims_corrupt_artifacts(self, tmp_path):
+        store = _store(tmp_path)
+        path = _put(store, "k1")
+        with open(os.path.join(path, MANIFEST), "w") as fh:
+            fh.write("not json")
+        out = store.gc(ttl_s=None, max_bytes=None)
+        assert out["evicted"] == ["k1"]
+        assert store.keys() == []
+
+
+# --------------------------------------------------------------------- #
+# state cells (filesystem CAS)                                          #
+# --------------------------------------------------------------------- #
+
+class TestStateCell:
+    def test_read_never_written(self, tmp_path):
+        assert StateCell(str(tmp_path), "c").read() == (0, None)
+
+    def test_versioned_write_read(self, tmp_path):
+        cell = StateCell(str(tmp_path), "c")
+        assert cell.try_write(0, {"n": 1}) is True
+        assert cell.read() == (1, {"n": 1})
+        # stale-version write loses the CAS
+        assert cell.try_write(0, {"n": 99}) is False
+        assert cell.try_write(1, {"n": 2}) is True
+        assert cell.read() == (2, {"n": 2})
+
+    def test_update_loop_and_prune(self, tmp_path):
+        cell = StateCell(str(tmp_path), "c")
+        for _ in range(10):
+            cell.update(lambda v: {"n": (v or {}).get("n", 0) + 1})
+        version, value = cell.read()
+        assert version == 10 and value == {"n": 10}
+        kept = [n for n in os.listdir(cell.dir) if n.startswith("c.v")]
+        assert len(kept) <= 4  # keep-window pruned
+
+    def test_concurrent_updates_lose_nothing(self, tmp_path):
+        cell = StateCell(str(tmp_path), "c")
+        n_threads, n_each = 4, 25
+
+        def worker():
+            for _ in range(n_each):
+                cell.update(lambda v: {"n": (v or {}).get("n", 0) + 1},
+                            retries=500)
+
+        threads = [threading.Thread(target=worker, name=f"cas-{i}")
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert cell.read()[1] == {"n": n_threads * n_each}
+
+    def test_illegal_name_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            StateCell(str(tmp_path), "../x")
+
+
+# --------------------------------------------------------------------- #
+# shared quota                                                          #
+# --------------------------------------------------------------------- #
+
+class TestSharedQuota:
+    def test_k_replica_sum_bounded_by_burst(self, tmp_path):
+        """Two replicas on one cell can jointly admit at most the burst
+        budget when no time passes (refill is wall-clock driven)."""
+        root = str(tmp_path)
+        q1 = SharedQuota(root, replica="r1", registry=MetricsRegistry())
+        q2 = SharedQuota(root, replica="r2", registry=MetricsRegistry())
+        rate, burst = 0.000001, 100.0
+        admitted = 0
+        for q in (q1, q2) * 30:
+            if q.try_spend("acme", 10, rate, burst):
+                admitted += 10
+        assert admitted == 100
+
+    def test_denied_then_refill_eta_positive(self, tmp_path):
+        q = SharedQuota(str(tmp_path), registry=MetricsRegistry())
+        rate, burst = 0.000001, 10.0
+        assert q.try_spend("t", 10, rate, burst) is True
+        assert q.try_spend("t", 10, rate, burst) is False
+        assert q.refill_eta_s("t", 10, rate) > 0
+
+    def test_infinite_rate_always_admits(self, tmp_path):
+        q = SharedQuota(str(tmp_path), registry=MetricsRegistry())
+        assert q.try_spend("t", 10**9, float("inf"), 1.0) is True
+
+    def test_lease_makes_hot_path_local(self, tmp_path):
+        reg = MetricsRegistry()
+        q = SharedQuota(str(tmp_path), replica="r1", lease_frac=0.5,
+                        registry=reg)
+        rate, burst = 0.000001, 100.0
+        for _ in range(5):  # 5 spends of 10 inside one 50-token lease
+            assert q.try_spend("t", 10, rate, burst)
+        syncs = reg.find("router_quota_syncs_total", replica="r1")
+        assert syncs.value == 1.0  # one withdraw served all five
+
+    def test_snapshot_shape(self, tmp_path):
+        q = SharedQuota(str(tmp_path), replica="rX",
+                        registry=MetricsRegistry())
+        q.try_spend("t", 1, 100.0, 100.0)
+        snap = q.snapshot()
+        assert snap["replica"] == "rX"
+        assert "t" in snap["tenants"]
+        assert snap["tenants"]["t"]["shared"]["rate"] == 100.0

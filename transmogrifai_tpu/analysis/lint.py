@@ -185,6 +185,21 @@ silent slowness or nondeterminism once XLA is in the loop:
   also sees lock-holding CALLERS of the I/O) honors, so one annotation
   satisfies both tools. Smoke/chaos drivers and tests are allowlisted.
 
+- ``L020 store-bypass-write``: a direct write (``open(..., "w")`` /
+  ``np.save``/``np.savez`` / ``Path.write_text``-family) whose path
+  expression is built from an artifact-store location (a call to
+  ``path_of``/``default_cache_dir``/``cache_root``/``resolve_dir``/
+  ``resolved_dir``/``resolved_corpus_dir``, or a ``cache_dir``/
+  ``store_dir``/``artifact_dir`` variable). Artifacts in those
+  namespaces carry sha256 manifests written LAST by
+  ``store.ArtifactStore.put``/``seal_and_commit`` — a bypass write
+  either lands an unverifiable file (readers reject the artifact) or
+  mutates a sealed one (checksum mismatch on next load). Stage files
+  and commit through the store; deliberate sidecars (access clocks,
+  append-only shard logs, the store internals themselves) annotate the
+  site ``# store-ok: <why>``. ``store/artifact.py``, smoke drivers and
+  tests are allowlisted.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1551,6 +1566,98 @@ def _check_blocking_under_lock(tree: ast.AST, path: str,
     return findings
 
 
+# -- L020: direct writes into artifact-store namespaces ---------------------- #
+
+# calls that RESOLVE a store/cache location: any path expression built
+# on top of one of these is inside a manifest-verified namespace
+_L020_DIR_FUNCS = {"path_of", "default_cache_dir", "cache_root",
+                   "resolve_dir", "resolved_dir", "resolved_corpus_dir"}
+# variable spellings that name a store/cache directory
+_L020_DIR_NAME_RE = re.compile(
+    r"^(cache|store|artifact)_?dir$|^(feature_cache|artifact_store)_dir$")
+_L020_WRITE_MODES = re.compile(r"[wax+]")
+_L020_NP_WRITERS = {"save", "savez", "savez_compressed", "savetxt"}
+_L020_PATH_LEAVES = {"write_text", "write_bytes"}
+_L020_STORE_OK_RE = re.compile(r"#\s*store-ok\b")
+
+
+def _l020_storeish(expr: ast.AST) -> Optional[str]:
+    """The dotted name of the store-location source inside a path
+    expression, else None. Walks the whole expression so
+    ``os.path.join(cache.path_of(k), "x")`` and f-strings match."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name and name.split(".")[-1] in _L020_DIR_FUNCS:
+                return name
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            name = _dotted(node)
+            if name and _L020_DIR_NAME_RE.match(name.split(".")[-1]):
+                return name
+    return None
+
+
+def _l020_suppressed(lines: Sequence[str], lineno: int) -> bool:
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and _L020_STORE_OK_RE.search(lines[ln - 1]):
+            return True
+    return False
+
+
+def _check_store_bypass_writes(tree: ast.AST, path: str,
+                               lines: Sequence[str]) -> List[LintFinding]:
+    """Flag writes whose destination path derives from an artifact-store
+    location without going through ``ArtifactStore.put``."""
+    parts = os.path.normpath(path).split(os.sep)
+    base = parts[-1]
+    if base.endswith("_smoke.py") or base in ("smoke.py", "chaos.py",
+                                              "artifact.py") \
+            or "tests" in parts or "testkit" in parts \
+            or ("store" in parts and base == "state.py"):
+        return []
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if fn is None or not node.args:
+            continue
+        leaf = fn.split(".")[-1]
+        target: Optional[ast.AST] = None
+        if fn in ("open", "io.open"):
+            mode = ""
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                mode = str(node.args[1].value)
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if _L020_WRITE_MODES.search(mode):
+                target = node.args[0]
+        elif "." in fn and leaf in _L020_NP_WRITERS:
+            target = node.args[0]
+        elif "." in fn and leaf in _L020_PATH_LEAVES:
+            target = node.func.value  # receiver path expression
+        if target is None:
+            continue
+        src_name = _l020_storeish(target)
+        if src_name is None:
+            continue
+        lineno = getattr(node, "lineno", 0)
+        findings.append(LintFinding(
+            path, lineno, "L020",
+            f"direct write via `{fn}` into an artifact-store namespace "
+            f"(path built from `{src_name}`) — files in manifest-"
+            f"verified directories must land through "
+            f"`store.ArtifactStore.put`/`seal_and_commit` (the manifest "
+            f"goes in LAST, so readers never see this file as part of a "
+            f"verified artifact, or reject the artifact it mutated); "
+            f"stage + commit through the store, or annotate a "
+            f"deliberate sidecar with `# store-ok: <why>`",
+            suppression=("annotation"
+                         if _l020_suppressed(lines, lineno) else None)))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1575,6 +1682,8 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_event_name_cardinality(tree, path))
     linter.findings.extend(_check_per_row_serving_loops(tree, path))
     linter.findings.extend(_check_blocking_under_lock(
+        tree, path, src.splitlines()))
+    linter.findings.extend(_check_store_bypass_writes(
         tree, path, src.splitlines()))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 

@@ -78,9 +78,9 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from transmogrifai_tpu.obs.metrics import get_registry
-from transmogrifai_tpu.runtime.integrity import (
-    commit_staged_dir as _commit_staged_dir, fsync_dir as _fsync_dir,
-    fsync_file as _fsync_file, sha256_file as _sha256_file)
+from transmogrifai_tpu.store.artifact import (
+    ArtifactStore, LocalDirBackend, StoreCorruptError)
+from transmogrifai_tpu.store.config import resolve_dir as _resolve_dir
 
 __all__ = [
     "FeatureCacheParams", "FeatureCacheError", "FeatureCache",
@@ -105,24 +105,31 @@ ENV_DIR = "TRANSMOGRIFAI_FEATURE_CACHE_DIR"
 ENV_WIRE = "TRANSMOGRIFAI_FEATURE_CACHE_WIRE"
 
 
-class FeatureCacheError(RuntimeError):
+class FeatureCacheError(StoreCorruptError):
     """A cache artifact failed verification (missing/unreadable manifest,
     truncated or bit-flipped file, meta mismatch). Structured: carries
     the artifact path, the cache key, and what disagreed. Builders treat
-    it as a miss and rebuild — it must never surface as stale data."""
+    it as a miss and rebuild — it must never surface as stale data.
+
+    Subclasses the store's `StoreCorruptError` so fleet-level code that
+    handles artifact corruption generically catches cache rejects too.
+    """
 
     def __init__(self, path: str, reason: str, key: Optional[str] = None):
         self.path = path
         self.reason = reason
         self.key = key
-        super().__init__(
+        RuntimeError.__init__(
+            self,
             f"feature-cache artifact {path!r}"
             f"{f' (key {key})' if key else ''} rejected: {reason}")
 
 
 def default_cache_dir() -> str:
-    return os.environ.get(ENV_DIR) or os.path.expanduser(
-        "~/.cache/transmogrifai_tpu/feature_cache")
+    # one resolution point with the artifact store: explicit env wins,
+    # else a subdir of the shared store root when one is configured,
+    # else the per-user cache root
+    return _resolve_dir("feature_cache", env=ENV_DIR)
 
 
 @dataclass
@@ -493,19 +500,28 @@ class CacheArtifact:
 class ArtifactWriter:
     """Staged artifact write: wire chunks append (in upload order — the
     pipeline's main thread calls in item order) into a temp sibling
-    directory; `finalize` fsyncs everything, writes the integrity
-    manifest LAST, and renames into place — the same crash-consistency
-    contract as `workflow/serialization.save_model`, so a kill at any
-    instruction leaves either no artifact or a fully verified one."""
+    directory; `finalize` hands the staged dir to the artifact store,
+    which fsyncs everything, writes the integrity manifest LAST, and
+    renames into place — the same crash-consistency contract as
+    `workflow/serialization.save_model`, so a kill at any instruction
+    leaves either no artifact or a fully verified one."""
 
-    def __init__(self, final_path: str, key: str, meta: Dict[str, Any]):
+    def __init__(self, final_path: str, key: str, meta: Dict[str, Any],
+                 store: Optional[ArtifactStore] = None):
         self.final_path = final_path
         self.key = key
         self.meta = dict(meta)
+        if store is None:
+            store = ArtifactStore(
+                LocalDirBackend(os.path.dirname(final_path) or "."))
+        self.store = store
         # pid alone is not unique within a process: two threads staging
         # the same key must not rmtree each other's in-progress dir (the
-        # second finalize simply displaces the first's artifact)
-        self.tmp = f"{final_path}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        # second finalize simply displaces the first's artifact). The
+        # dot prefix keeps the stage invisible to store.keys()/gc().
+        self.tmp = os.path.join(
+            os.path.dirname(final_path) or ".",
+            f".stage-{key}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
         if os.path.exists(self.tmp):
             shutil.rmtree(self.tmp)
         os.makedirs(self.tmp)
@@ -528,40 +544,28 @@ class ArtifactWriter:
             os.fsync(self._fh.fileno())
             self._fh.close()
             self._closed = True
-            names = [WIRE]
             if quant is not None:
                 qpath = os.path.join(self.tmp, QUANT)
                 np.savez(qpath, scale=quant.scale, lo=quant.lo,
                          bits=np.int64(quant.bits))
-                _fsync_file(qpath)
-                names.append(QUANT)
-            manifest = dict(self.meta)
-            manifest.update({
-                "cache_version": FORMAT_VERSION,
-                "key": self.key,
-                "cold": dict(cold or {}),
-                "files": {name: {
-                    "sha256": _sha256_file(os.path.join(self.tmp, name)),
-                    "bytes": os.path.getsize(os.path.join(self.tmp, name)),
-                } for name in names},
-            })
-            apath = os.path.join(self.tmp, ARTIFACT)
-            with open(apath, "w") as fh:
-                json.dump(manifest, fh)
-                fh.flush()
-                os.fsync(fh.fileno())
-            _fsync_dir(self.tmp)
         except BaseException:
             self.abort()
             raise
-        # swap into place via the shared staged-dir protocol (same
-        # crash-consistency contract as save_model): a displaced older
-        # artifact is renamed aside, never deleted before the
-        # replacement is live. A FAILED commit (e.g. losing the rename
-        # race to a concurrent writer of the same key) must not orphan
-        # the fully staged multi-GB tape on disk.
+        # seal + swap through the artifact store (the only legal
+        # manifest writer, lint L020): it hashes and fsyncs the staged
+        # files, writes the sha256 manifest LAST, and commits via the
+        # staged-dir rename protocol. A displaced older artifact is
+        # renamed aside, never deleted before the replacement is live; a
+        # FAILED commit (e.g. losing the rename race to a concurrent
+        # writer of the same key) must not orphan the fully staged
+        # multi-GB tape on disk.
+        manifest = dict(self.meta)
+        manifest.update({
+            "cache_version": FORMAT_VERSION,
+            "cold": dict(cold or {}),
+        })
         try:
-            _commit_staged_dir(self.tmp, self.final_path)
+            self.store.seal_and_commit(self.key, self.tmp, manifest)
         except BaseException:
             shutil.rmtree(self.tmp, ignore_errors=True)
             raise
@@ -569,11 +573,14 @@ class ArtifactWriter:
 
 
 class FeatureCache:
-    """Directory of content-addressed artifacts (one subdir per key)."""
+    """Directory of content-addressed artifacts (one subdir per key),
+    served through an `ArtifactStore` so every replica sharing the dir
+    sees the same verified tapes (and the store's metrics/GC apply)."""
 
     def __init__(self, params: FeatureCacheParams):
         self.params = params
         self.dir = params.resolved_dir()
+        self.store = ArtifactStore(LocalDirBackend(self.dir))
 
     def path_of(self, key: str) -> str:
         return os.path.join(self.dir, key)
@@ -581,6 +588,15 @@ class FeatureCache:
     def probe(self, key: str) -> bool:
         """A *finalized* artifact exists (manifest present)."""
         return os.path.exists(os.path.join(self.path_of(key), ARTIFACT))
+
+    def prefetch(self, key: str) -> None:
+        """Stream the wire tape through the page cache (and sha256) on
+        a background thread ahead of the first `load`."""
+        self.store.prefetch(key)
+
+    def gc(self, ttl_s: Optional[float] = None,
+           max_bytes: Optional[int] = None) -> Dict[str, Any]:
+        return self.store.gc(ttl_s=ttl_s, max_bytes=max_bytes)
 
     def load(self, key: str) -> Optional[CacheArtifact]:
         """Open + verify the artifact for `key`. Returns None on a clean
@@ -590,42 +606,30 @@ class FeatureCache:
         path = self.path_of(key)
         if not os.path.isdir(path):
             return None
-        apath = os.path.join(path, ARTIFACT)
-        if not os.path.exists(apath):
+        # file-level verification (manifest structure, sizes, sha256)
+        # is the store's job; meta-level checks stay cache-specific
+        try:
+            got = self.store.get(key, verify=self.params.verify is True)
+        except FeatureCacheError:
+            raise
+        except StoreCorruptError as e:
+            raise FeatureCacheError(path, e.reason, key)
+        if got is None:
             raise FeatureCacheError(
                 path, f"missing {ARTIFACT} — the write died before the "
                       "integrity manifest landed (torn artifact)", key)
         try:
-            with open(apath) as fh:
-                meta = json.load(fh)
-        except ValueError as e:
-            raise FeatureCacheError(path, f"unreadable {ARTIFACT}: {e}", key)
+            meta = self.store.manifest(key)
+        except StoreCorruptError as e:
+            raise FeatureCacheError(path, e.reason, key)
         if meta.get("cache_version") != FORMAT_VERSION:
             raise FeatureCacheError(
                 path, f"format version {meta.get('cache_version')!r} != "
                       f"{FORMAT_VERSION}", key)
-        if meta.get("key") != key:
-            raise FeatureCacheError(
-                path, f"manifest key {meta.get('key')!r} does not match "
-                      f"the directory address", key)
         files = meta.get("files")
         if not isinstance(files, dict) or WIRE not in files:
             raise FeatureCacheError(path, "malformed integrity manifest",
                                     key)
-        verify = self.params.verify
-        for name, rec in files.items():
-            fpath = os.path.join(path, name)
-            if not os.path.exists(fpath):
-                raise FeatureCacheError(path, f"{name} is missing", key)
-            size = os.path.getsize(fpath)
-            if size != rec.get("bytes"):
-                raise FeatureCacheError(
-                    path, f"{name} truncated or resized: {size} bytes on "
-                          f"disk, {rec.get('bytes')} recorded", key)
-            if verify is True and _sha256_file(fpath) != rec.get("sha256"):
-                raise FeatureCacheError(
-                    path, f"{name} checksum mismatch (torn write or bit "
-                          "corruption)", key)
         try:
             n_pad = int(meta["n_pad"])
             wire_cols = int(meta["wire_cols"])
@@ -659,7 +663,8 @@ class FeatureCache:
 
     def writer(self, key: str, meta: Dict[str, Any]) -> ArtifactWriter:
         os.makedirs(self.dir, exist_ok=True)
-        return ArtifactWriter(self.path_of(key), key, meta)
+        return ArtifactWriter(self.path_of(key), key, meta,
+                              store=self.store)
 
 
 # -- resident registry ------------------------------------------------------- #

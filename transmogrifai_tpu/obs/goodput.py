@@ -90,6 +90,12 @@ class GoodputReport:
     # rolling-swaps number of serving/fleet.py) and ``tenant_shed``
     # admission events. Empty when no fleet ran in this trace.
     fleet: Dict[str, Any] = field(default_factory=dict)
+    # fleet-frontend routing accounting: rolled up from
+    # ``router_route`` events (serving/frontend.py emits one per routed
+    # request) — requests per replica, warm vs cold routing decisions,
+    # wire split (json vs binary), and error outcomes. Empty when no
+    # frontend routed in this trace.
+    router: Dict[str, Any] = field(default_factory=dict)
     # compiled-scoring accounting: rolled up from ``device_dispatch``
     # events (CompiledScorer._dispatch emits one per XLA program launch
     # with the bytes shipped in and returned) — dispatch counts prove
@@ -144,6 +150,8 @@ class GoodputReport:
             out["perf"] = dict(sorted(self.perf.items()))
         if self.fleet:
             out["fleet"] = dict(sorted(self.fleet.items()))
+        if self.router:
+            out["router"] = dict(sorted(self.router.items()))
         if self.scoring:
             out["scoring"] = dict(sorted(self.scoring.items()))
         if self.resilience:
@@ -182,6 +190,7 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     compile_saved = 0.0
     compile_hits = 0
     fleet: Dict[str, Any] = {}
+    router: Dict[str, Any] = {}
     resilience: Dict[str, Any] = {}
     scoring: Dict[str, Any] = {}
     slo: Dict[str, Any] = {}
@@ -257,6 +266,24 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
                             (d or {}).get("shed", 0) or 0)
             elif name == "tenant_shed":
                 fleet["sheds"] = fleet.get("sheds", 0) + 1
+            elif name == "router_route":
+                router["requests"] = router.get("requests", 0) + 1
+                router["rows"] = router.get("rows", 0) + int(
+                    attrs.get("rows", 0) or 0)
+                if attrs.get("warm"):
+                    router["warm_routes"] = router.get("warm_routes", 0) + 1
+                else:
+                    router["cold_routes"] = router.get("cold_routes", 0) + 1
+                by_rep = router.setdefault("by_replica", {})
+                rep = str(attrs.get("replica") or "unknown")
+                by_rep[rep] = by_rep.get(rep, 0) + 1
+                by_wire = router.setdefault("by_wire", {})
+                wire = str(attrs.get("wire") or "json")
+                by_wire[wire] = by_wire.get(wire, 0) + 1
+                outcome = str(attrs.get("outcome") or "ok")
+                if outcome != "ok":
+                    errs = router.setdefault("errors", {})
+                    errs[outcome] = errs.get(outcome, 0) + 1
             elif name == "device_dispatch":
                 scoring["dispatches"] = scoring.get("dispatches", 0) + 1
                 scoring["bytes_in"] = scoring.get("bytes_in", 0) + int(
@@ -372,6 +399,8 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
         counts["compile_cache_hits"] = compile_hits
     if fleet:
         report.fleet = fleet
+    if router:
+        report.router = router
     if scoring:
         report.scoring = scoring
     if resilience:

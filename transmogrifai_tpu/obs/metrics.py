@@ -225,6 +225,34 @@ class Histogram:
         out.append((float("inf"), cum + counts[-1]))
         return out
 
+    def merge_from(self, other: "Histogram") -> None:
+        """Fold `other`'s observations into this histogram (the
+        cross-replica /metrics merge). Bucket ladders must agree —
+        merging a µs ladder into a default ladder would silently
+        misplace every count."""
+        if self.bounds != other.bounds:
+            raise ValueError(
+                f"histogram bounds differ: {len(self.bounds)} vs "
+                f"{len(other.bounds)} buckets")
+        with other._lock:
+            counts = list(other._counts)
+            total, count = other._sum, other._count
+            mn, mx = other._min, other._max
+            exemplars = dict(other._exemplars)
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += total
+            self._count += count
+            if mn is not None:
+                self._min = mn if self._min is None else min(self._min, mn)
+            if mx is not None:
+                self._max = mx if self._max is None else max(self._max, mx)
+            for i, ex in exemplars.items():
+                # first writer wins: an exemplar is one concrete trace,
+                # any replica's is as good as another's
+                self._exemplars.setdefault(i, ex)
+
     def exemplars(self) -> List[Tuple[float, str, float, float]]:
         """(bucket_upper_bound, exemplar_id, value, epoch_ts) for every
         bucket holding one; the +inf bucket reports float('inf')."""
@@ -319,6 +347,57 @@ class MetricsRegistry:
             if all(labels.get(k) == v for k, v in want.items()):
                 total += metric.value if hasattr(metric, "value") else 0.0
         return total
+
+    # -- cross-replica aggregation ----------------------------------------- #
+
+    def merge(self, other: "MetricsRegistry",
+              **extra_labels: Any) -> "MetricsRegistry":
+        """Fold `other`'s families into this registry — how the fleet
+        router's /metrics exposes a FLEET-WIDE view over K per-replica
+        registries. Semantics per metric type:
+
+        - counters SUM into the same-labeled series (fleet totals);
+        - gauges keep per-replica identity: `extra_labels` (e.g.
+          ``replica="r1"``) are added so two replicas' queue depths
+          never average into a number nobody measured;
+        - histograms merge bucket counts/sums when ladders agree; a
+          ladder mismatch falls back to a separate `extra_labels`
+          series instead of corrupting the buckets.
+
+        Returns self, so K registries chain:
+        ``m.merge(a.registry, replica="a").merge(b.registry, ...)``.
+        A family whose TYPE conflicts with an existing name is skipped
+        (scrapes must never 500 over one bad series).
+        """
+        with other._lock:
+            families = {n: (f["type"], f["help"], dict(f["series"]))
+                        for n, f in other._families.items()}
+        for name, (mtype, help_, series) in families.items():
+            for key, metric in series.items():
+                labels = dict(key)
+                try:
+                    if mtype == "counter":
+                        self.counter(name, help_, **labels).inc(
+                            metric.value)
+                    elif mtype == "gauge":
+                        self.gauge(name, help_,
+                                   **{**labels, **extra_labels}).set(
+                            metric.value)
+                    else:
+                        target = self.histogram(
+                            name, help_, bounds=metric.bounds, **labels)
+                        try:
+                            target.merge_from(metric)
+                        except ValueError:
+                            self.histogram(
+                                name, help_, bounds=metric.bounds,
+                                **{**labels, **extra_labels},
+                            ).merge_from(metric)
+                except ValueError:
+                    # type conflict across registries: keep the scrape
+                    # alive, drop the conflicting series
+                    continue
+        return self
 
     # -- export ----------------------------------------------------------- #
 

@@ -933,8 +933,8 @@ class _DispatchSpan:
 
 def _calib_path() -> str:
     import os
-    return os.path.join(os.path.expanduser("~/.cache/transmogrifai_tpu"),
-                        "sweep_calib.json")
+    from transmogrifai_tpu.store.config import cache_root
+    return os.path.join(cache_root(), "sweep_calib.json")
 
 
 def _load_calib() -> None:

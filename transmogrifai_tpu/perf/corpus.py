@@ -47,19 +47,44 @@ log = logging.getLogger(__name__)
 
 CORPUS_FILE = "corpus.jsonl"
 
+# a replica name (FleetConfig.replica, exported by the operator) moves
+# this process's appends into its own shard — corpus-<replica>.jsonl —
+# so K replicas on one shared corpus dir never interleave writes into
+# one file; readers merge every shard
+ENV_REPLICA = "TRANSMOGRIFAI_PERF_REPLICA"
+
 # targets the model learns; anything else is ignored at fit time
 TARGETS = ("block_runtime", "hbm", "ingest", "serving_bucket",
            "serving_parse")
 
 
 class CostCorpus:
-    """Append-only JSONL training corpus, one file per directory."""
+    """Append-only JSONL training corpus: this process writes ONE shard
+    (`corpus.jsonl`, or `corpus-<replica>.jsonl` when a replica name is
+    set), readers merge every shard in the directory."""
 
-    def __init__(self, dir_path: str):
+    def __init__(self, dir_path: str, replica: Optional[str] = None):
         self.dir = dir_path
-        self.path = os.path.join(dir_path, CORPUS_FILE)
+        if replica is None:
+            replica = os.environ.get(ENV_REPLICA) or None
+        self.replica = replica
+        name = f"corpus-{replica}.jsonl" if replica else CORPUS_FILE
+        self.path = os.path.join(dir_path, name)
         self._lock = threading.Lock()
         self._appended = 0  # rows this process added (fit invalidation)
+
+    def _shard_paths(self) -> List[str]:
+        """Every corpus shard in the directory, own shard included —
+        the unsharded `corpus.jsonl` plus each `corpus-<replica>.jsonl`."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return [self.path]
+        shards = sorted(
+            os.path.join(self.dir, n) for n in names
+            if n == CORPUS_FILE
+            or (n.startswith("corpus-") and n.endswith(".jsonl")))
+        return shards or [self.path]
 
     def append(self, target: str, features: Dict[str, float], value: float,
                predicted: Optional[float] = None, **extra: Any) -> bool:
@@ -108,34 +133,42 @@ class CostCorpus:
         `max_rows` keeps a years-old corpus from ballooning fit time —
         the NEWEST rows are kept (they reflect the current hardware)."""
         out: List[Dict[str, Any]] = []
-        try:
-            with open(self.path, encoding="utf-8", errors="replace") as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue  # torn tail / garbage line
-                    if not isinstance(rec, dict):
-                        continue
-                    if target is not None and rec.get("target") != target:
-                        continue
-                    if isinstance(rec.get("features"), dict) and \
-                            isinstance(rec.get("value"), (int, float)):
-                        out.append(rec)
-        except OSError:
-            return []
+        for path in self._shard_paths():
+            try:
+                with open(path, encoding="utf-8", errors="replace") as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail / garbage line
+                        if not isinstance(rec, dict):
+                            continue
+                        if target is not None and \
+                                rec.get("target") != target:
+                            continue
+                        if isinstance(rec.get("features"), dict) and \
+                                isinstance(rec.get("value"), (int, float)):
+                            out.append(rec)
+            except OSError:
+                continue
+        # shards interleave in wall time: order the merged view by
+        # timestamp (stable, so same-second rows keep shard order)
+        # before trimming to the NEWEST max_rows
+        out.sort(key=lambda r: r.get("ts", 0))
         return out[-max_rows:]
 
     def version(self) -> tuple:
-        """Cheap change token for fit caching: (size, rows appended by
-        this process)."""
-        try:
-            size = os.path.getsize(self.path)
-        except OSError:
-            size = 0
+        """Cheap change token for fit caching: (total shard bytes, rows
+        appended by this process)."""
+        size = 0
+        for path in self._shard_paths():
+            try:
+                size += os.path.getsize(path)
+            except OSError:
+                pass
         return (self.path, size, self._appended)
 
     def __len__(self) -> int:
@@ -152,11 +185,12 @@ def get_corpus() -> Optional[CostCorpus]:
     if not perf_params.enabled():
         return None
     d = perf_params.resolved_corpus_dir()
+    key = f"{d}\x00{os.environ.get(ENV_REPLICA, '')}"
     with _CORPUS_LOCK:
-        c = _CORPUS.get(d)
+        c = _CORPUS.get(key)
         if c is None:
             c = CostCorpus(d)
-            _CORPUS[d] = c
+            _CORPUS[key] = c
         return c
 
 

@@ -118,14 +118,12 @@ def enabled() -> bool:
 
 
 def resolved_corpus_dir() -> str:
-    p = _PARAMS.corpus_dir
-    if p:
-        return p
-    env = os.environ.get("TRANSMOGRIFAI_PERF_CORPUS_DIR")
-    if env:
-        return env
-    return os.path.join(os.path.expanduser("~/.cache/transmogrifai_tpu"),
-                        "perf")
+    # one resolution point with the artifact store: params arg wins,
+    # then the subsystem env, then <store root>/perf — so pointing
+    # TRANSMOGRIFAI_STORE_DIR at shared storage moves the corpus too
+    from transmogrifai_tpu.store.config import resolve_dir
+    return resolve_dir("perf", env="TRANSMOGRIFAI_PERF_CORPUS_DIR",
+                       explicit=_PARAMS.corpus_dir)
 
 
 def target_block_s() -> float:

@@ -383,10 +383,18 @@ class FleetConfig:
     # the labeled fleet_* series (member-level SLOs go in a member's
     # own serving config instead)
     slo: Optional[Dict[str, Any]] = None
+    # shared state plane (store/): the artifact-store root this replica
+    # shares with its peers, this replica's name, and whether tenant
+    # quotas meter against the CAS-guarded fleet-wide balance instead of
+    # a private per-replica bucket (the K-replica tenant invariant)
+    store_dir: Optional[str] = None
+    replica: str = "r0"
+    shared_quota: bool = False
 
     _FIELDS = ("models", "tenants", "default_tenant", "shed_watermark",
                "serving", "compile_cache", "compile_cache_dir",
-               "resilience", "slo")
+               "resilience", "slo", "store_dir", "replica",
+               "shared_quota")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "FleetConfig":
@@ -430,13 +438,20 @@ class FleetService:
         self.config = config or FleetConfig()
         self.registry = registry or MetricsRegistry()
         self.pool = ProgramPool()
+        self.shared_quota = None
+        if self.config.shared_quota and self.config.store_dir:
+            from transmogrifai_tpu.store import SharedQuota
+            self.shared_quota = SharedQuota(
+                self.config.store_dir, replica=self.config.replica,
+                registry=self.registry)
         self.router = Router(
             tenants={name: TenantPolicy.from_json(p)
                      for name, p in (self.config.tenants or {}).items()},
             default=(TenantPolicy.from_json(self.config.default_tenant)
                      if self.config.default_tenant else None),
             shed_watermark=self.config.shed_watermark,
-            registry=self.registry)
+            registry=self.registry,
+            shared=self.shared_quota)
         self._lock = threading.Lock()
         self._services: Dict[str, FleetMemberService] = {}
         self._started = False
@@ -683,6 +698,23 @@ class FleetService:
             lambda svc, tr: svc.score_columns(
                 columns, deadline_ms=deadline_ms, trace=tr))
 
+    def score_frame(self, frame: bytes,
+                    trace: Optional[TraceContext] = None):
+        """Binary columnar wire: decode one length-prefixed frame
+        (serving/binwire.py) and route it exactly like `score_columns`.
+        Any malformation raises bad_request BEFORE admission, so a
+        client framing bug never charges a tenant's quota, the breaker,
+        or the health window."""
+        from transmogrifai_tpu.serving.binwire import decode_frame
+        columns, meta = decode_frame(frame)
+        model = meta.get("model")
+        if not isinstance(model, str) or not model:
+            raise ScoreError("bad_request",
+                             "binary frame: missing model name")
+        return self.score_columns(
+            model, columns, tenant=meta.get("tenant"),
+            deadline_ms=meta.get("deadline_ms"), trace=trace)
+
     def _score_routed(self, model: str, n_rows: int,
                       tenant: Optional[str],
                       trace: Optional[TraceContext], member_call):
@@ -794,6 +826,7 @@ class FleetService:
             status = "degraded"
         out = {
             "status": status,
+            "replica": self.config.replica,
             "models": models,
             "tenants": self.router.snapshot(),
             "shared_programs": self.pool.report(),

@@ -1,0 +1,406 @@
+"""Warmth-aware L7 router over K fleet replicas.
+
+The fleet can now share its cold-start artifacts through the store
+(`store/`); this is the tier that makes K replicas LOOK like one
+service. A `Frontend` holds N `FleetService` replicas (in-process
+tier-0; the replica surface it consumes — `health()`, `score*()` — is
+exactly what a remote replica exposes over HTTP, so a URL-backed
+replica handle can slot in later), learns each replica's WARMTH from
+its health/warmup reports — which models it hosts, which bucket-ladder
+programs are compiled, whether resident staging buffers are live — and
+routes every request to the warmest replica for its (model, bucket),
+breaking ties power-of-two-choices on queue depth so one warm replica
+doesn't melt while an equally-warm peer idles.
+
+Admission stays in each replica's `Router`; with `FleetConfig.
+shared_quota` the replicas meter against the CAS-guarded shared balance
+(store/state.py), so the over-quota tenant gets its 429 from EITHER
+replica and the K-replica sum stays inside one tenant's rate — the
+frontend never needs a per-request quota round trip of its own.
+
+Speaks both request wires: the JSON row/columnar body and the binary
+columnar framing (serving/binwire.py) — decoded ONCE here at the edge,
+then handed to the replica as columns (no JSON re-encode on the hop).
+
+`/metrics` on the frontend HTTP server is the fleet-wide view:
+`MetricsRegistry.merge()` over every replica registry (counters sum,
+gauges keep a `replica` label, histograms merge buckets).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.obs.trace import TRACER, TraceContext
+from transmogrifai_tpu.serving.batcher import ScoreError, bucket_for
+from transmogrifai_tpu.serving.http import (
+    _columnar_payload, _JSONHandler, _row_payload)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Frontend", "FrontendHTTPServer", "serve_frontend"]
+
+
+def _record_event(name: str, **attrs: Any) -> None:
+    try:
+        from transmogrifai_tpu.obs.export import record_event
+        record_event(name, **attrs)
+    except Exception:
+        log.debug("%s event emission failed", name, exc_info=True)
+
+
+class Frontend:
+    """Route requests across replicas by warmth. See module docstring."""
+
+    def __init__(self, replicas: Dict[str, Any],
+                 registry: Optional[MetricsRegistry] = None,
+                 refresh_s: float = 2.0, seed: int = 0):
+        if not replicas:
+            raise ValueError("frontend needs at least one replica")
+        self.replicas = dict(replicas)
+        self.registry = registry or MetricsRegistry()
+        self.refresh_s = float(refresh_s)
+        self._lock = threading.Lock()
+        self._warmth: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
+        self._refreshed = 0.0  # guarded-by: self._lock
+        self._rng = random.Random(seed)  # guarded-by: self._lock
+        self._m_requests = {}  # pre-bound per (replica, wire) lazily
+        self._m_latency = self.registry.histogram(
+            "router_request_latency_seconds",
+            "frontend route + replica scoring latency")
+        self._m_warm = self.registry.counter(
+            "router_warm_hits_total",
+            "requests routed to a replica with warm bucket programs")
+        self._m_cold = self.registry.counter(
+            "router_cold_routes_total",
+            "requests routed with no warm replica available")
+        self._m_frame_err = self.registry.counter(
+            "router_frame_errors_total",
+            "binary frames rejected as bad_request")
+        self.refresh()
+
+    # -- warmth ------------------------------------------------------------ #
+
+    def refresh(self) -> Dict[str, Dict[str, Any]]:
+        """Pull each replica's health report and distill the routing
+        facts: hosted models, their ladders, whether the ladder is
+        compiled (warm), staging residency, queue depth."""
+        reports: Dict[str, Dict[str, Any]] = {}
+        for name, fleet in self.replicas.items():
+            try:
+                health = fleet.health()
+            except Exception as e:
+                log.warning("frontend: replica %s health failed: %s",
+                            name, e)
+                reports[name] = {"status": "down", "models": {}}
+                continue
+            models: Dict[str, Dict[str, Any]] = {}
+            for mname, m in (health.get("models") or {}).items():
+                versions = m.get("versions") or []
+                active = versions[0] if versions else {}
+                staging = m.get("staging") or {}
+                models[mname] = {
+                    "status": m.get("status"),
+                    "buckets": list(m.get("buckets") or ()),
+                    "queue_depth": int(m.get("queue_depth") or 0),
+                    # warm = the active version finished its warmup
+                    # ladder (compile counts reported) — the fact the
+                    # warmup manifest records for replay
+                    "warm": bool(active.get("compile_counts")
+                                 or active.get("warmed")),
+                    "staging": bool(staging.get("allocations")),
+                }
+            reports[name] = {"status": health.get("status"),
+                             "models": models}
+        with self._lock:
+            self._warmth = reports
+            self._refreshed = time.monotonic()
+        return reports
+
+    def _maybe_refresh(self) -> None:
+        with self._lock:
+            stale = (time.monotonic() - self._refreshed) > self.refresh_s
+        if stale:
+            self.refresh()
+
+    @staticmethod
+    def _score_warmth(entry: Optional[Dict[str, Any]],
+                      n_rows: int) -> int:
+        """0 = can't serve, 1 = hosts the model cold, 2 = warm
+        programs, 3 = warm + resident staging for this bucket."""
+        if not entry or entry.get("status") not in ("ok", "degraded"):
+            return 0
+        score = 1
+        if entry.get("warm"):
+            score += 1
+            if entry.get("staging"):
+                buckets = entry.get("buckets") or ()
+                try:
+                    bucket_for(max(1, n_rows), tuple(buckets))
+                    score += 1
+                except (ScoreError, ValueError):
+                    # rows overflow the replica's bucket ladder: its
+                    # resident staging cannot host this request, so no
+                    # staging point — warm-programs score stands
+                    log.debug("warmth: %d rows overflow ladder %r",
+                              n_rows, buckets)
+        return score
+
+    def route(self, model: str, n_rows: int) -> Tuple[str, Any, bool]:
+        """(replica_name, fleet, warm?) for one request. Warmest wins;
+        ties break power-of-two-choices on queue depth."""
+        self._maybe_refresh()
+        with self._lock:
+            warmth = {name: dict((self._warmth.get(name) or {})
+                                 .get("models", {}).get(model) or {})
+                      for name in self.replicas}
+            scored = [(self._score_warmth(entry or None, n_rows), name)
+                      for name, entry in warmth.items()]
+            best = max(s for s, _ in scored)
+            candidates = [name for s, name in scored if s == best]
+            if best == 0:
+                # nobody reports the model (all cold or health lag):
+                # spread p2c over everyone and let the replica 404
+                candidates = list(self.replicas)
+            if len(candidates) > 2:
+                candidates = self._rng.sample(candidates, 2)
+            elif len(candidates) == 2 and self._rng.random() < 0.5:
+                candidates.reverse()
+        name = min(candidates,
+                   key=lambda n: (warmth.get(n) or {}).get(
+                       "queue_depth", 0))
+        return name, self.replicas[name], best >= 2
+
+    # -- scoring ----------------------------------------------------------- #
+
+    def _count(self, replica: str, wire: str) -> None:
+        key = (replica, wire)
+        m = self._m_requests.get(key)
+        if m is None:
+            m = self.registry.counter(
+                "router_requests_total",
+                "requests routed per replica and wire",
+                replica=replica, wire=wire)
+            # conc-ok: C001 (idempotent memo — racing writers store the
+            # same registry-deduped Counter object)
+            self._m_requests[key] = m
+        m.inc()
+
+    def _route_and_score(self, model: str, n_rows: int, wire: str,
+                         call) -> Any:
+        t0 = time.monotonic()
+        with TRACER.span("router:route", category="router", model=model,
+                         wire=wire):
+            name, fleet, warm = self.route(model, n_rows)
+        (self._m_warm if warm else self._m_cold).inc()
+        self._count(name, wire)
+        try:
+            result = call(fleet)
+        except ScoreError as e:
+            _record_event("router_route", replica=name, model=model,
+                          wire=wire, warm=warm, rows=n_rows,
+                          outcome=e.code)
+            raise
+        self._m_latency.observe(time.monotonic() - t0)
+        _record_event("router_route", replica=name, model=model,
+                      wire=wire, warm=warm, rows=n_rows, outcome="ok")
+        return result
+
+    def score(self, model: str, rows: List[Dict[str, Any]],
+              tenant: Optional[str] = None,
+              deadline_ms: Optional[float] = None,
+              trace: Optional[TraceContext] = None):
+        return self._route_and_score(
+            model, len(rows or ()), "json",
+            lambda fleet: fleet.score(model, rows, tenant=tenant,
+                                      deadline_ms=deadline_ms,
+                                      trace=trace))
+
+    def score_columns(self, model: str, columns: Dict[str, Any],
+                      tenant: Optional[str] = None,
+                      deadline_ms: Optional[float] = None,
+                      trace: Optional[TraceContext] = None,
+                      wire: str = "json"):
+        n_rows = 0
+        for v in (columns or {}).values():
+            n_rows = len(v) if hasattr(v, "__len__") else 0
+            break
+        return self._route_and_score(
+            model, n_rows, wire,
+            lambda fleet: fleet.score_columns(model, columns,
+                                              tenant=tenant,
+                                              deadline_ms=deadline_ms,
+                                              trace=trace))
+
+    def score_frame(self, frame: bytes,
+                    trace: Optional[TraceContext] = None):
+        """Binary wire entry: decode once at the edge, route on the
+        header, hand the replica decoded columns. Malformed frames are
+        bad_request and never reach (or get charged to) a replica."""
+        from transmogrifai_tpu.serving.binwire import decode_frame
+        try:
+            columns, meta = decode_frame(frame)
+        except ScoreError:
+            self._m_frame_err.inc()
+            raise
+        model = meta.get("model")
+        if not isinstance(model, str) or not model:
+            self._m_frame_err.inc()
+            raise ScoreError("bad_request",
+                             "binary frame: missing model name")
+        return self.score_columns(
+            model, columns, tenant=meta.get("tenant"),
+            deadline_ms=meta.get("deadline_ms"), trace=trace,
+            wire="binary")
+
+    # -- introspection ------------------------------------------------------ #
+
+    def health(self) -> Dict[str, Any]:
+        reports = self.refresh()
+        statuses = [r.get("status") for r in reports.values()]
+        if any(s == "ok" for s in statuses):
+            status = ("ok" if all(s == "ok" for s in statuses)
+                      else "degraded")
+        else:
+            status = "down"
+        return {"status": status, "replicas": reports}
+
+    def warmth(self) -> Dict[str, Any]:
+        self._maybe_refresh()
+        with self._lock:
+            return {name: dict(report)
+                    for name, report in self._warmth.items()}
+
+    def merged_registry(self) -> MetricsRegistry:
+        """Fleet-wide metrics: the frontend's own router_* series plus
+        every replica registry merged (counters sum, gauges labeled
+        per replica, histogram buckets folded)."""
+        merged = MetricsRegistry()
+        merged.merge(self.registry, replica="frontend")
+        for name, fleet in self.replicas.items():
+            merged.merge(fleet.registry, replica=name)
+        return merged
+
+
+class FrontendHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the Frontend reference."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address: Tuple[str, int], frontend: Frontend):
+        super().__init__(address, _FrontendHandler)
+        self.frontend = frontend
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+class _FrontendHandler(_JSONHandler):
+    """Router routes:
+
+    - ``POST /score``  JSON row/columnar body (same shape as the fleet
+      endpoint) or a binary columnar frame under the
+      ``application/x-transmogrifai-columnar`` content type;
+    - ``GET /healthz`` aggregated replica health (200 while ANY replica
+      serves);
+    - ``GET /warmth``  the routing table the frontend decides with;
+    - ``GET /metrics`` fleet-wide merged exposition (?format=json).
+    """
+
+    @property
+    def frontend(self) -> Frontend:
+        return self.server.frontend  # type: ignore[attr-defined]
+
+    def do_GET(self) -> None:  # noqa: N802
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            self._send_health(self.frontend.health())
+        elif path == "/warmth":
+            self._send_json(200, {"replicas": self.frontend.warmth()})
+        elif path == "/metrics":
+            merged = self.frontend.merged_registry()
+            if "format=json" in query:
+                self._send_json(200, merged.to_json())
+            else:
+                self._send(200, merged.to_prometheus().encode(),
+                           content_type="text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": "not_found",
+                                  "message": f"no route {path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = self.path.partition("?")[0]
+        try:
+            if path != "/score":
+                self._send_json(404, {"error": "not_found",
+                                      "message": f"no route {path}"})
+                return
+            ctype = (self.headers.get("Content-Type") or "")
+            ctype = ctype.partition(";")[0].strip().lower()
+            from transmogrifai_tpu.serving.binwire import CONTENT_TYPE
+            if ctype == CONTENT_TYPE:
+                result = self.frontend.score_frame(
+                    self._read_bytes(), trace=self._trace_ctx())
+                model = None
+            else:
+                body = self._read_json()
+                model = body.get("model")
+                if not model:
+                    raise ScoreError(
+                        "bad_request",
+                        'expected {"model": "name", "rows": [...]}')
+                tenant = (body.get("tenant")
+                          or self.headers.get("X-Tenant"))
+                cols = _columnar_payload(body)
+                if cols is not None:
+                    result = self.frontend.score_columns(
+                        str(model), cols, tenant=tenant,
+                        deadline_ms=body.get("deadline_ms"),
+                        trace=self._trace_ctx())
+                else:
+                    result = self.frontend.score(
+                        str(model), _row_payload(body), tenant=tenant,
+                        deadline_ms=body.get("deadline_ms"),
+                        trace=self._trace_ctx())
+            self._send_json(200, {
+                "scores": result.rows(),
+                "model": model,
+                "model_version": result.model_version,
+                "latency_ms": round(result.latency_s * 1000.0, 3),
+                "trace_id": result.trace_id,
+            }, headers=self._trace_headers(result))
+        except ScoreError as e:
+            self._send_error(e)
+        except Exception as e:  # keep the server alive on handler bugs
+            log.exception("http: unhandled frontend error on %s", path)
+            self._send_json(500, {"error": "internal",
+                                  "message": f"{type(e).__name__}: {e}"})
+
+
+def serve_frontend(frontend: Frontend, host: str = "127.0.0.1",
+                   port: int = 0, block: bool = True
+                   ) -> Tuple[FrontendHTTPServer,
+                              Optional[threading.Thread]]:
+    """Boot the router HTTP frontend — same contract as `serve` /
+    `serve_fleet` (port=0 binds a free port; block=False runs on a
+    daemon thread)."""
+    server = FrontendHTTPServer((host, port), frontend)
+    if block:
+        try:
+            server.serve_forever(poll_interval=0.2)
+        finally:
+            server.server_close()
+        return server, None
+    thread = threading.Thread(target=server.serve_forever,
+                              kwargs={"poll_interval": 0.2},
+                              name="router-http", daemon=True)
+    thread.start()
+    return server, thread

@@ -187,6 +187,19 @@ class _JSONHandler(BaseHTTPRequestHandler):
             raise ScoreError("bad_request", "body must be a JSON object")
         return body
 
+    def _read_bytes(self, max_bytes: int = 256 << 20) -> bytes:
+        """Raw body for the binary columnar wire. Size problems are
+        bad_request like any other malformed frame — never a breaker
+        signal."""
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise ScoreError("bad_request",
+                             "binary frame requires Content-Length")
+        if length > max_bytes:
+            raise ScoreError("bad_request",
+                             f"binary frame too large ({length} bytes)")
+        return self.rfile.read(length)
+
     def _trace_ctx(self) -> Optional[TraceContext]:
         """The caller's W3C trace context, when a valid ``traceparent``
         header came in (malformed headers are ignored per spec, not
@@ -433,6 +446,12 @@ class _FleetHandler(_JSONHandler):
     def do_POST(self) -> None:  # noqa: N802
         path = self.path.partition("?")[0]
         try:
+            ctype = (self.headers.get("Content-Type") or "")
+            ctype = ctype.partition(";")[0].strip().lower()
+            from transmogrifai_tpu.serving.binwire import CONTENT_TYPE
+            if path == "/score" and ctype == CONTENT_TYPE:
+                self._score_frame(self._read_bytes())
+                return
             body = self._read_json()
             if path == "/score":
                 self._score(body)
@@ -449,6 +468,30 @@ class _FleetHandler(_JSONHandler):
             log.exception("http: unhandled fleet error on %s", path)
             self._send_json(500, {"error": "internal",
                                   "message": f"{type(e).__name__}: {e}"})
+
+    def _score_frame(self, frame: bytes) -> None:
+        """Binary columnar wire: the frame header carries model/tenant/
+        deadline, the buffers feed the columnar scoring path with no
+        JSON decode. The response stays JSON (scores are tiny; the win
+        is on the request side, where the columns live)."""
+        from transmogrifai_tpu.serving.binwire import decode_frame
+        columns, meta = decode_frame(frame)
+        model = meta.get("model")
+        if not isinstance(model, str) or not model:
+            raise ScoreError("bad_request",
+                             "binary frame: missing model name")
+        tenant = meta.get("tenant") or self.headers.get("X-Tenant")
+        result = self.fleet.score_columns(
+            model, columns, tenant=tenant,
+            deadline_ms=meta.get("deadline_ms"),
+            trace=self._trace_ctx())
+        self._send_json(200, {
+            "scores": result.rows(),
+            "model": model,
+            "model_version": result.model_version,
+            "latency_ms": round(result.latency_s * 1000.0, 3),
+            "trace_id": result.trace_id,
+        }, headers=self._trace_headers(result))
 
     def _score(self, body: Dict[str, Any]) -> None:
         model = body.get("model")

@@ -130,12 +130,18 @@ class Router:
                  default: Optional[TenantPolicy] = None,
                  shed_watermark: float = 0.5,
                  registry: Optional[MetricsRegistry] = None,
-                 max_tenants: int = 1024):
+                 max_tenants: int = 1024,
+                 shared=None):
         if not (0.0 < shed_watermark <= 1.0):
             raise ValueError(
                 f"shed_watermark must be in (0, 1]: {shed_watermark}")
         self.registry = registry or MetricsRegistry()
         self.shed_watermark = float(shed_watermark)
+        # when a store-backed SharedQuota is attached, metered tenants
+        # spend against the FLEET-WIDE balance (local lease, CAS-synced
+        # cell) instead of this replica's private bucket — the invariant
+        # that K replicas together stay within one tenant's rate
+        self.shared = shared
         # unknown tenant names come straight off the wire (X-Tenant):
         # cap how many may mint per-tenant state + labeled metric series,
         # or a client cycling random names grows memory and Prometheus
@@ -210,7 +216,18 @@ class Router:
                 "retry with backoff",
                 retry_after_s=round(max(0.1, min(1.0, queue_frac)), 3))
         n_take = max(1, int(n_rows))
-        if not state.bucket.try_take(n_take):
+        if self.shared is not None and not math.isinf(state.policy.rate):
+            if not self.shared.try_spend(name, n_take, state.policy.rate,
+                                         state.policy.effective_burst()):
+                self._shed(name, state, model, "quota_exceeded")
+                raise ScoreError(
+                    "quota_exceeded",
+                    f"tenant {name!r} over its fleet-wide row quota "
+                    f"({state.policy.rate:g} rows/s across all "
+                    "replicas); retry after backoff",
+                    retry_after_s=round(self.shared.refill_eta_s(
+                        name, n_take, state.policy.rate), 3))
+        elif not state.bucket.try_take(n_take):
             self._shed(name, state, model, "quota_exceeded")
             raise ScoreError(
                 "quota_exceeded",

@@ -1,0 +1,34 @@
+"""Shared state plane: content-addressed artifact store + CAS state.
+
+`ArtifactStore` (get/put/stat over a pluggable backend) carries every
+replica-portable artifact — feature-cache tapes, warmup manifests,
+perf-corpus shards — on the PR-4/PR-6 staged-commit protocol, with
+LRU+TTL GC and wire-tape prefetch. `StateCell`/`SharedQuota` add
+CAS-guarded mutable state (token-bucket snapshots, SLO burn) on the
+same directory, so the K-replica tenant invariant holds without a
+per-request round trip. `config` is the single resolution point for
+every shared on-disk location (`TRANSMOGRIFAI_STORE_DIR`).
+"""
+
+from transmogrifai_tpu.store.artifact import (
+    MANIFEST, STORE_VERSION, ArtifactInfo, ArtifactStore, Backend,
+    LocalDirBackend, StoreCorruptError)
+from transmogrifai_tpu.store.config import (
+    ENV_STORE, cache_root, resolve_dir, store_configured)
+from transmogrifai_tpu.store.state import SharedQuota, StateCell
+
+__all__ = [
+    "MANIFEST",
+    "STORE_VERSION",
+    "ArtifactInfo",
+    "ArtifactStore",
+    "Backend",
+    "LocalDirBackend",
+    "StoreCorruptError",
+    "ENV_STORE",
+    "cache_root",
+    "resolve_dir",
+    "store_configured",
+    "SharedQuota",
+    "StateCell",
+]

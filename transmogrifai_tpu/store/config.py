@@ -1,0 +1,60 @@
+"""One resolution point for every shared on-disk location.
+
+Before the store existed, each subsystem hardcoded its own corner of
+`~/.cache/transmogrifai_tpu` (feature cache, perf corpus, XLA compile
+cache, sweep calibration), so pointing a K-replica fleet at shared
+storage meant chasing N env vars and still missing the hardcoded
+fallbacks. Now: `TRANSMOGRIFAI_STORE_DIR` moves the WHOLE root (every
+subsystem follows), while each subsystem's existing env var still wins
+for its own subtree — nothing previously configurable got less so.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "ENV_STORE",
+    "cache_root",
+    "resolve_dir",
+    "store_configured",
+]
+
+ENV_STORE = "TRANSMOGRIFAI_STORE_DIR"
+
+# subsystem env overrides, kept here so callers and docs agree on the
+# precedence order: explicit arg > subsystem env > store root env > HOME
+ENV_FEATURE_CACHE = "TRANSMOGRIFAI_FEATURE_CACHE_DIR"
+ENV_PERF_CORPUS = "TRANSMOGRIFAI_PERF_CORPUS_DIR"
+ENV_COMPILE_CACHE = "TRANSMOGRIFAI_TPU_CACHE"
+
+
+def store_configured() -> bool:
+    """True when a shared store root was explicitly pointed somewhere —
+    the signal consumers use to ALSO publish replica-portable artifacts
+    (warmup manifests, corpus shards) instead of only local sidecars."""
+    return bool(os.environ.get(ENV_STORE))
+
+
+def cache_root() -> str:
+    env = os.environ.get(ENV_STORE)
+    if env:
+        return env
+    return os.path.expanduser("~/.cache/transmogrifai_tpu")
+
+
+def resolve_dir(kind: str, env: str | None = None,
+                explicit: str | None = None) -> str:
+    """Resolve the directory for one artifact kind.
+
+    Precedence: explicit caller arg, then the subsystem's own env var,
+    then `<store root>/<kind>` (where the store root itself honors
+    `TRANSMOGRIFAI_STORE_DIR` before falling back to the home cache).
+    """
+    if explicit:
+        return explicit
+    if env:
+        val = os.environ.get(env)
+        if val:
+            return val
+    return os.path.join(cache_root(), kind)

@@ -1,0 +1,257 @@
+"""CAS-guarded shared mutable state on the artifact store's directory.
+
+Artifacts are immutable; quota balances and SLO burn counters are not.
+`StateCell` gives them a lock-free compare-and-swap on any POSIX-ish
+shared filesystem: each write publishes a fully written, fsynced temp
+file under the NEXT version number via `os.link` — link creation is
+atomic and fails with EEXIST when another replica claimed that version
+first, which IS the CAS failure. Readers take the highest parseable
+version (a reader can never observe a torn value, because the link only
+ever exposes complete files). Old versions are pruned behind a keep
+window so the cell stays O(1) on disk.
+
+`SharedQuota` builds the K-replica tenant invariant on top: one shared
+token balance per tenant, refilled by wall clock at CAS time, from
+which each replica WITHDRAWS a lease (a fraction of the burst budget)
+and spends it locally per-request. The shared balance is only touched
+when a lease runs dry, so admission stays a local counter decrement in
+the hot path — no per-request round trip — while the sum of what K
+replicas can admit between syncs stays bounded by the one shared
+refill rate.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from transmogrifai_tpu.runtime.integrity import fsync_dir
+
+__all__ = ["StateCell", "SharedQuota"]
+
+log = logging.getLogger(__name__)
+
+_CELL_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,120}$")
+_KEEP_VERSIONS = 4
+
+
+class StateCell:
+    """A named JSON value with filesystem compare-and-swap."""
+
+    def __init__(self, root: str, name: str) -> None:
+        if not _CELL_RE.match(name):
+            raise ValueError(f"illegal state cell name: {name!r}")
+        self.dir = os.path.join(os.path.abspath(os.path.expanduser(root)),
+                                "state")
+        self.name = name
+
+    def _version_path(self, version: int) -> str:
+        return os.path.join(self.dir, f"{self.name}.v{version}.json")
+
+    def _versions(self) -> List[int]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        out = []
+        prefix = f"{self.name}.v"
+        for n in names:
+            if n.startswith(prefix) and n.endswith(".json"):
+                try:
+                    out.append(int(n[len(prefix):-len(".json")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def read(self) -> Tuple[int, Optional[Any]]:
+        """(version, value) of the newest parseable version; (0, None)
+        for a never-written cell. Values are complete by construction
+        (link-published), but a version written by a crashed process
+        before its fsync landed could in principle read short after a
+        power cut — fall back one version instead of failing."""
+        for version in reversed(self._versions()):
+            try:
+                with open(self._version_path(version), "r",
+                          encoding="utf-8") as fh:
+                    return version, json.load(fh)
+            except (OSError, ValueError):
+                continue
+        return 0, None
+
+    def try_write(self, version: int, value: Any) -> bool:
+        """Publish `value` as version `version + 1`. False = CAS lost
+        (someone else claimed the version) — re-read and retry."""
+        os.makedirs(self.dir, exist_ok=True)
+        target = self._version_path(version + 1)
+        tmp = os.path.join(
+            self.dir,
+            f".{self.name}-{os.getpid()}-{uuid.uuid4().hex[:8]}.tmp")
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(value, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            try:
+                os.link(tmp, target)  # atomic claim-or-fail
+            except FileExistsError:
+                return False
+            except OSError as e:
+                # no hardlink support on this filesystem: O_EXCL create
+                # + byte copy is the degraded path (claim is still
+                # atomic; the value was already durable in tmp)
+                log.debug("state cell link failed (%s); O_EXCL fallback", e)
+                try:
+                    fd = os.open(target,
+                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                except FileExistsError:
+                    return False
+                with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                    json.dump(value, fh)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            fsync_dir(self.dir)
+            self._prune(version + 1)
+            return True
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _prune(self, latest: int) -> None:
+        for version in self._versions():
+            if version <= latest - _KEEP_VERSIONS:
+                try:
+                    os.unlink(self._version_path(version))
+                except OSError:
+                    pass
+
+    def update(self, fn: Callable[[Optional[Any]], Any],
+               retries: int = 32) -> Any:
+        """CAS loop: read, transform, try_write; backs off a few ms on
+        contention. Raises RuntimeError if `retries` straight CAS
+        losses (K replicas hammering one cell — raise the lease size,
+        not the retry count)."""
+        for attempt in range(retries):
+            version, value = self.read()
+            new = fn(value)
+            if self.try_write(version, new):
+                return new
+            time.sleep(min(0.001 * (2 ** min(attempt, 5)), 0.05))
+        raise RuntimeError(
+            f"state cell {self.name}: CAS contention exceeded "
+            f"{retries} retries")
+
+
+class SharedQuota:
+    """Lease-based cross-replica token budget per tenant.
+
+    The shared cell holds ``{"tokens": float, "ts": wall_clock}`` —
+    refill happens inside the CAS transform from the wall-clock delta,
+    capped at the burst budget, so K replicas reading concurrently can
+    never mint more than `rate * elapsed` between them. A replica
+    withdraws ``lease_frac * burst`` tokens at a time and spends the
+    lease locally; `try_spend` is the hot-path call and only goes to the
+    shared cell when the local lease runs dry.
+    """
+
+    def __init__(self, root: str, replica: str = "r0",
+                 lease_frac: float = 0.25, registry=None) -> None:
+        self.root = root
+        self.replica = replica
+        self.lease_frac = float(lease_frac)
+        self._lock = threading.Lock()
+        self._leases: Dict[str, float] = {}  # guarded-by: self._lock
+        self._cells: Dict[str, StateCell] = {}  # guarded-by: self._lock
+        if registry is None:
+            from transmogrifai_tpu.obs.metrics import get_registry
+            registry = get_registry()
+        self._m_sync = registry.counter(
+            "router_quota_syncs_total",
+            "shared-quota cell round trips", replica=replica)
+        self._m_denied = registry.counter(
+            "router_quota_denied_total",
+            "admissions denied by the shared balance", replica=replica)
+
+    def _cell(self, tenant: str) -> StateCell:
+        with self._lock:
+            cell = self._cells.get(tenant)
+            if cell is None:
+                safe = re.sub(r"[^A-Za-z0-9._-]", "_", tenant)[:80] or "t"
+                cell = StateCell(self.root, f"quota-{safe}")
+                self._cells[tenant] = cell
+        return cell
+
+    def _withdraw(self, tenant: str, rate: float, burst: float,
+                  want: float) -> float:
+        """CAS-withdraw up to `want` tokens from the shared balance.
+        Runs OUTSIDE self._lock — the cell update can touch shared
+        storage and must never serialize the other tenants."""
+        granted = {"v": 0.0}
+
+        def transform(value: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+            now = time.time()
+            if not isinstance(value, dict):
+                tokens, ts = burst, now
+            else:
+                tokens = float(value.get("tokens", 0.0))
+                ts = float(value.get("ts", now))
+                tokens = min(burst, tokens + max(0.0, now - ts) * rate)
+            granted["v"] = max(0.0, min(tokens, want))
+            return {"tokens": tokens - granted["v"], "ts": now,
+                    "rate": rate, "burst": burst}
+
+        self._cell(tenant).update(transform)
+        self._m_sync.inc()
+        return granted["v"]
+
+    def try_spend(self, tenant: str, n: float, rate: float,
+                  burst: float) -> bool:
+        """Spend `n` tokens for `tenant`; False = over the K-replica
+        budget (caller maps to quota_exceeded/429)."""
+        if rate == float("inf"):
+            return True
+        with self._lock:
+            lease = self._leases.get(tenant, 0.0)
+            if lease >= n:
+                self._leases[tenant] = lease - n
+                return True
+        want = max(n, burst * self.lease_frac)
+        granted = self._withdraw(tenant, rate, burst, want)
+        with self._lock:
+            lease = self._leases.get(tenant, 0.0) + granted
+            if lease >= n:
+                self._leases[tenant] = lease - n
+                return True
+            # not enough fleet-wide: keep the partial lease for later
+            self._leases[tenant] = lease
+        self._m_denied.inc()
+        return False
+
+    def refill_eta_s(self, tenant: str, n: float, rate: float) -> float:
+        """Honest Retry-After for a denied admission: how long the
+        SHARED refill needs to cover `n` tokens beyond what this
+        replica already holds."""
+        if rate <= 0.0:
+            return 3600.0
+        with self._lock:
+            lease = self._leases.get(tenant, 0.0)
+        return min(3600.0, max(0.0, (n - lease)) / rate)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            leases = dict(self._leases)
+        out: Dict[str, Any] = {"replica": self.replica, "tenants": {}}
+        for tenant, lease in sorted(leases.items()):
+            _, value = self._cell(tenant).read()
+            out["tenants"][tenant] = {
+                "lease": round(lease, 3),
+                "shared": value if isinstance(value, dict) else None,
+            }
+        return out

@@ -15,7 +15,12 @@ import os
 
 log = logging.getLogger(__name__)
 
-_DEFAULT = os.path.expanduser("~/.cache/transmogrifai_tpu/xla-cache")
+def _default_dir() -> str:
+    # resolved through the shared store config: pointing
+    # TRANSMOGRIFAI_STORE_DIR at shared storage moves the compile cache
+    # there too (a second replica replays this replica's compiles)
+    from transmogrifai_tpu.store.config import resolve_dir
+    return resolve_dir("xla-cache")
 
 # the JAX compilation cache is PROCESS-GLOBAL config: remember what was
 # applied so a second caller asking for a different dir/threshold gets a
@@ -37,7 +42,8 @@ def enable_compile_cache(path: str | None = None,
     global _applied
     import jax
 
-    path = path or os.environ.get("TRANSMOGRIFAI_TPU_CACHE", _DEFAULT)
+    path = path or os.environ.get("TRANSMOGRIFAI_TPU_CACHE") \
+        or _default_dir()
     try:
         os.makedirs(path, exist_ok=True)
         if _applied is not None and _applied != (path, float(min_compile_s)):

@@ -283,32 +283,77 @@ def save_warmup_manifest(model_dir: str, payload: Dict[str, Any]) -> bool:
     record = {"warmup_version": WARMUP_VERSION, **payload}
     path = os.path.join(model_dir, WARMUP)
     tmp = f"{path}.tmp-{os.getpid()}"
+    ok = True
     try:
         with open(tmp, "w") as fh:
             json.dump(record, fh)
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, path)
-        return True
     except OSError:
         log.debug("warmup manifest write to %s failed", path, exc_info=True)
         try:
             os.unlink(tmp)
         except OSError:
             pass
-        return False
+        ok = False
+    # replica-portable copy: when a shared store root is configured,
+    # also publish the record keyed by model fingerprint so a SECOND
+    # replica's cold start replays this replica's warmup plan (bucket
+    # ladder, compile counts) instead of rediscovering it. Best-effort:
+    # warmup must never fail because the store is unreachable.
+    try:
+        store = _warmup_store()
+        if store is not None:
+            key = f"warmup-{model_fingerprint(model_dir)}"
+
+            def stage(tmp_dir: str) -> None:
+                with open(os.path.join(tmp_dir, WARMUP), "w") as fh:
+                    json.dump(record, fh)
+
+            store.put(key, stage, meta={"kind": "warmup_manifest"})
+    except Exception:
+        log.debug("warmup manifest store publish failed", exc_info=True)
+    return ok
+
+
+def _warmup_store():
+    """Shared-store handle for replica-portable warmup manifests, or
+    None when no store root is configured (local sidecar only)."""
+    from transmogrifai_tpu.store.artifact import (
+        ArtifactStore, LocalDirBackend)
+    from transmogrifai_tpu.store.config import (
+        resolve_dir, store_configured)
+    if not store_configured():
+        return None
+    return ArtifactStore(LocalDirBackend(resolve_dir("warmup")))
 
 
 def load_warmup_manifest(model_dir: str) -> Optional[Dict[str, Any]]:
-    """Read the warmup manifest beside a model dir, or None when absent,
-    unreadable, or from a different manifest version (a torn/garbage
-    sidecar means 'cold start', never an error)."""
+    """Read the warmup manifest beside a model dir — falling back to
+    the shared artifact store (keyed by model fingerprint) when the
+    sidecar is absent, so a fresh replica inherits the fleet's warmup
+    plan. None when absent everywhere, unreadable, or from a different
+    manifest version (a torn/garbage sidecar means 'cold start', never
+    an error)."""
     path = os.path.join(model_dir, WARMUP)
+    record: Any = None
     try:
         with open(path) as fh:
             record = json.load(fh)
     except (OSError, ValueError):
-        return None
+        record = None
+    if record is None:
+        try:
+            store = _warmup_store()
+            if store is not None:
+                key = f"warmup-{model_fingerprint(model_dir)}"
+                apath = store.get(key)
+                if apath is not None:
+                    with open(os.path.join(apath, WARMUP)) as fh:
+                        record = json.load(fh)
+        except Exception:
+            record = None
     if not isinstance(record, dict) or \
             record.get("warmup_version") != WARMUP_VERSION:
         return None
