@@ -18,7 +18,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 .PHONY: lint conc-check serve-smoke fleet-smoke chaos-smoke \
 	ingest-smoke faults-smoke trace-smoke cache-smoke multichip-smoke \
 	continual-smoke costmodel-smoke roofline-smoke slo-smoke \
-	parse-smoke router-smoke test check
+	parse-smoke router-smoke pod-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -102,6 +102,20 @@ chaos-smoke:
 multichip-smoke:
 	$(PY) -m transmogrifai_tpu.parallel.smoke
 
+# pod-scale sweep smoke: 2 real host scheduler PROCESSES (fresh
+# interpreters, forced host meshes) claim-race one sweep's blocks
+# through the shared store/ lease table; every host must report the
+# bit-identical winner vs a single-host run (rows merged from the
+# host-qualified journal shards); a host killed holding a block lease
+# is TTL-reclaimed by a survivor process that finishes with exactly
+# the dead host's unjournaled blocks re-run (journal-shard- and
+# lease-attempt-asserted); measured speedup + the fleet-wide
+# mesh-utilization rollup are emitted. The parent never initializes
+# JAX (children force their own host meshes).
+# See transmogrifai_tpu/parallel/pod_smoke.py.
+pod-smoke:
+	$(PY) -m transmogrifai_tpu.parallel.pod_smoke
+
 # continuous-training smoke: drifted records appended to a live store
 # fire the drift monitor, a warm-start refit runs while serving stays
 # live (zero dropped requests, p99 measured during refit), the promoted
@@ -165,5 +179,5 @@ test:
 
 check: lint conc-check serve-smoke parse-smoke fleet-smoke chaos-smoke \
 	roofline-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
-	slo-smoke multichip-smoke continual-smoke costmodel-smoke \
+	slo-smoke multichip-smoke pod-smoke continual-smoke costmodel-smoke \
 	router-smoke test

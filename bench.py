@@ -978,6 +978,57 @@ def run_multichip() -> None:
     })
 
 
+def run_pod() -> None:
+    """Measured multi-HOST sweep (`python bench.py pod`).
+
+    The multichip mode measures lanes inside ONE process; this mode
+    measures the pod tier: 2+ real scheduler processes (one per
+    "host"), each on its own forced host mesh, claim-racing one sweep's
+    blocks through the shared `store/` lease table, with the
+    host-qualified journal shards as the cross-host completion log.
+    Reports the measured single-host vs pod wall pair, the fleet-wide
+    mesh-utilization rollup (per-host `GoodputReport.mesh` sections
+    merged by `obs.goodput.fleet_mesh_rollup`), and asserts every
+    host's winner is bit-identical to the single-host run. The parent
+    never initializes JAX, so unlike multichip this mode needs no
+    fresh-subprocess trampoline for itself — the host processes ARE the
+    fresh subprocesses."""
+    n_hosts = int(os.environ.get("BENCH_POD_HOSTS", 2))
+    workers = int(os.environ.get("BENCH_POD_WORKERS", 2))
+    n_rows = int(os.environ.get("BENCH_MESH_ROWS", 2048))
+    from transmogrifai_tpu.parallel.pod_smoke import run_pod as _run_pod
+    # 8 LR max_iter groups + 1 SVC = 9 blocks over n_hosts×workers
+    # lanes: enough rounds that claim racing (not startup skew) sets
+    # the packing
+    measured = _run_pod(n_hosts=n_hosts, workers=workers, n_rows=n_rows,
+                        max_iters=(24, 20, 16, 12, 8, 4, 6, 3))
+    key = f"sweep_pod{n_hosts}_measured_s"
+    _emit({
+        "metric": "pod_sweep_measured",
+        "value": measured["pod_speedup"],
+        "unit": f"x vs single host ({n_hosts} host processes × "
+                f"{workers} lanes, shared store)",
+        "vs_baseline": measured["pod_speedup"],
+        "platform": "cpu-hostmesh-pod",
+        "n_rows": n_rows,
+        "winner_exact": measured["winner_exact"],
+        "sweep_single_host_measured_s":
+            measured["sweep_single_host_measured_s"],
+        key: measured[key],
+        "pod_scaling_efficiency": round(
+            measured["pod_speedup"] / n_hosts, 4),
+        # a pod of n_hosts interpreters sharing fewer cores than hosts
+        # is core-starved: the measured speedup tops out near
+        # host_cpus/n_hosts there, so record the denominator
+        "host_cpus": measured["host_cpus"],
+        "core_starved": measured["host_cpus"] < n_hosts,
+        "mesh_utilization_frac":
+            measured["fleet_mesh_utilization_frac"],
+        "fleet_mesh": measured["fleet_mesh"],
+        "blocks": measured["blocks"],
+    })
+
+
 def run_costmodel() -> None:
     """Learned-cost-model bench (`python bench.py costmodel`): the
     model's production scorecard. Reports holdout MAPE per target on
@@ -1896,6 +1947,16 @@ def main() -> None:
                    "vs_baseline": 0.0,
                    "error": f"multichip bench failed: "
                             f"{type(e).__name__}: {e}",
+                   "trace_tail":
+                       traceback.format_exc().strip().splitlines()[-3:]})
+        return
+    if "pod" in sys.argv[1:]:
+        try:
+            run_pod()
+        except Exception as e:
+            _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"pod bench failed: {type(e).__name__}: {e}",
                    "trace_tail":
                        traceback.format_exc().strip().splitlines()[-3:]})
         return

@@ -857,3 +857,38 @@ class TestUnifiedTimeline:
         assert j2.duration_of({"a": 1}) == pytest.approx(1.25)
         assert j2.duration_of({"a": 2}) == 0.0
         assert j2.lookup({"a": 1}) == [0.5, 0.6]
+
+
+# --------------------------------------------------------------------- #
+# fleet_mesh_rollup: pod-wide mesh utilization (satellite)              #
+# --------------------------------------------------------------------- #
+
+class TestFleetMeshRollup:
+    def test_weighted_by_worker_wall(self):
+        """Two hosts with different worker-wall must merge by raw
+        busy/wall accumulators, not by averaging the per-host fracs."""
+        a = {"workers": 2, "worker_wall_s": 10.0, "busy_s": 9.0,
+             "utilization_frac": 0.9, "blocks": 3, "schedules": 1,
+             "steals": 1, "requeues": 0, "idle_s": 1.0}
+        b = {"workers": 2, "worker_wall_s": 30.0, "busy_s": 15.0,
+             "utilization_frac": 0.5, "blocks": 5, "schedules": 1,
+             "steals": 0, "requeues": 2, "idle_s": 15.0}
+        out = obsg.fleet_mesh_rollup([a, b])
+        assert out["hosts"] == 2 and out["workers"] == 4
+        # 24/40, NOT mean(0.9, 0.5) = 0.7
+        assert out["mesh_utilization_frac"] == pytest.approx(0.6)
+        assert out["blocks"] == 8 and out["steals"] == 1
+        assert out["requeues"] == 2 and out["schedules"] == 2
+
+    def test_legacy_mesh_without_accumulators(self):
+        """A mesh section predating worker_wall_s/busy_s contributes
+        its utilization_frac at unit weight instead of vanishing."""
+        legacy = {"workers": 4, "utilization_frac": 0.8}
+        out = obsg.fleet_mesh_rollup([legacy, {}])
+        assert out["hosts"] == 1  # the empty dict is skipped
+        assert out["mesh_utilization_frac"] == pytest.approx(0.8)
+
+    def test_empty_rollup(self):
+        out = obsg.fleet_mesh_rollup([])
+        assert out["hosts"] == 0
+        assert out["mesh_utilization_frac"] == 0.0

@@ -803,3 +803,77 @@ class TestServiceSLOWiring:
             rec.configure(dump_dir=old_dir)
             server.shutdown()
             server.server_close()
+
+
+# --------------------------------------------------------------------- #
+# fleet SLO burn sharing (satellite): replicas publish per-SLO          #
+# good/total into a store StateCell; everyone reports fleet-wide burn   #
+# --------------------------------------------------------------------- #
+
+class TestFleetSLO:
+    def _engine(self, tmp_path, replica):
+        params = SLOParams(
+            slos=[{"name": "avail", "kind": "availability",
+                   "objective": 0.99}],
+            windows=[[60.0, 10.0, 2.0, "page"]], eval_period_s=1.0)
+        engine = SLOEngine(params)
+        state = {"good": 0.0, "total": 0.0}
+        engine.set_source("avail",
+                          lambda: (state["good"], state["total"]))
+        engine.attach_fleet(str(tmp_path), replica)
+        return engine, state
+
+    def test_fleet_burn_beside_local(self, tmp_path):
+        """Replica A is perfectly healthy locally; replica B burns.
+        A's LOCAL burn stays 0 while its FLEET view shows the shared
+        burn — the pod-wide signal a single replica cannot see."""
+        ea, sa = self._engine(tmp_path, "repA")
+        eb, sb = self._engine(tmp_path, "repB")
+        now = 1000.0
+        for i in range(12):
+            sa["good"] += 10
+            sa["total"] += 10
+            sb["good"] += 5   # 50% errors on B throughout
+            sb["total"] += 10
+            ea.evaluate(now=now + i)
+            eb.evaluate(now=now + i)
+        sta = ea.status(now=now + 12)
+        slo = sta["slos"]["avail"]
+        assert sta["fleet_replica"] == "repA"
+        assert slo["windows"]["60s/10s"]["long_burn"] == 0.0  # local: spotless
+        fleet = slo["fleet"]
+        assert fleet["replicas"] == 2
+        # fleet: 60 bad / 240 total = 25% errors vs a 1% budget
+        assert fleet["burn"]["60s"] == pytest.approx(25.0, rel=0.05)
+        # B sees the same fleet numbers through its own engine
+        fb = eb.status(now=now + 12)["slos"]["avail"]["fleet"]
+        assert fb["replicas"] == 2
+        assert fb["burn"]["60s"] == pytest.approx(25.0, rel=0.05)
+
+    def test_unattached_engine_reports_no_fleet(self):
+        params = SLOParams(
+            slos=[{"name": "avail", "kind": "availability",
+                   "objective": 0.99}],
+            windows=[[60.0, 10.0, 2.0, "page"]], eval_period_s=1.0)
+        engine = SLOEngine(params)
+        engine.set_source("avail", lambda: (10.0, 10.0))
+        engine.evaluate(now=1.0)
+        st = engine.status(now=2.0)
+        assert "fleet_replica" not in st
+        assert "fleet" not in st["slos"]["avail"]
+
+    def test_maybe_attach_fleet_env_gate(self, tmp_path, monkeypatch):
+        from transmogrifai_tpu.obs.slo import maybe_attach_fleet
+        params = SLOParams(
+            slos=[{"name": "avail", "kind": "availability",
+                   "objective": 0.99}],
+            windows=[[60.0, 10.0, 2.0, "page"]], eval_period_s=1.0)
+        engine = SLOEngine(params)
+        monkeypatch.delenv("TRANSMOGRIFAI_SLO_FLEET_DIR", raising=False)
+        assert maybe_attach_fleet(engine) is False
+        monkeypatch.setenv("TRANSMOGRIFAI_SLO_FLEET_DIR", str(tmp_path))
+        monkeypatch.setenv("TRANSMOGRIFAI_SLO_REPLICA", "rZ")
+        assert maybe_attach_fleet(engine) is True
+        engine.set_source("avail", lambda: (10.0, 10.0))
+        engine.evaluate(now=1.0)
+        assert engine.status(now=2.0)["fleet_replica"] == "rZ"

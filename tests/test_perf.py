@@ -495,3 +495,60 @@ class TestParams:
         assert sp.auto_ladder is True
         assert sp.to_config().auto_ladder is True
         assert ServingParams.from_json(sp.to_json()).auto_ladder is True
+
+
+# --------------------------------------------------------------------------- #
+# fleet corpus merge (replica shards, one directory)                          #
+# --------------------------------------------------------------------------- #
+
+class TestCorpusFleetMerge:
+    def test_merge_total_order_breaks_int_second_ties(self, perf_env,
+                                                      monkeypatch):
+        """Replica shards on a fleet store carry identical int-second
+        `ts` constantly; the (ts, replica, seq) total order must keep
+        the merged view stable across fresh readers instead of leaving
+        same-second interleaving to shard listing order."""
+        import transmogrifai_tpu.perf.corpus as corpus_mod
+        monkeypatch.setattr(corpus_mod.time, "time", lambda: 1700000000.5)
+        ca = corpus_mod.CostCorpus(str(perf_env), replica="a")
+        cb = corpus_mod.CostCorpus(str(perf_env), replica="b")
+        # interleave appends so FILE order disagrees with replica order
+        for i, c in enumerate([cb, ca, cb, ca, ca, cb]):
+            assert c.append("block_runtime", {"x": 1.0}, float(i), tag=i)
+        want = [1, 3, 4, 0, 2, 5]  # all of a's rows (by seq), then b's
+        for _ in range(2):  # fresh readers agree with each other
+            reader = corpus_mod.CostCorpus(str(perf_env))
+            got = [r["tag"] for r in reader.rows("block_runtime")]
+            assert got == want
+
+    def test_harvest_after_live_recording_dedupes_across_shards(
+            self, perf_env, monkeypatch):
+        """A sweep host live-records its block row into ITS replica
+        shard; harvesting that host's journal from ANOTHER replica must
+        see the key through the merged view and skip it — even when
+        every row carries the same int-second ts."""
+        import transmogrifai_tpu.perf.corpus as corpus_mod
+        from transmogrifai_tpu.perf.corpus import harvest_journal
+        monkeypatch.setattr(corpus_mod.time, "time", lambda: 1700000000.5)
+        live = corpus_mod.CostCorpus(str(perf_env), replica="h0")
+        assert live.append("block_runtime", {"n_configs": 2.0}, 0.5,
+                           source="live", block_key="bk1")
+        journal = perf_env / "run.journal-wh0_0.jsonl"
+        recs = [
+            {"grid": {"i": 0}, "facts": {"block_key": "bk1",
+                                         "block_s": 0.5, "n_configs": 2}},
+            {"grid": {"i": 1}, "facts": {"block_key": "bk2",
+                                         "block_s": 0.7, "n_configs": 2}},
+        ]
+        journal.write_text(
+            "\n".join(json.dumps(r) for r in recs) + "\n", encoding="utf-8")
+        harvester = corpus_mod.CostCorpus(str(perf_env), replica="hx")
+        # bk1 is already live-recorded in h0's shard: only bk2 lands
+        assert harvest_journal([str(journal)], corpus=harvester) == 1
+        rows = harvester.rows("block_runtime")
+        keys = [r.get("block_key") for r in rows]
+        assert keys == ["bk1", "bk2"]
+        assert rows[1]["source"] == "journal"
+        # re-harvest is a no-op: both keys visible through the merge
+        assert harvest_journal([str(journal)], corpus=harvester) == 0
+        assert len(harvester.rows("block_runtime")) == 2

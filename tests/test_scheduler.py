@@ -446,3 +446,53 @@ def test_scheduled_block_retries_transient_error(cols):
     assert set(base) == set(retried)  # family survived
     for k in base:
         assert json.dumps(base[k]) == json.dumps(retried[k]), k
+
+
+def test_sharded_journal_host_qualified_shards_and_refresh(tmp_path):
+    """Pod hosts write host-qualified shards (`-w<host>_<lane>`); a
+    fresh refresh() on one host discovers shards another host wrote
+    AFTER this journal was opened — the cross-host completion log."""
+    base = str(tmp_path / "fam.journal")
+    mine = ShardedSweepJournal(base, meta={"sig": "s"})
+    mine.shard("h0_0").append({"a": 1}, [0.5], duration_s=1.0)
+    # another "host" opens the same base later and writes its shard
+    theirs = ShardedSweepJournal(base, meta={"sig": "s"})
+    theirs.shard("h1_0").append({"a": 2}, [0.7], duration_s=2.0)
+    # mine opened before h1's shard existed: refresh pulls it in
+    assert mine.refresh() >= 1
+    assert mine.lookup({"a": 2}) == [0.7]
+    names = sorted(os.path.basename(p) for p in glob.glob(base + "-w*"))
+    assert names == ["fam.journal-wh0_0.jsonl", "fam.journal-wh1_0.jsonl"]
+    # numeric tokens still parse as ints (legacy single-host shards)
+    mine.shard(2).append({"a": 3}, [0.9], duration_s=1.0)
+    assert ShardedSweepJournal(base, meta={"sig": "s"}).lookup({"a": 3}) \
+        == [0.9]
+
+
+def test_sharded_journal_illegal_shard_token_rejected(tmp_path):
+    base = str(tmp_path / "fam.journal")
+    j = ShardedSweepJournal(base, meta={"sig": "s"})
+    import pytest
+    with pytest.raises(ValueError):
+        j.shard("h0/../../etc")
+
+
+def test_journal_parse_cache_hits_on_unchanged_file(tmp_path):
+    """Re-opening an unchanged journal shard must serve rows from the
+    (ino, size, mtime) parse cache; an append invalidates the key."""
+    from transmogrifai_tpu.runtime import journal as journal_mod
+    base = str(tmp_path / "fam.journal")
+    j = ShardedSweepJournal(base, meta={"sig": "s"})
+    j.shard(0).append({"a": 1}, [0.5], duration_s=1.0)
+    # a fresh instance parses the shard once and caches the result...
+    j2 = ShardedSweepJournal(base, meta={"sig": "s"})
+    assert j2.lookup({"a": 1}) == [0.5]
+    path = os.path.abspath(glob.glob(base + "-w*")[0])
+    st = os.stat(path)
+    with journal_mod._PARSE_CACHE_LOCK:
+        stat_key, _state = journal_mod._PARSE_CACHE[path]
+    assert stat_key == (st.st_ino, st.st_size, st.st_mtime_ns)
+    # append moves size/mtime: the stale key must not be served
+    j2.shard(0).append({"a": 2}, [0.7], duration_s=1.0)
+    j3 = ShardedSweepJournal(base, meta={"sig": "s"})
+    assert j3.lookup({"a": 2}) == [0.7]

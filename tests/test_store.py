@@ -11,7 +11,7 @@ import pytest
 
 from transmogrifai_tpu.obs.metrics import MetricsRegistry
 from transmogrifai_tpu.store import (
-    ArtifactStore, LocalDirBackend, SharedQuota, StateCell,
+    ArtifactStore, LeaseTable, LocalDirBackend, SharedQuota, StateCell,
     StoreCorruptError, cache_root, resolve_dir, store_configured)
 from transmogrifai_tpu.store.artifact import MANIFEST
 
@@ -351,3 +351,139 @@ class TestSharedQuota:
         assert snap["replica"] == "rX"
         assert "t" in snap["tenants"]
         assert snap["tenants"]["t"]["shared"]["rate"] == 100.0
+
+
+# --------------------------------------------------------------------- #
+# lease table (pod block claims)                                        #
+# --------------------------------------------------------------------- #
+
+class TestLeaseTable:
+    def test_register_is_idempotent_union(self, tmp_path):
+        a = LeaseTable(str(tmp_path), "s", owner="a")
+        b = LeaseTable(str(tmp_path), "s", owner="b")
+        a.register(["k1", "k2"])
+        b.register(["k2", "k3"])  # first writer wins per key
+        snap = a.snapshot()
+        assert sorted(snap) == ["k1", "k2", "k3"]
+        assert all(v["state"] == "pool" for v in snap.values())
+
+    def test_acquire_complete_lifecycle(self, tmp_path):
+        t = LeaseTable(str(tmp_path), "s", owner="h0", ttl_s=30.0)
+        t.register(["k"])
+        assert t.acquire("k") == "acquired"
+        assert t.acquire("k") == "held"  # own live lease: idempotent
+        assert t.snapshot()["k"]["attempts"] == 1  # held never re-counts
+        assert t.complete("k") is True
+        assert t.acquire("k") == "done"
+        assert t.pending() == (0, float("inf"))
+
+    def test_live_foreign_lease_is_busy(self, tmp_path):
+        a = LeaseTable(str(tmp_path), "s", owner="a", ttl_s=30.0)
+        b = LeaseTable(str(tmp_path), "s", owner="b", ttl_s=30.0)
+        a.register(["k"])
+        assert a.acquire("k") == "acquired"
+        assert b.acquire("k") == "busy"
+        n, expiry = b.pending()
+        assert n == 1 and 0.0 < expiry <= 30.0
+
+    def test_ttl_expiry_takeover_attempts(self, tmp_path):
+        a = LeaseTable(str(tmp_path), "s", owner="a", ttl_s=0.05)
+        b = LeaseTable(str(tmp_path), "s", owner="b", ttl_s=30.0)
+        a.register(["k"])
+        assert a.acquire("k") == "acquired"
+        time.sleep(0.06)
+        assert b.acquire("k") == "takeover"
+        assert b.takeovers == 1
+        snap = b.snapshot()["k"]
+        assert snap["owner"] == "b" and snap["attempts"] == 2
+        # the revoked owner's late renew/complete must NOT clobber b
+        assert a.renew("k") is False
+        assert a.complete("k") is False
+        assert b.snapshot()["k"]["owner"] == "b"
+
+    def test_failed_is_terminal_for_everyone(self, tmp_path):
+        a = LeaseTable(str(tmp_path), "s", owner="a", ttl_s=30.0)
+        b = LeaseTable(str(tmp_path), "s", owner="b", ttl_s=30.0)
+        a.register(["k"])
+        assert a.acquire("k") == "acquired"
+        assert a.fail("k", "family exploded") is True
+        assert b.acquire("k") == "failed"
+        snap = b.snapshot()["k"]
+        assert snap["state"] == "failed"
+        assert "family exploded" in snap["error"]
+
+    def test_claim_prefers_own_plan_slice(self, tmp_path):
+        t = LeaseTable(str(tmp_path), "s", owner="h0")
+        t.register(["a", "b", "c"])
+        assert t.claim(prefer=["b"]) == "b"
+        assert t.claim() == "a"  # sorted scan for the rest
+        assert t.claim() == "c"
+        assert t.claim() is None  # all leased-and-live
+
+
+# --------------------------------------------------------------------- #
+# cross-PROCESS coordination (two real interpreters, one store dir)     #
+# --------------------------------------------------------------------- #
+
+_CAS_CHILD = """
+import sys
+from transmogrifai_tpu.store.state import StateCell
+cell = StateCell(sys.argv[1], "podcas")
+for _ in range(int(sys.argv[2])):
+    cell.update(lambda v: {"n": (v or {}).get("n", 0) + 1}, retries=2000)
+"""
+
+_VICTIM_CHILD = """
+import os
+import sys
+from transmogrifai_tpu.store.state import LeaseTable
+t = LeaseTable(sys.argv[1], "sweep", owner="victim", ttl_s=float(sys.argv[2]))
+t.register(["blk"])
+assert t.acquire("blk") == "acquired"
+os._exit(9)  # die holding the lease: no release, no renewer
+"""
+
+
+class TestCrossProcess:
+    def test_two_processes_cas_lose_nothing(self, tmp_path):
+        """Two INTERPRETERS CAS-updating one cell through the shared
+        directory lose no updates — the os.link publish is the only
+        arbiter, there is no in-process lock to hide behind."""
+        import subprocess
+        import sys as _sys
+        n_each = 20
+        procs = [subprocess.Popen(
+            [_sys.executable, "-c", _CAS_CHILD, str(tmp_path), str(n_each)])
+            for _ in range(2)]
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+        assert StateCell(str(tmp_path), "podcas").read()[1] == \
+            {"n": 2 * n_each}
+
+    def test_killed_lease_holder_ttl_observed_by_survivor(self, tmp_path):
+        """A holder killed mid-block (os._exit — no release, exactly a
+        SIGKILLed host) leaves a live lease; a survivor in another
+        process sees `busy` until the TTL runs out, then takes over
+        with the attempt count recording the re-run."""
+        import subprocess
+        import sys as _sys
+        ttl = 1.0
+        p = subprocess.run(
+            [_sys.executable, "-c", _VICTIM_CHILD, str(tmp_path), str(ttl)],
+            timeout=120)
+        assert p.returncode == 9  # died as scripted, lease still live
+        survivor = LeaseTable(str(tmp_path), "sweep", owner="survivor",
+                              ttl_s=ttl)
+        snap = survivor.snapshot()["blk"]
+        assert snap["state"] == "leased" and snap["owner"] == "victim"
+        deadline = time.time() + 30.0
+        status = survivor.acquire("blk")
+        while status == "busy" and time.time() < deadline:
+            _, expiry = survivor.pending()
+            time.sleep(min(max(expiry, 0.01), 0.25))
+            status = survivor.acquire("blk")
+        assert status == "takeover"
+        snap = survivor.snapshot()["blk"]
+        assert snap["owner"] == "survivor" and snap["attempts"] == 2
+        assert survivor.complete("blk") is True
+        assert survivor.pending() == (0, float("inf"))

@@ -200,6 +200,21 @@ silent slowness or nondeterminism once XLA is in the loop:
   site ``# store-ok: <why>``. ``store/artifact.py``, smoke drivers and
   tests are allowlisted.
 
+- ``L021 blind-poll-loop``: a constant-argument ``time.sleep`` lexically
+  inside a ``while`` loop. A fixed-delay poll is wrong at both ends of
+  the distribution — too fast, and K replicas hammering one shared cell
+  (a ``store.state`` lease table, a journal dir, a readiness file) turn
+  the store into a CAS storm that scales with fleet size; too slow, and
+  a cross-host handoff (lease expiry, barrier release) eats the full
+  period as idle wall time. Poll loops must derive their delay from the
+  thing they wait on — a TTL/deadline (``min(next_expiry, ttl)``, the
+  scheduler's ``_pod_takeover``), capped exponential backoff
+  (``StateCell.update``), or an ``Event.wait(timeout=...)``/
+  ``Condition.wait(timeout=...)`` that a writer can wake early.
+  Computed delays pass by construction (only literal constants flag);
+  a deliberate fixed-cadence loop annotates the site
+  ``# conc-ok: L021``. Smoke/chaos drivers and tests are allowlisted.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1658,6 +1673,61 @@ def _check_store_bypass_writes(tree: ast.AST, path: str,
     return findings
 
 
+# -- L021: constant-delay polling loops -------------------------------------- #
+
+def _l021_suppressed(lines: Sequence[str], lineno: int) -> bool:
+    """Same ``# conc-ok`` spelling as L019; accepts L021 (or bare)."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = _L019_CONC_OK_RE.search(lines[ln - 1])
+            if m:
+                rules = m.group(1)
+                if rules is None:
+                    return True
+                if {r.strip() for r in rules.split(",")} & {"L021"}:
+                    return True
+    return False
+
+
+def _check_blind_poll_loops(tree: ast.AST, path: str,
+                            lines: Sequence[str]) -> List[LintFinding]:
+    """Flag ``time.sleep(<literal>)`` lexically inside a ``while`` loop:
+    coordination waits must be TTL/backoff-derived or Event-woken (see
+    module docstring). Only constant arguments flag — a computed delay
+    is evidence the loop already derives its cadence from something."""
+    parts = os.path.normpath(path).split(os.sep)
+    base = parts[-1]
+    if base.endswith("_smoke.py") or base in ("smoke.py", "chaos.py") \
+            or "tests" in parts or "testkit" in parts:
+        return []
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) \
+                    or _dotted(sub.func) != "time.sleep":
+                continue
+            if not sub.args or not isinstance(sub.args[0], ast.Constant):
+                continue
+            lineno = getattr(sub, "lineno", 0)
+            findings.append(LintFinding(
+                path, lineno, "L021",
+                f"constant-delay `time.sleep({sub.args[0].value!r})` "
+                f"inside a `while` polling loop — a fixed cadence either "
+                f"hammers shared state (K replicas polling one "
+                f"store/state cell scale the CAS load with fleet size) "
+                f"or eats the whole period as idle wall on a cross-host "
+                f"handoff; derive the delay from the wait (TTL/deadline, "
+                f"capped exponential backoff) or block on "
+                f"`Event.wait(timeout=...)` so a writer can wake the "
+                f"loop early; annotate a deliberate fixed cadence with "
+                f"`# conc-ok: L021`",
+                suppression=("annotation"
+                             if _l021_suppressed(lines, lineno) else None)))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1684,6 +1754,8 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_blocking_under_lock(
         tree, path, src.splitlines()))
     linter.findings.extend(_check_store_bypass_writes(
+        tree, path, src.splitlines()))
+    linter.findings.extend(_check_blind_poll_loops(
         tree, path, src.splitlines()))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 

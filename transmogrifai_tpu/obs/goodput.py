@@ -54,7 +54,8 @@ from typing import Any, Dict, Iterable, Optional
 
 from transmogrifai_tpu.obs.trace import Span
 
-__all__ = ["GoodputReport", "build_report", "BADPUT_BUCKETS"]
+__all__ = ["GoodputReport", "build_report", "fleet_mesh_rollup",
+           "BADPUT_BUCKETS"]
 
 BADPUT_BUCKETS = ("retry_backoff_s", "recompile_s", "ingest_wait_s",
                   "oom_redo_s", "fault_redo_s")
@@ -168,6 +169,47 @@ class GoodputReport:
         for k, v in sorted(self.savings.items()):
             lines.append(f"  (saved) {k}: {v:.3f}s")
         return "\n".join(lines)
+
+
+def fleet_mesh_rollup(
+        host_meshes: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Roll per-host ``GoodputReport.mesh`` sections into one fleet
+    view.
+
+    ``mesh_utilization_frac`` is weighted by each host's workers × wall
+    (the ``worker_wall_s`` accumulator `build_report` stamps), so a
+    host with 8 busy lanes counts 8× a host with one — the same math
+    `build_report` uses within a host, lifted across the pod. Worker
+    counts and block/steal/requeue counters sum; hosts without a mesh
+    section (no distributed sweep ran there) are skipped. Hosts that
+    only report ``utilization_frac`` (pre-accumulator payloads) fall
+    back to an unweighted wall of 1.0 so old reports still merge.
+    """
+    out: Dict[str, Any] = {"hosts": 0}
+    wall = busy = 0.0
+    for m in host_meshes:
+        if not m:
+            continue
+        out["hosts"] += 1
+        w = float(m.get("worker_wall_s", 0.0) or 0.0)
+        if w <= 0.0:
+            w = 1.0
+            b = float(m.get("utilization_frac", 0.0) or 0.0)
+        else:
+            b = float(m.get("busy_s", 0.0) or 0.0)
+        wall += w
+        busy += b
+        out["workers"] = out.get("workers", 0) + int(
+            m.get("workers", 0) or 0)
+        for key in ("schedules", "steals", "requeues", "blocks"):
+            out[key] = out.get(key, 0) + int(m.get(key, 0) or 0)
+        out["idle_s"] = round(out.get("idle_s", 0.0)
+                              + float(m.get("idle_s", 0.0) or 0.0), 6)
+    out["worker_wall_s"] = round(wall, 6)
+    out["busy_s"] = round(busy, 6)
+    out["mesh_utilization_frac"] = round(
+        busy / wall, 4) if wall > 0 else 0.0
+    return out
 
 
 def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
@@ -413,6 +455,10 @@ def build_report(root: Span, spans: Iterable[Span]) -> GoodputReport:
     if mesh:
         mesh["utilization_frac"] = round(
             mesh_busy / mesh_wall, 4) if mesh_wall > 0 else 0.0
+        # raw accumulators so fleet_mesh_rollup can re-weight across
+        # hosts without re-walking each host's trace
+        mesh["worker_wall_s"] = round(mesh_wall, 6)
+        mesh["busy_s"] = round(mesh_busy, 6)
         report.mesh = mesh
     if continual:
         report.continual = continual

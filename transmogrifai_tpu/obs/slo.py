@@ -37,6 +37,7 @@ after recovery.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -44,7 +45,31 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
-__all__ = ["SLO", "SLOParams", "SLOEngine", "BurnWindow"]
+__all__ = ["SLO", "SLOParams", "SLOEngine", "BurnWindow",
+           "maybe_attach_fleet"]
+
+# opt-in fleet burn sharing: the shared store dir + this replica's name
+ENV_FLEET_DIR = "TRANSMOGRIFAI_SLO_FLEET_DIR"
+ENV_REPLICA = "TRANSMOGRIFAI_SLO_REPLICA"
+
+
+def maybe_attach_fleet(engine: "SLOEngine") -> bool:
+    """Attach `engine` to the fleet burn cell when the env opts in
+    (``TRANSMOGRIFAI_SLO_FLEET_DIR``). Replica identity comes from
+    ``TRANSMOGRIFAI_SLO_REPLICA``, falling back to the perf replica
+    name, falling back to the pid. Never raises."""
+    root = os.environ.get(ENV_FLEET_DIR)
+    if not root:
+        return False
+    replica = (os.environ.get(ENV_REPLICA)
+               or os.environ.get("TRANSMOGRIFAI_PERF_REPLICA")
+               or f"pid{os.getpid()}")
+    try:
+        engine.attach_fleet(root, replica)
+        return True
+    except Exception:
+        log.debug("slo: fleet attach failed", exc_info=True)
+        return False
 
 
 @dataclass
@@ -190,6 +215,7 @@ class _SLOState:
         self.last_change: Optional[float] = None
         self.fired_windows: List[str] = []
         self.alerts = 0
+        self.replicas = 0  # fleet fold only: replicas seen last tick
 
     def sample(self, now: float) -> None:
         good, total = self.source()
@@ -242,6 +268,12 @@ class SLOEngine:
         # parent here at start() — slo_alert events then land in the
         # run's trace timeline and its GoodputReport `slo` section
         self.span = None
+        # fleet burn sharing (attach_fleet): each replica publishes its
+        # cumulative good/total per SLO through a StateCell; everyone
+        # folds the cell's sum into a second, fleet-wide sample ring
+        self._fleet_cell = None        # guarded-by: self._lock
+        self._fleet_replica = ""       # guarded-by: self._lock
+        self._fleet_states: Dict[str, _SLOState] = {}  # engine thread only
         max_window = max((w.long_s for w in self.windows), default=60.0)
         self._max_window_s = max_window
         for slo in self.params.build_slos():
@@ -267,6 +299,64 @@ class SLOEngine:
                 slo, source, self._max_window_s,
                 self.params.eval_period_s)
 
+    def attach_fleet(self, store_root: str, replica: str,
+                     name: str = "default") -> "SLOEngine":
+        """Share burn state across replicas through a `StateCell` on the
+        shared store. Each `evaluate()` tick CAS-publishes this
+        replica's cumulative good/total per SLO, then folds the cell's
+        cross-replica sum into a fleet sample ring — `/slo` (`status()`)
+        reports fleet-wide burn beside the local one. Cumulative sums
+        mean a restarted replica's counter reset shows up as a no-delta
+        window (no data), not a phantom recovery."""
+        from transmogrifai_tpu.store.state import StateCell
+        with self._lock:
+            self._fleet_cell = StateCell(store_root, f"slo-fleet-{name}")
+            self._fleet_replica = str(replica)
+        return self
+
+    def _fleet_tick(self, states: List["_SLOState"], now: float) -> None:
+        """Publish local cumulative counters + fold the fleet sum.
+        Engine-thread only (called from evaluate())."""
+        with self._lock:
+            cell = self._fleet_cell
+            replica = self._fleet_replica
+        if cell is None:
+            return
+        mine = {st.slo.name: [st.samples[-1][1], st.samples[-1][2]]
+                for st in states if st.samples}
+
+        def put(cur):
+            cur = dict(cur or {})
+            reps = dict(cur.get("replicas") or {})
+            reps[replica] = {"slos": mine, "ts": time.time()}
+            cur["replicas"] = reps
+            return cur
+
+        try:
+            merged = cell.update(put)
+        except Exception:
+            log.debug("slo: fleet cell publish failed", exc_info=True)
+            return
+        reps = (merged or {}).get("replicas") or {}
+        for st in states:
+            good = total = 0.0
+            n = 0
+            for rep in reps.values():
+                row = (rep.get("slos") or {}).get(st.slo.name)
+                if row:
+                    good += float(row[0])
+                    total += float(row[1])
+                    n += 1
+            fst = self._fleet_states.get(st.slo.name)
+            if fst is None:
+                fst = self._fleet_states[st.slo.name] = _SLOState(
+                    st.slo, lambda: (0.0, 0.0), self._max_window_s,
+                    self.params.eval_period_s)
+            fst.samples.append((now, good, total))
+            if len(fst.samples) > fst.max_samples:
+                del fst.samples[:len(fst.samples) - fst.max_samples]
+            fst.replicas = n
+
     # -- evaluation ---------------------------------------------------------- #
 
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
@@ -283,6 +373,7 @@ class SLOEngine:
                           exc_info=True)
                 continue
             self._judge(st, now)
+        self._fleet_tick(states, now)
         return self.status(now=now)
 
     def _judge(self, st: _SLOState, now: float) -> None:
@@ -400,9 +491,26 @@ class SLOEngine:
                 "windows": burns,
                 "samples": len(st.samples),
             }
-        return {"slos": slos,
-                "windows": [w.to_json() for w in self.windows],
-                "eval_period_s": self.params.eval_period_s}
+            fst = self._fleet_states.get(st.slo.name)
+            if fst is not None:
+                fleet_burns = {}
+                for w in self.windows:
+                    rate = fst.window_rate(now, w.long_s)
+                    fleet_burns[f"{w.long_s:g}s"] = (
+                        None if rate is None
+                        else round(rate / budget, 4))
+                slos[st.slo.name]["fleet"] = {
+                    "replicas": fst.replicas,
+                    "burn": fleet_burns,
+                    "samples": len(fst.samples),
+                }
+        out = {"slos": slos,
+               "windows": [w.to_json() for w in self.windows],
+               "eval_period_s": self.params.eval_period_s}
+        with self._lock:
+            if self._fleet_cell is not None:
+                out["fleet_replica"] = self._fleet_replica
+        return out
 
     def firing(self) -> List[str]:
         with self._lock:

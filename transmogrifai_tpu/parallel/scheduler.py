@@ -66,7 +66,8 @@ from transmogrifai_tpu.parallel.sweep import (
     journal_prefill, run_sweep, static_signature)
 from transmogrifai_tpu.runtime.faults import SITE_WORKER_BLOCK, fault_point
 
-__all__ = ["SweepJob", "GridScheduler", "SchedulerReport", "WorkerStats"]
+__all__ = ["SweepJob", "GridScheduler", "HostScheduler", "SchedulerReport",
+           "WorkerStats"]
 
 log = logging.getLogger(__name__)
 
@@ -119,9 +120,11 @@ class SchedulerReport:
     utilization_frac: float = 0.0
     straggler: Optional[int] = None
     workers: List[WorkerStats] = field(default_factory=list)
+    # pod tier (HostScheduler runs only): host id + lease-table traffic
+    pod: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "n_workers": self.n_workers,
             "wall_s": round(self.wall_s, 6),
             "blocks": self.blocks,
@@ -134,6 +137,9 @@ class SchedulerReport:
                 "busy_s": round(w.busy_s, 6), "idle_s": round(w.idle_s, 6),
                 "retired": w.retired} for w in self.workers],
         }
+        if self.pod is not None:
+            out["pod"] = dict(self.pod)
+        return out
 
 
 class GridScheduler:
@@ -148,12 +154,16 @@ class GridScheduler:
     """
 
     def __init__(self, mesh=None, n_workers: Optional[int] = None,
-                 on_worker_death: str = "requeue"):
+                 on_worker_death: str = "requeue", pod=None):
         import jax
         if on_worker_death not in ("requeue", "abort"):
             raise ValueError(f"on_worker_death={on_worker_death!r}")
         self.mesh = mesh
         self.on_worker_death = on_worker_death
+        # pod tier: a parallel.pod.PodCoordinator makes this one HOST's
+        # scheduler in a multi-host sweep — workers CAS-acquire each
+        # block fleet-wide before running it (see HostScheduler)
+        self.pod = pod
         if mesh is not None:
             rows = np.asarray(mesh.devices)
             names = list(getattr(mesh, "axis_names", ()) or ())
@@ -183,6 +193,10 @@ class GridScheduler:
         self._inflight = 0
         self._abort_exc: Optional[BaseException] = None
         self._job_errors: Dict[int, Exception] = {}
+        # pod-mode plan identity: _Block id -> fleet block key, and back
+        self._block_keys: Dict[int, str] = {}
+        self._blocks_by_key: Dict[str, "_Block"] = {}
+        self._pod_finished = False  # guarded-by: self._cond
         self._placed: Dict[int, Tuple[Any, Any, Any, Any]] = {}
         self._place_lock = threading.Lock()
         # per-worker (1, data) sub-meshes, built once: _place tests this
@@ -282,30 +296,52 @@ class GridScheduler:
                 loads[k] += blk.pred_s or 0.0
         self._inflight = 0
         self._abort_exc = None
+        self._pod_finished = False  # guarded-by: self._cond (pre-start reset)
         self._placed = {}  # drop a previous run's pinned device buffers
         self.report = SchedulerReport(
             n_workers=self.n_workers, blocks=len(blocks),
             workers=[WorkerStats(worker=k) for k in range(self.n_workers)])
 
+        self._block_keys, self._blocks_by_key = {}, {}
+        if self.pod is not None:
+            from transmogrifai_tpu.parallel.pod import block_key
+            for ji, job in enumerate(jobs):
+                if job.journal is None:
+                    raise ValueError(
+                        "pod scheduling requires a journal per job: the "
+                        "shards are the cross-host completion log")
+            for blk in blocks:
+                bkey = block_key(blk.job, blk.key, blk.idxs)
+                self._block_keys[id(blk)] = bkey
+                self._blocks_by_key[bkey] = blk
+            # every host registers the same deterministic plan; first
+            # writer wins per key, so the table converges to the union
+            self.pod.register(sorted(self._blocks_by_key))
+            self.pod.start()
+
         t0 = time.perf_counter()
-        with TRACER.span("sweep:scheduler", category="scheduler",
-                         workers=self.n_workers, blocks=len(blocks),
-                         jobs=len(jobs)) as root:
-            worker_ctxs = [self._worker_ctx(k, ctx)
-                           for k in range(self.n_workers)]
-            threads = [
-                threading.Thread(
-                    target=self._worker_loop,
-                    args=(k, root, jobs, results, worker_ctxs[k],
-                          X, y, folds, evaluator),
-                    name=f"sweep-worker-{k}", daemon=True)
-                for k in range(self.n_workers)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            self.report.wall_s = time.perf_counter() - t0
-            self._rollup(root)
+        try:
+            with TRACER.span("sweep:scheduler", category="scheduler",
+                             workers=self.n_workers, blocks=len(blocks),
+                             jobs=len(jobs)) as root:
+                worker_ctxs = [self._worker_ctx(k, ctx)
+                               for k in range(self.n_workers)]
+                threads = [
+                    threading.Thread(
+                        target=self._worker_loop,
+                        args=(k, root, jobs, results, worker_ctxs[k],
+                              X, y, folds, evaluator),
+                        name=f"sweep-worker-{k}", daemon=True)
+                    for k in range(self.n_workers)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                self.report.wall_s = time.perf_counter() - t0
+                self._rollup(root)
+        finally:
+            if self.pod is not None:
+                self.pod.stop()
         if self._abort_exc is not None:
             raise self._abort_exc
         leftover = sum(len(q) for q in self._queues)
@@ -313,8 +349,48 @@ class GridScheduler:
             raise RuntimeError(
                 f"all {self.n_workers} sweep workers retired with "
                 f"{leftover} grid blocks unfinished")
+        if self.pod is not None:
+            self._pod_fill(jobs, results)
         return [self._job_errors.get(ji, results[ji])
                 for ji in range(len(jobs))]
+
+    def _pod_fill(self, jobs: Sequence[SweepJob], results) -> None:
+        """Fill the rows OTHER hosts computed: re-merge their journal
+        shards from the shared store (the cross-host completion log —
+        `complete()` is ordered after the records are durable, so a
+        done block's rows are readable by now) and prefill exactly like
+        a resume; the JSON float round trip keeps the winner
+        bit-identical to a single-host run. A family that failed
+        fleet-wide surfaces as that job's error, mirroring the local
+        family-drop policy."""
+        for ji, job in enumerate(jobs):
+            if hasattr(job.journal, "refresh"):
+                job.journal.refresh()
+            # "pod_merge", not "journal_resume": these blocks were run
+            # by OTHER hosts this run — fleet work, not resume savings
+            journal_prefill(job.journal, job.grids, results[ji],
+                            event="pod_merge")
+        snap = self.pod.snapshot()
+        for ji in range(len(jobs)):
+            if ji in self._job_errors:
+                continue
+            missing = [i for i, row in enumerate(results[ji])
+                       if row is None]
+            if not missing:
+                continue
+            failed = [b for key, b in snap.items()
+                      if b.get("state") == "failed"
+                      and key in self._blocks_by_key
+                      and self._blocks_by_key[key].job == ji]
+            if failed:
+                self._job_errors[ji] = RuntimeError(
+                    f"sweep family failed fleet-wide on host "
+                    f"{failed[0].get('owner')}: {failed[0].get('error')}")
+            else:
+                raise RuntimeError(
+                    f"pod sweep: job {ji} still missing {len(missing)} "
+                    "grid rows after the fleet drained (done block "
+                    "without journal records?)")
 
     def _plan(self, blocks: List[_Block], X, y, folds) -> List[_Block]:
         """Order (and, with a warm cost model, size) the grid blocks.
@@ -459,17 +535,66 @@ class GridScheduler:
 
     def _claims(self, k: int, stats: WorkerStats, lane):
         """Yield (block, stolen) claims for lane k until the schedule
-        drains, charging wait time to the lane's idle account."""
+        drains, charging wait time to the lane's idle account. In pod
+        mode a locally drained lane keeps polling the fleet lease table
+        (cross-host stealing) until every block is done fleet-wide."""
         while True:
             t_wait = time.perf_counter()
             claim = self._claim(k)
+            if claim is None and self.pod is not None \
+                    and not self._pod_over():
+                claim = self._pod_takeover(k)
             waited = time.perf_counter() - t_wait
             if waited > 0.002:
                 stats.idle_s += waited
                 lane.event("idle", waited_s=round(waited, 6))
             if claim is None:
+                if self.pod is not None and not self._pod_over():
+                    continue  # fleet still has live blocks: poll again
                 return
             yield claim
+
+    def _pod_over(self) -> bool:
+        with self._cond:
+            return self._pod_finished or self._abort_exc is not None
+
+    def _pod_takeover(self, k: int):
+        """One fleet poll round for a locally drained lane: claim a
+        pool or TTL-expired block (cross-host steal), flag the schedule
+        finished when every block is done fleet-wide, or sleep until
+        the earliest foreign lease can expire. Returns a (block,
+        stolen) claim or None (caller re-polls)."""
+        remaining, next_expiry = self.pod.pending()
+        if remaining == 0:
+            with self._cond:
+                self._pod_finished = True
+                self._cond.notify_all()
+            return None
+        key = self.pod.claim_any()
+        if key is not None:
+            blk = self._blocks_by_key.get(key)
+            if blk is None:
+                # a key from a DIVERGENT foreign plan (e.g. a different
+                # warm cost model split the blocks differently): not
+                # ours to run — hand it back to its planner's host
+                self.pod.foreign += 1
+                self.pod.release(key)
+            else:
+                with self._cond:
+                    if self._abort_exc is None:
+                        self._inflight += 1
+                        return blk, True
+                self.pod.release(key)
+                return None
+        # everything left is live-leased elsewhere (or foreign): sleep
+        # until the earliest lease could expire, woken early by a local
+        # requeue/abort notify — TTL-derived, never a blind poll
+        delay = 0.05 if next_expiry == float("inf") \
+            else min(max(next_expiry, 0.05), self.pod.ttl_s)
+        with self._cond:
+            if self._abort_exc is None and not self._queues[k]:
+                self._cond.wait(timeout=delay)
+        return None
 
     def _worker_loop(self, k: int, root, jobs, results, wctx,
                      X, y, folds, evaluator) -> None:
@@ -479,6 +604,23 @@ class GridScheduler:
                          devices=int(len(self._rows[k]))) as lane:
             for blk, stolen in self._claims(k, stats, lane):
                 job = jobs[blk.job]
+                bkey = self._block_keys.get(id(blk)) \
+                    if self.pod is not None else None
+                if bkey is not None:
+                    with self._cond:
+                        job_failed = blk.job in self._job_errors
+                    if job_failed:
+                        # our host already failed this family: propagate
+                        # instead of letting the block ping-pong
+                        self.pod.fail(bkey, "family failed on this host")
+                        self._complete()
+                        continue
+                    if not self.pod.try_acquire(bkey):
+                        # another host owns or finished it: drop the
+                        # block locally — its rows arrive at _pod_fill
+                        # via the merged journal shards
+                        self._complete()
+                        continue
                 if stolen:
                     stats.steals += 1
                     with self._cond:  # += from N lanes loses increments
@@ -518,6 +660,8 @@ class GridScheduler:
                               k, job.name or type(job.est).__name__,
                               exc_info=True)
                     self._fail_job(blk.job, e)
+                    if bkey is not None:
+                        self.pod.fail(bkey, f"{type(e).__name__}: {e}")
                     self._complete()
                     continue
                 except BaseException as e:
@@ -530,6 +674,11 @@ class GridScheduler:
                     for i, row in zip(blk.idxs, rows):
                         results[blk.job][i] = row
                 block_s = time.perf_counter() - t0
+                if bkey is not None:
+                    # ordered AFTER _run_block: the journal records are
+                    # durable, so done-in-the-lease-table implies
+                    # readable-by-any-host
+                    self.pod.complete(bkey)
                 # NOT residual-scored here: the lane's run_sweep already
                 # predicts and scores this same block with the same
                 # features inside _run_groups_resilient — a second note
@@ -544,7 +693,12 @@ class GridScheduler:
                    X, y, folds, evaluator):
         import jax
         grids = [job.grids[i] for i in blk.idxs]
-        journal = job.journal.shard(k) if job.journal is not None else None
+        journal = None
+        if job.journal is not None:
+            # pod mode: host-qualified shard ids so two hosts' lane-k
+            # workers never share a shard file on the shared store
+            tag = k if self.pod is None else f"{self.pod.host}_{k}"
+            journal = job.journal.shard(tag)
         Xk, yk = self._place(k, X, y)
         fn = job.run or run_sweep
         with jax.default_device(self._device(k)):
@@ -568,11 +722,49 @@ class GridScheduler:
                 obs_export.record_event(
                     "straggler", worker=worst,
                     busy_s=round(worst_busy, 6), median_s=round(med, 6))
+        extra: Dict[str, Any] = {}
+        if self.pod is not None:
+            rep.pod = {"host": self.pod.host, "ttl_s": self.pod.ttl_s,
+                       **self.pod.stats()}
+            extra = {"host": self.pod.host,
+                     "pod_takeovers": self.pod.takeovers,
+                     "pod_skips": self.pod.skips}
         obs_export.record_event(
             "mesh_utilization", workers=rep.n_workers,
             utilization_frac=round(rep.utilization_frac, 4),
             steals=rep.steals, requeues=rep.requeues,
             idle_s=round(sum(w.idle_s for w in rep.workers), 6),
-            blocks=rep.blocks, wall_s=round(rep.wall_s, 6))
+            blocks=rep.blocks, wall_s=round(rep.wall_s, 6), **extra)
         root.set(utilization_frac=round(rep.utilization_frac, 4),
                  steals=rep.steals)
+
+
+class HostScheduler(GridScheduler):
+    """One pod host's scheduler tier: the work-stealing `GridScheduler`
+    for the host's local lanes plus a `parallel.pod.PodCoordinator`
+    claiming every block from the shared lease table before running it.
+
+    K processes (one per host), each constructed over the SAME shared
+    `store_root` and `sweep_id` with a unique `host` id, cooperatively
+    drain one sweep: blocks distribute by claim-order racing, a drained
+    host steals pool/TTL-expired blocks, a killed host's in-flight
+    block is TTL-reclaimed by a survivor, and every host returns the
+    complete, bit-identical result matrix (its own rows plus the other
+    hosts' rows merged from the host-qualified journal shards).
+
+    Determinism note: hosts must compute the same plan — same jobs in
+    the same order, and a shared (or equally cold) perf corpus so warm-
+    model block splitting agrees. A divergent plan only costs the
+    dedupe (both hosts run overlapping blocks; the journal merge still
+    converges).
+    """
+
+    def __init__(self, store_root: str, host: str, sweep_id: str = "pod",
+                 mesh=None, n_workers: Optional[int] = None,
+                 on_worker_death: str = "requeue",
+                 lease_ttl_s: float = 30.0):
+        from transmogrifai_tpu.parallel.pod import PodCoordinator
+        super().__init__(mesh=mesh, n_workers=n_workers,
+                         on_worker_death=on_worker_death,
+                         pod=PodCoordinator(store_root, sweep_id, host,
+                                            ttl_s=lease_ttl_s))

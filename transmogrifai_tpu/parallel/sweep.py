@@ -100,12 +100,18 @@ class _BestTracker:
 
 
 def journal_prefill(journal, grids: List[Dict],
-                    metrics: List[Optional[List[float]]]) -> int:
+                    metrics: List[Optional[List[float]]],
+                    event: str = "journal_resume") -> int:
     """Fill journaled rows into `metrics`; returns how many were skipped.
     Journal floats round-trip JSON exactly, so a resumed sweep's metric
     matrix is bit-identical to an uninterrupted run's. The ONE resume-
-    skip implementation: the in-family path below and the distributed
-    scheduler's per-job resume both route through it."""
+    skip implementation: the in-family path below, the distributed
+    scheduler's per-job resume, and the pod scheduler's cross-host
+    merge all route through it. `event` names the timeline event: a
+    resume credits the journal with blocks it AVOIDED re-running
+    ("journal_resume" savings in the goodput report), while a pod
+    host merging shards for blocks other hosts ran THIS run records
+    "pod_merge" — fleet work, not savings."""
     if journal is None:
         return 0
     hits = 0
@@ -119,13 +125,21 @@ def journal_prefill(journal, grids: List[Dict],
             saved_s += journal.duration_of(g)
             hits += 1
     if hits:
-        log.info("sweep journal: resuming past %d/%d completed blocks",
-                 hits, len(grids))
-        # resume-skip savings into the unified timeline + event log: the
-        # goodput report credits the journal with the blocks it avoided
-        obs_export.record_event("journal_resume", blocks=hits,
-                                total=len(grids),
-                                saved_s=round(saved_s, 6))
+        if event == "journal_resume":
+            log.info("sweep journal: resuming past %d/%d completed blocks",
+                     hits, len(grids))
+            # resume-skip savings into the unified timeline + event log:
+            # the goodput report credits the journal with the blocks it
+            # avoided
+            obs_export.record_event("journal_resume", blocks=hits,
+                                    total=len(grids),
+                                    saved_s=round(saved_s, 6))
+        else:
+            log.info("sweep journal: merged %d/%d foreign blocks (%s)",
+                     hits, len(grids), event)
+            obs_export.record_event(event, blocks=hits,
+                                    total=len(grids),
+                                    foreign_s=round(saved_s, 6))
     return hits
 
 

@@ -23,25 +23,26 @@ MAPE per target and the measured packing improvement.
 """
 
 from transmogrifai_tpu.perf.corpus import (
-    CostCorpus, get_corpus, harvest_journal, note, note_parse,
-    note_serving)
+    CostCorpus, device_generation, get_corpus, harvest_journal, note,
+    note_parse, note_serving)
 from transmogrifai_tpu.perf.features import (
     block_features, hbm_proxy_bytes, ingest_features, parse_features,
     serving_features)
 from transmogrifai_tpu.perf.model import (
     CostModel, Prediction, choose_upload_plan, fit_corpus, get_model,
-    holdout_mape, predict_block_seconds, predict_sweep_seconds, refresh,
-    set_model)
+    holdout_mape, observe, predict_block_seconds, predict_sweep_seconds,
+    refresh, set_model)
 from transmogrifai_tpu.perf.params import (
     PerfModelParams, enabled, get_params, hbm_budget_bytes, params_scope,
     resolved_corpus_dir, set_params, target_block_s)
 
 __all__ = [
     "CostCorpus", "CostModel", "PerfModelParams", "Prediction",
-    "block_features", "choose_upload_plan", "enabled", "fit_corpus",
+    "block_features", "choose_upload_plan", "device_generation",
+    "enabled", "fit_corpus",
     "get_corpus", "get_model", "get_params", "harvest_journal",
     "hbm_budget_bytes", "hbm_proxy_bytes", "holdout_mape",
-    "ingest_features", "note", "note_parse", "note_serving",
+    "ingest_features", "note", "note_parse", "note_serving", "observe",
     "params_scope", "parse_features", "predict_block_seconds",
     "predict_sweep_seconds", "resolved_corpus_dir", "refresh",
     "serving_features", "set_model", "set_params", "target_block_s",

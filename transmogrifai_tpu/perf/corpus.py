@@ -41,7 +41,8 @@ from typing import Any, Dict, Iterable, List, Optional
 from transmogrifai_tpu.perf import params as perf_params
 
 __all__ = ["CostCorpus", "get_corpus", "note", "note_serving",
-           "note_parse", "harvest_journal", "CORPUS_FILE"]
+           "note_parse", "harvest_journal", "device_generation",
+           "CORPUS_FILE"]
 
 log = logging.getLogger(__name__)
 
@@ -53,9 +54,44 @@ CORPUS_FILE = "corpus.jsonl"
 # one file; readers merge every shard
 ENV_REPLICA = "TRANSMOGRIFAI_PERF_REPLICA"
 
+# device-generation namespace override (tests / heterogeneous-pod ops);
+# default is derived from the local accelerator's device_kind
+ENV_DEVGEN = "TRANSMOGRIFAI_PERF_DEVGEN"
+
 # targets the model learns; anything else is ignored at fit time
 TARGETS = ("block_runtime", "hbm", "ingest", "serving_bucket",
            "serving_parse")
+
+_DEVGEN_LOCK = threading.Lock()
+_DEVGEN: Optional[str] = None  # guarded-by: _DEVGEN_LOCK
+
+
+def device_generation() -> str:
+    """The accelerator generation this process measures on, as a slug
+    (``cpu``, ``tpu_v4``, ...). A fleet corpus on shared storage mixes
+    hosts of different generations; rows are stamped with this so each
+    host fits only the timings its own hardware produced — a v4 block
+    time is training noise to a v5 scheduler. Env-overridable; falls
+    back to ``unknown`` before the backend is importable."""
+    global _DEVGEN
+    with _DEVGEN_LOCK:
+        if _DEVGEN is not None:
+            return _DEVGEN
+    env = os.environ.get(ENV_DEVGEN)
+    if env:
+        gen = env
+    else:
+        try:
+            import jax
+            import re as _re
+            kind = jax.devices()[0].device_kind
+            gen = _re.sub(r"[^a-z0-9]+", "_", str(kind).lower()).strip("_") \
+                or "unknown"
+        except Exception:
+            return "unknown"  # backend not up yet: do NOT cache
+    with _DEVGEN_LOCK:
+        _DEVGEN = gen
+    return gen
 
 
 class CostCorpus:
@@ -72,6 +108,8 @@ class CostCorpus:
         self.path = os.path.join(dir_path, name)
         self._lock = threading.Lock()
         self._appended = 0  # rows this process added (fit invalidation)
+        self._appended_bytes = 0  # bytes of those rows (foreign-delta calc)
+        self._seq = 0  # per-process append sequence (merge tie-break)
 
     def _shard_paths(self) -> List[str]:
         """Every corpus shard in the directory, own shard included —
@@ -95,14 +133,22 @@ class CostCorpus:
             "features": {k: float(v) for k, v in features.items()},
             "value": float(value),
             "ts": int(time.time()),
+            # merge identity: (ts, replica, seq) totally orders the
+            # fleet-merged view — ts alone ties constantly at int-second
+            # resolution across K replica shards
+            "replica": self.replica or "",
+            # device-generation namespace: fits filter on this
+            "devgen": device_generation(),
         }
         if predicted is not None:
             rec["predicted"] = float(predicted)
         if extra:
             rec.update(extra)
         try:
-            line = json.dumps(rec)
             with self._lock:
+                rec["seq"] = self._seq
+                self._seq += 1
+                line = json.dumps(rec)
                 # the corpus IS an append-only log: the lock exists to
                 # serialize the disk appends (torn-tail repair + write
                 # must be atomic per row), so I/O under it is the design
@@ -122,21 +168,36 @@ class CostCorpus:
                     fh.write(line.encode("utf-8") + b"\n")
                     fh.flush()
                 self._appended += 1
+                self._appended_bytes += len(line) + 1
             return True
         except (OSError, ValueError, TypeError):
             log.debug("perf corpus append failed", exc_info=True)
             return False
 
     def rows(self, target: Optional[str] = None,
-             max_rows: int = 200_000) -> List[Dict[str, Any]]:
+             max_rows: int = 200_000,
+             devgen: Optional[str] = None) -> List[Dict[str, Any]]:
         """Parsed corpus rows (newest-last), skipping torn/garbage lines.
         `max_rows` keeps a years-old corpus from ballooning fit time —
-        the NEWEST rows are kept (they reflect the current hardware)."""
-        out: List[Dict[str, Any]] = []
+        the NEWEST rows are kept (they reflect the current hardware).
+        `devgen` filters to one device-generation namespace (rows
+        without a stamp — pre-namespacing corpora — are kept, they came
+        from the same machine as today's unsharded readers).
+
+        The merge is totally ordered by (ts, replica, seq): replica
+        shards on a fleet store carry identical int-second `ts` values
+        constantly, and a ts-only sort leaves same-second interleaving
+        to incidental shard listing order — the max_rows trim would
+        then drop one replica's rows wholesale and dedupe keys (e.g.
+        harvest block_keys) could vanish from the kept window. Rows
+        predating the stamps tie-break on (shard name, line number),
+        which is the same order the old stable sort preserved."""
+        keyed: List[tuple] = []
         for path in self._shard_paths():
+            shard_name = os.path.basename(path)
             try:
                 with open(path, encoding="utf-8", errors="replace") as fh:
-                    for line in fh:
+                    for lineno, line in enumerate(fh):
                         line = line.strip()
                         if not line:
                             continue
@@ -149,27 +210,38 @@ class CostCorpus:
                         if target is not None and \
                                 rec.get("target") != target:
                             continue
+                        if devgen is not None and \
+                                rec.get("devgen") not in (None, devgen):
+                            continue
                         if isinstance(rec.get("features"), dict) and \
                                 isinstance(rec.get("value"), (int, float)):
-                            out.append(rec)
+                            ts = rec.get("ts", 0)
+                            if not isinstance(ts, (int, float)):
+                                ts = 0
+                            replica = rec.get("replica")
+                            if not isinstance(replica, str):
+                                replica = shard_name
+                            seq = rec.get("seq")
+                            if not isinstance(seq, int):
+                                seq = lineno
+                            keyed.append((ts, replica, seq, len(keyed),
+                                          rec))
             except OSError:
                 continue
-        # shards interleave in wall time: order the merged view by
-        # timestamp (stable, so same-second rows keep shard order)
-        # before trimming to the NEWEST max_rows
-        out.sort(key=lambda r: r.get("ts", 0))
-        return out[-max_rows:]
+        keyed.sort(key=lambda t: t[:4])
+        return [t[4] for t in keyed[-max_rows:]]
 
     def version(self) -> tuple:
         """Cheap change token for fit caching: (total shard bytes, rows
-        appended by this process)."""
+        appended by this process, bytes this process appended — the
+        foreign-growth delta is total minus own)."""
         size = 0
         for path in self._shard_paths():
             try:
                 size += os.path.getsize(path)
             except OSError:
                 pass
-        return (self.path, size, self._appended)
+        return (self.path, size, self._appended, self._appended_bytes)
 
     def __len__(self) -> int:
         return len(self.rows())
@@ -211,6 +283,12 @@ def note(target: str, features: Dict[str, float], predicted,
             if corpus is not None:
                 corpus.append(target, features, measured,
                               predicted=pred_v, **extra)
+                # online per-decision Bayesian update: the process
+                # model absorbs this measurement NOW (sufficient-
+                # statistics update, perf/model.py) instead of waiting
+                # for a periodic batch refit
+                from transmogrifai_tpu.perf.model import observe
+                observe(target, features, measured)
         if pred_v is not None and measured > 0:
             err = abs(pred_v - measured) / max(abs(measured), 1e-9)
             from transmogrifai_tpu.obs.metrics import get_registry

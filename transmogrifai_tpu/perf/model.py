@@ -21,6 +21,17 @@ Cold-start contract: a target with fewer than `min_rows` training rows
 predicts **None**, and every consumer falls back to today's heuristics
 bit-for-bit (regression-tested per call site). A fitted model
 save/loads as JSON so a saved workflow ships with its predictor.
+
+Fleet behaviour (pod-scale sweeps): every training row is stamped with
+its **device generation** (`corpus.device_generation`) and fits filter
+to the local generation — a shared corpus on pod storage can mix v4 and
+v5 hosts without cross-training. The lazily fitted process model is
+updated **online, per decision**: the ridge fit is exactly Bayesian
+linear regression's posterior mean under a Gaussian prior, so each
+`corpus.note` appends one row to the running sufficient statistics
+(A ← A + φφᵀ, b ← b + φ·z) and re-solves w = A⁻¹b in O(k²) — no
+periodic ~512-row refit cadence; batch refits remain only for FOREIGN
+shard growth (another host writing the shared corpus).
 """
 
 from __future__ import annotations
@@ -30,17 +41,19 @@ import logging
 import math
 import os
 import threading
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from transmogrifai_tpu.perf import params as perf_params
-from transmogrifai_tpu.perf.corpus import CostCorpus, get_corpus
+from transmogrifai_tpu.perf.corpus import (
+    CostCorpus, device_generation, get_corpus)
 from transmogrifai_tpu.perf.features import block_features, ingest_features
 
 __all__ = ["Prediction", "CostModel", "fit_corpus", "get_model",
-           "set_model", "refresh", "choose_upload_plan",
+           "set_model", "refresh", "observe", "choose_upload_plan",
            "predict_block_seconds", "predict_sweep_seconds",
            "holdout_mape"]
 
@@ -48,9 +61,10 @@ log = logging.getLogger(__name__)
 
 _EPS = 1e-6
 _RIDGE = 1e-3
-# refit cadence for the lazily-fitted process model: enough new rows to
-# move the fit, cheap enough to never matter on the critical path
-_REFIT_ROWS = 512
+# residual window for the online error bands: the newest prediction
+# errors define lo/hi, so the bands track hardware drift instead of
+# averaging over the corpus's whole history
+_RESID_WINDOW = 256
 
 
 @dataclass
@@ -69,14 +83,25 @@ class Prediction:
 
 
 class _TargetFit:
-    """One target's fitted log-linear ridge."""
+    """One target's fitted log-linear ridge, optionally carrying the
+    running sufficient statistics (A = ΦᵀΦ + λI, b = Φᵀz) that make it
+    an online Bayesian posterior: `observe` folds one decision's
+    measurement in and re-solves the posterior mean. JSON-loaded fits
+    have no statistics and stay frozen."""
 
     def __init__(self, names: List[str], w: Sequence[float],
-                 resid_q: Sequence[float], n: int):
+                 resid_q: Sequence[float], n: int,
+                 A: Optional[np.ndarray] = None,
+                 b: Optional[np.ndarray] = None,
+                 resid: Optional[Sequence[float]] = None):
         self.names = list(names)
         self.w = np.asarray(w, np.float64)
         self.resid_q = [float(q) for q in resid_q]  # [q10, q50, q90]
         self.n = int(n)
+        self.A = None if A is None else np.asarray(A, np.float64)
+        self.b = None if b is None else np.asarray(b, np.float64)
+        self._resid: deque = deque((float(r) for r in (resid or [])),
+                                   maxlen=_RESID_WINDOW)
 
     def phi(self, feats: Dict[str, float]) -> np.ndarray:
         row = [1.0] + [math.log1p(max(float(feats.get(nm, 0.0)), 0.0))
@@ -89,6 +114,44 @@ class _TargetFit:
         return Prediction(value=math.exp(z + q50), lo=math.exp(z + q10),
                           hi=math.exp(z + q90), n=self.n)
 
+    def observe(self, feats: Dict[str, float], value: float) -> None:
+        """One per-decision Bayesian update: record this prediction's
+        residual (computed BEFORE the update — an honest error sample),
+        add φφᵀ/φz to the running statistics, re-solve the posterior
+        mean, and refresh the residual-quantile bands. O(k²) in the
+        feature count — microseconds for these targets."""
+        if self.A is None or value <= 0.0:
+            return
+        new = sorted(set(feats) - set(self.names))
+        if new:
+            # a feature this fit never saw (new family one-hot): expand
+            # the statistics with the ridge prior on the new dimensions
+            k_old = len(self.w)
+            self.names.extend(new)
+            k = 1 + len(self.names)
+            A = np.eye(k, dtype=np.float64) * _RIDGE
+            A[:k_old, :k_old] = self.A
+            b = np.zeros(k, dtype=np.float64)
+            b[:k_old] = self.b
+            w = np.zeros(k, dtype=np.float64)
+            w[:k_old] = self.w
+            self.A, self.b, self.w = A, b, w
+        phi = self.phi(feats)
+        z = math.log(max(float(value), _EPS))
+        if self.n > 0:
+            self._resid.append(z - float(phi @ self.w))
+        self.A = self.A + np.outer(phi, phi)
+        self.b = self.b + phi * z
+        try:
+            self.w = np.linalg.solve(self.A, self.b)
+        except np.linalg.LinAlgError:
+            self.w = np.linalg.lstsq(self.A, self.b, rcond=None)[0]
+        self.n += 1
+        if len(self._resid) > 1:
+            q10, q50, q90 = np.quantile(
+                np.asarray(self._resid), (0.1, 0.5, 0.9))
+            self.resid_q = [float(q10), float(q50), float(q90)]
+
     def to_json(self) -> Dict[str, Any]:
         return {"names": self.names, "w": [float(x) for x in self.w],
                 "resid_q": self.resid_q, "n": self.n}
@@ -99,27 +162,52 @@ class _TargetFit:
 
 
 class CostModel:
-    """Per-target predictors + the cold-start floor."""
+    """Per-target predictors + the cold-start floor. `devgen` names the
+    device-generation namespace the fits were trained in (None =
+    unspecified, e.g. a hand-built test model)."""
 
-    def __init__(self, min_rows: Optional[int] = None):
+    def __init__(self, min_rows: Optional[int] = None,
+                 devgen: Optional[str] = None):
         self.targets: Dict[str, _TargetFit] = {}
         self.min_rows = int(min_rows if min_rows is not None
                             else perf_params.get_params().min_rows)
+        self.devgen = devgen
+        # online observes land from every consumer thread (scheduler
+        # lanes, serving threads); the fit objects mutate in place, so
+        # reads and updates share one lock — both are microseconds
+        self._lock = threading.Lock()
 
     def predict(self, target: str,
                 feats: Dict[str, float]) -> Optional[Prediction]:
         """Point estimate + error band, or None when this target is
         cold (unfitted, or fitted on fewer than `min_rows` rows) — the
         caller then uses today's heuristic unchanged."""
-        fit = self.targets.get(target)
-        if fit is None or fit.n < self.min_rows:
-            return None
-        try:
-            return fit.predict(feats)
-        except Exception:
-            log.debug("cost model predict failed for %s", target,
-                      exc_info=True)
-            return None
+        with self._lock:
+            fit = self.targets.get(target)
+            if fit is None or fit.n < self.min_rows:
+                return None
+            try:
+                return fit.predict(feats)
+            except Exception:
+                log.debug("cost model predict failed for %s", target,
+                          exc_info=True)
+                return None
+
+    def observe(self, target: str, feats: Dict[str, float],
+                value: float) -> None:
+        """Fold one measured decision into `target`'s posterior. An
+        unseen target starts from the bare ridge prior and stays cold
+        (predict → None) until `min_rows` observations accumulate."""
+        with self._lock:
+            fit = self.targets.get(target)
+            if fit is None:
+                names = sorted(feats)
+                k = 1 + len(names)
+                fit = _TargetFit(names, np.zeros(k), [0.0, 0.0, 0.0], 0,
+                                 A=np.eye(k, dtype=np.float64) * _RIDGE,
+                                 b=np.zeros(k, dtype=np.float64))
+                self.targets[target] = fit
+            fit.observe(feats, value)
 
     def fit_target(self, target: str,
                    rows: List[Dict[str, Any]], ridge: float = _RIDGE) -> None:
@@ -146,18 +234,28 @@ class CostModel:
         resid = z - phi @ w
         q10, q50, q90 = (np.quantile(resid, (0.1, 0.5, 0.9))
                          if len(resid) > 1 else (0.0, 0.0, 0.0))
-        self.targets[target] = _TargetFit(names, w, [q10, q50, q90],
-                                          len(rows))
+        fit = _TargetFit(
+            names, w, [q10, q50, q90], len(rows),
+            # seed the online posterior with the batch's sufficient
+            # statistics so subsequent observes CONTINUE this fit
+            A=phi.T @ phi + ridge * np.eye(k, dtype=np.float64),
+            b=phi.T @ z, resid=resid[-_RESID_WINDOW:].tolist())
+        with self._lock:
+            self.targets[target] = fit
 
     # -- persistence ------------------------------------------------------- #
 
     def to_json(self) -> Dict[str, Any]:
-        return {"cost_model": 1, "min_rows": self.min_rows,
-                "targets": {t: f.to_json() for t, f in self.targets.items()}}
+        out: Dict[str, Any] = {
+            "cost_model": 1, "min_rows": self.min_rows,
+            "targets": {t: f.to_json() for t, f in self.targets.items()}}
+        if self.devgen is not None:
+            out["devgen"] = self.devgen
+        return out
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "CostModel":
-        m = CostModel(min_rows=d.get("min_rows"))
+        m = CostModel(min_rows=d.get("min_rows"), devgen=d.get("devgen"))
         for t, fd in (d.get("targets") or {}).items():
             m.targets[t] = _TargetFit.from_json(fd)
         return m
@@ -176,13 +274,16 @@ class CostModel:
 
 def fit_corpus(corpus: CostCorpus,
                min_rows: Optional[int] = None) -> CostModel:
-    """Fit every known target from the corpus. An empty corpus yields a
-    model with no fitted targets — every predict() is None, every
-    consumer cold."""
+    """Fit every known target from the corpus, restricted to this
+    host's device-generation namespace (rows another generation's host
+    wrote into a shared fleet corpus are someone else's physics). An
+    empty corpus yields a model with no fitted targets — every
+    predict() is None, every consumer cold."""
     from transmogrifai_tpu.perf.corpus import TARGETS
-    model = CostModel(min_rows=min_rows)
+    gen = device_generation()
+    model = CostModel(min_rows=min_rows, devgen=gen)
     for target in TARGETS:
-        rows = corpus.rows(target)
+        rows = corpus.rows(target, devgen=gen)
         if rows:
             try:
                 model.fit_target(target, rows)
@@ -207,7 +308,7 @@ _FOREIGN_BYTES = 1 << 20
 def get_model() -> Optional[CostModel]:
     """The process's active cost model, or None when disabled. Lazily
     fitted from the active corpus and refitted when the corpus version
-    moves enough (~_REFIT_ROWS rows appended by this process, or ≥1 MB
+    moves enough (≥1 MB
     written by another), or loaded once from
     `PerfModelParams.model_path` when a fitted model ships with the
     workflow. A load FAILURE is cached too: an unreadable model_path
@@ -250,15 +351,16 @@ def get_model() -> Optional[CostModel]:
         stale = (_MODEL is None or _MODEL_KEY != key
                  or _MODEL_VERSION is None)
         if not stale:
-            appended_delta = version[2] - _MODEL_VERSION[2]
             size_delta = abs(version[1] - _MODEL_VERSION[1])
-            # size trigger is NOT gated on appended_delta == 0: our own
-            # sub-_REFIT_ROWS appends are far under _FOREIGN_BYTES, so
-            # a >=1MB growth means another process wrote the bulk of it
-            # (a serving process recording a few sampled rows must not
-            # mask a concurrent training run's corpus)
-            stale = (appended_delta >= _REFIT_ROWS
-                     or size_delta >= _FOREIGN_BYTES)
+            own_bytes = (version[3] - _MODEL_VERSION[3]
+                         if len(version) > 3 and len(_MODEL_VERSION) > 3
+                         else 0)
+            # our OWN appends are absorbed online, per decision
+            # (observe() below) — only FOREIGN shard growth (another
+            # host/replica writing the shared fleet corpus) warrants a
+            # batch refit; the old ~512-row own-append refit cadence is
+            # gone
+            stale = (size_delta - max(own_bytes, 0)) >= _FOREIGN_BYTES
         if stale:
             _MODEL = fit_corpus(corpus)
             _MODEL_KEY = key
@@ -280,6 +382,26 @@ def refresh() -> Optional[CostModel]:
     """Drop the cached model and refit from the current corpus."""
     set_model(None)
     return get_model()
+
+
+def observe(target: str, features: Dict[str, float], value: float) -> None:
+    """Per-decision online update of the lazily fitted process model
+    (`corpus.note` calls this after appending the training row).
+    Explicit (`set_model`) and `model_path`-loaded models are pinned —
+    they stay exactly what was installed/shipped. A not-yet-fitted
+    model is left alone too: the next `get_model()` batch fit reads
+    this row from the corpus anyway. Never raises."""
+    if not perf_params.enabled():
+        return
+    with _MODEL_LOCK:
+        model, key = _MODEL, _MODEL_KEY
+    if model is None or not key or key[0] != "corpus":
+        return
+    try:
+        model.observe(target, features, float(value))
+    except Exception:
+        log.debug("online cost-model update failed for %s", target,
+                  exc_info=True)
 
 
 # -- consumer helpers -------------------------------------------------------- #
@@ -379,7 +501,7 @@ def holdout_mape(corpus: CostCorpus, target: str,
     """Mean absolute percentage error on a random holdout split of one
     target's corpus rows — the continuous scorecard `bench.py costmodel`
     reports. None when the target has too few rows to split."""
-    rows = corpus.rows(target)
+    rows = corpus.rows(target, devgen=device_generation())
     if len(rows) < 10:
         return None
     rng = np.random.default_rng(seed)

@@ -45,6 +45,32 @@ log = logging.getLogger(__name__)
 
 _FORMAT_VERSION = 1
 
+# Parsed-shard cache keyed by absolute path -> ((size, mtime_ns), state).
+# Cross-host resume over shared storage re-opens every shard on each
+# refresh/restart; an unchanged shard (same size + mtime) must not be
+# re-read and re-json-parsed — on NFS-ish pod stores that is the
+# difference between an O(changed) and an O(all shards) resume. Entries
+# hold the immutable parse result; instances copy the dict skins so one
+# journal's post-load appends never leak into another's view.
+_PARSE_CACHE: Dict[str, tuple] = {}  # guarded-by: _PARSE_CACHE_LOCK
+_PARSE_CACHE_LOCK = threading.Lock()
+_PARSE_CACHE_MAX = 256
+
+
+def _parse_cache_get(path: str, stat_key: tuple):
+    with _PARSE_CACHE_LOCK:
+        hit = _PARSE_CACHE.get(path)
+        if hit is not None and hit[0] == stat_key:
+            return hit[1]
+    return None
+
+
+def _parse_cache_put(path: str, stat_key: tuple, state: tuple) -> None:
+    with _PARSE_CACHE_LOCK:
+        if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX and path not in _PARSE_CACHE:
+            _PARSE_CACHE.pop(next(iter(_PARSE_CACHE)))
+        _PARSE_CACHE[path] = (stat_key, state)
+
 
 class SweepJournal:
     """Append-only per-family journal. Thread-safe (block completions
@@ -78,12 +104,35 @@ class SweepJournal:
     def _load(self) -> None:
         if not os.path.exists(self.path):
             return
+        apath = os.path.abspath(self.path)
         try:
             with open(self.path, "rb") as fh:
-                raw = fh.read()
+                st = os.fstat(fh.fileno())
+                stat_key = (st.st_ino, st.st_size, st.st_mtime_ns)
+                cached = _parse_cache_get(apath, stat_key)
+                raw = b"" if cached is not None else fh.read()
         except OSError:
             log.warning("sweep journal %s unreadable; starting fresh",
                         self.path, exc_info=True)
+            return
+        if cached is not None:
+            header_meta, c_rows, c_durations, c_grids, c_facts = cached
+            if header_meta != self.meta:
+                stale = self.path + ".stale"
+                try:
+                    os.replace(self.path, stale)
+                except OSError:
+                    pass
+                log.warning("sweep journal %s: header mismatch; rotated "
+                            "to %s and starting fresh", self.path, stale)
+                return
+            # dict skins are per-instance (appends add keys); the row
+            # lists and grid dicts inside are never mutated in place
+            self._rows = dict(c_rows)
+            self._durations = dict(c_durations)
+            self._grids = dict(c_grids)
+            self._facts = dict(c_facts)
+            self._header_written = True
             return
         rows: Dict[str, List[float]] = {}
         durations: Dict[str, float] = {}
@@ -170,6 +219,12 @@ class SweepJournal:
         # only a validated header makes appends skip re-writing it — an
         # empty or header-torn file must get a fresh header first
         self._header_written = header_ok
+        if header_ok and valid_bytes == len(raw):
+            # clean, fully parsed file: the next reader of these exact
+            # bytes (cross-host refresh, resume restart) skips the parse
+            _parse_cache_put(apath, stat_key,
+                             (dict(self.meta), dict(rows), dict(durations),
+                              dict(grids), dict(facts)))
 
     def lookup(self, grid: Dict[str, Any]) -> Optional[List[float]]:
         with self._lock:
@@ -276,7 +331,15 @@ class SweepJournal:
 # multi-writer sharding                                                       #
 # --------------------------------------------------------------------------- #
 
-_SHARD_RE = re.compile(r"-w(\d+)\.jsonl$")
+# shard tokens: plain ints for single-host workers (`-w3.jsonl`), and
+# host-qualified names for pod runs (`-wh0_3.jsonl` = host h0, lane 3)
+# so two hosts' lane-3 workers never share a shard file on the shared
+# store. Digit-only tokens stay int keys for legacy shard discovery.
+_SHARD_RE = re.compile(r"-w([A-Za-z0-9_]+)\.jsonl$")
+
+
+def _shard_key(token: str):
+    return int(token) if token.isdigit() else token
 
 
 class _ShardWriter:
@@ -334,7 +397,8 @@ class ShardedSweepJournal:
         self.meta = dict(meta or {})
         self.fsync = fsync
         self._lock = threading.Lock()
-        self._shards: Dict[int, SweepJournal] = {}
+        self._shards: Dict[Any, SweepJournal] = {}  # guarded-by: self._lock
+        self._owned: set = set()  # keys we hand writers for  # guarded-by: self._lock
         # glob.escape: a checkpoint dir containing [, ?, or * must not
         # turn shard discovery into a character-class match that finds
         # nothing (which would silently re-run every journaled block)
@@ -343,7 +407,7 @@ class ShardedSweepJournal:
             m = _SHARD_RE.search(path)
             if m is None:
                 continue
-            k = int(m.group(1))
+            k = _shard_key(m.group(1))
             # load (and torn-tail-repair) every existing shard up front:
             # resume must see the union before any block is scheduled
             self._shards[k] = SweepJournal(path, meta=self.meta,
@@ -358,15 +422,62 @@ class ShardedSweepJournal:
     def _shard_path(self, k) -> str:
         return f"{self.base_path}-w{k}.jsonl"
 
-    def shard(self, k: int) -> _ShardWriter:
-        """Worker k's writer view (merged reads, own-file appends)."""
+    def shard(self, k) -> _ShardWriter:
+        """Worker k's writer view (merged reads, own-file appends). `k`
+        is an int lane index on a single host, or a host-qualified
+        string like ``h0_3`` in a pod run."""
+        if not isinstance(k, int) and not re.fullmatch(r"[A-Za-z0-9_]+",
+                                                       str(k)):
+            raise ValueError(f"illegal journal shard id: {k!r}")
         with self._lock:
             sj = self._shards.get(k)
             if sj is None:
                 sj = SweepJournal(self._shard_path(k), meta=self.meta,
                                   fsync=self.fsync)
                 self._shards[k] = sj
+            self._owned.add(k)
         return _ShardWriter(self, sj)
+
+    def refresh(self) -> int:
+        """Re-merge foreign shards from disk: discover shards that
+        appeared since construction and reload existing non-owned ones
+        whose bytes changed (the per-path parse cache makes unchanged
+        shards a stat call). Shards this process writes (`shard()` was
+        called) are authoritative in memory and never reloaded. Returns
+        the number of shards (re)loaded — the cross-host completion-log
+        merge a pod host runs before filling other hosts' results."""
+        loaded = 0
+        with self._lock:
+            known = dict(self._shards)
+            owned = set(self._owned)
+        fresh: Dict[Any, SweepJournal] = {}
+        for path in sorted(_glob.glob(
+                _glob.escape(self.base_path) + "-w*.jsonl")):
+            m = _SHARD_RE.search(path)
+            if m is None:
+                continue
+            k = _shard_key(m.group(1))
+            if k in owned:
+                continue
+            prior = known.get(k)
+            if prior is not None:
+                try:
+                    st = os.stat(path)
+                    a_hit = _parse_cache_get(
+                        os.path.abspath(path),
+                        (st.st_ino, st.st_size, st.st_mtime_ns))
+                except OSError:
+                    a_hit = None
+                if a_hit is not None and len(a_hit[1]) == len(prior):
+                    continue  # unchanged since our load: keep it
+            fresh[k] = SweepJournal(path, meta=self.meta, fsync=self.fsync)
+            loaded += 1
+        if fresh:
+            with self._lock:
+                for k, sj in fresh.items():
+                    if k not in self._owned:
+                        self._shards[k] = sj
+        return loaded
 
     def shard_paths(self) -> List[str]:
         with self._lock:

@@ -274,7 +274,29 @@ class ModelSelector(Estimator):
                 run=self._block_runner(type(est).__name__)))
             meta.append((mi, ckpt))
         if jobs:
-            sched = GridScheduler(mesh=ctx.mesh)
+            import os as _os
+            pod_store = _os.environ.get("TRANSMOGRIFAI_POD_STORE")
+            if pod_store:
+                # pod tier (parallel/pod.py): this process is ONE HOST of
+                # a multi-host sweep — every host env-points at the same
+                # store dir + sweep id and races block claims through the
+                # shared lease table. Requires journals (checkpoint_dir),
+                # which double as the cross-host completion log.
+                from transmogrifai_tpu.parallel.scheduler import (
+                    HostScheduler)
+                workers = _os.environ.get("TRANSMOGRIFAI_POD_WORKERS")
+                sched = HostScheduler(
+                    pod_store,
+                    _os.environ.get("TRANSMOGRIFAI_POD_HOST",
+                                    f"h{_os.getpid()}"),
+                    sweep_id=_os.environ.get(
+                        "TRANSMOGRIFAI_POD_SWEEP", "pod"),
+                    mesh=ctx.mesh,
+                    n_workers=int(workers) if workers else None,
+                    lease_ttl_s=float(_os.environ.get(
+                        "TRANSMOGRIFAI_POD_TTL_S", "30") or 30))
+            else:
+                sched = GridScheduler(mesh=ctx.mesh)
             for (mi, ckpt), out in zip(meta, sched.run(
                     jobs, X, y_dev, folds, self.evaluator, ctx)):
                 outcomes[mi] = out

@@ -528,6 +528,8 @@ class FleetService:
                 engine.set_source(slo.name, staleness_source(
                     get_registry(), "continual_staleness_current_seconds",
                     slo.threshold_s))
+        from transmogrifai_tpu.obs.slo import maybe_attach_fleet
+        maybe_attach_fleet(engine)
         self.slo_engine = engine
 
     # -- membership -------------------------------------------------------- #

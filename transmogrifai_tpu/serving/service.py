@@ -463,6 +463,8 @@ class ScoringService:
                 engine.set_source(slo.name, staleness_source(
                     get_registry(), "continual_staleness_current_seconds",
                     slo.threshold_s))
+        from transmogrifai_tpu.obs.slo import maybe_attach_fleet
+        maybe_attach_fleet(engine)
         self.slo_engine = engine
 
     # the closed phase-label set (span names are `serving:<phase>`);

@@ -15,7 +15,8 @@ from transmogrifai_tpu.store.artifact import (
     LocalDirBackend, StoreCorruptError)
 from transmogrifai_tpu.store.config import (
     ENV_STORE, cache_root, resolve_dir, store_configured)
-from transmogrifai_tpu.store.state import SharedQuota, StateCell
+from transmogrifai_tpu.store.state import (
+    LeaseTable, SharedQuota, StateCell)
 
 __all__ = [
     "MANIFEST",
@@ -29,6 +30,7 @@ __all__ = [
     "cache_root",
     "resolve_dir",
     "store_configured",
+    "LeaseTable",
     "SharedQuota",
     "StateCell",
 ]

@@ -33,7 +33,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from transmogrifai_tpu.runtime.integrity import fsync_dir
 
-__all__ = ["StateCell", "SharedQuota"]
+__all__ = ["StateCell", "SharedQuota", "LeaseTable"]
 
 log = logging.getLogger(__name__)
 
@@ -147,6 +147,216 @@ class StateCell:
         raise RuntimeError(
             f"state cell {self.name}: CAS contention exceeded "
             f"{retries} retries")
+
+
+class LeaseTable:
+    """TTL-leased work claims on one `StateCell` — the pod scheduler's
+    shared block pool.
+
+    The cell value is ``{"blocks": {key: {"state": pool|leased|done,
+    "owner": host, "deadline": wall_clock, "attempts": n}}}``. Every
+    transition is a CAS transform, so two hosts racing for the same
+    block resolve to exactly one owner, and a host that dies mid-block
+    simply stops renewing: when its deadline passes, any survivor's
+    `claim` takes the block over (attempts increments — the preemption
+    costs the fleet that one in-flight block, the same unit PR-7 lane
+    retirement costs a single host).
+
+    Wall-clock TTLs assume the hosts' clocks agree to within a fraction
+    of `ttl_s` — the same assumption `SharedQuota`'s refill already
+    makes on this store.
+    """
+
+    def __init__(self, root: str, name: str, owner: str,
+                 ttl_s: float = 30.0) -> None:
+        self.owner = str(owner)
+        self.ttl_s = float(ttl_s)
+        self._cell = StateCell(root, f"lease-{name}")
+        self.takeovers = 0       # expired-lease claims we performed
+        self.cas_rounds = 0      # update() calls (round trips)
+
+    # -- transforms -------------------------------------------------------- #
+
+    @staticmethod
+    def _blocks(value: Optional[Any]) -> Dict[str, Dict[str, Any]]:
+        if isinstance(value, dict) and isinstance(value.get("blocks"), dict):
+            return value["blocks"]
+        return {}
+
+    def register(self, keys: List[str]) -> None:
+        """Idempotently add `keys` to the pool. Every host registers the
+        same deterministic block plan; first writer wins per key, so the
+        table converges to the union without coordination."""
+        keys = [str(k) for k in keys]
+
+        def transform(value):
+            blocks = dict(self._blocks(value))
+            for k in keys:
+                blocks.setdefault(k, {"state": "pool", "attempts": 0})
+            return {"blocks": blocks}
+
+        self._cell.update(transform)
+        self.cas_rounds += 1
+
+    def claim(self, prefer: Optional[List[str]] = None) -> Optional[str]:
+        """CAS-claim one block: a pool block, else an EXPIRED lease
+        (takeover). `prefer` orders the scan (a host tries its own plan
+        slice first, then steals), making claim order deterministic
+        under no contention. Returns the claimed key, or None when
+        nothing is claimable right now (all leased-and-live or done)."""
+        got: Dict[str, Any] = {"key": None, "takeover": False}
+
+        def transform(value):
+            blocks = dict(self._blocks(value))
+            got["key"] = None
+            got["takeover"] = False
+            now = time.time()
+            order = [k for k in (prefer or []) if k in blocks]
+            order += [k for k in sorted(blocks) if k not in set(order)]
+            for k in order:
+                b = blocks[k]
+                state = b.get("state")
+                expired = (state == "leased"
+                           and float(b.get("deadline", 0.0)) < now)
+                if state == "pool" or expired:
+                    blocks[k] = {"state": "leased", "owner": self.owner,
+                                 "deadline": now + self.ttl_s,
+                                 "attempts": int(b.get("attempts", 0)) + 1}
+                    got["key"] = k
+                    got["takeover"] = expired
+                    break
+            return {"blocks": blocks}
+
+        self._cell.update(transform)
+        self.cas_rounds += 1
+        if got["takeover"]:
+            self.takeovers += 1
+        return got["key"]
+
+    def acquire(self, key: str) -> str:
+        """Targeted claim of one block: ``acquired`` (was pool),
+        ``takeover`` (expired foreign lease), ``held`` (our own live
+        lease, deadline renewed — two lanes of one host may pass the
+        same requeued block), ``busy`` (live foreign lease), ``done``,
+        ``failed``, or ``missing``."""
+        out = {"status": "missing"}
+
+        def transform(value):
+            blocks = dict(self._blocks(value))
+            b = blocks.get(key)
+            if not isinstance(b, dict):
+                out["status"] = "missing"
+                return {"blocks": blocks}
+            now = time.time()
+            state = b.get("state")
+            if state in ("done", "failed"):
+                out["status"] = state
+                return {"blocks": blocks}
+            if state == "leased":
+                live = float(b.get("deadline", 0.0)) >= now
+                if live and b.get("owner") != self.owner:
+                    out["status"] = "busy"
+                    return {"blocks": blocks}
+                out["status"] = "held" if b.get("owner") == self.owner \
+                    else "takeover"
+            else:
+                out["status"] = "acquired"
+            attempts = int(b.get("attempts", 0))
+            if out["status"] != "held":
+                attempts += 1
+            blocks[key] = {"state": "leased", "owner": self.owner,
+                           "deadline": now + self.ttl_s,
+                           "attempts": attempts}
+            return {"blocks": blocks}
+
+        self._cell.update(transform)
+        self.cas_rounds += 1
+        if out["status"] == "takeover":
+            self.takeovers += 1
+        return out["status"]
+
+    def fail(self, key: str, error: str) -> bool:
+        """Mark our leased block permanently failed (family-level error:
+        every host must apply the same family-drop policy rather than
+        re-running a block that fails deterministically)."""
+        ok = {"v": False}
+
+        def transform(value):
+            blocks = dict(self._blocks(value))
+            b = blocks.get(key)
+            ok["v"] = (isinstance(b, dict) and b.get("state") == "leased"
+                       and b.get("owner") == self.owner)
+            if ok["v"]:
+                blocks[key] = {"state": "failed", "owner": self.owner,
+                               "error": str(error)[:500],
+                               "attempts": int(b.get("attempts", 0))}
+            return {"blocks": blocks}
+
+        self._cell.update(transform)
+        self.cas_rounds += 1
+        return ok["v"]
+
+    def _transition(self, key: str, state: str) -> bool:
+        """Move `key` to `state` iff we still hold its lease (a TTL
+        takeover revokes the old owner: its late complete/release must
+        not clobber the new owner's claim)."""
+        ok = {"v": False}
+
+        def transform(value):
+            blocks = dict(self._blocks(value))
+            b = blocks.get(key)
+            ok["v"] = (isinstance(b, dict) and b.get("state") == "leased"
+                       and b.get("owner") == self.owner)
+            if ok["v"]:
+                nb = {"state": state, "owner": self.owner,
+                      "attempts": int(b.get("attempts", 0))}
+                if state == "leased":
+                    nb["deadline"] = time.time() + self.ttl_s
+                elif state == "pool":
+                    nb.pop("owner")
+                blocks[key] = nb
+            return {"blocks": blocks}
+
+        self._cell.update(transform)
+        self.cas_rounds += 1
+        return ok["v"]
+
+    def renew(self, key: str) -> bool:
+        """Extend our lease by `ttl_s`; False = lost to a takeover."""
+        return self._transition(key, "leased")
+
+    def complete(self, key: str) -> bool:
+        """Mark our leased block done (its journal record is durable)."""
+        return self._transition(key, "done")
+
+    def release(self, key: str) -> bool:
+        """Return our leased block to the pool (lane-retirement path:
+        the block failed locally; let another host run it)."""
+        return self._transition(key, "pool")
+
+    # -- reads ------------------------------------------------------------- #
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        _, value = self._cell.read()
+        return dict(self._blocks(value))
+
+    def pending(self) -> Tuple[int, float]:
+        """(blocks not done, seconds until the earliest live lease
+        expires). The second value is what a drained host's wait loop
+        sleeps against — TTL-aware, never a blind poll; `inf` when
+        nothing is leased (only pool blocks remain: claim immediately)."""
+        now = time.time()
+        remaining = 0
+        next_expiry = float("inf")
+        for b in self.snapshot().values():
+            state = b.get("state")
+            if state in ("done", "failed"):
+                continue
+            remaining += 1
+            if state == "leased":
+                next_expiry = min(next_expiry,
+                                  float(b.get("deadline", 0.0)) - now)
+        return remaining, next_expiry
 
 
 class SharedQuota:
