@@ -18,7 +18,7 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 .PHONY: lint conc-check serve-smoke fleet-smoke chaos-smoke \
 	ingest-smoke faults-smoke trace-smoke cache-smoke multichip-smoke \
 	continual-smoke costmodel-smoke roofline-smoke slo-smoke \
-	parse-smoke router-smoke pod-smoke test check
+	parse-smoke router-smoke pod-smoke autopilot-smoke test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -91,6 +91,19 @@ roofline-smoke:
 # transmogrifai_tpu/serving/chaos.py.
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.chaos
+
+# serving-autopilot smoke: the same seeded overload storm (delayed
+# member + low-priority flood, gold deadline tighter than the degraded
+# queue drain) is driven at a static-config fleet and an autopilot
+# fleet; the static arm's gold availability collapses while the
+# controller climbs the actuation ladder (rebucket re-arm -> fidelity
+# flip to the resident int8 member -> predictive admission -> warm
+# spare), damps gold p99 below the static arm, makes ZERO actuations
+# in the healthy phase, releases every actuation after the storm, and
+# every actuation event embeds the burn window that justified it. See
+# transmogrifai_tpu/serving/chaos.py (run_storm / storm_main).
+autopilot-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.chaos --storm
 
 # distributed-sweep smoke: on 8 forced host devices, a 2-family grid
 # sweep scheduled across the mesh must return the bit-identical winner
@@ -178,6 +191,6 @@ test:
 	@$(TIER1)
 
 check: lint conc-check serve-smoke parse-smoke fleet-smoke chaos-smoke \
-	roofline-smoke ingest-smoke cache-smoke faults-smoke trace-smoke \
-	slo-smoke multichip-smoke pod-smoke continual-smoke costmodel-smoke \
-	router-smoke test
+	autopilot-smoke roofline-smoke ingest-smoke cache-smoke faults-smoke \
+	trace-smoke slo-smoke multichip-smoke pod-smoke continual-smoke \
+	costmodel-smoke router-smoke test

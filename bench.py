@@ -1914,6 +1914,84 @@ def run_chaos_bench() -> None:
                "unit": "count", "vs_baseline": 0.0, **crash})
 
 
+def run_autopilot_bench() -> None:
+    """Autopilot-mode bench (`python bench.py autopilot`, also reached
+    as `python bench.py chaos --storm`): the numbers that make
+    "self-driving serving" falsifiable. Drives the SAME seeded overload
+    storm (`serving/chaos.run_storm` — delayed member + low-priority
+    flood, gold deadline tighter than the degraded queue drain) at a
+    static-config fleet and an autopilot fleet, and emits:
+
+    - ``autopilot_storm_availability``: late-storm gold availability
+      and p50/p99 per arm — the controller's damping is the static
+      minus autopilot gap, on the same storm;
+    - ``autopilot_actuations``: engage/release counts per ladder action
+      from the flight-recorder events (each embeds the burn window that
+      justified it), plus healthy-phase actuations (must be 0) and
+      whether every actuation was released after the storm;
+    - ``autopilot_shed``: shed counts by reason per arm (the
+      predictive-admission rung sheds on PREDICTED drain, the static
+      arm only on observed queue depth)."""
+    import tempfile
+
+    from transmogrifai_tpu.perf import model as perf_model
+    from transmogrifai_tpu.serving.chaos import (
+        _storm_cost_model, _train_models, run_storm)
+
+    platform = probe_backend()
+    flood_s = float(os.environ.get("BENCH_STORM_SECONDS", 2.0))
+    # predictive admission needs the perf model ON; the pinned
+    # deterministic cost model keeps the numbers host-independent
+    os.environ["TRANSMOGRIFAI_PERF_MODEL"] = "1"
+    with tempfile.TemporaryDirectory(prefix="bench-autopilot-") as tmp:
+        if "TRANSMOGRIFAI_PERF_CORPUS_DIR" not in os.environ:
+            os.environ["TRANSMOGRIFAI_PERF_CORPUS_DIR"] = \
+                f"{tmp}/perf-corpus"
+        dirs = _train_models(tmp)
+        _storm_cost_model()
+        try:
+            arms = {
+                "static": run_storm(dirs, autopilot=False, seed=0,
+                                    flood_s=flood_s,
+                                    flight_dir=f"{tmp}/flight"),
+                "autopilot": run_storm(dirs, autopilot=True, seed=0,
+                                       flood_s=flood_s,
+                                       flight_dir=f"{tmp}/flight"),
+            }
+        finally:
+            perf_model.set_model(None)
+        for arm, report in arms.items():
+            gold = report["storm"]["gold_a"]
+            _emit({"metric": "autopilot_storm_availability",
+                   "platform": platform, "value": gold["availability"],
+                   "unit": "frac", "vs_baseline": 0.0, "arm": arm,
+                   "slo_fired": report["storm"]["slo_fired"],
+                   "slo_cleared": report["slo_cleared"],
+                   "requests": gold["requests"],
+                   "errors": gold["errors"],
+                   "p50_ms": gold["p50_ms"], "p99_ms": gold["p99_ms"]})
+            _emit({"metric": "autopilot_shed", "platform": platform,
+                   "value": float(sum(report["shed"].values())),
+                   "unit": "count", "vs_baseline": 0.0, "arm": arm,
+                   **{f"shed_{k}": v
+                      for k, v in sorted(report["shed"].items())}})
+        auto = arms["autopilot"]
+        acts: dict = {}
+        for e in auto["events"]:
+            k = f"{e.get('transition')}:{e.get('action')}"
+            acts[k] = acts.get(k, 0) + 1
+        rel = auto["release"]
+        _emit({"metric": "autopilot_actuations", "platform": platform,
+               "value": float(sum(acts.values())), "unit": "count",
+               "vs_baseline": 0.0, "by_kind": acts,
+               "healthy_actuations": auto["healthy"]["actuations"],
+               "released": bool(rel["rung0"]
+                                and not rel["fidelity_routes"]
+                                and rel["pressure_a"] == 0.0
+                                and not rel["spare_hosted"]),
+               "flight_dumps": len(auto["flight_dumps"])})
+
+
 def main() -> None:
     global _BENCH_ROOT, _BENCH_ROOT_CM
     # root span for the whole bench: main-thread phase spans (train,
@@ -1972,11 +2050,27 @@ def main() -> None:
         return
     if "chaos" in sys.argv[1:]:
         try:
-            run_chaos_bench()
+            if "--storm" in sys.argv[1:]:
+                # the overload storm is a distinct scenario (load, not
+                # faults): `bench.py chaos --storm` == `bench.py autopilot`
+                run_autopilot_bench()
+            else:
+                run_chaos_bench()
         except Exception as e:
             _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
                    "vs_baseline": 0.0,
                    "error": f"chaos bench failed: {type(e).__name__}: {e}",
+                   "trace_tail":
+                       traceback.format_exc().strip().splitlines()[-3:]})
+        return
+    if "autopilot" in sys.argv[1:]:
+        try:
+            run_autopilot_bench()
+        except Exception as e:
+            _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"autopilot bench failed: "
+                            f"{type(e).__name__}: {e}",
                    "trace_tail":
                        traceback.format_exc().strip().splitlines()[-3:]})
         return

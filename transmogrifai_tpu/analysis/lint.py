@@ -215,6 +215,19 @@ silent slowness or nondeterminism once XLA is in the loop:
   a deliberate fixed-cadence loop annotates the site
   ``# conc-ok: L021``. Smoke/chaos drivers and tests are allowlisted.
 
+- ``L022 unlogged-actuation``: a call to a serving actuation API
+  (``rebucket``/``rearm_auto_rebucket``/``set_pressure``/
+  ``set_fidelity_route``/``set_route_override``) outside the autopilot
+  controller from a function that never emits a flight-recorder event
+  (no ``record_event``/``request_dump`` call in scope). The serving
+  control loop's audit trail is the flight recorder: every route flip,
+  admission-threshold write, or ladder re-derivation must name the
+  burn window/prediction (or the operator action) that justified it,
+  or a post-incident dump cannot explain why traffic moved. Emit an
+  event beside the call, or annotate a deliberate silent site with
+  ``# autopilot-ok: <why>``. ``serving/autopilot.py``, smoke/chaos
+  drivers and tests are allowlisted.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1728,6 +1741,71 @@ def _check_blind_poll_loops(tree: ast.AST, path: str,
     return findings
 
 
+# -- L022: actuation-path calls without a flight-recorder event -------------- #
+
+_L022_ACTUATORS = {"rebucket", "rearm_auto_rebucket", "set_pressure",
+                   "set_fidelity_route", "set_route_override"}
+_L022_EMITTERS = {"record_event", "request_dump"}
+_L022_OK_RE = re.compile(r"#\s*autopilot-ok\b")
+
+
+def _l022_suppressed(lines: Sequence[str], lineno: int) -> bool:
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and _L022_OK_RE.search(lines[ln - 1]):
+            return True
+    return False
+
+
+def _check_unlogged_actuations(tree: ast.AST, path: str,
+                               lines: Sequence[str]) -> List[LintFinding]:
+    """Flag actuation-API calls outside the controller whose enclosing
+    function never emits a flight-recorder event — see module
+    docstring (L022)."""
+    parts = os.path.normpath(path).split(os.sep)
+    base = parts[-1]
+    if base in ("autopilot.py", "smoke.py", "chaos.py") \
+            or base.endswith("_smoke.py") \
+            or "tests" in parts or "testkit" in parts:
+        return []
+    findings: List[LintFinding] = []
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # innermost-enclosing-function map: nested defs are visited too, so
+    # sort outer-first and let inner functions overwrite their ranges
+    for fn in funcs:
+        emits = any(isinstance(sub, ast.Call)
+                    and (_dotted(sub.func) or "").rsplit(".", 1)[-1]
+                    in _L022_EMITTERS
+                    for sub in ast.walk(fn))
+        if emits:
+            continue
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _dotted(sub.func) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in _L022_ACTUATORS:
+                continue
+            if fn.name == leaf:
+                continue  # the definition module's own wrapper
+            lineno = getattr(sub, "lineno", 0)
+            findings.append(LintFinding(
+                path, lineno, "L022",
+                f"actuation call `{name}` outside the autopilot "
+                f"controller with no flight-recorder event in "
+                f"`{fn.name}` — route flips, admission-threshold "
+                f"writes, and ladder re-derivations must record the "
+                f"burn window/prediction (or operator action) that "
+                f"justified them, or a post-incident flight dump "
+                f"cannot explain why traffic moved; emit "
+                f"`record_event(...)` beside the call or annotate "
+                f"`# autopilot-ok: <why>`",
+                suppression=("annotation"
+                             if _l022_suppressed(lines, lineno)
+                             else None)))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1756,6 +1834,8 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_store_bypass_writes(
         tree, path, src.splitlines()))
     linter.findings.extend(_check_blind_poll_loops(
+        tree, path, src.splitlines()))
+    linter.findings.extend(_check_unlogged_actuations(
         tree, path, src.splitlines()))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 

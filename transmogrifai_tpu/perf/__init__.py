@@ -30,8 +30,8 @@ from transmogrifai_tpu.perf.features import (
     serving_features)
 from transmogrifai_tpu.perf.model import (
     CostModel, Prediction, choose_upload_plan, fit_corpus, get_model,
-    holdout_mape, observe, predict_block_seconds, predict_sweep_seconds,
-    refresh, set_model)
+    holdout_mape, observe, predict_block_seconds, predict_bucket_seconds,
+    predict_drain_seconds, predict_sweep_seconds, refresh, set_model)
 from transmogrifai_tpu.perf.params import (
     PerfModelParams, enabled, get_params, hbm_budget_bytes, params_scope,
     resolved_corpus_dir, set_params, target_block_s)
@@ -44,6 +44,7 @@ __all__ = [
     "hbm_budget_bytes", "hbm_proxy_bytes", "holdout_mape",
     "ingest_features", "note", "note_parse", "note_serving", "observe",
     "params_scope", "parse_features", "predict_block_seconds",
+    "predict_bucket_seconds", "predict_drain_seconds",
     "predict_sweep_seconds", "resolved_corpus_dir", "refresh",
     "serving_features", "set_model", "set_params", "target_block_s",
 ]

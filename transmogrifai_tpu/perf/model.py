@@ -50,11 +50,13 @@ import numpy as np
 from transmogrifai_tpu.perf import params as perf_params
 from transmogrifai_tpu.perf.corpus import (
     CostCorpus, device_generation, get_corpus)
-from transmogrifai_tpu.perf.features import block_features, ingest_features
+from transmogrifai_tpu.perf.features import (
+    block_features, ingest_features, serving_features)
 
 __all__ = ["Prediction", "CostModel", "fit_corpus", "get_model",
            "set_model", "refresh", "observe", "choose_upload_plan",
-           "predict_block_seconds", "predict_sweep_seconds",
+           "predict_block_seconds", "predict_bucket_seconds",
+           "predict_drain_seconds", "predict_sweep_seconds",
            "holdout_mape"]
 
 log = logging.getLogger(__name__)
@@ -417,6 +419,38 @@ def predict_block_seconds(family: str, static: Tuple, n_configs: int,
     return m.predict("block_runtime",
                      block_features(family, static, n_configs, n_rows,
                                     n_cols, n_folds, dtype_bytes))
+
+
+def predict_bucket_seconds(bucket: int,
+                           model: Optional[CostModel] = None
+                           ) -> Optional[Prediction]:
+    """Predicted device+dispatch seconds for ONE serving batch at a
+    ladder rung (`serving_bucket` target, fed by `corpus.note_serving`).
+    None while the model is cold — callers must fall back to their
+    observed-signal path."""
+    m = model if model is not None else get_model()
+    if m is None:
+        return None
+    return m.predict("serving_bucket", serving_features(int(bucket)))
+
+
+def predict_drain_seconds(queue_rows: int, bucket: int,
+                          model: Optional[CostModel] = None
+                          ) -> Optional[Prediction]:
+    """Predicted wall seconds to drain `queue_rows` backlogged rows
+    through `bucket`-sized batches: ceil(rows/bucket) sequential batch
+    executions at the predicted per-batch latency. The serving layer
+    turns this into a proportional 429/503 Retry-After; the autopilot
+    compares it against the deadline budget for predictive admission.
+    None when the model is cold (constant Retry-After fallback)."""
+    per_batch = predict_bucket_seconds(bucket, model=model)
+    if per_batch is None or bucket <= 0:
+        return None
+    n_batches = max(1, math.ceil(max(0, int(queue_rows)) / int(bucket)))
+    return Prediction(value=per_batch.value * n_batches,
+                      lo=per_batch.lo * n_batches,
+                      hi=per_batch.hi * n_batches,
+                      n=per_batch.n)
 
 
 _PLAN_WORKERS = (1, 2, 4, 8)

@@ -1,0 +1,483 @@
+"""SLO-burn-driven serving autopilot: the controller over the sensors.
+
+PRs 9/13/14 built the sensors and actuators of a self-healing serving
+plane but no controller: the perf model predicts per-bucket latency
+(`perf/model.py`), the SLO engine measures multi-window burn rate
+(`obs/slo.py`), quantized builds stay resident beside f32
+(`serving/fleet.py`), and breakers/watchdog handle hard faults — yet
+overload response was a static config (queue bound + priority shed).
+This module closes the loop (the ML-productivity-goodput thesis, arxiv
+2502.06982, as an actual control loop): a supervisor thread reads the
+burn signal each tick and actuates remediation in ESCALATING order up a
+rung ladder, one rung per dwell window:
+
+1. **rebucket re-arm** — the PR-9 auto-rebucket path fires one shot
+   organically; under burn the controller re-arms it (cooldown-gated)
+   so the ladder re-derives from the storm's traffic mix;
+2. **adaptive fidelity** — route a burning model to its resident
+   int8-calibrated sibling member (`FleetService.set_fidelity_route`)
+   and back when burn clears: both builds stay resident (their
+   programs never adopt each other), so the swap is a table write —
+   no compile, no dropped request;
+3. **predictive admission** — write a synthetic queue pressure for
+   each primary model from the perf model's predicted queue-drain
+   time vs the deadline budget (`Router.set_pressure`), shedding low
+   classes BEFORE the bounded queue observes saturation. A cold model
+   predicts None → pressure stays 0 → admission is bit-identical to
+   observed-queue shedding;
+4. **warm-spare activation** — `add_model` a configured spare member
+   (program-pool adoption makes it near-free), removed on release.
+
+Every transition carries hysteresis — distinct engage/release burn
+thresholds plus a min-dwell between transitions, so boundary load
+cannot flap a route — and is recorded as an `autopilot_actuation`
+flight-recorder event embedding the exact burn window and prediction
+that justified it. Every actuation is reversible; the release path
+walks the ladder back down, and the controller's steady state on a
+healthy fleet is ZERO actuations.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Autopilot", "AutopilotParams"]
+
+
+def _record_event(name: str, **attrs: Any) -> None:
+    try:
+        from transmogrifai_tpu.obs.export import record_event
+        record_event(name, **attrs)
+    except Exception:
+        log.debug("%s event emission failed", name, exc_info=True)
+
+
+@dataclass
+class AutopilotParams:
+    """JSON-loadable controller knobs (`FleetConfig.autopilot`)."""
+
+    enabled: bool = True
+    # tick cadence of the supervisor thread
+    period_s: float = 0.25
+    # hysteresis: the burn signal (max over SLO windows of
+    # min(long, short) burn / window threshold; >= 1.0 iff some window
+    # fires) must reach `engage_burn` to climb a rung and fall to
+    # `release_burn` to descend one — distinct thresholds so boundary
+    # load cannot flap a route
+    engage_burn: float = 1.0
+    release_burn: float = 0.5
+    # minimum seconds between rung transitions (engage OR release):
+    # at most one transition per dwell window
+    min_dwell_s: float = 1.0
+    # a release additionally requires the burn to have stayed at or
+    # below `release_burn` CONTINUOUSLY for this long: one healthy
+    # window sample mid-storm (bursty completions, a starved SLO
+    # engine) must not walk a cure back while the overload is still on
+    release_hold_s: float = 0.0
+    # cooldown between controller-driven rebucket re-arms
+    rebucket_cooldown_s: float = 5.0
+    # fidelity flips: burning model -> resident quantized sibling
+    # member name (both must be hosted; the flip is a route-table write)
+    fidelity: Dict[str, str] = field(default_factory=dict)
+    # predictive admission: pressure = predicted_drain_s /
+    # (admission_headroom * deadline_budget_s); 1.0 sheds everything
+    # below the top priority class
+    admission_headroom: float = 1.0
+    # warm spare member spec: {"name": ..., "path": ...,
+    # "overrides": {...}} added at the top rung, removed on release
+    spare: Optional[Dict[str, Any]] = None
+
+    _FIELDS = ("enabled", "period_s", "engage_burn", "release_burn",
+               "min_dwell_s", "release_hold_s", "rebucket_cooldown_s",
+               "fidelity", "admission_headroom", "spare")
+
+    def __post_init__(self) -> None:
+        if self.period_s <= 0:
+            raise ValueError(f"period_s must be > 0: {self.period_s}")
+        if self.engage_burn <= self.release_burn:
+            raise ValueError(
+                f"engage_burn ({self.engage_burn}) must exceed "
+                f"release_burn ({self.release_burn}) — equal thresholds "
+                f"remove the hysteresis band and the loop can flap")
+        if self.release_burn < 0:
+            raise ValueError(
+                f"release_burn must be >= 0: {self.release_burn}")
+        if self.min_dwell_s < 0:
+            raise ValueError(
+                f"min_dwell_s must be >= 0: {self.min_dwell_s}")
+        if self.release_hold_s < 0:
+            raise ValueError(
+                f"release_hold_s must be >= 0: {self.release_hold_s}")
+        if self.rebucket_cooldown_s < 0:
+            raise ValueError(f"rebucket_cooldown_s must be >= 0: "
+                             f"{self.rebucket_cooldown_s}")
+        if self.admission_headroom <= 0:
+            raise ValueError(f"admission_headroom must be > 0: "
+                             f"{self.admission_headroom}")
+        if self.spare is not None and not (
+                isinstance(self.spare, dict) and self.spare.get("name")
+                and self.spare.get("path")):
+            raise ValueError(
+                f'spare must be {{"name": ..., "path": ...}}: '
+                f"{self.spare!r}")
+
+    @staticmethod
+    def from_json(d: Optional[Dict[str, Any]]) -> "AutopilotParams":
+        d = d or {}
+        return AutopilotParams(**{k: d[k] for k in AutopilotParams._FIELDS
+                                  if k in d})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._FIELDS}
+
+
+class Autopilot:
+    """The supervisor. `start()` spawns the tick thread; `tick(now=...)`
+    is directly callable (tests drive it with a fake clock). All shared
+    controller state lives under `self._lock`; actuations and event
+    emission happen OUTSIDE it (never block under a lock — C003)."""
+
+    def __init__(self, fleet, params: Optional[AutopilotParams] = None):
+        self.fleet = fleet
+        self.params = params or AutopilotParams()
+        # the actuation ladder this config can actually climb: rungs
+        # with nothing to do (no fidelity map, no spare spec) are left
+        # out rather than burned as no-op dwell windows
+        self.ladder: Tuple[str, ...] = tuple(
+            ["rebucket"]
+            + (["fidelity"] if self.params.fidelity else [])
+            + ["admission"]
+            + (["spare"] if self.params.spare else []))
+        self._lock = threading.Lock()
+        self._rung = 0               # guarded-by: self._lock
+        self._last_transition = 0.0  # guarded-by: self._lock
+        self._rebucket_last = -1e18  # guarded-by: self._lock
+        self._last_burn = 0.0        # guarded-by: self._lock
+        # start of the current continuous at-or-below-release_burn
+        # streak; None while burn is above it or unmeasured
+        self._below_since: Optional[float] = None  # guarded-by: self._lock
+        self._last_window: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+        self._actuations = 0         # guarded-by: self._lock
+        self._halt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m_actuations = fleet.registry.counter(
+            "autopilot_actuations_total",
+            "autopilot engage/release actuations by action")
+        self._m_rung = fleet.registry.gauge(
+            "autopilot_rung", "current autopilot escalation rung")
+
+    # -- lifecycle --------------------------------------------------------- #
+
+    def start(self) -> "Autopilot":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._halt.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="serving-autopilot",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._halt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._halt.wait(timeout=self.params.period_s):
+            try:
+                self.tick()
+            except Exception:
+                # one bad tick (a member mid-removal, a racing health
+                # read) must not kill the controller
+                log.warning("autopilot tick failed", exc_info=True)
+
+    # -- sensing ----------------------------------------------------------- #
+
+    def burn_signal(self) -> Tuple[Optional[float],
+                                   Optional[Dict[str, Any]]]:
+        """(signal, justifying window). The signal is the max over every
+        SLO's burn windows of min(long_burn, short_burn) / threshold —
+        >= 1.0 exactly when some window fires (both of its rates over
+        budget) — and the window dict names the SLO, window key, and
+        the measured rates, embedded verbatim in actuation events.
+
+        Returns ``(None, None)`` on a SENSING GAP: no engine, a failed
+        status read, or every window missing a rate (a rate is None
+        when its sample delta spans no completed traffic — e.g. the
+        engine thread was starved under the very overload the
+        controller is damping). A gap is not health: the caller holds
+        state rather than treating it as burn 0.0."""
+        engine = getattr(self.fleet, "slo_engine", None)
+        if engine is None:
+            return None, None
+        try:
+            status = engine.status()
+        except Exception:
+            log.debug("autopilot: SLO status read failed", exc_info=True)
+            return None, None
+        best, best_window, sensed = 0.0, None, False
+        for name, slo in (status.get("slos") or {}).items():
+            for wkey, w in (slo.get("windows") or {}).items():
+                long_b = w.get("long_burn")
+                short_b = w.get("short_burn")
+                if long_b is None or short_b is None:
+                    continue
+                sensed = True
+                threshold = float(w.get("threshold") or 1.0)
+                signal = min(float(long_b), float(short_b)) \
+                    / max(1e-9, threshold)
+                if signal > best:
+                    best = signal
+                    best_window = {"slo": name, "window": wkey, **w}
+        if not sensed:
+            return None, None
+        return best, best_window
+
+    def _members(self) -> Dict[str, Any]:
+        return self.fleet._live_services()
+
+    def _primary_members(self) -> Dict[str, Any]:
+        """Members that take first-line traffic: everything except the
+        fidelity targets and the spare (they absorb overflow — writing
+        pressure against them would shed the traffic we just moved)."""
+        skip = set(self.params.fidelity.values())
+        if self.params.spare:
+            skip.add(self.params.spare["name"])
+        return {n: s for n, s in self._members().items() if n not in skip}
+
+    def _drain_prediction(self, svc) -> Optional[Any]:
+        from transmogrifai_tpu import perf
+        try:
+            top = max(svc.ladder) if svc.ladder else svc.config.max_batch
+            return perf.predict_drain_seconds(
+                max(1, svc._batcher.depth()), top)
+        except Exception:
+            log.debug("autopilot: drain prediction failed", exc_info=True)
+            return None
+
+    # -- control loop ------------------------------------------------------ #
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One controller evaluation. Reads the burn signal, applies the
+        hysteresis ladder (at most ONE rung transition per call, and
+        only after `min_dwell_s` since the last), then maintains the
+        predictive-admission pressure while that rung is engaged.
+        Returns a status snapshot (tests assert on it)."""
+        if now is None:
+            now = time.monotonic()
+        burn, window = self.burn_signal()
+        transition: Optional[Tuple[str, str]] = None
+        with self._lock:
+            if burn is None:
+                # sensing gap: hold the rung (and break any
+                # below-release streak — unmeasured is not healthy),
+                # but keep maintaining admission pressure below
+                self._below_since = None
+                burn = self._last_burn
+                window = self._last_window
+            else:
+                self._last_burn = burn
+                self._last_window = window
+                # a release streak: burn continuously at or below the
+                # release threshold since `_below_since`
+                if burn <= self.params.release_burn:
+                    if self._below_since is None:
+                        self._below_since = now
+                else:
+                    self._below_since = None
+                dwell_ok = (now - self._last_transition) \
+                    >= self.params.min_dwell_s
+                held = (self._below_since is not None
+                        and now - self._below_since
+                        >= self.params.release_hold_s)
+                if burn >= self.params.engage_burn and dwell_ok \
+                        and self._rung < len(self.ladder):
+                    self._rung += 1
+                    self._last_transition = now
+                    transition = ("engage", self.ladder[self._rung - 1])
+                elif burn <= self.params.release_burn and dwell_ok \
+                        and held and self._rung > 0:
+                    transition = ("release", self.ladder[self._rung - 1])
+                    self._rung -= 1
+                    self._last_transition = now
+            rung = self._rung
+            admission_on = "admission" in self.ladder[:rung]
+        self._m_rung.set(rung)
+        if transition is not None:
+            kind, action = transition
+            self._actuate(kind, action, burn, window, now)
+        if admission_on and (transition is None
+                             or transition[1] != "admission"):
+            # maintain pressure from FRESH predictions every tick while
+            # the rung stays engaged (engage/release themselves wrote it)
+            self._update_pressure(burn, window, emit=False)
+        return self.status()
+
+    def _actuate(self, kind: str, action: str, burn: float,
+                 window: Optional[Dict[str, Any]], now: float) -> None:
+        try:
+            if action == "rebucket":
+                self._act_rebucket(kind, burn, window, now)
+            elif action == "fidelity":
+                self._act_fidelity(kind, burn, window)
+            elif action == "admission":
+                if kind == "engage":
+                    self._update_pressure(burn, window, emit=True)
+                else:
+                    self._clear_pressure(burn, window)
+            elif action == "spare":
+                self._act_spare(kind, burn, window)
+        except Exception:
+            log.warning("autopilot: %s %s failed", kind, action,
+                        exc_info=True)
+        with self._lock:
+            self._actuations += 1
+        self._m_actuations.inc()
+        if kind == "engage":
+            try:
+                from transmogrifai_tpu.obs import flight
+                # OFF the control thread: the ring is fullest exactly
+                # when actuations happen (overload = span flood), and a
+                # multi-second artifact write here would freeze the
+                # ladder for dozens of dwell windows mid-incident — the
+                # one time the controller must keep ticking. The dump
+                # snapshots the ring when the writer runs; the
+                # actuation event is already in it (recorded above).
+                threading.Thread(
+                    target=flight.request_dump,
+                    args=(f"autopilot_{action}",),
+                    name="autopilot-dump", daemon=True).start()
+            except Exception:
+                log.debug("autopilot flight dump failed", exc_info=True)
+
+    def _event(self, action: str, kind: str, burn: float,
+               window: Optional[Dict[str, Any]], **attrs: Any) -> None:
+        with self._lock:
+            rung = self._rung
+        # the attr is `transition`, not `kind`: flight-dump events.jsonl
+        # records already use a top-level `kind` ("event"/"span") and
+        # event attrs are splatted into the same record
+        _record_event("autopilot_actuation", action=action,
+                      transition=kind, rung=rung, burn=round(burn, 4),
+                      burn_window=window, **attrs)
+
+    def _act_rebucket(self, kind: str, burn: float,
+                      window: Optional[Dict[str, Any]],
+                      now: float) -> None:
+        """Re-arm the members' auto-rebucket shot so the next scored
+        batch re-derives the ladder from the storm's size mix. The
+        controller owns the cooldown; release re-arms once more so the
+        ladder can re-derive from the RECOVERED traffic too."""
+        with self._lock:
+            cooled = (now - self._rebucket_last
+                      >= self.params.rebucket_cooldown_s)
+            if cooled:
+                self._rebucket_last = now
+        if not cooled:
+            self._event("rebucket", kind, burn, window,
+                        skipped="cooldown")
+            return
+        rearmed = [name for name, svc in self._members().items()
+                   if svc.rearm_auto_rebucket()]
+        self._event("rebucket", kind, burn, window, rearmed=rearmed)
+
+    def _act_fidelity(self, kind: str, burn: float,
+                      window: Optional[Dict[str, Any]]) -> None:
+        for model, target in self.params.fidelity.items():
+            try:
+                if kind == "engage":
+                    self.fleet.set_fidelity_route(model, target)
+                else:
+                    self.fleet.set_fidelity_route(model, None)
+            except Exception:
+                log.warning("autopilot: fidelity %s %s->%s failed",
+                            kind, model, target, exc_info=True)
+                continue
+            self._event("fidelity", kind, burn, window, model=model,
+                        target=(target if kind == "engage" else None),
+                        restored=(model if kind == "release" else None))
+
+    def _update_pressure(self, burn: float,
+                         window: Optional[Dict[str, Any]],
+                         emit: bool) -> None:
+        """Predictive admission: per primary member, pressure =
+        predicted drain seconds / (headroom x deadline budget), clamped
+        to [0, 1]. Cold model -> None prediction -> pressure cleared,
+        leaving admission bit-identical to observed-queue shedding."""
+        members = self._members()
+        for name, svc in self._primary_members().items():
+            # pressure is keyed by the logical model name, but the drain
+            # prediction must read the queue of the member that name
+            # currently RESOLVES to (fidelity flips move the traffic)
+            svc = members.get(self.fleet.resolve_model(name), svc)
+            pred = self._drain_prediction(svc)
+            deadline_s = max(1e-3,
+                             svc.config.default_deadline_ms / 1000.0)
+            if pred is None:
+                self.fleet.router.set_pressure(name, 0.0)
+                if emit:
+                    self._event("admission", "engage", burn, window,
+                                model=name, prediction=None,
+                                pressure=0.0, note="model cold")
+                continue
+            ratio = pred.value / (self.params.admission_headroom
+                                  * deadline_s)
+            pressure = max(0.0, min(1.0, ratio))
+            self.fleet.router.set_pressure(name, pressure)
+            if emit:
+                self._event("admission", "engage", burn, window,
+                            model=name, prediction=pred.to_json(),
+                            deadline_budget_s=round(deadline_s, 3),
+                            pressure=round(pressure, 4))
+
+    def _clear_pressure(self, burn: float,
+                        window: Optional[Dict[str, Any]]) -> None:
+        for name in self._primary_members():
+            self.fleet.router.set_pressure(name, 0.0)
+            self._event("admission", "release", burn, window,
+                        model=name, pressure=0.0)
+
+    def _act_spare(self, kind: str, burn: float,
+                   window: Optional[Dict[str, Any]]) -> None:
+        spare = self.params.spare or {}
+        name = spare.get("name")
+        if kind == "engage":
+            if name in self._members():
+                self._event("spare", kind, burn, window, member=name,
+                            skipped="already hosted")
+                return
+            self.fleet.add_model(name, spare["path"],
+                                 dict(spare.get("overrides") or {}))
+            self._event("spare", kind, burn, window, member=name)
+        else:
+            try:
+                self.fleet.remove_model(name)
+            except Exception:
+                log.debug("autopilot: spare %s already gone", name,
+                          exc_info=True)
+            self._event("spare", kind, burn, window, member=name)
+
+    # -- introspection ----------------------------------------------------- #
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            rung = self._rung
+            return {
+                "rung": rung,
+                "ladder": list(self.ladder),
+                "engaged": list(self.ladder[:rung]),
+                "burn": round(self._last_burn, 4),
+                "burn_window": self._last_window,
+                "actuations": self._actuations,
+                "running": (self._thread is not None
+                            and self._thread.is_alive()),
+            }

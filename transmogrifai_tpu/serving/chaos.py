@@ -29,13 +29,26 @@ sites (`runtime/faults`):
    restarts (`continual_supervisor_restarts_total`) and the NEXT cycle
    completes — used by ``python bench.py chaos``.
 
+6. **Overload storm** (`run_storm`, ``--storm``): a seeded flood plus
+   an injected dispatch delay overload one member beyond what the
+   static config (queue bound + priority shed) can absorb — the gold
+   tenant's availability SLO burns until the flood stops. The same
+   storm against an autopilot fleet (`serving/autopilot.py`) must be
+   DAMPED: the controller climbs its actuation ladder (rebucket
+   re-arm, fidelity flip to the resident int8 member, predictive
+   admission, warm spare), gold availability and p99 beat the static
+   arm, and every actuation is released after the storm.
+
 `make chaos-smoke` runs ``main()`` (scenarios 1-4 with hard
-assertions); ``python bench.py chaos`` reuses `run_chaos` +
-`run_continual_crash` and emits per-tenant availability, p99, breaker
-transition counts, MTTR, and the goodput resilience section into the
-bench payload.
+assertions); `make autopilot-smoke` runs ``storm_main()`` (scenario 6,
+static arm vs autopilot arm); ``python bench.py chaos`` reuses
+`run_chaos` + `run_continual_crash` and emits per-tenant availability,
+p99, breaker transition counts, MTTR, and the goodput resilience
+section into the bench payload; ``python bench.py autopilot`` emits
+the storm comparison.
 
 Run: ``JAX_PLATFORMS=cpu python -m transmogrifai_tpu.serving.chaos``
+(``--storm`` for the autopilot acceptance)
 """
 
 from __future__ import annotations
@@ -101,11 +114,17 @@ class _LoadClient(threading.Thread):
     error counts, latencies, and the serving version of each response
     (how the fallback-serves-the-previous-version claim is proven)."""
 
-    def __init__(self, fleet, tenant: str, model: str, idx: int):
+    def __init__(self, fleet, tenant: str, model: str, idx: int,
+                 rows: int = 1, pace: float = 0.004,
+                 deadline_ms: float = 10_000):
         super().__init__(daemon=True, name=f"chaos-client-{idx}")
         self.fleet = fleet
         self.tenant = tenant
         self.model = model
+        self.idx = idx
+        self.n_rows = rows
+        self.pace = pace
+        self.deadline_ms = deadline_ms
         self.ok = 0
         self.errors: List[str] = []
         self.latencies: List[float] = []
@@ -116,20 +135,32 @@ class _LoadClient(threading.Thread):
         while not self._halt.is_set():
             t0 = time.perf_counter()
             try:
-                res = self.fleet.score(self.model, [dict(ROW)],
+                res = self.fleet.score(self.model,
+                                       [dict(ROW)
+                                        for _ in range(self.n_rows)],
                                        tenant=self.tenant,
-                                       deadline_ms=10_000)
+                                       deadline_ms=self.deadline_ms)
                 self.ok += 1
                 self.latencies.append(time.perf_counter() - t0)
                 self.versions[res.model_version] = \
                     self.versions.get(res.model_version, 0) + 1
             except Exception as e:
+                # an error answer still took this long to arrive: the
+                # latency distribution is time-to-ANSWER, not
+                # time-to-success (a deadline drop that surfaces after
+                # 600 ms in queue IS the client's tail)
+                self.latencies.append(time.perf_counter() - t0)
                 self.errors.append(
                     f"{getattr(e, 'code', type(e).__name__)}: {e}"[:120])
-            time.sleep(0.004)
+            time.sleep(self.pace)
 
     def stop(self) -> None:
         self._halt.set()
+
+    def mark(self) -> Dict[str, int]:
+        """Counter snapshot for phase-scoped stats (`_stats_since`)."""
+        return {"ok": self.ok, "errors": len(self.errors),
+                "latencies": len(self.latencies)}
 
     def stats(self) -> Dict[str, Any]:
         import numpy as np
@@ -538,6 +569,345 @@ def run_continual_crash(tmp: str) -> Dict[str, Any]:
     }
 
 
+# --------------------------------------------------------------------------- #
+# overload storm: static config vs the serving autopilot (PR 19)
+# --------------------------------------------------------------------------- #
+
+# the pinned cost model's per-batch latency slope AND the injected
+# per-dispatch delay: the storm's physics must not depend on how fast
+# THIS host happens to score, or the smoke flakes on slow CI
+_STORM_BATCH_S = 0.05
+
+
+def _storm_cost_model():
+    """Pin a deterministic warm cost model (per-batch latency
+    ``_STORM_BATCH_S * bucket``): a dozen-deep queue at bucket 4
+    predicts a ~0.6 s drain against the 0.3 s deadline budget —
+    pressure clamps to 1.0, far past the 0.5 shed watermark — so
+    predictive admission has an unambiguous signal. Caller must
+    ``perf_model.set_model(None)`` when done."""
+    from transmogrifai_tpu.perf import model as perf_model
+    m = perf_model.CostModel(min_rows=8)
+    for _ in range(12):
+        for b in (1, 2, _MAX_BATCH):
+            m.observe("serving_bucket", {"bucket": float(b)},
+                      _STORM_BATCH_S * b)
+    perf_model.set_model(m)
+    return m
+
+
+def _collect_autopilot_events(dumps: List[str]) -> List[Dict[str, Any]]:
+    """autopilot_actuation events parsed from flight-dump artifacts
+    (the in-memory ring evicts under sustained traffic; the dumps each
+    engage wrote — plus the forced end-of-storm dump — are the durable
+    record), deduped across overlapping ring snapshots, oldest first."""
+    import json
+    seen: Dict[Any, Dict[str, Any]] = {}
+    for d in dumps:
+        try:
+            with open(os.path.join(d, "events.jsonl"),
+                      encoding="utf-8") as fh:
+                for line in fh:
+                    if not line.strip():
+                        continue
+                    rec = json.loads(line)
+                    if rec.get("name") != "autopilot_actuation":
+                        continue
+                    key = (rec.get("ts_s"), rec.get("action"),
+                           rec.get("transition"), rec.get("model"))
+                    seen[key] = rec
+        except OSError:
+            continue
+    return [seen[k] for k in sorted(seen, key=lambda k: k[0] or 0.0)]
+
+
+def _stats_since(clients: List[_LoadClient],
+                 marks: Dict[_LoadClient, Dict[str, int]]) -> Dict[str, Any]:
+    """Aggregate stats over the requests `clients` completed since
+    their `mark()` snapshots — the storm arms are compared on the
+    late-storm window, not whole-run numbers that average the healthy
+    baseline in. The latency distribution is time-to-ANSWER: error
+    answers count, or the failing arm would report a rosy p99 from its
+    one lucky success."""
+    import numpy as np
+    ok = sum(c.ok - marks[c]["ok"] for c in clients)
+    errors = sum(len(c.errors) - marks[c]["errors"] for c in clients)
+    lats: List[float] = []
+    for c in clients:
+        lats.extend(c.latencies[marks[c]["latencies"]:])
+    lat = np.asarray(lats) if lats else np.zeros(1)
+    total = ok + errors
+    return {
+        "tenant": clients[0].tenant, "model": clients[0].model,
+        "requests": total, "ok": ok, "errors": errors,
+        "availability": round(ok / total, 4) if total else 1.0,
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+    }
+
+
+def _shed_by_reason(fleet) -> Dict[str, int]:
+    shed: Dict[str, int] = {}
+    for s in fleet.registry.to_json().get(
+            "fleet_shed_total", {"series": []})["series"]:
+        reason = (s.get("labels") or {}).get("reason", "?")
+        shed[reason] = shed.get(reason, 0) + int(s.get("value", 0))
+    return shed
+
+
+def run_storm(dirs: Dict[str, str], autopilot: bool = True,
+              seed: int = 0, flood_s: float = 2.0,
+              flight_dir: Optional[str] = None) -> Dict[str, Any]:
+    """One seeded OVERLOAD storm against one fleet. ``autopilot=False``
+    is the static-config control arm; ``autopilot=True`` the treatment.
+
+    Unlike `run_chaos` this storm is load, not faults: an injected
+    per-dispatch delay caps member `a`'s drain rate while low-priority
+    flood clients keep its queue deep. The static config's own graded
+    priority shedding DOES keep gold admitted (that is PR-13 working)
+    — but admitted is not served: the queue's drain time under the
+    delay is ~2x the gold tenant's deadline, so every admitted gold
+    request expires in queue (``deadline_exceeded``), device time is
+    burned on answers nobody is waiting for, and the availability SLO
+    burns until the flood stops. That is the overload shape a static
+    config cannot damp — no admission threshold on OBSERVED depth
+    helps when the queue is short but slow. The autopilot arm must
+    climb the actuation ladder — rebucket re-arm, fidelity flip to the
+    resident int8-calibrated member (no injected delay: the overload
+    is member-a physics, and the flip routes around it with no compile
+    and no dropped request), predictive admission shedding the flood
+    because PREDICTED drain time exceeds the deadline budget,
+    warm-spare activation — then walk it back down after the storm.
+
+    Caller owns the pinned deterministic cost model
+    (`_storm_cost_model`) and the perf-model env. Returns the per-arm
+    report; `storm_main` compares the two arms."""
+    from transmogrifai_tpu.obs import flight
+    from transmogrifai_tpu.obs.trace import TRACER
+    from transmogrifai_tpu.runtime.faults import (
+        SITE_DEVICE_DISPATCH, FaultPlan, FaultSpec)
+    from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+
+    slo = {
+        "slos": [{"name": "gold-availability",
+                  "kind": "availability", "objective": 0.999,
+                  "tenant": "gold"}],
+        "windows": [[2.4, 1.2, 2.0, "page"]],
+        "time_scale": 1.0, "eval_period_s": 0.05,
+    }
+    config = FleetConfig(
+        models={"a": dirs["a"], "b": dirs["b"],
+                # the resident int8-calibrated sibling the fidelity
+                # rung flips routes to: same artifact, quantized build
+                # (distinct programs; both stay resident)
+                "a_int8": {"path": dirs["a"],
+                           "serving": {"quantize": "int8-calibrated"}}},
+        tenants={"gold": {"priority": 1}, "trial": {"priority": 0}},
+        # the deadline budget is the pressure denominator: a queue
+        # whose PREDICTED drain exceeds ~300ms is already failing its
+        # gold clients even though its observed depth looks fine
+        serving={"max_batch": _MAX_BATCH, "batch_wait_ms": 1.0,
+                 "max_queue": 16, "auto_ladder": True,
+                 "default_deadline_ms": 300.0},
+        slo=slo,
+        # release_burn 0.25: the 2.4s long window holds storm errors
+        # past the comparison window, so a cure that DILUTES the short
+        # window (gold healthy again) cannot release the ladder while
+        # the flood is still on — release happens in recovery
+        autopilot=({"period_s": 0.05, "min_dwell_s": 0.2,
+                    "engage_burn": 1.0, "release_burn": 0.25,
+                    "release_hold_s": 1.0,
+                    "rebucket_cooldown_s": 0.5,
+                    "fidelity": {"a": "a_int8"},
+                    "admission_headroom": 1.0,
+                    "spare": {"name": "a_spare", "path": dirs["a_v2"]}}
+                   if autopilot else None))
+    if flight_dir:
+        # a storm's span volume would scroll actuation events out of
+        # the default ring before the post-run read
+        flight.get_recorder().configure(dump_dir=flight_dir,
+                                        capacity=65536,
+                                        min_interval_s=0.0)
+    dumps_before = len(flight.get_recorder().dumps)
+    report: Dict[str, Any] = {"arm": "autopilot" if autopilot
+                              else "static"}
+    with TRACER.span("run:storm", category="run", new_trace=True):
+        fleet = FleetService(config).start()
+        try:
+            # gold's deadline: comfortable against a healthy member
+            # (single-digit ms), fatal against the delayed queue. A
+            # POOL of gold clients, not one: a single client stuck in a
+            # ~600ms request cycle gives the 1.2s short burn window ~2
+            # samples and the burn estimate flickers across the release
+            # threshold — four staggered clients keep the error-rate
+            # estimate dense enough to hold the ladder engaged
+            gold_a = [_LoadClient(fleet, "gold", "a", i, deadline_ms=300)
+                      for i in range(4)]
+            gold_b = _LoadClient(fleet, "gold", "b", 8)
+            gold = [*gold_a, gold_b]
+            for c in gold:
+                c.start()
+            # -- healthy phase: the controller must do NOTHING -------- #
+            time.sleep(0.6)
+            if fleet.autopilot is not None:
+                st = fleet.autopilot.status()
+                report["healthy"] = {"actuations": st["actuations"],
+                                     "rung": st["rung"]}
+            marks = {c: c.mark() for c in gold}
+            # -- flood: trial tenant offers ~100x member a's capacity - #
+            flood = [_LoadClient(fleet, "trial", "a", 10 + i,
+                                 rows=_MAX_BATCH, pace=0.004)
+                     for i in range(12)]
+            storm = FaultPlan(
+                [FaultSpec(site=f"{SITE_DEVICE_DISPATCH}#a", at=1,
+                           times=1_000_000, kind="delay",
+                           delay_s=_STORM_BATCH_S)], seed=seed)
+            t0 = time.perf_counter()
+            with storm.active():
+                for c in flood:
+                    c.start()
+                fired = _wait_slo(fleet, "gold-availability", True,
+                                  timeout_s=10.0)
+                # control-latency allowance: the ladder climbs one rung
+                # per dwell window; the static arm gets the SAME grace,
+                # then the arms are compared over the late-storm window
+                # (the flood is still on — a static config is still
+                # failing here, a controller must not be)
+                time.sleep(1.5)
+                late = {c: c.mark() for c in gold}
+                time.sleep(flood_s)
+                report["storm"] = {
+                    "slo_fired": fired,
+                    "flood_s": round(time.perf_counter() - t0, 3),
+                    "gold_a": _stats_since(gold_a, late),
+                    "gold_b": _stats_since([gold_b], late),
+                    "gold_a_whole_storm": _stats_since(gold_a, marks),
+                }
+                for c in flood:
+                    c.stop()
+                for c in flood:
+                    c.join(timeout=5)
+            # -- recovery: burn clears, the ladder walks back down ---- #
+            report["slo_cleared"] = _wait_slo(
+                fleet, "gold-availability", False, timeout_s=20.0)
+            if fleet.autopilot is not None:
+                rung0 = False
+                t1 = time.perf_counter()
+                while time.perf_counter() - t1 < 25.0:
+                    if fleet.autopilot.status()["rung"] == 0:
+                        rung0 = True
+                        break
+                    time.sleep(0.05)
+                health = fleet.health()
+                report["release"] = {
+                    "rung0": rung0,
+                    "fidelity_routes":
+                        health.get("fidelity_routes") or {},
+                    "pressure_a": fleet.router.pressure("a"),
+                    "spare_hosted": "a_spare" in fleet._live_services(),
+                }
+                # durable record of the release events before the ring
+                # scrolls them out under post-storm traffic
+                flight.request_dump("storm_end", force=True)
+            for c in gold:
+                c.stop()
+            for c in gold:
+                c.join(timeout=5)
+            report["tenants"] = {f"{c.tenant}:{c.model}:{c.idx}": c.stats()
+                                 for c in (*gold, *flood)}
+            report["shed"] = _shed_by_reason(fleet)
+            if fleet.autopilot is not None:
+                report["autopilot"] = fleet.autopilot.status()
+                new_dumps = flight.get_recorder().dumps[dumps_before:]
+                report["events"] = _collect_autopilot_events(new_dumps)
+                report["flight_dumps"] = [os.path.basename(d)
+                                          for d in new_dumps]
+        finally:
+            fleet.stop()
+    return report
+
+
+def storm_main() -> int:  # noqa: C901 (one linear acceptance script)
+    """``python -m transmogrifai_tpu.serving.chaos --storm`` — the
+    autopilot acceptance: the same seeded storm is driven at a static
+    fleet and an autopilot fleet, and the controller must measurably
+    damp what the static config cannot (`make autopilot-smoke`)."""
+    # predictive admission needs the perf model ON (chaos `main` turns
+    # it off; the storm is the one chaos path that requires it)
+    os.environ["TRANSMOGRIFAI_PERF_MODEL"] = "1"
+    from transmogrifai_tpu.perf import model as perf_model
+    with tempfile.TemporaryDirectory(prefix="storm-smoke-") as tmp:
+        os.environ.setdefault("TRANSMOGRIFAI_PERF_CORPUS_DIR",
+                              os.path.join(tmp, "perf-corpus"))
+        dirs = _train_models(tmp)
+        _storm_cost_model()
+        try:
+            static = run_storm(dirs, autopilot=False, seed=0,
+                               flight_dir=os.path.join(tmp, "flight"))
+            auto = run_storm(dirs, autopilot=True, seed=0,
+                             flight_dir=os.path.join(tmp, "flight"))
+        finally:
+            perf_model.set_model(None)
+        try:
+            s_gold = static["storm"]["gold_a"]
+            a_gold = auto["storm"]["gold_a"]
+            assert static["storm"]["slo_fired"], static["storm"]
+            assert s_gold["availability"] < 0.9, \
+                f"storm did not hurt the static arm: {s_gold}"
+            # zero actuations on a healthy fleet
+            assert auto["healthy"]["actuations"] == 0 \
+                and auto["healthy"]["rung"] == 0, auto["healthy"]
+            evs = auto["events"]
+            assert evs, "autopilot made no actuations under storm"
+            missing = [e for e in evs if "burn_window" not in e]
+            assert not missing, \
+                f"actuation events without a burn window: {missing}"
+            engages = [e for e in evs
+                       if e.get("transition") == "engage"]
+            assert engages and all(e.get("burn_window")
+                                   for e in engages), engages
+            fid = [e for e in engages if e.get("action") == "fidelity"]
+            shed_pred = auto["shed"].get("shed_predictive", 0)
+            assert fid or shed_pred > 0, \
+                f"neither fidelity downshift nor predictive shed " \
+                f"fired: {engages} {auto['shed']}"
+            # the headline: the controller damps what static cannot
+            assert a_gold["availability"] > s_gold["availability"], \
+                f"controller did not improve gold availability: " \
+                f"{a_gold} vs {s_gold}"
+            assert a_gold["p99_ms"] < s_gold["p99_ms"], \
+                f"controller did not damp gold p99: {a_gold} vs {s_gold}"
+            # full release: every actuation reversed after the storm
+            rel = auto["release"]
+            assert rel["rung0"] and not rel["fidelity_routes"] \
+                and rel["pressure_a"] == 0.0 \
+                and not rel["spare_hosted"], rel
+            assert auto["slo_cleared"], auto
+            assert any("autopilot_" in d for d in auto["flight_dumps"]), \
+                auto["flight_dumps"]
+        except AssertionError as e:
+            print(f"autopilot-smoke FAILED: {e}", file=sys.stderr)
+            for ev in auto.get("events", []):
+                print(f"  event ts={ev.get('ts_s')} "
+                      f"{ev.get('transition')}:{ev.get('action')} "
+                      f"burn={ev.get('burn')}", file=sys.stderr)
+            return 1
+        acts = {}
+        for e in auto["events"]:
+            k = f"{e.get('transition')}:{e.get('action')}"
+            acts[k] = acts.get(k, 0) + 1
+        print(f"autopilot-smoke OK: storm gold availability "
+              f"{s_gold['availability']} static -> "
+              f"{a_gold['availability']} autopilot, p99 "
+              f"{s_gold['p99_ms']}ms -> {a_gold['p99_ms']}ms; "
+              f"actuations {acts}; predictive sheds "
+              f"{auto['shed'].get('shed_predictive', 0)}; healthy-phase "
+              f"actuations 0; released to rung 0 with routes/pressure/"
+              f"spare cleared; {len(auto['flight_dumps'])} flight "
+              f"dump(s)")
+    return 0
+
+
 def main() -> int:  # noqa: C901 (one linear acceptance script)
     os.environ.setdefault("TRANSMOGRIFAI_PERF_MODEL", "0")
     with tempfile.TemporaryDirectory(prefix="chaos-smoke-") as tmp:
@@ -617,4 +987,4 @@ def main() -> int:  # noqa: C901 (one linear acceptance script)
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(storm_main() if "--storm" in sys.argv[1:] else main())

@@ -390,11 +390,16 @@ class FleetConfig:
     store_dir: Optional[str] = None
     replica: str = "r0"
     shared_quota: bool = False
+    # serving/autopilot.AutopilotParams JSON: the SLO-burn-driven
+    # supervisor (rebucket re-arm, fidelity route flips, predictive
+    # admission, warm-spare activation). None = no controller; requires
+    # an `slo` block (the burn signal it closes the loop on)
+    autopilot: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("models", "tenants", "default_tenant", "shed_watermark",
                "serving", "compile_cache", "compile_cache_dir",
                "resilience", "slo", "store_dir", "replica",
-               "shared_quota")
+               "shared_quota", "autopilot")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "FleetConfig":
@@ -487,6 +492,20 @@ class FleetService:
         self.slo_engine = None
         if self.config.slo and dict(self.config.slo).get("enabled", True):
             self._build_slo_engine()
+        # fidelity route flips (autopilot-owned): requests for a key
+        # model resolve to the mapped resident sibling (e.g. its
+        # int8-calibrated build) until cleared — a table write, no
+        # compile, no drop (the quant sibling is a separate member
+        # whose programs never adopt the f32 member's)
+        self._fidelity_routes: Dict[str, str] = {}  # guarded-by: self._lock
+        # SLO-burn autopilot (opt-in via config.autopilot; needs slo)
+        self.autopilot = None
+        if self.config.autopilot and \
+                dict(self.config.autopilot).get("enabled", True):
+            from transmogrifai_tpu.serving.autopilot import (
+                Autopilot, AutopilotParams)
+            self.autopilot = Autopilot(
+                self, AutopilotParams.from_json(self.config.autopilot))
         for name, spec in (self.config.models or {}).items():
             path, overrides = _model_spec(spec)
             self.add_model(name, path, overrides)
@@ -641,9 +660,13 @@ class FleetService:
             # roots): the engine thread has no ambient span of its own
             self.slo_engine.span = TRACER.current()
             self.slo_engine.start()
+        if self.autopilot is not None:
+            self.autopilot.start()
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
+        if self.autopilot is not None:
+            self.autopilot.stop()
         if self.slo_engine is not None:
             self.slo_engine.stop()
         if self.watchdog is not None:
@@ -717,9 +740,36 @@ class FleetService:
             model, columns, tenant=meta.get("tenant"),
             deadline_ms=meta.get("deadline_ms"), trace=trace)
 
+    def set_fidelity_route(self, model: str,
+                           target: Optional[str] = None) -> Optional[str]:
+        """Install (target given) or clear (target=None) the fidelity
+        route flip for `model`. Autopilot-owned: callers must emit the
+        actuation event that justified the flip (lint L022). Returns
+        the previous target, None if there was none."""
+        with self._lock:
+            if target is None:
+                return self._fidelity_routes.pop(model, None)
+            if target not in self._services:
+                raise ScoreError(
+                    "not_found",
+                    f"fidelity target {target!r} is not a hosted member")
+            prev = self._fidelity_routes.get(model)
+            self._fidelity_routes[model] = str(target)
+            return prev
+
+    def resolve_model(self, model: str) -> str:
+        """The member name requests for `model` actually score on."""
+        with self._lock:
+            return self._fidelity_routes.get(model, model)
+
     def _score_routed(self, model: str, n_rows: int,
                       tenant: Optional[str],
                       trace: Optional[TraceContext], member_call):
+        # predictive pressure is keyed by the REQUESTED model (the
+        # logical route key the autopilot writes against); the fidelity
+        # flip only changes which member's queue serves that traffic
+        requested = model
+        model = self.resolve_model(model)
         svc = self._service(model)
         rt: Optional[RequestTrace] = None
         if self.sampler is not None and svc.sampler is not None:
@@ -732,8 +782,16 @@ class FleetService:
             with admission:
                 queue_frac = svc._batcher.depth() / max(
                     1, svc.config.max_queue)
+                drain_s = None
+                if max(queue_frac, self.router.pressure(requested)) >= \
+                        self.router.shed_watermark:
+                    # only when a shed is plausible: the model predict
+                    # is cheap but not free, and the happy path pays
+                    # nothing for the proportional backoff hint
+                    drain_s = svc.predicted_drain_s()
                 tname = self.router.admit(tenant, n_rows,
-                                          queue_frac, model=model)
+                                          queue_frac, model=requested,
+                                          drain_s=drain_s)
         except ScoreError as e:
             # admission shed: the member never saw this request, so the
             # fleet finishes + samples the trace itself (always kept)
@@ -833,6 +891,11 @@ class FleetService:
             "tenants": self.router.snapshot(),
             "shared_programs": self.pool.report(),
         }
+        with self._lock:
+            if self._fidelity_routes:
+                out["fidelity_routes"] = dict(self._fidelity_routes)
+        if self.autopilot is not None:
+            out["autopilot"] = self.autopilot.status()
         if status == "down":
             hints = [float(m.get("retry_after_s") or 0.0)
                      for m in models.values()]

@@ -70,6 +70,12 @@ class Frontend:
         self._warmth: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
         self._refreshed = 0.0  # guarded-by: self._lock
         self._rng = random.Random(seed)  # guarded-by: self._lock
+        # fidelity route flips (autopilot-owned): requests for a key
+        # model route AND score as the mapped resident sibling (e.g.
+        # the int8-calibrated build) until the flip is cleared. Plain
+        # table write — no compile, no drop; the caller must emit the
+        # actuation event that justified it (lint L022).
+        self._route_overrides: Dict[str, str] = {}  # guarded-by: self._lock
         self._m_requests = {}  # pre-bound per (replica, wire) lazily
         self._m_latency = self.registry.histogram(
             "router_request_latency_seconds",
@@ -152,6 +158,22 @@ class Frontend:
                               n_rows, buckets)
         return score
 
+    def set_route_override(self, model: str,
+                           target: Optional[str] = None) -> Optional[str]:
+        """Install (or, with target=None, clear) a fidelity route flip
+        for `model`. Returns the previous target (None if none)."""
+        with self._lock:
+            if target is None:
+                return self._route_overrides.pop(model, None)
+            prev = self._route_overrides.get(model)
+            self._route_overrides[model] = str(target)
+            return prev
+
+    def resolve_route(self, model: str) -> str:
+        """The model name requests for `model` actually score as."""
+        with self._lock:
+            return self._route_overrides.get(model, model)
+
     def route(self, model: str, n_rows: int) -> Tuple[str, Any, bool]:
         """(replica_name, fleet, warm?) for one request. Warmest wins;
         ties break power-of-two-choices on queue depth."""
@@ -216,6 +238,7 @@ class Frontend:
               tenant: Optional[str] = None,
               deadline_ms: Optional[float] = None,
               trace: Optional[TraceContext] = None):
+        model = self.resolve_route(model)
         return self._route_and_score(
             model, len(rows or ()), "json",
             lambda fleet: fleet.score(model, rows, tenant=tenant,
@@ -227,6 +250,7 @@ class Frontend:
                       deadline_ms: Optional[float] = None,
                       trace: Optional[TraceContext] = None,
                       wire: str = "json"):
+        model = self.resolve_route(model)
         n_rows = 0
         for v in (columns or {}).values():
             n_rows = len(v) if hasattr(v, "__len__") else 0

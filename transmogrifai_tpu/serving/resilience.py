@@ -330,6 +330,15 @@ class MemberHealth:
             reasons, self._pending_dumps = self._pending_dumps, []
         for reason in reasons:
             _flight_dump(reason)
+        with self._lock:
+            # every queued reason marks an incident ENTRY, and the dump
+            # above is disk I/O of unbounded duration — if it ran past
+            # half_open_after_s the backoff would already be spent and
+            # the first post-incident request would sail through admit()
+            # as an unthrottled probe; the half-open window measures
+            # time serving while broken, so start it now
+            if self._breaker_open or self.state == QUARANTINED:
+                self._probe_anchor = time.monotonic()
 
     # -- internals (lock held) ---------------------------------------------- #
 

@@ -151,6 +151,13 @@ class Router:
         self._lock = threading.Lock()
         self._tenants: Dict[str, _TenantState] = {
             name: _TenantState(p) for name, p in (tenants or {}).items()}
+        # predictive admission pressure per model (autopilot-written):
+        # a synthetic queue fraction merged with the OBSERVED fraction
+        # at admit time, so predicted saturation sheds low classes
+        # through the exact same graded ladder before the bounded
+        # queue ever backs up. Empty map = observed-only (bit-identical
+        # to the pre-autopilot behavior).
+        self._pressure: Dict[str, float] = {}  # guarded-by: self._lock
         # anonymous/unknown tenants: unmetered but LOWEST priority by
         # default, so configured tenants outrank them under pressure
         self._default = default or TenantPolicy(
@@ -198,23 +205,54 @@ class Router:
                 1 + int(frac * (len(self._levels) - 1)))
         return self._levels[k]
 
+    def set_pressure(self, model: str, frac: float) -> None:
+        """Write the predictive admission pressure for `model` (0 or
+        negative clears it). Autopilot-owned: every write must be
+        paired with a flight-recorder actuation event naming the burn
+        window + prediction that justified it (lint L022)."""
+        with self._lock:
+            if frac <= 0.0:
+                self._pressure.pop(model, None)
+            else:
+                self._pressure[model] = min(1.0, float(frac))
+
+    def pressure(self, model: str = "") -> float:
+        """Current predictive pressure for `model` (0.0 when none)."""
+        with self._lock:
+            return self._pressure.get(model, 0.0)
+
     def admit(self, tenant: Optional[str], n_rows: int,
-              queue_frac: float, model: str = "") -> str:
+              queue_frac: float, model: str = "",
+              drain_s: Optional[float] = None) -> str:
         """Admission gate: returns the resolved tenant name or raises a
-        structured ScoreError (quota_exceeded / shed_low_priority)."""
+        structured ScoreError (quota_exceeded / shed_low_priority).
+        `queue_frac` is the observed queue fill; any predictive
+        pressure set for `model` merges in as max(). `drain_s` (the
+        perf model's predicted queue-drain seconds, when warm) turns
+        the shed backoff hint proportional instead of constant."""
         name, state = self._state(tenant)
-        floor = self._shed_floor(queue_frac)
+        pressure = self.pressure(model)
+        eff_frac = max(queue_frac, pressure)
+        floor = self._shed_floor(eff_frac)
         if floor is not None and state.policy.priority < floor:
-            self._shed(name, state, model, "shed_low_priority")
-            # backoff hint scaled by how deep past the watermark the
-            # queue is: pressure at the watermark suggests a short
-            # retry, pressure at capacity a full second
+            self._shed(name, state, model,
+                       "shed_predictive" if pressure > queue_frac
+                       else "shed_low_priority")
+            # backoff hint: predicted drain time when the model is
+            # warm; otherwise scaled by how deep past the watermark
+            # the queue is (pressure at the watermark suggests a short
+            # retry, pressure at capacity a full second)
+            if drain_s is not None:
+                hint = round(max(0.1, min(30.0, float(drain_s))), 3)
+            else:
+                hint = round(max(0.1, min(1.0, eff_frac)), 3)
             raise ScoreError(
                 "shed_low_priority",
                 f"tenant {name!r} (priority {state.policy.priority}) shed "
-                f"under queue pressure ({queue_frac:.0%} of capacity); "
-                "retry with backoff",
-                retry_after_s=round(max(0.1, min(1.0, queue_frac)), 3))
+                f"under queue pressure ({eff_frac:.0%} of capacity"
+                + (", predicted" if pressure > queue_frac else "")
+                + "); retry with backoff",
+                retry_after_s=hint)
         n_take = max(1, int(n_rows))
         if self.shared is not None and not math.isinf(state.policy.rate):
             if not self.shared.try_spend(name, n_take, state.policy.rate,

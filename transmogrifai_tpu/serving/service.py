@@ -920,6 +920,12 @@ class ScoringService:
             self._batcher.put(req)
         except ScoreError as e:
             self._shed(e.code).inc()
+            if e.code == "queue_full" and e.retry_after_s is None:
+                # proportional backoff: predicted time to drain the
+                # backlog through top-rung batches. None while the
+                # model is cold — the HTTP layer then answers its
+                # constant default, exactly the pre-model behavior.
+                e.retry_after_s = self.predicted_drain_s()
             raise
         self._m_requests.inc()
         self._m_queue.set(self._batcher.depth())
@@ -1161,6 +1167,40 @@ class ScoringService:
                         exc_info=True)
         finally:
             self._rebucket_lock.release()
+
+    def rearm_auto_rebucket(self) -> bool:
+        """Re-arm the auto-rebucket trigger after its one shot landed.
+        The shot stays one-shot ORGANICALLY (a derived ladder should not
+        churn under stable traffic); a controller that watched the
+        traffic mix shift (SLO burn) re-arms it under its own cooldown.
+        The next scored batch re-derives from the freshest size sample.
+        Returns False when there was nothing to re-arm (still armed, or
+        the auto path is off for this config)."""
+        if not self.config.auto_ladder or self.config.buckets:
+            return False
+        if not self._auto_done:
+            return False
+        self._auto_done = False
+        self._auto_next = self._auto_seen + 1
+        return True
+
+    def predicted_drain_s(self) -> Optional[float]:
+        """Predicted seconds to drain the CURRENT queue backlog through
+        top-rung batches (perf.predict_drain_seconds), clamped to
+        [0.1, 30] so a runaway fit can never tell clients to go away
+        for an hour. None while the cost model is cold."""
+        try:
+            from transmogrifai_tpu import perf
+            depth = self._batcher.depth()
+            top = max(self.ladder) if self.ladder else \
+                self.config.max_batch
+            pred = perf.predict_drain_seconds(max(1, depth), top)
+            if pred is None:
+                return None
+            return round(max(0.1, min(30.0, pred.value)), 3)
+        except Exception:
+            log.debug("drain-time prediction failed", exc_info=True)
+            return None
 
     # -- introspection ----------------------------------------------------- #
 

@@ -99,13 +99,17 @@ class ServingParams:
     # crash flight recorder config ({"enabled", "dir", "capacity",
     # "min_interval_s"}; None = enabled with defaults)
     flight: Optional[Dict[str, Any]] = None
+    # SLO-burn serving autopilot (serving/autopilot.AutopilotParams
+    # JSON; fleet runs only — needs the fleet block + an slo block to
+    # close the loop on; None = no controller)
+    autopilot: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("host", "port", "max_batch", "min_bucket", "buckets",
                "max_queue", "batch_wait_ms", "default_deadline_ms",
                "warm_on_load", "keep_versions", "auto_ladder",
                "feature_cache", "compile_cache", "compile_cache_dir",
                "warmup_manifest", "fleet", "resilience", "quantize",
-               "tracing", "slo", "flight")
+               "tracing", "slo", "flight", "autopilot")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "ServingParams":
@@ -166,6 +170,8 @@ class ServingParams:
             block.setdefault("resilience", self.resilience)
         if self.slo is not None:
             block.setdefault("slo", self.slo)
+        if self.autopilot is not None:
+            block.setdefault("autopilot", self.autopilot)
         return FleetConfig.from_json({**block, "serving": serving})
 
 
