@@ -18,7 +18,8 @@ TIER1 = set -o pipefail; rm -f /tmp/_t1.log; \
 .PHONY: lint conc-check serve-smoke fleet-smoke chaos-smoke \
 	ingest-smoke faults-smoke trace-smoke cache-smoke multichip-smoke \
 	continual-smoke costmodel-smoke roofline-smoke slo-smoke \
-	parse-smoke router-smoke pod-smoke autopilot-smoke test check
+	parse-smoke router-smoke pod-smoke autopilot-smoke fleetobs-smoke \
+	test check
 
 lint:
 	$(PY) -m transmogrifai_tpu.lint transmogrifai_tpu/
@@ -187,10 +188,23 @@ parse-smoke:
 router-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.router_smoke
 
+# fleet-observability smoke: two replica PROCESSES + a routing frontend
+# over one shared store — a sampled request's W3C traceparent crosses
+# the HTTP hop and the fleet merge stitches frontend + replica shards
+# into ONE validate-clean Perfetto trace (100% of sampled requests);
+# /metrics/fleet folds every replica's published registry snapshot; a
+# seeded storm split across both replicas fires the fleet SLO alert
+# EXACTLY once (CAS latch) and clears without re-firing; the firing
+# replica's flight dump opens a fleet incident that every peer joins
+# within the capture window, merged into one cross-host Chrome trace.
+# See transmogrifai_tpu/serving/fleetobs_smoke.py.
+fleetobs-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m transmogrifai_tpu.serving.fleetobs_smoke
+
 test:
 	@$(TIER1)
 
 check: lint conc-check serve-smoke parse-smoke fleet-smoke chaos-smoke \
 	autopilot-smoke roofline-smoke ingest-smoke cache-smoke faults-smoke \
 	trace-smoke slo-smoke multichip-smoke pod-smoke continual-smoke \
-	costmodel-smoke router-smoke test
+	costmodel-smoke router-smoke fleetobs-smoke test

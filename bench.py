@@ -35,6 +35,7 @@ import os
 import sys
 import time
 import traceback
+import uuid
 
 import numpy as np
 
@@ -1819,6 +1820,166 @@ def run_router() -> None:
             r2.stop()
 
 
+def run_fleetobs() -> None:
+    """Fleet-observability bench (`python bench.py fleetobs`): the
+    PR-20 acceptance numbers. Emits:
+
+    - ``fleetobs_overhead``: serving p99 through one replica at the
+      128-ladder config WITH trace-shard + metrics publishing on vs
+      off — the observability plane must cost < 5% p99;
+    - ``fleetobs_stitch_coverage``: fraction of sampled cross-hop
+      requests (frontend process → replica process over HTTP) whose
+      fleet-merged trace validates clean with both legs present —
+      must be 100%."""
+    import tempfile
+    import threading
+
+    from transmogrifai_tpu.serving import fleetobs_smoke
+    from transmogrifai_tpu.serving.fleet import FleetConfig, FleetService
+    from transmogrifai_tpu.serving.frontend import Frontend, HTTPReplica
+
+    platform = probe_backend()
+    duration_s = float(os.environ.get("BENCH_FLEETOBS_SECONDS", 3.0))
+    n_clients = int(os.environ.get("BENCH_FLEETOBS_CLIENTS", 4))
+    n_sampled = int(os.environ.get("BENCH_FLEETOBS_SAMPLED", 10))
+
+    with tempfile.TemporaryDirectory(prefix="bench-fleetobs-") as tmp:
+        store = f"{tmp}/store"
+        os.makedirs(store, exist_ok=True)
+        os.environ["TRANSMOGRIFAI_STORE_DIR"] = store
+        if "TRANSMOGRIFAI_PERF_CORPUS_DIR" not in os.environ:
+            os.environ["TRANSMOGRIFAI_PERF_CORPUS_DIR"] = \
+                f"{tmp}/perf-corpus"
+        fleetobs_smoke._fit_model(f"{tmp}/model")
+        cols = fleetobs_smoke._cols(4)
+
+        # -- publishing overhead at the 128-ladder config --------------- #
+        # p99 on a multi-tenant CPU box is noisy run-to-run: tail
+        # events are bursty (one scheduler stall poisons every client
+        # in flight), so even pooled p99s swing +-15% between arms
+        # measured at different moments. Estimate the overhead from
+        # PAIRED reps instead — each rep runs both arms back to back
+        # (alternating order, so allocator/GC growth doesn't fold into
+        # the delta), the rep's p99 ratio cancels the slow drift, and
+        # the median ratio across reps drops outlier reps entirely.
+        n_reps = int(os.environ.get("BENCH_FLEETOBS_REPS", 6))
+        lat_by_arm: dict = {"off": [], "on": []}
+        rep_p99: dict = {"off": [], "on": []}
+
+        def one_arm(arm: str, rep: int) -> None:
+            config = FleetConfig(
+                models={"m": f"{tmp}/model"},
+                tenants={"gold": {"priority": 1}},
+                serving={"max_batch": 128, "batch_wait_ms": 1.0,
+                         "max_queue": 1024},
+                compile_cache={"dir": f"{tmp}/compile-cache"},
+                store_dir=store, replica=f"bench-{arm}",
+                obs={"enabled": arm == "on"})
+            fleet = FleetService(config).start()
+            try:
+                lat: list = []
+                lock = threading.Lock()
+                # measure_from > now gives an unmeasured under-load
+                # warmup so the XLA compiles for every batch bucket the
+                # client mix produces land OUTSIDE the p99 window
+                measure_from = time.perf_counter() + 1.0
+                stop_at = measure_from + duration_s
+
+                def client(i: int) -> None:
+                    k = 0
+                    while time.perf_counter() < stop_at:
+                        # every 16th request rides a sampled trace so
+                        # the "on" arm actually pays shard publishing
+                        trace = (fleetobs_smoke._sampled_ctx(
+                            uuid.uuid4().hex) if k % 16 == 0 else None)
+                        k += 1
+                        t1 = time.perf_counter()
+                        try:
+                            fleet.score_columns("m", cols,
+                                                tenant="gold",
+                                                trace=trace)
+                        except Exception:
+                            continue
+                        if t1 < measure_from:
+                            continue
+                        with lock:
+                            lat.append(time.perf_counter() - t1)
+
+                threads = [threading.Thread(target=client, args=(i,),
+                                            name=f"fleetobs-{arm}-{i}")
+                           for i in range(n_clients)]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                lat_by_arm[arm].extend(lat)
+                lat.sort()
+                if lat:
+                    rep_p99[arm].append(
+                        lat[min(len(lat) - 1, int(0.99 * len(lat)))])
+            finally:
+                fleet.stop()
+
+        for rep in range(n_reps):
+            order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+            for arm in order:
+                one_arm(arm, rep)
+
+        def pooled_p99(arm: str):
+            lat = sorted(lat_by_arm[arm])
+            if not lat:
+                return None
+            return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+        on, off = pooled_p99("on"), pooled_p99("off")
+        ratios = sorted(a / b for a, b in
+                        zip(rep_p99["on"], rep_p99["off"]) if b)
+        if ratios and on is not None and off:
+            overhead = ratios[len(ratios) // 2] - 1.0
+            _emit({"metric": "fleetobs_overhead", "platform": platform,
+                   "value": round(overhead, 4), "unit": "frac",
+                   "vs_baseline": 0.0,
+                   "p99_publish_on_ms": round(on * 1e3, 3),
+                   "p99_publish_off_ms": round(off * 1e3, 3),
+                   "rep_ratios": [round(r, 3) for r in ratios],
+                   "max_batch": 128, "clients": n_clients,
+                   "reps": n_reps, "budget_frac": 0.05,
+                   "within_budget": bool(overhead < 0.05)})
+
+        # -- cross-process stitched-trace coverage ---------------------- #
+        if _remaining() < 120.0:
+            _emit({"metric": "fleetobs_skipped", "value": 1.0,
+                   "unit": "arm", "vs_baseline": 0.0,
+                   "reason": "budget"})
+            return
+        procs = {}
+        frontend = None
+        try:
+            urls = {}
+            for name in ("r1", "r2"):
+                procs[name], urls[name] = fleetobs_smoke.spawn_replica(
+                    tmp, store, name, f"{tmp}/model")
+            frontend = Frontend(
+                {n: HTTPReplica(u) for n, u in urls.items()},
+                store_dir=store)
+            cov = fleetobs_smoke._stitched(frontend, store, n_sampled)
+            _emit({"metric": "fleetobs_stitch_coverage",
+                   "platform": platform,
+                   "value": round(cov["stitched"] / max(1, cov["requests"]),
+                                  4),
+                   "unit": "frac", "vs_baseline": 0.0,
+                   "requests": cov["requests"],
+                   "stitched": cov["stitched"],
+                   "hosts": cov["sample"]["hosts"],
+                   "skew_s": cov["sample"]["skew_s"],
+                   "acceptance_min": 1.0})
+        finally:
+            if frontend is not None:
+                frontend.close()
+            for proc in procs.values():
+                fleetobs_smoke.stop_replica(proc)
+
+
 def run_chaos_bench() -> None:
     """Chaos-mode bench (`python bench.py chaos`): the numbers that make
     "graceful degradation" falsifiable. Drives the 3-model/2-tenant
@@ -2091,6 +2252,17 @@ def main() -> None:
             _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
                    "vs_baseline": 0.0,
                    "error": f"router bench failed: {type(e).__name__}: {e}",
+                   "trace_tail":
+                       traceback.format_exc().strip().splitlines()[-3:]})
+        return
+    if "fleetobs" in sys.argv[1:]:
+        try:
+            run_fleetobs()
+        except Exception as e:
+            _emit({"metric": "bench_error", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"fleetobs bench failed: "
+                            f"{type(e).__name__}: {e}",
                    "trace_tail":
                        traceback.format_exc().strip().splitlines()[-3:]})
         return

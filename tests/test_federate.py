@@ -1,0 +1,355 @@
+"""PR-20 fleet observability plane: cross-host trace-shard stitching
+(torn tails, missing shards, duplicate span ids, clock skew), the
+cross-replica histogram/registry merge algebra, the metrics snapshot
+round-trip, the fleet alert latch's exactly-once claim semantics, and
+the L023 dropped-trace-context lint.
+
+The shard failure-mode tests write shard files BY HAND (the wire format
+is the contract — a reader must survive whatever a crashed writer left
+behind), and every merge asserts ``problems == []`` through the
+Chrome-trace validator: a degraded merge must still be a valid trace.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from transmogrifai_tpu.analysis.lint import lint_source
+from transmogrifai_tpu.obs.federate import (
+    FleetAlertLatch, MetricsPublisher, TraceShardWriter,
+    aggregate_fleet_metrics, list_trace_shards, merge_fleet_trace,
+    read_trace_shard)
+from transmogrifai_tpu.obs.metrics import Histogram, MetricsRegistry
+
+TID = "ab" * 16  # a request trace id (32 hex): the shard writer's filter
+
+
+def _rec(span_id, trace_id=TID, name="serving:score", parent_id=None,
+         start=0.0, end=0.001, **attrs):
+    """A shard span record, matching federate._span_record's wire form."""
+    return {"name": name, "category": "serving", "span_id": span_id,
+            "parent_id": parent_id, "trace_id": trace_id,
+            "start_s": start, "end_s": end, "thread_id": 1,
+            "thread_name": "score-0", "attributes": attrs, "events": [],
+            "error": None}
+
+
+def _write_shard(root, host, records, epoch_time=1000.0, tail=None):
+    d = os.path.join(root, "obs", "trace")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"shard-{host}.jsonl")
+    header = {"traceshard": 1, "host": host, "pid": 1,
+              "epoch_time": epoch_time, "epoch_perf": 0.0}
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(header) + "\n")
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+        if tail is not None:
+            fh.write(tail)  # deliberately NOT newline-terminated
+    return path
+
+
+class TestTraceShardFailureModes:
+    def test_torn_tail_drops_only_the_tail(self, tmp_path):
+        root = str(tmp_path)
+        path = _write_shard(root, "h1", [_rec(1), _rec(2, parent_id=1)],
+                            tail='{"name": "half-writ')
+        header, records, torn = read_trace_shard(path)
+        assert torn
+        assert header is not None and header["host"] == "h1"
+        assert [r["span_id"] for r in records] == [1, 2]
+
+        out = merge_fleet_trace(TID, root)
+        assert out["torn_shards"] == ["h1"]
+        assert out["hosts"] == ["h1"] and out["spans"] == 2
+        assert out["problems"] == []
+
+    def test_garbage_mid_shard_stops_at_first_bad_line(self, tmp_path):
+        root = str(tmp_path)
+        path = _write_shard(root, "h1", [_rec(1)])
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write("not json at all\n")
+            fh.write(json.dumps(_rec(9)) + "\n")  # after the tear: lost
+        header, records, torn = read_trace_shard(path)
+        assert torn and len(records) == 1
+
+    def test_missing_host_shard_is_marked_never_a_hang(self, tmp_path):
+        root = str(tmp_path)
+        _write_shard(root, "h1", [_rec(1)])
+        out = merge_fleet_trace(TID, root, expect_hosts=["h1", "h2"])
+        assert out["missing_shards"] == ["h2"]
+        assert out["hosts"] == ["h1"]
+        assert out["problems"] == []
+
+    def test_empty_store_degrades_to_empty_trace(self, tmp_path):
+        out = merge_fleet_trace(TID, str(tmp_path),
+                                expect_hosts=["h1"])
+        assert out["missing_shards"] == ["h1"]
+        assert out["spans"] == 0
+        assert out["trace"]["traceEvents"] == []
+
+    def test_duplicate_span_ids_within_shard_keep_first(self, tmp_path):
+        root = str(tmp_path)
+        # a crash-replayed tail: span 1 appended twice with different
+        # attributes — the first record is the committed one
+        _write_shard(root, "h1",
+                     [_rec(1, phase="committed"),
+                      _rec(1, phase="replayed"), _rec(2)])
+        out = merge_fleet_trace(TID, root)
+        assert out["spans"] == 2
+        assert out["problems"] == []
+        names = [e for e in out["trace"]["traceEvents"]
+                 if e.get("args", {}).get("phase") == "replayed"]
+        assert not names
+
+    def test_duplicate_span_ids_across_hosts_dont_collide(self, tmp_path):
+        root = str(tmp_path)
+        # span-id counters are per process, so two hosts legitimately
+        # reuse id 1 — each host is its own pid in the merged trace
+        _write_shard(root, "h1", [_rec(1)])
+        _write_shard(root, "h2", [_rec(1)])
+        out = merge_fleet_trace(TID, root)
+        assert out["hosts"] == ["h1", "h2"] and out["spans"] == 2
+        assert out["problems"] == []
+        pids = {e["pid"] for e in out["trace"]["traceEvents"]
+                if e.get("ph") == "X"}
+        assert len(pids) == 2
+
+    def test_clock_skew_seconds_normalized_from_anchors(self, tmp_path):
+        root = str(tmp_path)
+        # h2 booted 5 wall-seconds after h1: identical perf offsets
+        # must land 5s apart on the merged fleet timeline
+        _write_shard(root, "h1", [_rec(1)], epoch_time=1000.0)
+        _write_shard(root, "h2", [_rec(1)], epoch_time=1005.0)
+        out = merge_fleet_trace(TID, root)
+        assert out["skew_s"] == {"h1": 0.0, "h2": 5.0}
+        assert out["problems"] == []
+        ts = sorted(e["ts"] for e in out["trace"]["traceEvents"]
+                    if e.get("ph") == "X")
+        assert ts[-1] - ts[0] == pytest.approx(5e6, rel=1e-6)
+
+    def test_cross_shard_parent_is_detached_not_dangling(self, tmp_path):
+        root = str(tmp_path)
+        # the remote hop: the replica's root span names the frontend's
+        # span as parent, but that span lives in the frontend's shard
+        _write_shard(root, "h1", [_rec(7, name="router:request")])
+        _write_shard(root, "h2", [_rec(3, parent_id=7,
+                                       name="serving:request")])
+        out = merge_fleet_trace(TID, root)
+        assert out["problems"] == []
+        orphans = [e for e in out["trace"]["traceEvents"]
+                   if e.get("args", {}).get("orphaned_parent") == 7]
+        assert len(orphans) == 1
+
+    def test_writer_roundtrip_and_filter(self, tmp_path):
+        root = str(tmp_path)
+        w = TraceShardWriter(root, "w1")
+        from transmogrifai_tpu.obs.trace import Span
+        kept = Span("serving:score", category="serving", trace_id=TID)
+        kept.end()
+        unkept = Span("internal", category="serving",
+                      trace_id="run-abc123")  # not a request trace id
+        unkept.end()
+        w(kept)
+        w(unkept)
+        w.close()
+        header, records, torn = read_trace_shard(
+            list_trace_shards(root)["w1"])
+        assert not torn and header["host"] == "w1"
+        assert [r["trace_id"] for r in records] == [TID]
+        assert w.stats() == {"published": 1, "skipped": 1, "errors": 0}
+
+
+class TestHistogramMergeAlgebra:
+    BOUNDS = (0.001, 0.01, 0.1, 1.0)
+
+    def _hist(self, values):
+        h = Histogram(self.BOUNDS)
+        for v in values:
+            h.observe(v)
+        return h
+
+    def test_empty_union_x_is_x(self):
+        x = self._hist([0.005, 0.05, 0.5, 5.0])
+        ref = x.summary()
+        empty = Histogram(self.BOUNDS)
+        empty.merge_from(x)
+        assert empty.summary() == ref
+        # and the other direction leaves x untouched
+        x.merge_from(Histogram(self.BOUNDS))
+        assert x.summary() == ref
+
+    def test_commutative(self):
+        a_vals = [0.0005, 0.02, 0.02, 0.3]
+        b_vals = [0.004, 0.09, 2.0]
+        ab = self._hist(a_vals)
+        ab.merge_from(self._hist(b_vals))
+        ba = self._hist(b_vals)
+        ba.merge_from(self._hist(a_vals))
+        assert ab.summary() == ba.summary()
+        assert ab.bucket_counts() == ba.bucket_counts()
+
+    def test_associative(self):
+        vals = ([0.0001, 0.5], [0.03, 0.03, 0.7], [1.5, 0.002])
+        left = self._hist(vals[0])
+        left.merge_from(self._hist(vals[1]))
+        left.merge_from(self._hist(vals[2]))
+        bc = self._hist(vals[1])
+        bc.merge_from(self._hist(vals[2]))
+        right = self._hist(vals[0])
+        right.merge_from(bc)
+        assert left.summary() == right.summary()
+        assert left.bucket_counts() == right.bucket_counts()
+
+    def test_counts_sum_exactly(self):
+        a = self._hist([0.005] * 7 + [0.5] * 3)
+        b = self._hist([0.005] * 11 + [3.0] * 2)
+        a.merge_from(b)
+        assert a.count == 23
+        assert a.bucket_counts()[-1][1] == 23
+        # per-bucket: cumulative counts are the exact sums
+        assert dict(a.bucket_counts())[0.01] == 18
+
+    def test_mismatched_ladders_refused(self):
+        a = Histogram((0.001, 0.01))
+        b = Histogram((0.5, 1.0))
+        with pytest.raises(ValueError, match="bounds differ"):
+            a.merge_from(b)
+
+
+class TestMetricsFederation:
+    def test_snapshot_roundtrip_and_fleet_sum(self, tmp_path):
+        root = str(tmp_path)
+        regs = {}
+        for name, n in (("r1", 3), ("r2", 5)):
+            reg = MetricsRegistry()
+            c = reg.counter("requests_total", "requests", tenant="gold")
+            for _ in range(n):
+                c.inc()
+            h = reg.histogram("latency_s", "latency")
+            h.observe(0.01 * n)
+            regs[name] = reg
+            pub = MetricsPublisher(root, name, lambda r=reg: r)
+            assert pub.publish_once()
+        merged, info = aggregate_fleet_metrics(root)
+        assert set(info) == {"r1", "r2"}
+        snap = merged.snapshot()
+        series = snap["requests_total"]["series"]
+        assert [s["value"] for s in series
+                if s["labels"] == {"tenant": "gold"}] == [8.0]
+        hist = snap["latency_s"]["series"][0]["state"]
+        assert hist["count"] == 2
+        assert hist["sum"] == pytest.approx(0.03 + 0.05)
+
+    def test_atomic_publish_never_reads_torn(self, tmp_path):
+        root = str(tmp_path)
+        reg = MetricsRegistry()
+        reg.counter("x", "x").inc()
+        pub = MetricsPublisher(root, "r1", lambda: reg)
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                merged, info = aggregate_fleet_metrics(root)
+                if info and "r1" not in info:
+                    bad.append(info)
+
+        th = threading.Thread(target=reader)
+        th.start()
+        try:
+            for _ in range(50):
+                assert pub.publish_once()
+        finally:
+            stop.set()
+            th.join()
+        assert not bad
+
+
+class TestFleetAlertLatch:
+    def test_exactly_one_claimant_per_transition(self, tmp_path):
+        root = str(tmp_path)
+        a = FleetAlertLatch(root, name="t")
+        b = FleetAlertLatch(root, name="t")
+        claimed_a, fired_a = a.transition("avail", "firing", "rA")
+        claimed_b, fired_b = b.transition("avail", "firing", "rB")
+        assert claimed_a and not claimed_b
+        assert fired_a == 1 and fired_b == 1
+        row = a.counts()["avail"]
+        assert row["state"] == "firing" and row["owner"] == "rA"
+
+        # resolve, then a second genuine incident increments fired
+        assert b.transition("avail", "ok", "rB")[0]
+        claimed, fired = a.transition("avail", "firing", "rA")
+        assert claimed and fired == 2
+
+    def test_concurrent_claim_race_yields_one_winner(self, tmp_path):
+        root = str(tmp_path)
+        results = []
+        lock = threading.Lock()
+
+        def claimant(name):
+            latch = FleetAlertLatch(root, name="race")
+            got = latch.transition("avail", "firing", name)
+            with lock:
+                results.append((name, got))
+
+        threads = [threading.Thread(target=claimant, args=(f"r{i}",))
+                   for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        winners = [n for n, (claimed, _) in results if claimed]
+        assert len(winners) == 1, results
+        assert all(fired == 1 for _, (_, fired) in results)
+
+
+class TestL023DroppedTraceContext:
+    PATH = "transmogrifai_tpu/serving/somefile.py"
+
+    def _findings(self, src, path=None):
+        """Gating L023 findings: suppressed ones don't fail CI."""
+        return [f for f in lint_source(src, path or self.PATH)
+                if f.code == "L023" and f.suppression is None]
+
+    def test_manual_uuid_trace_id_flagged(self):
+        src = ("import uuid\n"
+               "from transmogrifai_tpu.obs.trace import TRACER\n"
+               "def f():\n"
+               "    with TRACER.span('x', trace_id=uuid.uuid4().hex):\n"
+               "        pass\n")
+        assert len(self._findings(src)) == 1
+
+    def test_literal_trace_id_flagged(self):
+        src = ("def f(tracer):\n"
+               "    tracer.span('x', trace_id='deadbeef' * 4)\n")
+        assert len(self._findings(src)) == 1
+
+    def test_suppression_comment_accepted(self):
+        src = ("def f(tracer):\n"
+               "    tracer.span('x',  # trace-ok: synthetic load id\n"
+               "                trace_id='deadbeef' * 4)\n")
+        assert not self._findings(src)
+        # the finding is still reported, just marked suppressed
+        marked = [f for f in lint_source(src, self.PATH)
+                  if f.code == "L023"]
+        assert [f.suppression for f in marked] == ["annotation"]
+
+    def test_propagated_trace_id_passes(self):
+        src = ("def f(tracer, rt):\n"
+               "    tracer.span('x', trace_id=rt.trace_id)\n")
+        assert not self._findings(src)
+
+    def test_out_of_scope_dir_ignored(self):
+        src = "def f(t):\n    t.span('x', trace_id='ab' * 16)\n"
+        assert not self._findings(
+            src, path="transmogrifai_tpu/perf/somefile.py")
+
+    def test_tests_and_smokes_exempt(self):
+        src = "def f(t):\n    t.span('x', trace_id='ab' * 16)\n"
+        assert not self._findings(
+            src, path="transmogrifai_tpu/serving/fleetobs_smoke.py")
+        assert not self._findings(src, path="tests/test_x.py")

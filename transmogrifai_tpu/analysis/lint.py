@@ -228,6 +228,21 @@ silent slowness or nondeterminism once XLA is in the loop:
   ``# autopilot-ok: <why>``. ``serving/autopilot.py``, smoke/chaos
   drivers and tests are allowlisted.
 
+- ``L023 dropped-trace-context``: a span-opening or event-emission call
+  (``TRACER.span``/``Span``/``RequestTrace``/``record_event``/
+  ``emit_event``/``add_event``) in ``serving/``/``parallel/``/
+  ``continual/`` that passes a MANUAL trace id — a string literal,
+  f-string, concatenation, or a fresh ``new_run_id()``/
+  ``new_trace_id()``/``uuid*`` — instead of joining the ambient
+  contextvar parent. A hand-built trace id severs the cross-process
+  stitch: the span lands in the trace shard under an id
+  ``merge_fleet_trace`` will never be asked for, and the request's
+  remote leg goes missing from the merged timeline. Join the current
+  trace (omit ``trace_id``; pass a ``TraceContext``/parent span;
+  ``new_trace=True`` roots deliberately), or annotate
+  ``# trace-ok: <why>``. Smoke/chaos drivers and tests are
+  allowlisted.
+
 Classes that set ``jittable = False`` in their body are exempt from
 L001/L002 (their device_apply runs eagerly on host, where numpy and
 Python control flow are legal).
@@ -1806,6 +1821,83 @@ def _check_unlogged_actuations(tree: ast.AST, path: str,
     return findings
 
 
+# -- L023: manual trace ids that sever the ambient trace context ------------ #
+
+_L023_OPENERS = {"span", "Span", "RequestTrace", "record_event",
+                 "emit_event", "add_event"}
+_L023_GENERATORS = {"new_run_id", "new_trace_id", "uuid1", "uuid3",
+                    "uuid4", "uuid5", "hex", "token_hex"}
+_L023_DIRS = {"serving", "parallel", "continual"}
+_L023_OK_RE = re.compile(r"#\s*trace-ok\b")
+
+
+def _l023_suppressed(lines: Sequence[str], lineno: int) -> bool:
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and _L023_OK_RE.search(lines[ln - 1]):
+            return True
+    return False
+
+
+def _l023_manual_id(node: ast.AST) -> bool:
+    """A trace-id VALUE that was hand-built rather than derived from
+    live context: string literals/templates/concats and fresh
+    id-generator calls flag; attribute reads (``rt.trace_id``,
+    ``ctx.trace_id``) and plain names pass — they carry an id that
+    already exists somewhere upstream."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str)
+    if isinstance(node, (ast.JoinedStr, ast.BinOp)):
+        return True
+    if isinstance(node, ast.Call):
+        leaf = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+        return leaf in _L023_GENERATORS
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Call):
+        # `uuid.uuid4().hex`: a fresh-id call dressed as an attribute
+        # read — still hand-built, unlike `rt.trace_id` (Name-rooted)
+        return _l023_manual_id(node.value)
+    return False
+
+
+def _check_dropped_trace_context(tree: ast.AST, path: str,
+                                 lines: Sequence[str]
+                                 ) -> List[LintFinding]:
+    """Flag span/event calls that pass a manual trace-id string instead
+    of the ambient contextvar parent — see module docstring (L023)."""
+    parts = os.path.normpath(path).split(os.sep)
+    base = parts[-1]
+    if not _L023_DIRS.intersection(parts[:-1]):
+        return []
+    if base in ("smoke.py", "chaos.py") or base.endswith("_smoke.py") \
+            or "tests" in parts or "testkit" in parts:
+        return []
+    findings: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = (_dotted(node.func) or "").rsplit(".", 1)[-1]
+        if leaf not in _L023_OPENERS:
+            continue
+        for kw in node.keywords:
+            if kw.arg != "trace_id" or not _l023_manual_id(kw.value):
+                continue
+            lineno = getattr(node, "lineno", 0)
+            findings.append(LintFinding(
+                path, lineno, "L023",
+                f"`{leaf}(...)` passes a manual trace id instead of "
+                f"the ambient trace context — a hand-built id severs "
+                f"the cross-process stitch: the span lands in the "
+                f"trace shard under an id merge_fleet_trace will "
+                f"never be asked for, and the request's remote leg "
+                f"goes missing from the merged timeline; join the "
+                f"current trace (omit trace_id, pass a TraceContext/"
+                f"parent span, or root deliberately with "
+                f"new_trace=True) or annotate `# trace-ok: <why>`",
+                suppression=("annotation"
+                             if _l023_suppressed(lines, lineno)
+                             else None)))
+    return findings
+
+
 # -- driver ----------------------------------------------------------------- #
 
 def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
@@ -1836,6 +1928,8 @@ def lint_source(src: str, path: str = "<string>") -> List[LintFinding]:
     linter.findings.extend(_check_blind_poll_loops(
         tree, path, src.splitlines()))
     linter.findings.extend(_check_unlogged_actuations(
+        tree, path, src.splitlines()))
+    linter.findings.extend(_check_dropped_trace_context(
         tree, path, src.splitlines()))
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.code))
 

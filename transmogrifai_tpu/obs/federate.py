@@ -1,0 +1,837 @@
+"""Fleet-wide observability federation over the shared ``store/`` tier.
+
+PR-14 built a per-process observability plane (traceparent tracing,
+``/metrics``, the SLO engine, the flight recorder); PRs 17-19 made the
+system a fleet. This module federates the plane through the same
+no-leader shared-directory pattern the lease scheduler and perf corpus
+already use — no collector daemon, no push gateway, just crash-
+consistent files under ``<store>/obs/`` plus CAS ``StateCell``s:
+
+- **Trace stitching** (`TraceShardWriter` / `merge_fleet_trace`): every
+  process appends the completed spans of *kept* request traces to a
+  host-qualified JSONL shard (torn-tail-tolerant, same discipline as
+  the pod journal shards). A reader assembles ONE
+  `validate_chrome_trace`-clean Perfetto trace for a trace id across
+  frontend, replica scoring threads, and sweep lanes, normalizing
+  clock skew from each shard's (epoch-wall, epoch-perf) anchor pair.
+- **Metrics federation** (`MetricsPublisher` /
+  `aggregate_fleet_metrics`): replicas publish full-fidelity
+  `MetricsRegistry` snapshots (counters, gauges, mergeable histogram
+  buckets) on a cadence; the frontend serves the merged registry on
+  ``/metrics/fleet``.
+- **Incident correlation** (`IncidentCoordinator` / `merge_incident`):
+  a flight-recorder trigger on any member publishes an incident id
+  through a `StateCell`; peers that see it within the capture window
+  dump their rings keyed by that id, and `merge_incident` emits one
+  cross-host Chrome trace from all contributed dumps.
+- **Fleet alert dedup** (`FleetAlertLatch`): a CAS latch so the
+  fleet-level SLO alert is emitted by exactly one replica per
+  transition, not K times.
+
+`FleetObs` bundles writer + publisher + incident coordinator behind
+one start()/stop() pair for `serving/fleet.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from transmogrifai_tpu.obs import trace as trace_mod
+from transmogrifai_tpu.obs.export import (chrome_trace, merge_chrome_traces,
+                                          validate_chrome_trace)
+from transmogrifai_tpu.obs.metrics import MetricsRegistry
+from transmogrifai_tpu.obs.trace import Span, TRACER
+from transmogrifai_tpu.store.state import StateCell
+
+log = logging.getLogger(__name__)
+
+# Request traces (RequestTrace roots and their children) carry 32-hex
+# uuid trace ids; ambient TRACER.span() spans carry 12-hex run ids. The
+# shard writer keys on this: only spans of kept REQUEST traces — the
+# ones the tail sampler decided to publish via Tracer.collect() — match.
+_REQUEST_TRACE_RE = re.compile(r"^[0-9a-f]{32}$")
+
+_HOST_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+def _safe_host(host: str) -> str:
+    if not _HOST_RE.match(host or ""):
+        raise ValueError(f"host name {host!r} is not path-safe")
+    return host
+
+
+def _obs_dir(root: str, *parts: str) -> str:
+    path = os.path.join(root, "obs", *parts)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+# --------------------------------------------------------------------------- #
+# Trace shards: crash-consistent span publishing                              #
+# --------------------------------------------------------------------------- #
+
+class TraceShardWriter:
+    """A `Tracer` sink appending kept-trace spans to this host's shard.
+
+    The shard is a JSONL file ``<root>/obs/trace/shard-<host>.jsonl``:
+    a header line carrying the host's clock anchors (wall epoch + perf
+    epoch taken at the same instant, so readers can shift every span
+    onto one fleet timeline), then one record per finished span. Writes
+    are append+flush per record under a lock; fsync happens on a
+    background syncer thread (at most ~2/s) so span collection on the
+    request path never stalls on disk latency — traces are best-effort
+    diagnostics, unlike the completion journal, and the torn-tail
+    reader drops a half-written last line the same way the journal
+    reader does.
+    """
+
+    FSYNC_INTERVAL_S = 0.5
+
+    def __init__(self, root: str, host: str):
+        self.root = str(root)
+        self.host = _safe_host(host)
+        self.path = os.path.join(_obs_dir(self.root, "trace"),
+                                 f"shard-{self.host}.jsonl")
+        self._lock = threading.Lock()
+        self._fh = None            # guarded-by: _lock
+        self._dirty = False        # guarded-by: _lock
+        self._syncer = None        # guarded-by: _lock
+        self._stop = threading.Event()
+        self.published = 0         # guarded-by: _lock
+        self.skipped = 0           # guarded-by: _lock
+        self.errors = 0            # guarded-by: _lock
+
+    # -- sink protocol ------------------------------------------------------ #
+
+    def __call__(self, span: Span) -> None:
+        """Tracer sink: called for every finished span, outside the
+        tracer's lock. Filters to completed spans of request traces."""
+        tid = getattr(span, "trace_id", None)
+        if not (isinstance(tid, str) and _REQUEST_TRACE_RE.match(tid)) \
+                or span.end_s is None:
+            with self._lock:
+                self.skipped += 1
+            return
+        rec = _span_record(span)
+        line = json.dumps(rec, default=str) + "\n"
+        with self._lock:
+            try:
+                # single-owner append file: the open happens once per
+                # process and every writer must serialize on it anyway
+                fh = self._ensure_open()  # conc-ok: C003
+                fh.write(line)
+                fh.flush()
+                self._dirty = True
+                self.published += 1
+            except Exception:
+                self.errors += 1
+                log.debug("federate: trace shard write failed",
+                          exc_info=True)
+
+    def _ensure_open(self):
+        # guarded-by: _lock (callers hold it)
+        if self._fh is None:
+            fresh = not os.path.exists(self.path) or \
+                os.path.getsize(self.path) == 0
+            self._fh = open(self.path, "a",  # guarded-by: _lock
+                            encoding="utf-8")
+            if fresh:
+                header = {"traceshard": 1, "host": self.host,
+                          "pid": os.getpid(),
+                          "epoch_time": trace_mod._EPOCH_TIME,
+                          "epoch_perf": trace_mod._EPOCH_PERF}
+                self._fh.write(json.dumps(header) + "\n")
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            if self._syncer is None:
+                self._syncer = threading.Thread(
+                    target=self._sync_loop,
+                    name=f"traceshard-sync-{self.host}", daemon=True)
+                self._syncer.start()
+        return self._fh
+
+    def _sync_loop(self) -> None:
+        # background durability: writers only write+flush; this thread
+        # pays the fsync so sampled requests never stall on disk
+        while not self._stop.wait(self.FSYNC_INTERVAL_S):
+            self._sync_once()
+        self._sync_once()
+
+    def _sync_once(self) -> None:
+        with self._lock:
+            if not self._dirty or self._fh is None:
+                return
+            try:
+                # off the request path: only the syncer thread blocks
+                os.fsync(self._fh.fileno())  # conc-ok: C003
+                self._dirty = False
+            except OSError:
+                self.errors += 1
+                log.debug("federate: shard fsync failed", exc_info=True)
+
+    # -- lifecycle ---------------------------------------------------------- #
+
+    def install(self) -> None:
+        TRACER.add_sink(self)
+
+    def close(self) -> None:
+        TRACER.remove_sink(self)
+        self._stop.set()
+        syncer = self._syncer
+        if syncer is not None and syncer is not threading.current_thread():
+            syncer.join(timeout=2.0)
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    # final durability point of a single-owner shard
+                    # file; nothing else contends
+                    os.fsync(self._fh.fileno())  # conc-ok: C003
+                    self._fh.close()
+                except OSError:
+                    log.debug("federate: shard close failed",
+                              exc_info=True)
+                self._fh = None
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"published": self.published, "skipped": self.skipped,
+                    "errors": self.errors}
+
+
+def _span_record(span: Span) -> Dict[str, Any]:
+    """Wire form of a finished span: perf-clock offsets (shiftable by
+    the shard's anchors), never the derived wall strings."""
+    return {
+        "name": span.name, "category": span.category,
+        "span_id": span.span_id, "parent_id": span.parent_id,
+        "trace_id": span.trace_id,
+        "start_s": span.start_s, "end_s": span.end_s,
+        "thread_id": span.thread_id, "thread_name": span.thread_name,
+        "attributes": dict(span.attributes),
+        "events": [[n, t, dict(a)] for (n, t, a) in span.events],
+        "error": span.error,
+    }
+
+
+def read_trace_shard(path: str
+                     ) -> Tuple[Optional[Dict[str, Any]],
+                                List[Dict[str, Any]], bool]:
+    """Torn-tail-tolerant shard read (the journal idiom): a record
+    counts only if it is newline-terminated AND parses; reading stops
+    at the first bad line. Returns (header, records, torn) — header is
+    None when even the first line is unusable."""
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except OSError:
+        return None, [], True
+    header: Optional[Dict[str, Any]] = None
+    records: List[Dict[str, Any]] = []
+    torn = False
+    for i, line in enumerate(raw.splitlines(keepends=True)):
+        if not line.endswith(b"\n"):
+            torn = True
+            break
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            torn = True
+            break
+        if not isinstance(rec, dict):
+            torn = True
+            break
+        if i == 0:
+            if rec.get("traceshard") != 1:
+                return None, [], True
+            header = rec
+        else:
+            records.append(rec)
+    return header, records, torn
+
+
+def list_trace_shards(root: str) -> Dict[str, str]:
+    """{host: shard path} for every shard under the store root."""
+    d = os.path.join(root, "obs", "trace")
+    out: Dict[str, str] = {}
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("shard-") and name.endswith(".jsonl"):
+            out[name[len("shard-"):-len(".jsonl")]] = os.path.join(d, name)
+    return out
+
+
+def _span_from_record(rec: Dict[str, Any], shift_s: float) -> Optional[Span]:
+    """Reconstruct a Span from a shard record, shifting its perf-clock
+    offsets by the shard's skew onto the fleet timeline."""
+    try:
+        sp = Span(str(rec["name"]),
+                  category=str(rec.get("category") or "span"),
+                  trace_id=str(rec.get("trace_id") or ""))
+        sp.span_id = int(rec["span_id"])
+        pid = rec.get("parent_id")
+        sp.parent_id = int(pid) if pid is not None else None
+        sp.start_s = float(rec["start_s"]) + shift_s
+        sp.end_s = float(rec["end_s"]) + shift_s
+        sp.thread_id = int(rec.get("thread_id") or 0)
+        sp.thread_name = str(rec.get("thread_name") or "thread")
+        attrs = rec.get("attributes")
+        sp.attributes = dict(attrs) if isinstance(attrs, dict) else {}
+        sp.events = []
+        for ev in rec.get("events") or []:
+            try:
+                name, t, a = ev
+                sp.events.append((str(name), float(t) + shift_s,
+                                  dict(a) if isinstance(a, dict) else {}))
+            except (TypeError, ValueError):
+                continue
+        err = rec.get("error")
+        sp.error = str(err) if err is not None else None
+        return sp
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def merge_fleet_trace(trace_id: str, root: str,
+                      expect_hosts: Optional[List[str]] = None
+                      ) -> Dict[str, Any]:
+    """Assemble ONE Chrome trace for ``trace_id`` from every host shard
+    under ``root``.
+
+    Pure file reads over whatever shards exist right now — a missing or
+    unreadable host shard degrades the result (named in
+    ``missing_shards``), it never blocks or hangs. Clock skew is
+    normalized from each shard's (epoch_time, epoch_perf) anchors: the
+    earliest-booted host is the reference, every other shard's spans
+    shift by its wall-epoch delta. Each host becomes its own Perfetto
+    process (pid = shard index), so duplicate span ids across hosts
+    cannot collide (span ids are per-pid in the validator); duplicate
+    ids WITHIN a shard (a crash-replayed tail) keep the first record.
+    Spans whose parent did not land in the same shard are detached and
+    marked ``orphaned_parent`` — cross-process causality stays visible
+    through the shared trace id and the ``parent_traceparent``
+    attribute the wire hop stamps on the remote root.
+    """
+    shards = list_trace_shards(root)
+    hosts_found: List[str] = []
+    torn_shards: List[str] = []
+    per_host: List[Tuple[str, List[Span]]] = []
+    skew: Dict[str, float] = {}
+
+    anchors: Dict[str, Tuple[Dict[str, Any], List[Dict[str, Any]]]] = {}
+    for host, path in shards.items():
+        header, records, torn = read_trace_shard(path)
+        if torn:
+            torn_shards.append(host)
+        if header is None:
+            continue
+        matching = [r for r in records if r.get("trace_id") == trace_id]
+        if matching:
+            anchors[host] = (header, matching)
+
+    ref_epoch: Optional[float] = None
+    for host, (header, _) in anchors.items():
+        try:
+            e = float(header["epoch_time"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        ref_epoch = e if ref_epoch is None else min(ref_epoch, e)
+
+    for host in sorted(anchors):
+        header, matching = anchors[host]
+        try:
+            shift = float(header["epoch_time"]) - (ref_epoch or 0.0)
+        except (KeyError, TypeError, ValueError):
+            shift = 0.0
+        skew[host] = shift
+        seen_ids: set = set()
+        spans: List[Span] = []
+        for rec in matching:
+            sp = _span_from_record(rec, shift)
+            if sp is None or sp.span_id in seen_ids:
+                continue
+            seen_ids.add(sp.span_id)
+            spans.append(sp)
+        # detach parents that never landed in THIS shard — the
+        # validator requires same-pid parents, and cross-host links
+        # ride the trace id, not the span tree
+        for sp in spans:
+            if sp.parent_id is not None and sp.parent_id not in seen_ids:
+                sp.attributes = dict(sp.attributes)
+                sp.attributes["orphaned_parent"] = sp.parent_id
+                sp.parent_id = None
+        if spans:
+            hosts_found.append(host)
+            per_host.append((host, spans))
+
+    traces = [chrome_trace(spans, process_name=f"host:{host}", pid=i)
+              for i, (host, spans) in enumerate(per_host)]
+    merged = merge_chrome_traces(*traces) if traces else {"traceEvents": []}
+    missing = sorted(set(expect_hosts or []) - set(hosts_found))
+    return {
+        "trace_id": trace_id,
+        "trace": merged,
+        "hosts": hosts_found,
+        "spans": sum(len(s) for _, s in per_host),
+        "missing_shards": missing,
+        "torn_shards": sorted(torn_shards),
+        "skew_s": skew,
+        "problems": validate_chrome_trace(merged),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Metrics federation                                                          #
+# --------------------------------------------------------------------------- #
+
+class MetricsPublisher:
+    """Periodic full-fidelity `MetricsRegistry` snapshots to the store.
+
+    One JSON file per replica under ``<root>/obs/metrics/``, replaced
+    atomically (tmp + ``os.replace``) so readers never see a torn
+    snapshot. ``snapshot_fn`` returns the registry (or an already-built
+    snapshot dict) to publish — evaluated on the publisher thread, so
+    it must be cheap and lock-clean."""
+
+    def __init__(self, root: str, replica: str,
+                 snapshot_fn: Callable[[], Any],
+                 period_s: float = 1.0):
+        self.root = str(root)
+        self.replica = _safe_host(replica)
+        self.snapshot_fn = snapshot_fn
+        self.period_s = max(0.05, float(period_s))
+        self.path = os.path.join(_obs_dir(self.root, "metrics"),
+                                 f"{self.replica}.json")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.publishes = 0   # publisher-thread only
+        self.errors = 0      # publisher-thread only
+
+    def publish_once(self) -> bool:
+        try:
+            snap = self.snapshot_fn()
+            if isinstance(snap, MetricsRegistry):
+                snap = snap.snapshot()
+            doc = {"replica": self.replica, "ts": time.time(),
+                   "pid": os.getpid(), "registry": snap}
+            tmp = self.path + f".tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, default=str)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+            self.publishes += 1
+            return True
+        except Exception:
+            self.errors += 1
+            log.debug("federate: metrics publish failed", exc_info=True)
+            return False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="metrics-publisher",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 2.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+            self._thread = None
+        self.publish_once()  # final snapshot so a clean stop is current
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.publish_once()
+
+
+def read_metrics_snapshots(root: str) -> List[Dict[str, Any]]:
+    """Every replica's last-published snapshot doc (unparseable or
+    half-written files are skipped — `os.replace` makes those rare)."""
+    d = os.path.join(root, "obs", "metrics")
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json") or ".tmp." in name:
+            continue
+        try:
+            with open(os.path.join(d, name), "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("registry"), dict):
+            out.append(doc)
+    return out
+
+
+def aggregate_fleet_metrics(root: str,
+                            base: Optional[MetricsRegistry] = None
+                            ) -> Tuple[MetricsRegistry, Dict[str, Any]]:
+    """Merge every published replica snapshot into one registry.
+
+    Counters with identical labels sum; histograms merge bucket-exact
+    (same bounds) or keep replica-labeled series (different bounds);
+    gauges stay replica-labeled — a mean of gauges is a lie. Returns
+    the merged registry plus {replica: publish wall-ts} provenance."""
+    merged = MetricsRegistry()
+    if base is not None:
+        merged.merge(base)
+    info: Dict[str, Any] = {}
+    for doc in read_metrics_snapshots(root):
+        replica = str(doc.get("replica") or "unknown")
+        restored = MetricsRegistry.from_snapshot(doc["registry"])
+        merged.merge(restored, replica=replica)
+        info[replica] = doc.get("ts")
+    return merged, info
+
+
+# --------------------------------------------------------------------------- #
+# Fleet alert latch: one transition, one emitter                              #
+# --------------------------------------------------------------------------- #
+
+class FleetAlertLatch:
+    """CAS latch deduplicating fleet-level SLO alert emissions.
+
+    Every replica evaluates the same fleet-folded burn state, so on a
+    threshold crossing K replicas want to fire the same alert. The
+    latch is one `StateCell` holding per-SLO {state, owner, ts, fired}:
+    `transition` returns claimed=True for exactly the replica whose CAS
+    write moved the recorded state — only the claimant emits the alert
+    event / flight dump; the rest keep their local bookkeeping quiet.
+    """
+
+    def __init__(self, root: str, name: str = "default"):
+        self.cell = StateCell(root, f"slo-fleet-alert-{name}")
+
+    def transition(self, slo: str, state: str, owner: str
+                   ) -> Tuple[bool, int]:
+        """Record `slo` entering `state`. Returns (claimed, fired_count)
+        — claimed iff THIS call moved the recorded state. The CAS
+        transform may run multiple times on contention; the last
+        invocation's view is the committed one, so a peer winning the
+        same transition mid-retry correctly yields claimed=False."""
+        claim = {"claimed": False, "fired": 0}
+
+        def put(cur):
+            cur = dict(cur) if isinstance(cur, dict) else {}
+            slos = dict(cur.get("slos") or {})
+            rec = dict(slos.get(slo) or {})
+            claim["claimed"] = rec.get("state") != state
+            if claim["claimed"]:
+                rec["state"] = state
+                rec["owner"] = owner
+                rec["ts"] = time.time()
+                if state == "firing":
+                    rec["fired"] = int(rec.get("fired") or 0) + 1
+            claim["fired"] = int(rec.get("fired") or 0)
+            slos[slo] = rec
+            cur["slos"] = slos
+            return cur
+
+        try:
+            self.cell.update(put)
+        except Exception:
+            log.debug("federate: alert latch CAS failed", exc_info=True)
+            return False, claim["fired"]
+        return claim["claimed"], claim["fired"]
+
+    def counts(self) -> Dict[str, Dict[str, Any]]:
+        _, value = self.cell.read()
+        slos = (value or {}).get("slos") if isinstance(value, dict) else None
+        return dict(slos or {})
+
+
+# --------------------------------------------------------------------------- #
+# Incident correlation                                                        #
+# --------------------------------------------------------------------------- #
+
+_INCIDENT_REASON_RE = re.compile(r"[^A-Za-z0-9_-]+")
+
+
+class IncidentCoordinator:
+    """One incident id, K ring dumps, one merged artifact.
+
+    A flight-recorder trigger anywhere in the fleet calls `publish`:
+    the CAS cell either opens a new incident (fresh id) or joins the
+    currently-open one (within `capture_window_s` — a storm tripping K
+    breakers is ONE incident, not K). Every member then dumps its ring
+    under ``<root>/obs/incidents/<id>/<host>/``; a watcher thread makes
+    members that did NOT trip contribute their rings too, as long as
+    they notice within the window."""
+
+    def __init__(self, root: str, host: str,
+                 capture_window_s: float = 10.0,
+                 recorder=None, poll_s: float = 0.5):
+        self.root = str(root)
+        self.host = _safe_host(host)
+        self.capture_window_s = float(capture_window_s)
+        self.poll_s = max(0.05, float(poll_s))
+        if recorder is None:
+            from transmogrifai_tpu.obs.flight import RECORDER
+            recorder = RECORDER
+        self.recorder = recorder
+        self.cell = StateCell(self.root, "obs-incident")
+        self._lock = threading.Lock()
+        self._contributed: set = set()   # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- publishing --------------------------------------------------------- #
+
+    def publish(self, reason: str) -> Optional[str]:
+        """Open (or join) an incident and contribute this host's ring.
+        Returns the incident id, or None when coordination failed."""
+        safe_reason = _INCIDENT_REASON_RE.sub("_", str(reason))[:48] or "x"
+        fresh_id = uuid.uuid4().hex[:12]
+        out = {"id": None}
+
+        def put(cur):
+            cur = dict(cur) if isinstance(cur, dict) else {}
+            inc = cur.get("incident")
+            now = time.time()
+            if isinstance(inc, dict) and inc.get("id") and \
+                    now - float(inc.get("ts") or 0.0) < self.capture_window_s:
+                out["id"] = str(inc["id"])   # join the open incident
+                return cur
+            out["id"] = fresh_id
+            cur["incident"] = {"id": fresh_id, "reason": safe_reason,
+                               "host": self.host, "ts": now,
+                               "seq": int(cur.get("seq") or 0) + 1}
+            cur["seq"] = int(cur.get("seq") or 0) + 1
+            return cur
+
+        try:
+            self.cell.update(put)
+        except Exception:
+            log.debug("federate: incident publish failed", exc_info=True)
+            return None
+        incident_id = out["id"]
+        if incident_id:
+            self._contribute(incident_id, safe_reason)
+        return incident_id
+
+    def _contribute(self, incident_id: str, reason: str) -> None:
+        with self._lock:
+            if incident_id in self._contributed:
+                return
+            self._contributed.add(incident_id)
+        out_dir = os.path.join(_obs_dir(self.root, "incidents",
+                                        incident_id), self.host)
+        try:
+            self.recorder.dump(reason=f"incident-{reason}",
+                               out_dir=out_dir, force=True)
+        except Exception:
+            log.debug("federate: incident ring dump failed", exc_info=True)
+
+    # -- the flight-recorder hook ------------------------------------------- #
+
+    def on_flight_dump(self, reason: str, path: str) -> None:
+        """`FlightRecorder.on_dump` hook: any organic dump (breaker
+        open, watchdog restart, SLO alert, SIGTERM) ALSO opens/joins a
+        fleet incident — except dumps this coordinator itself asked
+        for, which would recurse."""
+        if str(reason).startswith("incident"):
+            return
+        self.publish(reason)
+
+    # -- the peer watcher --------------------------------------------------- #
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._watch,
+                                        name="incident-watcher",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 2.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+            self._thread = None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                _, value = self.cell.read()
+            except (OSError, ValueError):
+                log.debug("federate: incident cell read failed",
+                          exc_info=True)
+                continue
+            inc = (value or {}).get("incident") \
+                if isinstance(value, dict) else None
+            if not isinstance(inc, dict) or not inc.get("id"):
+                continue
+            # cross-process age: the publisher's epoch stamp against
+            # our epoch clock — wall time is the only shared clock
+            wall_now = time.time()
+            if wall_now - float(inc.get("ts") or 0.0) \
+                    >= self.capture_window_s:
+                continue
+            self._contribute(str(inc["id"]),
+                             str(inc.get("reason") or "peer"))
+
+
+def merge_incident(incident_id: str, root: str) -> Dict[str, Any]:
+    """One cross-host Chrome trace from every ring dump contributed
+    under ``<root>/obs/incidents/<incident_id>/``.
+
+    Each host's flight dump already validates standalone; the merge
+    re-pids them (one Perfetto process per dump) and shifts every
+    timestamp by the dump's wall-epoch anchor delta so the fleet shares
+    one timeline. Pure file reads — missing or torn dumps are skipped
+    and named, never waited on."""
+    base = os.path.join(root, "obs", "incidents", str(incident_id))
+    dumps: List[Tuple[str, str, Dict[str, Any], Dict[str, Any]]] = []
+    problems_reading: List[str] = []
+    try:
+        host_names = sorted(os.listdir(base))
+    except OSError:
+        host_names = []
+    for host in host_names:
+        host_dir = os.path.join(base, host)
+        if not os.path.isdir(host_dir):
+            continue
+        for dump_name in sorted(os.listdir(host_dir)):
+            dump_dir = os.path.join(host_dir, dump_name)
+            try:
+                with open(os.path.join(dump_dir, "trace.json"),
+                          "r", encoding="utf-8") as fh:
+                    tr = json.load(fh)
+                with open(os.path.join(dump_dir, "meta.json"),
+                          "r", encoding="utf-8") as fh:
+                    meta = json.load(fh)
+            except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+                problems_reading.append(f"{host}/{dump_name}")
+                continue
+            if isinstance(tr, dict) and isinstance(meta, dict):
+                dumps.append((host, dump_name, tr, meta))
+
+    ref_epoch: Optional[float] = None
+    for _, _, _, meta in dumps:
+        try:
+            e = float(meta["epoch_time"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        ref_epoch = e if ref_epoch is None else min(ref_epoch, e)
+
+    shifted: List[Dict[str, Any]] = []
+    hosts: List[str] = []
+    for i, (host, dump_name, tr, meta) in enumerate(dumps):
+        try:
+            shift_us = int((float(meta["epoch_time"]) -
+                            (ref_epoch or 0.0)) * 1e6)
+        except (KeyError, TypeError, ValueError):
+            shift_us = 0
+        events = []
+        for ev in tr.get("traceEvents") or []:
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            ev["pid"] = i
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = int(ev["ts"]) + shift_us
+            events.append(ev)
+        # re-name the process row for the merged view
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                args = dict(ev.get("args") or {})
+                args["name"] = f"{host}:{args.get('name', dump_name)}"
+                ev["args"] = args
+        shifted.append({"traceEvents": events})
+        if host not in hosts:
+            hosts.append(host)
+
+    merged = merge_chrome_traces(*shifted) if shifted \
+        else {"traceEvents": []}
+    return {
+        "incident_id": str(incident_id),
+        "trace": merged,
+        "hosts": hosts,
+        "dumps": [f"{h}/{d}" for h, d, _, _ in dumps],
+        "unreadable": problems_reading,
+        "problems": validate_chrome_trace(merged),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# The bundle                                                                  #
+# --------------------------------------------------------------------------- #
+
+class FleetObs:
+    """Writer + publisher + incident coordinator behind one switch.
+
+    `serving/fleet.py` owns one of these per process when a store dir
+    is configured: `start()` installs the trace-shard sink on the
+    global tracer, starts the metrics publisher thread, hooks the
+    flight recorder's dump callback into the incident cell, and starts
+    the peer watcher; `stop()` unwinds all of it in reverse."""
+
+    def __init__(self, root: str, host: str,
+                 snapshot_fn: Callable[[], Any],
+                 metrics_period_s: float = 1.0,
+                 capture_window_s: float = 10.0,
+                 recorder=None):
+        self.root = str(root)
+        self.host = _safe_host(host)
+        self.writer = TraceShardWriter(self.root, self.host)
+        self.publisher = MetricsPublisher(self.root, self.host,
+                                          snapshot_fn,
+                                          period_s=metrics_period_s)
+        self.incidents = IncidentCoordinator(
+            self.root, self.host, capture_window_s=capture_window_s,
+            recorder=recorder)
+        self._started = False
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self.writer.install()
+        self.publisher.start()
+        rec = self.incidents.recorder
+        hooks = getattr(rec, "on_dump", None)
+        if isinstance(hooks, list) and \
+                self.incidents.on_flight_dump not in hooks:
+            hooks.append(self.incidents.on_flight_dump)
+        self.incidents.start()
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self.incidents.stop()
+        rec = self.incidents.recorder
+        hooks = getattr(rec, "on_dump", None)
+        if isinstance(hooks, list):
+            try:
+                hooks.remove(self.incidents.on_flight_dump)
+            except ValueError:
+                pass
+        self.publisher.stop()
+        self.writer.close()
+
+    def stats(self) -> Dict[str, Any]:
+        return {"host": self.host,
+                "trace": self.writer.stats(),
+                "metrics_publishes": self.publisher.publishes,
+                "metrics_errors": self.publisher.errors}

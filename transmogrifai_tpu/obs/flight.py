@@ -89,6 +89,10 @@ class FlightRecorder:
         self._last_dump_s: Optional[float] = None
         self._dump_lock = threading.Lock()  # dumps only — never the feed
         self._seq = 0
+        # called (reason, committed_path) after every successful dump;
+        # the fleet incident coordinator hooks here. Exceptions eaten —
+        # a bad hook must not fail the artifact that already committed.
+        self.on_dump: List[Any] = []
 
     def configure(self, dump_dir: Optional[str] = None,
                   capacity: Optional[int] = None,
@@ -229,12 +233,19 @@ class FlightRecorder:
                              **labels}, default=repr) + "\n")
             with open(os.path.join(staged, "meta.json"), "w",
                       encoding="utf-8") as fh:
+                from transmogrifai_tpu.obs import trace as _trace_mod
                 json.dump({
                     "reason": reason, "at": time.time(), "pid": os.getpid(),
                     "records": len(records),
                     "capacity": self.capacity,
                     "records_seen": self.records_seen,
                     "dropped": max(0, self.records_seen - len(records)),
+                    # clock anchors: this process's wall epoch and the
+                    # perf-clock zero all ts_s offsets count from — the
+                    # cross-host incident merge shifts every dump onto
+                    # one fleet timeline with these
+                    "epoch_time": _trace_mod._EPOCH_TIME,
+                    "epoch_perf": _trace_mod._EPOCH_PERF,
                 }, fh)
             commit_staged_dir(staged, final)
         except BaseException:
@@ -243,6 +254,11 @@ class FlightRecorder:
         self.dumps.append(final)
         log.warning("flight: dumped %d record(s) to %s (reason: %s)",
                     len(records), final, reason)
+        for hook in list(self.on_dump):
+            try:
+                hook(reason, final)
+            except Exception:
+                log.debug("flight: on_dump hook failed", exc_info=True)
         try:
             from transmogrifai_tpu.obs.export import emit_event
             emit_event("flight_dump", reason=reason, path=final,

@@ -264,6 +264,47 @@ class Histogram:
             out.append((bound, eid, v, ts))
         return out
 
+    # -- full-fidelity wire form (fleet federation) -------------------------- #
+
+    def state(self) -> Dict[str, Any]:
+        """Lossless JSON-able form: raw per-bucket counts (NOT the
+        cumulative export form, and not `summary()`'s quantile digests)
+        plus bounds/sum/count/min/max/exemplars — exactly what
+        `merge_from` needs on the other side of a file."""
+        with self._lock:
+            return {
+                "bounds": list(self.bounds),
+                "counts": list(self._counts),
+                "sum": self._sum, "count": self._count,
+                "min": self._min, "max": self._max,
+                "exemplars": [[i, eid, v, ts]
+                              for i, (eid, v, ts)
+                              in sorted(self._exemplars.items())],
+            }
+
+    @classmethod
+    def from_state(cls, d: Dict[str, Any]) -> "Histogram":
+        """Inverse of `state()`. Raises ValueError on malformed input
+        (wrong counts length, bad bounds) — a corrupt snapshot must not
+        silently misplace buckets."""
+        h = cls(bounds=tuple(float(b) for b in d["bounds"]))
+        counts = [int(c) for c in d["counts"]]
+        if len(counts) != len(h.bounds) + 1:
+            raise ValueError(
+                f"histogram state has {len(counts)} counts for "
+                f"{len(h.bounds)} bounds")
+        with h._lock:
+            h._counts = counts
+            h._sum = float(d.get("sum") or 0.0)
+            h._count = int(d.get("count") or 0)
+            mn, mx = d.get("min"), d.get("max")
+            h._min = float(mn) if mn is not None else None
+            h._max = float(mx) if mx is not None else None
+            for ex in d.get("exemplars") or []:
+                i, eid, v, ts = ex
+                h._exemplars[int(i)] = (str(eid), float(v), float(ts))
+        return h
+
 
 class MetricsRegistry:
     """Named, labeled metric families with dual JSON/Prometheus export."""
@@ -398,6 +439,62 @@ class MetricsRegistry:
                     # alive, drop the conflicting series
                     continue
         return self
+
+    # -- full-fidelity wire form (fleet federation) ------------------------- #
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Lossless JSON-able form of every family, for cross-process
+        publication. Unlike `to_json()` (quantile digests, no raw
+        buckets) a snapshot round-trips through `from_snapshot` and
+        merges bucket-exact on the reader side."""
+        with self._lock:
+            families = {n: (f["type"], f["help"], dict(f["series"]))
+                        for n, f in self._families.items()}
+        out: Dict[str, Any] = {}
+        for name, (mtype, help_, series) in sorted(families.items()):
+            entries = []
+            for key, metric in sorted(series.items()):
+                entry: Dict[str, Any] = {"labels": dict(key)}
+                if mtype == "histogram":
+                    entry["state"] = metric.state()
+                else:
+                    entry["value"] = metric.value
+                entries.append(entry)
+            out[name] = {"type": mtype, "help": help_, "series": entries}
+        return out
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any]) -> "MetricsRegistry":
+        """Rebuild a registry from `snapshot()` output. Malformed
+        series are skipped (a reader aggregating K replica files must
+        survive one bad snapshot), malformed top-level shapes yield an
+        empty registry."""
+        reg = cls()
+        if not isinstance(snap, dict):
+            return reg
+        for name, fam in snap.items():
+            if not isinstance(fam, dict):
+                continue
+            mtype = fam.get("type")
+            help_ = str(fam.get("help") or "")
+            for entry in fam.get("series") or []:
+                try:
+                    labels = dict(entry.get("labels") or {})
+                    if mtype == "counter":
+                        reg.counter(name, help_, **labels).inc(
+                            float(entry["value"]))
+                    elif mtype == "gauge":
+                        reg.gauge(name, help_, **labels).set(
+                            float(entry["value"]))
+                    elif mtype == "histogram":
+                        h = Histogram.from_state(entry["state"])
+                        reg.histogram(name, help_, bounds=h.bounds,
+                                      **labels).merge_from(h)
+                    else:
+                        continue
+                except (KeyError, TypeError, ValueError):
+                    continue
+        return reg
 
     # -- export ----------------------------------------------------------- #
 

@@ -32,6 +32,18 @@ GoodputReport ``slo`` section rolled from those events. The chaos
 harness (`serving/chaos.py`) proves the loop: a seeded device-error
 storm must fire the availability alert during the storm and clear it
 after recovery.
+
+Burn state is FLEET-WIDE, not per-replica: with `attach_fleet` each
+replica CAS-publishes its cumulative good/total per SLO through a
+`StateCell`, folds the cross-replica sum into a second sample ring, and
+JUDGES that fleet ring with the same multi-window recipe — so a split
+overload (each replica under threshold, the fleet over it) still pages.
+The fleet alert is deduplicated through a CAS latch
+(`obs.federate.FleetAlertLatch`): K replicas all see the crossing, ONE
+emits the ``slo_alert`` event/flight dump. A stale cell (no recent
+replica publishes) marks the fleet view not-fresh — consumers like the
+autopilot fall back to LOCAL burn rather than reading silence as
+health.
 """
 
 from __future__ import annotations
@@ -273,7 +285,12 @@ class SLOEngine:
         # folds the cell's sum into a second, fleet-wide sample ring
         self._fleet_cell = None        # guarded-by: self._lock
         self._fleet_replica = ""       # guarded-by: self._lock
+        self._fleet_latch = None       # guarded-by: self._lock
         self._fleet_states: Dict[str, _SLOState] = {}  # engine thread only
+        # wall-clock freshness of the fleet fold: consumers must never
+        # read a dead cell's frozen burn as "healthy fleet"
+        self._fleet_last_fold = 0.0    # engine thread only
+        self._fleet_fresh_replicas = 0  # engine thread only
         max_window = max((w.long_s for w in self.windows), default=60.0)
         self._max_window_s = max_window
         for slo in self.params.build_slos():
@@ -303,15 +320,20 @@ class SLOEngine:
                      name: str = "default") -> "SLOEngine":
         """Share burn state across replicas through a `StateCell` on the
         shared store. Each `evaluate()` tick CAS-publishes this
-        replica's cumulative good/total per SLO, then folds the cell's
-        cross-replica sum into a fleet sample ring — `/slo` (`status()`)
-        reports fleet-wide burn beside the local one. Cumulative sums
-        mean a restarted replica's counter reset shows up as a no-delta
-        window (no data), not a phantom recovery."""
+        replica's cumulative good/total per SLO, folds the cell's
+        cross-replica sum into a fleet sample ring, and JUDGES that
+        ring with the same multi-window recipe — `/slo` (`status()`)
+        reports fleet-wide burn and alert state beside the local one,
+        and the fleet alert is emitted by exactly ONE replica (CAS
+        latch). Cumulative sums mean a restarted replica's counter
+        reset shows up as a no-delta window (no data), not a phantom
+        recovery."""
+        from transmogrifai_tpu.obs.federate import FleetAlertLatch
         from transmogrifai_tpu.store.state import StateCell
         with self._lock:
             self._fleet_cell = StateCell(store_root, f"slo-fleet-{name}")
             self._fleet_replica = str(replica)
+            self._fleet_latch = FleetAlertLatch(store_root, name=name)
         return self
 
     def _fleet_tick(self, states: List["_SLOState"], now: float) -> None:
@@ -338,6 +360,12 @@ class SLOEngine:
             log.debug("slo: fleet cell publish failed", exc_info=True)
             return
         reps = (merged or {}).get("replicas") or {}
+        wall_now = time.time()
+        horizon = self._fleet_fresh_horizon_s()
+        self._fleet_last_fold = wall_now
+        self._fleet_fresh_replicas = sum(
+            1 for rep in reps.values()
+            if wall_now - float(rep.get("ts") or 0.0) <= horizon)
         for st in states:
             good = total = 0.0
             n = 0
@@ -356,6 +384,69 @@ class SLOEngine:
             if len(fst.samples) > fst.max_samples:
                 del fst.samples[:len(fst.samples) - fst.max_samples]
             fst.replicas = n
+            self._judge_fleet(fst, now)
+
+    def _fleet_fresh_horizon_s(self) -> float:
+        """How stale the fleet fold / a replica's publish may be before
+        the fleet view stops counting as live."""
+        return max(2.0, 10.0 * self.params.eval_period_s)
+
+    def fleet_fresh(self) -> bool:
+        """True while the fleet fold is recent AND at least one replica
+        published within the horizon — the autopilot's gate for
+        preferring fleet burn over local. Engine-thread state read
+        without the lock (floats/ints, torn reads are benign)."""
+        with self._lock:
+            if self._fleet_cell is None:
+                return False
+        horizon = self._fleet_fresh_horizon_s()
+        # cross-process freshness: the fold's epoch stamp against our
+        # epoch clock — wall time is the only clock replicas share
+        wall_now = time.time()
+        return (wall_now - self._fleet_last_fold <= horizon
+                and self._fleet_fresh_replicas >= 1)
+
+    def _judge_fleet(self, fst: _SLOState, now: float) -> None:
+        """Judge the fleet-folded ring with the same multi-window
+        recipe as `_judge`, but dedupe the EMISSION through the CAS
+        latch: every replica flips its local fleet bookkeeping, exactly
+        one gets claimed=True per transition and emits the alert event
+        + flight dump. Engine-thread only."""
+        budget = fst.slo.budget
+        fired: List[str] = []
+        for w in self.windows:
+            long_rate = fst.window_rate(now, w.long_s)
+            short_rate = fst.window_rate(now, w.short_s)
+            if long_rate is None or short_rate is None:
+                continue
+            if long_rate / budget >= w.burn \
+                    and short_rate / budget >= w.burn:
+                fired.append(f"{w.severity}:{w.long_s:g}s")
+        was = fst.firing
+        fst.fired_windows = fired
+        fst.firing = bool(fired)
+        if fst.firing == was:
+            return
+        state = "firing" if fst.firing else "resolved"
+        if fst.firing:
+            fst.fired_at = now
+        fst.last_change = now
+        with self._lock:
+            latch = self._fleet_latch
+            replica = self._fleet_replica
+        claimed = True
+        if latch is not None:
+            claimed, _ = latch.transition(
+                fst.slo.name, "firing" if fst.firing else "ok", replica)
+        if claimed:
+            if fst.firing:
+                fst.alerts += 1
+            self._note_alert(fst, state, now, scope="fleet")
+        if self.registry is not None:
+            self.registry.gauge(
+                "slo_fleet_alert_active",
+                "1 while the fleet-level SLO alert is firing",
+                slo=fst.slo.name).set(1.0 if fst.firing else 0.0)
 
     # -- evaluation ---------------------------------------------------------- #
 
@@ -400,11 +491,15 @@ class SLOEngine:
             self._note_alert(st, "resolved", now)
         self._gauges(st, now)
 
-    def _note_alert(self, st: _SLOState, state: str, now: float) -> None:
+    def _note_alert(self, st: _SLOState, state: str, now: float,
+                    scope: str = "local") -> None:
         attrs: Dict[str, Any] = {
             "slo": st.slo.name, "state": state,
             "objective": st.slo.objective,
             "windows": ",".join(st.fired_windows)}
+        if scope != "local":
+            attrs["scope"] = scope
+            attrs["replicas"] = st.replicas
         if state == "resolved" and st.fired_at is not None:
             attrs["alert_s"] = round(now - st.fired_at, 3)
         try:
@@ -424,12 +519,14 @@ class SLOEngine:
             # else (breaker, watchdog) trips
             try:
                 from transmogrifai_tpu.obs import flight
-                flight.request_dump("slo_alert")
+                flight.request_dump("slo_alert" if scope == "local"
+                                    else "fleet_slo_alert")
             except Exception:  # best-effort black box
                 log.debug("flight dump on slo alert failed",
                           exc_info=True)
         log.log(logging.WARNING if state == "firing" else logging.INFO,
-                "slo: %s %s (%s)", st.slo.name, state,
+                "slo: %s%s %s (%s)", st.slo.name,
+                "" if scope == "local" else f" [{scope}]", state,
                 attrs.get("windows") or "recovered")
 
     def _gauges(self, st: _SLOState, now: float) -> None:
@@ -494,15 +591,29 @@ class SLOEngine:
             fst = self._fleet_states.get(st.slo.name)
             if fst is not None:
                 fleet_burns = {}
+                fleet_windows = {}
                 for w in self.windows:
                     rate = fst.window_rate(now, w.long_s)
+                    srate = fst.window_rate(now, w.short_s)
                     fleet_burns[f"{w.long_s:g}s"] = (
                         None if rate is None
                         else round(rate / budget, 4))
+                    fleet_windows[f"{w.long_s:g}s/{w.short_s:g}s"] = {
+                        "threshold": w.burn, "severity": w.severity,
+                        "long_burn": (None if rate is None
+                                      else round(rate / budget, 4)),
+                        "short_burn": (None if srate is None
+                                       else round(srate / budget, 4)),
+                    }
                 slos[st.slo.name]["fleet"] = {
                     "replicas": fst.replicas,
                     "burn": fleet_burns,
+                    "windows": fleet_windows,
+                    "state": "firing" if fst.firing else "ok",
+                    "fired_windows": list(fst.fired_windows),
+                    "alerts": fst.alerts,
                     "samples": len(fst.samples),
+                    "fresh": self.fleet_fresh(),
                 }
         out = {"slos": slos,
                "windows": [w.to_json() for w in self.windows],

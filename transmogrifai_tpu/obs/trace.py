@@ -51,9 +51,10 @@ from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
                     Tuple)
 
 __all__ = ["Span", "Tracer", "TRACER", "get_tracer", "current_span",
-           "add_event", "new_run_id", "now_s", "new_trace_id",
-           "span_id_hex", "parse_traceparent", "format_traceparent",
-           "TraceContext", "RequestTrace", "TracingParams", "TailSampler"]
+           "add_event", "ambient_traceparent", "new_run_id", "now_s",
+           "new_trace_id", "span_id_hex", "parse_traceparent",
+           "format_traceparent", "TraceContext", "RequestTrace",
+           "TracingParams", "TailSampler"]
 
 # one process epoch for both clocks: export timestamps are
 # perf_counter-relative to this origin, mapped onto the epoch origin
@@ -344,6 +345,17 @@ def add_event(name: str, **attributes: Any) -> bool:
         return False
     sp.event(name, **attributes)
     return True
+
+
+def ambient_traceparent() -> Optional[str]:
+    """The calling context's current span as a W3C ``traceparent``
+    header value, or None with no span open — how out-of-band state
+    (pod lease claims, published records) stamps the trace it belongs
+    to without threading a span handle through every signature."""
+    sp = TRACER.current()
+    if sp is None:
+        return None
+    return format_traceparent(sp.trace_id, sp.span_id, sampled=True)
 
 
 # -- request-scoped tracing --------------------------------------------------- #

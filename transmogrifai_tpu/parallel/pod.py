@@ -134,7 +134,7 @@ class PodCoordinator:
         already holds (requeue-within-host); False when another host
         owns it live or it is already done/failed — the caller drops
         the block locally."""
-        status = self.table.acquire(key)
+        status = self.table.acquire(key, meta=self._lease_meta())
         if status in ("acquired", "takeover", "held"):
             with self._lock:
                 self._held.add(key)
@@ -144,11 +144,25 @@ class PodCoordinator:
 
     def claim_any(self, prefer: Optional[List[str]] = None) -> Optional[str]:
         """Cross-host steal: claim any pool or expired block."""
-        key = self.table.claim(prefer=prefer)
+        key = self.table.claim(prefer=prefer, meta=self._lease_meta())
         if key is not None:
             with self._lock:
                 self._held.add(key)
         return key
+
+    @staticmethod
+    def _lease_meta() -> Optional[Dict[str, str]]:
+        """Ambient trace context stamped into the lease record: when a
+        sweep lane claims a block under a sampled request/sweep span,
+        the lease carries the W3C ``traceparent``, so the fleet trace
+        merge (obs/federate.py) can attribute remote block work to the
+        driving trace. None (no stamp) outside any span."""
+        try:
+            from transmogrifai_tpu.obs.trace import ambient_traceparent
+            tp = ambient_traceparent()
+        except Exception:
+            return None
+        return {"traceparent": tp} if tp else None
 
     def complete(self, key: str) -> None:
         """Mark `key` done fleet-wide. Callers MUST have made the

@@ -207,8 +207,15 @@ class Autopilot:
         """(signal, justifying window). The signal is the max over every
         SLO's burn windows of min(long_burn, short_burn) / threshold —
         >= 1.0 exactly when some window fires (both of its rates over
-        budget) — and the window dict names the SLO, window key, and
-        the measured rates, embedded verbatim in actuation events.
+        budget) — and the window dict names the SLO, window key, scope,
+        and the measured rates, embedded verbatim in actuation events.
+
+        Each SLO contributes its FLEET burn windows when the engine's
+        fleet fold is attached and fresh (K replicas' traffic summed —
+        the controller damps the fleet's burn, not its 1/K local
+        shadow of it); a stale cell falls back to the LOCAL windows, so
+        a dead publisher degrades sensing to per-replica instead of
+        reading frozen fleet counters as health.
 
         Returns ``(None, None)`` on a SENSING GAP: no engine, a failed
         status read, or every window missing a rate (a rate is None
@@ -226,7 +233,15 @@ class Autopilot:
             return None, None
         best, best_window, sensed = 0.0, None, False
         for name, slo in (status.get("slos") or {}).items():
-            for wkey, w in (slo.get("windows") or {}).items():
+            fleet = slo.get("fleet") or {}
+            scope, windows = "local", slo.get("windows") or {}
+            fw = fleet.get("windows") or {}
+            if fleet.get("fresh") and any(
+                    w.get("long_burn") is not None
+                    and w.get("short_burn") is not None
+                    for w in fw.values()):
+                scope, windows = "fleet", fw
+            for wkey, w in windows.items():
                 long_b = w.get("long_burn")
                 short_b = w.get("short_burn")
                 if long_b is None or short_b is None:
@@ -237,7 +252,10 @@ class Autopilot:
                     / max(1e-9, threshold)
                 if signal > best:
                     best = signal
-                    best_window = {"slo": name, "window": wkey, **w}
+                    best_window = {"slo": name, "window": wkey,
+                                   "scope": scope, **w}
+                    if scope == "fleet":
+                        best_window["replicas"] = fleet.get("replicas")
         if not sensed:
             return None, None
         return best, best_window

@@ -39,16 +39,24 @@ sites (`runtime/faults`):
    admission, warm spare), gold availability and p99 beat the static
    arm, and every actuation is released after the storm.
 
+7. **Fleet-observability storm** (``--fleet``): delegates to
+   `serving/fleetobs_smoke.py` — a seeded error storm against TWO
+   replica processes sharing one store must fire the FLEET
+   availability alert exactly once (CAS-latch dedup, not once per
+   replica), clear it, and leave ONE merged cross-host incident
+   artifact.
+
 `make chaos-smoke` runs ``main()`` (scenarios 1-4 with hard
 assertions); `make autopilot-smoke` runs ``storm_main()`` (scenario 6,
-static arm vs autopilot arm); ``python bench.py chaos`` reuses
-`run_chaos` + `run_continual_crash` and emits per-tenant availability,
-p99, breaker transition counts, MTTR, and the goodput resilience
-section into the bench payload; ``python bench.py autopilot`` emits
-the storm comparison.
+static arm vs autopilot arm); `make fleetobs-smoke` runs scenario 7;
+``python bench.py chaos`` reuses `run_chaos` + `run_continual_crash`
+and emits per-tenant availability, p99, breaker transition counts,
+MTTR, and the goodput resilience section into the bench payload;
+``python bench.py autopilot`` emits the storm comparison.
 
 Run: ``JAX_PLATFORMS=cpu python -m transmogrifai_tpu.serving.chaos``
-(``--storm`` for the autopilot acceptance)
+(``--storm`` for the autopilot acceptance, ``--fleet`` for the
+fleet-observability acceptance)
 """
 
 from __future__ import annotations
@@ -986,5 +994,15 @@ def main() -> int:  # noqa: C901 (one linear acceptance script)
     return 0
 
 
+def fleet_main() -> int:
+    """Scenario 7: the fleet-observability storm (2 replica processes,
+    one fleet alert, one incident artifact) — the full script lives in
+    `serving/fleetobs_smoke.py`."""
+    from transmogrifai_tpu.serving import fleetobs_smoke
+    return fleetobs_smoke.main()
+
+
 if __name__ == "__main__":
+    if "--fleet" in sys.argv[1:]:
+        sys.exit(fleet_main())
     sys.exit(storm_main() if "--storm" in sys.argv[1:] else main())

@@ -395,11 +395,16 @@ class FleetConfig:
     # admission, warm-spare activation). None = no controller; requires
     # an `slo` block (the burn signal it closes the loop on)
     autopilot: Optional[Dict[str, Any]] = None
+    # obs/federate.FleetObs knobs (requires store_dir): trace-shard
+    # publishing, metrics snapshots, incident correlation. Keys:
+    # enabled (default True when store_dir is set), metrics_period_s,
+    # capture_window_s
+    obs: Optional[Dict[str, Any]] = None
 
     _FIELDS = ("models", "tenants", "default_tenant", "shed_watermark",
                "serving", "compile_cache", "compile_cache_dir",
                "resilience", "slo", "store_dir", "replica",
-               "shared_quota", "autopilot")
+               "shared_quota", "autopilot", "obs")
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "FleetConfig":
@@ -506,6 +511,23 @@ class FleetService:
                 Autopilot, AutopilotParams)
             self.autopilot = Autopilot(
                 self, AutopilotParams.from_json(self.config.autopilot))
+        # fleet observability federation (trace shards + metrics
+        # snapshots + incident correlation) over the shared store
+        self.fleetobs = None
+        obs_cfg = dict(self.config.obs or {})
+        if self.config.store_dir and obs_cfg.get("enabled", True):
+            try:
+                from transmogrifai_tpu.obs.federate import FleetObs
+                self.fleetobs = FleetObs(
+                    self.config.store_dir, self.config.replica,
+                    snapshot_fn=self._obs_snapshot,
+                    metrics_period_s=float(
+                        obs_cfg.get("metrics_period_s", 1.0)),
+                    capture_window_s=float(
+                        obs_cfg.get("capture_window_s", 10.0)))
+            except Exception:
+                log.warning("fleet: observability federation disabled "
+                            "(setup failed)", exc_info=True)
         for name, spec in (self.config.models or {}).items():
             path, overrides = _model_spec(spec)
             self.add_model(name, path, overrides)
@@ -547,8 +569,18 @@ class FleetService:
                 engine.set_source(slo.name, staleness_source(
                     get_registry(), "continual_staleness_current_seconds",
                     slo.threshold_s))
-        from transmogrifai_tpu.obs.slo import maybe_attach_fleet
-        maybe_attach_fleet(engine)
+        if self.config.store_dir:
+            # a configured store IS the fleet: share burn state (and the
+            # fleet alert latch) through it directly — the env-var path
+            # stays for processes without a FleetConfig
+            try:
+                engine.attach_fleet(self.config.store_dir,
+                                    self.config.replica)
+            except Exception:
+                log.debug("fleet: slo fleet attach failed", exc_info=True)
+        else:
+            from transmogrifai_tpu.obs.slo import maybe_attach_fleet
+            maybe_attach_fleet(engine)
         self.slo_engine = engine
 
     # -- membership -------------------------------------------------------- #
@@ -662,6 +694,8 @@ class FleetService:
             self.slo_engine.start()
         if self.autopilot is not None:
             self.autopilot.start()
+        if self.fleetobs is not None:
+            self.fleetobs.start()
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
@@ -669,6 +703,8 @@ class FleetService:
             self.autopilot.stop()
         if self.slo_engine is not None:
             self.slo_engine.stop()
+        if self.fleetobs is not None:
+            self.fleetobs.stop()
         if self.watchdog is not None:
             self.watchdog.stop()
         with self._lock:
@@ -910,3 +946,29 @@ class FleetService:
         return {"fleet": self.registry.to_json(),
                 "models": {name: svc.registry.to_json()
                            for name, svc in services.items()}}
+
+    def _obs_snapshot(self):
+        """What this replica publishes to the metrics federation: the
+        fleet registry (tenant/model-labeled series) plus every
+        member's serving_* registry labeled by model. Runs on the
+        publisher thread — reads only, never blocks a scoring path."""
+        snap = MetricsRegistry()
+        snap.merge(self.registry)
+        for name, svc in self._live_services().items():
+            snap.merge(svc.registry, model=name)
+        return snap
+
+    def fleet_metrics_json(self) -> Dict[str, Any]:
+        """The aggregated `/metrics/fleet` payload: every replica's
+        last-published snapshot merged (counters summed, histograms
+        bucket-merged, gauges replica-labeled), with per-replica
+        publish timestamps as provenance. Requires a store_dir."""
+        if not self.config.store_dir:
+            raise ScoreError(
+                "not_found",
+                "no store_dir configured: metrics federation is off")
+        from transmogrifai_tpu.obs.federate import aggregate_fleet_metrics
+        merged, info = aggregate_fleet_metrics(self.config.store_dir)
+        return {"replica": self.config.replica,
+                "replicas": info,
+                "fleet": merged.to_json()}

@@ -24,11 +24,24 @@ then handed to the replica as columns (no JSON re-encode on the hop).
 
 `/metrics` on the frontend HTTP server is the fleet-wide view:
 `MetricsRegistry.merge()` over every replica registry (counters sum,
-gauges keep a `replica` label, histograms merge buckets).
+gauges keep a `replica` label, histograms merge buckets). With a
+``store_dir`` the frontend also federates: ``/metrics/fleet`` serves
+the replicas' PUBLISHED snapshots (works across processes, where
+in-process registry merging can't reach), and a sampled request's
+frontend leg is appended to the store's ``frontend`` trace shard so
+`obs.federate.merge_fleet_trace` stitches frontend → replica into one
+Perfetto timeline.
+
+Remote replicas are first-class: `HTTPReplica` wraps a replica fleet's
+base URL behind the same `health()`/`score*()` surface the in-process
+`FleetService` exposes — the replica hop forwards the W3C
+``traceparent`` (frontend request root as the parent), which is what
+makes the cross-process stitch possible.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import random
 import threading
@@ -37,14 +50,16 @@ from http.server import ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from transmogrifai_tpu.obs.metrics import MetricsRegistry
-from transmogrifai_tpu.obs.trace import TRACER, TraceContext
+from transmogrifai_tpu.obs.trace import (RequestTrace, TRACER, TraceContext,
+                                         format_traceparent, span_id_hex)
 from transmogrifai_tpu.serving.batcher import ScoreError, bucket_for
 from transmogrifai_tpu.serving.http import (
     _columnar_payload, _JSONHandler, _row_payload)
 
 log = logging.getLogger(__name__)
 
-__all__ = ["Frontend", "FrontendHTTPServer", "serve_frontend"]
+__all__ = ["Frontend", "FrontendHTTPServer", "HTTPReplica",
+           "serve_frontend"]
 
 
 def _record_event(name: str, **attrs: Any) -> None:
@@ -60,12 +75,21 @@ class Frontend:
 
     def __init__(self, replicas: Dict[str, Any],
                  registry: Optional[MetricsRegistry] = None,
-                 refresh_s: float = 2.0, seed: int = 0):
+                 refresh_s: float = 2.0, seed: int = 0,
+                 store_dir: Optional[str] = None):
         if not replicas:
             raise ValueError("frontend needs at least one replica")
         self.replicas = dict(replicas)
         self.registry = registry or MetricsRegistry()
         self.refresh_s = float(refresh_s)
+        self.store_dir = store_dir
+        self.shard_writer = None
+        if store_dir:
+            # publish the frontend leg of sampled traces to the shared
+            # store so merge_fleet_trace can stitch across processes
+            from transmogrifai_tpu.obs.federate import TraceShardWriter
+            self.shard_writer = TraceShardWriter(store_dir, "frontend")
+            self.shard_writer.install()
         self._lock = threading.Lock()
         self._warmth: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
         self._refreshed = 0.0  # guarded-by: self._lock
@@ -215,20 +239,53 @@ class Frontend:
         m.inc()
 
     def _route_and_score(self, model: str, n_rows: int, wire: str,
-                         call) -> Any:
+                         call, trace: Optional[TraceContext] = None
+                         ) -> Any:
         t0 = time.monotonic()
-        with TRACER.span("router:route", category="router", model=model,
-                         wire=wire):
-            name, fleet, warm = self.route(model, n_rows)
-        (self._m_warm if warm else self._m_cold).inc()
-        self._count(name, wire)
+        rt = None
+        downstream = trace
+        if trace is not None and (trace.sampled
+                                  or trace.parent is not None):
+            # sampled cross-hop request: the frontend leg gets its own
+            # request root in the caller's trace, and the replica hop
+            # is re-parented under it (same trace id, root as parent)
+            # so merge_fleet_trace stitches frontend → replica
+            rt = RequestTrace(name="router:request", ctx=trace,
+                              rows=n_rows, model=model, wire=wire)
+            downstream = TraceContext(
+                trace_id=rt.trace_id,
+                parent_hex=span_id_hex(rt.root.span_id),
+                parent=rt.root, sampled=True)
         try:
-            result = call(fleet)
+            if rt is not None:
+                route_span = rt.child("router:route", model=model,
+                                      wire=wire)
+            else:
+                route_span = TRACER.span("router:route",
+                                         category="router",
+                                         model=model, wire=wire)
+            with route_span:
+                name, fleet, warm = self.route(model, n_rows)
+            (self._m_warm if warm else self._m_cold).inc()
+            self._count(name, wire)
+        except Exception:
+            if rt is not None:
+                rt.finish("internal")
+                TRACER.collect(rt.spans)
+            raise
+        try:
+            result = call(fleet, downstream)
         except ScoreError as e:
+            if rt is not None:
+                rt.finish(e.code)
+                TRACER.collect(rt.spans)
             _record_event("router_route", replica=name, model=model,
                           wire=wire, warm=warm, rows=n_rows,
                           outcome=e.code)
             raise
+        if rt is not None:
+            rt.finish()
+            TRACER.collect(rt.spans)
         self._m_latency.observe(time.monotonic() - t0)
         _record_event("router_route", replica=name, model=model,
                       wire=wire, warm=warm, rows=n_rows, outcome="ok")
@@ -241,9 +298,10 @@ class Frontend:
         model = self.resolve_route(model)
         return self._route_and_score(
             model, len(rows or ()), "json",
-            lambda fleet: fleet.score(model, rows, tenant=tenant,
-                                      deadline_ms=deadline_ms,
-                                      trace=trace))
+            lambda fleet, tr: fleet.score(model, rows, tenant=tenant,
+                                          deadline_ms=deadline_ms,
+                                          trace=tr),
+            trace=trace)
 
     def score_columns(self, model: str, columns: Dict[str, Any],
                       tenant: Optional[str] = None,
@@ -257,10 +315,10 @@ class Frontend:
             break
         return self._route_and_score(
             model, n_rows, wire,
-            lambda fleet: fleet.score_columns(model, columns,
-                                              tenant=tenant,
-                                              deadline_ms=deadline_ms,
-                                              trace=trace))
+            lambda fleet, tr: fleet.score_columns(
+                model, columns, tenant=tenant,
+                deadline_ms=deadline_ms, trace=tr),
+            trace=trace)
 
     def score_frame(self, frame: bytes,
                     trace: Optional[TraceContext] = None):
@@ -311,6 +369,151 @@ class Frontend:
             merged.merge(fleet.registry, replica=name)
         return merged
 
+    def fleet_metrics_json(self) -> Dict[str, Any]:
+        """Federated metrics: fold every replica's PUBLISHED snapshot
+        from the shared store (obs.federate) with the frontend's own
+        router_* series. Unlike merged_registry() this reaches replicas
+        in OTHER processes — HTTPReplica handles carry an empty local
+        registry, their real series arrive through the store."""
+        if not self.store_dir:
+            raise ScoreError(
+                "not_found",
+                "frontend has no store_dir: metrics federation is off")
+        from transmogrifai_tpu.obs.federate import aggregate_fleet_metrics
+        merged, info = aggregate_fleet_metrics(self.store_dir)
+        merged.merge(self.registry, replica="frontend")
+        return {"replicas": info, "fleet": merged.to_json()}
+
+    def close(self) -> None:
+        """Tear down the frontend's trace-shard sink (no-op without a
+        store_dir)."""
+        if self.shard_writer is not None:
+            self.shard_writer.close()
+            self.shard_writer = None
+
+
+class _RemoteResult:
+    """Scoring result decoded from a replica's HTTP response — the
+    slice of the in-process result surface the frontend handler reads
+    (`rows()`, `model_version`, `latency_s`, trace echo)."""
+
+    def __init__(self, payload: Dict[str, Any],
+                 headers: Dict[str, str]):
+        self._scores = payload.get("scores")
+        self.model_version = payload.get("model_version")
+        self.latency_s = float(payload.get("latency_ms") or 0.0) / 1000.0
+        self.traceparent = headers.get("traceparent")
+        self.trace_id = (payload.get("trace_id")
+                         if self.traceparent else None)
+
+    def rows(self) -> Any:
+        return self._scores
+
+
+class HTTPReplica:
+    """URL-backed replica handle: the `health()`/`score*()` surface a
+    `Frontend` consumes, served by a remote fleet's HTTP endpoint
+    (serving/http.py `serve_fleet`). Forwards the downstream
+    `TraceContext` as a W3C ``traceparent`` header so the replica's leg
+    of a sampled request lands in ITS trace shard under the frontend's
+    trace id — `obs.federate.merge_fleet_trace` does the stitching.
+
+    Carries an empty local `registry` (satisfies `merged_registry()`);
+    the replica's real series federate through the store
+    (`/metrics/fleet`), not through this handle."""
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self.registry = MetricsRegistry()
+
+    @staticmethod
+    def _trace_header(trace: Optional[TraceContext]
+                      ) -> Optional[str]:
+        if trace is None or not trace.trace_id:
+            return None
+        if trace.parent is not None:
+            return format_traceparent(trace.trace_id,
+                                      trace.parent.span_id,
+                                      sampled=trace.sampled)
+        if trace.parent_hex:
+            return format_traceparent(trace.trace_id, trace.parent_hex,
+                                      sampled=trace.sampled)
+        return None
+
+    def _request(self, method: str, path: str,
+                 body: Optional[bytes] = None,
+                 headers: Optional[Dict[str, str]] = None
+                 ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(self.base_url + path, data=body,
+                                     headers=dict(headers or {}),
+                                     method=method)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                payload = json.loads(resp.read().decode("utf-8"))
+                return resp.status, payload, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode("utf-8"))
+            except Exception:
+                payload = {"error": "internal",
+                           "message": f"replica HTTP {e.code}"}
+            return e.code, payload, dict(e.headers or {})
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise ScoreError("internal",
+                             f"replica {self.base_url}{path}: {e}")
+
+    def _score_request(self, payload: Dict[str, Any],
+                       trace: Optional[TraceContext]) -> _RemoteResult:
+        headers = {"Content-Type": "application/json"}
+        tp = self._trace_header(trace)
+        if tp:
+            headers["traceparent"] = tp
+        status, body, resp_headers = self._request(
+            "POST", "/score", json.dumps(payload).encode("utf-8"),
+            headers)
+        if status != 200:
+            retry = resp_headers.get("Retry-After")
+            raise ScoreError(
+                str(body.get("error") or "internal"),
+                str(body.get("message") or f"replica HTTP {status}"),
+                retry_after_s=float(retry) if retry else None)
+        return _RemoteResult(body, resp_headers)
+
+    def health(self) -> Dict[str, Any]:
+        # both 200 (ok/degraded) and 503 (down) carry the health body
+        _, body, _ = self._request("GET", "/healthz")
+        return body
+
+    def score(self, model: str, rows: List[Dict[str, Any]],
+              tenant: Optional[str] = None,
+              deadline_ms: Optional[float] = None,
+              trace: Optional[TraceContext] = None) -> _RemoteResult:
+        payload: Dict[str, Any] = {"model": model, "rows": rows}
+        if tenant:
+            payload["tenant"] = tenant
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        return self._score_request(payload, trace)
+
+    def score_columns(self, model: str, columns: Dict[str, Any],
+                      tenant: Optional[str] = None,
+                      deadline_ms: Optional[float] = None,
+                      trace: Optional[TraceContext] = None
+                      ) -> _RemoteResult:
+        cols = {k: (list(v) if hasattr(v, "__len__")
+                    and not isinstance(v, list) else v)
+                for k, v in (columns or {}).items()}
+        payload: Dict[str, Any] = {"model": model, "columns": cols}
+        if tenant:
+            payload["tenant"] = tenant
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        return self._score_request(payload, trace)
+
 
 class FrontendHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer carrying the Frontend reference."""
@@ -336,7 +539,9 @@ class _FrontendHandler(_JSONHandler):
     - ``GET /healthz`` aggregated replica health (200 while ANY replica
       serves);
     - ``GET /warmth``  the routing table the frontend decides with;
-    - ``GET /metrics`` fleet-wide merged exposition (?format=json).
+    - ``GET /metrics`` fleet-wide merged exposition (?format=json);
+    - ``GET /metrics/fleet`` federated exposition from the replicas'
+      store-published snapshots (cross-process; 404 without a store).
     """
 
     @property
@@ -349,6 +554,11 @@ class _FrontendHandler(_JSONHandler):
             self._send_health(self.frontend.health())
         elif path == "/warmth":
             self._send_json(200, {"replicas": self.frontend.warmth()})
+        elif path == "/metrics/fleet":
+            try:
+                self._send_json(200, self.frontend.fleet_metrics_json())
+            except ScoreError as e:
+                self._send_error(e)
         elif path == "/metrics":
             merged = self.frontend.merged_registry()
             if "format=json" in query:

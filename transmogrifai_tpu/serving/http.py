@@ -416,6 +416,10 @@ class _FleetHandler(_JSONHandler):
     - ``GET /models``   model listing only.
     - ``GET /metrics``  fleet+process Prometheus text; ``?format=json``
       nests per-model registries under their names.
+    - ``GET /metrics/fleet``  the FEDERATED view: every replica's
+      published `MetricsRegistry` snapshot merged (counters summed,
+      histograms bucket-merged, gauges replica-labeled) — 404 without
+      a configured store_dir.
     - ``POST /reload``  ``{"model": "name", "model_location": "dir"}``
       rolling swap of ONE member, or ``{"model": ..., "rollback":
       true}``.
@@ -433,6 +437,13 @@ class _FleetHandler(_JSONHandler):
             self._send_slo(self.fleet.slo_engine)
         elif path == "/models":
             self._send_json(200, {"models": self.fleet.models()})
+        elif path == "/metrics/fleet":
+            # the federated view: every replica's published snapshot
+            # merged (404 without a shared store)
+            try:
+                self._send_json(200, self.fleet.fleet_metrics_json())
+            except ScoreError as e:
+                self._send_error(e)
         elif path == "/metrics":
             if "format=json" in query:
                 self._send_json(200, fleet_metrics_json(self.fleet))

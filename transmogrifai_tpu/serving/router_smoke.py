@@ -17,7 +17,11 @@ prove the acceptance surface of the shared state plane + router tier
    frontend);
 4. under concurrent mixed-wire load through the frontend HTTP server,
    binary-framed requests score BIT-IDENTICALLY to the JSON columnar
-   wire.
+   wire;
+5. split overload across replicas: each replica's burn stays under the
+   multi-window threshold locally (errors never sit in both of its
+   windows at once), but the fleet-folded burn crosses in BOTH windows
+   — the fleet alert fires, exactly once, via the CAS latch.
 
 Run: ``JAX_PLATFORMS=cpu python -m transmogrifai_tpu.serving.router_smoke``
 """
@@ -225,12 +229,89 @@ def main() -> int:  # noqa: C901 (one linear acceptance script)
             r1.stop()
             r2.stop()
 
+        # -- 5: split overload — fleet burn fires ONCE, locals never -- #
+        # rA's errors all land early: while they sit in its short
+        # window its long window is diluted by warm-up traffic, and by
+        # the time the long window slides past the warm-up the short
+        # window has drained — never both at once. rB errors late and
+        # little: its short window spikes but its long window stays
+        # diluted. The fleet fold SUMS the counters: once the clean
+        # warm-up slides out of the long window, fleet burn crosses the
+        # threshold in BOTH windows and exactly one replica's engine
+        # wins the CAS latch.
+        from transmogrifai_tpu.obs.federate import FleetAlertLatch
+        from transmogrifai_tpu.obs.slo import SLOEngine, SLOParams
+
+        slo_store = f"{tmp}/slo-store"
+        params = SLOParams.from_json({
+            "slos": [{"name": "fleet-avail", "kind": "availability",
+                      "objective": 0.9}],
+            "windows": [[8.0, 2.0, 2.0, "page"]],
+            "eval_period_s": 0.25})
+        counters = {"rA": [0.0, 0.0], "rB": [0.0, 0.0]}  # [good, total]
+
+        def source(nm: str):
+            return lambda: tuple(counters[nm])
+
+        engines = {}
+        for nm in ("rA", "rB"):
+            eng = SLOEngine(params)
+            eng.set_source("fleet-avail", source(nm))
+            eng.attach_fleet(slo_store, nm, name="router-split")
+            engines[nm] = eng
+
+        def add(nm: str, good: int, bad: int) -> None:
+            counters[nm][0] += good
+            counters[nm][1] += good + bad
+
+        local_fired = []
+        dt = 0.25
+        for k in range(1, 43):  # t = 0.25 .. 10.5
+            t = k * dt
+            if t <= 2.0:        # warm-up: both clean
+                add("rA", 25, 0)
+                add("rB", 25, 0)
+            elif t <= 4.0:      # rA's overload burst
+                add("rA", 0, 5)
+                add("rB", 10, 0)
+            elif t <= 8.0:      # quiet middle: rA trickles, rB serves
+                add("rA", 1, 0)
+                add("rB", 10, 0)
+            elif t <= 10.0:     # rB's (small) overload burst
+                add("rA", 1, 0)
+                add("rB", 0, 5)
+            # else: two settle ticks, no traffic, so BOTH engines see
+            # the final counters after the other's last publish
+            for nm, eng in engines.items():
+                st = eng.evaluate(now=t)["slos"]["fleet-avail"]
+                if st["state"] == "firing":
+                    local_fired.append((nm, t))
+
+        assert not local_fired, \
+            (f"local burn crossed the multi-window threshold on "
+             f"{local_fired[:4]} — the split overload should only be "
+             f"visible fleet-wide")
+        for nm, eng in engines.items():
+            st = eng.evaluate(now=10.5)["slos"]["fleet-avail"]
+            assert st["alerts"] == 0, (nm, st["alerts"])
+            fleet_view = st.get("fleet") or {}
+            assert fleet_view.get("state") == "firing", (nm, fleet_view)
+            assert fleet_view.get("replicas") == 2, (nm, fleet_view)
+        latch_counts = FleetAlertLatch(
+            slo_store, name="router-split").counts()
+        row = latch_counts.get("fleet-avail") or {}
+        assert row.get("state") == "firing" and row.get("fired") == 1, \
+            (f"fleet alert must fire exactly once across both "
+             f"replicas: {latch_counts}")
+
     print(f"router-smoke OK: replica-2 artifact replay "
           f"{r2_s:.2f}s vs warm {warm_s:.2f}s ({ratio:.2f}x, bar 1.5x; "
           f"cold was {cold_s:.2f}s); over-quota tenant denied by BOTH "
           f"replicas ({denied}) and 429'd by the frontend; 40 "
           f"concurrent mixed-wire requests bit-identical across "
-          f"binary/JSON")
+          f"binary/JSON; split overload fired the FLEET alert exactly "
+          f"once (owner={row.get('owner')}) while both local engines "
+          f"stayed quiet")
     return 0
 
 

@@ -198,12 +198,19 @@ class LeaseTable:
         self._cell.update(transform)
         self.cas_rounds += 1
 
-    def claim(self, prefer: Optional[List[str]] = None) -> Optional[str]:
+    def claim(self, prefer: Optional[List[str]] = None,
+              meta: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """CAS-claim one block: a pool block, else an EXPIRED lease
         (takeover). `prefer` orders the scan (a host tries its own plan
         slice first, then steals), making claim order deterministic
         under no contention. Returns the claimed key, or None when
-        nothing is claimable right now (all leased-and-live or done)."""
+        nothing is claimable right now (all leased-and-live or done).
+
+        `meta` (JSON-safe dict) is stamped into the leased block —
+        e.g. the claimer's ambient ``traceparent`` so a cross-host
+        trace merge can attribute the lease to the request that drove
+        it. Merged INSIDE the transform: lease transforms replace
+        block dicts wholesale, so the stamp survives CAS retries."""
         got: Dict[str, Any] = {"key": None, "takeover": False}
 
         def transform(value):
@@ -219,9 +226,12 @@ class LeaseTable:
                 expired = (state == "leased"
                            and float(b.get("deadline", 0.0)) < now)
                 if state == "pool" or expired:
-                    blocks[k] = {"state": "leased", "owner": self.owner,
-                                 "deadline": now + self.ttl_s,
-                                 "attempts": int(b.get("attempts", 0)) + 1}
+                    lease = {"state": "leased", "owner": self.owner,
+                             "deadline": now + self.ttl_s,
+                             "attempts": int(b.get("attempts", 0)) + 1}
+                    if meta:
+                        lease.update(meta)
+                    blocks[k] = lease
                     got["key"] = k
                     got["takeover"] = expired
                     break
@@ -233,12 +243,13 @@ class LeaseTable:
             self.takeovers += 1
         return got["key"]
 
-    def acquire(self, key: str) -> str:
+    def acquire(self, key: str,
+                meta: Optional[Dict[str, Any]] = None) -> str:
         """Targeted claim of one block: ``acquired`` (was pool),
         ``takeover`` (expired foreign lease), ``held`` (our own live
         lease, deadline renewed — two lanes of one host may pass the
         same requeued block), ``busy`` (live foreign lease), ``done``,
-        ``failed``, or ``missing``."""
+        ``failed``, or ``missing``. `meta` as in :meth:`claim`."""
         out = {"status": "missing"}
 
         def transform(value):
@@ -264,9 +275,12 @@ class LeaseTable:
             attempts = int(b.get("attempts", 0))
             if out["status"] != "held":
                 attempts += 1
-            blocks[key] = {"state": "leased", "owner": self.owner,
-                           "deadline": now + self.ttl_s,
-                           "attempts": attempts}
+            lease = {"state": "leased", "owner": self.owner,
+                     "deadline": now + self.ttl_s,
+                     "attempts": attempts}
+            if meta:
+                lease.update(meta)
+            blocks[key] = lease
             return {"blocks": blocks}
 
         self._cell.update(transform)
